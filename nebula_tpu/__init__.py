@@ -1,0 +1,11 @@
+"""nebula-tpu: a TPU-native distributed graph database framework.
+
+A ground-up re-design of the capabilities of Nebula Graph v1.0.0-beta
+(see SURVEY.md): an nGQL query frontend, a stateless graph query engine,
+hash-partitioned Raft-replicated storage, a meta/catalog service — and a
+TPU traversal backend that executes multi-hop GO / FIND SHORTEST PATH as
+batched-BFS frontier expansion over HBM-resident CSR edge partitions in
+JAX/XLA/Pallas, exchanging cross-partition frontiers over ICI collectives.
+"""
+
+__version__ = "0.1.0"

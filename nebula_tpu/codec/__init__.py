@@ -1,0 +1,2 @@
+from .rows import (RowWriter, RowReader, RowSetWriter, RowSetReader,
+                   RowUpdater, encode_row, decode_row)

@@ -1,0 +1,3 @@
+from .status import Status, StatusOr, ErrorCode
+from .keys import KeyUtils
+from .clock import Duration, now_micros, inverted_version

@@ -1,0 +1,38 @@
+"""Wall-clock helpers (reference: src/common/time/{Duration,WallClock}.h).
+
+``Duration`` stamps every query/storage response's latency_in_us; inverted
+versions order multi-version rows latest-first in key space.
+"""
+from __future__ import annotations
+
+import time
+
+INT64_MAX = (1 << 63) - 1
+
+
+def now_micros() -> int:
+    """WallClock::fastNowInMicroSec equivalent."""
+    return time.time_ns() // 1000
+
+
+def inverted_version(micros: int | None = None) -> int:
+    """int64max - now_us — latest version sorts first (AddVerticesProcessor.cpp:30)."""
+    return INT64_MAX - (now_micros() if micros is None else micros)
+
+
+class Duration:
+    """Elapsed-microseconds timer (reference time/Duration.h)."""
+
+    __slots__ = ("_start",)
+
+    def __init__(self):
+        self._start = time.perf_counter_ns()
+
+    def reset(self) -> None:
+        self._start = time.perf_counter_ns()
+
+    def elapsed_in_usec(self) -> int:
+        return (time.perf_counter_ns() - self._start) // 1000
+
+    def elapsed_in_msec(self) -> int:
+        return self.elapsed_in_usec() // 1000

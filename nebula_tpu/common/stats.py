@@ -1,0 +1,133 @@
+"""StatsManager — registered counters with sliding time-window histograms.
+
+Capability parity with the reference (src/common/stats/StatsManager.h:24-96):
+  * register a counter or histogram once, add values from any thread,
+  * read back with the string syntax
+        "<name>.{sum|count|avg|rate|pNN}.{5|60|600|3600}"
+    where the trailing number selects the sliding window in seconds.
+
+Design: per-stat ring of one-second buckets (3600 of them) holding
+(sum, count) plus a bounded per-bucket sample reservoir for percentiles —
+no global locks on the read path, one small lock per stat on write.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_WINDOWS = (5, 60, 600, 3600)
+_RING = 3600
+_MAX_SAMPLES_PER_BUCKET = 256
+
+
+class _Stat:
+    __slots__ = ("lock", "sums", "counts", "samples", "stamps")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sums = [0.0] * _RING
+        self.counts = [0] * _RING
+        self.samples: List[List[float]] = [[] for _ in range(_RING)]
+        self.stamps = [0] * _RING  # epoch second each bucket last belonged to
+
+    def add(self, value: float, now: Optional[float] = None) -> None:
+        sec = int(now if now is not None else time.time())
+        idx = sec % _RING
+        with self.lock:
+            if self.stamps[idx] != sec:
+                self.stamps[idx] = sec
+                self.sums[idx] = 0.0
+                self.counts[idx] = 0
+                self.samples[idx] = []
+            self.sums[idx] += value
+            self.counts[idx] += 1
+            bucket = self.samples[idx]
+            if len(bucket) < _MAX_SAMPLES_PER_BUCKET:
+                bucket.append(value)
+
+    def window(self, seconds: int, now: Optional[float] = None) -> Tuple[float, int, List[float]]:
+        sec = int(now if now is not None else time.time())
+        total, count, vals = 0.0, 0, []
+        with self.lock:
+            for off in range(min(seconds, _RING)):
+                idx = (sec - off) % _RING
+                if self.stamps[idx] == sec - off:
+                    total += self.sums[idx]
+                    count += self.counts[idx]
+                    vals.extend(self.samples[idx])
+        return total, count, vals
+
+
+class StatsManager:
+    """Process-global registry. Use the module-level singleton ``stats``."""
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def register_stats(self, name: str) -> str:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = _Stat()
+        return name
+
+    def add_value(self, name: str, value: float = 1.0) -> None:
+        stat = self._stats.get(name)
+        if stat is None:
+            stat = self._stats.setdefault(name, _Stat())
+        stat.add(value)
+
+    def read_stats(self, expr: str, now: Optional[float] = None) -> Optional[float]:
+        """Evaluate "name.method.window" (StatsManager.h:67-96)."""
+        parts = expr.rsplit(".", 2)
+        if len(parts) != 3:
+            return None
+        name, method, window_s = parts
+        try:
+            window = int(window_s)
+        except ValueError:
+            return None
+        stat = self._stats.get(name)
+        if stat is None or window not in _WINDOWS:
+            return None
+        total, count, vals = stat.window(window, now)
+        if method == "sum":
+            return total
+        if method == "count":
+            return float(count)
+        if method == "avg":
+            return total / count if count else 0.0
+        if method == "rate":
+            return total / window
+        if method.startswith("p") and method[1:].isdigit():
+            if not vals:
+                return 0.0
+            vals.sort()
+            q = min(int(method[1:]), 100) / 100.0
+            pos = q * (len(vals) - 1)
+            i = int(pos)
+            frac = pos - i
+            if i + 1 < len(vals):
+                return vals[i] * (1 - frac) + vals[i + 1] * frac
+            return vals[i]
+        return None
+
+    def dump(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
+        """All stats over the 60 s window — feeds /get_stats (webservice)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name in list(self._stats):
+            total, count, _ = self._stats[name].window(60, now)
+            out[name] = {
+                "sum.60": total,
+                "count.60": float(count),
+                "avg.60": total / count if count else 0.0,
+                "rate.60": total / 60.0,
+            }
+        return out
+
+    def names(self) -> List[str]:
+        return sorted(self._stats)
+
+
+stats = StatsManager()

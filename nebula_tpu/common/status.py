@@ -1,0 +1,188 @@
+"""Status / StatusOr / ErrorCode — the framework-wide result types.
+
+Capability parity with the reference's src/common/base/Status.h and
+StatusOr.h plus the thrift ErrorCode enums (common.thrift, storage.thrift,
+meta.thrift in /root/reference/src/interface): every service call returns a
+Status-bearing result so errors (leader changes, schema misses, parse
+failures) propagate without exceptions across RPC seams.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ErrorCode(enum.IntEnum):
+    """Unified error space across graph/storage/meta/raft services.
+
+    Mirrors the capability of the per-service thrift ErrorCode enums
+    (reference: interface/graph.thrift:13-32, storage.thrift:15-45,
+    meta.thrift:15-34) collapsed into one namespace.
+    """
+
+    SUCCEEDED = 0
+
+    # Generic
+    E_DISCONNECTED = -1
+    E_FAIL_TO_CONNECT = -2
+    E_RPC_FAILURE = -3
+    E_BAD_USERNAME_PASSWORD = -4
+    E_SESSION_INVALID = -5
+    E_SESSION_TIMEOUT = -6
+    E_SYNTAX_ERROR = -7
+    E_EXECUTION_ERROR = -8
+    E_STATEMENT_EMPTY = -9
+    E_INTERNAL_ERROR = -10
+
+    # Storage
+    E_KEY_NOT_FOUND = -100
+    E_PART_NOT_FOUND = -101
+    E_SPACE_NOT_FOUND = -102
+    E_LEADER_CHANGED = -103
+    E_KEY_HAS_EXISTS = -104
+    E_CONSENSUS_ERROR = -105
+    E_EDGE_PROP_NOT_FOUND = -106
+    E_TAG_PROP_NOT_FOUND = -107
+    E_IMPROPER_DATA_TYPE = -108
+    E_FILTER_OUT = -109
+    E_INVALID_FILTER = -110
+
+    # Meta
+    E_NO_HOSTS = -200
+    E_EXISTED = -201
+    E_NOT_FOUND = -202
+    E_INVALID_HOST = -203
+    E_UNSUPPORTED = -204
+    E_NO_VALID_HOST = -205
+    E_WRONGCLUSTER = -206
+    E_SCHEMA_NOT_FOUND = -207
+    E_BALANCED = -208
+    E_BALANCER_RUNNING = -209
+    E_BAD_BALANCE_PLAN = -210
+    E_NO_RUNNING_BALANCE_PLAN = -211
+
+    # Raft
+    E_LOG_GAP = -300
+    E_LOG_STALE = -301
+    E_TERM_OUT_OF_DATE = -302
+    E_WAITING_SNAPSHOT = -303
+    E_BAD_STATE = -304
+    E_WAL_FAIL = -305
+    E_NOT_A_LEADER = -306
+    E_HOST_STOPPED = -307
+    E_NOT_READY = -308
+    E_BUFFER_OVERFLOW = -309
+
+    E_UNKNOWN = -999
+
+
+class Status:
+    """Cheap ok/error value. ``Status.OK()`` is a shared singleton."""
+
+    __slots__ = ("code", "msg")
+
+    _OK: Optional["Status"] = None
+
+    def __init__(self, code: ErrorCode = ErrorCode.SUCCEEDED, msg: str = ""):
+        self.code = code
+        self.msg = msg
+
+    # -- constructors -------------------------------------------------
+    @classmethod
+    def OK(cls) -> "Status":
+        if cls._OK is None:
+            cls._OK = cls()
+        return cls._OK
+
+    @classmethod
+    def Error(cls, msg: str, code: ErrorCode = ErrorCode.E_INTERNAL_ERROR) -> "Status":
+        return cls(code, msg)
+
+    @classmethod
+    def SyntaxError(cls, msg: str) -> "Status":
+        return cls(ErrorCode.E_SYNTAX_ERROR, msg)
+
+    @classmethod
+    def NotFound(cls, msg: str = "not found") -> "Status":
+        return cls(ErrorCode.E_NOT_FOUND, msg)
+
+    @classmethod
+    def SpaceNotFound(cls, msg: str = "space not found") -> "Status":
+        return cls(ErrorCode.E_SPACE_NOT_FOUND, msg)
+
+    @classmethod
+    def LeaderChanged(cls, msg: str = "leader changed") -> "Status":
+        return cls(ErrorCode.E_LEADER_CHANGED, msg)
+
+    # -- predicates ---------------------------------------------------
+    def ok(self) -> bool:
+        return self.code == ErrorCode.SUCCEEDED
+
+    def __bool__(self) -> bool:
+        return self.ok()
+
+    def __repr__(self) -> str:
+        if self.ok():
+            return "Status::OK"
+        return f"Status({self.code.name}: {self.msg})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Status) and self.code == other.code
+
+    def __hash__(self) -> int:
+        return hash(self.code)
+
+    def to_string(self) -> str:
+        return "OK" if self.ok() else f"{self.code.name}: {self.msg}"
+
+
+class StatusOr(Generic[T]):
+    """Either a value or an error Status (reference StatusOr.h)."""
+
+    __slots__ = ("_status", "_value")
+
+    def __init__(self, status_or_value):
+        if isinstance(status_or_value, Status):
+            assert not status_or_value.ok(), "use StatusOr.of(value) for ok results"
+            self._status = status_or_value
+            self._value = None
+        else:
+            self._status = Status.OK()
+            self._value = status_or_value
+
+    @classmethod
+    def of(cls, value: T) -> "StatusOr[T]":
+        s = cls.__new__(cls)
+        s._status = Status.OK()
+        s._value = value
+        return s
+
+    @classmethod
+    def error(cls, status: Status) -> "StatusOr[T]":
+        s = cls.__new__(cls)
+        s._status = status
+        s._value = None
+        return s
+
+    def ok(self) -> bool:
+        return self._status.ok()
+
+    def __bool__(self) -> bool:
+        return self.ok()
+
+    @property
+    def status(self) -> Status:
+        return self._status
+
+    def value(self) -> T:
+        if not self._status.ok():
+            raise RuntimeError(f"value() on error StatusOr: {self._status}")
+        return self._value
+
+    def value_or(self, default: T) -> T:
+        return self._value if self._status.ok() else default
+
+    def __repr__(self) -> str:
+        return f"StatusOr({self._value!r})" if self.ok() else f"StatusOr({self._status!r})"

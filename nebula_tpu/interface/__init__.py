@@ -1,0 +1,2 @@
+from .common import (SupportedType, PropValue, ColumnDef, Schema, SchemaProp,
+                     HostAddr, AlterSchemaOp, RoleType, ConfigModule, ConfigMode)

@@ -1,0 +1,152 @@
+"""Shared wire-contract types.
+
+Capability parity with /root/reference/src/interface/common.thrift:14-87
+(GraphSpaceID/PartitionID/TagID/EdgeType/EdgeRanking/VertexID typedefs,
+SupportedType, ColumnDef/Schema/SchemaProp with TTL, HostAddr) and the small
+shared enums from meta.thrift (AlterSchemaOp:45-50, RoleType:60-65,
+ConfigModule/ConfigMode:440-459).
+
+These are plain dataclasses; the TCP transport serializes them with msgpack
+(see nebula_tpu/interface/rpc.py). Schemas here are also the source of truth
+for the TPU property-column layout: each SupportedType maps to a device
+dtype (to_dtype) so a Schema directly describes a struct-of-arrays block in
+HBM.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+# typedefs (common.thrift:14-20): all ids are ints
+GraphSpaceID = int
+PartitionID = int
+TagID = int
+EdgeType = int
+EdgeRanking = int
+VertexID = int
+SchemaVer = int
+ClusterID = int
+
+
+class SupportedType(enum.IntEnum):
+    """common.thrift:22-43 (subset actually used by the reference)."""
+    UNKNOWN = 0
+    BOOL = 1
+    INT = 2
+    VID = 3
+    FLOAT = 4
+    DOUBLE = 5
+    STRING = 6
+    TIMESTAMP = 21
+
+    def to_dtype(self) -> str:
+        """Device column dtype for the TPU prop store (strings dict-encoded)."""
+        return {
+            SupportedType.BOOL: "bool",
+            SupportedType.INT: "int64",
+            SupportedType.VID: "int64",
+            SupportedType.TIMESTAMP: "int64",
+            SupportedType.FLOAT: "float32",
+            SupportedType.DOUBLE: "float32",
+            SupportedType.STRING: "int32",  # dictionary code
+        }[self]
+
+
+PropValue = Union[bool, int, float, str]
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type: SupportedType
+    default: Optional[PropValue] = None
+
+
+@dataclass
+class SchemaProp:
+    """TTL properties (common.thrift:59-66)."""
+    ttl_duration: Optional[int] = None
+    ttl_col: Optional[str] = None
+
+
+@dataclass
+class Schema:
+    """A versioned tag/edge schema (common.thrift:68-72).
+
+    Also acts as the reference's SchemaProviderIf (meta/SchemaProviderIf.h):
+    field lookup by name/index for the row codec.
+    """
+    columns: List[ColumnDef] = field(default_factory=list)
+    schema_prop: SchemaProp = field(default_factory=SchemaProp)
+    version: SchemaVer = 0
+
+    def __post_init__(self):
+        self._index: Dict[str, int] = {c.name: i for i, c in enumerate(self.columns)}
+
+    def num_fields(self) -> int:
+        return len(self.columns)
+
+    def field_index(self, name: str) -> int:
+        return self._index.get(name, -1)
+
+    def field_name(self, i: int) -> str:
+        return self.columns[i].name
+
+    def field_type(self, i: int) -> SupportedType:
+        return self.columns[i].type
+
+    def get_field(self, name: str) -> Optional[ColumnDef]:
+        i = self.field_index(name)
+        return self.columns[i] if i >= 0 else None
+
+    def names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+
+@dataclass(frozen=True)
+class HostAddr:
+    """(ip, port) — common.thrift:74-77. We keep host as str for sanity."""
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @staticmethod
+    def parse(s: str) -> "HostAddr":
+        h, p = s.rsplit(":", 1)
+        return HostAddr(h, int(p))
+
+
+class AlterSchemaOp(enum.IntEnum):  # meta.thrift:45-50
+    ADD = 1
+    CHANGE = 2
+    DROP = 3
+
+
+class RoleType(enum.IntEnum):  # meta.thrift:60-65
+    GOD = 1
+    ADMIN = 2
+    USER = 3
+    GUEST = 4
+
+
+class ConfigModule(enum.IntEnum):  # meta.thrift:440-446
+    ALL = 0
+    GRAPH = 1
+    META = 2
+    STORAGE = 3
+
+
+class ConfigMode(enum.IntEnum):  # meta.thrift:455-459
+    IMMUTABLE = 0
+    REBOOT = 1
+    MUTABLE = 2
+
+
+class ConfigType(enum.IntEnum):  # meta.thrift:448-453
+    INT64 = 0
+    DOUBLE = 1
+    BOOL = 2
+    STRING = 3

@@ -1,0 +1,17 @@
+"""Test config: force an 8-device virtual CPU mesh so multi-chip sharding
+paths (frontier all_to_all/psum over a Mesh) run without TPU hardware.
+
+Mirrors the reference's strategy of in-process multi-instance harnesses
+(SURVEY.md §4): our "cluster" tests also run all daemons in one process.
+"""
+import os
+import sys
+
+# Must happen before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,135 @@
+"""Row codec tests — modeled on the reference's dataman test tier
+(RowReaderTest/RowWriterTest/RowUpdaterTest, SURVEY.md §4)."""
+import pytest
+
+from nebula_tpu.codec.rows import (RowReader, RowSetReader, RowSetWriter,
+                                   RowUpdater, RowWriter, decode_row,
+                                   encode_row)
+from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+
+PLAYER = Schema(columns=[
+    ColumnDef("name", SupportedType.STRING),
+    ColumnDef("age", SupportedType.INT),
+    ColumnDef("mvp", SupportedType.BOOL),
+    ColumnDef("ppg", SupportedType.DOUBLE),
+], version=0)
+
+
+def test_roundtrip_all_types():
+    row = (RowWriter(PLAYER)
+           .set("name", "Tim Duncan")
+           .set("age", 42)
+           .set("mvp", True)
+           .set("ppg", 19.0)
+           .encode())
+    r = RowReader(row, PLAYER)
+    assert r.get("name") == "Tim Duncan"
+    assert r.get("age") == 42
+    assert r.get("mvp") is True
+    assert r.get("ppg") == 19.0
+    assert r.to_dict() == {"name": "Tim Duncan", "age": 42, "mvp": True, "ppg": 19.0}
+
+
+def test_negative_and_large_ints():
+    s = Schema(columns=[ColumnDef("x", SupportedType.INT)])
+    for v in (0, -1, 1, 2**62, -(2**62), 127, -128):
+        row = encode_row(s, {"x": v})
+        assert decode_row(row, s)["x"] == v
+
+
+def test_defaults_for_unset_fields():
+    row = RowWriter(PLAYER).set("age", 30).encode()
+    r = RowReader(row, PLAYER)
+    assert r.get("name") == ""
+    assert r.get("mvp") is False
+    assert r.get("ppg") == 0.0
+    assert r.get("age") == 30
+
+
+def test_column_default_values():
+    s = Schema(columns=[ColumnDef("n", SupportedType.INT, default=7)])
+    assert decode_row(encode_row(s, {}), s)["n"] == 7
+
+
+def test_unknown_field_raises():
+    with pytest.raises(KeyError):
+        RowWriter(PLAYER).set("nope", 1)
+    r = RowReader(RowWriter(PLAYER).encode(), PLAYER)
+    with pytest.raises(KeyError):
+        r.get("nope")
+    assert r.get("nope", default=5) == 5
+
+
+def test_schema_version_resolution():
+    v0 = Schema(columns=[ColumnDef("a", SupportedType.INT)], version=0)
+    v1 = Schema(columns=[ColumnDef("a", SupportedType.INT),
+                         ColumnDef("b", SupportedType.STRING)], version=1)
+    versions = {0: v0, 1: v1}
+    row0 = encode_row(v0, {"a": 1})
+    row1 = encode_row(v1, {"a": 2, "b": "hi"})
+    r0 = RowReader.from_resolver(row0, versions.get)
+    r1 = RowReader.from_resolver(row1, versions.get)
+    assert r0.row_version == 0 and r0.get("a") == 1
+    assert r1.row_version == 1 and r1.get("b") == "hi"
+
+
+def test_lazy_offsets():
+    row = (RowWriter(PLAYER).set("name", "x" * 1000).set("age", 1).encode())
+    r = RowReader(row, PLAYER)
+    # reading field 0 shouldn't have indexed past field 1
+    assert r.get_by_index(0) == "x" * 1000
+    assert len(r._offsets) <= 2
+    assert r.get_by_index(3) == 0.0
+    assert r.size() == len(row)
+
+
+def test_row_updater():
+    row = RowWriter(PLAYER).set("name", "Tony").set("age", 36).encode()
+    u = RowUpdater(PLAYER, row)
+    u.set("age", 37)
+    out = RowReader(u.encode(), PLAYER)
+    assert out.get("age") == 37
+    assert out.get("name") == "Tony"
+
+
+def test_rowset_roundtrip():
+    w = RowSetWriter()
+    rows = [encode_row(PLAYER, {"name": f"p{i}", "age": i}) for i in range(10)]
+    for row in rows:
+        w.add_row(row)
+    assert w.count == 10
+    got = list(RowSetReader(w.data()))
+    assert got == rows
+
+
+def test_empty_rowset():
+    assert list(RowSetReader(b"")) == []
+
+
+def test_old_row_reads_new_schema_defaults():
+    # ALTER ADD appends columns; rows written before the alter must read
+    # the new column's default (reference RowReader semantics).
+    v0 = Schema(columns=[ColumnDef("a", SupportedType.INT)], version=0)
+    v1 = Schema(columns=[ColumnDef("a", SupportedType.INT),
+                         ColumnDef("b", SupportedType.STRING),
+                         ColumnDef("c", SupportedType.INT, default=9)], version=1)
+    old_row = encode_row(v0, {"a": 4})
+    r = RowReader(old_row, v1)
+    assert r.get("a") == 4
+    assert r.get("b") == ""
+    assert r.get("c") == 9
+
+
+def test_int64_overflow_raises():
+    s = Schema(columns=[ColumnDef("x", SupportedType.INT)])
+    with pytest.raises(OverflowError):
+        encode_row(s, {"x": 2**63})
+    with pytest.raises(OverflowError):
+        encode_row(s, {"x": -(2**63) - 1})
+
+
+def test_string_type_check():
+    s = Schema(columns=[ColumnDef("s", SupportedType.STRING)])
+    with pytest.raises(TypeError):
+        encode_row(s, {"s": 5})
+    assert decode_row(encode_row(s, {"s": b"raw"}), s)["s"] == "raw"

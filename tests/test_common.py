@@ -1,0 +1,126 @@
+"""Unit tests for the common layer (keys, status, stats, clock).
+
+Modeled on the reference's base/test suite (NebulaKeyUtilsTest.cpp,
+StatsManagerTest.cpp — SURVEY.md §4 unit tier).
+"""
+import time
+
+from nebula_tpu.common.clock import Duration, inverted_version, now_micros
+from nebula_tpu.common.keys import KeyUtils, id_hash
+from nebula_tpu.common.stats import StatsManager
+from nebula_tpu.common.status import ErrorCode, Status, StatusOr
+
+
+class TestKeys:
+    def test_vertex_roundtrip(self):
+        k = KeyUtils.vertex_key(7, 12345, 3, 999)
+        assert KeyUtils.is_vertex(k) and not KeyUtils.is_edge(k)
+        assert KeyUtils.parse_vertex(k) == (7, 12345, 3, 999)
+
+    def test_edge_roundtrip_negative(self):
+        k = KeyUtils.edge_key(1, -42, -100, -5, 17, 3)
+        assert KeyUtils.is_edge(k)
+        assert KeyUtils.parse_edge(k) == (1, -42, -100, -5, 17, 3)
+
+    def test_lexicographic_equals_logical_order(self):
+        # (src, etype, rank, dst, version) ordering under byte compare
+        keys = [
+            KeyUtils.edge_key(1, 1, 2, 0, 5, 9),
+            KeyUtils.edge_key(1, 1, 2, 0, 6, 1),
+            KeyUtils.edge_key(1, 1, 2, 1, 0, 0),
+            KeyUtils.edge_key(1, 1, 3, -1, 0, 0),
+            KeyUtils.edge_key(1, 2, -9, 0, 0, 0),
+        ]
+        assert keys == sorted(keys)
+
+    def test_version_inversion_latest_first(self):
+        t0 = inverted_version(1000)
+        t1 = inverted_version(2000)
+        k_old = KeyUtils.vertex_key(1, 1, 1, t0)
+        k_new = KeyUtils.vertex_key(1, 1, 1, t1)
+        assert k_new < k_old  # newer sorts first in scans
+
+    def test_prefixes(self):
+        k = KeyUtils.edge_key(3, 10, 5, 2, 20, 1)
+        assert k.startswith(KeyUtils.part_prefix(3))
+        assert k.startswith(KeyUtils.edge_prefix(3, 10))
+        assert k.startswith(KeyUtils.edge_prefix(3, 10, 5))
+        assert k.startswith(KeyUtils.edge_prefix(3, 10, 5, 2))
+        assert not k.startswith(KeyUtils.edge_prefix(3, 11))
+
+    def test_id_hash_range(self):
+        for vid in (0, 1, -1, 2**62, -(2**62), 123456789):
+            p = id_hash(vid, 100)
+            assert 1 <= p <= 100
+        # deterministic
+        assert id_hash(42, 10) == id_hash(42, 10)
+
+
+class TestStatus:
+    def test_ok_singleton(self):
+        assert Status.OK().ok()
+        assert Status.OK() is Status.OK()
+
+    def test_error(self):
+        s = Status.SyntaxError("bad token")
+        assert not s.ok()
+        assert s.code == ErrorCode.E_SYNTAX_ERROR
+        assert "bad token" in s.to_string()
+
+    def test_status_or(self):
+        v = StatusOr.of(42)
+        assert v.ok() and v.value() == 42
+        e = StatusOr.error(Status.NotFound())
+        assert not e.ok()
+        assert e.value_or(7) == 7
+
+
+class TestStats:
+    def test_counter_windows(self):
+        m = StatsManager()
+        m.register_stats("rpc.latency")
+        now = time.time()
+        for v in (10, 20, 30):
+            m._stats["rpc.latency"].add(v, now)
+        assert m.read_stats("rpc.latency.sum.60", now) == 60
+        assert m.read_stats("rpc.latency.count.5", now) == 3
+        assert m.read_stats("rpc.latency.avg.60", now) == 20
+        assert m.read_stats("rpc.latency.rate.60", now) == 1.0
+
+    def test_percentiles(self):
+        m = StatsManager()
+        now = time.time()
+        for v in range(1, 101):
+            m.add_value("lat", v)
+        p50 = m.read_stats("lat.p50.60")
+        assert 45 <= p50 <= 55
+        p99 = m.read_stats("lat.p99.60")
+        assert p99 >= 95
+
+    def test_bad_exprs(self):
+        m = StatsManager()
+        assert m.read_stats("nope.sum.60") is None
+        m.add_value("x", 1)
+        assert m.read_stats("x.sum.61") is None
+        assert m.read_stats("x.wat.60") is None
+
+
+class TestClock:
+    def test_duration(self):
+        d = Duration()
+        time.sleep(0.01)
+        assert d.elapsed_in_usec() >= 9000
+
+    def test_now(self):
+        a = now_micros()
+        assert a > 1_600_000_000_000_000
+
+
+def test_status_hashable():
+    assert len({Status.OK(), Status.OK(), Status.NotFound()}) == 2
+
+
+def test_edge_prefix_noncontiguous_rejected():
+    import pytest
+    with pytest.raises(ValueError):
+        KeyUtils.edge_prefix(1, 2, None, rank=5)
