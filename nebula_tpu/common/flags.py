@@ -1,0 +1,128 @@
+"""Process-wide flag registry — the gflags equivalent.
+
+Capability parity with the reference's layered config system (SURVEY.md
+§5.6): (1) per-daemon flags with defaults, loadable from a conf file;
+(2) flags declared as remotely-managed register into metad's config
+registry (GflagsManager) and MUTABLE ones hot-update via the meta cache
+refresh; (3) runtime get/set over the web service (/flags).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..interface.common import ConfigMode, ConfigModule
+
+
+class FlagInfo:
+    __slots__ = ("name", "default", "value", "help", "mode", "module", "watchers")
+
+    def __init__(self, name: str, default: Any, help_: str, mode: ConfigMode,
+                 module: ConfigModule):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.help = help_
+        self.mode = mode
+        self.module = module
+        self.watchers: List[Callable[[Any], None]] = []
+
+
+class FlagsRegistry:
+    def __init__(self):
+        self._flags: Dict[str, FlagInfo] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help_: str = "",
+               mode: ConfigMode = ConfigMode.MUTABLE,
+               module: ConfigModule = ConfigModule.ALL) -> None:
+        with self._lock:
+            if name not in self._flags:
+                self._flags[name] = FlagInfo(name, default, help_, mode, module)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        f = self._flags.get(name)
+        return f.value if f is not None else default
+
+    def set(self, name: str, value: Any, force: bool = False) -> bool:
+        f = self._flags.get(name)
+        if f is None:
+            return False
+        if f.mode == ConfigMode.IMMUTABLE and not force:
+            return False
+        # coerce to the default's type when possible
+        if f.default is not None and not isinstance(value, type(f.default)):
+            try:
+                if isinstance(f.default, bool):
+                    value = str(value).lower() in ("1", "true", "yes")
+                else:
+                    value = type(f.default)(value)
+            except (TypeError, ValueError):
+                return False
+        f.value = value
+        for w in f.watchers:
+            w(value)
+        return True
+
+    def watch(self, name: str, fn: Callable[[Any], None]) -> None:
+        f = self._flags.get(name)
+        if f is not None:
+            f.watchers.append(fn)
+
+    def names(self, module: Optional[ConfigModule] = None) -> List[str]:
+        return sorted(n for n, f in self._flags.items()
+                      if module in (None, ConfigModule.ALL) or
+                      f.module in (module, ConfigModule.ALL))
+
+    def info(self, name: str) -> Optional[FlagInfo]:
+        return self._flags.get(name)
+
+    def dump(self) -> Dict[str, Any]:
+        return {n: f.value for n, f in sorted(self._flags.items())}
+
+    def load_file(self, path: str) -> None:
+        """Conf file: json object or ``--name=value`` lines."""
+        with open(path) as fh:
+            text = fh.read()
+        try:
+            for k, v in json.loads(text).items():
+                self.define(k, v)
+                self.set(k, v, force=True)
+            return
+        except json.JSONDecodeError:
+            pass
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("--") and "=" in line:
+                k, v = line[2:].split("=", 1)
+                for cast in (int, float):
+                    try:
+                        v = cast(v)
+                        break
+                    except ValueError:
+                        continue
+                else:
+                    if v in ("true", "false"):
+                        v = v == "true"
+                self.define(k, v)
+                self.set(k, v, force=True)
+
+
+flags = FlagsRegistry()
+
+# framework defaults (reference GraphFlags.cpp:10-29, MetaClient.cpp:13-14)
+flags.define("session_idle_timeout_secs", 600, "session reclaim timeout")
+flags.define("session_reclaim_interval_secs", 10, "reclaim cadence")
+flags.define("heartbeat_interval_secs", 10, "storaged->metad heartbeat")
+flags.define("load_data_interval_secs", 120, "meta cache refresh cadence")
+flags.define("expired_hosts_check_interval_sec", 20, "active host sweep")
+flags.define("expired_threshold_sec", 10 * 60, "host liveness TTL")
+flags.define("max_handlers_per_req", 10, "per-request bucket fan-out")
+flags.define("min_vertices_per_bucket", 3, "min vertices per bucket")
+flags.define("storage_backend", "auto", "storage traversal backend: cpu|tpu|auto")
+flags.define("raft_heartbeat_interval_ms", 500, "raft leader heartbeat")
+flags.define("raft_election_timeout_ms", 1500, "raft election timeout base")
+flags.define("wal_buffer_size_bytes", 256 * 1024, "wal flush buffer")

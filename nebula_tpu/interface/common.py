@@ -119,6 +119,23 @@ class HostAddr:
         return HostAddr(h, int(p))
 
 
+def schema_to_wire(s: Schema) -> dict:
+    return {
+        "columns": [[c.name, int(c.type), c.default] for c in s.columns],
+        "ttl_duration": s.schema_prop.ttl_duration,
+        "ttl_col": s.schema_prop.ttl_col,
+        "version": s.version,
+    }
+
+
+def schema_from_wire(w: dict) -> Schema:
+    return Schema(
+        columns=[ColumnDef(n, SupportedType(t), d) for n, t, d in w["columns"]],
+        schema_prop=SchemaProp(w.get("ttl_duration"), w.get("ttl_col")),
+        version=w.get("version", 0),
+    )
+
+
 class AlterSchemaOp(enum.IntEnum):  # meta.thrift:45-50
     ADD = 1
     CHANGE = 2

@@ -1,0 +1,255 @@
+"""RPC transport — the thrift-equivalent service seam.
+
+Capability parity with the reference's fbthrift plumbing
+(ThriftClientManager.h, thrift servers in each daemon — SURVEY.md §5.8):
+named-method request/response services over TCP with pooled client
+connections, plus an in-process "loopback" channel used by tests and
+single-process clusters (the reference's mock-server idiom,
+common/test/ServerContext.h:19-40).
+
+Wire format: 4-byte BE length | msgpack [method, payload]. Responses are
+msgpack payloads; errors travel as {"__error__": code, "msg": ...} and
+surface as Status on the client. Payloads are plain msgpack types (ints,
+str, bytes, lists, dicts); typed structs provide to_wire/from_wire.
+
+This is the host control plane (DCN-side). The TPU data plane never goes
+through here — device arrays move via jax collectives (tpu/).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import msgpack
+
+from ..common.status import ErrorCode, Status
+from .common import HostAddr
+
+_LEN = struct.Struct(">I")
+_MAX_FRAME = 1 << 30
+
+
+class RpcError(Exception):
+    def __init__(self, status: Status):
+        super().__init__(status.to_string())
+        self.status = status
+
+
+def _pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpack(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _read_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = _read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = _LEN.unpack(hdr)
+    if ln > _MAX_FRAME:
+        return None
+    return _read_exact(sock, ln)
+
+
+def _write_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+# ---------------------------------------------------------------- server
+class RpcServer:
+    """Serves a handler object's ``rpc_*`` methods over TCP.
+
+    ``rpc_getNeighbors(payload) -> payload`` handles method
+    "getNeighbors". Raising RpcError returns its status; other exceptions
+    return E_INTERNAL_ERROR with the message.
+    """
+
+    def __init__(self, handler: Any, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        outer = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    frame = _read_frame(sock)
+                    if frame is None:
+                        return
+                    try:
+                        method, payload = _unpack(frame)
+                        resp = outer.dispatch(method, payload)
+                    except RpcError as e:
+                        resp = {"__error__": int(e.status.code),
+                                "msg": e.status.msg}
+                    except Exception as e:  # noqa: BLE001 — server must not die
+                        resp = {"__error__": int(ErrorCode.E_INTERNAL_ERROR),
+                                "msg": f"{type(e).__name__}: {e}"}
+                    _write_frame(sock, _pack(resp))
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Conn)
+        self.addr = HostAddr(host, self._server.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    def dispatch(self, method: str, payload: Any) -> Any:
+        fn = getattr(self.handler, "rpc_" + method, None)
+        if fn is None:
+            raise RpcError(Status.Error(f"no method {method}",
+                                        ErrorCode.E_UNSUPPORTED))
+        return fn(payload)
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"rpc-{self.addr.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ---------------------------------------------------------------- client
+class RpcChannel:
+    """One pooled connection to a host; thread-safe call()."""
+
+    def __init__(self, addr: HostAddr, timeout: float = 30.0):
+        self.addr = addr
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.addr.host, self.addr.port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, method: str, payload: Any) -> Any:
+        frame_out = _pack([method, payload])
+        with self._lock:
+            for attempt in (0, 1):
+                sent = False
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _write_frame(self._sock, frame_out)
+                    sent = True
+                    frame = _read_frame(self._sock)
+                    if frame is None:
+                        raise ConnectionError("connection closed")
+                    resp = _unpack(frame)
+                    break
+                except (OSError, ConnectionError) as e:
+                    if self._sock:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    # Retry ONLY pre-send failures (stale pooled connection,
+                    # connect refused-then-up). Once the request may have
+                    # reached the server, re-sending would duplicate
+                    # non-idempotent ops — surface the failure instead.
+                    if sent or attempt == 1:
+                        raise RpcError(Status.Error(
+                            f"rpc to {self.addr} failed: {e}",
+                            ErrorCode.E_RPC_FAILURE)) from e
+        if isinstance(resp, dict) and "__error__" in resp:
+            raise RpcError(Status(ErrorCode(resp["__error__"]),
+                                  resp.get("msg", "")))
+        return resp
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+class LoopbackChannel:
+    """In-process channel: dispatches directly to a handler (the tests'
+    mock-server seam). Runs the same serialize/deserialize path so wire
+    bugs don't hide."""
+
+    def __init__(self, handler: Any):
+        self.handler = handler
+
+    def call(self, method: str, payload: Any) -> Any:
+        payload = _unpack(_pack(payload))
+        fn = getattr(self.handler, "rpc_" + method, None)
+        if fn is None:
+            raise RpcError(Status.Error(f"no method {method}",
+                                        ErrorCode.E_UNSUPPORTED))
+        try:
+            return _unpack(_pack(fn(payload)))
+        except RpcError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise RpcError(Status.Error(f"{type(e).__name__}: {e}")) from e
+
+    def close(self) -> None:
+        pass
+
+
+class ClientManager:
+    """Per-host channel cache (reference ThriftClientManager). Register
+    loopback handlers for in-process daemons; everything else dials TCP."""
+
+    def __init__(self):
+        self._channels: Dict[HostAddr, Any] = {}
+        self._loopbacks: Dict[HostAddr, Any] = {}
+        self._lock = threading.Lock()
+
+    def register_loopback(self, addr: HostAddr, handler: Any) -> None:
+        with self._lock:
+            self._loopbacks[addr] = handler
+            self._channels.pop(addr, None)
+
+    def channel(self, addr: HostAddr):
+        with self._lock:
+            ch = self._channels.get(addr)
+            if ch is None:
+                if addr in self._loopbacks:
+                    ch = LoopbackChannel(self._loopbacks[addr])
+                else:
+                    ch = RpcChannel(addr)
+                self._channels[addr] = ch
+            return ch
+
+    def call(self, addr: HostAddr, method: str, payload: Any) -> Any:
+        return self.channel(addr).call(method, payload)
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+
+
+# process-global default manager (like the reference's shared
+# ThriftClientManager instances)
+default_client_manager = ClientManager()

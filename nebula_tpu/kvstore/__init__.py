@@ -1,0 +1,4 @@
+from .engine import KVEngine, MemEngine
+from .store import NebulaStore, KVOptions
+from .part import Part
+from .partman import PartManager, MemPartManager

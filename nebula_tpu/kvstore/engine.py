@@ -1,0 +1,174 @@
+"""KVEngine — the byte-ordered storage engine seam.
+
+Capability parity with the reference's KVEngine/RocksEngine
+(/root/reference/src/kvstore/KVEngine.h, RocksEngine.h:94-156): point
+get/put, batched writes, prefix/range iteration, range deletes, whole-file
+ingest, and named "system" parts persistence.
+
+Two implementations:
+  * ``MemEngine`` — sorted in-memory table (sortedcontainers.SortedDict)
+    with an append-only snapshot/ingest file format. Because keys are
+    order-preserving bytes (common/keys.py), prefix scans here iterate
+    edges in exactly CSR order.
+  * ``NativeEngine`` (native/kv_engine.cpp, loaded via ctypes) — C++
+    skiplist-backed engine with the same ABI, used when the shared lib is
+    built. See nebula_tpu/kvstore/native.py.
+
+The engine seam is deliberately tiny so the TPU CSR mirror can subscribe to
+writes (see storage/csr_mirror.py) without knowing the engine.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from sortedcontainers import SortedDict
+
+from ..common.status import ErrorCode, Status
+
+KV = Tuple[bytes, bytes]
+
+
+class KVEngine:
+    """Abstract engine interface (reference KVEngine.h)."""
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        return [self.get(k) for k in keys]
+
+    def put(self, key: bytes, value: bytes) -> Status:
+        raise NotImplementedError
+
+    def multi_put(self, kvs: List[KV]) -> Status:
+        raise NotImplementedError
+
+    def remove(self, key: bytes) -> Status:
+        raise NotImplementedError
+
+    def multi_remove(self, keys: List[bytes]) -> Status:
+        raise NotImplementedError
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        raise NotImplementedError
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        raise NotImplementedError
+
+    def prefix(self, prefix: bytes) -> Iterator[KV]:
+        raise NotImplementedError
+
+    def range(self, start: bytes, end: bytes) -> Iterator[KV]:
+        raise NotImplementedError
+
+    def ingest(self, path: str) -> Status:
+        raise NotImplementedError
+
+    def flush(self, path: str) -> Status:
+        raise NotImplementedError
+
+    def compact(self) -> Status:
+        return Status.OK()
+
+    def total_keys(self) -> int:
+        raise NotImplementedError
+
+
+_FRAME = struct.Struct(">II")  # key_len, value_len
+
+
+class MemEngine(KVEngine):
+    """Sorted in-memory engine with snapshot files.
+
+    ``compaction_filter`` mirrors the reference's CompactionFilter seam
+    (storage/CompactionFilter.h): a predicate invoked during compact();
+    returning True drops the key (TTL-expired / schema-orphaned data).
+    """
+
+    def __init__(self, compaction_filter: Optional[Callable[[bytes, bytes], bool]] = None):
+        self._table: SortedDict = SortedDict()
+        self.compaction_filter = compaction_filter
+
+    # ---- reads ------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._table.get(key)
+
+    def prefix(self, prefix: bytes) -> Iterator[KV]:
+        table = self._table
+        for key in table.irange(minimum=prefix):
+            if not key.startswith(prefix):
+                break
+            yield key, table[key]
+
+    def range(self, start: bytes, end: bytes) -> Iterator[KV]:
+        table = self._table
+        for key in table.irange(minimum=start, maximum=end, inclusive=(True, False)):
+            yield key, table[key]
+
+    def total_keys(self) -> int:
+        return len(self._table)
+
+    # ---- writes -----------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> Status:
+        self._table[key] = value
+        return Status.OK()
+
+    def multi_put(self, kvs: List[KV]) -> Status:
+        self._table.update(kvs)
+        return Status.OK()
+
+    def remove(self, key: bytes) -> Status:
+        self._table.pop(key, None)
+        return Status.OK()
+
+    def multi_remove(self, keys: List[bytes]) -> Status:
+        for k in keys:
+            self._table.pop(k, None)
+        return Status.OK()
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        doomed = [k for k, _ in self.prefix(prefix)]
+        return self.multi_remove(doomed)
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        doomed = [k for k, _ in self.range(start, end)]
+        return self.multi_remove(doomed)
+
+    # ---- files ------------------------------------------------------
+    def flush(self, path: str) -> Status:
+        """Write a snapshot file (sorted frames) — SST-flush equivalent."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for k, v in self._table.items():
+                f.write(_FRAME.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+        os.replace(tmp, path)
+        return Status.OK()
+
+    def ingest(self, path: str) -> Status:
+        """Bulk-load a snapshot file (reference RocksEngine::ingest)."""
+        if not os.path.exists(path):
+            return Status.Error(f"no such file {path}", ErrorCode.E_NOT_FOUND)
+        with open(path, "rb") as f:
+            data = f.read()
+        pos, n = 0, len(data)
+        batch = []
+        while pos + _FRAME.size <= n:
+            klen, vlen = _FRAME.unpack_from(data, pos)
+            pos += _FRAME.size
+            if pos + klen + vlen > n:
+                return Status.Error(f"corrupt snapshot {path}")
+            batch.append((data[pos:pos + klen], data[pos + klen:pos + klen + vlen]))
+            pos += klen + vlen
+        self.multi_put(batch)
+        return Status.OK()
+
+    def compact(self) -> Status:
+        if self.compaction_filter is not None:
+            doomed = [k for k, v in self._table.items()
+                      if self.compaction_filter(k, v)]
+            self.multi_remove(doomed)
+        return Status.OK()

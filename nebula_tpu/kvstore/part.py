@@ -1,0 +1,167 @@
+"""Part — one partition's state machine over the engine.
+
+Capability parity with /root/reference/src/kvstore/Part.cpp: serializes KV
+ops into log records (log_encoder), routes them through consensus when a
+RaftPart is attached (replicated mode) or applies them directly
+(single-replica mode), applies committed logs as one batch, and persists a
+``__system_commit_msg_<part>`` = (lastLogId, term) watermark for crash
+recovery (Part.cpp:60-75,163-255).
+
+The ``listeners`` hook is the TPU seam: the CSR mirror subscribes to
+committed batches so device-side CSR deltas track exactly the committed
+prefix of the raft log — never uncommitted writes.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status
+from .engine import KVEngine
+from .log_encoder import LogOp, decode, encode_host, encode_multi, encode_single
+
+KV = Tuple[bytes, bytes]
+_COMMIT = struct.Struct(">QQ")
+
+
+def _commit_key(part_id: int) -> bytes:
+    return b"__system_commit_msg_%d" % part_id
+
+
+class Part:
+    def __init__(self, space_id: int, part_id: int, engine: KVEngine,
+                 raft=None):
+        self.space_id = space_id
+        self.part_id = part_id
+        self.engine = engine
+        self.raft = raft  # raftex.RaftPart or None (single replica)
+        # committed-batch listeners: fn(part, List[(LogOp, payload)])
+        self.listeners: List[Callable] = []
+        if raft is not None:
+            raft.commit_handler = self.commit_logs
+            raft.pre_process_handler = self.pre_process_log
+
+    # ---- recovery ----------------------------------------------------
+    def last_committed_log_id(self) -> Tuple[int, int]:
+        raw = self.engine.get(_commit_key(self.part_id))
+        if raw is None or len(raw) != _COMMIT.size:
+            return 0, 0
+        return _COMMIT.unpack(raw)
+
+    # ---- write api (storage processors call these) -------------------
+    def put(self, key: bytes, value: bytes) -> Status:
+        return self._submit(encode_single(LogOp.OP_PUT, key, value))
+
+    def multi_put(self, kvs: List[KV]) -> Status:
+        return self._submit(encode_multi(LogOp.OP_MULTI_PUT, kvs))
+
+    def remove(self, key: bytes) -> Status:
+        return self._submit(encode_single(LogOp.OP_REMOVE, key))
+
+    def multi_remove(self, keys: List[bytes]) -> Status:
+        return self._submit(encode_multi(LogOp.OP_MULTI_REMOVE, keys))
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        return self._submit(encode_single(LogOp.OP_REMOVE_PREFIX, prefix))
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        return self._submit(encode_multi(LogOp.OP_REMOVE_RANGE, (start, end)))
+
+    def cas(self, expected: bytes, key: bytes, value: bytes) -> Status:
+        """Atomic compare-and-set through the log (reference CAS log type,
+        RaftPart.h:60-78): applied only if current value == expected."""
+        if self.raft is not None:
+            return self.raft.cas_async(key, expected, value)
+        cur = self.engine.get(key) or b""  # absent == empty
+        if cur != expected:
+            return Status.Error("cas mismatch", ErrorCode.E_BAD_STATE)
+        return self.engine.put(key, value)
+
+    def _submit(self, log: bytes) -> Status:
+        if self.raft is not None:
+            return self.raft.append_async(log)
+        return self._apply([(1, log)], log_id=0, term=0)
+
+    # ---- leadership passthrough --------------------------------------
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader()
+
+    def leader(self):
+        return self.raft.leader_addr() if self.raft is not None else None
+
+    # ---- log application (raft commit hook) --------------------------
+    def commit_logs(self, entries: List[Tuple[int, int, bytes]]) -> Status:
+        """entries: [(log_id, term, msg)] committed in order
+        (reference Part::commitLogs Part.cpp:163-255)."""
+        if not entries:
+            return Status.OK()
+        last_id, last_term = entries[-1][0], entries[-1][1]
+        logs = [(lid, msg) for lid, _t, msg in entries if msg]
+        return self._apply(logs, log_id=last_id, term=last_term)
+
+    def _apply(self, logs: List[Tuple[int, bytes]], log_id: int, term: int) -> Status:
+        # Ops MUST apply in log order (a PUT then REMOVE of the same key
+        # must end absent). Consecutive puts/removes coalesce into engine
+        # batches; any order-sensitive boundary flushes first.
+        decoded = []
+        batch_put: List[KV] = []
+        batch_del: List[bytes] = []
+
+        def flush():
+            if batch_del:
+                self.engine.multi_remove(batch_del)
+                batch_del.clear()
+            if batch_put:
+                self.engine.multi_put(batch_put)
+                batch_put.clear()
+
+        for _lid, msg in logs:
+            op, payload = decode(msg)
+            decoded.append((op, payload))
+            if op == LogOp.OP_PUT:
+                if batch_del:
+                    flush()
+                batch_put.append(payload)
+            elif op == LogOp.OP_MULTI_PUT:
+                if batch_del:
+                    flush()
+                batch_put.extend(payload)
+            elif op == LogOp.OP_REMOVE:
+                if batch_put:
+                    flush()
+                batch_del.append(payload)
+            elif op == LogOp.OP_MULTI_REMOVE:
+                if batch_put:
+                    flush()
+                batch_del.extend(payload)
+            elif op == LogOp.OP_REMOVE_PREFIX:
+                flush()
+                self.engine.remove_prefix(payload)
+            elif op == LogOp.OP_REMOVE_RANGE:
+                flush()
+                self.engine.remove_range(*payload)
+            # membership ops are handled in pre_process_log
+        flush()
+        if log_id > 0:
+            self.engine.put(_commit_key(self.part_id), _COMMIT.pack(log_id, term))
+        for listener in self.listeners:
+            listener(self, decoded)
+        return Status.OK()
+
+    # ---- membership (COMMAND logs) -----------------------------------
+    def pre_process_log(self, log_id: int, term: int, msg: bytes) -> None:
+        """COMMAND log types take effect before commit
+        (reference Part::preProcessLog Part.cpp:257-278)."""
+        if not msg:
+            return
+        op, payload = decode(msg)
+        if self.raft is None:
+            return
+        if op == LogOp.OP_ADD_LEARNER:
+            self.raft.add_learner(payload)
+        elif op == LogOp.OP_TRANS_LEADER:
+            self.raft.prepare_leader_transfer(payload)
+        elif op == LogOp.OP_ADD_PEER:
+            self.raft.add_peer(payload)
+        elif op == LogOp.OP_REMOVE_PEER:
+            self.raft.remove_peer(payload)

@@ -1,0 +1,78 @@
+"""PartManager — who owns which partitions.
+
+Capability parity with /root/reference/src/kvstore/PartManager.h:18-135:
+a Handler callback interface (addSpace/addPart/removeSpace/removePart) that
+a store registers on, plus two implementations:
+
+  * ``MemPartManager`` — in-memory placement for tests and metad's own
+    store (reference PartManager.h:66-130).
+  * ``MetaServerBasedPartManager`` (meta/part_manager.py) — subscribes to
+    MetaClient cache diffs and pushes placement changes into the store,
+    closing the meta → storage control loop (reference PartManager.h:132).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Protocol
+
+from ..interface.common import GraphSpaceID, HostAddr, PartitionID
+
+
+class PartHandler(Protocol):
+    def add_space(self, space_id: GraphSpaceID) -> None: ...
+    def add_part(self, space_id: GraphSpaceID, part_id: PartitionID,
+                 peers: Optional[List[HostAddr]] = None) -> None: ...
+    def remove_space(self, space_id: GraphSpaceID) -> None: ...
+    def remove_part(self, space_id: GraphSpaceID, part_id: PartitionID) -> None: ...
+
+
+class PartManager:
+    def __init__(self):
+        self.handler: Optional[PartHandler] = None
+
+    def register_handler(self, handler: PartHandler) -> None:
+        self.handler = handler
+
+    def parts(self, host: HostAddr) -> Dict[GraphSpaceID, List[PartitionID]]:
+        raise NotImplementedError
+
+    def part_exists(self, space_id: GraphSpaceID, part_id: PartitionID) -> bool:
+        raise NotImplementedError
+
+    def space_exists(self, space_id: GraphSpaceID) -> bool:
+        raise NotImplementedError
+
+
+class MemPartManager(PartManager):
+    def __init__(self):
+        super().__init__()
+        self._parts: Dict[GraphSpaceID, Dict[PartitionID, List[HostAddr]]] = {}
+
+    def add_part(self, space_id: GraphSpaceID, part_id: PartitionID,
+                 peers: Optional[List[HostAddr]] = None) -> None:
+        new_space = space_id not in self._parts
+        space = self._parts.setdefault(space_id, {})
+        if new_space and self.handler:
+            self.handler.add_space(space_id)
+        if part_id not in space:
+            space[part_id] = peers or []
+            if self.handler:
+                self.handler.add_part(space_id, part_id, peers)
+
+    def remove_part(self, space_id: GraphSpaceID, part_id: PartitionID) -> None:
+        space = self._parts.get(space_id)
+        if space and part_id in space:
+            del space[part_id]
+            if self.handler:
+                self.handler.remove_part(space_id, part_id)
+
+    def parts(self, host: HostAddr) -> Dict[GraphSpaceID, List[PartitionID]]:
+        return {sid: sorted(parts) for sid, parts in self._parts.items()}
+
+    def part_exists(self, space_id, part_id) -> bool:
+        return part_id in self._parts.get(space_id, {})
+
+    def space_exists(self, space_id) -> bool:
+        return space_id in self._parts
+
+    def peers(self, space_id, part_id) -> List[HostAddr]:
+        return self._parts.get(space_id, {}).get(part_id, [])

@@ -1,0 +1,231 @@
+"""NebulaStore — space → partitions → engine mapping, the KVStore facade.
+
+Capability parity with /root/reference/src/kvstore/{KVStore.h:57-150,
+NebulaStore.h:35-197}: per-space engines across data paths (round-robin
+part→engine placement), PartManager Handler callbacks for dynamic part
+placement pushed from meta, read ops routed by (space, part) with
+leader/ownership checks, write ops routed through Part (and raft when
+replicated), snapshot flush/ingest per engine.
+
+Replication: when ``raft_service`` is provided, new parts get a RaftPart
+whose peers come from the PartManager (see raftex/). Without it parts run
+single-replica — the mode metad's own store and unit tests use.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status
+from ..interface.common import GraphSpaceID, HostAddr, PartitionID
+from .engine import KVEngine, MemEngine
+from .part import Part
+from .partman import PartManager
+
+KV = Tuple[bytes, bytes]
+
+
+@dataclass
+class KVOptions:
+    data_paths: List[str] = field(default_factory=list)
+    part_man: Optional[PartManager] = None
+    compaction_filter_factory: Optional[object] = None  # fn(space_id) -> filter
+    engine_factory: Optional[object] = None  # fn(space, path, cf) -> KVEngine
+
+
+class SpaceData:
+    def __init__(self):
+        self.engines: List[KVEngine] = []
+        self.parts: Dict[PartitionID, Part] = {}
+
+
+class NebulaStore:
+    def __init__(self, options: KVOptions, local_host: Optional[HostAddr] = None,
+                 raft_service=None):
+        self.options = options
+        self.local_host = local_host
+        self.raft_service = raft_service
+        self.spaces: Dict[GraphSpaceID, SpaceData] = {}
+        if options.part_man is not None:
+            options.part_man.register_handler(self)
+
+    def init(self) -> None:
+        """Adopt parts the PartManager says belong to this host
+        (reference NebulaStore::init)."""
+        pm = self.options.part_man
+        if pm is None:
+            return
+        for space_id, parts in pm.parts(self.local_host).items():
+            self.add_space(space_id)
+            for part_id in parts:
+                peers = pm.peers(space_id, part_id) if hasattr(pm, "peers") else None
+                self.add_part(space_id, part_id, peers)
+
+    # ---- PartHandler callbacks (meta-driven placement) ---------------
+    def add_space(self, space_id: GraphSpaceID) -> None:
+        if space_id in self.spaces:
+            return
+        sd = SpaceData()
+        paths = self.options.data_paths or [""]
+        for p in paths:
+            sd.engines.append(self._new_engine(space_id, p))
+        self.spaces[space_id] = sd
+
+    def _new_engine(self, space_id: GraphSpaceID, path: str) -> KVEngine:
+        cf = None
+        factory = self.options.compaction_filter_factory
+        if factory is not None:
+            cf = factory(space_id)
+        if self.options.engine_factory is not None:
+            return self.options.engine_factory(space_id, path, cf)
+        if path:
+            os.makedirs(os.path.join(path, f"nebula_space_{space_id}"),
+                        exist_ok=True)
+        return MemEngine(compaction_filter=cf)
+
+    def add_part(self, space_id: GraphSpaceID, part_id: PartitionID,
+                 peers: Optional[List[HostAddr]] = None) -> None:
+        self.add_space(space_id)
+        sd = self.spaces[space_id]
+        if part_id in sd.parts:
+            return
+        if peers:  # normalize "host:port" strings from part managers
+            peers = [p if isinstance(p, HostAddr) else HostAddr.parse(p)
+                     for p in peers]
+        # round-robin parts across engines (NebulaStore.cpp engine pick)
+        engine = sd.engines[len(sd.parts) % len(sd.engines)]
+        raft = None
+        if self.raft_service is not None:
+            raft = self.raft_service.add_part(space_id, part_id, peers or [])
+        sd.parts[part_id] = Part(space_id, part_id, engine, raft=raft)
+
+    def remove_space(self, space_id: GraphSpaceID) -> None:
+        sd = self.spaces.pop(space_id, None)
+        if sd is None:
+            return
+        if self.raft_service is not None:
+            for part_id in sd.parts:
+                self.raft_service.remove_part(space_id, part_id)
+
+    def remove_part(self, space_id: GraphSpaceID, part_id: PartitionID) -> None:
+        sd = self.spaces.get(space_id)
+        if sd and part_id in sd.parts:
+            del sd.parts[part_id]
+            if self.raft_service is not None:
+                self.raft_service.remove_part(space_id, part_id)
+
+    # ---- lookup ------------------------------------------------------
+    def part(self, space_id: GraphSpaceID, part_id: PartitionID) -> Optional[Part]:
+        sd = self.spaces.get(space_id)
+        return sd.parts.get(part_id) if sd else None
+
+    def _check(self, space_id, part_id) -> Tuple[Optional[Part], Status]:
+        sd = self.spaces.get(space_id)
+        if sd is None:
+            return None, Status.SpaceNotFound(f"space {space_id}")
+        p = sd.parts.get(part_id)
+        if p is None:
+            return None, Status.Error(f"part {part_id} not here",
+                                      ErrorCode.E_PART_NOT_FOUND)
+        return p, Status.OK()
+
+    def part_ids(self, space_id: GraphSpaceID) -> List[PartitionID]:
+        sd = self.spaces.get(space_id)
+        return sorted(sd.parts) if sd else []
+
+    # ---- reads (local, no consensus) ---------------------------------
+    def get(self, space_id, part_id, key: bytes):
+        p, st = self._check(space_id, part_id)
+        if not st.ok():
+            return None, st
+        return p.engine.get(key), Status.OK()
+
+    def multi_get(self, space_id, part_id, keys: List[bytes]):
+        p, st = self._check(space_id, part_id)
+        if not st.ok():
+            return [], st
+        return p.engine.multi_get(keys), Status.OK()
+
+    def prefix(self, space_id, part_id, prefix: bytes) -> Iterator[KV]:
+        p, st = self._check(space_id, part_id)
+        if not st.ok():
+            return iter(())
+        return p.engine.prefix(prefix)
+
+    def range(self, space_id, part_id, start: bytes, end: bytes) -> Iterator[KV]:
+        p, st = self._check(space_id, part_id)
+        if not st.ok():
+            return iter(())
+        return p.engine.range(start, end)
+
+    # ---- writes (via Part → raft when attached) ----------------------
+    def multi_put(self, space_id, part_id, kvs: List[KV]) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.multi_put(kvs) if st.ok() else st
+
+    def put(self, space_id, part_id, key: bytes, value: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.put(key, value) if st.ok() else st
+
+    def remove(self, space_id, part_id, key: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.remove(key) if st.ok() else st
+
+    def multi_remove(self, space_id, part_id, keys: List[bytes]) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.multi_remove(keys) if st.ok() else st
+
+    def remove_prefix(self, space_id, part_id, prefix: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.remove_prefix(prefix) if st.ok() else st
+
+    def remove_range(self, space_id, part_id, start: bytes, end: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.remove_range(start, end) if st.ok() else st
+
+    def cas(self, space_id, part_id, expected: bytes, key: bytes,
+            value: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.cas(expected, key, value) if st.ok() else st
+
+    # ---- maintenance -------------------------------------------------
+    def compact(self, space_id: GraphSpaceID) -> Status:
+        sd = self.spaces.get(space_id)
+        if sd is None:
+            return Status.SpaceNotFound(f"space {space_id}")
+        for e in sd.engines:
+            e.compact()
+        return Status.OK()
+
+    def flush(self, space_id: GraphSpaceID, path_prefix: str) -> Status:
+        sd = self.spaces.get(space_id)
+        if sd is None:
+            return Status.SpaceNotFound(f"space {space_id}")
+        for i, e in enumerate(sd.engines):
+            st = e.flush(f"{path_prefix}.engine{i}.snap")
+            if not st.ok():
+                return st
+        return Status.OK()
+
+    def ingest(self, space_id: GraphSpaceID, paths: List[str]) -> Status:
+        sd = self.spaces.get(space_id)
+        if sd is None:
+            return Status.SpaceNotFound(f"space {space_id}")
+        for path in paths:
+            # flush() names snapshots "<prefix>.engineN.snap"; route each
+            # back to the engine whose parts read it. Unknown names load
+            # into every engine (reads are part-prefix-filtered, so extra
+            # keys are invisible — only memory is wasted).
+            engines = sd.engines
+            if ".engine" in path:
+                try:
+                    idx = int(path.rsplit(".engine", 1)[1].split(".", 1)[0])
+                    engines = [sd.engines[idx]]
+                except (ValueError, IndexError):
+                    pass
+            for e in engines:
+                st = e.ingest(path)
+                if not st.ok():
+                    return st
+        return Status.OK()

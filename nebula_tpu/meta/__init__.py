@@ -1,0 +1,3 @@
+from .service import MetaService
+from .client import MetaClient
+from .schema_manager import SchemaManager

@@ -1,0 +1,313 @@
+"""MetaClient — caching client embedded in graphd and storaged.
+
+Capability parity with /root/reference/src/meta/client/MetaClient.h:28-103:
+per-space caches (parts allocation, parts-on-host, tag/edge schemas all
+versions + newest, name↔id maps), a background refresh loop
+(load_data_interval_secs) whose diffs fire MetaChangedListener callbacks
+(onSpaceAdded/onPartAdded/...), an optional heartbeat loop
+(heartbeat_interval_secs), config registry round-trip, and retry across
+meta addresses on leader change / RPC failure.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..common.flags import flags
+from ..common.status import ErrorCode, Status, StatusOr
+from ..interface.common import (HostAddr, Schema, schema_from_wire)
+from ..interface.rpc import ClientManager, RpcError, default_client_manager
+
+
+class SpaceInfoCache:
+    def __init__(self):
+        self.space_name = ""
+        self.partition_num = 0
+        self.replica_factor = 1
+        self.parts_alloc: Dict[int, List[str]] = {}
+        self.tag_schemas: Dict[Tuple[int, int], Schema] = {}   # (tag_id, ver)
+        self.edge_schemas: Dict[Tuple[int, int], Schema] = {}  # (etype, ver)
+        self.newest_tag_ver: Dict[int, int] = {}
+        self.newest_edge_ver: Dict[int, int] = {}
+        self.tag_name_to_id: Dict[str, int] = {}
+        self.edge_name_to_type: Dict[str, int] = {}
+        self.tag_id_to_name: Dict[int, str] = {}
+        self.edge_type_to_name: Dict[int, str] = {}
+
+
+class MetaChangedListener:
+    """Override what you need (reference MetaClient.h:76-83)."""
+
+    def on_space_added(self, space_id: int) -> None: ...
+    def on_space_removed(self, space_id: int) -> None: ...
+    def on_part_added(self, space_id: int, part_id: int, peers: List[str]) -> None: ...
+    def on_part_removed(self, space_id: int, part_id: int) -> None: ...
+    def on_part_updated(self, space_id: int, part_id: int, peers: List[str]) -> None: ...
+
+
+class MetaClient:
+    def __init__(self, addrs: List[HostAddr], local_host: Optional[str] = None,
+                 send_heartbeat: bool = False,
+                 client_manager: Optional[ClientManager] = None):
+        self.addrs = list(addrs)
+        self.local_host = local_host
+        self.send_heartbeat = send_heartbeat
+        self.cm = client_manager or default_client_manager
+        self.listener: Optional[MetaChangedListener] = None
+        self.cluster_id = 0
+        self.last_update_time = -1
+
+        self._cache_lock = threading.RLock()
+        self.spaces: Dict[int, SpaceInfoCache] = {}
+        self.space_name_to_id: Dict[str, int] = {}
+
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ---------------- rpc plumbing ----------------
+    def _call(self, method: str, payload: dict):
+        last_exc: Optional[RpcError] = None
+        for addr in self.addrs:
+            try:
+                return self.cm.call(addr, method, payload)
+            except RpcError as e:
+                if e.status.code in (ErrorCode.E_RPC_FAILURE,
+                                     ErrorCode.E_LEADER_CHANGED,
+                                     ErrorCode.E_NOT_A_LEADER):
+                    last_exc = e
+                    continue  # chase another metad
+                raise
+        raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))
+
+    def _call_status(self, method: str, payload: dict) -> StatusOr:
+        try:
+            return StatusOr.of(self._call(method, payload))
+        except RpcError as e:
+            return StatusOr.error(e.status)
+
+    # ---------------- lifecycle ----------------
+    def wait_for_metad_ready(self, attempts: int = 3) -> bool:
+        for _ in range(attempts):
+            if self._call_status("listSpaces", {}).ok():
+                self.load_data()
+                return True
+            self._stop.wait(0.3)
+        return False
+
+    def start(self) -> None:
+        """Spin the refresh (and optionally heartbeat) threads."""
+        t = threading.Thread(target=self._refresh_loop, name="meta-refresh",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.send_heartbeat:
+            t2 = threading.Thread(target=self._heartbeat_loop, name="meta-hb",
+                                  daemon=True)
+            t2.start()
+            self._threads.append(t2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(flags.get("load_data_interval_secs", 120))
+            if self._stop.is_set():
+                return
+            try:
+                self.load_data()
+            except RpcError:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            self.heartbeat()
+            self._stop.wait(flags.get("heartbeat_interval_secs", 10))
+
+    def heartbeat(self) -> Status:
+        if not self.local_host:
+            return Status.Error("no local host for heartbeat")
+        r = self._call_status("heartBeat", {"host": self.local_host,
+                                            "cluster_id": self.cluster_id})
+        if r.ok():
+            self.cluster_id = r.value().get("cluster_id", self.cluster_id)
+            # cheap change detection (reference uses last_update_time the
+            # same way to skip full reloads)
+            lut = r.value().get("last_update_time_in_us", 0)
+            if lut != self.last_update_time:
+                self.last_update_time = lut
+                try:
+                    self.load_data()
+                except RpcError:
+                    pass
+            return Status.OK()
+        return r.status
+
+    # ---------------- cache load + diff ----------------
+    def load_data(self) -> None:
+        resp = self._call("listSpaces", {})
+        new_spaces: Dict[int, SpaceInfoCache] = {}
+        new_name_to_id: Dict[str, int] = {}
+        for sp in resp["spaces"]:
+            sid = sp["id"]
+            cache = SpaceInfoCache()
+            props = self._call("getSpace", {"space_name": sp["name"]})
+            cache.space_name = sp["name"]
+            cache.partition_num = props["partition_num"]
+            cache.replica_factor = props.get("replica_factor", 1)
+            alloc = self._call("getPartsAlloc", {"space_id": sid})
+            cache.parts_alloc = {int(p): list(hosts)
+                                 for p, hosts in alloc["parts"].items()}
+            for rec in self._call("listTagSchemas", {"space_id": sid})["schemas"]:
+                schema = schema_from_wire(rec["schema"])
+                cache.tag_schemas[(rec["id"], rec["version"])] = schema
+                cache.tag_name_to_id[rec["name"]] = rec["id"]
+                cache.tag_id_to_name[rec["id"]] = rec["name"]
+                cur = cache.newest_tag_ver.get(rec["id"], -1)
+                cache.newest_tag_ver[rec["id"]] = max(cur, rec["version"])
+            for rec in self._call("listEdgeSchemas", {"space_id": sid})["schemas"]:
+                schema = schema_from_wire(rec["schema"])
+                cache.edge_schemas[(rec["id"], rec["version"])] = schema
+                cache.edge_name_to_type[rec["name"]] = rec["id"]
+                cache.edge_type_to_name[rec["id"]] = rec["name"]
+                cur = cache.newest_edge_ver.get(rec["id"], -1)
+                cache.newest_edge_ver[rec["id"]] = max(cur, rec["version"])
+            new_spaces[sid] = cache
+            new_name_to_id[sp["name"]] = sid
+        with self._cache_lock:
+            old_spaces = self.spaces
+            self.spaces = new_spaces
+            self.space_name_to_id = new_name_to_id
+        self._diff(old_spaces, new_spaces)
+
+    def _diff(self, old: Dict[int, SpaceInfoCache],
+              new: Dict[int, SpaceInfoCache]) -> None:
+        lst = self.listener
+        if lst is None:
+            return
+        host = self.local_host
+        for sid in new:
+            if sid not in old:
+                lst.on_space_added(sid)
+        for sid in old:
+            if sid not in new:
+                lst.on_space_removed(sid)
+        # part-level diff restricted to parts this host serves
+        for sid, cache in new.items():
+            old_parts = old.get(sid).parts_alloc if sid in old else {}
+            for part, peers in cache.parts_alloc.items():
+                mine = host is None or host in peers
+                was_mine = host is None or host in old_parts.get(part, [])
+                if mine and (part not in old_parts or not was_mine):
+                    lst.on_part_added(sid, part, peers)
+                elif not mine and was_mine and part in old_parts:
+                    lst.on_part_removed(sid, part)
+                elif mine and was_mine and old_parts.get(part) != peers:
+                    lst.on_part_updated(sid, part, peers)
+            for part in old_parts:
+                if part not in cache.parts_alloc and \
+                        (host is None or host in old_parts[part]):
+                    lst.on_part_removed(sid, part)
+
+    # ---------------- cache reads ----------------
+    def get_space_id_by_name(self, name: str) -> StatusOr[int]:
+        with self._cache_lock:
+            sid = self.space_name_to_id.get(name)
+        if sid is None:
+            return StatusOr.error(Status.SpaceNotFound(name))
+        return StatusOr.of(sid)
+
+    def space_cache(self, space_id: int) -> Optional[SpaceInfoCache]:
+        with self._cache_lock:
+            return self.spaces.get(space_id)
+
+    def part_num(self, space_id: int) -> int:
+        c = self.space_cache(space_id)
+        return c.partition_num if c else 0
+
+    def parts_alloc(self, space_id: int) -> Dict[int, List[str]]:
+        c = self.space_cache(space_id)
+        return dict(c.parts_alloc) if c else {}
+
+    def get_tag_id(self, space_id: int, name: str) -> StatusOr[int]:
+        c = self.space_cache(space_id)
+        if c and name in c.tag_name_to_id:
+            return StatusOr.of(c.tag_name_to_id[name])
+        return StatusOr.error(Status(ErrorCode.E_SCHEMA_NOT_FOUND, f"tag {name}"))
+
+    def get_edge_type(self, space_id: int, name: str) -> StatusOr[int]:
+        c = self.space_cache(space_id)
+        if c and name in c.edge_name_to_type:
+            return StatusOr.of(c.edge_name_to_type[name])
+        return StatusOr.error(Status(ErrorCode.E_SCHEMA_NOT_FOUND, f"edge {name}"))
+
+    def get_tag_schema(self, space_id: int, tag_id: int,
+                       ver: int = -1) -> Optional[Schema]:
+        c = self.space_cache(space_id)
+        if not c:
+            return None
+        if ver < 0:
+            ver = c.newest_tag_ver.get(tag_id, -1)
+        return c.tag_schemas.get((tag_id, ver))
+
+    def get_edge_schema(self, space_id: int, etype: int,
+                        ver: int = -1) -> Optional[Schema]:
+        c = self.space_cache(space_id)
+        if not c:
+            return None
+        if ver < 0:
+            ver = c.newest_edge_ver.get(etype, -1)
+        return c.edge_schemas.get((etype, ver))
+
+    def all_edge_types(self, space_id: int) -> List[int]:
+        c = self.space_cache(space_id)
+        return sorted(c.edge_type_to_name) if c else []
+
+    def all_tag_ids(self, space_id: int) -> List[int]:
+        c = self.space_cache(space_id)
+        return sorted(c.tag_id_to_name) if c else []
+
+    # ---------------- write-through API ----------------
+    def create_space(self, name: str, partition_num: int = 1,
+                     replica_factor: int = 1) -> StatusOr[int]:
+        r = self._call_status("createSpace", {"space_name": name,
+                                              "partition_num": partition_num,
+                                              "replica_factor": replica_factor})
+        if r.ok():
+            self.load_data()
+            return StatusOr.of(r.value()["id"])
+        return StatusOr.error(r.status)
+
+    def drop_space(self, name: str) -> Status:
+        r = self._call_status("dropSpace", {"space_name": name})
+        if r.ok():
+            self.load_data()
+        return r.status
+
+    def create_tag_schema(self, space_id: int, name: str, schema_wire: dict) -> StatusOr[int]:
+        r = self._call_status("createTagSchema", {"space_id": space_id,
+                                                  "name": name,
+                                                  "schema": schema_wire})
+        if r.ok():
+            self.load_data()
+            return StatusOr.of(r.value()["id"])
+        return StatusOr.error(r.status)
+
+    def create_edge_schema(self, space_id: int, name: str, schema_wire: dict) -> StatusOr[int]:
+        r = self._call_status("createEdgeSchema", {"space_id": space_id,
+                                                   "name": name,
+                                                   "schema": schema_wire})
+        if r.ok():
+            self.load_data()
+            return StatusOr.of(r.value()["id"])
+        return StatusOr.error(r.status)
+
+    def call(self, method: str, payload: dict) -> StatusOr:
+        """Generic passthrough for the long tail of meta RPCs (DDL
+        executors use this; cache-affecting calls should load_data after)."""
+        return self._call_status(method, payload)
+
+    def refresh(self) -> None:
+        self.load_data()

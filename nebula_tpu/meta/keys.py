@@ -1,0 +1,120 @@
+"""Meta kvstore key layout (reference src/meta/MetaServiceUtils.h idiom)."""
+from __future__ import annotations
+
+import struct
+
+_BE32 = struct.Struct(">I")
+_BE64 = struct.Struct(">Q")
+
+ID_KEY = b"_next_id_"
+CLUSTER_ID_KEY = b"_cluster_id_"
+LAST_UPDATE_KEY = b"_last_update_time_"
+
+SPACE_PREFIX = b"_spaces_"
+SPACE_IDX_PREFIX = b"_space_idx_"
+PART_PREFIX = b"_parts_"
+TAG_PREFIX = b"_tags_"
+TAG_IDX_PREFIX = b"_tag_idx_"
+EDGE_PREFIX = b"_edges_"
+EDGE_IDX_PREFIX = b"_edge_idx_"
+HOST_PREFIX = b"_hosts_"
+USER_PREFIX = b"_users_"
+CONFIG_PREFIX = b"_configs_"
+KV_PREFIX = b"_kv_"
+BALANCE_PLAN_PREFIX = b"_balance_"
+
+
+def space_key(space_id: int) -> bytes:
+    return SPACE_PREFIX + _BE32.pack(space_id)
+
+
+def space_id_from_key(key: bytes) -> int:
+    return _BE32.unpack(key[len(SPACE_PREFIX):])[0]
+
+
+def space_index_key(name: str) -> bytes:
+    return SPACE_IDX_PREFIX + name.encode()
+
+
+def part_key(space_id: int, part_id: int) -> bytes:
+    return PART_PREFIX + _BE32.pack(space_id) + _BE32.pack(part_id)
+
+
+def part_prefix(space_id: int) -> bytes:
+    return PART_PREFIX + _BE32.pack(space_id)
+
+
+def part_id_from_key(key: bytes) -> int:
+    return _BE32.unpack(key[-4:])[0]
+
+
+def tag_key(space_id: int, tag_id: int, version: int) -> bytes:
+    # newest version first: invert version in key order
+    return (TAG_PREFIX + _BE32.pack(space_id) + _BE32.pack(tag_id) +
+            _BE64.pack((1 << 64) - 1 - version))
+
+
+def tag_prefix(space_id: int, tag_id: int | None = None) -> bytes:
+    p = TAG_PREFIX + _BE32.pack(space_id)
+    if tag_id is not None:
+        p += _BE32.pack(tag_id)
+    return p
+
+
+def tag_version_from_key(key: bytes) -> int:
+    return (1 << 64) - 1 - _BE64.unpack(key[-8:])[0]
+
+
+def tag_id_from_key(key: bytes) -> int:
+    return _BE32.unpack(key[len(TAG_PREFIX) + 4:len(TAG_PREFIX) + 8])[0]
+
+
+def tag_index_key(space_id: int, name: str) -> bytes:
+    return TAG_IDX_PREFIX + _BE32.pack(space_id) + name.encode()
+
+
+def edge_key(space_id: int, edge_type: int, version: int) -> bytes:
+    return (EDGE_PREFIX + _BE32.pack(space_id) + _BE32.pack(edge_type) +
+            _BE64.pack((1 << 64) - 1 - version))
+
+
+def edge_prefix(space_id: int, edge_type: int | None = None) -> bytes:
+    p = EDGE_PREFIX + _BE32.pack(space_id)
+    if edge_type is not None:
+        p += _BE32.pack(edge_type)
+    return p
+
+
+edge_version_from_key = tag_version_from_key
+
+
+def edge_type_from_key(key: bytes) -> int:
+    return _BE32.unpack(key[len(EDGE_PREFIX) + 4:len(EDGE_PREFIX) + 8])[0]
+
+
+def edge_index_key(space_id: int, name: str) -> bytes:
+    return EDGE_IDX_PREFIX + _BE32.pack(space_id) + name.encode()
+
+
+def host_key(host: str) -> bytes:
+    return HOST_PREFIX + host.encode()
+
+
+def user_key(name: str) -> bytes:
+    return USER_PREFIX + name.encode()
+
+
+def config_key(module: int, name: str) -> bytes:
+    return CONFIG_PREFIX + _BE32.pack(module) + name.encode()
+
+
+def config_prefix(module: int | None = None) -> bytes:
+    return CONFIG_PREFIX if module is None else CONFIG_PREFIX + _BE32.pack(module)
+
+
+def kv_key(segment: str, key: str) -> bytes:
+    return KV_PREFIX + segment.encode() + b"\x00" + key.encode()
+
+
+def kv_prefix(segment: str) -> bytes:
+    return KV_PREFIX + segment.encode() + b"\x00"

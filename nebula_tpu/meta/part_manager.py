@@ -1,0 +1,71 @@
+"""MetaServerBasedPartManager — meta-driven part placement.
+
+Capability parity with /root/reference/src/kvstore/PartManager.h:132: a
+MetaChangedListener that translates MetaClient cache diffs into
+add/remove-part calls on the local store, so `CREATE SPACE` on metad makes
+partitions (and their raft groups) appear on the right storaged hosts
+within one refresh interval (SURVEY.md §3.4).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..interface.common import GraphSpaceID, HostAddr, PartitionID
+from ..kvstore.partman import PartManager
+from .client import MetaChangedListener, MetaClient
+
+
+class MetaServerBasedPartManager(PartManager, MetaChangedListener):
+    def __init__(self, meta_client: MetaClient, local_host: str):
+        PartManager.__init__(self)
+        self.meta = meta_client
+        self.local_host = local_host
+        meta_client.listener = self
+
+    # ---- PartManager reads (from meta cache) -------------------------
+    def parts(self, host: Optional[HostAddr] = None) -> Dict[GraphSpaceID, List[PartitionID]]:
+        out: Dict[GraphSpaceID, List[PartitionID]] = {}
+        with self.meta._cache_lock:
+            for sid, cache in self.meta.spaces.items():
+                mine = [p for p, peers in cache.parts_alloc.items()
+                        if self.local_host in peers]
+                if mine:
+                    out[sid] = sorted(mine)
+        return out
+
+    def peers(self, space_id: GraphSpaceID, part_id: PartitionID) -> List[str]:
+        c = self.meta.space_cache(space_id)
+        return list(c.parts_alloc.get(part_id, [])) if c else []
+
+    def part_exists(self, space_id, part_id) -> bool:
+        c = self.meta.space_cache(space_id)
+        return bool(c) and part_id in c.parts_alloc
+
+    def space_exists(self, space_id) -> bool:
+        return self.meta.space_cache(space_id) is not None
+
+    # ---- MetaChangedListener (push into the store) -------------------
+    def on_space_added(self, space_id: int) -> None:
+        if self.handler:
+            self.handler.add_space(space_id)
+
+    def on_space_removed(self, space_id: int) -> None:
+        if self.handler:
+            self.handler.remove_space(space_id)
+
+    def on_part_added(self, space_id: int, part_id: int, peers: List[str]) -> None:
+        if self.handler:
+            self.handler.add_space(space_id)
+            self.handler.add_part(space_id, part_id,
+                                  [HostAddr.parse(p) for p in peers])
+
+    def on_part_removed(self, space_id: int, part_id: int) -> None:
+        if self.handler:
+            self.handler.remove_part(space_id, part_id)
+
+    def on_part_updated(self, space_id: int, part_id: int, peers: List[str]) -> None:
+        part = None
+        if self.handler and hasattr(self.handler, "part"):
+            part = self.handler.part(space_id, part_id)
+        if part is not None and part.raft is not None:
+            part.raft.update_peers([HostAddr.parse(p) for p in peers])

@@ -1,0 +1,140 @@
+"""SchemaManager — schema resolution seam shared by graphd and storaged.
+
+Capability parity with /root/reference/src/meta/SchemaManager.h:18 and
+ServerBasedSchemaManager.h:18 (resolve via MetaClient cache), plus the
+test-double AdHocSchemaManager idiom (storage/test/AdHocSchemaManager.h)
+used throughout our test pyramid.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status, StatusOr
+from ..interface.common import Schema
+
+
+class SchemaManager:
+    """Interface."""
+
+    def get_tag_schema(self, space_id: int, tag_id: int, ver: int = -1) -> Optional[Schema]:
+        raise NotImplementedError
+
+    def get_edge_schema(self, space_id: int, etype: int, ver: int = -1) -> Optional[Schema]:
+        raise NotImplementedError
+
+    def to_tag_id(self, space_id: int, name: str) -> StatusOr[int]:
+        raise NotImplementedError
+
+    def to_edge_type(self, space_id: int, name: str) -> StatusOr[int]:
+        raise NotImplementedError
+
+    def tag_name(self, space_id: int, tag_id: int) -> Optional[str]:
+        raise NotImplementedError
+
+    def edge_name(self, space_id: int, etype: int) -> Optional[str]:
+        raise NotImplementedError
+
+    def all_edge_types(self, space_id: int) -> List[int]:
+        raise NotImplementedError
+
+    def all_tag_ids(self, space_id: int) -> List[int]:
+        raise NotImplementedError
+
+
+class ServerBasedSchemaManager(SchemaManager):
+    """Resolves through a MetaClient's cache."""
+
+    def __init__(self, meta_client):
+        self.meta = meta_client
+
+    def get_tag_schema(self, space_id, tag_id, ver=-1):
+        return self.meta.get_tag_schema(space_id, tag_id, ver)
+
+    def get_edge_schema(self, space_id, etype, ver=-1):
+        return self.meta.get_edge_schema(space_id, etype, ver)
+
+    def to_tag_id(self, space_id, name):
+        return self.meta.get_tag_id(space_id, name)
+
+    def to_edge_type(self, space_id, name):
+        return self.meta.get_edge_type(space_id, name)
+
+    def tag_name(self, space_id, tag_id):
+        c = self.meta.space_cache(space_id)
+        return c.tag_id_to_name.get(tag_id) if c else None
+
+    def edge_name(self, space_id, etype):
+        c = self.meta.space_cache(space_id)
+        return c.edge_type_to_name.get(etype) if c else None
+
+    def all_edge_types(self, space_id):
+        return self.meta.all_edge_types(space_id)
+
+    def all_tag_ids(self, space_id):
+        return self.meta.all_tag_ids(space_id)
+
+
+class AdHocSchemaManager(SchemaManager):
+    """Schemas injected directly — no metad (test seam)."""
+
+    def __init__(self):
+        self.tags: Dict[Tuple[int, int, int], Schema] = {}
+        self.edges: Dict[Tuple[int, int, int], Schema] = {}
+        self.tag_names: Dict[Tuple[int, str], int] = {}
+        self.edge_names: Dict[Tuple[int, str], int] = {}
+        self.newest_tag: Dict[Tuple[int, int], int] = {}
+        self.newest_edge: Dict[Tuple[int, int], int] = {}
+
+    def add_tag_schema(self, space_id: int, tag_id: int, name: str,
+                       schema: Schema) -> None:
+        self.tags[(space_id, tag_id, schema.version)] = schema
+        self.tag_names[(space_id, name)] = tag_id
+        cur = self.newest_tag.get((space_id, tag_id), -1)
+        self.newest_tag[(space_id, tag_id)] = max(cur, schema.version)
+
+    def add_edge_schema(self, space_id: int, etype: int, name: str,
+                        schema: Schema) -> None:
+        self.edges[(space_id, etype, schema.version)] = schema
+        self.edge_names[(space_id, name)] = etype
+        cur = self.newest_edge.get((space_id, etype), -1)
+        self.newest_edge[(space_id, etype)] = max(cur, schema.version)
+
+    def get_tag_schema(self, space_id, tag_id, ver=-1):
+        if ver < 0:
+            ver = self.newest_tag.get((space_id, tag_id), -1)
+        return self.tags.get((space_id, tag_id, ver))
+
+    def get_edge_schema(self, space_id, etype, ver=-1):
+        if ver < 0:
+            ver = self.newest_edge.get((space_id, etype), -1)
+        return self.edges.get((space_id, etype, ver))
+
+    def to_tag_id(self, space_id, name):
+        tid = self.tag_names.get((space_id, name))
+        if tid is None:
+            return StatusOr.error(Status(ErrorCode.E_SCHEMA_NOT_FOUND, f"tag {name}"))
+        return StatusOr.of(tid)
+
+    def to_edge_type(self, space_id, name):
+        et = self.edge_names.get((space_id, name))
+        if et is None:
+            return StatusOr.error(Status(ErrorCode.E_SCHEMA_NOT_FOUND, f"edge {name}"))
+        return StatusOr.of(et)
+
+    def tag_name(self, space_id, tag_id):
+        for (sid, name), tid in self.tag_names.items():
+            if sid == space_id and tid == tag_id:
+                return name
+        return None
+
+    def edge_name(self, space_id, etype):
+        for (sid, name), et in self.edge_names.items():
+            if sid == space_id and et == etype:
+                return name
+        return None
+
+    def all_edge_types(self, space_id):
+        return sorted({k[1] for k in self.newest_edge if k[0] == space_id})
+
+    def all_tag_ids(self, space_id):
+        return sorted({k[1] for k in self.newest_tag if k[0] == space_id})

@@ -1,0 +1,512 @@
+"""MetaService — the catalog/cluster manager (metad's brain).
+
+Capability parity with /root/reference/src/meta/ (MetaServiceHandler.h:18-161
+and the processor families under processors/): space/part CRUD with
+part→host assignment, versioned tag/edge schemas with ALTER semantics,
+host add/remove/list, heartbeats → ActiveHostsMan liveness, segment-scoped
+custom KV, users/roles, and the central config registry.
+
+All state lives in a single-space kvstore (space 0, part 0) exactly like
+the reference (MetaDaemon.cpp:58-78), so pointing that store at a raft-
+replicated Part replicates the whole catalog.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..common.clock import now_micros
+from ..common.status import ErrorCode, Status
+from ..interface.common import (AlterSchemaOp, ConfigMode, HostAddr, RoleType,
+                                Schema, schema_from_wire, schema_to_wire)
+from ..interface.rpc import RpcError, _pack as _pk, _unpack as _unpk
+from ..kvstore.store import NebulaStore
+from . import keys as mk
+
+META_SPACE = 0
+META_PART = 0
+
+
+def _err(code: ErrorCode, msg: str = "") -> RpcError:
+    return RpcError(Status(code, msg))
+
+
+class ActiveHostsMan:
+    """Host liveness from heartbeats with TTL expiry
+    (reference ActiveHostsMan.h:46-54)."""
+
+    def __init__(self, kv: NebulaStore):
+        self.kv = kv
+
+    def update_host(self, host: str, info: Optional[dict] = None) -> None:
+        rec = {"last_hb_ms": int(time.time() * 1000)}
+        if info:
+            rec.update(info)
+        self.kv.put(META_SPACE, META_PART, mk.host_key(host), _pk(rec))
+
+    def hosts(self) -> Dict[str, dict]:
+        out = {}
+        for k, v in self.kv.prefix(META_SPACE, META_PART, mk.HOST_PREFIX):
+            out[k[len(mk.HOST_PREFIX):].decode()] = _unpk(v)
+        return out
+
+    def active_hosts(self, expired_ttl_secs: float = 600.0) -> List[str]:
+        cutoff = time.time() * 1000 - expired_ttl_secs * 1000
+        return sorted(h for h, rec in self.hosts().items()
+                      if rec.get("last_hb_ms", 0) >= cutoff)
+
+
+class ClusterIdMan:
+    """Generate/persist the cluster id; storaged validates on heartbeat
+    (reference ClusterIdMan.h:24)."""
+
+    @staticmethod
+    def get_or_create(kv: NebulaStore) -> int:
+        raw, _ = kv.get(META_SPACE, META_PART, mk.CLUSTER_ID_KEY)
+        if raw is not None:
+            return _unpk(raw)
+        cid = random.getrandbits(63)
+        kv.put(META_SPACE, META_PART, mk.CLUSTER_ID_KEY, _pk(cid))
+        return cid
+
+
+class MetaService:
+    """rpc_* methods are the MetaService contract (meta.thrift:498-547)."""
+
+    def __init__(self, kv: Optional[NebulaStore] = None):
+        if kv is None:
+            from ..kvstore.partman import MemPartManager
+            from ..kvstore.store import KVOptions
+            pm = MemPartManager()
+            kv = NebulaStore(KVOptions(part_man=pm))
+            pm.add_part(META_SPACE, META_PART)
+        self.kv = kv
+        self.active_hosts = ActiveHostsMan(kv)
+        self.cluster_id = ClusterIdMan.get_or_create(kv)
+        self.balancer = None  # wired by meta/balancer.py when admin client exists
+        # RpcServer is threaded: one lock serializes catalog access
+        # (id allocation + check-then-put DDL are read-modify-write).
+        # Meta QPS is trivially low; correctness over concurrency here.
+        self._write_lock = threading.RLock()
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                setattr(self, name, self._locked(getattr(self, name)))
+
+    def _locked(self, fn):
+        def wrapper(req: dict):
+            with self._write_lock:
+                return fn(req)
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    # ================= helpers =================
+    def _bump_last_update(self) -> None:
+        self.kv.put(META_SPACE, META_PART, mk.LAST_UPDATE_KEY, _pk(now_micros()))
+
+    def _next_id(self) -> int:
+        raw, _ = self.kv.get(META_SPACE, META_PART, mk.ID_KEY)
+        nxt = (_unpk(raw) if raw is not None else 0) + 1
+        self.kv.put(META_SPACE, META_PART, mk.ID_KEY, _pk(nxt))
+        return nxt
+
+    def _space_id(self, name: str) -> Optional[int]:
+        raw, _ = self.kv.get(META_SPACE, META_PART, mk.space_index_key(name))
+        return _unpk(raw) if raw is not None else None
+
+    def _space_props(self, space_id: int) -> Optional[dict]:
+        raw, _ = self.kv.get(META_SPACE, META_PART, mk.space_key(space_id))
+        return _unpk(raw) if raw is not None else None
+
+    # ================= partsMan =================
+    def rpc_createSpace(self, req: dict) -> dict:
+        name = req["space_name"]
+        parts = int(req.get("partition_num", 1))
+        replica = int(req.get("replica_factor", 1))
+        if parts <= 0 or replica <= 0:
+            raise _err(ErrorCode.E_INVALID_HOST, "partition_num/replica_factor must be > 0")
+        if self._space_id(name) is not None:
+            raise _err(ErrorCode.E_EXISTED, f"space {name} exists")
+        hosts = self.active_hosts.active_hosts()
+        if not hosts:
+            raise _err(ErrorCode.E_NO_HOSTS, "no active storage hosts")
+        if replica > len(hosts):
+            raise _err(ErrorCode.E_NO_VALID_HOST,
+                       f"replica_factor {replica} > active hosts {len(hosts)}")
+        space_id = self._next_id()
+        batch = [
+            (mk.space_index_key(name), _pk(space_id)),
+            (mk.space_key(space_id), _pk({"name": name, "partition_num": parts,
+                                          "replica_factor": replica})),
+        ]
+        # random-offset round-robin assignment (reference
+        # CreateSpaceProcessor.cpp picks hosts pseudo-randomly per part)
+        offset = random.randrange(len(hosts))
+        for part in range(1, parts + 1):
+            peers = [hosts[(offset + part + r) % len(hosts)] for r in range(replica)]
+            batch.append((mk.part_key(space_id, part), _pk(peers)))
+        self.kv.multi_put(META_SPACE, META_PART, batch)
+        self._bump_last_update()
+        return {"id": space_id}
+
+    def rpc_dropSpace(self, req: dict) -> dict:
+        name = req["space_name"]
+        space_id = self._space_id(name)
+        if space_id is None:
+            raise _err(ErrorCode.E_NOT_FOUND, f"space {name}")
+        self.kv.remove(META_SPACE, META_PART, mk.space_index_key(name))
+        self.kv.remove(META_SPACE, META_PART, mk.space_key(space_id))
+        self.kv.remove_prefix(META_SPACE, META_PART, mk.part_prefix(space_id))
+        self.kv.remove_prefix(META_SPACE, META_PART, mk.tag_prefix(space_id))
+        self.kv.remove_prefix(META_SPACE, META_PART, mk.edge_prefix(space_id))
+        self._bump_last_update()
+        return {}
+
+    def rpc_listSpaces(self, req: dict) -> dict:
+        out = []
+        for k, v in self.kv.prefix(META_SPACE, META_PART, mk.SPACE_PREFIX):
+            props = _unpk(v)
+            out.append({"id": mk.space_id_from_key(k), "name": props["name"]})
+        return {"spaces": out}
+
+    def rpc_getSpace(self, req: dict) -> dict:
+        space_id = self._space_id(req["space_name"])
+        if space_id is None:
+            raise _err(ErrorCode.E_NOT_FOUND, f"space {req['space_name']}")
+        props = self._space_props(space_id)
+        return {"id": space_id, **props}
+
+    def rpc_getPartsAlloc(self, req: dict) -> dict:
+        space_id = int(req["space_id"])
+        if self._space_props(space_id) is None:
+            raise _err(ErrorCode.E_NOT_FOUND, f"space {space_id}")
+        parts = {}
+        for k, v in self.kv.prefix(META_SPACE, META_PART, mk.part_prefix(space_id)):
+            parts[mk.part_id_from_key(k)] = _unpk(v)
+        return {"parts": parts}
+
+    def rpc_updatePartAlloc(self, req: dict) -> dict:
+        """Balancer support: move a part's peer list."""
+        space_id, part_id = int(req["space_id"]), int(req["part_id"])
+        self.kv.put(META_SPACE, META_PART, mk.part_key(space_id, part_id),
+                    _pk(list(req["peers"])))
+        self._bump_last_update()
+        return {}
+
+    # ================= hostsMan =================
+    def rpc_addHosts(self, req: dict) -> dict:
+        for h in req["hosts"]:
+            self.active_hosts.update_host(h, {"registered": True})
+        return {}
+
+    def rpc_removeHosts(self, req: dict) -> dict:
+        for h in req["hosts"]:
+            self.kv.remove(META_SPACE, META_PART, mk.host_key(h))
+        return {}
+
+    def rpc_listHosts(self, req: dict) -> dict:
+        hosts = self.active_hosts.hosts()
+        active = set(self.active_hosts.active_hosts())
+        return {"hosts": [{"host": h, "status": "online" if h in active else "offline"}
+                          for h in sorted(hosts)]}
+
+    # ================= heartbeat (admin/HBProcessor) =================
+    def rpc_heartBeat(self, req: dict) -> dict:
+        cid = req.get("cluster_id", 0)
+        if cid and cid != self.cluster_id:
+            raise _err(ErrorCode.E_WRONGCLUSTER, "cluster id mismatch")
+        self.active_hosts.update_host(req["host"], req.get("info"))
+        return {"cluster_id": self.cluster_id,
+                "last_update_time_in_us": self.last_update_time()}
+
+    def last_update_time(self) -> int:
+        raw, _ = self.kv.get(META_SPACE, META_PART, mk.LAST_UPDATE_KEY)
+        return _unpk(raw) if raw is not None else 0
+
+    # ================= schemaMan: tags =================
+    def _create_schema(self, req: dict, prefix_fn, index_key_fn, key_fn) -> dict:
+        space_id = int(req["space_id"])
+        name = req["name"]
+        if self._space_props(space_id) is None:
+            raise _err(ErrorCode.E_NOT_FOUND, f"space {space_id}")
+        raw, _ = self.kv.get(META_SPACE, META_PART, index_key_fn(space_id, name))
+        if raw is not None:
+            raise _err(ErrorCode.E_EXISTED, f"{name} exists")
+        sid = self._next_id()
+        schema = schema_from_wire(req["schema"])
+        schema.version = 0
+        self.kv.multi_put(META_SPACE, META_PART, [
+            (index_key_fn(space_id, name), _pk(sid)),
+            (key_fn(space_id, sid, 0), _pk({"name": name,
+                                            "schema": schema_to_wire(schema)})),
+        ])
+        self._bump_last_update()
+        return {"id": sid}
+
+    def _alter_schema(self, req: dict, index_key_fn, key_fn, prefix_fn) -> dict:
+        space_id = int(req["space_id"])
+        name = req["name"]
+        raw, _ = self.kv.get(META_SPACE, META_PART, index_key_fn(space_id, name))
+        if raw is None:
+            raise _err(ErrorCode.E_SCHEMA_NOT_FOUND, name)
+        sid = _unpk(raw)
+        # newest version is first under the prefix (inverted version key)
+        it = self.kv.prefix(META_SPACE, META_PART, prefix_fn(space_id, sid))
+        try:
+            k, v = next(iter(it))
+        except StopIteration:
+            raise _err(ErrorCode.E_SCHEMA_NOT_FOUND, name)
+        cur = schema_from_wire(_unpk(v)["schema"])
+        cols = {c.name: c for c in cur.columns}
+        order = [c.name for c in cur.columns]
+        for item in req.get("items", []):
+            op = AlterSchemaOp(item["op"])
+            for colw in item["schema"]["columns"]:
+                cname, ctype, cdefault = colw
+                from ..interface.common import ColumnDef, SupportedType
+                col = ColumnDef(cname, SupportedType(ctype), cdefault)
+                if op == AlterSchemaOp.ADD:
+                    if cname in cols:
+                        raise _err(ErrorCode.E_EXISTED, f"column {cname}")
+                    cols[cname] = col
+                    order.append(cname)
+                elif op == AlterSchemaOp.CHANGE:
+                    if cname not in cols:
+                        raise _err(ErrorCode.E_NOT_FOUND, f"column {cname}")
+                    cols[cname] = col
+                elif op == AlterSchemaOp.DROP:
+                    if cname not in cols:
+                        raise _err(ErrorCode.E_NOT_FOUND, f"column {cname}")
+                    del cols[cname]
+                    order.remove(cname)
+        ttl = req.get("ttl")
+        new_ver = cur.version + 1
+        new_schema = Schema(columns=[cols[n] for n in order],
+                            schema_prop=cur.schema_prop, version=new_ver)
+        if ttl is not None:
+            from ..interface.common import SchemaProp
+            new_schema.schema_prop = SchemaProp(ttl.get("ttl_duration"),
+                                                ttl.get("ttl_col"))
+        self.kv.put(META_SPACE, META_PART, key_fn(space_id, sid, new_ver),
+                    _pk({"name": name, "schema": schema_to_wire(new_schema)}))
+        self._bump_last_update()
+        return {"id": sid, "version": new_ver}
+
+    def _drop_schema(self, req: dict, index_key_fn, prefix_fn) -> dict:
+        space_id = int(req["space_id"])
+        name = req["name"]
+        raw, _ = self.kv.get(META_SPACE, META_PART, index_key_fn(space_id, name))
+        if raw is None:
+            raise _err(ErrorCode.E_SCHEMA_NOT_FOUND, name)
+        sid = _unpk(raw)
+        self.kv.remove(META_SPACE, META_PART, index_key_fn(space_id, name))
+        self.kv.remove_prefix(META_SPACE, META_PART, prefix_fn(space_id, sid))
+        self._bump_last_update()
+        return {}
+
+    def _list_schemas(self, space_id: int, prefix_fn, id_fn, ver_fn) -> list:
+        if self._space_props(space_id) is None:
+            raise _err(ErrorCode.E_NOT_FOUND, f"space {space_id}")
+        out = []
+        for k, v in self.kv.prefix(META_SPACE, META_PART, prefix_fn(space_id)):
+            rec = _unpk(v)
+            out.append({"id": id_fn(k), "version": ver_fn(k),
+                        "name": rec["name"], "schema": rec["schema"]})
+        return out
+
+    def rpc_createTagSchema(self, req: dict) -> dict:
+        return self._create_schema(req, mk.tag_prefix, mk.tag_index_key, mk.tag_key)
+
+    def rpc_alterTagSchema(self, req: dict) -> dict:
+        return self._alter_schema(req, mk.tag_index_key, mk.tag_key, mk.tag_prefix)
+
+    def rpc_dropTagSchema(self, req: dict) -> dict:
+        return self._drop_schema(req, mk.tag_index_key, mk.tag_prefix)
+
+    def rpc_listTagSchemas(self, req: dict) -> dict:
+        return {"schemas": self._list_schemas(int(req["space_id"]), mk.tag_prefix,
+                                              mk.tag_id_from_key,
+                                              mk.tag_version_from_key)}
+
+    def rpc_createEdgeSchema(self, req: dict) -> dict:
+        return self._create_schema(req, mk.edge_prefix, mk.edge_index_key, mk.edge_key)
+
+    def rpc_alterEdgeSchema(self, req: dict) -> dict:
+        return self._alter_schema(req, mk.edge_index_key, mk.edge_key, mk.edge_prefix)
+
+    def rpc_dropEdgeSchema(self, req: dict) -> dict:
+        return self._drop_schema(req, mk.edge_index_key, mk.edge_prefix)
+
+    def rpc_listEdgeSchemas(self, req: dict) -> dict:
+        return {"schemas": self._list_schemas(int(req["space_id"]), mk.edge_prefix,
+                                              mk.edge_type_from_key,
+                                              mk.edge_version_from_key)}
+
+    # ================= customKV =================
+    def rpc_multiPut(self, req: dict) -> dict:
+        seg = req["segment"]
+        self.kv.multi_put(META_SPACE, META_PART,
+                          [(mk.kv_key(seg, k), v if isinstance(v, bytes) else _pk(v))
+                           for k, v in req["pairs"]])
+        return {}
+
+    def rpc_get(self, req: dict) -> dict:
+        raw, _ = self.kv.get(META_SPACE, META_PART,
+                             mk.kv_key(req["segment"], req["key"]))
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["key"])
+        return {"value": raw}
+
+    def rpc_multiGet(self, req: dict) -> dict:
+        seg = req["segment"]
+        values = []
+        for k in req["keys"]:
+            raw, _ = self.kv.get(META_SPACE, META_PART, mk.kv_key(seg, k))
+            values.append(raw)
+        return {"values": values}
+
+    def rpc_scan(self, req: dict) -> dict:
+        seg = req["segment"]
+        prefix = mk.kv_prefix(seg)
+        lo = prefix + req["start"].encode()
+        hi = prefix + req["end"].encode()
+        out = []
+        for k, v in self.kv.range(META_SPACE, META_PART, lo, hi):
+            out.append([k[len(prefix):].decode(), v])
+        return {"values": out}
+
+    def rpc_remove(self, req: dict) -> dict:
+        self.kv.remove(META_SPACE, META_PART, mk.kv_key(req["segment"], req["key"]))
+        return {}
+
+    def rpc_removeRange(self, req: dict) -> dict:
+        prefix = mk.kv_prefix(req["segment"])
+        self.kv.remove_range(META_SPACE, META_PART,
+                             prefix + req["start"].encode(),
+                             prefix + req["end"].encode())
+        return {}
+
+    # ================= usersMan =================
+    def rpc_createUser(self, req: dict) -> dict:
+        name = req["account"]
+        key = mk.user_key(name)
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is not None:
+            if req.get("if_not_exists"):
+                return {}
+            raise _err(ErrorCode.E_EXISTED, name)
+        self.kv.put(META_SPACE, META_PART, key,
+                    _pk({"password": req.get("password", ""), "roles": {}}))
+        return {}
+
+    def rpc_dropUser(self, req: dict) -> dict:
+        key = mk.user_key(req["account"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None and not req.get("if_exists"):
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        self.kv.remove(META_SPACE, META_PART, key)
+        return {}
+
+    def rpc_changePassword(self, req: dict) -> dict:
+        key = mk.user_key(req["account"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        rec = _unpk(raw)
+        if req.get("old_password") is not None and \
+                rec["password"] != req["old_password"]:
+            raise _err(ErrorCode.E_BAD_USERNAME_PASSWORD, "wrong password")
+        rec["password"] = req["new_password"]
+        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        return {}
+
+    def rpc_checkPassword(self, req: dict) -> dict:
+        raw, _ = self.kv.get(META_SPACE, META_PART, mk.user_key(req["account"]))
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        ok = _unpk(raw)["password"] == req.get("password", "")
+        return {"ok": ok}
+
+    def rpc_grantRole(self, req: dict) -> dict:
+        key = mk.user_key(req["account"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        rec = _unpk(raw)
+        rec.setdefault("roles", {})[str(req["space_id"])] = int(req["role"])
+        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        return {}
+
+    def rpc_revokeRole(self, req: dict) -> dict:
+        key = mk.user_key(req["account"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        rec = _unpk(raw)
+        rec.get("roles", {}).pop(str(req["space_id"]), None)
+        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        return {}
+
+    def rpc_listUsers(self, req: dict) -> dict:
+        out = []
+        for k, v in self.kv.prefix(META_SPACE, META_PART, mk.USER_PREFIX):
+            rec = _unpk(v)
+            out.append({"account": k[len(mk.USER_PREFIX):].decode(),
+                        "roles": rec.get("roles", {})})
+        return {"users": out}
+
+    # ================= configMan =================
+    def rpc_regConfig(self, req: dict) -> dict:
+        for item in req["items"]:
+            key = mk.config_key(int(item["module"]), item["name"])
+            raw, _ = self.kv.get(META_SPACE, META_PART, key)
+            if raw is None:  # first registration wins; value is the default
+                self.kv.put(META_SPACE, META_PART, key, _pk({
+                    "mode": int(item.get("mode", ConfigMode.MUTABLE)),
+                    "value": item.get("value"),
+                }))
+        return {}
+
+    def rpc_getConfig(self, req: dict) -> dict:
+        key = mk.config_key(int(req["module"]), req["name"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["name"])
+        rec = _unpk(raw)
+        return {"module": int(req["module"]), "name": req["name"], **rec}
+
+    def rpc_setConfig(self, req: dict) -> dict:
+        key = mk.config_key(int(req["module"]), req["name"])
+        raw, _ = self.kv.get(META_SPACE, META_PART, key)
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["name"])
+        rec = _unpk(raw)
+        if ConfigMode(rec["mode"]) == ConfigMode.IMMUTABLE:
+            raise _err(ErrorCode.E_UNSUPPORTED, f"{req['name']} is immutable")
+        rec["value"] = req["value"]
+        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        self._bump_last_update()
+        return {}
+
+    def rpc_listConfigs(self, req: dict) -> dict:
+        module = req.get("module")
+        prefix = mk.config_prefix(int(module) if module is not None else None)
+        out = []
+        for k, v in self.kv.prefix(META_SPACE, META_PART, prefix):
+            rec = _unpk(v)
+            mod = int.from_bytes(k[len(mk.CONFIG_PREFIX):len(mk.CONFIG_PREFIX) + 4], "big")
+            out.append({"module": mod,
+                        "name": k[len(mk.CONFIG_PREFIX) + 4:].decode(), **rec})
+        return {"items": out}
+
+    # ================= balance =================
+    def rpc_balance(self, req: dict) -> dict:
+        if self.balancer is None:
+            raise _err(ErrorCode.E_UNSUPPORTED, "balancer not wired")
+        return self.balancer.balance(req)
+
+    def rpc_leaderBalance(self, req: dict) -> dict:
+        if self.balancer is None:
+            raise _err(ErrorCode.E_UNSUPPORTED, "balancer not wired")
+        return self.balancer.leader_balance(req)

@@ -1,0 +1,260 @@
+"""KV store tier tests — engine, part, store, WAL, log encoding.
+
+Modeled on the reference's kvstore test tier (NebulaStoreTest,
+LogEncoderTest, FileBasedWalTest — SURVEY.md §4)."""
+import os
+
+import pytest
+
+from nebula_tpu.common.keys import KeyUtils
+from nebula_tpu.kvstore import KVOptions, MemEngine, MemPartManager, NebulaStore
+from nebula_tpu.kvstore.log_encoder import LogOp, decode, encode_host, encode_multi, encode_single
+from nebula_tpu.kvstore.wal import FileBasedWal, LogEntry
+
+
+class TestMemEngine:
+    def test_put_get_remove(self):
+        e = MemEngine()
+        e.put(b"k1", b"v1")
+        assert e.get(b"k1") == b"v1"
+        assert e.get(b"nope") is None
+        e.remove(b"k1")
+        assert e.get(b"k1") is None
+
+    def test_prefix_scan_order(self):
+        e = MemEngine()
+        keys = [KeyUtils.edge_key(1, 1, 2, 0, d, 0) for d in range(10)]
+        e.multi_put([(k, b"x%d" % i) for i, k in enumerate(keys)])
+        e.put(KeyUtils.edge_key(1, 2, 2, 0, 0, 0), b"other")
+        got = [k for k, _ in e.prefix(KeyUtils.edge_prefix(1, 1, 2))]
+        assert got == keys  # sorted by dst
+
+    def test_range_scan_half_open(self):
+        e = MemEngine()
+        e.multi_put([(bytes([i]), b"v") for i in range(10)])
+        got = [k[0] for k, _ in e.range(bytes([3]), bytes([7]))]
+        assert got == [3, 4, 5, 6]
+
+    def test_remove_prefix_and_range(self):
+        e = MemEngine()
+        e.multi_put([(b"a" + bytes([i]), b"v") for i in range(5)])
+        e.multi_put([(b"b" + bytes([i]), b"v") for i in range(5)])
+        e.remove_prefix(b"a")
+        assert e.total_keys() == 5
+        e.remove_range(b"b\x01", b"b\x03")
+        assert e.total_keys() == 3
+
+    def test_flush_ingest_roundtrip(self, tmp_path):
+        e = MemEngine()
+        e.multi_put([(b"k%d" % i, b"v%d" % i) for i in range(100)])
+        snap = str(tmp_path / "x.snap")
+        e.flush(snap)
+        e2 = MemEngine()
+        assert e2.ingest(snap).ok()
+        assert e2.total_keys() == 100
+        assert e2.get(b"k42") == b"v42"
+        assert not e2.ingest(str(tmp_path / "missing.snap")).ok()
+
+    def test_compaction_filter(self):
+        e = MemEngine(compaction_filter=lambda k, v: v == b"expired")
+        e.put(b"a", b"ok")
+        e.put(b"b", b"expired")
+        e.compact()
+        assert e.get(b"a") == b"ok"
+        assert e.get(b"b") is None
+
+
+class TestLogEncoder:
+    def test_single_roundtrip(self):
+        op, payload = decode(encode_single(LogOp.OP_PUT, b"k", b"v"))
+        assert op == LogOp.OP_PUT and payload == (b"k", b"v")
+        op, payload = decode(encode_single(LogOp.OP_REMOVE, b"k"))
+        assert op == LogOp.OP_REMOVE and payload == b"k"
+
+    def test_multi_roundtrip(self):
+        kvs = [(b"a", b"1"), (b"b", b"2")]
+        assert decode(encode_multi(LogOp.OP_MULTI_PUT, kvs)) == (LogOp.OP_MULTI_PUT, kvs)
+        keys = [b"x", b"y"]
+        assert decode(encode_multi(LogOp.OP_MULTI_REMOVE, keys)) == (LogOp.OP_MULTI_REMOVE, keys)
+        assert decode(encode_multi(LogOp.OP_REMOVE_RANGE, (b"s", b"e"))) == (
+            LogOp.OP_REMOVE_RANGE, (b"s", b"e"))
+
+    def test_host_ops(self):
+        for op in (LogOp.OP_ADD_LEARNER, LogOp.OP_TRANS_LEADER,
+                   LogOp.OP_ADD_PEER, LogOp.OP_REMOVE_PEER):
+            got_op, host = decode(encode_host(op, "10.0.0.1:44500"))
+            assert got_op == op and host == "10.0.0.1:44500"
+
+
+class TestWal:
+    def test_append_iterate(self, tmp_path):
+        wal = FileBasedWal(str(tmp_path / "wal"))
+        for i in range(1, 11):
+            assert wal.append_log(i, 1, b"msg%d" % i)
+        assert wal.first_log_id() == 1 and wal.last_log_id() == 10
+        got = [(e.log_id, e.msg) for e in wal.iterate(3, 5)]
+        assert got == [(3, b"msg3"), (4, b"msg4"), (5, b"msg5")]
+
+    def test_gap_rejected(self, tmp_path):
+        wal = FileBasedWal(str(tmp_path / "wal"))
+        wal.append_log(1, 1, b"a")
+        assert not wal.append_log(3, 1, b"c")
+
+    def test_recovery_across_restart(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = FileBasedWal(d)
+        for i in range(1, 6):
+            wal.append_log(i, 2, b"m%d" % i)
+        wal.close()
+        wal2 = FileBasedWal(d)
+        assert wal2.last_log_id() == 5
+        assert wal2.last_log_term() == 2
+        assert [e.msg for e in wal2.iterate(1)] == [b"m%d" % i for i in range(1, 6)]
+
+    def test_rollback_durable(self, tmp_path):
+        d = str(tmp_path / "wal")
+        wal = FileBasedWal(d)
+        for i in range(1, 11):
+            wal.append_log(i, 1, b"x%d" % i)
+        wal.rollback_to_log(4)
+        assert wal.last_log_id() == 4
+        # diverged entries replaced by new leader's entries
+        wal.append_log(5, 2, b"new5")
+        wal.close()
+        wal2 = FileBasedWal(d)
+        assert wal2.last_log_id() == 5
+        assert wal2.get_term(5) == 2
+        assert list(e.msg for e in wal2.iterate(4)) == [b"x4", b"new5"]
+
+
+class TestNebulaStore:
+    def make_store(self, nparts=3):
+        pm = MemPartManager()
+        store = NebulaStore(KVOptions(part_man=pm))
+        pm.register_handler(store)
+        for p in range(1, nparts + 1):
+            pm.add_part(1, p)
+        return store
+
+    def test_parts_created_via_partman(self):
+        store = self.make_store()
+        assert store.part_ids(1) == [1, 2, 3]
+        assert store.part(1, 2) is not None
+        assert store.part(1, 9) is None
+
+    def test_write_read(self):
+        store = self.make_store()
+        assert store.multi_put(1, 1, [(b"k", b"v")]).ok()
+        val, st = store.get(1, 1, b"k")
+        assert st.ok() and val == b"v"
+
+    def test_missing_space_and_part(self):
+        store = self.make_store()
+        _, st = store.get(9, 1, b"k")
+        assert not st.ok()
+        st2 = store.multi_put(1, 99, [(b"k", b"v")])
+        assert not st2.ok()
+
+    def test_part_isolation(self):
+        store = self.make_store()
+        store.put(1, 1, b"k", b"p1")
+        store.put(1, 2, b"k", b"p2")
+        # parts share an engine by default but keys are part-prefixed in
+        # real usage; raw same-key writes do collide on a shared engine —
+        # use KeyUtils part prefixes as production code does
+        k1 = KeyUtils.vertex_key(1, 10, 1, 0)
+        k2 = KeyUtils.vertex_key(2, 10, 1, 0)
+        store.put(1, 1, k1, b"a")
+        store.put(1, 2, k2, b"b")
+        assert list(store.prefix(1, 1, KeyUtils.part_prefix(1)))[0][1] == b"a"
+
+    def test_remove_part(self):
+        store = self.make_store()
+        store.remove_part(1, 2)
+        assert store.part_ids(1) == [1, 3]
+
+    def test_cas(self):
+        store = self.make_store()
+        assert store.cas(1, 1, b"", b"k", b"v1").ok()   # create if absent
+        assert not store.cas(1, 1, b"bad", b"k", b"v2").ok()
+        assert store.cas(1, 1, b"v1", b"k", b"v2").ok()
+        assert store.get(1, 1, b"k")[0] == b"v2"
+
+    def test_commit_listener(self):
+        store = self.make_store()
+        seen = []
+        store.part(1, 1).listeners.append(lambda part, ops: seen.append(ops))
+        store.multi_put(1, 1, [(b"a", b"1")])
+        assert len(seen) == 1
+        op, kvs = seen[0][0]
+        assert op == LogOp.OP_MULTI_PUT and kvs == [(b"a", b"1")]
+
+
+def test_apply_order_put_then_remove():
+    # PUT then REMOVE of the same key in one committed batch must end absent
+    from nebula_tpu.kvstore import MemEngine
+    from nebula_tpu.kvstore.part import Part
+    from nebula_tpu.kvstore.log_encoder import encode_single, encode_multi
+    part = Part(1, 1, MemEngine())
+    part.commit_logs([
+        (1, 1, encode_single(LogOp.OP_PUT, b"k", b"v")),
+        (2, 1, encode_single(LogOp.OP_REMOVE, b"k")),
+    ])
+    assert part.engine.get(b"k") is None
+    # and PUT inside a prefix then REMOVE_PREFIX must also end absent
+    part.commit_logs([
+        (3, 1, encode_single(LogOp.OP_PUT, b"p/x", b"v")),
+        (4, 1, encode_single(LogOp.OP_REMOVE_PREFIX, b"p/")),
+        (5, 1, encode_single(LogOp.OP_PUT, b"p/y", b"v2")),
+    ])
+    assert part.engine.get(b"p/x") is None
+    assert part.engine.get(b"p/y") == b"v2"
+
+
+def test_store_flush_ingest_multi_engine(tmp_path):
+    from nebula_tpu.kvstore import KVOptions, MemPartManager, NebulaStore
+    pm = MemPartManager()
+    store = NebulaStore(KVOptions(part_man=pm, data_paths=[str(tmp_path / "d1"),
+                                                           str(tmp_path / "d2")]))
+    pm.register_handler(store)
+    pm.add_part(1, 1)
+    pm.add_part(1, 2)  # lands on engine 1
+    k1 = KeyUtils.vertex_key(1, 10, 1, 0)
+    k2 = KeyUtils.vertex_key(2, 20, 1, 0)
+    store.put(1, 1, k1, b"a")
+    store.put(1, 2, k2, b"b")
+    prefix = str(tmp_path / "snap")
+    assert store.flush(1, prefix).ok()
+
+    store2 = NebulaStore(KVOptions(part_man=MemPartManager(),
+                                   data_paths=[str(tmp_path / "r1"),
+                                               str(tmp_path / "r2")]))
+    store2.options.part_man.register_handler(store2)
+    store2.options.part_man.add_part(1, 1)
+    store2.options.part_man.add_part(1, 2)
+    assert store2.ingest(1, [prefix + ".engine0.snap",
+                             prefix + ".engine1.snap"]).ok()
+    assert store2.get(1, 1, k1)[0] == b"a"
+    assert store2.get(1, 2, k2)[0] == b"b"  # part 2 reads engine 1
+
+
+def test_wal_clean_up_deletes_segments(tmp_path):
+    import os as _os
+    d = str(tmp_path / "wal")
+    wal = FileBasedWal(d, buffer_size=1)  # flush every record
+    # force tiny segments
+    import nebula_tpu.kvstore.wal as walmod
+    old = walmod._SEGMENT_BYTES
+    walmod._SEGMENT_BYTES = 64
+    try:
+        for i in range(1, 51):
+            wal.append_log(i, 1, b"x" * 32)
+        nseg_before = len(wal._segments())
+        assert nseg_before > 2
+        wal.clean_up_to(40)
+        assert wal.first_log_id() == 41
+        assert len(wal._segments()) < nseg_before
+        # tail still intact
+        assert [e.log_id for e in wal.iterate(41)] == list(range(41, 51))
+    finally:
+        walmod._SEGMENT_BYTES = old

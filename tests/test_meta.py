@@ -1,0 +1,265 @@
+"""Meta service + client tests — modeled on the reference's
+meta/test/ProcessorTest.cpp + MetaClient tests (SURVEY.md §4)."""
+import time
+
+import pytest
+
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.interface.common import (AlterSchemaOp, ConfigMode,
+                                         ConfigModule, HostAddr, RoleType,
+                                         Schema, ColumnDef, SupportedType,
+                                         schema_to_wire)
+from nebula_tpu.interface.rpc import ClientManager, RpcError, RpcServer
+from nebula_tpu.meta.client import MetaChangedListener, MetaClient
+from nebula_tpu.meta.part_manager import MetaServerBasedPartManager
+from nebula_tpu.meta.schema_manager import AdHocSchemaManager, ServerBasedSchemaManager
+from nebula_tpu.meta.service import MetaService
+from nebula_tpu.kvstore import KVOptions, NebulaStore
+
+
+PLAYER_WIRE = schema_to_wire(Schema(columns=[
+    ColumnDef("name", SupportedType.STRING),
+    ColumnDef("age", SupportedType.INT),
+]))
+FOLLOW_WIRE = schema_to_wire(Schema(columns=[
+    ColumnDef("degree", SupportedType.INT),
+]))
+
+
+@pytest.fixture
+def svc():
+    return MetaService()
+
+
+def register_hosts(svc, n=3):
+    for i in range(n):
+        svc.rpc_heartBeat({"host": f"127.0.0.1:{45000+i}"})
+
+
+class TestMetaService:
+    def test_create_space_assigns_parts(self, svc):
+        register_hosts(svc, 3)
+        resp = svc.rpc_createSpace({"space_name": "nba", "partition_num": 6,
+                                    "replica_factor": 3})
+        sid = resp["id"]
+        alloc = svc.rpc_getPartsAlloc({"space_id": sid})["parts"]
+        assert len(alloc) == 6
+        for part, peers in alloc.items():
+            assert len(peers) == 3
+            assert len(set(peers)) == 3
+
+    def test_create_space_needs_hosts(self, svc):
+        with pytest.raises(RpcError) as ei:
+            svc.rpc_createSpace({"space_name": "x"})
+        assert ei.value.status.code == ErrorCode.E_NO_HOSTS
+
+    def test_replica_exceeds_hosts(self, svc):
+        register_hosts(svc, 2)
+        with pytest.raises(RpcError) as ei:
+            svc.rpc_createSpace({"space_name": "x", "partition_num": 1,
+                                 "replica_factor": 3})
+        assert ei.value.status.code == ErrorCode.E_NO_VALID_HOST
+
+    def test_duplicate_space(self, svc):
+        register_hosts(svc)
+        svc.rpc_createSpace({"space_name": "nba"})
+        with pytest.raises(RpcError) as ei:
+            svc.rpc_createSpace({"space_name": "nba"})
+        assert ei.value.status.code == ErrorCode.E_EXISTED
+
+    def test_drop_space(self, svc):
+        register_hosts(svc)
+        svc.rpc_createSpace({"space_name": "nba"})
+        svc.rpc_dropSpace({"space_name": "nba"})
+        assert svc.rpc_listSpaces({})["spaces"] == []
+        with pytest.raises(RpcError):
+            svc.rpc_dropSpace({"space_name": "nba"})
+
+    def test_schema_crud_and_versioning(self, svc):
+        register_hosts(svc)
+        sid = svc.rpc_createSpace({"space_name": "nba"})["id"]
+        tid = svc.rpc_createTagSchema({"space_id": sid, "name": "player",
+                                       "schema": PLAYER_WIRE})["id"]
+        schemas = svc.rpc_listTagSchemas({"space_id": sid})["schemas"]
+        assert len(schemas) == 1 and schemas[0]["version"] == 0
+
+        # ALTER ADD a column -> version 1
+        resp = svc.rpc_alterTagSchema({
+            "space_id": sid, "name": "player",
+            "items": [{"op": int(AlterSchemaOp.ADD),
+                       "schema": {"columns": [["height", int(SupportedType.DOUBLE), None]]}}]})
+        assert resp["version"] == 1
+        schemas = svc.rpc_listTagSchemas({"space_id": sid})["schemas"]
+        assert len(schemas) == 2
+        newest = max(schemas, key=lambda s: s["version"])
+        assert [c[0] for c in newest["schema"]["columns"]] == ["name", "age", "height"]
+
+        # DROP a column -> version 2
+        svc.rpc_alterTagSchema({
+            "space_id": sid, "name": "player",
+            "items": [{"op": int(AlterSchemaOp.DROP),
+                       "schema": {"columns": [["age", int(SupportedType.INT), None]]}}]})
+        schemas = svc.rpc_listTagSchemas({"space_id": sid})["schemas"]
+        newest = max(schemas, key=lambda s: s["version"])
+        assert [c[0] for c in newest["schema"]["columns"]] == ["name", "height"]
+
+        svc.rpc_dropTagSchema({"space_id": sid, "name": "player"})
+        assert svc.rpc_listTagSchemas({"space_id": sid})["schemas"] == []
+
+    def test_edge_schema(self, svc):
+        register_hosts(svc)
+        sid = svc.rpc_createSpace({"space_name": "nba"})["id"]
+        et = svc.rpc_createEdgeSchema({"space_id": sid, "name": "follow",
+                                       "schema": FOLLOW_WIRE})["id"]
+        assert et > 0
+        schemas = svc.rpc_listEdgeSchemas({"space_id": sid})["schemas"]
+        assert schemas[0]["name"] == "follow"
+
+    def test_custom_kv(self, svc):
+        svc.rpc_multiPut({"segment": "s1", "pairs": [["k1", b"v1"], ["k2", b"v2"]]})
+        assert svc.rpc_get({"segment": "s1", "key": "k1"})["value"] == b"v1"
+        got = svc.rpc_scan({"segment": "s1", "start": "k1", "end": "kz"})["values"]
+        assert [k for k, _ in got] == ["k1", "k2"]
+        svc.rpc_remove({"segment": "s1", "key": "k1"})
+        with pytest.raises(RpcError):
+            svc.rpc_get({"segment": "s1", "key": "k1"})
+        # segment isolation
+        svc.rpc_multiPut({"segment": "s2", "pairs": [["k9", b"x"]]})
+        got = svc.rpc_scan({"segment": "s1", "start": "a", "end": "z"})["values"]
+        assert [k for k, _ in got] == ["k2"]
+
+    def test_users_and_roles(self, svc):
+        svc.rpc_createUser({"account": "alice", "password": "pw"})
+        assert svc.rpc_checkPassword({"account": "alice", "password": "pw"})["ok"]
+        assert not svc.rpc_checkPassword({"account": "alice", "password": "no"})["ok"]
+        svc.rpc_grantRole({"account": "alice", "space_id": 1,
+                           "role": int(RoleType.ADMIN)})
+        users = svc.rpc_listUsers({})["users"]
+        assert users[0]["roles"] == {"1": int(RoleType.ADMIN)}
+        svc.rpc_changePassword({"account": "alice", "old_password": "pw",
+                                "new_password": "pw2"})
+        assert svc.rpc_checkPassword({"account": "alice", "password": "pw2"})["ok"]
+        svc.rpc_dropUser({"account": "alice"})
+        assert svc.rpc_listUsers({})["users"] == []
+
+    def test_config_registry(self, svc):
+        svc.rpc_regConfig({"items": [
+            {"module": int(ConfigModule.GRAPH), "name": "f1",
+             "mode": int(ConfigMode.MUTABLE), "value": 10},
+            {"module": int(ConfigModule.GRAPH), "name": "f2",
+             "mode": int(ConfigMode.IMMUTABLE), "value": "x"},
+        ]})
+        assert svc.rpc_getConfig({"module": int(ConfigModule.GRAPH),
+                                  "name": "f1"})["value"] == 10
+        svc.rpc_setConfig({"module": int(ConfigModule.GRAPH), "name": "f1",
+                           "value": 42})
+        assert svc.rpc_getConfig({"module": int(ConfigModule.GRAPH),
+                                  "name": "f1"})["value"] == 42
+        with pytest.raises(RpcError):
+            svc.rpc_setConfig({"module": int(ConfigModule.GRAPH), "name": "f2",
+                               "value": "y"})
+        items = svc.rpc_listConfigs({"module": int(ConfigModule.GRAPH)})["items"]
+        assert {i["name"] for i in items} == {"f1", "f2"}
+
+    def test_cluster_id_persists(self):
+        svc = MetaService()
+        cid = svc.cluster_id
+        svc2 = MetaService(kv=svc.kv)
+        assert svc2.cluster_id == cid
+
+    def test_heartbeat_wrong_cluster(self, svc):
+        with pytest.raises(RpcError) as ei:
+            svc.rpc_heartBeat({"host": "h:1", "cluster_id": 12345})
+        assert ei.value.status.code == ErrorCode.E_WRONGCLUSTER
+
+
+class TestMetaClient:
+    def make_client(self, svc, **kw):
+        cm = ClientManager()
+        addr = HostAddr("meta", 9559)
+        cm.register_loopback(addr, svc)
+        return MetaClient([addr], client_manager=cm, **kw)
+
+    def test_caches(self, svc):
+        register_hosts(svc)
+        client = self.make_client(svc)
+        assert client.wait_for_metad_ready()
+        sid = client.create_space("nba", partition_num=4).value()
+        client.create_tag_schema(sid, "player", PLAYER_WIRE)
+        client.create_edge_schema(sid, "follow", FOLLOW_WIRE)
+
+        assert client.get_space_id_by_name("nba").value() == sid
+        assert client.part_num(sid) == 4
+        tid = client.get_tag_id(sid, "player").value()
+        schema = client.get_tag_schema(sid, tid)
+        assert schema.names() == ["name", "age"]
+        et = client.get_edge_type(sid, "follow").value()
+        assert client.get_edge_schema(sid, et).names() == ["degree"]
+        assert not client.get_tag_id(sid, "nope").ok()
+
+    def test_listener_diff(self, svc):
+        register_hosts(svc, 1)
+        client = self.make_client(svc, local_host="127.0.0.1:45000")
+        events = []
+
+        class L(MetaChangedListener):
+            def on_space_added(self, sid): events.append(("space+", sid))
+            def on_part_added(self, sid, pid, peers): events.append(("part+", sid, pid))
+            def on_space_removed(self, sid): events.append(("space-", sid))
+            def on_part_removed(self, sid, pid): events.append(("part-", sid, pid))
+
+        client.listener = L()
+        client.wait_for_metad_ready()
+        sid = client.create_space("nba", partition_num=2).value()
+        assert ("space+", sid) in events
+        assert ("part+", sid, 1) in events and ("part+", sid, 2) in events
+        client.drop_space("nba")
+        assert ("space-", sid) in events
+
+    def test_meta_server_based_part_manager(self, svc):
+        register_hosts(svc, 1)
+        client = self.make_client(svc, local_host="127.0.0.1:45000")
+        pm = MetaServerBasedPartManager(client, "127.0.0.1:45000")
+        store = NebulaStore(KVOptions(part_man=pm))
+        client.wait_for_metad_ready()
+        sid = client.create_space("nba", partition_num=3).value()
+        # parts materialize on the local store via listener callbacks
+        assert store.part_ids(sid) == [1, 2, 3]
+        client.drop_space("nba")
+        assert store.part_ids(sid) == []
+
+    def test_over_real_tcp(self, svc):
+        server = RpcServer(svc).start()
+        try:
+            register_hosts(svc)
+            client = MetaClient([server.addr], client_manager=ClientManager())
+            assert client.wait_for_metad_ready()
+            sid = client.create_space("tcp_space", partition_num=2).value()
+            assert client.part_num(sid) == 2
+        finally:
+            server.stop()
+
+    def test_schema_manager_server_based(self, svc):
+        register_hosts(svc)
+        client = self.make_client(svc)
+        client.wait_for_metad_ready()
+        sid = client.create_space("nba").value()
+        client.create_tag_schema(sid, "player", PLAYER_WIRE)
+        sm = ServerBasedSchemaManager(client)
+        tid = sm.to_tag_id(sid, "player").value()
+        assert sm.get_tag_schema(sid, tid).names() == ["name", "age"]
+        assert sm.tag_name(sid, tid) == "player"
+
+
+class TestAdHocSchemaManager:
+    def test_basic(self):
+        sm = AdHocSchemaManager()
+        s = Schema(columns=[ColumnDef("x", SupportedType.INT)])
+        sm.add_tag_schema(1, 10, "t", s)
+        sm.add_edge_schema(1, 100, "e", s)
+        assert sm.to_tag_id(1, "t").value() == 10
+        assert sm.to_edge_type(1, "e").value() == 100
+        assert sm.get_tag_schema(1, 10).names() == ["x"]
+        assert sm.all_edge_types(1) == [100]
+        assert sm.all_tag_ids(1) == [10]
+        assert sm.tag_name(1, 10) == "t"
