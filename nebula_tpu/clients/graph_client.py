@@ -1,0 +1,96 @@
+"""GraphClient — connect/execute against graphd.
+
+Capability parity with /root/reference/src/client/cpp/GraphClient.h
+(blocking connect/execute returning ExecutionResponse).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.status import ErrorCode, Status
+from ..interface.common import HostAddr
+from ..interface.rpc import ClientManager, RpcError, default_client_manager
+
+
+class ExecutionResponse:
+    def __init__(self, raw: dict):
+        self.raw = raw
+
+    @property
+    def error_code(self) -> ErrorCode:
+        try:
+            return ErrorCode(self.raw.get("error_code", 0))
+        except ValueError:
+            return ErrorCode.E_UNKNOWN
+
+    @property
+    def error_msg(self) -> str:
+        return self.raw.get("error_msg", "")
+
+    @property
+    def latency_in_us(self) -> int:
+        return self.raw.get("latency_in_us", 0)
+
+    @property
+    def column_names(self):
+        return self.raw.get("column_names")
+
+    @property
+    def rows(self):
+        return self.raw.get("rows")
+
+    @property
+    def space_name(self) -> str:
+        return self.raw.get("space_name", "")
+
+    def ok(self) -> bool:
+        return self.error_code == ErrorCode.SUCCEEDED
+
+    def __repr__(self):
+        if not self.ok():
+            return f"ExecutionResponse({self.error_code.name}: {self.error_msg})"
+        return (f"ExecutionResponse(cols={self.column_names}, "
+                f"{len(self.rows or [])} rows, {self.latency_in_us}us)")
+
+
+class GraphClient:
+    def __init__(self, addr: HostAddr,
+                 client_manager: Optional[ClientManager] = None):
+        self.addr = addr
+        self.cm = client_manager or default_client_manager
+        self.session_id: Optional[int] = None
+
+    def connect(self, username: str = "user",
+                password: str = "password") -> Status:
+        try:
+            resp = self.cm.call(self.addr, "authenticate",
+                                {"username": username, "password": password})
+        except RpcError as e:
+            return e.status
+        code = resp.get("error_code", 0)
+        if code != 0:
+            return Status(ErrorCode(code), resp.get("error_msg", ""))
+        self.session_id = resp["session_id"]
+        return Status.OK()
+
+    def execute(self, stmt: str) -> ExecutionResponse:
+        if self.session_id is None:
+            return ExecutionResponse(
+                {"error_code": int(ErrorCode.E_DISCONNECTED),
+                 "error_msg": "not connected"})
+        try:
+            raw = self.cm.call(self.addr, "execute",
+                               {"session_id": self.session_id, "stmt": stmt})
+        except RpcError as e:
+            raw = {"error_code": int(e.status.code),
+                   "error_msg": e.status.msg}
+        return ExecutionResponse(raw)
+
+    def disconnect(self) -> None:
+        if self.session_id is not None:
+            try:
+                self.cm.call(self.addr, "signout",
+                             {"session_id": self.session_id})
+            except RpcError:
+                pass
+            self.session_id = None

@@ -1,0 +1,153 @@
+"""LocalCluster — wire metad + storaged(s) + graphd in one process.
+
+This is both the framework's single-process deployment AND the e2e test
+fixture (the reference boots mock metad + storaged + graphd in-process the
+same way — graph/test/TestEnv.cpp:29-70). Set ``use_tcp=True`` to put every
+service behind a real socket (RpcServer) instead of loopback channels.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .common.flags import flags
+from .interface.common import HostAddr
+from .interface.rpc import ClientManager, RpcServer
+from .kvstore.store import KVOptions, NebulaStore
+from .meta.client import MetaClient
+from .meta.part_manager import MetaServerBasedPartManager
+from .meta.schema_manager import ServerBasedSchemaManager
+from .meta.service import MetaService
+from .storage.client import StorageClient
+from .storage.compaction import make_compaction_filter_factory
+from .storage.service import StorageService
+from .graph.service import ExecutionEngine, GraphService
+
+
+class StorageNode:
+    def __init__(self, host: str, meta_addrs: List[HostAddr],
+                 cm: ClientManager, data_paths: Optional[List[str]] = None):
+        self.host = host
+        self.meta_client = MetaClient(meta_addrs, local_host=host,
+                                      send_heartbeat=True, client_manager=cm)
+        self.meta_client.wait_for_metad_ready()
+        self.meta_client.heartbeat()  # register immediately
+        self.schema_man = ServerBasedSchemaManager(self.meta_client)
+        self.part_man = MetaServerBasedPartManager(self.meta_client, host)
+        self.kv = NebulaStore(
+            KVOptions(part_man=self.part_man,
+                      data_paths=data_paths or [],
+                      compaction_filter_factory=make_compaction_filter_factory(
+                          self.schema_man)),
+            local_host=HostAddr.parse(host))
+        self.part_man.register_handler(self.kv)
+        self.kv.init()
+        self.service = StorageService(self.kv, self.schema_man,
+                                      local_host=host)
+
+    def start_loops(self) -> None:
+        self.meta_client.start()
+
+    def stop(self) -> None:
+        self.meta_client.stop()
+        self.service.shutdown()
+
+
+class LocalCluster:
+    def __init__(self, num_storage: int = 1, use_tcp: bool = False,
+                 data_paths: Optional[List[str]] = None,
+                 start_loops: bool = False, tpu_backend: bool = False):
+        self.cm = ClientManager()
+        self.servers: List[RpcServer] = []
+
+        # ---- metad --------------------------------------------------
+        self.meta_service = MetaService()
+        if use_tcp:
+            srv = RpcServer(self.meta_service).start()
+            self.servers.append(srv)
+            self.meta_addr = srv.addr
+        else:
+            self.meta_addr = HostAddr("meta", 9559)
+            self.cm.register_loopback(self.meta_addr, self.meta_service)
+
+        # ---- storaged(s) --------------------------------------------
+        self.storage_nodes: List[StorageNode] = []
+        storage_hosts = []
+        for i in range(num_storage):
+            srv = None
+            if use_tcp:
+                # bind the socket FIRST so the node registers under the
+                # address it actually serves on (handler attached below)
+                srv = RpcServer(None).start()
+                node_host = f"127.0.0.1:{srv.addr.port}"
+            else:
+                node_host = f"127.0.0.1:{44500 + i}"
+            # register heartbeat first so createSpace sees this host
+            self.meta_service.rpc_heartBeat({"host": node_host})
+            node = StorageNode(node_host, [self.meta_addr], self.cm,
+                               data_paths=data_paths)
+            if use_tcp:
+                srv.handler = node.service
+                self.servers.append(srv)
+            else:
+                self.cm.register_loopback(HostAddr.parse(node_host),
+                                          node.service)
+            self.storage_nodes.append(node)
+            storage_hosts.append(node.host)
+        self.storage_hosts = storage_hosts
+
+        # ---- graphd -------------------------------------------------
+        self.graph_meta_client = MetaClient([self.meta_addr],
+                                            client_manager=self.cm)
+        self.graph_meta_client.wait_for_metad_ready()
+        # declare managed flags into metad's config registry (GflagsManager)
+        from .interface.common import ConfigModule
+        from .meta.gflags_manager import GflagsManager
+        for module in (ConfigModule.GRAPH, ConfigModule.META,
+                       ConfigModule.STORAGE):
+            GflagsManager(self.graph_meta_client, module).declare_gflags()
+        self.schema_man = ServerBasedSchemaManager(self.graph_meta_client)
+        self.storage_client = StorageClient(self.graph_meta_client,
+                                            client_manager=self.cm)
+        self.tpu_runtime = None
+        if tpu_backend:
+            from .tpu.runtime import TpuQueryRuntime
+            self.tpu_runtime = TpuQueryRuntime(self.storage_nodes,
+                                               self.schema_man)
+        self.engine = ExecutionEngine(self.graph_meta_client, self.schema_man,
+                                      self.storage_client,
+                                      tpu_runtime=self.tpu_runtime)
+        self.graph_service = GraphService(self.engine)
+        if use_tcp:
+            srv = RpcServer(self.graph_service).start()
+            self.servers.append(srv)
+            self.graph_addr = srv.addr
+        else:
+            self.graph_addr = HostAddr("graph", 3699)
+            self.cm.register_loopback(self.graph_addr, self.graph_service)
+
+        if start_loops:
+            for node in self.storage_nodes:
+                node.start_loops()
+            self.graph_meta_client.start()
+
+    # ---- convenience ----------------------------------------------
+    def client(self):
+        from .clients.graph_client import GraphClient
+        c = GraphClient(self.graph_addr, client_manager=self.cm)
+        c.connect()
+        return c
+
+    def refresh_all(self) -> None:
+        """Propagate meta changes now (tests shrink the refresh interval;
+        we just push — reference TestEnv sleeps on load_data_interval_secs)."""
+        for node in self.storage_nodes:
+            node.meta_client.load_data()
+        self.graph_meta_client.load_data()
+
+    def stop(self) -> None:
+        for node in self.storage_nodes:
+            node.stop()
+        self.graph_meta_client.stop()
+        self.graph_service.sessions.stop()
+        for srv in self.servers:
+            srv.stop()

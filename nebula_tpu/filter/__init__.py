@@ -1,0 +1,8 @@
+from .expressions import (Expression, ExprContext, ExprError, PrimaryExpr,
+                          SourcePropExpr, DestPropExpr, AliasPropExpr,
+                          InputPropExpr, VariablePropExpr, EdgeTypeExpr,
+                          EdgeSrcIdExpr, EdgeDstIdExpr, EdgeRankExpr,
+                          FunctionCallExpr, UnaryExpr, TypeCastingExpr,
+                          ArithmeticExpr, RelationalExpr, LogicalExpr,
+                          encode_expr, decode_expr)
+from .functions import FunctionManager

@@ -1,0 +1,51 @@
+"""ExecutionContext — per-query resources (reference ExecutionContext.h)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..meta.client import MetaClient
+from ..meta.schema_manager import SchemaManager
+from ..storage.client import StorageClient
+from .interim import InterimResult, VariableHolder
+
+
+class ClientSession:
+    """Session state (reference ClientSession.h): current space + user."""
+
+    def __init__(self, session_id: int, user: str = ""):
+        self.session_id = session_id
+        self.user = user
+        self.space_name = ""
+        self.space_id = -1
+        import time
+        self._last_access = time.time()
+
+    def charge(self) -> None:
+        import time
+        self._last_access = time.time()
+
+    def idle_seconds(self) -> float:
+        import time
+        return time.time() - self._last_access
+
+
+class ExecutionContext:
+    def __init__(self, session: ClientSession, meta: MetaClient,
+                 schema_man: SchemaManager, storage: StorageClient,
+                 tpu_runtime=None):
+        self.session = session
+        self.meta = meta
+        self.schema_man = schema_man
+        self.storage = storage
+        self.variables = VariableHolder()
+        # set by Pipe: the left-hand result available as $- to the right
+        self.input: Optional[InterimResult] = None
+        # TPU query runtime (tpu/runtime.py) — executors prefer it when the
+        # current space has a device CSR mirror and the flag allows
+        self.tpu_runtime = tpu_runtime
+
+    def space_id(self) -> int:
+        return self.session.space_id
+
+    def space_chosen(self) -> bool:
+        return self.session.space_id >= 0

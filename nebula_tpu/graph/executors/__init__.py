@@ -1,0 +1,61 @@
+"""Executor registry — Sentence::Kind -> executor (reference
+Executor::makeExecutor, Executor.cpp:48-150)."""
+from __future__ import annotations
+
+from ..parser import ast
+from .base import Executor, ExecError
+from . import admin, mutate, traverse
+
+_REGISTRY = {
+    ast.Kind.GO: traverse.GoExecutor,
+    ast.Kind.FIND_PATH: traverse.FindPathExecutor,
+    ast.Kind.FIND: traverse.FindExecutor,
+    ast.Kind.MATCH: traverse.MatchExecutor,
+    ast.Kind.FETCH_VERTICES: traverse.FetchVerticesExecutor,
+    ast.Kind.FETCH_EDGES: traverse.FetchEdgesExecutor,
+    ast.Kind.YIELD: traverse.YieldExecutor,
+    ast.Kind.ORDER_BY: traverse.OrderByExecutor,
+    ast.Kind.LIMIT: traverse.LimitExecutor,
+    ast.Kind.GROUP_BY: traverse.GroupByExecutor,
+    ast.Kind.SET_OP: traverse.SetExecutor,
+    ast.Kind.PIPE: traverse.PipeExecutor,
+    ast.Kind.ASSIGNMENT: traverse.AssignmentExecutor,
+    ast.Kind.INSERT_VERTEX: mutate.InsertVertexExecutor,
+    ast.Kind.INSERT_EDGE: mutate.InsertEdgeExecutor,
+    ast.Kind.UPDATE_VERTEX: mutate.UpdateVertexExecutor,
+    ast.Kind.UPDATE_EDGE: mutate.UpdateEdgeExecutor,
+    ast.Kind.DELETE_VERTEX: mutate.DeleteVertexExecutor,
+    ast.Kind.DELETE_EDGE: mutate.DeleteEdgeExecutor,
+    ast.Kind.CREATE_SPACE: admin.CreateSpaceExecutor,
+    ast.Kind.DROP_SPACE: admin.DropSpaceExecutor,
+    ast.Kind.DESCRIBE_SPACE: admin.DescribeSpaceExecutor,
+    ast.Kind.CREATE_TAG: admin.CreateTagExecutor,
+    ast.Kind.CREATE_EDGE: admin.CreateEdgeExecutor,
+    ast.Kind.ALTER_TAG: admin.AlterTagExecutor,
+    ast.Kind.ALTER_EDGE: admin.AlterEdgeExecutor,
+    ast.Kind.DROP_TAG: admin.DropTagExecutor,
+    ast.Kind.DROP_EDGE: admin.DropEdgeExecutor,
+    ast.Kind.DESCRIBE_TAG: admin.DescribeTagExecutor,
+    ast.Kind.DESCRIBE_EDGE: admin.DescribeEdgeExecutor,
+    ast.Kind.USE: admin.UseExecutor,
+    ast.Kind.SHOW: admin.ShowExecutor,
+    ast.Kind.ADD_HOSTS: admin.AddHostsExecutor,
+    ast.Kind.REMOVE_HOSTS: admin.RemoveHostsExecutor,
+    ast.Kind.CONFIG: admin.ConfigExecutor,
+    ast.Kind.BALANCE: admin.BalanceExecutor,
+    ast.Kind.DOWNLOAD: admin.DownloadExecutor,
+    ast.Kind.INGEST: admin.IngestExecutor,
+    ast.Kind.CREATE_USER: admin.CreateUserExecutor,
+    ast.Kind.ALTER_USER: admin.AlterUserExecutor,
+    ast.Kind.DROP_USER: admin.DropUserExecutor,
+    ast.Kind.CHANGE_PASSWORD: admin.ChangePasswordExecutor,
+    ast.Kind.GRANT: admin.GrantExecutor,
+    ast.Kind.REVOKE: admin.RevokeExecutor,
+}
+
+
+def make_executor(sentence: ast.Sentence, ectx) -> Executor:
+    cls = _REGISTRY.get(sentence.kind)
+    if cls is None:
+        raise ExecError(f"statement {sentence.kind.value} not supported")
+    return cls(sentence, ectx)

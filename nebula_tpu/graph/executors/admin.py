@@ -1,0 +1,433 @@
+"""DDL / admin / user executors.
+
+Capability parity with the reference's one-file-each executor set
+(SURVEY.md §2.2): Use, CreateSpace/Tag/Edge, Alter, Drop, Describe, Show,
+AddHosts/RemoveHosts, ConfigExecutor (SHOW/GET/UPDATE CONFIGS), Balance,
+Download/Ingest, and the user-management executors.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ...common.status import ErrorCode
+from ...interface.common import (ConfigModule, RoleType, SupportedType,
+                                 schema_to_wire, Schema, ColumnDef, SchemaProp)
+from ..interim import InterimResult
+from ..parser import ast
+from .base import ExecError, Executor
+
+_TYPE_MAP = {
+    "int": SupportedType.INT,
+    "double": SupportedType.DOUBLE,
+    "string": SupportedType.STRING,
+    "bool": SupportedType.BOOL,
+    "timestamp": SupportedType.TIMESTAMP,
+}
+
+_TYPE_NAME = {v: k for k, v in _TYPE_MAP.items()}
+
+_MODULE_MAP = {"graph": ConfigModule.GRAPH, "meta": ConfigModule.META,
+               "storage": ConfigModule.STORAGE, None: ConfigModule.ALL}
+
+
+def _meta_call(ex: Executor, method: str, payload: dict,
+               ignore: tuple = ()) -> dict:
+    r = ex.ectx.meta.call(method, payload)
+    if not r.ok():
+        if r.status.code in ignore:
+            return {}
+        raise ExecError(r.status.msg or r.status.to_string(), r.status.code)
+    return r.value()
+
+
+class UseExecutor(Executor):
+    NAME = "UseExecutor"
+
+    def execute(self) -> None:
+        s: ast.UseSentence = self.sentence
+        r = self.ectx.meta.get_space_id_by_name(s.space)
+        if not r.ok():
+            self.ectx.meta.refresh()
+            r = self.ectx.meta.get_space_id_by_name(s.space)
+        if not r.ok():
+            raise ExecError(f"space `{s.space}' not found",
+                            ErrorCode.E_SPACE_NOT_FOUND)
+        self.ectx.session.space_name = s.space
+        self.ectx.session.space_id = r.value()
+        return None
+
+
+class CreateSpaceExecutor(Executor):
+    NAME = "CreateSpaceExecutor"
+
+    def execute(self) -> None:
+        s: ast.CreateSpaceSentence = self.sentence
+        props = {p.name: p.value for p in s.props}
+        payload = {"space_name": s.name,
+                   "partition_num": int(props.get("partition_num", 1)),
+                   "replica_factor": int(props.get("replica_factor", 1))}
+        ignore = (ErrorCode.E_EXISTED,) if s.if_not_exists else ()
+        _meta_call(self, "createSpace", payload, ignore)
+        self.ectx.meta.refresh()
+        return None
+
+
+class DropSpaceExecutor(Executor):
+    NAME = "DropSpaceExecutor"
+
+    def execute(self) -> None:
+        s: ast.DropSpaceSentence = self.sentence
+        ignore = (ErrorCode.E_NOT_FOUND,) if s.if_exists else ()
+        _meta_call(self, "dropSpace", {"space_name": s.name}, ignore)
+        if self.ectx.session.space_name == s.name:
+            self.ectx.session.space_name = ""
+            self.ectx.session.space_id = -1
+        self.ectx.meta.refresh()
+        return None
+
+
+class DescribeSpaceExecutor(Executor):
+    NAME = "DescribeSpaceExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.DescribeSpaceSentence = self.sentence
+        resp = _meta_call(self, "getSpace", {"space_name": s.name})
+        return InterimResult(
+            ["ID", "Name", "Partition number", "Replica Factor"],
+            [[resp["id"], resp["name"], resp["partition_num"],
+              resp["replica_factor"]]])
+
+
+def _columns_to_schema(cols: List[ast.ColumnSpec],
+                       props: List[ast.SchemaPropItem]) -> dict:
+    schema = Schema(columns=[
+        ColumnDef(c.name, _TYPE_MAP[c.type_name], c.default) for c in cols])
+    pm = {p.name: p.value for p in props}
+    ttl_d = pm.get("ttl_duration")
+    schema.schema_prop = SchemaProp(
+        int(ttl_d) if ttl_d is not None else None, pm.get("ttl_col"))
+    return schema_to_wire(schema)
+
+
+class _CreateSchemaExecutor(Executor):
+    METHOD = ""
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s = self.sentence
+        for c in s.columns:
+            if c.type_name not in _TYPE_MAP:
+                raise ExecError(f"bad column type {c.type_name}")
+        payload = {"space_id": self.ectx.space_id(), "name": s.name,
+                   "schema": _columns_to_schema(s.columns, s.props)}
+        ignore = (ErrorCode.E_EXISTED,) if s.if_not_exists else ()
+        _meta_call(self, self.METHOD, payload, ignore)
+        self.ectx.meta.refresh()
+        return None
+
+
+class CreateTagExecutor(_CreateSchemaExecutor):
+    NAME = "CreateTagExecutor"
+    METHOD = "createTagSchema"
+
+
+class CreateEdgeExecutor(_CreateSchemaExecutor):
+    NAME = "CreateEdgeExecutor"
+    METHOD = "createEdgeSchema"
+
+
+class _AlterSchemaExecutor(Executor):
+    METHOD = ""
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s = self.sentence
+        items = []
+        op_map = {"ADD": 1, "CHANGE": 2, "DROP": 3}
+        for item in s.items:
+            items.append({
+                "op": op_map[item.op],
+                "schema": {"columns": [
+                    [c.name, int(_TYPE_MAP[c.type_name]), c.default]
+                    for c in item.columns]},
+            })
+        payload = {"space_id": self.ectx.space_id(), "name": s.name,
+                   "items": items}
+        pm = {p.name: p.value for p in s.props}
+        if pm:
+            payload["ttl"] = {"ttl_duration": pm.get("ttl_duration"),
+                              "ttl_col": pm.get("ttl_col")}
+        _meta_call(self, self.METHOD, payload)
+        self.ectx.meta.refresh()
+        return None
+
+
+class AlterTagExecutor(_AlterSchemaExecutor):
+    NAME = "AlterTagExecutor"
+    METHOD = "alterTagSchema"
+
+
+class AlterEdgeExecutor(_AlterSchemaExecutor):
+    NAME = "AlterEdgeExecutor"
+    METHOD = "alterEdgeSchema"
+
+
+class _DropSchemaExecutor(Executor):
+    METHOD = ""
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s = self.sentence
+        ignore = (ErrorCode.E_SCHEMA_NOT_FOUND,) if s.if_exists else ()
+        _meta_call(self, self.METHOD,
+                   {"space_id": self.ectx.space_id(), "name": s.name}, ignore)
+        self.ectx.meta.refresh()
+        return None
+
+
+class DropTagExecutor(_DropSchemaExecutor):
+    NAME = "DropTagExecutor"
+    METHOD = "dropTagSchema"
+
+
+class DropEdgeExecutor(_DropSchemaExecutor):
+    NAME = "DropEdgeExecutor"
+    METHOD = "dropEdgeSchema"
+
+
+class _DescribeSchemaExecutor(Executor):
+    KIND = "tag"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        if self.KIND == "tag":
+            r = sm.to_tag_id(space, s.name)
+            schema = sm.get_tag_schema(space, r.value()) if r.ok() else None
+        else:
+            r = sm.to_edge_type(space, s.name)
+            schema = sm.get_edge_schema(space, r.value()) if r.ok() else None
+        if schema is None:
+            raise ExecError(f"{self.KIND} `{s.name}' not found",
+                            ErrorCode.E_SCHEMA_NOT_FOUND)
+        rows = [[c.name, _TYPE_NAME.get(c.type, str(int(c.type)))]
+                for c in schema.columns]
+        return InterimResult(["Field", "Type"], rows)
+
+
+class DescribeTagExecutor(_DescribeSchemaExecutor):
+    NAME = "DescribeTagExecutor"
+    KIND = "tag"
+
+
+class DescribeEdgeExecutor(_DescribeSchemaExecutor):
+    NAME = "DescribeEdgeExecutor"
+    KIND = "edge"
+
+
+class ShowExecutor(Executor):
+    NAME = "ShowExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.ShowSentence = self.sentence
+        t = s.target
+        if t == ast.ShowTarget.SPACES:
+            resp = _meta_call(self, "listSpaces", {})
+            return InterimResult(["Name"],
+                                 [[sp["name"]] for sp in resp["spaces"]])
+        if t == ast.ShowTarget.TAGS:
+            self.check_space_chosen()
+            resp = _meta_call(self, "listTagSchemas",
+                              {"space_id": self.ectx.space_id()})
+            seen = {}
+            for rec in resp["schemas"]:
+                seen[rec["id"]] = rec["name"]
+            return InterimResult(["ID", "Name"],
+                                 [[i, n] for i, n in sorted(seen.items())])
+        if t == ast.ShowTarget.EDGES:
+            self.check_space_chosen()
+            resp = _meta_call(self, "listEdgeSchemas",
+                              {"space_id": self.ectx.space_id()})
+            seen = {}
+            for rec in resp["schemas"]:
+                seen[rec["id"]] = rec["name"]
+            return InterimResult(["ID", "Name"],
+                                 [[i, n] for i, n in sorted(seen.items())])
+        if t == ast.ShowTarget.HOSTS:
+            resp = _meta_call(self, "listHosts", {})
+            return InterimResult(["Ip", "Port", "Status"], [
+                [h["host"].rsplit(":", 1)[0], int(h["host"].rsplit(":", 1)[1]),
+                 h["status"]] for h in resp["hosts"]])
+        if t == ast.ShowTarget.PARTS:
+            self.check_space_chosen()
+            resp = _meta_call(self, "getPartsAlloc",
+                              {"space_id": self.ectx.space_id()})
+            rows = [[int(p), ", ".join(hosts)]
+                    for p, hosts in sorted(resp["parts"].items(),
+                                           key=lambda kv: int(kv[0]))]
+            return InterimResult(["Partition ID", "Peers"], rows)
+        if t == ast.ShowTarget.USERS:
+            resp = _meta_call(self, "listUsers", {})
+            return InterimResult(["Account"],
+                                 [[u["account"]] for u in resp["users"]])
+        if t == ast.ShowTarget.VARIABLES:
+            return InterimResult(["Variable"], [])
+        raise ExecError(f"SHOW {t.value} not supported")
+
+
+class AddHostsExecutor(Executor):
+    NAME = "AddHostsExecutor"
+
+    def execute(self) -> None:
+        s: ast.AddHostsSentence = self.sentence
+        _meta_call(self, "addHosts", {"hosts": s.hosts})
+        return None
+
+
+class RemoveHostsExecutor(Executor):
+    NAME = "RemoveHostsExecutor"
+
+    def execute(self) -> None:
+        s: ast.RemoveHostsSentence = self.sentence
+        _meta_call(self, "removeHosts", {"hosts": s.hosts})
+        return None
+
+
+class ConfigExecutor(Executor):
+    NAME = "ConfigExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.ConfigSentence = self.sentence
+        module = _MODULE_MAP.get(s.module, ConfigModule.ALL)
+        if s.action == "show":
+            payload = {} if module == ConfigModule.ALL else {"module": int(module)}
+            resp = _meta_call(self, "listConfigs", payload)
+            rows = [[ConfigModule(i["module"]).name, i["name"],
+                     str(i.get("value"))] for i in resp["items"]]
+            return InterimResult(["module", "name", "value"], rows)
+        if s.action == "get":
+            resp = _meta_call(self, "getConfig",
+                              {"module": int(module), "name": s.name})
+            return InterimResult(["module", "name", "value"],
+                                 [[ConfigModule(resp["module"]).name,
+                                   resp["name"], str(resp.get("value"))]])
+        # update
+        _meta_call(self, "setConfig", {"module": int(module), "name": s.name,
+                                       "value": s.value})
+        from ...common.flags import flags
+        flags.set(s.name, s.value)
+        return None
+
+
+class BalanceExecutor(Executor):
+    NAME = "BalanceExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.BalanceSentence = self.sentence
+        if s.target == "leader":
+            _meta_call(self, "leaderBalance", {})
+            return None
+        payload = {}
+        if s.stop:
+            payload["stop"] = True
+        if s.plan_id is not None:
+            payload["plan_id"] = s.plan_id
+        resp = _meta_call(self, "balance", payload)
+        if "plan_id" in resp:
+            return InterimResult(["ID"], [[resp["plan_id"]]])
+        if "tasks" in resp:
+            return InterimResult(["balance task", "status"],
+                                 [[t["task"], t["status"]]
+                                  for t in resp["tasks"]])
+        return None
+
+
+class DownloadExecutor(Executor):
+    NAME = "DownloadExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s: ast.DownloadSentence = self.sentence
+        _meta_call(self, "download", {"space_id": self.ectx.space_id(),
+                                      "url": s.url})
+        return None
+
+
+class IngestExecutor(Executor):
+    NAME = "IngestExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        _meta_call(self, "ingest", {"space_id": self.ectx.space_id()})
+        return None
+
+
+class CreateUserExecutor(Executor):
+    NAME = "CreateUserExecutor"
+
+    def execute(self) -> None:
+        s: ast.CreateUserSentence = self.sentence
+        _meta_call(self, "createUser",
+                   {"account": s.account, "password": s.password,
+                    "if_not_exists": s.if_not_exists})
+        return None
+
+
+class AlterUserExecutor(Executor):
+    NAME = "AlterUserExecutor"
+
+    def execute(self) -> None:
+        s: ast.AlterUserSentence = self.sentence
+        _meta_call(self, "changePassword",
+                   {"account": s.account, "new_password": s.password})
+        return None
+
+
+class DropUserExecutor(Executor):
+    NAME = "DropUserExecutor"
+
+    def execute(self) -> None:
+        s: ast.DropUserSentence = self.sentence
+        _meta_call(self, "dropUser", {"account": s.account,
+                                      "if_exists": s.if_exists})
+        return None
+
+
+class ChangePasswordExecutor(Executor):
+    NAME = "ChangePasswordExecutor"
+
+    def execute(self) -> None:
+        s: ast.ChangePasswordSentence = self.sentence
+        _meta_call(self, "changePassword",
+                   {"account": s.account, "old_password": s.old_password,
+                    "new_password": s.new_password})
+        return None
+
+
+class GrantExecutor(Executor):
+    NAME = "GrantExecutor"
+
+    def execute(self) -> None:
+        s: ast.GrantSentence = self.sentence
+        r = self.ectx.meta.get_space_id_by_name(s.space)
+        if not r.ok():
+            raise ExecError(f"space `{s.space}' not found")
+        _meta_call(self, "grantRole", {"account": s.account,
+                                       "space_id": r.value(),
+                                       "role": int(RoleType[s.role])})
+        return None
+
+
+class RevokeExecutor(Executor):
+    NAME = "RevokeExecutor"
+
+    def execute(self) -> None:
+        s: ast.RevokeSentence = self.sentence
+        r = self.ectx.meta.get_space_id_by_name(s.space)
+        if not r.ok():
+            raise ExecError(f"space `{s.space}' not found")
+        _meta_call(self, "revokeRole", {"account": s.account,
+                                        "space_id": r.value()})
+        return None

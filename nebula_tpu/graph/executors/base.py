@@ -1,0 +1,91 @@
+"""Executor base (reference src/graph/Executor.h).
+
+``execute()`` returns the statement's InterimResult (None for statements
+with no rowset). Errors raise ExecError, converted to Status at the
+ExecutionPlan boundary.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...common.status import ErrorCode, Status
+from ...filter.expressions import ExprContext, ExprError, Expression
+from ..context import ExecutionContext
+from ..interim import InterimResult
+from ..parser import ast
+
+
+class ExecError(Exception):
+    def __init__(self, msg: str, code: ErrorCode = ErrorCode.E_EXECUTION_ERROR):
+        super().__init__(msg)
+        self.code = code
+
+    def status(self) -> Status:
+        return Status(self.code, str(self))
+
+
+class Executor:
+    NAME = "Executor"
+
+    def __init__(self, sentence, ectx: ExecutionContext):
+        self.sentence = sentence
+        self.ectx = ectx
+
+    def execute(self) -> Optional[InterimResult]:
+        raise NotImplementedError
+
+    # ---- helpers shared by executors --------------------------------
+    def check_space_chosen(self) -> None:
+        if not self.ectx.space_chosen():
+            raise ExecError("please choose a graph space with `USE spaceName' first")
+
+    def eval_const(self, expr: Expression):
+        """Evaluate an expression with no row context (vids, insert values)."""
+        try:
+            return expr.eval(ExprContext())
+        except ExprError as e:
+            raise ExecError(str(e))
+
+    def resolve_vids(self, from_: ast.FromClause) -> List[int]:
+        """FROM clause -> concrete vid list (literals, $-.col, $var.col)."""
+        if from_.ref is None:
+            vids = []
+            for e in from_.vids:
+                v = self.eval_const(e)
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ExecError(f"vid must be an integer, got {v!r}")
+                vids.append(v)
+            return vids
+        # ref: $-.col or $var.col
+        from ...filter.expressions import InputPropExpr, VariablePropExpr
+        ref = from_.ref
+        if isinstance(ref, InputPropExpr):
+            src = self.ectx.input
+            col = ref.prop
+            if src is None:
+                return []
+            if col == "id" and src.col_index("id") < 0:
+                vids = src.get_vids()
+            else:
+                vids = src.get_vids(col)
+        elif isinstance(ref, VariablePropExpr):
+            src = self.ectx.variables.get(ref.var)
+            if src is None:
+                raise ExecError(f"variable `${ref.var}' not defined")
+            col = ref.prop
+            if col == "id" and src.col_index("id") < 0:
+                vids = src.get_vids()
+            else:
+                vids = src.get_vids(col)
+        else:
+            raise ExecError("FROM clause must be vids, $-.col or $var.col")
+        if not vids.ok():
+            raise ExecError(vids.status.msg)
+        # preserve order, dedup (reference dedups pipe inputs)
+        seen = set()
+        out = []
+        for v in vids.value():
+            if v not in seen:
+                seen.add(v)
+                out.append(v)
+        return out

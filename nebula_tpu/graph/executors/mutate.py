@@ -1,0 +1,318 @@
+"""Mutate executors — INSERT / UPDATE / DELETE.
+
+Capability parity with /root/reference/src/graph/InsertVertexExecutor.cpp
+and InsertEdgeExecutor.cpp. The reference parses UPDATE/DELETE sentences
+but ships no executors (SURVEY.md §2.2 "no executors exist for them");
+we complete those paths against the same storage RPCs.
+
+Insert semantics mirrored: INSERT EDGE writes both directions — the
+out-edge keyed by src (+etype) and the in-edge keyed by dst (-etype) — so
+GO ... REVERSELY works (reference InsertEdgeExecutor).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ...codec.rows import decode_row, encode_row
+from ...common.status import ErrorCode
+from ...filter.expressions import ExprError
+from ...interface.common import Schema, schema_from_wire
+from ..interim import InterimResult
+from ..parser import ast
+from .base import ExecError, Executor
+
+
+class InsertVertexExecutor(Executor):
+    NAME = "InsertVertexExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s: ast.InsertVertexSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+
+        tag_infos = []  # (tag_id, schema, props)
+        total_props = 0
+        for item in s.tags:
+            tr = sm.to_tag_id(space, item.name)
+            if not tr.ok():
+                raise ExecError(f"unknown tag `{item.name}'")
+            schema = sm.get_tag_schema(space, tr.value())
+            for p in item.props:
+                if schema.field_index(p) < 0:
+                    raise ExecError(f"unknown property `{p}' on tag "
+                                    f"`{item.name}'")
+            tag_infos.append((tr.value(), schema, item.props))
+            total_props += len(item.props)
+
+        vertices = []
+        for row in s.rows:
+            vid = self.eval_const(row.vid)
+            if isinstance(vid, bool) or not isinstance(vid, int):
+                raise ExecError(f"vertex id must be an integer, got {vid!r}")
+            if len(row.values) != total_props:
+                raise ExecError(
+                    f"value count {len(row.values)} != prop count {total_props}")
+            values = [self.eval_const(v) for v in row.values]
+            tags = []
+            off = 0
+            for tag_id, schema, props in tag_infos:
+                vals = dict(zip(props, values[off:off + len(props)]))
+                off += len(props)
+                try:
+                    tags.append([tag_id, encode_row(schema, vals)])
+                except (TypeError, OverflowError) as e:
+                    raise ExecError(str(e), ErrorCode.E_IMPROPER_DATA_TYPE)
+            vertices.append({"id": vid, "tags": tags})
+
+        resp = self.ectx.storage.add_vertices(space, vertices,
+                                              overwritable=s.overwritable)
+        if not resp.succeeded():
+            first = next(iter(resp.failed_parts.values()))
+            raise ExecError(f"insert failed: {first.to_string()}")
+        return None
+
+
+class InsertEdgeExecutor(Executor):
+    NAME = "InsertEdgeExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s: ast.InsertEdgeSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        er = sm.to_edge_type(space, s.edge)
+        if not er.ok():
+            raise ExecError(f"unknown edge `{s.edge}'")
+        etype = er.value()
+        schema = sm.get_edge_schema(space, etype)
+        for p in s.props:
+            if schema.field_index(p) < 0:
+                raise ExecError(f"unknown property `{p}' on edge `{s.edge}'")
+
+        edges = []
+        for row in s.rows:
+            src = self.eval_const(row.src)
+            dst = self.eval_const(row.dst)
+            for v in (src, dst):
+                if isinstance(v, bool) or not isinstance(v, int):
+                    raise ExecError(f"vertex id must be an integer, got {v!r}")
+            if len(row.values) != len(s.props):
+                raise ExecError(f"value count {len(row.values)} != "
+                                f"prop count {len(s.props)}")
+            values = dict(zip(s.props, [self.eval_const(v) for v in row.values]))
+            try:
+                props = encode_row(schema, values)
+            except (TypeError, OverflowError) as e:
+                raise ExecError(str(e), ErrorCode.E_IMPROPER_DATA_TYPE)
+            # out-edge and in-edge (reference writes both directions)
+            edges.append({"src": src, "etype": etype, "rank": row.rank,
+                          "dst": dst, "props": props})
+            edges.append({"src": dst, "etype": -etype, "rank": row.rank,
+                          "dst": src, "props": props})
+
+        resp = self.ectx.storage.add_edges(space, edges,
+                                           overwritable=s.overwritable)
+        if not resp.succeeded():
+            first = next(iter(resp.failed_parts.values()))
+            raise ExecError(f"insert failed: {first.to_string()}")
+        return None
+
+
+class _UpdateBase(Executor):
+    def _fetch_current(self, space, vid, tag_or_none):
+        """Read current props of the target (for SET expr eval + write-back)."""
+        raise NotImplementedError
+
+
+class UpdateVertexExecutor(Executor):
+    NAME = "UpdateVertexExecutor"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.UpdateVertexSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        vid = self.eval_const(s.vid)
+
+        # read-modify-write through getProps/addVertices
+        resp = self.ectx.storage.get_props(space, [vid], [])
+        current: Dict[str, object] = {}
+        for r in resp.responses:
+            if r.get("vertex_schema") and r["vertices"]:
+                schema = schema_from_wire(r["vertex_schema"])
+                current = decode_row(r["vertices"][0]["vdata"], schema)
+        if not current and not s.insertable:
+            raise ExecError(f"vertex {vid} not found")
+
+        from .traverse import _RowCtx
+        ctx = _RowCtx()
+        ctx.src_vals = {}
+        # expose current props as $^.<anytag>.<prop> and bare input
+        for k, v in current.items():
+            ctx.input_row[k] = v
+
+        def src_get(tag, prop):
+            if prop in current:
+                return current[prop]
+            raise ExprError(f"$^.{tag}.{prop} unavailable")
+        ctx.get_src_tag_prop = src_get
+
+        try:
+            if s.where is not None and not s.where.filter.eval(ctx):
+                return InterimResult([], [])
+            updates = {item.prop: item.value.eval(ctx) for item in s.items}
+        except ExprError as e:
+            raise ExecError(str(e))
+        new_vals = dict(current)
+        new_vals.update(updates)
+
+        # figure out which tag each prop belongs to; write back per tag
+        tags = []
+        for tag_id in sm.all_tag_ids(space):
+            schema = sm.get_tag_schema(space, tag_id)
+            if any(schema.field_index(p) >= 0 for p in new_vals):
+                row = {p: v for p, v in new_vals.items()
+                       if schema.field_index(p) >= 0}
+                tags.append([tag_id, encode_row(schema, row)])
+        if not tags:
+            raise ExecError("no matching tag schema for SET properties")
+        w = self.ectx.storage.add_vertices(space, [{"id": vid, "tags": tags}])
+        if not w.succeeded():
+            raise ExecError("update write failed")
+        if s.yield_ is not None:
+            ctx.input_row.update(updates)
+            for k, v in updates.items():
+                current[k] = v
+            cols = [c.alias or str(c.expr) for c in s.yield_.columns]
+            try:
+                row = [c.expr.eval(ctx) for c in s.yield_.columns]
+            except ExprError as e:
+                raise ExecError(str(e))
+            return InterimResult(cols, [row])
+        return None
+
+
+class UpdateEdgeExecutor(Executor):
+    NAME = "UpdateEdgeExecutor"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.UpdateEdgeSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        er = sm.to_edge_type(space, s.edge)
+        if not er.ok():
+            raise ExecError(f"unknown edge `{s.edge}'")
+        etype = er.value()
+        schema = sm.get_edge_schema(space, etype)
+        src = self.eval_const(s.src)
+        dst = self.eval_const(s.dst)
+
+        resp = self.ectx.storage.get_edge_props(
+            space, [(src, etype, s.rank, dst)], schema.names())
+        current: Dict[str, object] = {}
+        from ...codec.rows import RowSetReader, RowReader
+        for r in resp.responses:
+            for et_s, blob in r.get("edges", {}).items():
+                rschema = schema_from_wire(r["edge_schemas"][int(et_s)])
+                for raw in RowSetReader(blob):
+                    d = RowReader(raw, rschema).to_dict()
+                    current = {k: v for k, v in d.items()
+                               if not k.startswith("_")}
+        if not current and not s.insertable:
+            raise ExecError(f"edge {src}->{dst}@{s.rank} not found")
+
+        from .traverse import _RowCtx
+        ctx = _RowCtx()
+        ctx.edge_vals = dict(current)
+        ctx.input_row = dict(current)
+        try:
+            if s.where is not None and not s.where.filter.eval(ctx):
+                return InterimResult([], [])
+            updates = {item.prop: item.value.eval(ctx) for item in s.items}
+        except ExprError as e:
+            raise ExecError(str(e))
+        new_vals = dict(current)
+        new_vals.update(updates)
+        props = encode_row(schema, new_vals)
+        w = self.ectx.storage.add_edges(space, [
+            {"src": src, "etype": etype, "rank": s.rank, "dst": dst,
+             "props": props},
+            {"src": dst, "etype": -etype, "rank": s.rank, "dst": src,
+             "props": props}])
+        if not w.succeeded():
+            raise ExecError("update write failed")
+        if s.yield_ is not None:
+            ctx.edge_vals.update(updates)
+            ctx.input_row.update(updates)
+            cols = [c.alias or str(c.expr) for c in s.yield_.columns]
+            try:
+                row = [c.expr.eval(ctx) for c in s.yield_.columns]
+            except ExprError as e:
+                raise ExecError(str(e))
+            return InterimResult(cols, [row])
+        return None
+
+
+class DeleteVertexExecutor(Executor):
+    NAME = "DeleteVertexExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s: ast.DeleteVertexSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        etypes = sm.all_edge_types(space)
+        for vexpr in s.vids:
+            vid = self.eval_const(vexpr)
+            # Remove the mirror records stored under NEIGHBOR vertices
+            # first, or traversals keep reaching the deleted vertex
+            # (both directions: out-edges' in-mirrors and in-edges'
+            # out-mirrors live on the neighbors).
+            doomed = []
+            for signed in list(etypes) + [-e for e in etypes]:
+                resp = self.ectx.storage.get_neighbors(space, [vid], [signed])
+                for r in resp.responses:
+                    for v in r["vertices"]:
+                        for et_s, blob in v["edges"].items():
+                            et = int(et_s)
+                            from ...interface.common import schema_from_wire
+                            from ...codec.rows import RowSetReader, RowReader
+                            schema = schema_from_wire(r["edge_schemas"][et])
+                            for raw in RowSetReader(blob):
+                                row = RowReader(raw, schema)
+                                dst = row.get("_dst")
+                                rank = row.get("_rank", 0)
+                                # mirror record under the neighbor
+                                doomed.append((dst, -et, rank, vid))
+            if doomed:
+                self.ectx.storage.delete_edges(space, doomed)
+            resp = self.ectx.storage.delete_vertex(space, vid)
+            if not resp.succeeded():
+                raise ExecError(f"delete vertex {vid} failed")
+        return None
+
+
+class DeleteEdgeExecutor(Executor):
+    NAME = "DeleteEdgeExecutor"
+
+    def execute(self) -> None:
+        self.check_space_chosen()
+        s: ast.DeleteEdgeSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        er = sm.to_edge_type(space, s.edge)
+        if not er.ok():
+            raise ExecError(f"unknown edge `{s.edge}'")
+        etype = er.value()
+        keys = []
+        for k in s.keys:
+            src = self.eval_const(k.src)
+            dst = self.eval_const(k.dst)
+            keys.append((src, etype, k.rank, dst))
+            keys.append((dst, -etype, k.rank, src))  # reverse edge too
+        resp = self.ectx.storage.delete_edges(space, keys)
+        if not resp.succeeded():
+            raise ExecError("delete edges failed")
+        return None

@@ -1,0 +1,881 @@
+"""Traverse executors — GO / FETCH / YIELD / ORDER BY / LIMIT / GROUP BY /
+set ops / pipes / variables / FIND [SHORTEST|ALL] PATH.
+
+Capability parity with /root/reference/src/graph/ (SURVEY.md §2.2):
+GoExecutor.cpp (step loop :334-399, dst back-tracking :407-431, second
+prop wave :531-569, final eval :669-782), FetchVerticesExecutor,
+FetchEdgesExecutor, YieldExecutor, OrderByExecutor, SetExecutor,
+PipeExecutor, AssignmentExecutor. FIND/MATCH are principled stubs in the
+reference (FindExecutor.cpp:19-21); here FIND SHORTEST/ALL PATH is fully
+implemented (BASELINE.md config 3) and MATCH remains a stub.
+
+When ``ectx.tpu_runtime`` serves the current space, GO and FIND PATH
+delegate the whole multi-hop loop to the device (tpu/runtime.py): frontier
+expansion, filtering and dedup happen in one jitted program over the CSR
+mirror instead of per-hop RPC fan-outs — same result sets.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...codec.rows import RowReader, RowSetReader
+from ...common.status import ErrorCode
+from ...filter.expressions import (AliasPropExpr, DestPropExpr,
+                                   EdgeDstIdExpr, EdgeRankExpr, EdgeSrcIdExpr,
+                                   EdgeTypeExpr, ExprContext, ExprError,
+                                   Expression, FunctionCallExpr,
+                                   InputPropExpr, PrimaryExpr,
+                                   SourcePropExpr, VariablePropExpr,
+                                   encode_expr)
+from ...interface.common import schema_from_wire
+from ..interim import InterimResult
+from ..parser import ast
+from .base import ExecError, Executor
+
+_AGG_FNS = {"count", "sum", "avg", "max", "min", "collect"}
+
+
+# ---------------------------------------------------------------- helpers
+def walk_expr(expr: Expression):
+    yield expr
+    for c in expr.children():
+        yield from walk_expr(c)
+
+
+def collect_prop_refs(exprs: List[Expression]):
+    """-> (src {(tag,prop)}, edge {(alias,prop)}, dst {(tag,prop)},
+          has_input, has_var)"""
+    src: Set[Tuple[str, str]] = set()
+    edge: Set[Tuple[str, str]] = set()
+    dst: Set[Tuple[str, str]] = set()
+    has_input = False
+    has_var = False
+    for e in exprs:
+        for node in walk_expr(e):
+            if isinstance(node, SourcePropExpr):
+                src.add((node.tag, node.prop))
+            elif isinstance(node, AliasPropExpr):
+                edge.add((node.alias, node.prop))
+            elif isinstance(node, DestPropExpr):
+                dst.add((node.tag, node.prop))
+            elif isinstance(node, InputPropExpr):
+                has_input = True
+            elif isinstance(node, VariablePropExpr):
+                has_var = True
+    return src, edge, dst, has_input, has_var
+
+
+def default_col_name(expr: Expression) -> str:
+    return str(expr)
+
+
+class _RowCtx(ExprContext):
+    """Mutable per-row binding used by GO final eval."""
+    __slots__ = ("src_vals", "edge_vals", "dst_vals", "input_row",
+                 "edge_meta")
+
+    def __init__(self):
+        super().__init__()
+        self.src_vals: Dict[Tuple[str, str], object] = {}
+        self.edge_vals: Dict[str, object] = {}
+        self.dst_vals: Dict[Tuple[str, str], object] = {}
+        self.input_row: Dict[str, object] = {}
+        self.edge_meta: Dict[str, object] = {}
+
+        def src_get(tag, prop):
+            try:
+                return self.src_vals[(tag, prop)]
+            except KeyError:
+                raise ExprError(f"$^.{tag}.{prop} unavailable")
+
+        def alias_get(alias, prop):
+            try:
+                return self.edge_vals[prop]
+            except KeyError:
+                raise ExprError(f"{alias}.{prop} unavailable")
+
+        def dst_get(tag, prop):
+            try:
+                return self.dst_vals[(tag, prop)]
+            except KeyError:
+                raise ExprError(f"$$.{tag}.{prop} unavailable")
+
+        def input_get(prop):
+            try:
+                return self.input_row[prop]
+            except KeyError:
+                raise ExprError(f"$-.{prop} unavailable")
+
+        self.get_src_tag_prop = src_get
+        self.get_alias_prop = alias_get
+        self.get_dst_tag_prop = dst_get
+        self.get_input_prop = input_get
+        self.get_variable_prop = lambda var, prop: input_get(prop)
+        self.get_edge_dst_id = lambda a: self.edge_meta.get("dst")
+        self.get_edge_src_id = lambda a: self.edge_meta.get("src")
+        self.get_edge_rank = lambda a: self.edge_meta.get("rank")
+        self.get_edge_type = lambda a: self.edge_meta.get("type_name")
+
+
+# ================================================================== GO
+class GoExecutor(Executor):
+    NAME = "GoExecutor"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.GoSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+
+        start_vids = self.resolve_vids(s.from_)
+        steps = s.step.steps
+
+        # ---- OVER resolution ----------------------------------------
+        over_aliases: Dict[str, int] = {}  # alias/name -> etype (signed)
+        if s.over.is_all:
+            for et in sm.all_edge_types(space):
+                name = sm.edge_name(space, et)
+                over_aliases[name] = -et if s.over.reversely else et
+        else:
+            for oe in s.over.edges:
+                r = sm.to_edge_type(space, oe.edge)
+                if not r.ok():
+                    raise ExecError(f"unknown edge `{oe.edge}'")
+                et = -r.value() if s.over.reversely else r.value()
+                over_aliases[oe.alias or oe.edge] = et
+        etypes = sorted(set(over_aliases.values()))
+        etype_to_alias = {et: a for a, et in over_aliases.items()}
+
+        # ---- YIELD defaults -----------------------------------------
+        if s.yield_ is not None:
+            yield_cols = s.yield_.columns
+            distinct = s.yield_.distinct
+        else:
+            yield_cols = [ast.YieldColumn(expr=EdgeDstIdExpr(a),
+                                          alias=f"{a}._dst")
+                          for a in over_aliases]
+            distinct = False
+
+        exprs = [c.expr for c in yield_cols]
+        where_expr = s.where.filter if s.where else None
+        all_exprs = exprs + ([where_expr] if where_expr is not None else [])
+        src_refs, edge_refs, dst_refs, has_input, has_var = \
+            collect_prop_refs(all_exprs)
+
+        # validate edge aliases
+        for alias, prop in edge_refs:
+            if alias not in over_aliases:
+                raise ExecError(f"unknown edge alias `{alias}'")
+
+        # ---- prop requests ------------------------------------------
+        vertex_props: List[List] = []
+        for tag, prop in sorted(src_refs):
+            tr = sm.to_tag_id(space, tag)
+            if not tr.ok():
+                raise ExecError(f"unknown tag `{tag}'")
+            vertex_props.append([tr.value(), prop])
+
+        edge_props: Dict[int, List[str]] = {}
+        for alias, prop in sorted(edge_refs):
+            edge_props.setdefault(over_aliases[alias], []).append(prop)
+
+        # ---- filter pushdown decision -------------------------------
+        pushed: Optional[bytes] = None
+        remnant: Optional[Expression] = None
+        if where_expr is not None:
+            w_src, w_edge, w_dst, w_inp, w_var = collect_prop_refs([where_expr])
+            if not w_dst and not w_inp and not w_var:
+                pushed = encode_expr(where_expr)
+            else:
+                remnant = where_expr
+
+        # ---- TPU fast path ------------------------------------------
+        rt = self.ectx.tpu_runtime
+        if rt is not None and rt.can_run_go(space, etypes, s, pushed,
+                                            remnant, src_refs, dst_refs,
+                                            has_input or has_var):
+            return rt.run_go(self, space, start_vids, etypes, steps,
+                             etype_to_alias, yield_cols, distinct,
+                             where_expr, edge_props, vertex_props)
+
+        # ---- input mapping (pipe/$var semantics) --------------------
+        input_map: Dict[int, Dict[str, object]] = {}
+        if has_input or has_var:
+            src_interim = self.ectx.input
+            if has_var:
+                # FROM $var: the variable's interim is the input
+                from ...filter.expressions import VariablePropExpr as _V
+                if s.from_.ref is not None and isinstance(s.from_.ref, _V):
+                    src_interim = self.ectx.variables.get(s.from_.ref.var)
+            if src_interim is not None:
+                key_col = None
+                if s.from_.ref is not None and hasattr(s.from_.ref, "prop"):
+                    key_col = s.from_.ref.prop
+                    if key_col == "id" and src_interim.col_index("id") < 0:
+                        key_col = src_interim.columns[0]
+                else:
+                    key_col = src_interim.columns[0]
+                ki = src_interim.col_index(key_col)
+                for row in src_interim.rows:
+                    vid = row[ki]
+                    if isinstance(vid, int) and vid not in input_map:
+                        input_map[vid] = dict(zip(src_interim.columns, row))
+
+        # ---- step loop (stepOut / onStepOutResponse) ----------------
+        cur = start_vids
+        backtracker: Dict[int, int] = {v: v for v in cur}
+        final_resp = None
+        for step in range(steps):
+            if not cur:
+                break
+            is_final = step == steps - 1
+            resp = self.ectx.storage.get_neighbors(
+                space, cur, etypes,
+                filter_bytes=pushed if is_final else None,
+                vertex_props=vertex_props if is_final else [],
+                edge_props=edge_props if is_final else {})
+            if not resp.succeeded() and resp.completeness() == 0:
+                first = next(iter(resp.failed_parts.values()))
+                raise ExecError(f"storage error: {first.to_string()}")
+            if is_final:
+                final_resp = resp
+            else:
+                nxt: List[int] = []
+                seen: Set[int] = set()
+                new_bt: Dict[int, int] = {}
+                for r in resp.responses:
+                    for v in r["vertices"]:
+                        root = backtracker.get(v["id"], v["id"])
+                        for et_s, blob in v["edges"].items():
+                            schema = schema_from_wire(
+                                r["edge_schemas"][int(et_s)])
+                            for raw in RowSetReader(blob):
+                                dst = RowReader(raw, schema).get("_dst")
+                                if dst not in seen:
+                                    seen.add(dst)
+                                    nxt.append(dst)
+                                if dst not in new_bt:
+                                    new_bt[dst] = root
+                cur = nxt
+                backtracker = new_bt
+
+        columns = [c.alias or default_col_name(c.expr) for c in yield_cols]
+        if final_resp is None:
+            return InterimResult(columns)
+
+        # ---- second wave: dst props ---------------------------------
+        dst_prop_map: Dict[int, Dict[Tuple[str, str], object]] = {}
+        if dst_refs:
+            dst_ids: Set[int] = set()
+            for r in final_resp.responses:
+                for v in r["vertices"]:
+                    for et_s, blob in v["edges"].items():
+                        schema = schema_from_wire(r["edge_schemas"][int(et_s)])
+                        for raw in RowSetReader(blob):
+                            dst_ids.add(RowReader(raw, schema).get("_dst"))
+            dst_vp: List[List] = []
+            for tag, prop in sorted(dst_refs):
+                tr = sm.to_tag_id(space, tag)
+                if not tr.ok():
+                    raise ExecError(f"unknown tag `{tag}'")
+                dst_vp.append([tr.value(), prop])
+            presp = self.ectx.storage.get_props(space, sorted(dst_ids), dst_vp)
+            names = [t for t, _ in sorted(dst_refs)]
+            props = [p for _, p in sorted(dst_refs)]
+            for r in presp.responses:
+                if not r.get("vertex_schema"):
+                    continue
+                schema = schema_from_wire(r["vertex_schema"])
+                for v in r["vertices"]:
+                    reader = RowReader(v["vdata"], schema)
+                    vals = {}
+                    for (tag, prop) in sorted(dst_refs):
+                        try:
+                            vals[(tag, prop)] = reader.get(prop)
+                        except KeyError:
+                            pass
+                    dst_prop_map[v["id"]] = vals
+
+        # ---- final eval (processFinalResult) ------------------------
+        ctx = _RowCtx()
+        rows: List[List[object]] = []
+        seen_rows: Set[Tuple] = set()
+        for r in final_resp.responses:
+            vschema = (schema_from_wire(r["vertex_schema"])
+                       if r.get("vertex_schema") else None)
+            for v in r["vertices"]:
+                src_vid = v["id"]
+                ctx.src_vals = {}
+                if vschema is not None and v["vdata"]:
+                    reader = RowReader(v["vdata"], vschema)
+                    for (tag, prop) in sorted(src_refs):
+                        try:
+                            ctx.src_vals[(tag, prop)] = reader.get(prop)
+                        except KeyError:
+                            pass
+                root = backtracker.get(src_vid, src_vid)
+                ctx.input_row = input_map.get(root, {})
+                for et_s, blob in v["edges"].items():
+                    et = int(et_s)
+                    schema = schema_from_wire(r["edge_schemas"][et])
+                    alias = etype_to_alias.get(et, str(et))
+                    for raw in RowSetReader(blob):
+                        reader = RowReader(raw, schema)
+                        ctx.edge_vals = reader.to_dict()
+                        dst = ctx.edge_vals.get("_dst")
+                        ctx.edge_meta = {"dst": dst, "src": src_vid,
+                                         "rank": ctx.edge_vals.get("_rank", 0),
+                                         "type_name": alias}
+                        ctx.dst_vals = dst_prop_map.get(dst, {})
+                        try:
+                            if remnant is not None and not remnant.eval(ctx):
+                                continue
+                            row = [c.expr.eval(ctx) for c in yield_cols]
+                        except ExprError as e:
+                            raise ExecError(str(e))
+                        if distinct:
+                            key = tuple(row)
+                            if key in seen_rows:
+                                continue
+                            seen_rows.add(key)
+                        rows.append(row)
+        return InterimResult(columns, rows)
+
+
+# ================================================================== FETCH
+class FetchVerticesExecutor(Executor):
+    NAME = "FetchVerticesExecutor"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.FetchVerticesSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        vids = self.resolve_vids(s.from_)
+
+        vertex_props: List[List] = []
+        if s.tag != "*":
+            tr = sm.to_tag_id(space, s.tag)
+            if not tr.ok():
+                raise ExecError(f"unknown tag `{s.tag}'")
+            tag_id = tr.value()
+            schema = sm.get_tag_schema(space, tag_id)
+            if s.yield_ is not None:
+                # request only referenced props
+                refs, _, _, _, _ = collect_prop_refs(
+                    [c.expr for c in s.yield_.columns])
+                props = sorted({p for t, p in refs if t == s.tag})
+                vertex_props = [[tag_id, p] for p in props]
+            else:
+                vertex_props = [[tag_id, p] for p in schema.names()]
+
+        resp = self.ectx.storage.get_props(space, vids, vertex_props)
+        if not resp.succeeded() and resp.completeness() == 0:
+            first = next(iter(resp.failed_parts.values()))
+            raise ExecError(f"storage error: {first.to_string()}")
+
+        if s.yield_ is not None:
+            yield_cols = s.yield_.columns
+        else:
+            if s.tag == "*":
+                # columns discovered from response schema
+                yield_cols = None
+            else:
+                schema = sm.get_tag_schema(space, sm.to_tag_id(space, s.tag).value())
+                yield_cols = [
+                    ast.YieldColumn(expr=AliasPropExpr(s.tag, p),
+                                    alias=f"{s.tag}.{p}")
+                    for p in schema.names()]
+
+        rows: List[List[object]] = []
+        if yield_cols is None:
+            columns = ["VertexID"]
+            col_set: List[str] = []
+            decoded = []
+            for r in resp.responses:
+                if not r.get("vertex_schema"):
+                    continue
+                schema = schema_from_wire(r["vertex_schema"])
+                for v in r["vertices"]:
+                    d = RowReader(v["vdata"], schema).to_dict()
+                    decoded.append((v["id"], d))
+                    for k in d:
+                        if k not in col_set:
+                            col_set.append(k)
+            columns += col_set
+            for vid, d in decoded:
+                rows.append([vid] + [d.get(c) for c in col_set])
+            return InterimResult(columns, rows)
+
+        columns = ["VertexID"] + [c.alias or default_col_name(c.expr)
+                                  for c in yield_cols]
+        ctx = _RowCtx()
+        for r in resp.responses:
+            if not r.get("vertex_schema"):
+                continue
+            schema = schema_from_wire(r["vertex_schema"])
+            for v in r["vertices"]:
+                reader = RowReader(v["vdata"], schema)
+                vals = reader.to_dict()
+                # expose as alias (tag.prop), $^ and plain
+                ctx.edge_vals = vals
+                ctx.src_vals = {(s.tag, k): val for k, val in vals.items()}
+                ctx.input_row = vals
+                try:
+                    row = [v["id"]] + [c.expr.eval(ctx) for c in yield_cols]
+                except ExprError as e:
+                    raise ExecError(str(e))
+                rows.append(row)
+        return InterimResult(columns, rows)
+
+
+class FetchEdgesExecutor(Executor):
+    NAME = "FetchEdgesExecutor"
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.FetchEdgesSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        er = sm.to_edge_type(space, s.edge)
+        if not er.ok():
+            raise ExecError(f"unknown edge `{s.edge}'")
+        etype = er.value()
+        schema = sm.get_edge_schema(space, etype)
+
+        keys: List[Tuple[int, int, int, int]] = []
+        if s.ref is not None:
+            src_ref, dst_ref = s.ref
+            src_col = getattr(src_ref, "prop", None)
+            dst_col = getattr(dst_ref, "prop", None)
+            inp = self.ectx.input
+            if isinstance(src_ref, VariablePropExpr):
+                inp = self.ectx.variables.get(src_ref.var)
+            if inp is not None:
+                si, di = inp.col_index(src_col), inp.col_index(dst_col)
+                if si < 0 or di < 0:
+                    raise ExecError(f"no such input columns "
+                                    f"`{src_col}'/`{dst_col}'")
+                for row in inp.rows:
+                    keys.append((row[si], etype, 0, row[di]))
+        else:
+            for k in s.keys:
+                keys.append((self.eval_const(k.src), etype, k.rank,
+                             self.eval_const(k.dst)))
+
+        props = None
+        if s.yield_ is not None:
+            _, edge_refs, _, _, _ = collect_prop_refs(
+                [c.expr for c in s.yield_.columns])
+            props = sorted({p for _a, p in edge_refs})
+        resp = self.ectx.storage.get_edge_props(space, keys, props)
+        if not resp.succeeded() and resp.completeness() == 0:
+            first = next(iter(resp.failed_parts.values()))
+            raise ExecError(f"storage error: {first.to_string()}")
+
+        if s.yield_ is not None:
+            yield_cols = s.yield_.columns
+        else:
+            yield_cols = [ast.YieldColumn(expr=AliasPropExpr(s.edge, p),
+                                          alias=f"{s.edge}.{p}")
+                          for p in schema.names()]
+        columns = ([f"{s.edge}._src", f"{s.edge}._dst", f"{s.edge}._rank"] +
+                   [c.alias or default_col_name(c.expr) for c in yield_cols])
+        ctx = _RowCtx()
+        rows = []
+        for r in resp.responses:
+            for et_s, blob in r.get("edges", {}).items():
+                rschema = schema_from_wire(r["edge_schemas"][int(et_s)])
+                for raw in RowSetReader(blob):
+                    vals = RowReader(raw, rschema).to_dict()
+                    ctx.edge_vals = vals
+                    src = vals.get("_src")
+                    ctx.edge_meta = {"dst": vals.get("_dst"), "src": src,
+                                     "rank": vals.get("_rank", 0),
+                                     "type_name": s.edge}
+                    try:
+                        row = ([src, vals.get("_dst"), vals.get("_rank", 0)] +
+                               [c.expr.eval(ctx) for c in yield_cols])
+                    except ExprError as e:
+                        raise ExecError(str(e))
+                    rows.append(row)
+        return InterimResult(columns, rows)
+
+
+# ================================================================== YIELD
+class YieldExecutor(Executor):
+    NAME = "YieldExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.YieldSentence = self.sentence
+        yield_cols = s.yield_.columns
+        columns = [c.alias or default_col_name(c.expr) for c in yield_cols]
+        exprs = [c.expr for c in yield_cols]
+        _, _, _, has_input, has_var = collect_prop_refs(
+            exprs + ([s.where.filter] if s.where else []))
+
+        ctx = _RowCtx()
+        rows: List[List[object]] = []
+        inp = self.ectx.input
+        has_agg = any(isinstance(e, FunctionCallExpr) and
+                      e.name.lower() in _AGG_FNS for e in exprs)
+        if has_agg and inp is not None:
+            return _aggregate_rows(self, inp, yield_cols, s.where)
+        if (has_input or has_var) and inp is not None:
+            for i in range(len(inp)):
+                ctx.input_row = inp.row_dict(i)
+                try:
+                    if s.where is not None and not s.where.filter.eval(ctx):
+                        continue
+                    rows.append([e.eval(ctx) for e in exprs])
+                except ExprError as e:
+                    raise ExecError(str(e))
+        else:
+            try:
+                if s.where is None or s.where.filter.eval(ctx):
+                    rows.append([self.eval_const(e) for e in exprs])
+            except ExprError as e:
+                raise ExecError(str(e))
+        result = InterimResult(columns, rows)
+        if s.yield_.distinct:
+            return _distinct(result)
+        return result
+
+
+def _distinct(r: InterimResult) -> InterimResult:
+    seen = set()
+    rows = []
+    for row in r.rows:
+        k = tuple(row)
+        if k not in seen:
+            seen.add(k)
+            rows.append(row)
+    return InterimResult(r.columns, rows)
+
+
+def _aggregate_rows(ex: Executor, inp: InterimResult,
+                    yield_cols: List[ast.YieldColumn],
+                    where: Optional[ast.WhereClause],
+                    group_exprs: Optional[List[Expression]] = None) -> InterimResult:
+    """Shared GROUP BY / aggregate-YIELD engine."""
+    ctx = _RowCtx()
+    groups: Dict[Tuple, List[int]] = {}
+    order: List[Tuple] = []
+    for i in range(len(inp)):
+        ctx.input_row = inp.row_dict(i)
+        try:
+            if where is not None and not where.filter.eval(ctx):
+                continue
+            if group_exprs:
+                key = tuple(g.eval(ctx) for g in group_exprs)
+            else:
+                key = ()
+        except ExprError as e:
+            raise ExecError(str(e))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    columns = [c.alias or default_col_name(c.expr) for c in yield_cols]
+    rows = []
+    for key in order:
+        idxs = groups[key]
+        row = []
+        for c in yield_cols:
+            e = c.expr
+            if isinstance(e, FunctionCallExpr) and e.name.lower() in _AGG_FNS:
+                fname = e.name.lower()
+                vals = []
+                for i in idxs:
+                    ctx.input_row = inp.row_dict(i)
+                    if not e.args:
+                        vals.append(1)
+                    else:
+                        try:
+                            vals.append(e.args[0].eval(ctx))
+                        except ExprError as ee:
+                            raise ExecError(str(ee))
+                if fname == "count":
+                    row.append(len(vals))
+                elif fname == "sum":
+                    row.append(sum(vals) if vals else 0)
+                elif fname == "avg":
+                    row.append(sum(vals) / len(vals) if vals else 0.0)
+                elif fname == "max":
+                    row.append(max(vals) if vals else None)
+                elif fname == "min":
+                    row.append(min(vals) if vals else None)
+                elif fname == "collect":
+                    row.append(vals)
+            else:
+                ctx.input_row = inp.row_dict(idxs[0])
+                try:
+                    row.append(e.eval(ctx))
+                except ExprError as ee:
+                    raise ExecError(str(ee))
+        rows.append(row)
+    return InterimResult(columns, rows)
+
+
+class GroupByExecutor(Executor):
+    NAME = "GroupByExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.GroupBySentence = self.sentence
+        inp = self.ectx.input
+        if inp is None:
+            raise ExecError("GROUP BY must follow a pipe")
+        if s.yield_ is None:
+            raise ExecError("GROUP BY requires YIELD")
+        return _aggregate_rows(self, inp, s.yield_.columns, None,
+                               [c.expr for c in s.group_cols])
+
+
+# ================================================================== ORDER/LIMIT
+class OrderByExecutor(Executor):
+    NAME = "OrderByExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.OrderBySentence = self.sentence
+        inp = self.ectx.input
+        if inp is None:
+            raise ExecError("ORDER BY must follow a pipe")
+        ctx = _RowCtx()
+
+        def sort_key_for(i: int):
+            ctx.input_row = inp.row_dict(i)
+            key = []
+            for f in s.factors:
+                try:
+                    v = f.expr.eval(ctx)
+                except ExprError as e:
+                    raise ExecError(str(e))
+                key.append(v)
+            return key
+
+        idxs = list(range(len(inp)))
+        # stable multi-factor sort honoring per-factor direction
+        for fi in range(len(s.factors) - 1, -1, -1):
+            f = s.factors[fi]
+
+            def one_key(i, fi=fi):
+                ctx.input_row = inp.row_dict(i)
+                try:
+                    v = s.factors[fi].expr.eval(ctx)
+                except ExprError as e:
+                    raise ExecError(str(e))
+                # mixed types: sort by (type rank, value)
+                tr = 0 if isinstance(v, bool) else \
+                    1 if isinstance(v, (int, float)) else 2
+                return (tr, v)
+
+            idxs.sort(key=one_key, reverse=not f.ascending)
+        return InterimResult(inp.columns, [inp.rows[i] for i in idxs])
+
+
+class LimitExecutor(Executor):
+    NAME = "LimitExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.LimitSentence = self.sentence
+        inp = self.ectx.input
+        if inp is None:
+            raise ExecError("LIMIT must follow a pipe")
+        lo = s.offset
+        hi = len(inp.rows) if s.count < 0 else lo + s.count
+        return InterimResult(inp.columns, inp.rows[lo:hi])
+
+
+# ================================================================== SET/PIPE
+class SetExecutor(Executor):
+    NAME = "SetExecutor"
+
+    def execute(self) -> InterimResult:
+        from . import make_executor
+        s: ast.SetSentence = self.sentence
+        left = make_executor(s.left, self.ectx).execute()
+        right = make_executor(s.right, self.ectx).execute()
+        left = left or InterimResult([])
+        right = right or InterimResult([])
+        if left.columns and right.columns and \
+                len(left.columns) != len(right.columns):
+            raise ExecError("set operand column counts differ: "
+                            f"{left.columns} vs {right.columns}")
+        columns = left.columns or right.columns
+        if s.op == ast.SetOpKind.UNION:
+            rows = left.rows + right.rows
+            result = InterimResult(columns, rows)
+            return _distinct(result) if s.distinct else result
+        lset = {tuple(r) for r in left.rows}
+        rset = {tuple(r) for r in right.rows}
+        if s.op == ast.SetOpKind.INTERSECT:
+            keep = lset & rset
+            return InterimResult(columns,
+                                 [r for r in left.rows if tuple(r) in keep])
+        keep = lset - rset
+        return InterimResult(columns,
+                             [r for r in left.rows if tuple(r) in keep])
+
+
+class PipeExecutor(Executor):
+    NAME = "PipeExecutor"
+
+    def execute(self) -> Optional[InterimResult]:
+        from . import make_executor
+        s: ast.PipedSentence = self.sentence
+        left = make_executor(s.left, self.ectx).execute()
+        saved = self.ectx.input
+        self.ectx.input = left if left is not None else InterimResult([])
+        try:
+            return make_executor(s.right, self.ectx).execute()
+        finally:
+            self.ectx.input = saved
+
+
+class AssignmentExecutor(Executor):
+    NAME = "AssignmentExecutor"
+
+    def execute(self) -> None:
+        from . import make_executor
+        s: ast.AssignmentSentence = self.sentence
+        result = make_executor(s.sentence, self.ectx).execute()
+        self.ectx.variables.add(s.var, result or InterimResult([]))
+        return None
+
+
+# ================================================================== PATH
+class FindPathExecutor(Executor):
+    """FIND SHORTEST|ALL PATH — layered BFS with parent tracking over the
+    getNeighbors seam (CPU path; the TPU runtime runs the same search as a
+    jitted bidirectional BFS over the CSR mirror)."""
+
+    NAME = "FindPathExecutor"
+    MAX_PATHS = 1000
+
+    def execute(self) -> InterimResult:
+        self.check_space_chosen()
+        s: ast.FindPathSentence = self.sentence
+        space = self.ectx.space_id()
+        sm = self.ectx.schema_man
+        srcs = self.resolve_vids(s.from_)
+        dsts = self.resolve_vids(s.to)
+        if s.over.is_all:
+            etypes = sm.all_edge_types(space)
+        else:
+            etypes = []
+            for oe in s.over.edges:
+                r = sm.to_edge_type(space, oe.edge)
+                if not r.ok():
+                    raise ExecError(f"unknown edge `{oe.edge}'")
+                etypes.append(r.value())
+        max_steps = s.upto.steps if s.upto else 5
+        etype_names = {et: sm.edge_name(space, et) or str(et)
+                       for et in etypes}
+
+        rt = self.ectx.tpu_runtime
+        if rt is not None and rt.can_run_path(space, etypes):
+            return rt.run_find_path(self, space, srcs, dsts, etypes,
+                                    max_steps, s.shortest, etype_names)
+
+        # BFS recording predecessor edges. SHORTEST keeps only edges that
+        # advance depth (depth-layered DAG); ALL keeps every discovered
+        # edge and reconstructs with cycle-avoiding DFS.
+        src_set = set(srcs)
+        parents: Dict[int, List[Tuple[int, int, int]]] = {}
+        depth_of: Dict[int, int] = {v: 0 for v in srcs}
+        frontier = list(srcs)
+        target_set = set(dsts)
+        unfound = set(dsts) - src_set
+        for depth in range(1, max_steps + 1):
+            if not frontier:
+                break
+            if not unfound and s.shortest:
+                break  # every target reached at its shortest depth
+            resp = self.ectx.storage.get_neighbors(space, frontier, etypes)
+            if not resp.succeeded() and resp.completeness() == 0:
+                first = next(iter(resp.failed_parts.values()))
+                raise ExecError(f"storage error: {first.to_string()}")
+            nxt: List[int] = []
+            for r in resp.responses:
+                for v in r["vertices"]:
+                    src = v["id"]
+                    for et_s, blob in v["edges"].items():
+                        et = int(et_s)
+                        schema = schema_from_wire(r["edge_schemas"][et])
+                        for raw in RowSetReader(blob):
+                            row = RowReader(raw, schema)
+                            dst = row.get("_dst")
+                            rank = row.get("_rank", 0)
+                            if dst not in depth_of:
+                                depth_of[dst] = depth
+                                nxt.append(dst)
+                            if s.shortest:
+                                if depth_of[dst] == depth:
+                                    parents.setdefault(dst, []).append(
+                                        (src, et, rank))
+                            else:
+                                parents.setdefault(dst, []).append(
+                                    (src, et, rank))
+                            if dst in target_set:
+                                unfound.discard(dst)
+            frontier = nxt
+
+        paths: List[str] = []
+
+        def fmt(chain: List, start: int) -> str:
+            parts = [str(start)]
+            for (etype, rank, node) in chain:
+                parts.append(f"<{etype_names.get(etype, etype)},{rank}>")
+                parts.append(str(node))
+            return " ".join(parts)
+
+        def build_shortest(v: int, acc: List, depth: int):
+            if len(paths) >= self.MAX_PATHS:
+                return
+            if depth == 0:
+                if v in src_set:
+                    paths.append(fmt(acc, v))
+                return
+            for (prev, et, rank) in parents.get(v, []):
+                if depth_of.get(prev, -1) == depth - 1:
+                    build_shortest(prev, [(et, rank, v)] + acc, depth - 1)
+
+        def build_all(v: int, acc: List, visited: Set[int]):
+            if len(paths) >= self.MAX_PATHS or len(acc) > max_steps:
+                return
+            if v in src_set and acc:
+                paths.append(fmt(acc, v))
+                # keep exploring: longer paths through v may also exist
+            for (prev, et, rank) in parents.get(v, []):
+                if prev not in visited:
+                    build_all(prev, [(et, rank, v)] + acc, visited | {prev})
+
+        for d in dsts:
+            if s.shortest:
+                if d in depth_of and depth_of[d] > 0:
+                    build_shortest(d, [], depth_of[d])
+            else:
+                build_all(d, [], {d})
+        return InterimResult(["path"], [[p] for p in sorted(paths)])
+
+
+class FindExecutor(Executor):
+    """Reference parity: FIND is parsed but unsupported
+    (FindExecutor.cpp:19-21)."""
+
+    NAME = "FindExecutor"
+
+    def execute(self):
+        raise ExecError("FIND is not supported yet; use FIND SHORTEST PATH",
+                        ErrorCode.E_UNSUPPORTED)
+
+
+class MatchExecutor(Executor):
+    """Reference parity: MATCH is parsed but unsupported
+    (MatchExecutor.cpp:19-21)."""
+
+    NAME = "MatchExecutor"
+
+    def execute(self):
+        raise ExecError("MATCH is not supported yet", ErrorCode.E_UNSUPPORTED)

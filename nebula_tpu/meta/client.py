@@ -58,6 +58,9 @@ class MetaClient:
         self.last_update_time = -1
 
         self._cache_lock = threading.RLock()
+        # serializes whole load_data passes (refresh + heartbeat threads)
+        # so a stale snapshot can never overwrite a newer one
+        self._load_lock = threading.Lock()
         self.spaces: Dict[int, SpaceInfoCache] = {}
         self.space_name_to_id: Dict[str, int] = {}
 
@@ -71,11 +74,15 @@ class MetaClient:
             try:
                 return self.cm.call(addr, method, payload)
             except RpcError as e:
-                if e.status.code in (ErrorCode.E_RPC_FAILURE,
+                # Fail over to another metad only when the request provably
+                # never executed (connect failure) or this peer isn't the
+                # leader. E_RPC_FAILURE means "may have executed" — a
+                # resend could duplicate non-idempotent DDL, so propagate.
+                if e.status.code in (ErrorCode.E_FAIL_TO_CONNECT,
                                      ErrorCode.E_LEADER_CHANGED,
                                      ErrorCode.E_NOT_A_LEADER):
                     last_exc = e
-                    continue  # chase another metad
+                    continue
                 raise
         raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))
 
@@ -147,40 +154,56 @@ class MetaClient:
 
     # ---------------- cache load + diff ----------------
     def load_data(self) -> None:
-        resp = self._call("listSpaces", {})
-        new_spaces: Dict[int, SpaceInfoCache] = {}
-        new_name_to_id: Dict[str, int] = {}
-        for sp in resp["spaces"]:
-            sid = sp["id"]
-            cache = SpaceInfoCache()
-            props = self._call("getSpace", {"space_name": sp["name"]})
-            cache.space_name = sp["name"]
-            cache.partition_num = props["partition_num"]
-            cache.replica_factor = props.get("replica_factor", 1)
-            alloc = self._call("getPartsAlloc", {"space_id": sid})
-            cache.parts_alloc = {int(p): list(hosts)
-                                 for p, hosts in alloc["parts"].items()}
-            for rec in self._call("listTagSchemas", {"space_id": sid})["schemas"]:
-                schema = schema_from_wire(rec["schema"])
-                cache.tag_schemas[(rec["id"], rec["version"])] = schema
-                cache.tag_name_to_id[rec["name"]] = rec["id"]
-                cache.tag_id_to_name[rec["id"]] = rec["name"]
-                cur = cache.newest_tag_ver.get(rec["id"], -1)
-                cache.newest_tag_ver[rec["id"]] = max(cur, rec["version"])
-            for rec in self._call("listEdgeSchemas", {"space_id": sid})["schemas"]:
-                schema = schema_from_wire(rec["schema"])
-                cache.edge_schemas[(rec["id"], rec["version"])] = schema
-                cache.edge_name_to_type[rec["name"]] = rec["id"]
-                cache.edge_type_to_name[rec["id"]] = rec["name"]
-                cur = cache.newest_edge_ver.get(rec["id"], -1)
-                cache.newest_edge_ver[rec["id"]] = max(cur, rec["version"])
-            new_spaces[sid] = cache
-            new_name_to_id[sp["name"]] = sid
-        with self._cache_lock:
-            old_spaces = self.spaces
-            self.spaces = new_spaces
-            self.space_name_to_id = new_name_to_id
-        self._diff(old_spaces, new_spaces)
+        with self._load_lock:
+            resp = self._call("listSpaces", {})
+            new_spaces: Dict[int, SpaceInfoCache] = {}
+            new_name_to_id: Dict[str, int] = {}
+            for sp in resp["spaces"]:
+                sid = sp["id"]
+                try:
+                    cache = self._load_space(sid, sp["name"])
+                except RpcError as e:
+                    if e.status.code == ErrorCode.E_NOT_FOUND:
+                        continue  # space dropped mid-refresh — skip it
+                    raise
+                new_spaces[sid] = cache
+                new_name_to_id[sp["name"]] = sid
+            with self._cache_lock:
+                old_spaces = self.spaces
+                self.spaces = new_spaces
+                self.space_name_to_id = new_name_to_id
+            self._diff(old_spaces, new_spaces)
+
+    def _load_space(self, sid: int, name: str) -> SpaceInfoCache:
+        cache = SpaceInfoCache()
+        props = self._call("getSpace", {"space_name": name})
+        cache.space_name = name
+        cache.partition_num = props["partition_num"]
+        cache.replica_factor = props.get("replica_factor", 1)
+        alloc = self._call("getPartsAlloc", {"space_id": sid})
+        cache.parts_alloc = {int(p): list(hosts)
+                             for p, hosts in alloc["parts"].items()}
+        for rec in self._call("listTagSchemas", {"space_id": sid})["schemas"]:
+            schema = schema_from_wire(rec["schema"])
+            cache.tag_schemas[(rec["id"], rec["version"])] = schema
+            cache.tag_name_to_id[rec["name"]] = rec["id"]
+            cache.tag_id_to_name[rec["id"]] = rec["name"]
+            cur = cache.newest_tag_ver.get(rec["id"], -1)
+            cache.newest_tag_ver[rec["id"]] = max(cur, rec["version"])
+        for rec in self._call("listEdgeSchemas", {"space_id": sid})["schemas"]:
+            schema = schema_from_wire(rec["schema"])
+            cache.edge_schemas[(rec["id"], rec["version"])] = schema
+            cache.edge_name_to_type[rec["name"]] = rec["id"]
+            cache.edge_type_to_name[rec["id"]] = rec["name"]
+            cur = cache.newest_edge_ver.get(rec["id"], -1)
+            cache.newest_edge_ver[rec["id"]] = max(cur, rec["version"])
+        return cache
+
+    def _refresh_quietly(self) -> None:
+        try:
+            self.load_data()
+        except RpcError:
+            pass  # DDL succeeded; cache catches up on the next refresh
 
     def _diff(self, old: Dict[int, SpaceInfoCache],
               new: Dict[int, SpaceInfoCache]) -> None:
@@ -276,14 +299,14 @@ class MetaClient:
                                               "partition_num": partition_num,
                                               "replica_factor": replica_factor})
         if r.ok():
-            self.load_data()
+            self._refresh_quietly()
             return StatusOr.of(r.value()["id"])
         return StatusOr.error(r.status)
 
     def drop_space(self, name: str) -> Status:
         r = self._call_status("dropSpace", {"space_name": name})
         if r.ok():
-            self.load_data()
+            self._refresh_quietly()
         return r.status
 
     def create_tag_schema(self, space_id: int, name: str, schema_wire: dict) -> StatusOr[int]:
@@ -291,7 +314,7 @@ class MetaClient:
                                                   "name": name,
                                                   "schema": schema_wire})
         if r.ok():
-            self.load_data()
+            self._refresh_quietly()
             return StatusOr.of(r.value()["id"])
         return StatusOr.error(r.status)
 
@@ -300,7 +323,7 @@ class MetaClient:
                                                    "name": name,
                                                    "schema": schema_wire})
         if r.ok():
-            self.load_data()
+            self._refresh_quietly()
             return StatusOr.of(r.value()["id"])
         return StatusOr.error(r.status)
 

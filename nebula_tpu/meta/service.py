@@ -52,7 +52,10 @@ class ActiveHostsMan:
             out[k[len(mk.HOST_PREFIX):].decode()] = _unpk(v)
         return out
 
-    def active_hosts(self, expired_ttl_secs: float = 600.0) -> List[str]:
+    def active_hosts(self, expired_ttl_secs: Optional[float] = None) -> List[str]:
+        if expired_ttl_secs is None:
+            from ..common.flags import flags
+            expired_ttl_secs = float(flags.get("expired_threshold_sec", 600))
         cutoff = time.time() * 1000 - expired_ttl_secs * 1000
         return sorted(h for h, rec in self.hosts().items()
                       if rec.get("last_hb_ms", 0) >= cutoff)
@@ -160,6 +163,10 @@ class MetaService:
         self.kv.remove_prefix(META_SPACE, META_PART, mk.part_prefix(space_id))
         self.kv.remove_prefix(META_SPACE, META_PART, mk.tag_prefix(space_id))
         self.kv.remove_prefix(META_SPACE, META_PART, mk.edge_prefix(space_id))
+        self.kv.remove_prefix(META_SPACE, META_PART,
+                              mk.tag_index_key(space_id, ""))
+        self.kv.remove_prefix(META_SPACE, META_PART,
+                              mk.edge_index_key(space_id, ""))
         self._bump_last_update()
         return {}
 
@@ -225,7 +232,7 @@ class MetaService:
         return _unpk(raw) if raw is not None else 0
 
     # ================= schemaMan: tags =================
-    def _create_schema(self, req: dict, prefix_fn, index_key_fn, key_fn) -> dict:
+    def _create_schema(self, req: dict, index_key_fn, key_fn) -> dict:
         space_id = int(req["space_id"])
         name = req["name"]
         if self._space_props(space_id) is None:
@@ -316,7 +323,7 @@ class MetaService:
         return out
 
     def rpc_createTagSchema(self, req: dict) -> dict:
-        return self._create_schema(req, mk.tag_prefix, mk.tag_index_key, mk.tag_key)
+        return self._create_schema(req, mk.tag_index_key, mk.tag_key)
 
     def rpc_alterTagSchema(self, req: dict) -> dict:
         return self._alter_schema(req, mk.tag_index_key, mk.tag_key, mk.tag_prefix)
@@ -330,7 +337,7 @@ class MetaService:
                                               mk.tag_version_from_key)}
 
     def rpc_createEdgeSchema(self, req: dict) -> dict:
-        return self._create_schema(req, mk.edge_prefix, mk.edge_index_key, mk.edge_key)
+        return self._create_schema(req, mk.edge_index_key, mk.edge_key)
 
     def rpc_alterEdgeSchema(self, req: dict) -> dict:
         return self._alter_schema(req, mk.edge_index_key, mk.edge_key, mk.edge_prefix)
@@ -347,7 +354,7 @@ class MetaService:
     def rpc_multiPut(self, req: dict) -> dict:
         seg = req["segment"]
         self.kv.multi_put(META_SPACE, META_PART,
-                          [(mk.kv_key(seg, k), v if isinstance(v, bytes) else _pk(v))
+                          [(mk.kv_key(seg, k), _pk(v))
                            for k, v in req["pairs"]])
         return {}
 
@@ -356,14 +363,14 @@ class MetaService:
                              mk.kv_key(req["segment"], req["key"]))
         if raw is None:
             raise _err(ErrorCode.E_NOT_FOUND, req["key"])
-        return {"value": raw}
+        return {"value": _unpk(raw)}
 
     def rpc_multiGet(self, req: dict) -> dict:
         seg = req["segment"]
         values = []
         for k in req["keys"]:
             raw, _ = self.kv.get(META_SPACE, META_PART, mk.kv_key(seg, k))
-            values.append(raw)
+            values.append(_unpk(raw) if raw is not None else None)
         return {"values": values}
 
     def rpc_scan(self, req: dict) -> dict:
@@ -373,7 +380,7 @@ class MetaService:
         hi = prefix + req["end"].encode()
         out = []
         for k, v in self.kv.range(META_SPACE, META_PART, lo, hi):
-            out.append([k[len(prefix):].decode(), v])
+            out.append([k[len(prefix):].decode(), _unpk(v)])
         return {"values": out}
 
     def rpc_remove(self, req: dict) -> dict:

@@ -1,0 +1,2 @@
+from .service import StorageService
+from .client import StorageClient, StorageRpcResponse

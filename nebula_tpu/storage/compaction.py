@@ -1,0 +1,41 @@
+"""Storage compaction filter — drops TTL-expired and schema-orphaned rows
+during engine compaction (reference storage/CompactionFilter.h,
+NebulaCompactionFilterFactory).
+"""
+from __future__ import annotations
+
+from ..codec.rows import RowReader
+from ..common.clock import now_micros
+from ..common.keys import KeyUtils
+from ..meta.schema_manager import SchemaManager
+
+
+def make_compaction_filter_factory(schema_man: SchemaManager):
+    """-> factory(space_id) -> filter(key, value) -> bool (True = drop)."""
+
+    def factory(space_id: int):
+        def filt(key: bytes, value: bytes) -> bool:
+            if KeyUtils.is_vertex(key):
+                _part, _vid, tag_id, _ver = KeyUtils.parse_vertex(key)
+                schema = schema_man.get_tag_schema(space_id, tag_id)
+            elif KeyUtils.is_edge(key):
+                _p, _s, etype, _r, _d, _v = KeyUtils.parse_edge(key)
+                schema = schema_man.get_edge_schema(space_id, abs(etype))
+            else:
+                return False  # system keys stay
+            if schema is None:
+                return True  # schema dropped -> orphaned data
+            ttl_col = schema.schema_prop.ttl_col
+            ttl_dur = schema.schema_prop.ttl_duration
+            if ttl_col and ttl_dur:
+                try:
+                    base = RowReader(value, schema).get(ttl_col)
+                except (KeyError, IndexError):
+                    return False
+                if isinstance(base, (int, float)) and \
+                        base + ttl_dur < now_micros() // 1_000_000:
+                    return True
+            return False
+        return filt
+
+    return factory

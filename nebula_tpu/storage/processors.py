@@ -1,0 +1,501 @@
+"""Storage processors — the request execution kernels of storaged.
+
+Capability parity with /root/reference/src/storage/ (SURVEY.md §2.5):
+QueryBoundProcessor (getNeighbors), QueryVertexPropsProcessor (getProps),
+QueryEdgePropsProcessor (getEdgeProps), QueryStatsProcessor
+(outBoundStats/inBoundStats aggregation pushdown), AddVertices/AddEdges.
+
+Semantics mirrored from the reference hot path (QueryBaseProcessor.inl):
+  * per-request Tag/Edge PropContexts from PropDefs (checkAndBuildContexts
+    :38-136) and pushed-filter decode + validation (checkExp:139-245 —
+    $$-refs are rejected here; graphd keeps those clauses);
+  * vertices bucketized across a worker pool (genBuckets:433-460,
+    max_handlers_per_req / min_vertices_per_bucket flags);
+  * per-vertex prefix scans with latest-version dedup by (rank, dst)
+    (:352-361) — our keys sort latest-first, so dedup is "first wins";
+  * TTL rows skipped on read (CompactionFilter drops them at compaction).
+
+Wire shapes (dict payloads; see storage/client.py for the caller side):
+  getBound req:  {space_id, parts: {part: [vids]}, edge_types: [et] | [],
+                  filter: bytes|None, vertex_props: [[tag_id, prop]],
+                  edge_props: {etype: [prop]}, reverse: bool}
+  getBound resp: {vertex_schema, edge_schemas: {et: wire_schema},
+                  vertices: [{id, vdata, edges: {et: rowset}}],
+                  latency_us}
+Edge rowsets always carry the pseudo-columns _dst/_rank/_type first, then
+requested real props — graphd's executors rely on that layout.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.rows import (RowReader, RowSetReader, RowSetWriter, RowWriter,
+                          encode_row)
+from ..common.clock import inverted_version, now_micros, Duration, INT64_MAX
+from ..common.flags import flags
+from ..common.keys import KeyUtils
+from ..common.status import ErrorCode, Status
+from ..filter.expressions import (DestPropExpr, ExprContext, ExprError,
+                                  Expression, decode_expr)
+from ..interface.common import (ColumnDef, Schema, SupportedType,
+                                schema_to_wire)
+from ..interface.rpc import RpcError
+from ..kvstore.store import NebulaStore
+from ..meta.schema_manager import SchemaManager
+
+_PSEUDO_COLS = [ColumnDef("_dst", SupportedType.VID),
+                ColumnDef("_rank", SupportedType.INT),
+                ColumnDef("_type", SupportedType.INT)]
+
+
+def _err(code: ErrorCode, msg: str = "") -> RpcError:
+    return RpcError(Status(code, msg))
+
+
+def _has_dst_ref(expr: Expression) -> bool:
+    if isinstance(expr, DestPropExpr):
+        return True
+    return any(_has_dst_ref(c) for c in expr.children())
+
+
+class _TagContext:
+    __slots__ = ("tag_id", "props", "schema")
+
+    def __init__(self, tag_id: int, props: List[str], schema: Schema):
+        self.tag_id = tag_id
+        self.props = props
+        self.schema = schema
+
+
+def _ttl_expired(reader: RowReader, schema: Schema) -> bool:
+    ttl_col = schema.schema_prop.ttl_col
+    if not ttl_col or not schema.schema_prop.ttl_duration:
+        return False
+    try:
+        base = reader.get(ttl_col)
+    except (KeyError, ExprError):
+        return False
+    return isinstance(base, (int, float)) and \
+        base + schema.schema_prop.ttl_duration < now_micros() // 1_000_000
+
+
+class QueryBaseProcessor:
+    """Shared context building + bucketing (reference QueryBaseProcessor)."""
+
+    def __init__(self, kv: NebulaStore, schema_man: SchemaManager,
+                 executor: Optional[concurrent.futures.Executor] = None):
+        self.kv = kv
+        self.schema_man = schema_man
+        self.executor = executor
+
+    # ---- contexts ----------------------------------------------------
+    def build_tag_contexts(self, space_id: int,
+                           vertex_props: List[List]) -> List[_TagContext]:
+        by_tag: Dict[int, List[str]] = {}
+        for tag_id, prop in vertex_props:
+            by_tag.setdefault(int(tag_id), []).append(prop)
+        out = []
+        for tag_id, props in by_tag.items():
+            schema = self.schema_man.get_tag_schema(space_id, tag_id)
+            if schema is None:
+                raise _err(ErrorCode.E_TAG_PROP_NOT_FOUND, f"tag {tag_id}")
+            for p in props:
+                if schema.field_index(p) < 0:
+                    raise _err(ErrorCode.E_TAG_PROP_NOT_FOUND,
+                               f"tag {tag_id} prop {p}")
+            out.append(_TagContext(tag_id, props, schema))
+        return out
+
+    def decode_filter(self, space_id: int,
+                      filter_bytes: Optional[bytes]) -> Optional[Expression]:
+        if not filter_bytes:
+            return None
+        try:
+            expr = decode_expr(filter_bytes)
+        except ExprError as e:
+            raise _err(ErrorCode.E_INVALID_FILTER, str(e))
+        if _has_dst_ref(expr):
+            # $$-refs need the second fetch wave; graphd must not push them
+            raise _err(ErrorCode.E_INVALID_FILTER, "$$ not allowed in pushed filter")
+        return expr
+
+    # ---- bucketing (genBuckets/asyncProcessBucket) -------------------
+    def process_buckets(self, items: list, fn) -> list:
+        """Run fn(item) for all items, fanned out across the worker pool in
+        buckets; preserves input order in the result list."""
+        if self.executor is None or len(items) <= 1:
+            return [fn(it) for it in items]
+        max_buckets = max(1, int(flags.get("max_handlers_per_req", 10)))
+        min_per = max(1, int(flags.get("min_vertices_per_bucket", 3)))
+        n_buckets = min(max_buckets, max(1, len(items) // min_per))
+        if n_buckets <= 1:
+            return [fn(it) for it in items]
+        buckets: List[list] = [[] for _ in range(n_buckets)]
+        for i, it in enumerate(items):
+            buckets[i % n_buckets].append((i, it))
+        results: list = [None] * len(items)
+
+        def run_bucket(bucket):
+            for i, it in bucket:
+                results[i] = fn(it)
+
+        futures = [self.executor.submit(run_bucket, b) for b in buckets if b]
+        for f in futures:
+            f.result()
+        return results
+
+    # ---- shared collectors -------------------------------------------
+    def collect_vertex_props(self, space_id: int, part: int, vid: int,
+                             tcs: List[_TagContext]):
+        """-> (row_bytes, reader_values dict) for the response vertex schema,
+        or (None, {}) if no requested tag rows exist."""
+        values: Dict[str, object] = {}
+        found = False
+        for tc in tcs:
+            prefix = KeyUtils.vertex_prefix(part, vid, tc.tag_id)
+            for key, val in self.kv.prefix(space_id, part, prefix):
+                reader = RowReader(val, tc.schema)
+                if _ttl_expired(reader, tc.schema):
+                    break
+                for p in tc.props:
+                    values[p] = reader.get(p)
+                found = True
+                break  # first key == latest version
+        return values if found else None
+
+
+class QueryBoundProcessor(QueryBaseProcessor):
+    """getNeighbors (reference QueryBoundProcessor.cpp:16-106)."""
+
+    def process(self, req: dict) -> dict:
+        dur = Duration()
+        space_id = int(req["space_id"])
+        edge_types = [int(e) for e in req.get("edge_types", [])]
+        if not edge_types:
+            edge_types = self.schema_man.all_edge_types(space_id)
+            if req.get("reverse"):
+                edge_types = [-e for e in edge_types]
+        tcs = self.build_tag_contexts(space_id, req.get("vertex_props", []))
+        filter_expr = self.decode_filter(space_id, req.get("filter"))
+        edge_props: Dict[int, List[str]] = {
+            int(k): list(v) for k, v in req.get("edge_props", {}).items()}
+
+        # per-edge-type schemas: pseudo cols + requested props
+        edge_out_schemas: Dict[int, Schema] = {}
+        edge_src_schemas: Dict[int, Schema] = {}
+        for et in edge_types:
+            schema = self.schema_man.get_edge_schema(space_id, abs(et))
+            if schema is None:
+                raise _err(ErrorCode.E_EDGE_PROP_NOT_FOUND, f"edge {et}")
+            req_props = edge_props.get(et, edge_props.get(abs(et), []))
+            for p in req_props:
+                if schema.field_index(p) < 0:
+                    raise _err(ErrorCode.E_EDGE_PROP_NOT_FOUND,
+                               f"edge {et} prop {p}")
+            cols = list(_PSEUDO_COLS)
+            cols += [schema.get_field(p) for p in req_props]
+            edge_out_schemas[et] = Schema(columns=cols)
+            edge_src_schemas[et] = schema
+
+        vertex_schema = None
+        if tcs:
+            vcols = []
+            for tc in tcs:
+                vcols += [tc.schema.get_field(p) for p in tc.props]
+            vertex_schema = Schema(columns=vcols)
+
+        def work(part_vid):
+            part, vid = part_vid
+            return self.process_vertex(space_id, part, vid, tcs, edge_types,
+                                       edge_src_schemas, edge_out_schemas,
+                                       edge_props, filter_expr)
+
+        items = [(int(part), int(vid))
+                 for part, vids in req["parts"].items() for vid in vids]
+        vertices = [v for v in self.process_buckets(items, work)
+                    if v is not None]
+        return {
+            "vertex_schema": schema_to_wire(vertex_schema) if vertex_schema else None,
+            "edge_schemas": {et: schema_to_wire(s)
+                             for et, s in edge_out_schemas.items()},
+            "vertices": vertices,
+            "latency_us": dur.elapsed_in_usec(),
+        }
+
+    def process_vertex(self, space_id, part, vid, tcs, edge_types,
+                       edge_src_schemas, edge_out_schemas, edge_props,
+                       filter_expr) -> Optional[dict]:
+        src_values = self.collect_vertex_props(space_id, part, vid, tcs)
+        vdata = b""
+        if tcs and src_values is not None:
+            flat: Dict[str, object] = dict(src_values)
+            cols = []
+            for tc in tcs:
+                cols += [tc.schema.get_field(p) for p in tc.props]
+            vdata = encode_row(Schema(columns=cols), flat)
+
+        # expression context bound to this vertex's src props; per-edge
+        # fields rebound in the loop
+        edge_row: Dict[str, object] = {}
+        edge_key: Dict[str, object] = {}
+        if filter_expr is not None:
+            ctx = ExprContext()
+            src_map = src_values or {}
+            ctx.get_src_tag_prop = lambda tag, prop: src_map.get(prop)
+            ctx.get_alias_prop = lambda alias, prop: edge_row.get(prop)
+            ctx.get_edge_rank = lambda alias: edge_key.get("rank")
+            ctx.get_edge_dst_id = lambda alias: edge_key.get("dst")
+            ctx.get_edge_src_id = lambda alias: vid
+            ctx.get_edge_type = lambda alias: edge_key.get("etype")
+
+        edges_out: Dict[int, bytes] = {}
+        any_edges = False
+        for et in edge_types:
+            schema = edge_src_schemas[et]
+            out_schema = edge_out_schemas[et]
+            req_props = edge_props.get(et, edge_props.get(abs(et), []))
+            writer = RowSetWriter()
+            last_dedup: Optional[Tuple[int, int]] = None
+            prefix = KeyUtils.edge_prefix(part, vid, et)
+            for key, val in self.kv.prefix(space_id, part, prefix):
+                _p, _src, _et, rank, dst, _ver = KeyUtils.parse_edge(key)
+                if last_dedup == (rank, dst):
+                    continue  # older version of same edge
+                last_dedup = (rank, dst)
+                reader = RowReader(val, schema)
+                if _ttl_expired(reader, schema):
+                    continue
+                if filter_expr is not None:
+                    edge_row.clear()
+                    for p in schema.names():
+                        edge_row[p] = reader.get(p)
+                    edge_key.update(rank=rank, dst=dst, etype=et)
+                    try:
+                        if not filter_expr.eval(ctx):
+                            continue
+                    except ExprError:
+                        continue  # row doesn't satisfy / type error -> drop
+                vals: Dict[str, object] = {"_dst": dst, "_rank": rank,
+                                           "_type": et}
+                for p in req_props:
+                    vals[p] = reader.get(p)
+                writer.add_row(encode_row(out_schema, vals))
+            if writer.count:
+                edges_out[et] = writer.data()
+                any_edges = True
+
+        if not any_edges and src_values is None:
+            return None
+        return {"id": vid, "vdata": vdata, "edges": edges_out}
+
+
+class QueryVertexPropsProcessor(QueryBaseProcessor):
+    """getProps (reference QueryVertexPropsProcessor) — vertex props only.
+
+    If vertex_props is empty, returns ALL props of ALL tags present on each
+    vertex (used by FETCH * and the dst-prop second wave)."""
+
+    def process(self, req: dict) -> dict:
+        dur = Duration()
+        space_id = int(req["space_id"])
+        vertex_props = req.get("vertex_props", [])
+        if vertex_props:
+            tcs = self.build_tag_contexts(space_id, vertex_props)
+        else:
+            tcs = []
+            for tag_id in self.schema_man.all_tag_ids(space_id):
+                schema = self.schema_man.get_tag_schema(space_id, tag_id)
+                if schema is not None:
+                    tcs.append(_TagContext(tag_id, schema.names(), schema))
+        vcols = []
+        for tc in tcs:
+            vcols += [tc.schema.get_field(p) for p in tc.props]
+        vertex_schema = Schema(columns=vcols)
+
+        def work(part_vid):
+            part, vid = part_vid
+            values = self.collect_vertex_props(space_id, part, vid, tcs)
+            if values is None:
+                return None
+            return {"id": vid, "vdata": encode_row(vertex_schema, values),
+                    "edges": {}}
+
+        items = [(int(part), int(vid))
+                 for part, vids in req["parts"].items() for vid in vids]
+        vertices = [v for v in self.process_buckets(items, work) if v is not None]
+        return {"vertex_schema": schema_to_wire(vertex_schema),
+                "edge_schemas": {}, "vertices": vertices,
+                "latency_us": dur.elapsed_in_usec()}
+
+
+class QueryEdgePropsProcessor(QueryBaseProcessor):
+    """getEdgeProps by exact EdgeKey (reference QueryEdgePropsProcessor).
+
+    req: {space_id, parts: {part: [[src, etype, rank, dst], ...]}, props: [..]}
+    """
+
+    def process(self, req: dict) -> dict:
+        dur = Duration()
+        space_id = int(req["space_id"])
+        want: Dict[int, List[str]] = {}
+        rows_by_et: Dict[int, RowSetWriter] = {}
+        out_schemas: Dict[int, Schema] = {}
+        for part_s, keys in req["parts"].items():
+            part = int(part_s)
+            for src, etype, rank, dst in keys:
+                etype = int(etype)
+                schema = self.schema_man.get_edge_schema(space_id, abs(etype))
+                if schema is None:
+                    raise _err(ErrorCode.E_EDGE_PROP_NOT_FOUND, f"edge {etype}")
+                props = req.get("props") or schema.names()
+                if etype not in out_schemas:
+                    # exact-key fetches also carry _src so callers can
+                    # attribute rows without guessing (colliding (dst,rank)
+                    # pairs across different sources are common)
+                    cols = ([ColumnDef("_src", SupportedType.VID)] +
+                            list(_PSEUDO_COLS) + [
+                        c for c in (schema.get_field(p) for p in props)
+                        if c is not None])
+                    out_schemas[etype] = Schema(columns=cols)
+                    rows_by_et[etype] = RowSetWriter()
+                    want[etype] = [p for p in props if schema.field_index(p) >= 0]
+                prefix = KeyUtils.edge_prefix(part, int(src), etype, int(rank),
+                                              int(dst))
+                for key, val in self.kv.prefix(space_id, part, prefix):
+                    reader = RowReader(val, schema)
+                    if _ttl_expired(reader, schema):
+                        break
+                    vals = {"_src": int(src), "_dst": int(dst),
+                            "_rank": int(rank), "_type": etype}
+                    for p in want[etype]:
+                        vals[p] = reader.get(p)
+                    rows_by_et[etype].add_row(encode_row(out_schemas[etype], vals))
+                    break  # latest version only
+        return {
+            "vertex_schema": None,
+            "edge_schemas": {et: schema_to_wire(s) for et, s in out_schemas.items()},
+            "edges": {et: w.data() for et, w in rows_by_et.items()},
+            "latency_us": dur.elapsed_in_usec(),
+        }
+
+
+class QueryStatsProcessor(QueryBaseProcessor):
+    """outBoundStats/inBoundStats — aggregation pushed to storage
+    (reference QueryStatsProcessor, CollectType::kAggregate).
+
+    req: {space_id, parts: {part: [vids]}, edge_types: [...],
+          stat_props: {alias: [etype, prop]}}  -> per-alias {sum,count,avg}
+    """
+
+    def process(self, req: dict) -> dict:
+        dur = Duration()
+        space_id = int(req["space_id"])
+        edge_types = [int(e) for e in req.get("edge_types", [])] or \
+            self.schema_man.all_edge_types(space_id)
+        stat_props = {alias: (int(et), prop)
+                      for alias, (et, prop) in req.get("stat_props", {}).items()}
+        sums: Dict[str, float] = {a: 0.0 for a in stat_props}
+        counts: Dict[str, int] = {a: 0 for a in stat_props}
+        degree = 0
+        for part_s, vids in req["parts"].items():
+            part = int(part_s)
+            for vid in vids:
+                for et in edge_types:
+                    schema = self.schema_man.get_edge_schema(space_id, abs(et))
+                    if schema is None:
+                        continue
+                    last_dedup = None
+                    for key, val in self.kv.prefix(
+                            space_id, part, KeyUtils.edge_prefix(part, int(vid), et)):
+                        _p, _s, _e, rank, dst, _v = KeyUtils.parse_edge(key)
+                        if last_dedup == (rank, dst):
+                            continue
+                        last_dedup = (rank, dst)
+                        degree += 1
+                        reader = RowReader(val, schema)
+                        for alias, (target_et, prop) in stat_props.items():
+                            if target_et == et and schema.field_index(prop) >= 0:
+                                v = reader.get(prop)
+                                if isinstance(v, (int, float)) and \
+                                        not isinstance(v, bool):
+                                    sums[alias] += v
+                                    counts[alias] += 1
+        stats = {a: {"sum": sums[a], "count": counts[a],
+                     "avg": (sums[a] / counts[a]) if counts[a] else 0.0}
+                 for a in stat_props}
+        return {"degree": degree, "stats": stats,
+                "latency_us": dur.elapsed_in_usec()}
+
+
+class AddVerticesProcessor(QueryBaseProcessor):
+    """addVertices (reference AddVerticesProcessor.cpp:18-52).
+
+    req: {space_id, overwritable, parts: {part: [{id, tags: [[tag_id, row_bytes]]}]}}
+    """
+
+    def process(self, req: dict) -> dict:
+        space_id = int(req["space_id"])
+        version = inverted_version()
+        for part_s, vertices in req["parts"].items():
+            part = int(part_s)
+            batch = []
+            for v in vertices:
+                vid = int(v["id"])
+                for tag_id, row in v["tags"]:
+                    key = KeyUtils.vertex_key(part, vid, int(tag_id), version)
+                    batch.append((key, row))
+            if batch:
+                st = self.kv.multi_put(space_id, part, batch)
+                if not st.ok():
+                    raise RpcError(st)
+        return {}
+
+
+class AddEdgesProcessor(QueryBaseProcessor):
+    """addEdges (reference AddEdgesProcessor).
+
+    req: {space_id, overwritable,
+          parts: {part: [{src, etype, rank, dst, props: row_bytes}]}}
+    """
+
+    def process(self, req: dict) -> dict:
+        space_id = int(req["space_id"])
+        version = inverted_version()
+        for part_s, edges in req["parts"].items():
+            part = int(part_s)
+            batch = []
+            for e in edges:
+                key = KeyUtils.edge_key(part, int(e["src"]), int(e["etype"]),
+                                        int(e.get("rank", 0)), int(e["dst"]),
+                                        version)
+                batch.append((key, e["props"]))
+            if batch:
+                st = self.kv.multi_put(space_id, part, batch)
+                if not st.ok():
+                    raise RpcError(st)
+        return {}
+
+
+class DeleteProcessor(QueryBaseProcessor):
+    """deleteVertex/deleteEdges — removes all versions (the reference parses
+    DELETE sentences but ships no executors; we complete the path)."""
+
+    def delete_vertex(self, req: dict) -> dict:
+        space_id = int(req["space_id"])
+        part = int(req["part"])
+        vid = int(req["vid"])
+        self.kv.remove_prefix(space_id, part, KeyUtils.vertex_prefix(part, vid))
+        self.kv.remove_prefix(space_id, part, KeyUtils.edge_prefix(part, vid))
+        return {}
+
+    def delete_edges(self, req: dict) -> dict:
+        space_id = int(req["space_id"])
+        for part_s, keys in req["parts"].items():
+            part = int(part_s)
+            for src, etype, rank, dst in keys:
+                prefix = KeyUtils.edge_prefix(part, int(src), int(etype),
+                                              int(rank), int(dst))
+                self.kv.remove_prefix(space_id, part, prefix)
+        return {}

@@ -1,0 +1,154 @@
+"""StorageService — the storaged RPC handler.
+
+Capability parity with /root/reference/src/storage/StorageServiceHandler.cpp
+(one processor per request) plus the leader-redirect contract: every
+part-addressed request checks local ownership and leadership first and
+returns E_LEADER_CHANGED with a leader hint (storage.thrift:57-62) so
+clients can chase leaders.
+
+The ``backend`` seam: when a TpuStorageBackend is attached (tpu/backend.py)
+and the space has a device CSR mirror, getBound/stats are answered from
+HBM-resident device arrays instead of KV prefix scans — same wire contract,
+same results (BASELINE.json north star).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Dict, List, Optional
+
+from ..common.flags import flags
+from ..common.stats import stats
+from ..common.status import ErrorCode, Status
+from ..interface.rpc import RpcError
+from ..kvstore.store import NebulaStore
+from ..meta.schema_manager import SchemaManager
+from .processors import (AddEdgesProcessor, AddVerticesProcessor,
+                         DeleteProcessor, QueryBoundProcessor,
+                         QueryEdgePropsProcessor, QueryStatsProcessor,
+                         QueryVertexPropsProcessor)
+
+
+class StorageService:
+    def __init__(self, kv: NebulaStore, schema_man: SchemaManager,
+                 local_host: Optional[str] = None,
+                 num_workers: int = 4):
+        self.kv = kv
+        self.schema_man = schema_man
+        self.local_host = local_host
+        self.pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="storage-worker")
+        self.backend = None  # TpuStorageBackend when attached
+        stats.register_stats("storage.get_bound.latency_us")
+        stats.register_stats("storage.add.latency_us")
+        stats.register_stats("storage.qps")
+
+    # ---- ownership / leadership gate --------------------------------
+    def _check_parts(self, space_id: int, part_ids) -> None:
+        for part_id in part_ids:
+            part = self.kv.part(space_id, int(part_id))
+            if part is None:
+                raise RpcError(Status(ErrorCode.E_PART_NOT_FOUND,
+                                      f"part {part_id} not on this host"))
+            if not part.is_leader():
+                leader = part.leader()
+                raise RpcError(Status(
+                    ErrorCode.E_LEADER_CHANGED,
+                    str(leader) if leader else ""))
+
+    # ---- reads ------------------------------------------------------
+    def rpc_getBound(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        if self.backend is not None and self.backend.serves(int(req["space_id"])):
+            resp = self.backend.get_bound(req)
+        else:
+            resp = QueryBoundProcessor(self.kv, self.schema_man,
+                                       self.pool).process(req)
+        stats.add_value("storage.get_bound.latency_us",
+                        resp.get("latency_us", 0))
+        return resp
+
+    def rpc_getProps(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        return QueryVertexPropsProcessor(self.kv, self.schema_man,
+                                         self.pool).process(req)
+
+    def rpc_getEdgeProps(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        return QueryEdgePropsProcessor(self.kv, self.schema_man).process(req)
+
+    def rpc_boundStats(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        if self.backend is not None and self.backend.serves(int(req["space_id"])):
+            return self.backend.bound_stats(req)
+        return QueryStatsProcessor(self.kv, self.schema_man).process(req)
+
+    # ---- writes -----------------------------------------------------
+    def rpc_addVertices(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        resp = AddVerticesProcessor(self.kv, self.schema_man).process(req)
+        return resp
+
+    def rpc_addEdges(self, req: dict) -> dict:
+        stats.add_value("storage.qps")
+        self._check_parts(req["space_id"], req["parts"].keys())
+        return AddEdgesProcessor(self.kv, self.schema_man).process(req)
+
+    def rpc_deleteVertex(self, req: dict) -> dict:
+        self._check_parts(req["space_id"], [req["part"]])
+        return DeleteProcessor(self.kv, self.schema_man).delete_vertex(req)
+
+    def rpc_deleteEdges(self, req: dict) -> dict:
+        self._check_parts(req["space_id"], req["parts"].keys())
+        return DeleteProcessor(self.kv, self.schema_man).delete_edges(req)
+
+    # ---- admin (raft membership — driven by meta's balancer) --------
+    def _raft(self, req: dict):
+        part = self.kv.part(int(req["space_id"]), int(req["part_id"]))
+        if part is None:
+            raise RpcError(Status(ErrorCode.E_PART_NOT_FOUND, ""))
+        return part
+
+    def rpc_transLeader(self, req: dict) -> dict:
+        part = self._raft(req)
+        if part.raft is not None:
+            part.raft.transfer_leadership(req["new_leader"])
+        return {}
+
+    def rpc_addPart(self, req: dict) -> dict:
+        self.kv.add_part(int(req["space_id"]), int(req["part_id"]),
+                         req.get("peers"))
+        return {}
+
+    def rpc_addLearner(self, req: dict) -> dict:
+        part = self._raft(req)
+        if part.raft is not None:
+            part.raft.add_learner(req["learner"])
+        return {}
+
+    def rpc_waitingForCatchUpData(self, req: dict) -> dict:
+        part = self._raft(req)
+        caught_up = True
+        if part.raft is not None:
+            caught_up = part.raft.learner_caught_up(req.get("target"))
+        return {"caught_up": caught_up}
+
+    def rpc_memberChange(self, req: dict) -> dict:
+        part = self._raft(req)
+        if part.raft is not None:
+            if req.get("add"):
+                part.raft.add_peer(req["peer"])
+            else:
+                part.raft.remove_peer(req["peer"])
+        return {}
+
+    def rpc_removePart(self, req: dict) -> dict:
+        self.kv.remove_part(int(req["space_id"]), int(req["part_id"]))
+        return {}
+
+    def shutdown(self) -> None:
+        self.pool.shutdown(wait=False)

@@ -1,0 +1,187 @@
+"""Expression engine tests — modeled on the reference's
+ExpressionTest.cpp (eval + encode/decode roundtrip, SURVEY.md §4)."""
+import pytest
+
+from nebula_tpu.filter import (AliasPropExpr, ArithmeticExpr, DestPropExpr,
+                               EdgeDstIdExpr, EdgeRankExpr, ExprContext,
+                               ExprError, FunctionCallExpr, FunctionManager,
+                               InputPropExpr, LogicalExpr, PrimaryExpr,
+                               RelationalExpr, SourcePropExpr, TypeCastingExpr,
+                               UnaryExpr, VariablePropExpr, decode_expr,
+                               encode_expr)
+
+
+def lit(v):
+    return PrimaryExpr(v)
+
+
+def ctx_with(src=None, edge=None, inp=None, var=None, dst=None):
+    c = ExprContext()
+    if src is not None:
+        c.get_src_tag_prop = lambda tag, prop: src[(tag, prop)]
+    if edge is not None:
+        c.get_alias_prop = lambda alias, prop: edge[prop]
+    if inp is not None:
+        c.get_input_prop = lambda prop: inp[prop]
+    if var is not None:
+        c.get_variable_prop = lambda v, p: var[(v, p)]
+    if dst is not None:
+        c.get_dst_tag_prop = lambda tag, prop: dst[(tag, prop)]
+    return c
+
+
+class TestArithmetic:
+    def test_int_ops(self):
+        c = ExprContext()
+        assert ArithmeticExpr("+", lit(2), lit(3)).eval(c) == 5
+        assert ArithmeticExpr("-", lit(2), lit(3)).eval(c) == -1
+        assert ArithmeticExpr("*", lit(4), lit(3)).eval(c) == 12
+        assert ArithmeticExpr("/", lit(7), lit(2)).eval(c) == 3
+        assert ArithmeticExpr("/", lit(-7), lit(2)).eval(c) == -3  # C trunc
+        assert ArithmeticExpr("%", lit(7), lit(3)).eval(c) == 1
+        assert ArithmeticExpr("%", lit(-7), lit(3)).eval(c) == -1
+        assert ArithmeticExpr("^", lit(6), lit(3)).eval(c) == 5
+
+    def test_mixed_promotion(self):
+        c = ExprContext()
+        assert ArithmeticExpr("+", lit(1), lit(2.5)).eval(c) == 3.5
+        assert ArithmeticExpr("/", lit(7), lit(2.0)).eval(c) == 3.5
+
+    def test_string_concat(self):
+        c = ExprContext()
+        assert ArithmeticExpr("+", lit("a"), lit("b")).eval(c) == "ab"
+        assert ArithmeticExpr("+", lit("n"), lit(1)).eval(c) == "n1"
+
+    def test_division_by_zero(self):
+        with pytest.raises(ExprError):
+            ArithmeticExpr("/", lit(1), lit(0)).eval(ExprContext())
+        with pytest.raises(ExprError):
+            ArithmeticExpr("%", lit(1), lit(0)).eval(ExprContext())
+
+    def test_bool_not_numeric(self):
+        with pytest.raises(ExprError):
+            ArithmeticExpr("-", lit(True), lit(1)).eval(ExprContext())
+
+
+class TestRelationalLogical:
+    def test_compare(self):
+        c = ExprContext()
+        assert RelationalExpr("<", lit(1), lit(2)).eval(c)
+        assert RelationalExpr(">=", lit(2.0), lit(2)).eval(c)
+        assert RelationalExpr("==", lit("x"), lit("x")).eval(c)
+        assert RelationalExpr("!=", lit("x"), lit(1)).eval(c)  # mixed types
+        assert not RelationalExpr("==", lit("x"), lit(1)).eval(c)
+
+    def test_mixed_order_compare_raises(self):
+        with pytest.raises(ExprError):
+            RelationalExpr("<", lit("x"), lit(1)).eval(ExprContext())
+
+    def test_logical_short_circuit(self):
+        c = ExprContext()
+        # right side would raise (unbound $-), so && must short-circuit
+        bad = InputPropExpr("x")
+        assert not LogicalExpr("&&", lit(False), bad).eval(c)
+        assert LogicalExpr("||", lit(True), bad).eval(c)
+        assert LogicalExpr("&&", lit(True), lit(1)).eval(c)
+
+    def test_unary(self):
+        c = ExprContext()
+        assert UnaryExpr("!", lit(False)).eval(c) is True
+        assert UnaryExpr("-", lit(5)).eval(c) == -5
+        assert UnaryExpr("+", lit(5)).eval(c) == 5
+
+
+class TestCasting:
+    def test_casts(self):
+        c = ExprContext()
+        assert TypeCastingExpr("int", lit("42")).eval(c) == 42
+        assert TypeCastingExpr("double", lit(2)).eval(c) == 2.0
+        assert TypeCastingExpr("string", lit(True)).eval(c) == "true"
+        assert TypeCastingExpr("bool", lit(0)).eval(c) is False
+
+    def test_bad_cast(self):
+        with pytest.raises(ExprError):
+            TypeCastingExpr("int", lit("abc")).eval(ExprContext())
+
+
+class TestPropertyRefs:
+    def test_all_getters(self):
+        c = ctx_with(src={("player", "age"): 42},
+                     edge={"degree": 7},
+                     inp={"name": "Tim"},
+                     var={("v1", "x"): 3},
+                     dst={("team", "name"): "Spurs"})
+        assert SourcePropExpr("player", "age").eval(c) == 42
+        assert AliasPropExpr("follow", "degree").eval(c) == 7
+        assert InputPropExpr("name").eval(c) == "Tim"
+        assert VariablePropExpr("v1", "x").eval(c) == 3
+        assert DestPropExpr("team", "name").eval(c) == "Spurs"
+
+    def test_unbound_getter_raises(self):
+        with pytest.raises(ExprError):
+            SourcePropExpr("t", "p").eval(ExprContext())
+
+    def test_prepare_alias_check(self):
+        c = ExprContext()
+        c.aliases = {"follow": True}
+        AliasPropExpr("follow", "x").prepare(c)
+        with pytest.raises(ExprError):
+            AliasPropExpr("like", "x").prepare(c)
+
+
+class TestFunctions:
+    def test_math(self):
+        c = ExprContext()
+        assert FunctionCallExpr("abs", [lit(-3)]).eval(c) == 3
+        assert FunctionCallExpr("pow", [lit(2), lit(10)]).eval(c) == 1024
+        assert FunctionCallExpr("floor", [lit(2.7)]).eval(c) == 2
+
+    def test_hash_deterministic(self):
+        c = ExprContext()
+        h1 = FunctionCallExpr("hash", [lit("abc")]).eval(c)
+        h2 = FunctionCallExpr("hash", [lit("abc")]).eval(c)
+        assert h1 == h2
+        assert isinstance(h1, int)
+
+    def test_strcasecmp(self):
+        c = ExprContext()
+        assert FunctionCallExpr("strcasecmp", [lit("ABC"), lit("abc")]).eval(c) == 0
+
+    def test_arity_checked_at_prepare(self):
+        with pytest.raises(ExprError):
+            FunctionCallExpr("abs", []).prepare(ExprContext())
+        with pytest.raises(ExprError):
+            FunctionCallExpr("nosuchfn", [lit(1)]).prepare(ExprContext())
+        assert FunctionManager.exists("now")
+
+
+class TestCodec:
+    def test_roundtrip_complex(self):
+        # ($^.player.age > 30 && follow.degree < 5.0) || $-.name == "x"
+        expr = LogicalExpr(
+            "||",
+            LogicalExpr(
+                "&&",
+                RelationalExpr(">", SourcePropExpr("player", "age"), lit(30)),
+                RelationalExpr("<", AliasPropExpr("follow", "degree"), lit(5.0))),
+            RelationalExpr("==", InputPropExpr("name"), lit("x")))
+        data = encode_expr(expr)
+        back = decode_expr(data)
+        assert back == expr
+        c = ctx_with(src={("player", "age"): 35}, edge={"degree": 3.0},
+                     inp={"name": "y"})
+        assert back.eval(c) is True
+
+    def test_roundtrip_pseudo_and_fn(self):
+        expr = RelationalExpr("==", EdgeDstIdExpr("follow"),
+                              FunctionCallExpr("abs", [lit(-5)]))
+        back = decode_expr(encode_expr(expr))
+        c = ExprContext()
+        c.get_edge_dst_id = lambda alias: 5
+        assert back.eval(c) is True
+
+    def test_corrupt_rejected(self):
+        with pytest.raises(ExprError):
+            decode_expr(b"\x93\x01\x02")
+        with pytest.raises(ExprError):
+            decode_expr(b"garbage-not-msgpack\xff")

@@ -1,0 +1,298 @@
+"""Storage processor + client tests.
+
+Modeled on the reference's storage/test tier (QueryBoundTest, AddEdgesTest,
+QueryStatsTest with TestUtils::initKV + AdHocSchemaManager fakes,
+SURVEY.md §4)."""
+import pytest
+
+from nebula_tpu.codec.rows import RowReader, RowSetReader, encode_row
+from nebula_tpu.common.keys import id_hash
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.filter import (AliasPropExpr, PrimaryExpr, RelationalExpr,
+                               SourcePropExpr, DestPropExpr, encode_expr)
+from nebula_tpu.interface.common import (ColumnDef, HostAddr, Schema,
+                                         SupportedType, schema_from_wire)
+from nebula_tpu.interface.rpc import ClientManager, RpcError
+from nebula_tpu.kvstore import KVOptions, MemPartManager, NebulaStore
+from nebula_tpu.meta.client import MetaClient
+from nebula_tpu.meta.schema_manager import AdHocSchemaManager
+from nebula_tpu.meta.service import MetaService
+from nebula_tpu.storage.client import StorageClient
+from nebula_tpu.storage.service import StorageService
+
+SPACE = 1
+NUM_PARTS = 6
+TAG_PLAYER = 10
+EDGE_FOLLOW = 101
+
+PLAYER = Schema(columns=[ColumnDef("name", SupportedType.STRING),
+                         ColumnDef("age", SupportedType.INT)])
+FOLLOW = Schema(columns=[ColumnDef("degree", SupportedType.INT)])
+
+
+def make_storage():
+    """initKV-equivalent: real store + MemPartManager + AdHoc schemas."""
+    pm = MemPartManager()
+    kv = NebulaStore(KVOptions(part_man=pm))
+    pm.register_handler(kv)
+    for p in range(1, NUM_PARTS + 1):
+        pm.add_part(SPACE, p)
+    sm = AdHocSchemaManager()
+    sm.add_tag_schema(SPACE, TAG_PLAYER, "player", PLAYER)
+    sm.add_edge_schema(SPACE, EDGE_FOLLOW, "follow", FOLLOW)
+    return StorageService(kv, sm)
+
+
+def insert_graph(svc, n_vertices=10, fanout=3):
+    """vertex i follows (i+1..i+fanout) % n, degree = 10*i+j."""
+    verts, edges = [], []
+    for i in range(n_vertices):
+        verts.append({"id": i, "tags": [[TAG_PLAYER, encode_row(
+            PLAYER, {"name": f"p{i}", "age": 20 + i})]]})
+        for j in range(1, fanout + 1):
+            dst = (i + j) % n_vertices
+            edges.append({"src": i, "etype": EDGE_FOLLOW, "rank": 0,
+                          "dst": dst,
+                          "props": encode_row(FOLLOW, {"degree": 10 * i + j})})
+    by_part_v, by_part_e = {}, {}
+    for v in verts:
+        by_part_v.setdefault(str(id_hash(v["id"], NUM_PARTS)), []).append(v)
+    for e in edges:
+        by_part_e.setdefault(str(id_hash(e["src"], NUM_PARTS)), []).append(e)
+    svc.rpc_addVertices({"space_id": SPACE, "parts": by_part_v,
+                         "overwritable": True})
+    svc.rpc_addEdges({"space_id": SPACE, "parts": by_part_e,
+                      "overwritable": True})
+
+
+def get_bound(svc, vids, **kw):
+    req = {"space_id": SPACE,
+           "parts": {}, "edge_types": [EDGE_FOLLOW],
+           "vertex_props": kw.get("vertex_props", []),
+           "edge_props": kw.get("edge_props", {}),
+           "filter": kw.get("filter")}
+    for vid in vids:
+        req["parts"].setdefault(str(id_hash(vid, NUM_PARTS)), []).append(vid)
+    return svc.rpc_getBound(req)
+
+
+def edge_rows(resp):
+    """-> {src_vid: [decoded edge row dicts]}"""
+    out = {}
+    for v in resp["vertices"]:
+        rows = []
+        for et, blob in v["edges"].items():
+            schema = schema_from_wire(resp["edge_schemas"][int(et)])
+            for raw in RowSetReader(blob):
+                rows.append(RowReader(raw, schema).to_dict())
+        out[v["id"]] = rows
+    return out
+
+
+class TestQueryBound:
+    def test_simple_expand(self):
+        svc = make_storage()
+        insert_graph(svc)
+        resp = get_bound(svc, [0])
+        rows = edge_rows(resp)
+        assert sorted(r["_dst"] for r in rows[0]) == [1, 2, 3]
+
+    def test_edge_props_and_src_props(self):
+        svc = make_storage()
+        insert_graph(svc)
+        resp = get_bound(svc, [2],
+                         vertex_props=[[TAG_PLAYER, "age"]],
+                         edge_props={EDGE_FOLLOW: ["degree"]})
+        rows = edge_rows(resp)
+        assert sorted(r["degree"] for r in rows[2]) == [21, 22, 23]
+        vschema = schema_from_wire(resp["vertex_schema"])
+        v = [x for x in resp["vertices"] if x["id"] == 2][0]
+        assert RowReader(v["vdata"], vschema).get("age") == 22
+
+    def test_multi_version_dedup(self):
+        svc = make_storage()
+        insert_graph(svc)
+        # re-insert edge 0->1 with a newer version and different degree
+        part = str(id_hash(0, NUM_PARTS))
+        svc.rpc_addEdges({"space_id": SPACE, "parts": {part: [
+            {"src": 0, "etype": EDGE_FOLLOW, "rank": 0, "dst": 1,
+             "props": encode_row(FOLLOW, {"degree": 999})}]},
+            "overwritable": True})
+        rows = edge_rows(get_bound(svc, [0],
+                                   edge_props={EDGE_FOLLOW: ["degree"]}))
+        by_dst = {r["_dst"]: r["degree"] for r in rows[0]}
+        assert by_dst[1] == 999  # latest wins
+        assert len(rows[0]) == 3  # still one row per (rank,dst)
+
+    def test_filter_pushdown_edge_prop(self):
+        svc = make_storage()
+        insert_graph(svc)
+        flt = encode_expr(RelationalExpr(
+            ">", AliasPropExpr("follow", "degree"), PrimaryExpr(1)))
+        rows = edge_rows(get_bound(svc, [0], filter=flt,
+                                   edge_props={EDGE_FOLLOW: ["degree"]}))
+        assert sorted(r["degree"] for r in rows.get(0, [])) == [2, 3]
+
+    def test_filter_pushdown_src_prop(self):
+        svc = make_storage()
+        insert_graph(svc)
+        flt = encode_expr(RelationalExpr(
+            ">", SourcePropExpr("player", "age"), PrimaryExpr(24)))
+        resp = get_bound(svc, [0, 5], filter=flt,
+                         vertex_props=[[TAG_PLAYER, "age"]])
+        rows = edge_rows(resp)
+        assert rows.get(0, []) == []     # age 20 filtered
+        assert len(rows.get(5, [])) == 3  # age 25 passes
+
+    def test_dst_ref_rejected_in_pushdown(self):
+        svc = make_storage()
+        insert_graph(svc)
+        flt = encode_expr(RelationalExpr(
+            ">", DestPropExpr("player", "age"), PrimaryExpr(0)))
+        with pytest.raises(RpcError) as ei:
+            get_bound(svc, [0], filter=flt)
+        assert ei.value.status.code == ErrorCode.E_INVALID_FILTER
+
+    def test_unknown_prop_rejected(self):
+        svc = make_storage()
+        insert_graph(svc)
+        with pytest.raises(RpcError) as ei:
+            get_bound(svc, [0], edge_props={EDGE_FOLLOW: ["nope"]})
+        assert ei.value.status.code == ErrorCode.E_EDGE_PROP_NOT_FOUND
+
+    def test_part_not_found(self):
+        svc = make_storage()
+        with pytest.raises(RpcError) as ei:
+            svc.rpc_getBound({"space_id": SPACE, "parts": {"99": [1]},
+                              "edge_types": [EDGE_FOLLOW]})
+        assert ei.value.status.code == ErrorCode.E_PART_NOT_FOUND
+
+
+class TestOtherProcessors:
+    def test_get_props(self):
+        svc = make_storage()
+        insert_graph(svc)
+        req = {"space_id": SPACE,
+               "parts": {str(id_hash(3, NUM_PARTS)): [3]},
+               "vertex_props": [[TAG_PLAYER, "name"], [TAG_PLAYER, "age"]]}
+        resp = svc.rpc_getProps(req)
+        schema = schema_from_wire(resp["vertex_schema"])
+        row = RowReader(resp["vertices"][0]["vdata"], schema)
+        assert row.get("name") == "p3" and row.get("age") == 23
+
+    def test_get_props_all_tags(self):
+        svc = make_storage()
+        insert_graph(svc)
+        req = {"space_id": SPACE,
+               "parts": {str(id_hash(3, NUM_PARTS)): [3]}}
+        resp = svc.rpc_getProps(req)
+        schema = schema_from_wire(resp["vertex_schema"])
+        assert RowReader(resp["vertices"][0]["vdata"], schema).get("name") == "p3"
+
+    def test_get_edge_props(self):
+        svc = make_storage()
+        insert_graph(svc)
+        req = {"space_id": SPACE,
+               "parts": {str(id_hash(0, NUM_PARTS)): [[0, EDGE_FOLLOW, 0, 2]]},
+               "props": ["degree"]}
+        resp = svc.rpc_getEdgeProps(req)
+        schema = schema_from_wire(resp["edge_schemas"][EDGE_FOLLOW])
+        rows = [RowReader(r, schema).to_dict()
+                for r in RowSetReader(resp["edges"][EDGE_FOLLOW])]
+        assert rows[0]["degree"] == 2 and rows[0]["_dst"] == 2
+
+    def test_bound_stats(self):
+        svc = make_storage()
+        insert_graph(svc)
+        req = {"space_id": SPACE,
+               "parts": {str(id_hash(0, NUM_PARTS)): [0]},
+               "edge_types": [EDGE_FOLLOW],
+               "stat_props": {"d": [EDGE_FOLLOW, "degree"]}}
+        resp = svc.rpc_boundStats(req)
+        assert resp["degree"] == 3
+        assert resp["stats"]["d"]["sum"] == 1 + 2 + 3
+        assert resp["stats"]["d"]["count"] == 3
+        assert resp["stats"]["d"]["avg"] == 2.0
+
+    def test_delete_vertex(self):
+        svc = make_storage()
+        insert_graph(svc)
+        part = id_hash(0, NUM_PARTS)
+        svc.rpc_deleteVertex({"space_id": SPACE, "part": part, "vid": 0})
+        resp = get_bound(svc, [0])
+        assert resp["vertices"] == []
+
+
+class _Cluster:
+    """MetaService + one StorageService wired through loopback channels —
+    the mock-server idiom (reference common/test/ServerContext.h)."""
+
+    def __init__(self, num_parts=NUM_PARTS):
+        self.cm = ClientManager()
+        self.meta_svc = MetaService()
+        meta_addr = HostAddr("meta", 9559)
+        self.cm.register_loopback(meta_addr, self.meta_svc)
+        self.storage_host = "127.0.0.1:44500"
+        self.meta_svc.rpc_heartBeat({"host": self.storage_host})
+        self.meta_client = MetaClient([meta_addr], client_manager=self.cm)
+        self.meta_client.wait_for_metad_ready()
+
+
+class TestStorageClient:
+    def make_cluster(self):
+        from nebula_tpu.interface.common import schema_to_wire
+        cl = _Cluster()
+        sid = cl.meta_client.create_space("nba", partition_num=NUM_PARTS).value()
+        cl.meta_client.create_tag_schema(sid, "player", schema_to_wire(PLAYER))
+        cl.meta_client.create_edge_schema(sid, "follow", schema_to_wire(FOLLOW))
+        from nebula_tpu.meta.schema_manager import ServerBasedSchemaManager
+        pm = MemPartManager()
+        kv = NebulaStore(KVOptions(part_man=pm))
+        pm.register_handler(kv)
+        for p in range(1, NUM_PARTS + 1):
+            pm.add_part(sid, p)
+        sm = ServerBasedSchemaManager(cl.meta_client)
+        svc = StorageService(kv, sm, local_host=cl.storage_host)
+        cl.cm.register_loopback(HostAddr.parse(cl.storage_host), svc)
+        client = StorageClient(cl.meta_client, client_manager=cl.cm)
+        return cl, sid, client, sm
+
+    def test_scatter_gather_roundtrip(self):
+        cl, sid, client, sm = self.make_cluster()
+        tid = sm.to_tag_id(sid, "player").value()
+        et = sm.to_edge_type(sid, "follow").value()
+        verts = [{"id": i, "tags": [[tid, encode_row(PLAYER,
+                  {"name": f"p{i}", "age": 20 + i})]]} for i in range(20)]
+        edges = [{"src": i, "etype": et, "rank": 0, "dst": (i + 1) % 20,
+                  "props": encode_row(FOLLOW, {"degree": i})}
+                 for i in range(20)]
+        r1 = client.add_vertices(sid, verts)
+        assert r1.succeeded(), r1.failed_parts
+        r2 = client.add_edges(sid, edges)
+        assert r2.succeeded()
+
+        resp = client.get_neighbors(sid, list(range(20)), [et],
+                                    edge_props={et: ["degree"]})
+        assert resp.succeeded()
+        assert resp.completeness() == 100
+        all_dsts = set()
+        for r in resp.responses:
+            for v in r["vertices"]:
+                schema = schema_from_wire(r["edge_schemas"][et])
+                for raw in RowSetReader(v["edges"][et]):
+                    all_dsts.add(RowReader(raw, schema).get("_dst"))
+        assert all_dsts == set(range(20))
+
+    def test_failed_part_tracking(self):
+        cl, sid, client, sm = self.make_cluster()
+        # point one part's leader at a dead host
+        client.update_leader(sid, 1, "127.0.0.1:1")  # nothing listens
+        et = sm.to_edge_type(sid, "follow").value()
+        vids = list(range(20))  # covers all parts
+        resp = client.get_neighbors(sid, vids, [et])
+        assert not resp.succeeded()
+        assert 1 in resp.failed_parts
+        assert resp.completeness() < 100
+        # leader cache invalidated -> next call heals
+        resp2 = client.get_neighbors(sid, vids, [et])
+        assert resp2.succeeded()
