@@ -192,11 +192,15 @@ class RpcChannel:
                         sock.close()
                     except OSError:
                         pass
-                if pooled:
-                    # An idle keep-alive connection failing (on write OR
-                    # read) overwhelmingly means the server closed it while
-                    # idle — the request never executed. Flush the rest of
-                    # the (equally stale) pool and retry on a FRESH socket.
+                if pooled and not isinstance(e, TimeoutError):
+                    # An idle keep-alive connection failing on write or
+                    # with an immediate EOF overwhelmingly means the server
+                    # closed it while idle — the request never executed.
+                    # Flush the rest of the (equally stale) pool and retry
+                    # on a FRESH socket. A read TIMEOUT is different: the
+                    # server is alive but slow and may still execute the
+                    # request — resending would duplicate non-idempotent
+                    # ops, so fall through to the no-retry error path.
                     self.close()
                     continue
                 # Fresh-connection failure after send: the server may have

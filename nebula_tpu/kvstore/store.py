@@ -14,6 +14,7 @@ single-replica — the mode metad's own store and unit tests use.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -47,8 +48,25 @@ class NebulaStore:
         self.local_host = local_host
         self.raft_service = raft_service
         self.spaces: Dict[GraphSpaceID, SpaceData] = {}
+        # per-space committed-write counter — the TPU runtime's CSR mirror
+        # staleness check (tpu/runtime.py) compares this to its build
+        # snapshot. Bumped from each Part's committed-batch listener (the
+        # seam part.py documents for exactly this), so it advances only
+        # AFTER a batch is applied to the engine — leader or follower,
+        # raft or single-replica — never on submit or on rejected writes.
+        self.mutation_versions: Dict[GraphSpaceID, int] = {}
+        self._version_lock = threading.Lock()
         if options.part_man is not None:
             options.part_man.register_handler(self)
+
+    def _bump(self, space_id: GraphSpaceID) -> None:
+        with self._version_lock:
+            self.mutation_versions[space_id] = \
+                self.mutation_versions.get(space_id, 0) + 1
+
+    def mutation_version(self, space_id: GraphSpaceID) -> int:
+        with self._version_lock:
+            return self.mutation_versions.get(space_id, 0)
 
     def init(self) -> None:
         """Adopt parts the PartManager says belong to this host
@@ -98,7 +116,12 @@ class NebulaStore:
         raft = None
         if self.raft_service is not None:
             raft = self.raft_service.add_part(space_id, part_id, peers or [])
-        sd.parts[part_id] = Part(space_id, part_id, engine, raft=raft)
+        part = Part(space_id, part_id, engine, raft=raft)
+        # committed-batch listener: advance the space's mutation version
+        # only once the batch hit the engine (see __init__ comment)
+        part.listeners.append(
+            lambda _p, _logs, _sid=space_id: self._bump(_sid))
+        sd.parts[part_id] = part
 
     def remove_space(self, space_id: GraphSpaceID) -> None:
         sd = self.spaces.pop(space_id, None)
@@ -196,6 +219,9 @@ class NebulaStore:
             return Status.SpaceNotFound(f"space {space_id}")
         for e in sd.engines:
             e.compact()
+        # compaction filters drop TTL-expired/orphaned rows directly on
+        # the engines, bypassing Part — invalidate mirrors explicitly
+        self._bump(space_id)
         return Status.OK()
 
     def flush(self, space_id: GraphSpaceID, path_prefix: str) -> Status:
@@ -228,4 +254,5 @@ class NebulaStore:
                 st = e.ingest(path)
                 if not st.ok():
                     return st
+        self._bump(space_id)   # ingest loads keys engine-side, not via Part
         return Status.OK()

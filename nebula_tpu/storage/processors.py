@@ -90,6 +90,28 @@ class QueryBaseProcessor:
         self.schema_man = schema_man
         self.executor = executor
 
+    # ---- version-resolving readers -----------------------------------
+    # Rows embed the schema version they were written with; decoding with
+    # the newest schema after ALTER ... CHANGE/DROP walks wrong offsets
+    # (reference resolves via RowReader::getTagPropReader + SchemaManager,
+    # RowReader.h:76-151). Fall back to `newest` only when meta has
+    # already purged the old version.
+    def tag_reader(self, space_id: int, tag_id: int, val: bytes,
+                   newest: Schema) -> RowReader:
+        ver = RowReader.schema_version_of(val)
+        if ver == newest.version:
+            return RowReader(val, newest)
+        sch = self.schema_man.get_tag_schema(space_id, tag_id, ver)
+        return RowReader(val, sch if sch is not None else newest)
+
+    def edge_reader(self, space_id: int, etype: int, val: bytes,
+                    newest: Schema) -> RowReader:
+        ver = RowReader.schema_version_of(val)
+        if ver == newest.version:
+            return RowReader(val, newest)
+        sch = self.schema_man.get_edge_schema(space_id, abs(etype), ver)
+        return RowReader(val, sch if sch is not None else newest)
+
     # ---- contexts ----------------------------------------------------
     def build_tag_contexts(self, space_id: int,
                            vertex_props: List[List]) -> List[_TagContext]:
@@ -156,8 +178,9 @@ class QueryBaseProcessor:
         for tc in tcs:
             prefix = KeyUtils.vertex_prefix(part, vid, tc.tag_id)
             for key, val in self.kv.prefix(space_id, part, prefix):
-                reader = RowReader(val, tc.schema)
-                if _ttl_expired(reader, tc.schema):
+                reader = self.tag_reader(space_id, tc.tag_id, val,
+                                         tc.schema)
+                if _ttl_expired(reader, reader.schema):
                     break
                 for p in tc.props:
                     values[p] = reader.get(p)
@@ -264,8 +287,8 @@ class QueryBoundProcessor(QueryBaseProcessor):
                 if last_dedup == (rank, dst):
                     continue  # older version of same edge
                 last_dedup = (rank, dst)
-                reader = RowReader(val, schema)
-                if _ttl_expired(reader, schema):
+                reader = self.edge_reader(space_id, et, val, schema)
+                if _ttl_expired(reader, reader.schema):
                     continue
                 if filter_expr is not None:
                     edge_row.clear()
@@ -364,8 +387,8 @@ class QueryEdgePropsProcessor(QueryBaseProcessor):
                 prefix = KeyUtils.edge_prefix(part, int(src), etype, int(rank),
                                               int(dst))
                 for key, val in self.kv.prefix(space_id, part, prefix):
-                    reader = RowReader(val, schema)
-                    if _ttl_expired(reader, schema):
+                    reader = self.edge_reader(space_id, etype, val, schema)
+                    if _ttl_expired(reader, reader.schema):
                         break
                     vals = {"_src": int(src), "_dst": int(dst),
                             "_rank": int(rank), "_type": etype}
@@ -414,7 +437,7 @@ class QueryStatsProcessor(QueryBaseProcessor):
                             continue
                         last_dedup = (rank, dst)
                         degree += 1
-                        reader = RowReader(val, schema)
+                        reader = self.edge_reader(space_id, et, val, schema)
                         for alias, (target_et, prop) in stat_props.items():
                             if target_et == et and schema.field_index(prop) >= 0:
                                 v = reader.get(prop)

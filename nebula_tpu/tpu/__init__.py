@@ -1,0 +1,16 @@
+"""TPU traversal backend — the device-resident storage mirror and query
+kernels (the project's north star, BASELINE.json).
+
+The reference executes multi-hop GO as one RPC round trip per hop with
+host-side set dedup (GoExecutor.cpp:377-431, QueryBaseProcessor.inl
+prefix scans).  Here the whole loop runs on-device: each graph space's
+edge partitions are folded into an HBM-resident CSR mirror (csr.py), the
+pushed filter expression tree is compiled to vectorized XLA ops
+(expr_compile.py), and frontier expansion is a jitted edge-parallel BFS
+(kernels.py) — optionally sharded over a jax.sharding.Mesh with psum
+frontier merges riding ICI.  TpuQueryRuntime (runtime.py) plugs into the
+graphd executor seam (graph/executors/traverse.py).
+"""
+from .runtime import TpuQueryRuntime
+
+__all__ = ["TpuQueryRuntime"]

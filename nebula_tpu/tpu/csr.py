@@ -1,0 +1,390 @@
+"""CSR mirror — fold a space's edge/vertex KV partitions into device arrays.
+
+The storage key encoding is order-preserving (common/keys.py), so a plain
+range scan over each partition already yields edges in
+(src, etype, rank, dst, version) order.  Building CSR is therefore one
+merge pass with multi-version "first wins" dedup (the reference dedups the
+same way while scanning RocksDB — QueryBaseProcessor.inl:352-361).
+
+Everything the device needs is re-encoded into **order-preserving dense
+spaces** so the whole query runs in int32/float32:
+
+  * vertex ids  → dense indices into the sorted ``vids`` array.  Sorted
+    order means dense-index comparisons equal vid comparisons, so filter
+    literals translate via searchsorted.
+  * strings     → codes into a sorted per-column dictionary; the sort makes
+    codes order-preserving too, so ==/!=/</> all compile.
+  * int columns → int32 when the value range fits, else float32 when
+    exactly representable, else the column is marked uncompilable and the
+    runtime falls back to the CPU path for filters touching it.
+
+Host numpy mirrors of every column are kept for result materialization
+(the device returns bool masks; the host gathers rows with fancy
+indexing — no per-row Python in the hot path).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import time
+
+from ..codec.rows import RowReader
+from ..common.keys import KeyUtils
+from ..interface.common import Schema, SupportedType
+
+
+def _now_s() -> float:
+    return time.time()
+
+
+def _ttl_expiry(reader: RowReader):
+    """Absolute expiry time (seconds) of a row under its schema's TTL, or
+    None when the schema has no TTL / the column is unusable (same
+    semantics as processors._ttl_expired, which mirrors the reference's
+    compaction-filter + read-skip TTL handling)."""
+    prop = reader.schema.schema_prop
+    if not prop.ttl_col or not prop.ttl_duration:
+        return None
+    try:
+        base = reader.get(prop.ttl_col)
+    except KeyError:
+        return None
+    if not isinstance(base, (int, float)) or isinstance(base, bool):
+        return None
+    return base + prop.ttl_duration
+
+
+class Column:
+    """One columnar property: numeric array or dictionary-encoded strings.
+
+    ``values`` is aligned to the edge array (edge props) or to the dense
+    vertex array (tag props).  ``valid`` marks rows that actually carry the
+    column (a vertex may lack the tag; an edge row written under an older
+    schema version may miss appended columns).
+    """
+
+    __slots__ = ("name", "stype", "values", "valid", "dictionary",
+                 "device_ok", "raw")
+
+    def __init__(self, name: str, stype: SupportedType, size: int):
+        self.name = name
+        self.stype = stype
+        self.valid = np.zeros(size, dtype=bool)
+        self.dictionary: Optional[np.ndarray] = None  # sorted unique strings
+        self.device_ok = True
+        self.raw: Optional[list] = None
+        if stype == SupportedType.STRING:
+            self.raw = [""] * size          # filled then dict-encoded
+            self.values = None
+        elif stype in (SupportedType.FLOAT, SupportedType.DOUBLE):
+            self.values = np.zeros(size, dtype=np.float64)
+        elif stype == SupportedType.BOOL:
+            self.values = np.zeros(size, dtype=bool)
+        else:  # INT / VID / TIMESTAMP
+            self.values = np.zeros(size, dtype=np.int64)
+
+    def finalize(self) -> None:
+        """Dictionary-encode strings; decide device representability."""
+        if self.stype == SupportedType.STRING:
+            arr = np.asarray(self.raw, dtype=object)
+            self.dictionary, codes = np.unique(
+                arr.astype(str), return_inverse=True)
+            self.values = codes.astype(np.int32)
+            self.raw = arr
+            return
+        if self.values.dtype == np.int64 and len(self.values):
+            lo, hi = int(self.values.min()), int(self.values.max())
+            if not (-2**31 < lo and hi < 2**31):
+                # exactly representable in float32?
+                as32 = self.values.astype(np.float32)
+                self.device_ok = bool(
+                    np.array_equal(as32.astype(np.int64), self.values))
+        elif self.values.dtype == np.float64 and len(self.values):
+            # device compares in float32; only allow columns whose values
+            # round-trip exactly, else CPU-float64 vs device-float32
+            # comparisons could disagree at the boundary
+            as32 = self.values.astype(np.float32)
+            self.device_ok = bool(np.array_equal(
+                as32.astype(np.float64), self.values, equal_nan=True))
+
+    def device_values(self):
+        """int32/float32/bool view for the device (codes for strings)."""
+        if self.stype == SupportedType.STRING:
+            return self.values                      # int32 codes
+        if self.values.dtype == np.int64:
+            lo = int(self.values.min()) if len(self.values) else 0
+            hi = int(self.values.max()) if len(self.values) else 0
+            if -2**31 < lo and hi < 2**31:
+                return self.values.astype(np.int32)
+            return self.values.astype(np.float32)
+        if self.values.dtype == np.float64:
+            return self.values.astype(np.float32)
+        return self.values
+
+    def host_value(self, i: int):
+        """Python value at row i (for result rows)."""
+        if self.stype == SupportedType.STRING:
+            return str(self.raw[i])
+        v = self.values[i]
+        if self.stype == SupportedType.BOOL:
+            return bool(v)
+        if self.values.dtype == np.float64:
+            return float(v)
+        return int(v)
+
+
+class CsrMirror:
+    """Per-space CSR + columnar property store.
+
+    Edge arrays are sorted by (src_dense, etype, rank, dst) — the KV scan
+    order — and carry BOTH directions (the mutate executors write the
+    reverse edge under -etype, mirroring the reference), so
+    ``GO ... REVERSELY`` is just an etype-sign flip.
+    """
+
+    def __init__(self, space_id: int):
+        self.space_id = space_id
+        # dense vertex space
+        self.vids = np.zeros(0, dtype=np.int64)       # sorted unique
+        self.n = 0
+        # edge arrays (length m)
+        self.m = 0
+        self.edge_src = np.zeros(0, dtype=np.int32)   # dense idx
+        self.edge_dst = np.zeros(0, dtype=np.int32)   # dense idx
+        self.edge_etype = np.zeros(0, dtype=np.int32) # signed etype
+        self.edge_rank = np.zeros(0, dtype=np.int64)
+        self.row_ptr = np.zeros(1, dtype=np.int32)
+        # (etype, prop) -> Column aligned to edge arrays
+        self.edge_cols: Dict[Tuple[int, str], Column] = {}
+        # (tag_id, prop) -> Column aligned to dense vertex array
+        self.vertex_cols: Dict[Tuple[int, str], Column] = {}
+        # tag presence: tag_id -> bool[n]
+        self.has_tag: Dict[int, np.ndarray] = {}
+        self.build_version = -1
+        self._device = None   # populated lazily by runtime/kernels
+        # earliest future TTL expiry among mirrored rows (seconds), or
+        # None; the runtime rebuilds once this passes so aging rows drop
+        # out in lockstep with the CPU read path
+        self.expires_at_s = None
+
+    def note_expiry(self, exp_s: float) -> None:
+        if self.expires_at_s is None or exp_s < self.expires_at_s:
+            self.expires_at_s = exp_s
+
+    def expired_now(self) -> bool:
+        return self.expires_at_s is not None and _now_s() >= self.expires_at_s
+
+    # ---- lookups -----------------------------------------------------
+    def to_dense(self, vids) -> np.ndarray:
+        """vid values -> dense indices (-1 when absent)."""
+        a = np.asarray(vids, dtype=np.int64)
+        pos = np.searchsorted(self.vids, a)
+        pos = np.clip(pos, 0, max(self.n - 1, 0))
+        ok = (self.n > 0) & (self.vids[pos] == a) if self.n else \
+            np.zeros(len(a), dtype=bool)
+        return np.where(ok, pos, -1).astype(np.int32)
+
+    def vid_rank(self, vid: int) -> int:
+        """searchsorted position — order-preserving literal translation."""
+        return int(np.searchsorted(self.vids, np.int64(vid)))
+
+    def has_vid(self, vid: int) -> bool:
+        p = self.vid_rank(vid)
+        return p < self.n and int(self.vids[p]) == vid
+
+
+def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:
+    """Scan every part of ``space_id`` across the given NebulaStores and
+    fold the KV ranges into a CsrMirror.
+
+    ``stores`` — list of kvstore.store.NebulaStore (one per storage node;
+    in-process the runtime sees them all — this is the storaged-side
+    "CSR mirror fold" of SURVEY.md §7 step 5 run centrally).
+    """
+    sm = schema_man
+    edge_schema_cache: Dict[Tuple[int, int], Optional[Schema]] = {}
+    tag_schema_cache: Dict[Tuple[int, int], Optional[Schema]] = {}
+
+    def edge_schema(etype: int, ver: int) -> Optional[Schema]:
+        key = (etype, ver)
+        if key not in edge_schema_cache:
+            edge_schema_cache[key] = sm.get_edge_schema(
+                space_id, abs(etype), ver)
+        return edge_schema_cache[key]
+
+    def tag_schema(tag_id: int, ver: int) -> Optional[Schema]:
+        key = (tag_id, ver)
+        if key not in tag_schema_cache:
+            tag_schema_cache[key] = sm.get_tag_schema(space_id, tag_id, ver)
+        return tag_schema_cache[key]
+
+    # ---- pass 1: scan KV, dedup multi-version, collect raw tuples ----
+    # keys sort latest-version-first within (rank, dst) / (vid, tag), so
+    # dedup is "first wins" in scan order.
+    edges: List[Tuple[int, int, int, int, bytes]] = []  # src,etype,rank,dst,val
+    verts: List[Tuple[int, int, bytes]] = []            # vid,tag,val
+    seen_edge_prev: Optional[Tuple[int, int, int, int]] = None
+    seen_vert_prev: Optional[Tuple[int, int]] = None
+    for store in stores:
+        for part in sorted(store.part_ids(space_id)):
+            p = store.part(space_id, part)
+            if p is None or not p.is_leader():
+                continue
+            seen_edge_prev = seen_vert_prev = None
+            for key, val in store.prefix(space_id, part,
+                                         KeyUtils.part_prefix(part)):
+                if KeyUtils.is_edge(key):
+                    _, src, et, rank, dst, _ = KeyUtils.parse_edge(key)
+                    ident = (src, et, rank, dst)
+                    if ident == seen_edge_prev:
+                        continue          # older version of same edge
+                    seen_edge_prev = ident
+                    edges.append((src, et, rank, dst, val))
+                elif KeyUtils.is_vertex(key):
+                    _, vid, tag, _ = KeyUtils.parse_vertex(key)
+                    ident = (vid, tag)
+                    if ident == seen_vert_prev:
+                        continue
+                    seen_vert_prev = ident
+                    verts.append((vid, tag, val))
+
+    mirror = CsrMirror(space_id)
+
+    # ---- dense vertex space ------------------------------------------
+    vid_parts = [np.asarray([v for v, _, _ in verts], dtype=np.int64)]
+    if edges:
+        e_src = np.asarray([e[0] for e in edges], dtype=np.int64)
+        e_dst = np.asarray([e[3] for e in edges], dtype=np.int64)
+        vid_parts += [e_src, e_dst]
+    all_vids = np.concatenate(vid_parts) if vid_parts else \
+        np.zeros(0, dtype=np.int64)
+    mirror.vids = np.unique(all_vids)
+    mirror.n = len(mirror.vids)
+    n = mirror.n
+
+    # ---- edge arrays (sort by (src_dense, etype, rank, dst)) ---------
+    m = len(edges)
+    mirror.m = m
+    if m:
+        src_d = np.searchsorted(mirror.vids, e_src).astype(np.int32)
+        dst_d = np.searchsorted(mirror.vids, e_dst).astype(np.int32)
+        etype_a = np.asarray([e[1] for e in edges], dtype=np.int32)
+        rank_a = np.asarray([e[2] for e in edges], dtype=np.int64)
+        order = np.lexsort((dst_d, rank_a, etype_a, src_d))
+        mirror.edge_src = src_d[order]
+        mirror.edge_dst = dst_d[order]
+        mirror.edge_etype = etype_a[order]
+        mirror.edge_rank = rank_a[order]
+
+        # ---- edge prop columns ---------------------------------------
+        etypes_present = np.unique(mirror.edge_etype)
+        cols: Dict[Tuple[int, str], Column] = {}
+        for et in etypes_present.tolist():
+            schema = edge_schema(et, -1)
+            if schema is None:
+                continue
+            for col in schema.columns:
+                cols[(et, col.name)] = Column(col.name, col.type, m)
+        vals_in_order = [edges[i][4] for i in order]
+        et_in_order = mirror.edge_etype
+        keep = np.ones(m, dtype=bool)
+        for i, blob in enumerate(vals_in_order):
+            et = int(et_in_order[i])
+            if not blob:
+                continue
+            try:
+                reader = RowReader.from_resolver(
+                    blob, lambda ver, _et=et: edge_schema(_et, ver))
+            except KeyError:
+                continue
+            # TTL parity: the CPU read path skips expired rows
+            # (processors._ttl_expired); expired edges must not traverse
+            exp = _ttl_expiry(reader)
+            if exp is not None:
+                if exp < _now_s():
+                    keep[i] = False
+                    continue
+                mirror.note_expiry(exp)
+            for cname in reader.schema.names():
+                c = cols.get((et, cname))
+                if c is None:
+                    continue
+                try:
+                    v = reader.get(cname)
+                except KeyError:
+                    continue
+                if c.raw is not None:
+                    c.raw[i] = v if isinstance(v, str) else str(v)
+                else:
+                    c.values[i] = v
+                c.valid[i] = True
+        if not keep.all():
+            mirror.edge_src = mirror.edge_src[keep]
+            mirror.edge_dst = mirror.edge_dst[keep]
+            mirror.edge_etype = mirror.edge_etype[keep]
+            mirror.edge_rank = mirror.edge_rank[keep]
+            kept_idx = np.nonzero(keep)[0]
+            for c in cols.values():
+                c.valid = c.valid[keep]
+                if c.raw is not None:
+                    c.raw = [c.raw[j] for j in kept_idx]
+                else:
+                    c.values = c.values[keep]
+            m = len(mirror.edge_src)
+            mirror.m = m
+        for c in cols.values():
+            c.finalize()
+        mirror.edge_cols = cols
+        counts = np.bincount(mirror.edge_src, minlength=n)
+        mirror.row_ptr = np.concatenate(
+            [[0], np.cumsum(counts)]).astype(np.int32)
+    else:
+        mirror.row_ptr = np.zeros(n + 1, dtype=np.int32)
+
+    # ---- vertex (tag) prop columns -----------------------------------
+    vcols: Dict[Tuple[int, str], Column] = {}
+    tag_ids = sorted({t for _, t, _ in verts})
+    for t in tag_ids:
+        schema = tag_schema(t, -1)
+        if schema is None:
+            continue
+        for col in schema.columns:
+            vcols[(t, col.name)] = Column(col.name, col.type, n)
+        mirror.has_tag[t] = np.zeros(n, dtype=bool)
+    for vid, t, blob in verts:
+        di = int(np.searchsorted(mirror.vids, np.int64(vid)))
+        if not blob:
+            if t in mirror.has_tag:
+                mirror.has_tag[t][di] = True
+            continue
+        try:
+            reader = RowReader.from_resolver(
+                blob, lambda ver, _t=t: tag_schema(_t, ver))
+        except KeyError:
+            continue
+        exp = _ttl_expiry(reader)
+        if exp is not None:
+            if exp < _now_s():
+                continue    # expired tag row: CPU path treats it as absent
+            mirror.note_expiry(exp)
+        if t in mirror.has_tag:
+            mirror.has_tag[t][di] = True
+        for cname in reader.schema.names():
+            c = vcols.get((t, cname))
+            if c is None:
+                continue
+            try:
+                v = reader.get(cname)
+            except KeyError:
+                continue
+            if c.raw is not None:
+                c.raw[di] = v if isinstance(v, str) else str(v)
+            else:
+                c.values[di] = v
+            c.valid[di] = True
+    for c in vcols.values():
+        c.finalize()
+    mirror.vertex_cols = vcols
+    return mirror

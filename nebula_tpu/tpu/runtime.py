@@ -1,0 +1,592 @@
+"""TpuQueryRuntime — the device-side storage backend behind graphd's
+executor seam (BASELINE.json north star).
+
+The reference runs a multi-hop GO as one storaged RPC fan-out per hop
+plus graphd-side set dedup, and an extra RPC wave for $$-props
+(GoExecutor.cpp:334-431, 531-569).  This runtime answers the same
+executor calls from an HBM-resident CSR mirror instead: the full hop
+loop, the WHERE filter (including $$ refs — no second wave), and the
+frontier dedup all run inside one jitted XLA program; the host only
+materializes the selected result rows from numpy column mirrors.
+
+Fallback contract: ``can_run_go``/``can_run_path`` decline anything the
+device can't reproduce bit-for-bit (per-root $-/$var inputs, expressions
+the compiler rejects, columns too wide for int32/float32) — graphd's CPU
+path then executes the query, exactly like the reference's
+CPU-storaged path.  One flagship rule: whatever both paths can run must
+return identical result sets (tests/test_tpu_backend.py asserts this).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.flags import flags
+from ..common.status import ErrorCode
+from ..filter.expressions import ExprContext, ExprError, Expression
+from ..graph.interim import InterimResult
+from .csr import CsrMirror, build_mirror
+from .expr_compile import (CompileError, CVal, Env, ExprCompiler, K_BOOL,
+                           K_FLOAT, K_INT, K_STR, K_STRCODE, K_VIDRANK)
+from . import kernels
+
+
+class _GoPlan:
+    """Prepared per-query state handed from can_run_go to run_go."""
+
+    __slots__ = ("mirror", "alias_to_etype", "filter_cval", "filter_used",
+                 "pushed_mode", "compiler", "expr_str")
+
+    def __init__(self, mirror, alias_to_etype, filter_cval, filter_used,
+                 pushed_mode, compiler, expr_str):
+        self.mirror = mirror
+        self.alias_to_etype = alias_to_etype
+        self.filter_cval = filter_cval
+        self.filter_used = filter_used      # dict key -> descriptor
+        self.pushed_mode = pushed_mode      # True: skip-invalid (storage
+        self.compiler = compiler            # semantics); False: raise
+        self.expr_str = expr_str            # canonical WHERE text (cache key)
+
+
+def _pad_pow2(arr: np.ndarray, fill=-1, min_size: int = 8) -> np.ndarray:
+    size = max(min_size, 1 << (max(len(arr), 1) - 1).bit_length())
+    return kernels.pad_to(arr, size, fill)
+
+
+class TpuQueryRuntime:
+    def __init__(self, storage_nodes, schema_man):
+        # storage_nodes: objects with .kv (NebulaStore); the runtime is the
+        # in-process equivalent of a TpuStorageServiceHandler fleet.
+        self.stores = [n.kv for n in storage_nodes]
+        self.sm = schema_man
+        self.mirrors: Dict[int, CsrMirror] = {}
+        self._plans: Dict[int, _GoPlan] = {}
+        self._kernels: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+        # observability (tests assert the device path actually ran;
+        # webservice /get_stats exports these)
+        self.stats = {"go_device": 0, "path_device": 0, "mirror_builds": 0}
+
+    # ================================================== mirror lifecycle
+    def _space_version(self, space_id: int) -> int:
+        v = 0
+        for s in self.stores:
+            v += s.mutation_version(space_id)
+            v += 7919 * len(s.part_ids(space_id))
+        return v
+
+    def mirror(self, space_id: int) -> Optional[CsrMirror]:
+        ver = self._space_version(space_id)
+        with self._lock:
+            m = self.mirrors.get(space_id)
+            if m is not None and m.build_version == ver \
+                    and not m.expired_now():
+                return m
+            m = build_mirror(space_id, self.stores, self.sm)
+            m.build_version = ver
+            self.stats["mirror_builds"] += 1
+            m._device = self._to_device(m)
+            self.mirrors[space_id] = m
+            # CSR changed: every cached kernel for this space is stale
+            self._kernels = {k: v for k, v in self._kernels.items()
+                             if k[0] != space_id}
+            return m
+
+    @staticmethod
+    def _to_device(m: CsrMirror) -> Dict[str, object]:
+        import jax.numpy as jnp
+        dev = {
+            "edge_src": jnp.asarray(m.edge_src),
+            "edge_dst": jnp.asarray(m.edge_dst),
+            "edge_etype": jnp.asarray(m.edge_etype),
+        }
+        # rank device copy when int32-representable
+        if m.m == 0 or (m.edge_rank.min() > -2**31 and
+                        m.edge_rank.max() < 2**31):
+            dev["rank"] = jnp.asarray(m.edge_rank.astype(np.int32))
+        else:
+            dev["rank"] = None
+        return dev
+
+    # ================================================== GO
+    def can_run_go(self, space_id: int, etypes: List[int], sentence,
+                   pushed: Optional[bytes], remnant: Optional[Expression],
+                   src_refs, dst_refs, has_input: bool) -> bool:
+        if flags.get("storage_backend") == "cpu":
+            return False
+        if has_input:
+            return False
+        try:
+            m = self.mirror(space_id)
+        except Exception:
+            return False
+        # alias map (same resolution GoExecutor did)
+        alias_to_etype: Dict[str, int] = {}
+        s = sentence
+        if s.over.is_all:
+            for et in self.sm.all_edge_types(space_id):
+                name = self.sm.edge_name(space_id, et)
+                alias_to_etype[name] = -et if s.over.reversely else et
+        else:
+            for oe in s.over.edges:
+                r = self.sm.to_edge_type(space_id, oe.edge)
+                if not r.ok():
+                    return False
+                alias_to_etype[oe.alias or oe.edge] = \
+                    -r.value() if s.over.reversely else r.value()
+
+        where_expr = s.where.filter if s.where else None
+        filter_cval = None
+        filter_used: Dict[str, Tuple] = {}
+        compiler = ExprCompiler(m, space_id, self.sm, alias_to_etype)
+        if where_expr is not None:
+            try:
+                filter_cval = compiler.compile(where_expr)
+            except CompileError:
+                return False
+            filter_used = dict(compiler.used)
+            if "rank" in filter_used and m._device.get("rank") is None:
+                return False
+            if compiler.div_guards and pushed is None:
+                # graphd-side WHERE raises ExprError on a real x/0; the
+                # device can't raise mid-jit — let the CPU path run it
+                return False
+        self._plans[id(sentence)] = _GoPlan(
+            m, alias_to_etype, filter_cval, filter_used,
+            pushed_mode=(pushed is not None), compiler=compiler,
+            expr_str=(str(where_expr) if where_expr is not None else None))
+        return True
+
+    def run_go(self, executor, space_id: int, start_vids: List[int],
+               etypes: List[int], steps: int, etype_to_alias: Dict[int, str],
+               yield_cols, distinct: bool, where_expr,
+               edge_props, vertex_props) -> InterimResult:
+        from ..graph.executors.base import ExecError
+
+        s = executor.sentence
+        plan = self._plans.pop(id(s), None)
+        if plan is None:   # defensive: re-prepare
+            raise ExecError("TPU plan missing (can_run_go not called)")
+        m = plan.mirror
+        columns = [c.alias or _default_col_name(c.expr) for c in yield_cols]
+        if steps < 1 or not start_vids or m.m == 0:
+            return InterimResult(columns)
+
+        et_tuple = tuple(sorted(set(etypes)))
+        start_idx = m.to_dense(start_vids)
+        start_idx = _pad_pow2(start_idx)
+        self.stats["go_device"] += 1
+
+        final_mask, frontier = self._run_go_kernel(
+            m, space_id, steps, et_tuple, plan, start_idx)
+
+        final_mask = np.asarray(final_mask)
+        frontier = np.asarray(frontier)
+
+        # candidate edges of the final hop (pre-filter) — parity checks
+        etype_ok = np.isin(m.edge_etype, np.asarray(et_tuple, dtype=np.int32))
+        candidates = frontier[m.edge_src] & etype_ok
+
+        if plan.filter_cval is not None and not plan.pushed_mode:
+            # graphd-side WHERE raises on per-row missing props
+            self._check_valid(m, plan.filter_used, candidates, ExecError)
+
+        idx = np.nonzero(final_mask)[0]
+        rows = self._materialize(m, space_id, plan.alias_to_etype,
+                                 etype_to_alias, yield_cols, idx, ExecError)
+        if distinct:
+            seen = set()
+            out = []
+            for r in rows:
+                key = tuple(r)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(r)
+            rows = out
+        return InterimResult(columns, rows)
+
+    # -------------------------------------------------- kernel dispatch
+    def _run_go_kernel(self, m: CsrMirror, space_id: int, steps: int,
+                       et_tuple: Tuple[int, ...], plan: _GoPlan,
+                       start_idx: np.ndarray):
+        import jax.numpy as jnp
+        dev = m._device
+        filt = plan.filter_cval
+        key = (space_id, m.build_version, steps, et_tuple,
+               plan.pushed_mode, plan.expr_str, len(start_idx))
+        kern = self._kernels.get(key)
+
+        if filt is None:
+            if kern is None:
+                kern = kernels.make_go_kernel(m.n, steps, et_tuple)
+                self._kernels[key] = kern
+            return kern(dev["edge_src"], dev["edge_dst"], dev["edge_etype"],
+                        jnp.asarray(start_idx))
+
+        # device filter: assemble env columns (full-length, edge- or
+        # vertex-aligned) + validity arrays for pushed (skip) semantics
+        env_cols = self._env_cols(m, plan.alias_to_etype, plan.filter_used,
+                                  with_valid=plan.pushed_mode)
+
+        if kern is None:
+            used = dict(plan.filter_used)
+            cval = filt
+            pushed = plan.pushed_mode
+            guards = list(plan.compiler.div_guards)
+
+            def filter_fn(edge_src, edge_dst, raw):
+                cols = {}
+                for k2, desc2 in used.items():
+                    if desc2[0] == "vertex":
+                        arr = raw[k2]
+                        cols[k2] = arr[edge_src] if desc2[3] == "src" \
+                            else arr[edge_dst]
+                    elif desc2[0] in ("edge", "rank", "etype_alias"):
+                        cols[k2] = raw[k2]
+                    elif desc2[0] == "src_idx":
+                        cols[k2] = edge_src
+                    elif desc2[0] == "dst_idx":
+                        cols[k2] = edge_dst
+                env = Env(jnp, cols)
+                mask = cval.fn(env)
+                mask = jnp.broadcast_to(mask, edge_src.shape)
+                # x/0 raises ExprError on the CPU path; in pushed mode
+                # that drops the row (can_run_go declines remnant mode)
+                for g in guards:
+                    mask = mask & jnp.logical_not(
+                        jnp.broadcast_to(g(env), edge_src.shape))
+                if pushed:
+                    for vk, varr in raw.items():
+                        if not vk.startswith("valid:"):
+                            continue
+                        k2 = vk[6:]
+                        desc2 = used[k2]
+                        if desc2[0] == "edge":
+                            mask = mask & varr
+                        elif desc2[0] == "vertex":
+                            mask = mask & (varr[edge_src]
+                                           if desc2[3] == "src"
+                                           else varr[edge_dst])
+                return mask
+
+            kern = kernels.make_go_filtered_kernel(
+                m.n, steps, et_tuple, filter_fn)
+            self._kernels[key] = kern
+        return kern(dev["edge_src"], dev["edge_dst"], dev["edge_etype"],
+                    jnp.asarray(start_idx), env_cols)
+
+    def _env_cols(self, m: CsrMirror, alias_to_etype: Dict[str, int],
+                  used: Dict[str, Tuple], with_valid: bool) -> Dict:
+        """Device env for a compiled filter: {key: array} (+"valid:key")."""
+        import jax.numpy as jnp
+        env: Dict[str, object] = {}
+        for k, desc in used.items():
+            if desc[0] == "edge":
+                col = m.edge_cols[(desc[1], desc[2])]
+                env[k] = jnp.asarray(col.device_values())
+                if with_valid:
+                    env["valid:" + k] = jnp.asarray(col.valid)
+            elif desc[0] == "vertex":
+                col = m.vertex_cols[(desc[1], desc[2])]
+                env[k] = jnp.asarray(col.device_values())
+                if with_valid:
+                    env["valid:" + k] = jnp.asarray(col.valid)
+            elif desc[0] == "rank":
+                env["rank"] = m._device["rank"]
+            elif desc[0] == "etype_alias":
+                env["etype_alias"] = jnp.asarray(
+                    self._etype_alias_codes(m, alias_to_etype))
+        return env
+
+    @staticmethod
+    def _etype_alias_codes(m: CsrMirror,
+                           alias_to_etype: Dict[str, int]) -> np.ndarray:
+        """int32[m]: per-edge code into the sorted alias dictionary."""
+        alias_pos = {a: i for i, a in enumerate(sorted(alias_to_etype))}
+        et_to_code = {et: alias_pos[a] for a, et in alias_to_etype.items()}
+        codes = np.zeros(m.m, dtype=np.int32)
+        for et, code in et_to_code.items():
+            codes[m.edge_etype == et] = code
+        return codes
+
+    # -------------------------------------------------- validity parity
+    @staticmethod
+    def _check_valid(m: CsrMirror, used: Dict[str, Tuple],
+                     candidates: np.ndarray, exc_type) -> None:
+        for k, desc in used.items():
+            if desc[0] == "edge":
+                col = m.edge_cols[(desc[1], desc[2])]
+                bad = candidates & ~col.valid
+                if bad.any():
+                    raise exc_type(f"{desc[2]} unavailable")
+            elif desc[0] == "vertex":
+                col = m.vertex_cols[(desc[1], desc[2])]
+                gather = m.edge_src if desc[3] == "src" else m.edge_dst
+                bad = candidates & ~col.valid[gather]
+                if bad.any():
+                    raise exc_type(f"{desc[2]} unavailable")
+
+    # -------------------------------------------------- materialization
+    def _materialize(self, m: CsrMirror, space_id: int,
+                     alias_to_etype: Dict[str, int],
+                     etype_to_alias: Dict[int, str], yield_cols,
+                     idx: np.ndarray, exc_type) -> List[List[object]]:
+        """Evaluate YIELD columns for the selected edges.
+
+        Vectorized numpy (full int64/float64 precision) when the compiler
+        supports every column; falls back to per-row eval — which
+        reproduces _RowCtx error semantics exactly — otherwise.
+        """
+        if len(idx) == 0:
+            return []
+        compiler = ExprCompiler(m, space_id, self.sm, alias_to_etype)
+        try:
+            cvals = [compiler.compile(c.expr) for c in yield_cols]
+        except CompileError:
+            return self._materialize_per_row(
+                m, space_id, alias_to_etype, etype_to_alias, yield_cols,
+                idx, exc_type)
+
+        # validity → per-row fallback raises the right error
+        for k, desc in compiler.used.items():
+            if desc[0] == "edge":
+                col = m.edge_cols[(desc[1], desc[2])]
+                if not col.valid[idx].all():
+                    return self._materialize_per_row(
+                        m, space_id, alias_to_etype, etype_to_alias,
+                        yield_cols, idx, exc_type)
+            elif desc[0] == "vertex":
+                col = m.vertex_cols[(desc[1], desc[2])]
+                gather = m.edge_src[idx] if desc[3] == "src" \
+                    else m.edge_dst[idx]
+                if not col.valid[gather].all():
+                    return self._materialize_per_row(
+                        m, space_id, alias_to_etype, etype_to_alias,
+                        yield_cols, idx, exc_type)
+
+        cols_np: Dict[str, np.ndarray] = {}
+        for k, desc in compiler.used.items():
+            if desc[0] == "edge":
+                cols_np[k] = m.edge_cols[(desc[1], desc[2])].values[idx]
+            elif desc[0] == "vertex":
+                col = m.vertex_cols[(desc[1], desc[2])]
+                gather = m.edge_src[idx] if desc[3] == "src" \
+                    else m.edge_dst[idx]
+                cols_np[k] = col.values[gather]
+            elif desc[0] == "rank":
+                cols_np["rank"] = m.edge_rank[idx]
+            elif desc[0] == "src_idx":
+                cols_np["src_idx"] = m.edge_src[idx]
+            elif desc[0] == "dst_idx":
+                cols_np["dst_idx"] = m.edge_dst[idx]
+            elif desc[0] == "etype_alias":
+                cols_np["etype_alias"] = \
+                    self._etype_alias_codes(m, alias_to_etype)[idx]
+        env = Env(np, cols_np)
+
+        # a real x/0 in a YIELD raises on the CPU path — per-row eval
+        # reproduces the exact error
+        for g in compiler.div_guards:
+            if np.any(g(env)):
+                return self._materialize_per_row(
+                    m, space_id, alias_to_etype, etype_to_alias,
+                    yield_cols, idx, exc_type)
+
+        out_cols: List[List[object]] = []
+        k_edges = len(idx)
+        for cv, yc in zip(cvals, yield_cols):
+            arr = cv.fn(env)
+            out_cols.append(self._decode_col(m, cv, yc, arr, idx, k_edges,
+                                             etype_to_alias))
+        return [list(t) for t in zip(*out_cols)]
+
+    def _decode_col(self, m: CsrMirror, cv: CVal, yc, arr, idx: np.ndarray,
+                    k: int, etype_to_alias: Dict[int, str]) -> List[object]:
+        if cv.kind == K_VIDRANK:
+            return [int(v) for v in m.vids[np.asarray(arr)]]
+        if cv.kind == K_STR:
+            return [cv.const] * k
+        if cv.kind == K_STRCODE:
+            d = cv.dictionary
+            a = np.asarray(arr)
+            return [str(d[int(c)]) for c in a]
+        a = np.broadcast_to(np.asarray(arr), (k,))
+        if cv.kind == K_BOOL:
+            return [bool(v) for v in a]
+        if cv.kind == K_FLOAT:
+            return [float(v) for v in a]
+        return [int(v) for v in a]
+
+    def _materialize_per_row(self, m: CsrMirror, space_id: int,
+                             alias_to_etype: Dict[str, int],
+                             etype_to_alias: Dict[int, str], yield_cols,
+                             idx: np.ndarray, exc_type) -> List[List[object]]:
+        """Row-at-a-time eval with _RowCtx-equivalent getter semantics —
+        the universal fallback (strings ops, functions, missing props)."""
+        tag_ids = {}   # tag name -> id, resolved lazily
+
+        def tag_id(tag: str) -> Optional[int]:
+            if tag not in tag_ids:
+                r = self.sm.to_tag_id(space_id, tag)
+                tag_ids[tag] = r.value() if r.ok() else None
+            return tag_ids[tag]
+
+        rows = []
+        for e in idx.tolist():
+            src_i, dst_i = int(m.edge_src[e]), int(m.edge_dst[e])
+            et = int(m.edge_etype[e])
+            ctx = ExprContext()
+
+            def vget(which_i, tag, prop, _e=e):
+                t = tag_id(tag)
+                col = m.vertex_cols.get((t, prop)) if t is not None else None
+                if col is None or not col.valid[which_i]:
+                    raise ExprError(f"{tag}.{prop} unavailable")
+                return col.host_value(which_i)
+
+            ctx.get_src_tag_prop = lambda tag, prop, _i=src_i: \
+                vget(_i, tag, prop)
+            ctx.get_dst_tag_prop = lambda tag, prop, _i=dst_i: \
+                vget(_i, tag, prop)
+
+            def eget(alias, prop, _e=e, _et=et):
+                col = m.edge_cols.get((_et, prop))
+                if col is None or not col.valid[_e]:
+                    raise ExprError(f"{alias}.{prop} unavailable")
+                return col.host_value(_e)
+
+            ctx.get_alias_prop = eget
+            ctx.get_edge_dst_id = lambda a, _i=dst_i: int(m.vids[_i])
+            ctx.get_edge_src_id = lambda a, _i=src_i: int(m.vids[_i])
+            ctx.get_edge_rank = lambda a, _e=e: int(m.edge_rank[_e])
+            ctx.get_edge_type = lambda a, _et=et: \
+                etype_to_alias.get(_et, str(_et))
+            try:
+                rows.append([c.expr.eval(ctx) for c in yield_cols])
+            except ExprError as ex:
+                raise exc_type(str(ex))
+        return rows
+
+    # ================================================== FIND PATH
+    def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
+        if flags.get("storage_backend") == "cpu":
+            return False
+        try:
+            self.mirror(space_id)
+        except Exception:
+            return False
+        return True
+
+    def run_find_path(self, executor, space_id: int, srcs: List[int],
+                      dsts: List[int], etypes: List[int], max_steps: int,
+                      shortest: bool, etype_names: Dict[int, str]
+                      ) -> InterimResult:
+        import jax.numpy as jnp
+        m = self.mirror(space_id)
+        if m.m == 0 or not srcs or not dsts:
+            return InterimResult(["path"])
+        et_tuple = tuple(sorted(set(etypes)))
+        self.stats["path_device"] += 1
+
+        # --- device half: BFS depths --------------------------------
+        key = (space_id, m.build_version, "bfs", et_tuple, max_steps,
+               shortest)
+        kern = self._kernels.get(key)
+        if kern is None:
+            kern = kernels.make_bfs_kernel(m.n, max_steps, et_tuple,
+                                           stop_when_found=shortest)
+            self._kernels[key] = kern
+        dev = m._device
+        start_idx = _pad_pow2(m.to_dense(srcs))
+        target_idx = _pad_pow2(m.to_dense(dsts))
+        depth = np.asarray(kern(dev["edge_src"], dev["edge_dst"],
+                                dev["edge_etype"], jnp.asarray(start_idx),
+                                jnp.asarray(target_idx)))
+
+        # --- host half: parent-DAG reconstruction -------------------
+        return _reconstruct_paths(m, depth, srcs, dsts, et_tuple, max_steps,
+                                  shortest, etype_names)
+
+
+# ================================================== path reconstruction
+MAX_PATHS = 1000
+
+
+def _reconstruct_paths(m: CsrMirror, depth: np.ndarray, srcs, dsts,
+                       et_tuple, max_steps: int, shortest: bool,
+                       etype_names: Dict[int, str]) -> InterimResult:
+    """Host half of FIND PATH — mirrors FindPathExecutor's parent walk
+    (traverse.py) over the CSR's in-edge view instead of RPC responses."""
+    etype_ok = np.isin(m.edge_etype, np.asarray(et_tuple, dtype=np.int32))
+    # in-edge index: edges sorted by dst
+    order = np.argsort(m.edge_dst, kind="stable")
+    sorted_dst = m.edge_dst[order]
+
+    src_set = {int(i) for i in m.to_dense(srcs) if i >= 0}
+    paths: List[str] = []
+
+    def in_edges(v: int) -> np.ndarray:
+        lo = np.searchsorted(sorted_dst, v, "left")
+        hi = np.searchsorted(sorted_dst, v, "right")
+        return order[lo:hi]
+
+    def fmt(chain, start_dense: int) -> str:
+        parts = [str(int(m.vids[start_dense]))]
+        for (etype, rank, node) in chain:
+            parts.append(f"<{etype_names.get(etype, etype)},{rank}>")
+            parts.append(str(int(m.vids[node])))
+        return " ".join(parts)
+
+    if shortest:
+        def build_shortest(v: int, acc, d: int):
+            if len(paths) >= MAX_PATHS:
+                return
+            if d == 0:
+                if v in src_set:
+                    paths.append(fmt(acc, v))
+                return
+            for e in in_edges(v):
+                if not etype_ok[e]:
+                    continue
+                u = int(m.edge_src[e])
+                if depth[u] == d - 1:
+                    build_shortest(u, [(int(m.edge_etype[e]),
+                                        int(m.edge_rank[e]), v)] + acc,
+                                   d - 1)
+
+        for dd in m.to_dense(dsts):
+            dd = int(dd)
+            if dd >= 0 and 0 < depth[dd] < kernels.INT32_INF:
+                build_shortest(dd, [], int(depth[dd]))
+    else:
+        # ALL: every edge whose src was discovered within max_steps-1
+        # is a parent edge (FindPathExecutor records exactly those)
+        parent_edge = etype_ok & (depth[m.edge_src] <= max_steps - 1)
+
+        def build_all(v: int, acc, visited):
+            if len(paths) >= MAX_PATHS or len(acc) > max_steps:
+                return
+            if v in src_set and acc:
+                paths.append(fmt(acc, v))
+            for e in in_edges(v):
+                if not parent_edge[e]:
+                    continue
+                u = int(m.edge_src[e])
+                if u not in visited:
+                    build_all(u, [(int(m.edge_etype[e]),
+                                   int(m.edge_rank[e]), v)] + acc,
+                              visited | {u})
+
+        for dd in m.to_dense(dsts):
+            dd = int(dd)
+            if dd >= 0:
+                build_all(dd, [], {dd})
+    return InterimResult(["path"], [[p] for p in sorted(paths)])
+
+
+# ================================================== small helpers
+def _default_col_name(expr) -> str:
+    from ..graph.executors.traverse import default_col_name
+    return default_col_name(expr)

@@ -1,0 +1,254 @@
+"""TPU traversal backend tests.
+
+Two tiers, mirroring SURVEY.md §4's pyramid:
+  * kernel/CSR units — build_mirror over a hand-rolled store, jitted GO /
+    BFS kernels on a known graph, sharded (8-virtual-device) GO kernel
+    equivalence against the single-device kernel;
+  * end-to-end parity — the SAME nGQL queries against two LocalClusters
+    (CPU backend vs TPU backend) must return identical row sets, and the
+    TPU cluster's runtime stats must prove the device path actually ran.
+"""
+import numpy as np
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.tpu import kernels
+
+TIM, TONY, MANU, LEBRON, KYRIE = 100, 101, 102, 103, 104
+SPURS, CAVS = 200, 201
+
+FIXTURE = [
+    "CREATE TAG player(name string, age int)",
+    "CREATE TAG team(name string)",
+    "CREATE EDGE follow(degree int)",
+    "CREATE EDGE serve(start_year int, end_year int)",
+]
+DATA = [
+    'INSERT VERTEX player(name, age) VALUES '
+    f'{TIM}:("Tim Duncan", 42), {TONY}:("Tony Parker", 36), '
+    f'{MANU}:("Manu Ginobili", 41), {LEBRON}:("LeBron James", 34), '
+    f'{KYRIE}:("Kyrie Irving", 26)',
+    f'INSERT VERTEX team(name) VALUES {SPURS}:("Spurs"), {CAVS}:("Cavaliers")',
+    'INSERT EDGE follow(degree) VALUES '
+    f'{TIM} -> {TONY}:(95), {TIM} -> {MANU}:(95), '
+    f'{TONY} -> {TIM}:(95), {TONY} -> {MANU}:(90), '
+    f'{MANU} -> {TIM}:(90), {LEBRON} -> {KYRIE}:(80), '
+    f'{KYRIE} -> {LEBRON}:(85)',
+    'INSERT EDGE serve(start_year, end_year) VALUES '
+    f'{TIM} -> {SPURS}:(1997, 2016), {TONY} -> {SPURS}:(1999, 2018), '
+    f'{MANU} -> {SPURS}:(2002, 2018), {LEBRON} -> {CAVS}:(2003, 2010), '
+    f'{KYRIE} -> {CAVS}:(2011, 2017)',
+]
+
+
+def _boot(tpu_backend: bool):
+    c = LocalCluster(num_storage=1, tpu_backend=tpu_backend)
+    client = c.client()
+
+    def ok(stmt):
+        resp = client.execute(stmt)
+        assert resp.ok(), f"{stmt}: {resp.error_msg}"
+        return resp
+
+    client.ok = ok
+    ok("CREATE SPACE nba(partition_num=6, replica_factor=1)")
+    c.refresh_all()
+    ok("USE nba")
+    for stmt in FIXTURE:
+        ok(stmt)
+    c.refresh_all()
+    for stmt in DATA:
+        ok(stmt)
+    return c, client
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    cpu_c, cpu = _boot(tpu_backend=False)
+    tpu_c, tpu = _boot(tpu_backend=True)
+    yield cpu_c, cpu, tpu_c, tpu
+    cpu.disconnect()
+    tpu.disconnect()
+    cpu_c.stop()
+    tpu_c.stop()
+
+
+PARITY_QUERIES = [
+    f"GO FROM {TIM} OVER follow",
+    f"GO FROM {TIM} OVER follow YIELD follow._dst AS id, follow.degree AS d,"
+    f" $^.player.name AS me",
+    f"GO FROM {TIM} OVER follow YIELD $$.player.name AS n, $$.player.age AS a",
+    f"GO 2 STEPS FROM {TIM} OVER follow",
+    f"GO 3 STEPS FROM {TIM} OVER follow",
+    f"GO FROM {TONY} OVER follow WHERE follow.degree > 92 YIELD follow._dst",
+    f"GO FROM {TIM},{TONY} OVER follow WHERE $^.player.age > 40 "
+    f"YIELD follow._dst",
+    f"GO FROM {TIM} OVER follow WHERE $$.player.age > 40 YIELD follow._dst",
+    f"GO FROM {MANU} OVER follow REVERSELY",
+    f"GO FROM {TIM} OVER follow, serve",
+    f"GO FROM {TIM} OVER follow, serve YIELD follow._dst AS d",
+    f"GO FROM {TIM} OVER follow YIELD follow._dst, follow._src, "
+    f"follow._rank, follow._type",
+    f"GO 2 STEPS FROM {TIM} OVER follow YIELD follow._dst AS id, "
+    f"follow.degree AS deg",
+    f"GO FROM {TIM} OVER follow WHERE follow.degree > 90 && "
+    f"$$.player.age > 40 YIELD follow._dst, follow.degree",
+    f"GO FROM {TIM} OVER follow YIELD follow._dst AS id | "
+    f"GO FROM $-.id OVER follow",
+    f"GO FROM {TONY} OVER follow YIELD DISTINCT follow._dst",
+    f"GO FROM {TIM} OVER follow WHERE $$.player.name == \"Tony Parker\" "
+    f"YIELD follow._dst, $$.player.name",
+    f"GO FROM {TIM} OVER follow WHERE follow._dst == {TONY} "
+    f"YIELD follow._dst",
+    f"GO FROM {TIM} OVER follow YIELD follow.degree + 1 AS dd",
+    f"GO FROM {TIM} OVER follow YIELD $^.player.age / 2 AS h",
+    f"FIND SHORTEST PATH FROM {TIM} TO {MANU} OVER follow",
+    f"FIND SHORTEST PATH FROM {LEBRON} TO {CAVS} OVER * UPTO 3 STEPS",
+    f"FIND SHORTEST PATH FROM {TIM} TO {CAVS} OVER follow",
+    f"FIND ALL PATH FROM {TONY} TO {MANU} OVER follow UPTO 2 STEPS",
+    f"FIND SHORTEST PATH FROM {TONY} TO {TIM},{SPURS} OVER * UPTO 3 STEPS",
+]
+
+
+class TestParity:
+    @pytest.mark.parametrize("query", PARITY_QUERIES)
+    def test_same_rows(self, clusters, query):
+        _, cpu, _, tpu = clusters
+        r_cpu = cpu.execute(query)
+        r_tpu = tpu.execute(query)
+        assert r_cpu.ok() and r_tpu.ok(), \
+            f"{query}: cpu={r_cpu.error_msg} tpu={r_tpu.error_msg}"
+        assert r_cpu.column_names == r_tpu.column_names
+        assert sorted(map(tuple, r_cpu.rows)) == \
+            sorted(map(tuple, r_tpu.rows)), query
+
+    def test_device_path_actually_ran(self, clusters):
+        _, _, tpu_c, tpu = clusters
+        rt = tpu_c.tpu_runtime
+        assert rt is not None
+        before = rt.stats["go_device"]
+        tpu.execute(f"GO FROM {TIM} OVER follow")
+        assert rt.stats["go_device"] == before + 1
+        before_p = rt.stats["path_device"]
+        tpu.execute(f"FIND SHORTEST PATH FROM {TIM} TO {MANU} OVER follow")
+        assert rt.stats["path_device"] == before_p + 1
+
+    def test_error_parity_missing_prop(self, clusters):
+        # yielding a prop of a tag the dst doesn't carry errors both ways
+        _, cpu, _, tpu = clusters
+        q = f"GO FROM {TIM} OVER serve YIELD $$.player.name"
+        r_cpu = cpu.execute(q)
+        r_tpu = tpu.execute(q)
+        assert not r_cpu.ok() and not r_tpu.ok()
+
+    def test_div_zero_pushed_filter_parity(self, clusters):
+        # a zero-degree edge: CPU pushed filter drops the row on the
+        # ExprError; the device guard must drop it too — not emit inf>1
+        _, cpu, _, tpu = clusters
+        cpu.ok(f'INSERT EDGE follow(degree) VALUES {MANU} -> {TONY}:(0)')
+        tpu.ok(f'INSERT EDGE follow(degree) VALUES {MANU} -> {TONY}:(0)')
+        q = (f"GO FROM {MANU} OVER follow WHERE 10 / follow.degree >= 0 "
+             f"YIELD follow._dst")
+        r_cpu, r_tpu = cpu.execute(q), tpu.execute(q)
+        assert r_cpu.ok() and r_tpu.ok()
+        # 10/90 == 0 (C-style int division) passes >= 0; the degree-0 edge
+        # errors on the CPU path and must be guard-dropped on device
+        assert sorted(map(tuple, r_cpu.rows)) == \
+            sorted(map(tuple, r_tpu.rows)) == [(TIM,)]
+        cpu.ok(f"DELETE EDGE follow {MANU} -> {TONY}")
+        tpu.ok(f"DELETE EDGE follow {MANU} -> {TONY}")
+
+    def test_ttl_expired_edges_dropped(self):
+        # expired rows are skipped by the CPU read path; the mirror must
+        # drop them too (review finding: TTL parity)
+        import time as _t
+        c, client = _boot(tpu_backend=True)
+        try:
+            client.ok("CREATE EDGE seen(ts timestamp) "
+                      "ttl_duration = 1, ttl_col = ts")
+            c.refresh_all()
+            now = int(_t.time())
+            client.ok(f'INSERT EDGE seen(ts) VALUES {TIM} -> {TONY}:({now}),'
+                      f' {TIM} -> {MANU}:({now - 100})')
+            r = client.ok(f"GO FROM {TIM} OVER seen")
+            assert sorted(map(tuple, r.rows)) == [(TONY,)], r.rows
+        finally:
+            c.stop()
+
+    def test_mutation_invalidates_mirror(self, clusters):
+        _, _, tpu_c, tpu = clusters
+        rt = tpu_c.tpu_runtime
+        r = tpu.ok(f"GO FROM {KYRIE} OVER follow")
+        assert sorted(map(tuple, r.rows)) == [(LEBRON,)]
+        tpu.ok(f'INSERT EDGE follow(degree) VALUES {KYRIE} -> {TIM}:(70)')
+        r = tpu.ok(f"GO FROM {KYRIE} OVER follow")
+        assert sorted(map(tuple, r.rows)) == [(TIM,), (LEBRON,)]
+        # cleanup for other tests
+        tpu.ok(f"DELETE EDGE follow {KYRIE} -> {TIM}")
+
+
+class TestKernels:
+    """Direct kernel units on a known small graph.
+
+    Graph (dense ids): 0->1, 0->2, 1->3, 2->3, 3->4 all etype 1.
+    """
+
+    def _arrays(self):
+        import jax.numpy as jnp
+        es = jnp.asarray(np.array([0, 0, 1, 2, 3], dtype=np.int32))
+        ed = jnp.asarray(np.array([1, 2, 3, 3, 4], dtype=np.int32))
+        ee = jnp.asarray(np.ones(5, dtype=np.int32))
+        return es, ed, ee
+
+    def test_go_one_hop(self):
+        import jax.numpy as jnp
+        es, ed, ee = self._arrays()
+        kern = kernels.make_go_kernel(5, 1, (1,))
+        mask, frontier = kern(es, ed, ee,
+                              jnp.asarray(np.array([0, -1], dtype=np.int32)))
+        assert np.asarray(mask).tolist() == [True, True, False, False, False]
+
+    def test_go_two_hops(self):
+        import jax.numpy as jnp
+        es, ed, ee = self._arrays()
+        kern = kernels.make_go_kernel(5, 2, (1,))
+        mask, frontier = kern(es, ed, ee,
+                              jnp.asarray(np.array([0, -1], dtype=np.int32)))
+        # hop1 frontier {1,2}; final edges: 1->3, 2->3
+        assert np.asarray(mask).tolist() == [False, False, True, True, False]
+        assert np.asarray(frontier).tolist() == [False, True, True, False,
+                                                 False]
+
+    def test_bfs_depth(self):
+        import jax.numpy as jnp
+        es, ed, ee = self._arrays()
+        kern = kernels.make_bfs_kernel(5, 5, (1,), stop_when_found=False)
+        d = kern(es, ed, ee, jnp.asarray(np.array([0], dtype=np.int32)),
+                 jnp.asarray(np.array([4], dtype=np.int32)))
+        assert np.asarray(d).tolist() == [0, 1, 1, 2, 3]
+
+    def test_sharded_go_matches_single_device(self):
+        import jax
+        from jax.sharding import Mesh
+        rng = np.random.RandomState(7)
+        n, m = 64, 400
+        es = rng.randint(0, n, m).astype(np.int32)
+        ed = rng.randint(0, n, m).astype(np.int32)
+        ee = rng.choice([1, 2], m).astype(np.int32)
+        start = np.array([3, 11, -1, -1], dtype=np.int32)
+
+        import jax.numpy as jnp
+        single = kernels.make_go_kernel(n, 3, (1,))
+        mask1, f1 = single(jnp.asarray(es), jnp.asarray(ed), jnp.asarray(ee),
+                           jnp.asarray(start))
+
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, ("parts",))
+        sharded = kernels.make_sharded_go_kernel(mesh, "parts", n, 3, (1,))
+        s_es, s_ed, s_ee, padded = kernels.shard_edges(mesh, "parts", es, ed,
+                                                       ee)
+        f0 = kernels.bitmap_from_idx(jnp.asarray(start), n)
+        mask8, f8 = sharded(s_es, s_ed, s_ee, f0)
+        assert np.array_equal(np.asarray(f1), np.asarray(f8))
+        assert np.array_equal(np.asarray(mask1),
+                              np.asarray(mask8)[:m])
