@@ -61,7 +61,6 @@ def main():
     start_idx = np.arange(64, dtype=np.int32)
 
     # ---- CPU reference-equivalent path ------------------------------
-    t0 = time.perf_counter()
     cpu_mask, cpu_frontier, traversed = cpu_go(n, steps, edge_src, edge_dst,
                                                start_idx)
     reps_cpu = 3

@@ -23,9 +23,27 @@ from .storage.service import StorageService
 from .graph.service import ExecutionEngine, GraphService
 
 
+class CompositeHandler:
+    """One RPC address serving several handlers (storage + raftex share
+    the storaged address; the reference puts raft on storagePort+1 —
+    NebulaStore.h:55-60 — our transport namespaces methods instead)."""
+
+    def __init__(self, *handlers):
+        self._handlers = handlers
+
+    def __getattr__(self, name):
+        if name.startswith("rpc_"):
+            for h in self._handlers:
+                fn = getattr(h, name, None)
+                if fn is not None:
+                    return fn
+        raise AttributeError(name)
+
+
 class StorageNode:
     def __init__(self, host: str, meta_addrs: List[HostAddr],
-                 cm: ClientManager, data_paths: Optional[List[str]] = None):
+                 cm: ClientManager, data_paths: Optional[List[str]] = None,
+                 use_raft: bool = False, wal_root: Optional[str] = None):
         self.host = host
         self.meta_client = MetaClient(meta_addrs, local_host=host,
                                       send_heartbeat=True, client_manager=cm)
@@ -33,16 +51,23 @@ class StorageNode:
         self.meta_client.heartbeat()  # register immediately
         self.schema_man = ServerBasedSchemaManager(self.meta_client)
         self.part_man = MetaServerBasedPartManager(self.meta_client, host)
+        self.raft_service = None
+        if use_raft:
+            from .raftex import RaftexService
+            self.raft_service = RaftexService(host, cm, wal_root=wal_root)
         self.kv = NebulaStore(
             KVOptions(part_man=self.part_man,
                       data_paths=data_paths or [],
                       compaction_filter_factory=make_compaction_filter_factory(
                           self.schema_man)),
-            local_host=HostAddr.parse(host))
+            local_host=HostAddr.parse(host),
+            raft_service=self.raft_service)
         self.part_man.register_handler(self.kv)
         self.kv.init()
         self.service = StorageService(self.kv, self.schema_man,
                                       local_host=host)
+        self.handler = CompositeHandler(self.service, self.raft_service) \
+            if self.raft_service else self.service
 
     def start_loops(self) -> None:
         self.meta_client.start()
@@ -50,12 +75,15 @@ class StorageNode:
     def stop(self) -> None:
         self.meta_client.stop()
         self.service.shutdown()
+        if self.raft_service is not None:
+            self.raft_service.stop()
 
 
 class LocalCluster:
     def __init__(self, num_storage: int = 1, use_tcp: bool = False,
                  data_paths: Optional[List[str]] = None,
-                 start_loops: bool = False, tpu_backend: bool = False):
+                 start_loops: bool = False, tpu_backend: bool = False,
+                 use_raft: bool = False, wal_root: Optional[str] = None):
         self.cm = ClientManager()
         self.servers: List[RpcServer] = []
 
@@ -83,17 +111,23 @@ class LocalCluster:
                 node_host = f"127.0.0.1:{44500 + i}"
             # register heartbeat first so createSpace sees this host
             self.meta_service.rpc_heartBeat({"host": node_host})
-            node = StorageNode(node_host, [self.meta_addr], self.cm,
-                               data_paths=data_paths)
+            node = StorageNode(
+                node_host, [self.meta_addr], self.cm,
+                data_paths=data_paths, use_raft=use_raft,
+                wal_root=(f"{wal_root}/{i}" if wal_root else None))
             if use_tcp:
-                srv.handler = node.service
+                srv.handler = node.handler
                 self.servers.append(srv)
             else:
                 self.cm.register_loopback(HostAddr.parse(node_host),
-                                          node.service)
+                                          node.handler)
             self.storage_nodes.append(node)
             storage_hosts.append(node.host)
         self.storage_hosts = storage_hosts
+
+        # balancer: meta drives storage admin RPCs through the same
+        # client manager (reference AdminClient inside metad)
+        self.meta_service.wire_balancer(self.cm)
 
         # ---- graphd -------------------------------------------------
         self.graph_meta_client = MetaClient([self.meta_addr],

@@ -1,0 +1,4 @@
+"""console — interactive nGQL REPL (reference src/console/)."""
+from .repl import Console, main
+
+__all__ = ["Console", "main"]

@@ -1,0 +1,1 @@
+"""daemons — graphd / storaged / metad mains (reference src/daemons/)."""

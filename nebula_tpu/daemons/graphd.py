@@ -1,0 +1,64 @@
+"""nebula-graphd — stateless query-engine daemon.
+
+Reference wiring (GraphDaemon.cpp:36-162): init → pidfile → WebService →
+GraphService::init (MetaClient → waitForMetadReady → SchemaManager /
+GflagsManager / StorageClient) → serve. ``--enable_tpu_backend`` attaches
+the TpuQueryRuntime so GO / FIND PATH run on the device CSR mirror
+(BASELINE.json north star) — storage nodes must be reachable in-process
+for the mirror fold in this deployment; remote-storage mirroring rides
+the storage service's scan RPCs.
+
+Run: ``python -m nebula_tpu.daemons.graphd --port 43699 \
+      --meta_server_addrs 127.0.0.1:45500``
+"""
+from __future__ import annotations
+
+import sys
+
+from ..graph.service import ExecutionEngine, GraphService
+from ..interface.common import ConfigModule
+from ..interface.rpc import ClientManager, RpcServer
+from ..meta.client import MetaClient
+from ..meta.gflags_manager import GflagsManager
+from ..meta.schema_manager import ServerBasedSchemaManager
+from ..storage.client import StorageClient
+from ..webservice import WebService
+from .common import (apply_flag_overrides, base_parser, load_flagfile,
+                     parse_meta_addrs, serve_forever, write_pidfile)
+
+
+def main(argv=None) -> int:
+    p = base_parser("nebula-graphd", 43699)
+    args = p.parse_args(argv)
+    load_flagfile(args.flagfile)
+    apply_flag_overrides(args.flag)
+    write_pidfile(args.pid_file)
+
+    cm = ClientManager()
+    metas = parse_meta_addrs(args.meta_server_addrs)
+    meta_client = MetaClient(metas, client_manager=cm)
+    meta_client.wait_for_metad_ready()
+    GflagsManager(meta_client, ConfigModule.GRAPH).declare_gflags()
+    schema_man = ServerBasedSchemaManager(meta_client)
+    storage_client = StorageClient(meta_client, client_manager=cm)
+    engine = ExecutionEngine(meta_client, schema_man, storage_client)
+    service = GraphService(engine)
+    meta_client.start()
+
+    rpc = RpcServer(service, host=args.local_ip, port=args.port).start()
+    ws = WebService("nebula-graphd", host=args.local_ip,
+                    port=args.ws_http_port).start()
+    sys.stderr.write(f"graphd serving on {rpc.addr} (ws :{ws.port})\n")
+
+    def cleanup():
+        ws.stop()
+        meta_client.stop()
+        service.sessions.stop()
+        rpc.stop()
+
+    serve_forever(cleanup)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,78 @@
+"""nebula-metad — catalog / cluster-manager daemon.
+
+Reference wiring (MetaDaemon.cpp:58-242): kvstore over a single
+space(0)/part(0) whose raft peers are all metad addrs → cluster id →
+web handlers → MetaServiceHandler → serve. Replicated metad uses the
+same raftex as storage (SURVEY.md §2.8); single-instance runs
+single-replica.
+
+Run: ``python -m nebula_tpu.daemons.metad --port 45500``
+"""
+from __future__ import annotations
+
+import sys
+
+from ..interface.rpc import ClientManager, RpcServer
+from ..kvstore.partman import MemPartManager
+from ..kvstore.store import KVOptions, NebulaStore
+from ..meta.service import META_PART, META_SPACE, MetaService
+from ..webservice import WebService
+from .common import (apply_flag_overrides, base_parser, load_flagfile,
+                     parse_meta_addrs, serve_forever, write_pidfile)
+
+
+def build(args, cm=None):
+    cm = cm or ClientManager()
+    local = f"{args.local_ip}:{args.port}"
+    metas = [str(a) for a in parse_meta_addrs(args.meta_server_addrs)]
+    raft_service = None
+    if len(metas) > 1:
+        # replicated catalog: one raft group over all metad peers
+        from ..raftex import RaftexService
+        raft_service = RaftexService(local, cm,
+                                     wal_root=getattr(args, "wal_path", None))
+    pm = MemPartManager()
+    kv = NebulaStore(KVOptions(part_man=pm, snapshot_whole_engine=True),
+                     raft_service=raft_service)
+    pm.add_part(META_SPACE, META_PART, peers=metas if raft_service else None)
+    service = MetaService(kv)
+    service.wire_balancer(cm)
+    # peer metads dial the SAME address for MetaService and raft RPCs —
+    # serve both from one handler (cluster.CompositeHandler)
+    if raft_service is not None:
+        from ..cluster import CompositeHandler
+        handler = CompositeHandler(service, raft_service)
+    else:
+        handler = service
+    return service, cm, handler, raft_service
+
+
+def main(argv=None) -> int:
+    p = base_parser("nebula-metad", 45500)
+    p.add_argument("--wal_path", default=None)
+    args = p.parse_args(argv)
+    load_flagfile(args.flagfile)
+    apply_flag_overrides(args.flag)
+    write_pidfile(args.pid_file)
+
+    service, cm, handler, raft_service = build(args)
+    rpc = RpcServer(handler, host=args.local_ip, port=args.port).start()
+    ws = WebService("nebula-metad", host=args.local_ip,
+                    port=args.ws_http_port).start()
+    ws.register_handler(
+        "/balance", lambda q, b: (200, service.rpc_balance(
+            {k: v for k, v in q.items() if not k.startswith("__")})))
+    sys.stderr.write(f"metad serving on {rpc.addr} (ws :{ws.port})\n")
+
+    def cleanup():
+        ws.stop()
+        rpc.stop()
+        if raft_service is not None:
+            raft_service.stop()
+
+    serve_forever(cleanup)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,69 @@
+"""nebula-storaged — partitioned storage daemon.
+
+Reference wiring (StorageDaemon.cpp → StorageServer.cpp:91-146):
+MetaClient(heartbeat) → waitForMetadReady → SchemaManager →
+NebulaStore(MetaServerBasedPartManager, compaction filter) with the
+RaftexService for replication → StorageService + raft RPCs on one
+address → web handlers /status /download /ingest /admin → serve.
+
+Run: ``python -m nebula_tpu.daemons.storaged --port 44500 \
+      --meta_server_addrs 127.0.0.1:45500``
+"""
+from __future__ import annotations
+
+import sys
+
+from ..cluster import CompositeHandler, StorageNode
+from ..interface.rpc import ClientManager, RpcServer
+from ..webservice import WebService
+from .common import (apply_flag_overrides, base_parser, load_flagfile,
+                     parse_meta_addrs, serve_forever, write_pidfile)
+
+
+def main(argv=None) -> int:
+    p = base_parser("nebula-storaged", 44500)
+    p.add_argument("--data_path", default=None,
+                   help="comma-separated engine data dirs")
+    p.add_argument("--wal_path", default=None)
+    p.add_argument("--no_raft", action="store_true",
+                   help="single-replica mode (no consensus)")
+    args = p.parse_args(argv)
+    load_flagfile(args.flagfile)
+    apply_flag_overrides(args.flag)
+    write_pidfile(args.pid_file)
+
+    cm = ClientManager()
+    local = f"{args.local_ip}:{args.port}"
+    metas = parse_meta_addrs(args.meta_server_addrs)
+    node = StorageNode(
+        local, metas, cm,
+        data_paths=args.data_path.split(",") if args.data_path else None,
+        use_raft=not args.no_raft, wal_root=args.wal_path)
+    rpc = RpcServer(node.handler, host=args.local_ip,
+                    port=args.port).start()
+    node.start_loops()
+
+    ws = WebService("nebula-storaged", host=args.local_ip,
+                    port=args.ws_http_port).start()
+    ws.register_handler(
+        "/admin", lambda q, b: (200, node.service.rpc_raftPartStatus({})))
+    ws.register_handler(
+        "/ingest", lambda q, b: (200, {"ok": node.kv.ingest(
+            int(q.get("space", 0)),
+            q.get("path", "").split(",")).ok()}))
+    ws.register_handler(
+        "/download", lambda q, b: (200, {"error": "use local paths with "
+                                         "/ingest (no HDFS in this build)"}))
+    sys.stderr.write(f"storaged serving on {rpc.addr} (ws :{ws.port})\n")
+
+    def cleanup():
+        ws.stop()
+        node.stop()
+        rpc.stop()
+
+    serve_forever(cleanup)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -30,16 +30,23 @@ def _commit_key(part_id: int) -> bytes:
 
 class Part:
     def __init__(self, space_id: int, part_id: int, engine: KVEngine,
-                 raft=None):
+                 raft=None, snapshot_scan: Optional[Callable] = None):
         self.space_id = space_id
         self.part_id = part_id
         self.engine = engine
         self.raft = raft  # raftex.RaftPart or None (single replica)
+        # engine rows belonging to this part (for raft snapshot transfer);
+        # None → whole engine (single-part spaces like metad's)
+        self.snapshot_scan = snapshot_scan
         # committed-batch listeners: fn(part, List[(LogOp, payload)])
         self.listeners: List[Callable] = []
         if raft is not None:
             raft.commit_handler = self.commit_logs
             raft.pre_process_handler = self.pre_process_log
+            raft.install_handler = self.install_snapshot
+            raft.snapshot_source = self.snapshot_rows
+            raft.cas_reader = self.engine.get
+            raft.recover(self.last_committed_log_id()[0])
 
     # ---- recovery ----------------------------------------------------
     def last_committed_log_id(self) -> Tuple[int, int]:
@@ -147,6 +154,31 @@ class Part:
         for listener in self.listeners:
             listener(self, decoded)
         return Status.OK()
+
+    # ---- raft snapshot transfer --------------------------------------
+    def snapshot_rows(self):
+        """Committed rows of this part (leader side of snapshot send)."""
+        it = self.snapshot_scan() if self.snapshot_scan is not None \
+            else self.engine.prefix(b"")
+        for k, v in it:
+            if k.startswith(b"__system_commit_msg_"):
+                continue
+            yield k, v
+
+    def install_snapshot(self, rows: List[KV], log_id: int,
+                         term: int) -> None:
+        """Replace this part's state with a leader snapshot (follower
+        side); completes the reference's reserved snapshot path
+        (raftex.thrift:109, SURVEY.md §5.4)."""
+        stale = [k for k, _v in self.snapshot_rows()]
+        if stale:
+            self.engine.multi_remove(stale)
+        if rows:
+            self.engine.multi_put(rows)
+        self.engine.put(_commit_key(self.part_id),
+                        _COMMIT.pack(log_id, term))
+        for listener in self.listeners:
+            listener(self, [])
 
     # ---- membership (COMMAND logs) -----------------------------------
     def pre_process_log(self, log_id: int, term: int, msg: bytes) -> None:

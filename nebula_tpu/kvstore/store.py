@@ -33,6 +33,9 @@ class KVOptions:
     part_man: Optional[PartManager] = None
     compaction_filter_factory: Optional[object] = None  # fn(space_id) -> filter
     engine_factory: Optional[object] = None  # fn(space, path, cf) -> KVEngine
+    # raft snapshots stream the whole engine instead of the part's key
+    # prefix (single-part catalogs whose keys aren't part-prefixed — metad)
+    snapshot_whole_engine: bool = False
 
 
 class SpaceData:
@@ -103,7 +106,8 @@ class NebulaStore:
         return MemEngine(compaction_filter=cf)
 
     def add_part(self, space_id: GraphSpaceID, part_id: PartitionID,
-                 peers: Optional[List[HostAddr]] = None) -> None:
+                 peers: Optional[List[HostAddr]] = None,
+                 as_learner: bool = False) -> None:
         self.add_space(space_id)
         sd = self.spaces[space_id]
         if part_id in sd.parts:
@@ -114,14 +118,28 @@ class NebulaStore:
         # round-robin parts across engines (NebulaStore.cpp engine pick)
         engine = sd.engines[len(sd.parts) % len(sd.engines)]
         raft = None
+        snapshot_scan = None
         if self.raft_service is not None:
-            raft = self.raft_service.add_part(space_id, part_id, peers or [])
-        part = Part(space_id, part_id, engine, raft=raft)
+            # create unregistered: the RaftPart must not be RPC-routable
+            # until Part() below installs commit/pre-process handlers
+            raft = self.raft_service.add_part(
+                space_id, part_id, [str(p) for p in (peers or [])],
+                as_learner=as_learner, register=False)
+            if not self.options.snapshot_whole_engine:
+                # storage keys are part-prefixed (common/keys.py layout);
+                # metad's catalog keys are not — it sets the option
+                from ..common.keys import KeyUtils
+                snapshot_scan = (lambda _e=engine, _p=part_id:
+                                 _e.prefix(KeyUtils.part_prefix(_p)))
+        part = Part(space_id, part_id, engine, raft=raft,
+                    snapshot_scan=snapshot_scan)
         # committed-batch listener: advance the space's mutation version
         # only once the batch hit the engine (see __init__ comment)
         part.listeners.append(
             lambda _p, _logs, _sid=space_id: self._bump(_sid))
         sd.parts[part_id] = part
+        if raft is not None:
+            self.raft_service.register_part(raft)
 
     def remove_space(self, space_id: GraphSpaceID) -> None:
         sd = self.spaces.pop(space_id, None)

@@ -1,0 +1,459 @@
+"""Balancer + AdminClient — part re-replication / movement plans.
+
+Capability parity with the reference's admin processors (SURVEY.md §2.8,
+§3.5): ``BALANCE DATA`` diffs desired vs. actual part placement using the
+active-host table, generates one BalanceTask per part move, persists the
+plan in the meta kvstore for crash recovery (reference Balancer.h:35-105,
+BalancePlan/BalanceTask), and drives each move through the storage admin
+RPC sequence addLearner → waitingForCatchUpData → memberChange →
+(transLeader) → removePart via AdminClient (reference AdminClient.h).
+``BALANCE LEADER`` redistributes raft leaders across replicas.
+
+Task state machine (reference BalanceTask::invoke):
+    START → ADD_LEARNER → CATCH_UP → MEMBER_CHANGE → UPDATE_META
+          → REMOVE_OLD → SUCCEEDED | FAILED
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from ..common.flags import flags
+from ..common.status import ErrorCode, Status
+from ..interface.common import HostAddr
+from . import keys as mk
+
+flags.define("balance_catch_up_retries", 50,
+             "polls of waitingForCatchUpData before a task fails")
+flags.define("balance_catch_up_interval_s", 0.1,
+             "delay between catch-up polls")
+
+META_SPACE, META_PART = 0, 0
+
+
+def _pk(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _unpk(raw: bytes):
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+class AdminClient:
+    """Meta-side driver of storaged admin RPCs (reference
+    processors/admin/AdminClient.h) — each call targets one storage host."""
+
+    def __init__(self, client_manager):
+        self.cm = client_manager
+
+    def _call(self, host: str, method: str, payload: dict) -> dict:
+        return self.cm.call(HostAddr.parse(host), method, payload)
+
+    def add_part(self, host: str, space_id: int, part_id: int,
+                 peers: List[str], as_learner: bool = False) -> None:
+        self._call(host, "addPart", {"space_id": space_id,
+                                     "part_id": part_id, "peers": peers,
+                                     "as_learner": as_learner})
+
+    def add_learner(self, leader: str, space_id: int, part_id: int,
+                    learner: str) -> None:
+        self._call(leader, "addLearner", {"space_id": space_id,
+                                          "part_id": part_id,
+                                          "learner": learner})
+
+    def waiting_for_catch_up(self, leader: str, space_id: int,
+                             part_id: int, target: str) -> bool:
+        r = self._call(leader, "waitingForCatchUpData",
+                       {"space_id": space_id, "part_id": part_id,
+                        "target": target})
+        return bool(r.get("caught_up"))
+
+    def member_change(self, leader: str, space_id: int, part_id: int,
+                      peer: str, add: bool) -> None:
+        self._call(leader, "memberChange", {"space_id": space_id,
+                                            "part_id": part_id,
+                                            "peer": peer, "add": add})
+
+    def trans_leader(self, leader: str, space_id: int, part_id: int,
+                     new_leader: str) -> None:
+        self._call(leader, "transLeader", {"space_id": space_id,
+                                           "part_id": part_id,
+                                           "new_leader": new_leader})
+
+    def remove_part(self, host: str, space_id: int, part_id: int) -> None:
+        self._call(host, "removePart", {"space_id": space_id,
+                                        "part_id": part_id})
+
+    def get_leader_parts(self, host: str) -> Dict[Tuple[int, int], bool]:
+        """(space, part) -> is_leader from a storage node's raft status."""
+        r = self._call(host, "raftPartStatus", {})
+        return {(p["space"], p["part"]): p["role"] == "LEADER"
+                for p in r.get("parts", [])}
+
+
+class BalanceTask:
+    """Move one part replica ``src`` → ``dst``."""
+
+    def __init__(self, space_id: int, part_id: int, src: str, dst: str,
+                 status: str = "START"):
+        self.space_id = space_id
+        self.part_id = part_id
+        self.src = src
+        self.dst = dst
+        self.status = status
+
+    def to_wire(self) -> dict:
+        return {"space_id": self.space_id, "part_id": self.part_id,
+                "src": self.src, "dst": self.dst, "status": self.status}
+
+    @staticmethod
+    def from_wire(w: dict) -> "BalanceTask":
+        return BalanceTask(w["space_id"], w["part_id"], w["src"], w["dst"],
+                           w.get("status", "START"))
+
+    def describe(self) -> str:
+        return (f"{self.space_id}:{self.part_id}, {self.src} -> {self.dst}")
+
+
+class Balancer:
+    """Owned by MetaService; one plan runs at a time (reference
+    Balancer::instance semantics)."""
+
+    def __init__(self, meta_service, admin_client: Optional[AdminClient]):
+        self.meta = meta_service
+        self.admin = admin_client
+        self._lock = threading.Lock()
+        self._running_plan: Optional[int] = None
+        self._stop_requested = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------- persistence
+    def _plan_key(self, plan_id: int) -> bytes:
+        return mk.BALANCE_PLAN_PREFIX + b"%020d" % plan_id
+
+    def _save_plan(self, plan_id: int, tasks: List[BalanceTask],
+                   status: str) -> None:
+        self.meta.kv.put(META_SPACE, META_PART, self._plan_key(plan_id),
+                         _pk({"status": status,
+                              "tasks": [t.to_wire() for t in tasks]}))
+
+    def _load_plan(self, plan_id: int):
+        raw, _ = self.meta.kv.get(META_SPACE, META_PART,
+                                  self._plan_key(plan_id))
+        if raw is None:
+            return None
+        w = _unpk(raw)
+        return w["status"], [BalanceTask.from_wire(t) for t in w["tasks"]]
+
+    def _latest_plan_id(self) -> Optional[int]:
+        last = None
+        for k, _v in self.meta.kv.prefix(META_SPACE, META_PART,
+                                         mk.BALANCE_PLAN_PREFIX):
+            last = int(k[len(mk.BALANCE_PLAN_PREFIX):])
+        return last
+
+    def recover_in_flight_plan(self) -> None:
+        """On metad start: resume a plan that crashed mid-flight
+        (reference Balancer recovery via persisted plan, Balancer.h:96-98)."""
+        pid = self._latest_plan_id()
+        if pid is None:
+            return
+        loaded = self._load_plan(pid)
+        if loaded and loaded[0] == "IN_PROGRESS":
+            self._start_plan(pid, loaded[1])
+
+    # ---------------------------------------------------- entry points
+    def balance(self, req: dict) -> dict:
+        if req.get("stop"):
+            with self._lock:
+                if self._running_plan is None:
+                    raise _err(ErrorCode.E_NO_RUNNING_BALANCE_PLAN,
+                               "no running balance plan")
+                self._stop_requested = True
+                return {"plan_id": self._running_plan}
+        if req.get("plan_id") is not None:
+            loaded = self._load_plan(int(req["plan_id"]))
+            if loaded is None:
+                raise _err(ErrorCode.E_NOT_FOUND,
+                           f"balance plan {req['plan_id']}")
+            status, tasks = loaded
+            return {"tasks": [{"task": t.describe(), "status": t.status}
+                              for t in tasks], "plan_status": status}
+        with self._lock:
+            if self._running_plan is not None:
+                raise _err(ErrorCode.E_BALANCER_RUNNING,
+                           f"plan {self._running_plan} in progress")
+            # claim the slot before releasing the lock so two concurrent
+            # BALANCE requests can't both pass the guard and run plans
+            tasks = self.gen_tasks()
+            if not tasks:
+                raise _err(ErrorCode.E_BALANCED, "the cluster is balanced")
+            plan_id = int(time.time() * 1000)
+            self._save_plan(plan_id, tasks, "IN_PROGRESS")
+            self._running_plan = plan_id
+            self._stop_requested = False
+        self._spawn_runner(plan_id, tasks)
+        return {"plan_id": plan_id}
+
+    def _start_plan(self, plan_id: int, tasks: List[BalanceTask]) -> None:
+        with self._lock:
+            if self._running_plan is not None:
+                return
+            self._running_plan = plan_id
+            self._stop_requested = False
+        self._spawn_runner(plan_id, tasks)
+
+    def _spawn_runner(self, plan_id: int, tasks: List[BalanceTask]) -> None:
+        self._thread = threading.Thread(
+            target=self._run_plan, args=(plan_id, tasks),
+            name=f"balance-{plan_id}", daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    # ---------------------------------------------------- planning
+    def _placement(self) -> Dict[int, Dict[int, List[str]]]:
+        """space -> part -> peers from the meta kvstore."""
+        out: Dict[int, Dict[int, List[str]]] = {}
+        for k, v in self.meta.kv.prefix(META_SPACE, META_PART,
+                                        mk.SPACE_PREFIX):
+            sid = mk.space_id_from_key(k)
+            parts: Dict[int, List[str]] = {}
+            for pk_, pv in self.meta.kv.prefix(META_SPACE, META_PART,
+                                               mk.part_prefix(sid)):
+                parts[mk.part_id_from_key(pk_)] = list(_unpk(pv))
+            out[sid] = parts
+        return out
+
+    def gen_tasks(self) -> List[BalanceTask]:
+        """Diff desired vs. actual placement (reference Balancer::genTasks):
+        1) replicas on dead hosts move to the least-loaded active host;
+        2) load evens out — hosts holding > ceil(avg) replicas shed parts
+           to hosts holding < floor(avg)."""
+        active = self.meta.active_hosts.active_hosts()
+        if not active:
+            return []
+        placement = self._placement()
+        load: Dict[str, int] = {h: 0 for h in active}
+        for parts in placement.values():
+            for peers in parts.values():
+                for h in peers:
+                    if h in load:
+                        load[h] += 1
+
+        tasks: List[BalanceTask] = []
+
+        def pick_dst(exclude: List[str]) -> Optional[str]:
+            cands = [h for h in active if h not in exclude]
+            if not cands:
+                return None
+            dst = min(cands, key=lambda h: load[h])
+            load[dst] += 1
+            return dst
+
+        # pass 1: replace dead replicas
+        for sid, parts in placement.items():
+            for pid, peers in parts.items():
+                for h in peers:
+                    if h not in active:
+                        dst = pick_dst(peers)
+                        if dst is not None:
+                            tasks.append(BalanceTask(sid, pid, h, dst))
+                            peers[peers.index(h)] = dst
+
+        # pass 2: even out load among active hosts
+        total = sum(load.values())
+        if load and len(load) > 1:
+            avg_hi = -(-total // len(load))            # ceil
+            changed = True
+            while changed:
+                changed = False
+                over = max(load, key=lambda h: load[h])
+                under = min(load, key=lambda h: load[h])
+                if load[over] <= avg_hi or load[over] - load[under] <= 1:
+                    break
+                for sid, parts in placement.items():
+                    for pid, peers in parts.items():
+                        if over in peers and under not in peers:
+                            tasks.append(BalanceTask(sid, pid, over, under))
+                            peers[peers.index(over)] = under
+                            load[over] -= 1
+                            load[under] += 1
+                            changed = True
+                            break
+                    if changed:
+                        break
+        return tasks
+
+    # ---------------------------------------------------- execution
+    def _run_plan(self, plan_id: int, tasks: List[BalanceTask]) -> None:
+        ok = True
+        for t in tasks:
+            if self._stop_requested:
+                t.status = "STOPPED"
+                ok = False
+                self._save_plan(plan_id, tasks, "STOPPED")
+                continue
+            try:
+                self._run_task(t)
+                t.status = "SUCCEEDED"
+            except Exception as e:       # noqa: BLE001 — record and go on
+                t.status = f"FAILED: {e}"
+                ok = False
+            self._save_plan(plan_id, tasks, "IN_PROGRESS")
+        with self._lock:
+            self._running_plan = None
+        self._save_plan(plan_id, tasks,
+                        "SUCCEEDED" if ok else
+                        ("STOPPED" if self._stop_requested else "FAILED"))
+
+    def _leader_of(self, space_id: int, part_id: int,
+                   peers: List[str]) -> str:
+        if self.admin is not None:
+            for h in peers:
+                try:
+                    status = self.admin.get_leader_parts(h)
+                except Exception:      # noqa: BLE001
+                    continue
+                if status.get((space_id, part_id)):
+                    return h
+        return peers[0]
+
+    def _run_task(self, t: BalanceTask) -> None:
+        if self.admin is None:
+            raise RuntimeError("no admin client wired")
+        raw, _ = self.meta.kv.get(META_SPACE, META_PART,
+                                  mk.part_key(t.space_id, t.part_id))
+        peers = list(_unpk(raw)) if raw is not None else []
+        if t.src not in peers or t.dst in peers:
+            t.status = "SKIPPED"
+            return
+        leader = self._leader_of(t.space_id, t.part_id, peers)
+        retries = int(flags.get("balance_catch_up_retries"))
+        interval = float(flags.get("balance_catch_up_interval_s"))
+        if leader == t.src and len(peers) > 1:
+            # move leadership off the outgoing replica first (reference
+            # BalanceTask transLeaderIfNeeded)
+            target = [p for p in peers if p != t.src][0]
+            self.admin.trans_leader(leader, t.space_id, t.part_id, target)
+            for _ in range(retries):
+                time.sleep(interval)
+                leader = self._leader_of(t.space_id, t.part_id, peers)
+                if leader != t.src:
+                    break
+            else:
+                raise RuntimeError("leader transfer off src never happened")
+        # 1. spin the part up on dst as a learner
+        t.status = "ADD_LEARNER"
+        self.admin.add_part(t.dst, t.space_id, t.part_id, peers,
+                            as_learner=True)
+        self.admin.add_learner(leader, t.space_id, t.part_id, t.dst)
+        # 2. wait for catch-up
+        t.status = "CATCH_UP"
+        for _ in range(retries):
+            if self.admin.waiting_for_catch_up(leader, t.space_id,
+                                               t.part_id, t.dst):
+                break
+            time.sleep(interval)
+        else:
+            raise RuntimeError(f"{t.dst} never caught up")
+        # 3. promote dst, demote src
+        t.status = "MEMBER_CHANGE"
+        self.admin.member_change(leader, t.space_id, t.part_id, t.dst,
+                                 add=True)
+        if t.src == leader:
+            # single-replica source (couldn't pre-transfer): hand off to
+            # the now-voting dst, then WAIT for its election to finish —
+            # the demotion below must be served by an elected leader
+            self.admin.trans_leader(leader, t.space_id, t.part_id, t.dst)
+            group = [p for p in peers if p != t.src] + [t.dst]
+            for _ in range(retries):
+                time.sleep(interval)
+                leader = self._leader_of(t.space_id, t.part_id, group)
+                if leader != t.src:
+                    break
+            else:
+                raise RuntimeError("leader transfer to dst never happened")
+        last_err = None
+        for _ in range(retries):
+            try:
+                self.admin.member_change(leader, t.space_id, t.part_id,
+                                         t.src, add=False)
+                last_err = None
+                break
+            except Exception as e:        # noqa: BLE001 — young leader
+                last_err = e              # may still be committing no-op
+                time.sleep(interval)
+        if last_err is not None:
+            raise RuntimeError(f"demoting {t.src} failed: {last_err}")
+        # 4. commit the new placement to meta
+        t.status = "UPDATE_META"
+        new_peers = [h for h in peers if h != t.src] + [t.dst]
+        self.meta.kv.put(META_SPACE, META_PART,
+                         mk.part_key(t.space_id, t.part_id), _pk(new_peers))
+        self.meta._bump_last_update()
+        # 5. drop the replica from src
+        t.status = "REMOVE_OLD"
+        try:
+            self.admin.remove_part(t.src, t.space_id, t.part_id)
+        except Exception:        # noqa: BLE001 — src may be dead; fine
+            pass
+
+    # ---------------------------------------------------- leader balance
+    def leader_balance(self, req: dict) -> dict:
+        """Spread raft leaders evenly over replicas (reference
+        Balancer::leaderBalance)."""
+        if self.admin is None:
+            raise _err(ErrorCode.E_UNSUPPORTED, "no admin client wired")
+        placement = self._placement()
+        active = set(self.meta.active_hosts.active_hosts())
+        # current leader map
+        leaders: Dict[Tuple[int, int], str] = {}
+        for host in active:
+            try:
+                for key, is_leader in self.admin.get_leader_parts(
+                        host).items():
+                    if is_leader:
+                        leaders[key] = host
+            except Exception:    # noqa: BLE001
+                continue
+        moved = 0
+        for sid, parts in placement.items():
+            if not parts or not active:
+                continue
+            # per-space leader counts: balancing is within a space (a
+            # host's leader load in one space says nothing about another)
+            counts: Dict[str, int] = {h: 0 for h in active}
+            for pid in parts:
+                h = leaders.get((sid, pid))
+                if h in counts:
+                    counts[h] += 1
+            avg_hi = -(-len(parts) // len(active))
+            for pid, peers in parts.items():
+                cur = leaders.get((sid, pid))
+                if cur is None or counts.get(cur, 0) <= avg_hi:
+                    continue
+                cands = [h for h in peers
+                         if h in active and counts[h] < avg_hi]
+                if not cands:
+                    continue
+                dst = min(cands, key=lambda h: counts[h])
+                try:
+                    self.admin.trans_leader(cur, sid, pid, dst)
+                    counts[cur] -= 1
+                    counts[dst] += 1
+                    moved += 1
+                except Exception:    # noqa: BLE001
+                    continue
+        return {"moved": moved}
+
+
+def _err(code: ErrorCode, msg: str):
+    from ..interface.rpc import RpcError
+    return RpcError(Status(code, msg))

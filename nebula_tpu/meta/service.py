@@ -104,6 +104,13 @@ class MetaService:
         wrapper.__name__ = fn.__name__
         return wrapper
 
+    def wire_balancer(self, client_manager) -> None:
+        """Attach the Balancer + AdminClient (needs a channel to the
+        storaged fleet); resumes any plan that crashed mid-flight."""
+        from .balancer import AdminClient, Balancer
+        self.balancer = Balancer(self, AdminClient(client_manager))
+        self.balancer.recover_in_flight_plan()
+
     # ================= helpers =================
     def _bump_last_update(self) -> None:
         self.kv.put(META_SPACE, META_PART, mk.LAST_UPDATE_KEY, _pk(now_micros()))

@@ -1,0 +1,5 @@
+"""raftex — per-partition Raft consensus (reference src/kvstore/raftex/)."""
+from .raft_part import RaftPart, Role
+from .service import RaftexService
+
+__all__ = ["RaftPart", "Role", "RaftexService"]

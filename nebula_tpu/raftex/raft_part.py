@@ -1,0 +1,890 @@
+"""RaftPart — one partition's Raft consensus instance.
+
+Capability parity with the reference's raftex
+(/root/reference/src/kvstore/raftex/RaftPart.{h,cpp}): roles
+LEADER/FOLLOWER/CANDIDATE/LEARNER (RaftPart.h:228-234), group-commit log
+batching with one in-flight replication at a time (appendLogAsync
+RaftPart.cpp:390-488), quorum fan-out (replicateLogs:559-651 +
+CollectNSucceeded), election (leaderElection:864), periodic status
+polling driving heartbeats + election timeouts (statusPolling:966),
+follower-side append with log-gap/stale handling and leader verification
+(processAppendLogRequest:1087, verifyLeader:1254), CAS log type evaluated
+single-threaded at batch build (compareAndSet hook), COMMAND logs taking
+effect at append time via preProcessLog (membership: learners, peer
+add/remove, leader transfer), and WAL-backed divergence rollback.
+
+Where the reference reserves but does not implement snapshot transfer
+(raftex.thrift:109 snapshot_uri, SURVEY.md §5.4), this implementation
+completes it: a follower whose log is older than the leader's WAL window
+receives the committed state via ``sendSnapshot`` (service.py) — that
+plus ``Wal.clean_up_to`` bounds WAL growth.
+
+Threading model: one RLock per part guards all state; RPCs are NEVER
+issued while holding it (the reference gets the same property from folly
+futures). The caller that finds no replication in flight becomes the
+batch driver — the direct analogue of the reference's rolling
+SharedPromise group commit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common.flags import flags
+from ..common.status import ErrorCode, Status
+from ..interface.common import HostAddr
+from ..kvstore.log_encoder import LogOp, decode as decode_log, encode_single
+from ..kvstore.wal import FileBasedWal, LogEntry
+
+flags.define("raft_heartbeat_interval_s", 0.5,
+             "leader heartbeat period (seconds)")
+flags.define("raft_election_timeout_s", 1.5,
+             "base follower election timeout; actual is randomized in "
+             "[base, 2*base) per part")
+flags.define("raft_append_timeout_s", 10.0,
+             "client-visible timeout for one replicated append")
+flags.define("raft_rpc_timeout_s", 3.0, "per-peer raft RPC timeout")
+flags.define("raft_snapshot_rows_per_chunk", 4096,
+             "rows per sendSnapshot RPC chunk")
+flags.define("raft_wal_keep_logs", 10000,
+             "WAL entries to keep after a snapshot-eligible cleanup")
+
+
+class Role:
+    FOLLOWER = "FOLLOWER"
+    CANDIDATE = "CANDIDATE"
+    LEADER = "LEADER"
+    LEARNER = "LEARNER"
+
+
+class _Waiter:
+    __slots__ = ("event", "status")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.status: Optional[Status] = None
+
+    def set(self, st: Status) -> None:
+        self.status = st
+        self.event.set()
+
+
+class Peer:
+    """Per-peer replication agent state (reference Host.h:26-118): the
+    conversation lock serializes append streams to one peer, match_id
+    tracks the highest log known replicated there."""
+
+    __slots__ = ("addr", "is_learner", "match_id", "lock", "inflight_hb")
+
+    def __init__(self, addr: str, is_learner: bool = False):
+        self.addr = addr          # "host:port"
+        self.is_learner = is_learner
+        self.match_id = 0
+        self.lock = threading.Lock()
+        self.inflight_hb = False
+
+
+class RaftPart:
+    def __init__(self, space_id: int, part_id: int, local_addr: str,
+                 peer_addrs: List[str], client_manager, executor,
+                 wal_dir: Optional[str] = None, as_learner: bool = False):
+        self.space_id = space_id
+        self.part_id = part_id
+        self.addr = local_addr                     # "host:port"
+        self.cm = client_manager
+        self.executor = executor
+        self._lock = threading.RLock()
+        self.wal = FileBasedWal(wal_dir) if wal_dir else _MemWal()
+
+        self.role = Role.LEARNER if as_learner else Role.FOLLOWER
+        self.term = self.wal.last_log_term()
+        self.leader: Optional[str] = None
+        self.committed_id = 0
+        self._voted_term = 0
+        self._voted_for: Optional[str] = None
+        # durable (term, votedFor): without this a crash-restarted node
+        # could vote twice in one term → same-term split brain (classic
+        # Raft persistence requirement; the reference persists via WAL +
+        # vote state on disk)
+        self._state_path = os.path.join(wal_dir, "raft_state") \
+            if wal_dir else None
+        self._load_hard_state()
+
+        self.peers: Dict[str, Peer] = {
+            a: Peer(a) for a in peer_addrs if a != local_addr}
+
+        # hooks installed by kvstore.Part
+        self.commit_handler: Optional[Callable] = None
+        self.pre_process_handler: Optional[Callable] = None
+        self.install_handler: Optional[Callable] = None   # snapshot install
+        self.snapshot_source: Optional[Callable] = None   # snapshot rows
+
+        self._pending: List[Tuple[bytes, _Waiter]] = []
+        self._replicating = False
+        self._electing = False
+        self._stopped = False
+        self._snap_rows: List[Tuple[bytes, bytes]] = []
+
+        now = time.monotonic()
+        self._last_heard = now + random.random() * 0.2   # stagger first wave
+        self._last_hb = 0.0
+        self._reset_election_timeout()
+
+        # single replica group: immediately leader
+        if not self.peers and not as_learner:
+            self.role = Role.LEADER
+            self.leader = self.addr
+
+    # ------------------------------------------------------------ misc
+    def _load_hard_state(self) -> None:
+        if not self._state_path or not os.path.exists(self._state_path):
+            return
+        try:
+            with open(self._state_path) as f:
+                st = json.load(f)
+            self.term = max(self.term, int(st.get("term", 0)))
+            self._voted_term = int(st.get("voted_term", 0))
+            self._voted_for = st.get("voted_for")
+        except (OSError, ValueError):
+            pass
+
+    def _persist_hard_state(self) -> None:
+        """Caller holds the lock. fsync'd tmp+rename so a torn write can
+        never yield a forgotten vote."""
+        if not self._state_path:
+            return
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_term": self._voted_term,
+                       "voted_for": self._voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._state_path)
+
+    def _reset_election_timeout(self) -> None:
+        base = float(flags.get("raft_election_timeout_s"))
+        self._election_timeout = base * (1.0 + random.random())
+
+    def _quorum(self) -> int:
+        voters = 1 + sum(1 for p in self.peers.values() if not p.is_learner)
+        return voters // 2 + 1
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == Role.LEADER
+
+    def leader_addr(self) -> Optional[str]:
+        with self._lock:
+            return self.leader
+
+    def recover(self, committed_id: int) -> None:
+        """Part tells us the engine's durable commit watermark
+        (reference Part::lastCommittedLogId → RaftPart start)."""
+        with self._lock:
+            self.committed_id = min(committed_id, self.wal.last_log_id()) \
+                if self.wal.last_log_id() else committed_id
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "space": self.space_id, "part": self.part_id,
+                "role": self.role, "term": self.term, "leader": self.leader,
+                "committed": self.committed_id,
+                "last_log_id": self.wal.last_log_id(),
+                "peers": {a: {"learner": p.is_learner,
+                              "match": p.match_id}
+                          for a, p in self.peers.items()},
+            }
+
+    # ==================================================== client appends
+    def append_async(self, log: bytes) -> Status:
+        return self._append(log)
+
+    def send_command_async(self, log: bytes) -> Status:
+        """COMMAND logs (membership) — same path; pre-processed at append
+        on every replica (reference sendCommandAsync)."""
+        return self._append(log)
+
+    def cas_async(self, key: bytes, expected: bytes, value: bytes) -> Status:
+        """CAS log type: the check runs single-threaded at batch-build
+        time against applied state (reference atomic-op logs,
+        RaftPart.h:60-78). Encoded as a plain OP_PUT once it passes."""
+        waiter = _Waiter()
+        with self._lock:
+            if self.role != Role.LEADER:
+                return self._not_leader()
+            self._pending.append((("cas", key, expected, value), waiter))
+        self._drive()
+        return self._wait(waiter)
+
+    def _append(self, log: bytes) -> Status:
+        waiter = _Waiter()
+        with self._lock:
+            if self.role != Role.LEADER:
+                return self._not_leader()
+            self._pending.append((log, waiter))
+        self._drive()
+        return self._wait(waiter)
+
+    def _wait(self, waiter: _Waiter) -> Status:
+        if waiter.event.wait(float(flags.get("raft_append_timeout_s"))):
+            return waiter.status
+        return Status.Error("append timed out", ErrorCode.E_CONSENSUS_ERROR)
+
+    def _not_leader(self) -> Status:
+        return Status.Error(f"not a leader, leader is {self.leader}",
+                            ErrorCode.E_LEADER_CHANGED)
+
+    # ==================================================== batch driver
+    def _drive(self) -> None:
+        with self._lock:
+            if self._replicating:
+                return
+            self._replicating = True
+        try:
+            while True:
+                with self._lock:
+                    if not self._pending or self.role != Role.LEADER \
+                            or self._stopped:
+                        break
+                    batch = self._pending
+                    self._pending = []
+                    term = self.term
+                    prev_id = self.wal.last_log_id()
+                    prev_term = self.wal.last_log_term()
+                    entries: List[LogEntry] = []
+                    waiters: List[_Waiter] = []
+                    skipped: List[Tuple[_Waiter, Status]] = []
+                    next_id = prev_id + 1
+                    for log, waiter in batch:
+                        if isinstance(log, tuple):    # CAS: evaluate now
+                            _tag, key, expected, value = log
+                            cur = self._cas_read(key)
+                            if cur != expected:
+                                skipped.append((waiter, Status.Error(
+                                    "cas mismatch", ErrorCode.E_BAD_STATE)))
+                                continue
+                            log = encode_single(LogOp.OP_PUT, key, value)
+                        entries.append(LogEntry(next_id, term, log))
+                        waiters.append(waiter)
+                        next_id += 1
+                    if entries:
+                        self.wal.append_logs(entries)
+                        self.wal.flush()
+                        for e in entries:
+                            self._pre_process(e.log_id, e.term, e.msg)
+                    committed = self.committed_id
+                    peer_list = list(self.peers.values())
+                for waiter, st in skipped:
+                    waiter.set(st)
+                if not entries:
+                    continue
+                ok = self._replicate(term, prev_id, prev_term, entries,
+                                     committed, peer_list)
+                with self._lock:
+                    if ok and self.role == Role.LEADER and self.term == term:
+                        self._commit_to(entries[-1].log_id)
+                        st = Status.OK()
+                    elif self.role != Role.LEADER:
+                        st = self._not_leader()
+                    else:
+                        st = Status.Error("quorum not reached",
+                                          ErrorCode.E_CONSENSUS_ERROR)
+                for w in waiters:
+                    w.set(st)
+        finally:
+            with self._lock:
+                self._replicating = False
+                again = bool(self._pending) and self.role == Role.LEADER
+            if again:
+                self.executor.submit(self._drive)
+
+    def _cas_read(self, key: bytes) -> bytes:
+        """Read applied state for CAS (engine read via commit handler's
+        owner). Installed by kvstore.Part as ``cas_reader``."""
+        reader = getattr(self, "cas_reader", None)
+        return (reader(key) if reader else b"") or b""
+
+    def _replicate(self, term: int, prev_id: int, prev_term: int,
+                   entries: List[LogEntry], committed: int,
+                   peers: List[Peer]) -> bool:
+        quorum = self._quorum()
+        if quorum <= 1 and not peers:
+            return True
+        needed = quorum - 1
+        done = threading.Event()
+        state = {"acks": 1, "fails": 0}
+        voters = [p for p in peers if not p.is_learner]
+        lock = threading.Lock()
+
+        def one(peer: Peer):
+            ok = self._append_to_peer(peer, term, prev_id, prev_term,
+                                      entries, committed)
+            if peer.is_learner:
+                return
+            with lock:
+                if ok:
+                    state["acks"] += 1
+                    if state["acks"] >= quorum:
+                        done.set()
+                else:
+                    state["fails"] += 1
+                    if state["fails"] > len(voters) - needed:
+                        done.set()                 # can't reach quorum
+
+        for p in peers:
+            self.executor.submit(one, p)
+        if needed == 0:
+            # sole voter (peers are all learners) — already have quorum,
+            # but still push the logs out
+            return True
+        deadline = float(flags.get("raft_append_timeout_s"))
+        done.wait(deadline)
+        return state["acks"] >= quorum
+
+    # ------------------------------------------------ per-peer streaming
+    def _append_to_peer(self, peer: Peer, term: int, prev_id: int,
+                        prev_term: int, entries: List[LogEntry],
+                        committed: int, max_rounds: int = 64) -> bool:
+        """One conversation with one peer: append, then walk back through
+        gaps/divergence (reference Host::appendLogs request pipelining +
+        WAL catch-up), falling to snapshot when the WAL no longer reaches."""
+        with peer.lock:
+            s_prev_id, s_prev_term, s_entries = prev_id, prev_term, entries
+            for _ in range(max_rounds):
+                payload = {
+                    "space": self.space_id, "part": self.part_id,
+                    "term": term, "leader": self.addr,
+                    "committed": committed,
+                    "prev_id": s_prev_id, "prev_term": s_prev_term,
+                    "entries": [[e.log_id, e.term, e.msg]
+                                for e in s_entries],
+                }
+                try:
+                    resp = self.cm.call(HostAddr.parse(peer.addr),
+                                        "raftAppendLog", payload)
+                except Exception:            # noqa: BLE001 — peer down
+                    return False
+                code = resp.get("code", int(ErrorCode.E_INTERNAL_ERROR))
+                if code == 0:
+                    peer.match_id = resp.get("last_log_id", 0)
+                    return True
+                if code == int(ErrorCode.E_TERM_OUT_OF_DATE):
+                    self._maybe_step_down(resp.get("term", 0))
+                    return False
+                if code in (int(ErrorCode.E_LOG_GAP),
+                            int(ErrorCode.E_LOG_STALE)):
+                    follower_last = resp.get("last_log_id", 0)
+                    start = follower_last + 1
+                    with self._lock:
+                        first = self.wal.first_log_id()
+                        if first and start >= first:
+                            target = entries[-1].log_id if entries \
+                                else self.wal.last_log_id()
+                            s_entries = list(self.wal.iterate(start, target))
+                            s_prev_id = start - 1
+                            s_prev_term = self.wal.get_term(s_prev_id) \
+                                if s_prev_id else 0
+                            continue
+                    # WAL doesn't reach back that far → snapshot
+                    if not self._send_snapshot(peer, term):
+                        return False
+                    with self._lock:
+                        start = self.committed_id + 1
+                        target = entries[-1].log_id if entries \
+                            else self.wal.last_log_id()
+                        s_entries = list(self.wal.iterate(start, target))
+                        s_prev_id = start - 1
+                        s_prev_term = self.wal.get_term(s_prev_id) \
+                            if s_prev_id else 0
+                    continue
+                return False
+            return False
+
+    def _send_snapshot(self, peer: Peer, term: int) -> bool:
+        """Stream committed state to a lagging peer in chunks (completes
+        the reference's reserved snapshot_uri path, raftex.thrift:109)."""
+        if self.snapshot_source is None:
+            return False
+        with self._lock:
+            # materialized under the lock: commits mutate the engine under
+            # this same lock, so this is the cheapest consistent cut at
+            # committed_id (appends stall for one scan; RPC chunking below
+            # happens outside the lock)
+            rows = list(self.snapshot_source())
+            snap_committed = self.committed_id
+            snap_term = self.wal.get_term(snap_committed) or self.term
+        chunk = int(flags.get("raft_snapshot_rows_per_chunk"))
+        total = len(rows)
+        for off in range(0, max(total, 1), chunk):
+            part_rows = rows[off:off + chunk]
+            payload = {
+                "space": self.space_id, "part": self.part_id,
+                "term": term, "leader": self.addr,
+                "rows": [[k, v] for k, v in part_rows],
+                "committed_id": snap_committed,
+                "committed_term": snap_term,
+                "first": off == 0,
+                "done": off + chunk >= total,
+            }
+            try:
+                resp = self.cm.call(HostAddr.parse(peer.addr),
+                                    "raftSendSnapshot", payload)
+            except Exception:        # noqa: BLE001
+                return False
+            if resp.get("code", 1) != 0:
+                self._maybe_step_down(resp.get("term", 0))
+                return False
+        return True
+
+    def _maybe_step_down(self, peer_term: int) -> None:
+        with self._lock:
+            if peer_term > self.term:
+                self.term = peer_term
+                if self.role in (Role.LEADER, Role.CANDIDATE):
+                    self.role = Role.FOLLOWER
+                self.leader = None
+                self._persist_hard_state()
+
+    # ==================================================== commit
+    def _commit_to(self, to_id: int) -> None:
+        """Apply [committed+1, to_id] via the Part hook. Caller holds
+        the lock (reference commits on the same serialized path)."""
+        if to_id <= self.committed_id:
+            return
+        entries = [(e.log_id, e.term, e.msg)
+                   for e in self.wal.iterate(self.committed_id + 1, to_id)]
+        if self.commit_handler is not None and entries:
+            self.commit_handler(entries)
+        self.committed_id = to_id
+
+    def _pre_process(self, log_id: int, term: int, msg: bytes) -> None:
+        if self.pre_process_handler is not None and msg:
+            self.pre_process_handler(log_id, term, msg)
+
+    # ==================================================== RPC handlers
+    def process_ask_for_vote(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] < self.term:
+                return {"granted": False, "term": self.term}
+            if req["term"] > self.term:
+                self.term = req["term"]
+                if self.role in (Role.LEADER, Role.CANDIDATE):
+                    self.role = Role.FOLLOWER
+                self.leader = None
+                self._persist_hard_state()
+            if self.role == Role.LEARNER:
+                return {"granted": False, "term": self.term}
+            mine = (self.wal.last_log_term(), self.wal.last_log_id())
+            theirs = (req["last_log_term"], req["last_log_id"])
+            up_to_date = theirs >= mine
+            fresh_vote = (self._voted_term < req["term"]
+                          or self._voted_for == req["cand"])
+            if up_to_date and fresh_vote:
+                self._voted_term = req["term"]
+                self._voted_for = req["cand"]
+                self._persist_hard_state()   # vote durable BEFORE granting
+                self._last_heard = time.monotonic()
+                return {"granted": True, "term": self.term}
+            return {"granted": False, "term": self.term}
+
+    def process_append_log(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] < self.term:
+                return self._append_resp(ErrorCode.E_TERM_OUT_OF_DATE)
+            if req["term"] > self.term or self.role == Role.CANDIDATE:
+                if req["term"] > self.term:
+                    self.term = req["term"]
+                    self._persist_hard_state()
+                if self.role != Role.LEARNER:
+                    self.role = Role.FOLLOWER
+            elif self.role == Role.LEADER:
+                # same term, two leaders — impossible with correct quorum;
+                # highest log wins deterministically: step down
+                self.role = Role.FOLLOWER
+            self.leader = req["leader"]
+            self._last_heard = time.monotonic()
+
+            prev_id = req["prev_id"]
+            last = self.wal.last_log_id()
+            if prev_id > last:
+                return self._append_resp(ErrorCode.E_LOG_GAP)
+            if prev_id > 0 and prev_id >= self.wal.first_log_id():
+                my_term = self.wal.get_term(prev_id)
+                if my_term != req["prev_term"]:
+                    # divergence: drop the conflicting suffix (but never
+                    # committed entries) and ask the leader to back up
+                    rollback_to = max(prev_id - 1, self.committed_id)
+                    self.wal.rollback_to_log(rollback_to)
+                    return self._append_resp(ErrorCode.E_LOG_GAP)
+            elif prev_id > 0 and prev_id < self.committed_id:
+                # prev below our snapshot floor — already applied
+                pass
+
+            for lid, lterm, msg in req["entries"]:
+                cur_last = self.wal.last_log_id()
+                if lid <= cur_last:
+                    if self.wal.get_term(lid) == lterm:
+                        continue                     # duplicate
+                    if lid <= self.committed_id:
+                        # conflicting committed entry — corrupt leader
+                        return self._append_resp(ErrorCode.E_LOG_STALE)
+                    self.wal.rollback_to_log(lid - 1)
+                if not self.wal.append_log(lid, lterm, msg):
+                    return self._append_resp(ErrorCode.E_LOG_GAP)
+                self._pre_process(lid, lterm, msg)
+            self.wal.flush()
+
+            new_commit = min(req["committed"], self.wal.last_log_id())
+            if new_commit > self.committed_id:
+                self._commit_to(new_commit)
+            return self._append_resp(None)
+
+    def _append_resp(self, err: Optional[ErrorCode]) -> dict:
+        return {
+            "code": int(err) if err else 0,
+            "term": self.term,
+            "last_log_id": self.wal.last_log_id(),
+            "committed": self.committed_id,
+        }
+
+    def process_send_snapshot(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] < self.term:
+                return {"code": int(ErrorCode.E_TERM_OUT_OF_DATE),
+                        "term": self.term}
+            if req["term"] > self.term:
+                self.term = req["term"]
+                self._persist_hard_state()
+            self.leader = req["leader"]
+            if self.role != Role.LEARNER:
+                self.role = Role.FOLLOWER
+            self._last_heard = time.monotonic()
+            if req.get("first", True):
+                self._snap_rows = []
+            self._snap_rows.extend((bytes(k), bytes(v))
+                                   for k, v in req["rows"])
+            if req.get("done", True):
+                rows = self._snap_rows
+                self._snap_rows = []
+                if self.install_handler is not None:
+                    self.install_handler(rows, req["committed_id"],
+                                         req["committed_term"])
+                self.wal.reset()
+                # seed the WAL position so subsequent appends chain from
+                # the snapshot watermark
+                self.wal.append_log(req["committed_id"],
+                                    req["committed_term"], b"")
+                self.committed_id = req["committed_id"]
+            return {"code": 0, "term": self.term}
+
+    # ==================================================== elections
+    def tick(self, now: float) -> None:
+        """Called by the service's status-polling thread (reference
+        statusPolling RaftPart.cpp:966)."""
+        with self._lock:
+            if self._stopped:
+                return
+            role = self.role
+            if role == Role.LEADER:
+                if now - self._last_hb >= float(
+                        flags.get("raft_heartbeat_interval_s")):
+                    self._last_hb = now
+                    send_hb = True
+                else:
+                    send_hb = False
+            else:
+                send_hb = False
+                if role in (Role.FOLLOWER, Role.CANDIDATE) and self.peers \
+                        and now - self._last_heard >= self._election_timeout \
+                        and not self._electing:
+                    self._electing = True
+                    self.executor.submit(self._run_election)
+        if send_hb:
+            self._send_heartbeats()
+
+    def _send_heartbeats(self) -> None:
+        with self._lock:
+            term = self.term
+            committed = self.committed_id
+            prev_id = self.wal.last_log_id()
+            prev_term = self.wal.last_log_term()
+            peers = list(self.peers.values())
+
+        def hb(peer: Peer):
+            if peer.inflight_hb:
+                return
+            peer.inflight_hb = True
+            try:
+                self._append_to_peer(peer, term, prev_id, prev_term, [],
+                                     committed)
+            finally:
+                peer.inflight_hb = False
+
+        for p in peers:
+            self.executor.submit(hb, p)
+
+    def _run_election(self, bypass_timeout: bool = False) -> None:
+        try:
+            with self._lock:
+                if self.role in (Role.LEADER, Role.LEARNER) \
+                        or self._stopped:
+                    return
+                self.role = Role.CANDIDATE
+                self.term += 1
+                term = self.term
+                self._voted_term = term
+                self._voted_for = self.addr
+                self._persist_hard_state()
+                self.leader = None
+                self._last_heard = time.monotonic()
+                self._reset_election_timeout()
+                req = {
+                    "space": self.space_id, "part": self.part_id,
+                    "term": term, "cand": self.addr,
+                    "last_log_id": self.wal.last_log_id(),
+                    "last_log_term": self.wal.last_log_term(),
+                }
+                voters = [p for p in self.peers.values() if not p.is_learner]
+                quorum = self._quorum()
+
+            votes = {"n": 1}
+            won = threading.Event()
+            counted = {"n": 0}
+            vlock = threading.Lock()
+
+            def ask(peer: Peer):
+                try:
+                    resp = self.cm.call(HostAddr.parse(peer.addr),
+                                        "raftAskForVote", dict(req))
+                except Exception:      # noqa: BLE001
+                    resp = {"granted": False, "term": 0}
+                self._maybe_step_down(resp.get("term", 0))
+                with vlock:
+                    counted["n"] += 1
+                    if resp.get("granted"):
+                        votes["n"] += 1
+                    if votes["n"] >= quorum or counted["n"] >= len(voters):
+                        won.set()
+
+            for p in voters:
+                self.executor.submit(ask, p)
+            if not voters:
+                won.set()
+            won.wait(float(flags.get("raft_rpc_timeout_s")))
+
+            with self._lock:
+                if self.term != term or self.role != Role.CANDIDATE:
+                    return
+                if votes["n"] >= quorum:
+                    self.role = Role.LEADER
+                    self.leader = self.addr
+                    self._last_hb = 0.0
+                else:
+                    self.role = Role.FOLLOWER
+        finally:
+            with self._lock:
+                self._electing = False
+        if self.is_leader():
+            # no-op entry commits everything from prior terms (Raft §5.4.2
+            # safety — the reference leans on heartbeat committedLogId)
+            self.executor.submit(self.append_async, b"")
+            self._send_heartbeats()
+
+    # ==================================================== membership
+    def add_learner(self, payload: bytes) -> None:
+        addr = payload.decode() if isinstance(payload, bytes) else payload
+        with self._lock:
+            if addr == self.addr:
+                if self.role != Role.LEADER:
+                    self.role = Role.LEARNER
+                return
+            p = self.peers.get(addr)
+            if p is None:
+                self.peers[addr] = Peer(addr, is_learner=True)
+            else:
+                p.is_learner = True
+
+    def add_peer(self, payload: bytes) -> None:
+        addr = payload.decode() if isinstance(payload, bytes) else payload
+        with self._lock:
+            if addr == self.addr:
+                if self.role == Role.LEARNER:      # promoted
+                    self.role = Role.FOLLOWER
+                    self._last_heard = time.monotonic()
+                return
+            p = self.peers.get(addr)
+            if p is None:
+                self.peers[addr] = Peer(addr)
+            else:
+                p.is_learner = False
+
+    def remove_peer(self, payload: bytes) -> None:
+        addr = payload.decode() if isinstance(payload, bytes) else payload
+        with self._lock:
+            if addr == self.addr:
+                self.role = Role.LEARNER           # no longer votes
+                return
+            self.peers.pop(addr, None)
+
+    def prepare_leader_transfer(self, payload: bytes) -> None:
+        """COMMAND OP_TRANS_LEADER hits every replica at append; the
+        target elects immediately (reference processAppendLogRequest
+        TRANSFER handling)."""
+        addr = payload.decode() if isinstance(payload, bytes) else payload
+        with self._lock:
+            if addr != self.addr or self.role == Role.LEADER:
+                # non-targets do nothing; the old leader is deposed by the
+                # target's higher-term vote request, not here — stepping
+                # down early would abort the very batch carrying the
+                # command
+                return
+            if self._electing:
+                return
+            self._electing = True
+        self.executor.submit(self._run_election, True)
+
+    def transfer_leadership(self, target: str) -> Status:
+        """Admin entry (AdminProcessor transLeader): replicate the
+        command, then the target takes over."""
+        return self.send_command_async(
+            encode_single(LogOp.OP_TRANS_LEADER, target.encode()))
+
+    def add_learner_async(self, target: str) -> Status:
+        return self.send_command_async(
+            encode_single(LogOp.OP_ADD_LEARNER, target.encode()))
+
+    def add_peer_async(self, target: str) -> Status:
+        return self.send_command_async(
+            encode_single(LogOp.OP_ADD_PEER, target.encode()))
+
+    def remove_peer_async(self, target: str) -> Status:
+        return self.send_command_async(
+            encode_single(LogOp.OP_REMOVE_PEER, target.encode()))
+
+    def update_peers(self, peers) -> None:
+        """Reconcile the peer set with a meta-pushed part allocation
+        (MetaServerBasedPartManager.on_part_updated — the balancer just
+        rewrote placement). Voting state of retained peers is preserved."""
+        addrs = {str(p) for p in peers}
+        with self._lock:
+            for a in addrs:
+                if a != self.addr and a not in self.peers:
+                    self.peers[a] = Peer(a)
+            for a in list(self.peers):
+                if a not in addrs:
+                    self.peers.pop(a)
+
+    def learner_caught_up(self, target: Optional[str],
+                          max_gap: int = 2) -> bool:
+        """Admin waitingForCatchUpData check (reference AdminProcessor →
+        RaftPart catch-up probe): is the target's replicated log within
+        ``max_gap`` of our commit point?"""
+        with self._lock:
+            if not target:
+                return True
+            p = self.peers.get(str(target))
+            if p is None:
+                return False
+            return self.committed_id - p.match_id <= max_gap
+
+    # ==================================================== lifecycle
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self.role = Role.FOLLOWER
+            for _log, waiter in self._pending:
+                waiter.set(Status.Error("stopped",
+                                        ErrorCode.E_CONSENSUS_ERROR))
+            self._pending = []
+        self.wal.close() if hasattr(self.wal, "close") else None
+
+    def cleanup_wal(self) -> None:
+        """Forget WAL entries already covered by applied state, keeping a
+        catch-up window (snapshot transfer covers peers further behind)."""
+        with self._lock:
+            keep = int(flags.get("raft_wal_keep_logs"))
+            # never drop the WAL's last entry: the (last_id, last_term)
+            # position seeds future appends and append-consistency checks
+            floor = min(self.committed_id - keep,
+                        self.wal.last_log_id() - 1)
+            if floor > 0:
+                self.wal.clean_up_to(floor)
+
+
+class _MemWal:
+    """In-memory WAL (tests / metad's transient parts): same interface as
+    FileBasedWal minus durability."""
+
+    def __init__(self):
+        self._entries: List[LogEntry] = []
+
+    def first_log_id(self) -> int:
+        return self._entries[0].log_id if self._entries else 0
+
+    def last_log_id(self) -> int:
+        return self._entries[-1].log_id if self._entries else 0
+
+    def last_log_term(self) -> int:
+        return self._entries[-1].term if self._entries else 0
+
+    def get_term(self, log_id: int) -> int:
+        if not self._entries:
+            return 0
+        idx = log_id - self._entries[0].log_id
+        if 0 <= idx < len(self._entries):
+            return self._entries[idx].term
+        return 0
+
+    def append_log(self, log_id: int, term: int, msg: bytes) -> bool:
+        if self._entries and log_id != self._entries[-1].log_id + 1:
+            return False
+        self._entries.append(LogEntry(log_id, term, msg))
+        return True
+
+    def append_logs(self, entries: List[LogEntry]) -> bool:
+        for e in entries:
+            if not self.append_log(e.log_id, e.term, e.msg):
+                return False
+        return True
+
+    def rollback_to_log(self, log_id: int) -> bool:
+        if not self._entries:
+            return True
+        first = self._entries[0].log_id
+        keep = max(log_id - first + 1, 0)
+        del self._entries[keep:]
+        return True
+
+    def reset(self) -> None:
+        self._entries.clear()
+
+    def clean_up_to(self, log_id: int) -> None:
+        if not self._entries:
+            return
+        first = self._entries[0].log_id
+        drop = log_id - first + 1
+        if drop > 0:
+            self._entries = self._entries[drop:]
+
+    def iterate(self, first: int, last: Optional[int] = None):
+        if not self._entries:
+            return
+        lo = self._entries[0].log_id
+        hi = self._entries[-1].log_id
+        if last is None or last > hi:
+            last = hi
+        i = max(first, lo) - lo
+        while i < len(self._entries) and self._entries[i].log_id <= last:
+            yield self._entries[i]
+            i += 1
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

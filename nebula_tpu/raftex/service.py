@@ -1,0 +1,121 @@
+"""RaftexService — hosts every RaftPart of one node and routes raft RPCs.
+
+Capability parity with the reference's RaftexService (raftex/
+RaftexService.cpp; NebulaStore starts it on storagePort+1,
+NebulaStore.h:55-60): askForVote / appendLog / sendSnapshot dispatch by
+(space, part); a single status-polling thread drives every part's
+heartbeat + election clock (reference statusPolling, RaftPart.cpp:966);
+a shared worker pool runs replication fan-out, elections, and snapshot
+streaming (reference folly executors).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode
+from ..interface.rpc import RpcError
+from ..common.status import Status
+from .raft_part import RaftPart
+
+_TICK_S = 0.05
+
+
+class RaftexService:
+    def __init__(self, local_addr: str, client_manager,
+                 wal_root: Optional[str] = None, workers: int = 16):
+        self.local_addr = local_addr          # "host:port"
+        self.cm = client_manager
+        self.wal_root = wal_root
+        self.parts: Dict[Tuple[int, int], RaftPart] = {}
+        self._lock = threading.Lock()
+        self.executor = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix=f"raft-{local_addr}")
+        self._stop = threading.Event()
+        self._poller = threading.Thread(
+            target=self._status_polling, daemon=True,
+            name=f"raft-poll-{local_addr}")
+        self._poller.start()
+
+    # ---------------------------------------------------------- parts
+    def add_part(self, space_id: int, part_id: int, peers: List[str],
+                 as_learner: bool = False,
+                 register: bool = True) -> RaftPart:
+        """``register=False`` defers RPC routability until the caller has
+        attached the state-machine handlers (kvstore.Part) — otherwise a
+        log delivered in the creation window would be consumed with no
+        commit/pre-process hooks and silently dropped."""
+        peers = [str(p) for p in peers]
+        wal_dir = None
+        if self.wal_root:
+            wal_dir = os.path.join(self.wal_root, str(space_id),
+                                   str(part_id))
+        part = RaftPart(space_id, part_id, self.local_addr, peers,
+                        self.cm, self.executor, wal_dir=wal_dir,
+                        as_learner=as_learner)
+        if register:
+            self.register_part(part)
+        return part
+
+    def register_part(self, part: RaftPart) -> None:
+        with self._lock:
+            self.parts[(part.space_id, part.part_id)] = part
+
+    def remove_part(self, space_id: int, part_id: int) -> None:
+        with self._lock:
+            part = self.parts.pop((space_id, part_id), None)
+        if part is not None:
+            part.stop()
+
+    def part(self, space_id: int, part_id: int) -> Optional[RaftPart]:
+        with self._lock:
+            return self.parts.get((space_id, part_id))
+
+    # ---------------------------------------------------------- polling
+    def _status_polling(self) -> None:
+        while not self._stop.wait(_TICK_S):
+            now = time.monotonic()
+            with self._lock:
+                parts = list(self.parts.values())
+            for p in parts:
+                try:
+                    p.tick(now)
+                except Exception:     # noqa: BLE001 — polling must survive
+                    pass
+
+    # ---------------------------------------------------------- RPCs
+    def _route(self, req: dict) -> RaftPart:
+        part = self.part(req.get("space", -1), req.get("part", -1))
+        if part is None:
+            raise RpcError(Status.Error("raft part not found",
+                                        ErrorCode.E_PART_NOT_FOUND))
+        return part
+
+    def rpc_raftAskForVote(self, req: dict) -> dict:
+        return self._route(req).process_ask_for_vote(req)
+
+    def rpc_raftAppendLog(self, req: dict) -> dict:
+        return self._route(req).process_append_log(req)
+
+    def rpc_raftSendSnapshot(self, req: dict) -> dict:
+        return self._route(req).process_send_snapshot(req)
+
+    # ---------------------------------------------------------- admin
+    def status(self) -> List[dict]:
+        with self._lock:
+            parts = list(self.parts.values())
+        return [p.status() for p in parts]
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            parts = list(self.parts.values())
+            self.parts.clear()
+        for p in parts:
+            p.stop()
+        self.executor.shutdown(wait=False)
+        if self._poller.is_alive():
+            self._poller.join(timeout=1.0)

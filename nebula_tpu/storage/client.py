@@ -51,6 +51,8 @@ class StorageClient:
             max_workers=fanout_workers, thread_name_prefix="storage-client")
         self._leader_lock = threading.Lock()
         self._leaders: Dict[Tuple[int, int], str] = {}  # (space, part) -> host
+        # round-robin cursor for leaderless fallback routing
+        self._fallback_rr: Dict[Tuple[int, int], int] = {}
 
     # ---- partition / leader routing ---------------------------------
     def part_id(self, space_id: int, vid: int) -> int:
@@ -68,7 +70,13 @@ class StorageClient:
         if not peers:
             raise RpcError(Status(ErrorCode.E_PART_NOT_FOUND,
                                   f"part {part} unallocated"))
-        return peers[0]
+        # rotate through replicas on repeated cache misses so retries
+        # after invalidate_leader() fail over instead of re-dialing the
+        # same dead peers[0]
+        with self._leader_lock:
+            i = self._fallback_rr.get((space_id, part), 0)
+            self._fallback_rr[(space_id, part)] = i + 1
+        return peers[i % len(peers)]
 
     def update_leader(self, space_id: int, part: int, leader: str) -> None:
         with self._leader_lock:
@@ -96,11 +104,12 @@ class StorageClient:
     # ---- generic scatter-gather -------------------------------------
     def collect(self, space_id: int, part_items: Dict[int, list],
                 make_req: Callable[[Dict[int, list]], Tuple[str, dict]],
-                retries: int = 1) -> StorageRpcResponse:
+                retries: int = 3) -> StorageRpcResponse:
         """Fan a per-part payload out to leader hosts; retry leader-changed
         parts once against the hinted leader (reference collectResponse)."""
         resp = StorageRpcResponse(total_parts=len(part_items))
         pending = dict(part_items)
+        last_status: Dict[int, Status] = {}
         for _attempt in range(retries + 1):
             if not pending:
                 break
@@ -131,6 +140,20 @@ class StorageClient:
                         else:
                             self.invalidate_leader(space_id, part)
                         next_pending[part] = parts[part]
+                        last_status[part] = status
+                elif status.code in (ErrorCode.E_PART_NOT_FOUND,
+                                     ErrorCode.E_FAIL_TO_CONNECT):
+                    # stale leader cache (part moved by the balancer, or
+                    # host down before the request was sent — both cases
+                    # the op never executed, so resending is safe):
+                    # re-route from meta's current placement.
+                    # E_RPC_FAILURE is NOT retried: the server may have
+                    # executed the op (non-idempotent duplication risk,
+                    # same stance as the reference's collectResponse).
+                    for part in parts:
+                        self.invalidate_leader(space_id, part)
+                        next_pending[part] = parts[part]
+                        last_status[part] = status
                 else:
                     for part in parts:
                         self.invalidate_leader(space_id, part)
@@ -138,8 +161,9 @@ class StorageClient:
             for part, st in routing_failed.items():
                 resp.failed_parts[part] = st
             pending = next_pending
-        for part in pending:  # leader chase exhausted
-            resp.failed_parts[part] = Status.LeaderChanged()
+        for part in pending:  # retries exhausted: report what we saw
+            resp.failed_parts[part] = last_status.get(
+                part, Status.LeaderChanged())
         return resp
 
     def _call_host(self, host: str, method: str, payload: dict):
@@ -154,7 +178,8 @@ class StorageClient:
                       filter_bytes: Optional[bytes] = None,
                       vertex_props: Optional[List[List]] = None,
                       edge_props: Optional[Dict[int, List[str]]] = None,
-                      reverse: bool = False) -> StorageRpcResponse:
+                      reverse: bool = False,
+                      retries: int = 3) -> StorageRpcResponse:
         parts = self.cluster_by_part(space_id, vids)
 
         def make(parts_subset):
@@ -168,7 +193,7 @@ class StorageClient:
                 "reverse": reverse,
             }
 
-        return self.collect(space_id, parts, make)
+        return self.collect(space_id, parts, make, retries=retries)
 
     def get_props(self, space_id: int, vids: List[int],
                   vertex_props: Optional[List[List]] = None) -> StorageRpcResponse:

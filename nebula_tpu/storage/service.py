@@ -121,13 +121,35 @@ class StorageService:
 
     def rpc_addPart(self, req: dict) -> dict:
         self.kv.add_part(int(req["space_id"]), int(req["part_id"]),
-                         req.get("peers"))
+                         req.get("peers"),
+                         as_learner=bool(req.get("as_learner")))
         return {}
+
+    def rpc_raftPartStatus(self, req: dict) -> dict:
+        """Raft role/term per hosted part (AdminClient leader discovery +
+        webservice /status)."""
+        out = []
+        for sid in list(self.kv.spaces):
+            for pid in self.kv.part_ids(sid):
+                part = self.kv.part(sid, pid)
+                if part is None:
+                    continue
+                if part.raft is not None:
+                    out.append(part.raft.status())
+                else:
+                    out.append({"space": sid, "part": pid, "role": "LEADER",
+                                "term": 0, "leader": self.local_host,
+                                "committed": 0, "last_log_id": 0,
+                                "peers": {}})
+        return {"parts": out}
 
     def rpc_addLearner(self, req: dict) -> dict:
         part = self._raft(req)
         if part.raft is not None:
-            part.raft.add_learner(req["learner"])
+            # replicated COMMAND log so every replica learns the learner
+            st = part.raft.add_learner_async(req["learner"])
+            if not st.ok():
+                raise RpcError(st)
         return {}
 
     def rpc_waitingForCatchUpData(self, req: dict) -> dict:
@@ -141,9 +163,11 @@ class StorageService:
         part = self._raft(req)
         if part.raft is not None:
             if req.get("add"):
-                part.raft.add_peer(req["peer"])
+                st = part.raft.add_peer_async(req["peer"])
             else:
-                part.raft.remove_peer(req["peer"])
+                st = part.raft.remove_peer_async(req["peer"])
+            if not st.ok():
+                raise RpcError(st)
         return {}
 
     def rpc_removePart(self, req: dict) -> dict:

@@ -1,0 +1,1 @@
+"""tools — load generator, CSV importer, SST generator (reference src/tools/)."""

@@ -1,0 +1,4 @@
+"""webservice — per-daemon HTTP ops endpoint (reference src/webservice/)."""
+from .service import WebService
+
+__all__ = ["WebService"]

@@ -1,0 +1,141 @@
+"""WebService — HTTP ops endpoint embedded in every daemon.
+
+Capability parity with the reference's proxygen webservice
+(/root/reference/src/webservice/WebService.h:26-50, GetStatsHandler.h:
+17-40, GetFlagsHandler.cpp, SetFlagsHandler.cpp): each daemon runs one
+HTTP server exposing
+
+  GET /status                       liveness + daemon role
+  GET /flags[?names=a,b]            runtime gflag read (JSON)
+  PUT /flags?name=<n>&value=<v>     runtime gflag write (MUTABLE only)
+  GET /get_stats[?stats=expr,...]   StatsManager counters; expr syntax
+                                    "counter.{sum|count|avg|rate|pXX}.
+                                    {5|60|600|3600}" (StatsManager.h:24-40)
+  GET /get_stats?format=text        plain-text k=v dump
+
+plus ``register_handler(path, fn)`` for daemon-specific paths (storage's
+/download /ingest /admin, meta's /*-dispatch — SURVEY.md §2.10).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..common.flags import flags
+from ..common.stats import stats
+
+
+class WebService:
+    def __init__(self, daemon_name: str = "daemon", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.daemon_name = daemon_name
+        # path -> fn(query_dict, body: bytes) -> (code, obj-or-str)
+        self._handlers: Dict[str, Callable] = {}
+        self.register_handler("/status", self._status)
+        self.register_handler("/flags", self._flags)
+        self.register_handler("/get_stats", self._get_stats)
+        outer = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _serve(self, body: bytes):
+                url = urlparse(self.path)
+                fn = outer._handlers.get(url.path)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b"not found")
+                    return
+                q = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                q["__method__"] = self.command
+                try:
+                    code, obj = fn(q, body)
+                except Exception as e:       # noqa: BLE001
+                    code, obj = 500, {"error": f"{type(e).__name__}: {e}"}
+                payload = obj if isinstance(obj, (bytes, str)) \
+                    else json.dumps(obj, indent=2)
+                if isinstance(payload, str):
+                    payload = payload.encode()
+                self.send_response(code)
+                ctype = "application/json" if not isinstance(obj, (bytes, str)) \
+                    else "text/plain"
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve(b"")
+
+            def do_PUT(self):
+                ln = int(self.headers.get("Content-Length", 0) or 0)
+                self._serve(self.rfile.read(ln) if ln else b"")
+
+            do_POST = do_PUT
+
+        self._server = ThreadingHTTPServer((host, port), _Req)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "WebService":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"ws-{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def register_handler(self, path: str, fn: Callable) -> None:
+        self._handlers[path] = fn
+
+    # ------------------------------------------------------- built-ins
+    def _status(self, q: dict, body: bytes):
+        return 200, {"status": "running", "name": self.daemon_name,
+                     "git_info_sha": "nebula-tpu"}
+
+    def _flags(self, q: dict, body: bytes):
+        if q.get("__method__") in ("PUT", "POST"):
+            name, value = q.get("name"), q.get("value")
+            if name is None and body:
+                try:
+                    parsed = json.loads(body)
+                    (name, value), = parsed.items()
+                except Exception:    # noqa: BLE001
+                    return 400, {"error": "bad body"}
+            if name is None:
+                return 400, {"error": "name required"}
+            if not flags.set(name, value):
+                return 400, {"error": f"flag {name} immutable or unknown"}
+            return 200, {name: flags.get(name)}
+        names = q.get("names")
+        if names:
+            return 200, {n: flags.get(n) for n in names.split(",")}
+        return 200, flags.dump() if hasattr(flags, "dump") else \
+            {n: flags.get(n) for n in flags.names()}
+
+    def _get_stats(self, q: dict, body: bytes):
+        exprs = q.get("stats")
+        if exprs:
+            out = {e: stats.read_stats(e) for e in exprs.split(",")}
+        else:
+            out = stats.dump()
+        if q.get("format") == "text":
+            lines = []
+            for k, v in sorted(out.items()):
+                if isinstance(v, dict):
+                    for kk, vv in sorted(v.items()):
+                        lines.append(f"{k}.{kk}={vv}")
+                else:
+                    lines.append(f"{k}={v}")
+            return 200, "\n".join(lines) + "\n"
+        return 200, out

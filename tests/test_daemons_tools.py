@@ -1,0 +1,233 @@
+"""Daemon wiring + console + importer + webservice + perf-tool tests.
+
+The reference covers this tier with process-level scripts (scripts/
+services.sh) and the console's CmdProcessor; here the three daemon
+builders are exercised in-process over real TCP sockets (the daemons'
+serve_forever loop is signal-driven, so tests use the same build/wiring
+functions the mains use).
+"""
+import io
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.console.repl import Console, render_table
+from nebula_tpu.interface.common import HostAddr
+from nebula_tpu.webservice import WebService
+from nebula_tpu.common.stats import stats
+
+
+@pytest.fixture(scope="module")
+def tcp_cluster():
+    c = LocalCluster(num_storage=1, use_tcp=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def seeded(tcp_cluster):
+    client = tcp_cluster.client()
+    for stmt in [
+        "CREATE SPACE toolspace(partition_num=3)",
+    ]:
+        assert client.execute(stmt).ok()
+    tcp_cluster.refresh_all()
+    assert client.execute("USE toolspace").ok()
+    assert client.execute("CREATE TAG person(name string, age int)").ok()
+    assert client.execute("CREATE EDGE likes(w int)").ok()
+    tcp_cluster.refresh_all()
+    return tcp_cluster
+
+
+class TestConsole:
+    def test_render_table(self):
+        class R:
+            column_names = ["id", "name"]
+            rows = [[1, "alice"], [2, "bob"]]
+            latency_in_us = 42
+        out = render_table(R())
+        assert "| id | name  |" in out
+        assert "| 1  | alice |" in out
+        assert "Got 2 rows" in out
+
+    def test_console_statements_and_batch(self, seeded, tmp_path):
+        con = Console(seeded.graph_addr)
+        out = io.StringIO()
+        assert con.run_statement("USE toolspace", out=out)
+        assert con.run_statement(
+            'INSERT VERTEX person(name, age) VALUES 7:("carl", 33)',
+            out=out)
+        assert con.run_statement(
+            "FETCH PROP ON person 7 YIELD person.name, person.age",
+            out=out)
+        text = out.getvalue()
+        assert "carl" in text and "33" in text
+        # :batch file
+        script = tmp_path / "batch.ngql"
+        script.write_text("USE toolspace\n"
+                          'INSERT VERTEX person(name, age) VALUES '
+                          '8:("dora", 44)\n')
+        out2 = io.StringIO()
+        assert con.run_statement(f":batch {script}", out=out2)
+        out3 = io.StringIO()
+        con.run_statement("FETCH PROP ON person 8 YIELD person.name",
+                          out=out3)
+        assert "dora" in out3.getvalue()
+        # exit commands terminate
+        assert con.run_statement("exit") is False
+        # error path prints [ERROR
+        out4 = io.StringIO()
+        con2 = Console(seeded.graph_addr)
+        con2.run_statement("GO GO GADGET", out=out4)
+        assert "[ERROR" in out4.getvalue()
+
+
+class TestImporter:
+    def test_csv_vertex_and_edge_import(self, seeded, tmp_path):
+        from nebula_tpu.tools.importer import Importer
+        vfile = tmp_path / "people.csv"
+        vfile.write_text("100,eve,25\n101,frank,31\n102,grace,29\n")
+        efile = tmp_path / "likes.csv"
+        efile.write_text("100,101,5\n101,102,9\n")
+        client = seeded.client()
+        imp = Importer(client, "toolspace", batch_size=2)
+        import csv
+        with open(vfile, newline="") as f:
+            n = imp.load_vertices(csv.reader(f), "person", ["name", "age"])
+        assert n == 3
+        with open(efile, newline="") as f:
+            n = imp.load_edges(csv.reader(f), "likes", ["w"])
+        assert n == 2
+        resp = client.execute(
+            "GO FROM 100 OVER likes YIELD likes._dst, likes.w")
+        assert resp.ok()
+        assert [list(r) for r in resp.rows] == [[101, 5]]
+
+
+class TestWebService:
+    def test_status_flags_stats(self):
+        ws = WebService("testd").start()
+        base = f"http://127.0.0.1:{ws.port}"
+        try:
+            st = json.load(urllib.request.urlopen(f"{base}/status"))
+            assert st["status"] == "running" and st["name"] == "testd"
+
+            fl = json.load(urllib.request.urlopen(f"{base}/flags"))
+            assert "heartbeat_interval_secs" in fl
+
+            one = json.load(urllib.request.urlopen(
+                f"{base}/flags?names=heartbeat_interval_secs"))
+            assert list(one) == ["heartbeat_interval_secs"]
+
+            # runtime flag write (MUTABLE)
+            req = urllib.request.Request(
+                f"{base}/flags?name=max_handlers_per_req&value=7",
+                method="PUT")
+            json.load(urllib.request.urlopen(req))
+            from nebula_tpu.common.flags import flags
+            assert flags.get("max_handlers_per_req") == 7
+            flags.set("max_handlers_per_req", 10)
+
+            stats.add_value("web.test.counter", 5)
+            got = json.load(urllib.request.urlopen(f"{base}/get_stats"))
+            assert any("web.test.counter" in k for k in got)
+            txt = urllib.request.urlopen(
+                f"{base}/get_stats?format=text").read().decode()
+            assert "web.test.counter" in txt
+
+            try:
+                urllib.request.urlopen(f"{base}/nope")
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            ws.stop()
+
+
+class TestDaemonBuilders:
+    def test_metad_build_and_flagfile(self, tmp_path):
+        from nebula_tpu.daemons.common import load_flagfile
+        from nebula_tpu.common.flags import flags
+        conf = tmp_path / "metad.conf"
+        conf.write_text("# comment\n--heartbeat_interval_secs=3\n")
+        load_flagfile(str(conf))
+        assert flags.get("heartbeat_interval_secs") in (3, "3")
+        flags.set("heartbeat_interval_secs", 10)
+
+    def test_three_daemon_tcp_boot(self, tmp_path):
+        """metad + storaged + graphd over real sockets, console on top."""
+        import argparse
+        from nebula_tpu.daemons import metad
+        from nebula_tpu.interface.rpc import ClientManager, RpcServer
+        from nebula_tpu.cluster import StorageNode
+        from nebula_tpu.graph.service import ExecutionEngine, GraphService
+        from nebula_tpu.meta.client import MetaClient
+        from nebula_tpu.meta.schema_manager import ServerBasedSchemaManager
+        from nebula_tpu.storage.client import StorageClient
+
+        margs = argparse.Namespace(local_ip="127.0.0.1", port=0,
+                                   meta_server_addrs="127.0.0.1:0",
+                                   wal_path=None)
+        meta_service, _cm, meta_handler, _raft = metad.build(margs)
+        meta_rpc = RpcServer(meta_handler).start()
+
+        cm = ClientManager()
+        storage_rpc = RpcServer(None).start()
+        shost = f"127.0.0.1:{storage_rpc.addr.port}"
+        meta_service.rpc_heartBeat({"host": shost})
+        node = StorageNode(shost, [meta_rpc.addr], cm)
+        storage_rpc.handler = node.handler
+
+        meta_client = MetaClient([meta_rpc.addr], client_manager=cm)
+        meta_client.wait_for_metad_ready()
+        engine = ExecutionEngine(meta_client,
+                                 ServerBasedSchemaManager(meta_client),
+                                 StorageClient(meta_client,
+                                               client_manager=cm))
+        graph = GraphService(engine)
+        graph_rpc = RpcServer(graph).start()
+
+        con = Console(graph_rpc.addr)
+        out = io.StringIO()
+        con.run_statement("CREATE SPACE dspace(partition_num=2)", out=out)
+        node.meta_client.load_data()
+        meta_client.load_data()
+        con.run_statement("USE dspace", out=out)
+        con.run_statement("CREATE TAG t(x int)", out=out)
+        node.meta_client.load_data()
+        meta_client.load_data()
+        con.run_statement('INSERT VERTEX t(x) VALUES 5:(55)', out=out)
+        con.run_statement("FETCH PROP ON t 5 YIELD t.x", out=out)
+        assert "55" in out.getvalue()
+        assert "[ERROR" not in out.getvalue(), out.getvalue()
+
+        for srv in (graph_rpc, storage_rpc, meta_rpc):
+            srv.stop()
+        node.stop()
+        graph.sessions.stop()
+        meta_client.stop()
+
+
+class TestStoragePerfTool:
+    def test_perf_runner_inprocess(self):
+        from nebula_tpu.tools.perf_fixture import build_inprocess, vertex, edge
+        from nebula_tpu.tools.storage_perf import PerfRunner
+        cluster, sc, sid, tag_id, etype = build_inprocess()
+        try:
+            sc.add_vertices(sid, [vertex(1000 + i, tag_id, i)
+                                  for i in range(1, 20)])
+            sc.add_edges(sid, [edge(1000 + i, etype, 1000 + i % 19 + 1, i)
+                               for i in range(1, 20)])
+            r = PerfRunner(sc, sid, "getNeighbors", qps=0, total=50,
+                           threads=2, tag_id=tag_id, etype=etype).run()
+            assert r["requests"] == 50
+            assert r["p50_us"] > 0
+            w = PerfRunner(sc, sid, "addVertices", qps=0, total=30,
+                           threads=2, tag_id=tag_id, etype=etype).run()
+            assert w["requests"] == 30
+        finally:
+            cluster.stop()

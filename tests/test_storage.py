@@ -285,14 +285,22 @@ class TestStorageClient:
 
     def test_failed_part_tracking(self):
         cl, sid, client, sm = self.make_cluster()
-        # point one part's leader at a dead host
-        client.update_leader(sid, 1, "127.0.0.1:1")  # nothing listens
         et = sm.to_edge_type(sid, "follow").value()
         vids = list(range(20))  # covers all parts
-        resp = client.get_neighbors(sid, vids, [et])
+
+        # (a) with retries disabled, a dead leader is tracked as a failed
+        # part — with the REAL observed error, not a leader-changed mask
+        client.update_leader(sid, 1, "127.0.0.1:1")  # nothing listens
+        resp = client.get_neighbors(sid, vids, [et], retries=0)
         assert not resp.succeeded()
         assert 1 in resp.failed_parts
+        assert resp.failed_parts[1].code == ErrorCode.E_FAIL_TO_CONNECT
         assert resp.completeness() < 100
-        # leader cache invalidated -> next call heals
+
+        # (b) normal calls self-heal: connect failure means the request
+        # never executed, so the client invalidates the cached leader and
+        # re-routes from meta placement within the same call
+        client.update_leader(sid, 1, "127.0.0.1:1")
         resp2 = client.get_neighbors(sid, vids, [et])
         assert resp2.succeeded()
+        assert resp2.completeness() == 100
