@@ -1,0 +1,248 @@
+// Native KV engine — the RocksEngine-equivalent storage core.
+//
+// Capability parity with the reference's KVEngine/RocksEngine seam
+// (/root/reference/src/kvstore/RocksEngine.h:94-156): point get/put,
+// batched writes, prefix/range iteration, range deletes, snapshot
+// flush/ingest files, key count. Byte-ordered std::map under a
+// shared_mutex; the order-preserving key codec (keys.cc) guarantees the
+// map iterates edges in CSR order, so scans feed the TPU mirror with no
+// sort.
+//
+// C ABI, handle-based; buffers returned via neb_buf_free. Snapshot file
+// format matches the Python MemEngine exactly (big-endian u32 klen,vlen
+// frames) so flush/ingest interops across engines.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Engine {
+  std::map<std::string, std::string> table;
+  mutable std::shared_mutex mu;
+};
+
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+// next lexicographic string after all keys with this prefix
+bool prefix_upper_bound(const std::string& prefix, std::string* out) {
+  std::string ub = prefix;
+  while (!ub.empty()) {
+    if (uint8_t(ub.back()) != 0xFF) {
+      ub.back() = char(uint8_t(ub.back()) + 1);
+      *out = ub;
+      return true;
+    }
+    ub.pop_back();
+  }
+  return false;  // prefix is all 0xFF — scan to end
+}
+
+uint8_t* pack_kvs(const std::vector<std::pair<const std::string*,
+                                              const std::string*>>& rows,
+                  uint64_t* out_len) {
+  uint64_t total = 0;
+  for (auto& kv : rows) total += 8 + kv.first->size() + kv.second->size();
+  uint8_t* buf = static_cast<uint8_t*>(malloc(total ? total : 1));
+  uint8_t* p = buf;
+  for (auto& kv : rows) {
+    put_be32(p, uint32_t(kv.first->size()));
+    put_be32(p + 4, uint32_t(kv.second->size()));
+    p += 8;
+    memcpy(p, kv.first->data(), kv.first->size());
+    p += kv.first->size();
+    memcpy(p, kv.second->data(), kv.second->size());
+    p += kv.second->size();
+  }
+  *out_len = total;
+  return buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* neb_engine_create() { return new Engine(); }
+
+void neb_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+void neb_buf_free(uint8_t* p) { free(p); }
+
+int neb_put(void* h, const uint8_t* k, uint64_t klen, const uint8_t* v,
+            uint64_t vlen) {
+  auto* e = static_cast<Engine*>(h);
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  e->table[std::string(reinterpret_cast<const char*>(k), klen)] =
+      std::string(reinterpret_cast<const char*>(v), vlen);
+  return 0;
+}
+
+// frames: (u32be klen | u32be vlen | k | v)*
+int neb_multi_put(void* h, const uint8_t* buf, uint64_t len) {
+  auto* e = static_cast<Engine*>(h);
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  uint64_t pos = 0;
+  while (pos + 8 <= len) {
+    uint32_t klen = be32(buf + pos), vlen = be32(buf + pos + 4);
+    pos += 8;
+    if (pos + klen + vlen > len) return -1;
+    e->table[std::string(reinterpret_cast<const char*>(buf + pos), klen)] =
+        std::string(reinterpret_cast<const char*>(buf + pos + klen), vlen);
+    pos += klen + vlen;
+  }
+  return 0;
+}
+
+// returns value length, or -1 if absent; *out malloc'd (free via neb_buf_free)
+int64_t neb_get(void* h, const uint8_t* k, uint64_t klen, uint8_t** out) {
+  auto* e = static_cast<Engine*>(h);
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  auto it = e->table.find(std::string(reinterpret_cast<const char*>(k), klen));
+  if (it == e->table.end()) return -1;
+  *out = static_cast<uint8_t*>(malloc(it->second.size() ? it->second.size() : 1));
+  memcpy(*out, it->second.data(), it->second.size());
+  return int64_t(it->second.size());
+}
+
+int neb_remove(void* h, const uint8_t* k, uint64_t klen) {
+  auto* e = static_cast<Engine*>(h);
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  e->table.erase(std::string(reinterpret_cast<const char*>(k), klen));
+  return 0;
+}
+
+// frames: (u32be klen | k)*
+int neb_multi_remove(void* h, const uint8_t* buf, uint64_t len) {
+  auto* e = static_cast<Engine*>(h);
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  uint64_t pos = 0;
+  while (pos + 4 <= len) {
+    uint32_t klen = be32(buf + pos);
+    pos += 4;
+    if (pos + klen > len) return -1;
+    e->table.erase(std::string(reinterpret_cast<const char*>(buf + pos), klen));
+    pos += klen;
+  }
+  return 0;
+}
+
+int64_t neb_remove_range(void* h, const uint8_t* s, uint64_t slen,
+                         const uint8_t* t, uint64_t tlen) {
+  auto* e = static_cast<Engine*>(h);
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  auto lo = e->table.lower_bound(
+      std::string(reinterpret_cast<const char*>(s), slen));
+  auto hi = e->table.lower_bound(
+      std::string(reinterpret_cast<const char*>(t), tlen));
+  int64_t n = std::distance(lo, hi);
+  e->table.erase(lo, hi);
+  return n;
+}
+
+int64_t neb_remove_prefix(void* h, const uint8_t* p, uint64_t plen) {
+  auto* e = static_cast<Engine*>(h);
+  std::string prefix(reinterpret_cast<const char*>(p), plen);
+  std::string ub;
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  auto lo = e->table.lower_bound(prefix);
+  auto hi = prefix_upper_bound(prefix, &ub) ? e->table.lower_bound(ub)
+                                            : e->table.end();
+  int64_t n = std::distance(lo, hi);
+  e->table.erase(lo, hi);
+  return n;
+}
+
+// packed (u32be klen | u32be vlen | k | v)* of the prefix scan
+uint8_t* neb_scan_prefix(void* h, const uint8_t* p, uint64_t plen,
+                         uint64_t* out_len, uint64_t* out_count) {
+  auto* e = static_cast<Engine*>(h);
+  std::string prefix(reinterpret_cast<const char*>(p), plen);
+  std::string ub;
+  bool bounded = prefix_upper_bound(prefix, &ub);
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  std::vector<std::pair<const std::string*, const std::string*>> rows;
+  auto it = e->table.lower_bound(prefix);
+  auto end = bounded ? e->table.lower_bound(ub) : e->table.end();
+  for (; it != end; ++it) rows.emplace_back(&it->first, &it->second);
+  *out_count = rows.size();
+  return pack_kvs(rows, out_len);
+}
+
+uint8_t* neb_scan_range(void* h, const uint8_t* s, uint64_t slen,
+                        const uint8_t* t, uint64_t tlen, uint64_t* out_len,
+                        uint64_t* out_count) {
+  auto* e = static_cast<Engine*>(h);
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  std::vector<std::pair<const std::string*, const std::string*>> rows;
+  auto it = e->table.lower_bound(
+      std::string(reinterpret_cast<const char*>(s), slen));
+  auto end = e->table.lower_bound(
+      std::string(reinterpret_cast<const char*>(t), tlen));
+  for (; it != end; ++it) rows.emplace_back(&it->first, &it->second);
+  *out_count = rows.size();
+  return pack_kvs(rows, out_len);
+}
+
+int64_t neb_total_keys(void* h) {
+  auto* e = static_cast<Engine*>(h);
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  return int64_t(e->table.size());
+}
+
+// snapshot files: identical format to the Python MemEngine (">II" frames)
+int neb_flush(void* h, const char* path) {
+  auto* e = static_cast<Engine*>(h);
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  {
+    std::shared_lock<std::shared_mutex> g(e->mu);
+    uint8_t hdr[8];
+    for (auto& kv : e->table) {
+      put_be32(hdr, uint32_t(kv.first.size()));
+      put_be32(hdr + 4, uint32_t(kv.second.size()));
+      if (fwrite(hdr, 1, 8, f) != 8 ||
+          fwrite(kv.first.data(), 1, kv.first.size(), f) != kv.first.size() ||
+          fwrite(kv.second.data(), 1, kv.second.size(), f) !=
+              kv.second.size()) {
+        fclose(f);
+        remove(tmp.c_str());
+        return -1;
+      }
+    }
+  }
+  fclose(f);
+  return rename(tmp.c_str(), path);
+}
+
+int neb_ingest(void* h, const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> data(static_cast<size_t>(n), 0);
+  if (n && fread(data.data(), 1, size_t(n), f) != size_t(n)) {
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  return neb_multi_put(h, data.data(), uint64_t(n));
+}
+
+}  // extern "C"

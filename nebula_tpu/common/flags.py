@@ -123,6 +123,8 @@ flags.define("expired_threshold_sec", 10 * 60, "host liveness TTL")
 flags.define("max_handlers_per_req", 10, "per-request bucket fan-out")
 flags.define("min_vertices_per_bucket", 3, "min vertices per bucket")
 flags.define("storage_backend", "auto", "storage traversal backend: cpu|tpu|auto")
+flags.define("storage_engine", "auto",
+             "kv engine: native (C++ kv_engine.cc) | mem | auto")
 flags.define("raft_heartbeat_interval_ms", 500, "raft leader heartbeat")
 flags.define("raft_election_timeout_ms", 1500, "raft election timeout base")
 flags.define("wal_buffer_size_bytes", 256 * 1024, "wal flush buffer")

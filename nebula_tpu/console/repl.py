@@ -80,11 +80,20 @@ class Console:
         if low in ("exit", "quit"):
             return False
         if low.startswith(":batch"):
-            path = stmt.split(None, 1)[1].rstrip(";")
-            with open(path) as f:
-                for line in f:
-                    if line.strip() and not line.strip().startswith("#"):
-                        self.run_statement(line, out=out)
+            parts = stmt.split(None, 1)
+            if len(parts) < 2:
+                print("[ERROR]: usage: :batch <file>", file=out)
+                return True
+            path = parts[1].rstrip(";")
+            try:
+                with open(path) as f:
+                    lines = f.readlines()
+            except OSError as e:
+                print(f"[ERROR]: {e}", file=out)
+                return True
+            for line in lines:
+                if line.strip() and not line.strip().startswith("#"):
+                    self.run_statement(line, out=out)
             return True
         resp = self.client.execute(stmt)
         if resp.ok():

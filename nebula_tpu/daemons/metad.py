@@ -55,6 +55,9 @@ def main(argv=None) -> int:
     apply_flag_overrides(args.flag)
     write_pidfile(args.pid_file)
 
+    from ..native import ensure_built
+    ensure_built()      # compile the C++ engine before serving, not during
+
     service, cm, handler, raft_service = build(args)
     rpc = RpcServer(handler, host=args.local_ip, port=args.port).start()
     ws = WebService("nebula-metad", host=args.local_ip,

@@ -32,6 +32,9 @@ def main(argv=None) -> int:
     apply_flag_overrides(args.flag)
     write_pidfile(args.pid_file)
 
+    from ..native import ensure_built
+    ensure_built()      # compile the C++ engine before serving, not during
+
     cm = ClientManager()
     local = f"{args.local_ip}:{args.port}"
     metas = parse_meta_addrs(args.meta_server_addrs)

@@ -1,0 +1,150 @@
+"""NativeEngine — the C++ storage engine behind the KVEngine seam.
+
+RocksEngine-equivalent (reference RocksEngine.h:94-156) implemented in
+native/kv_engine.cc (byte-ordered C++ map, shared-mutex concurrency,
+packed-frame batch ABI). Snapshot files interop byte-for-byte with
+MemEngine's flush/ingest format, so a cluster can mix engines and the
+SST-generator output loads into either.
+"""
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status
+from ..native import lib
+from .engine import KVEngine
+
+KV = Tuple[bytes, bytes]
+_FRAME = struct.Struct(">II")
+_KLEN = struct.Struct(">I")
+
+
+def native_available() -> bool:
+    return lib() is not None
+
+
+class NativeEngine(KVEngine):
+    def __init__(self, compaction_filter: Optional[Callable[[bytes, bytes],
+                                                            bool]] = None):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library not built (make -C native)")
+        self._L = L
+        self._h = L.neb_engine_create()
+        self.compaction_filter = compaction_filter
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._L.neb_engine_destroy(self._h)
+                self._h = None
+        except Exception:    # noqa: BLE001 — interpreter teardown
+            pass
+
+    # ---- reads ------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._L.neb_get(self._h, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._L.neb_buf_free(out)
+
+    def _unpack_scan(self, ptr, total: int) -> Iterator[KV]:
+        try:
+            data = ctypes.string_at(ptr, total)
+        finally:
+            self._L.neb_buf_free(ptr)
+        pos, n = 0, len(data)
+        while pos + 8 <= n:
+            klen, vlen = _FRAME.unpack_from(data, pos)
+            pos += 8
+            yield data[pos:pos + klen], data[pos + klen:pos + klen + vlen]
+            pos += klen + vlen
+
+    def prefix(self, prefix: bytes) -> Iterator[KV]:
+        total = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        ptr = self._L.neb_scan_prefix(self._h, prefix, len(prefix),
+                                      ctypes.byref(total),
+                                      ctypes.byref(count))
+        return self._unpack_scan(ptr, total.value)
+
+    def range(self, start: bytes, end: bytes) -> Iterator[KV]:
+        total = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        ptr = self._L.neb_scan_range(self._h, start, len(start), end,
+                                     len(end), ctypes.byref(total),
+                                     ctypes.byref(count))
+        return self._unpack_scan(ptr, total.value)
+
+    def scan_prefix_packed(self, prefix: bytes) -> bytes:
+        """Raw packed frames of a prefix scan — zero-rework input for the
+        native batch codec (CSR mirror fold)."""
+        total = ctypes.c_uint64()
+        count = ctypes.c_uint64()
+        ptr = self._L.neb_scan_prefix(self._h, prefix, len(prefix),
+                                      ctypes.byref(total),
+                                      ctypes.byref(count))
+        try:
+            return ctypes.string_at(ptr, total.value)
+        finally:
+            self._L.neb_buf_free(ptr)
+
+    def total_keys(self) -> int:
+        return int(self._L.neb_total_keys(self._h))
+
+    # ---- writes -----------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> Status:
+        self._L.neb_put(self._h, key, len(key), value, len(value))
+        return Status.OK()
+
+    def multi_put(self, kvs: List[KV]) -> Status:
+        buf = bytearray()
+        for k, v in kvs:
+            buf += _FRAME.pack(len(k), len(v))
+            buf += k
+            buf += v
+        rc = self._L.neb_multi_put(self._h, bytes(buf), len(buf))
+        return Status.OK() if rc == 0 else Status.Error("bad batch")
+
+    def remove(self, key: bytes) -> Status:
+        self._L.neb_remove(self._h, key, len(key))
+        return Status.OK()
+
+    def multi_remove(self, keys: List[bytes]) -> Status:
+        buf = bytearray()
+        for k in keys:
+            buf += _KLEN.pack(len(k))
+            buf += k
+        rc = self._L.neb_multi_remove(self._h, bytes(buf), len(buf))
+        return Status.OK() if rc == 0 else Status.Error("bad batch")
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        self._L.neb_remove_prefix(self._h, prefix, len(prefix))
+        return Status.OK()
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        self._L.neb_remove_range(self._h, start, len(start), end, len(end))
+        return Status.OK()
+
+    # ---- files ------------------------------------------------------
+    def flush(self, path: str) -> Status:
+        rc = self._L.neb_flush(self._h, path.encode())
+        return Status.OK() if rc == 0 else Status.Error(f"flush {path}")
+
+    def ingest(self, path: str) -> Status:
+        rc = self._L.neb_ingest(self._h, path.encode())
+        return Status.OK() if rc == 0 else \
+            Status.Error(f"ingest {path}", ErrorCode.E_NOT_FOUND)
+
+    def compact(self) -> Status:
+        if self.compaction_filter is not None:
+            doomed = [k for k, v in self.prefix(b"")
+                      if self.compaction_filter(k, v)]
+            self.multi_remove(doomed)
+        return Status.OK()

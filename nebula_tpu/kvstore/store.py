@@ -103,6 +103,15 @@ class NebulaStore:
         if path:
             os.makedirs(os.path.join(path, f"nebula_space_{space_id}"),
                         exist_ok=True)
+        from ..common.flags import flags
+        kind = flags.get("storage_engine", "auto")
+        if kind in ("auto", "native"):
+            try:
+                from .native import NativeEngine
+                return NativeEngine(compaction_filter=cf)
+            except (RuntimeError, OSError):
+                if kind == "native":
+                    raise
         return MemEngine(compaction_filter=cf)
 
     def add_part(self, space_id: GraphSpaceID, part_id: PartitionID,

@@ -36,21 +36,29 @@ class LogEntry:
 
 
 class FileBasedWal:
-    def __init__(self, wal_dir: str, buffer_size: int = 256 * 1024):
+    """``wal_dir=None`` runs the same log fully in memory (tests, metad's
+    transient parts) — one implementation, optional persistence."""
+
+    def __init__(self, wal_dir: Optional[str] = None,
+                 buffer_size: int = 256 * 1024):
         self.dir = wal_dir
-        os.makedirs(wal_dir, exist_ok=True)
+        if wal_dir:
+            os.makedirs(wal_dir, exist_ok=True)
         self.buffer_size = buffer_size
         self._buf = bytearray()
         self._fh = None
         self._cur_seg_path: Optional[str] = None
         self._cur_seg_bytes = 0
-        # entries held in memory: full replay cache (framework-scale WALs are
-        # bounded by snapshotting; see raftex/snapshot.py)
+        # entries held in memory: full replay cache (bounded by the raft
+        # snapshot floor via clean_up_to — raftex service polling)
         self._entries: List[LogEntry] = []
-        self._load()
+        if wal_dir:
+            self._load()
 
     # ---- recovery ---------------------------------------------------
     def _segments(self) -> List[Tuple[int, str]]:
+        if not self.dir:
+            return []
         segs = []
         for name in os.listdir(self.dir):
             if name.startswith("wal.") and name.endswith(".log"):
@@ -127,7 +135,8 @@ class FileBasedWal:
         return True
 
     def flush(self) -> None:
-        if not self._buf:
+        if not self._buf or not self.dir:
+            self._buf.clear()
             return
         if self._fh is None or self._cur_seg_bytes >= _SEGMENT_BYTES:
             if self._fh:

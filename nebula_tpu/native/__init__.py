@@ -1,0 +1,113 @@
+"""ctypes loader for the native library (native/libnebula_native.so).
+
+The native layer supplies the RocksEngine-equivalent storage core and
+the batch row/key codec (reference's C++ dataman + kvstore engine,
+SURVEY.md §2.6-2.7). Pure-Python fallbacks exist for every entry point —
+``lib()`` returning None simply means slower paths.
+
+Build: ``make -C native`` (repo root).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "libnebula_native.so")
+
+
+def _sig(fn, restype, argtypes):
+    fn.restype = restype
+    fn.argtypes = argtypes
+    return fn
+
+
+def ensure_built() -> bool:
+    """Compile the native library if missing, then load it. Call this
+    from process STARTUP paths only (daemon mains, test session setup,
+    CLI tools) — never from a serving thread: the compile can take tens
+    of seconds and lib() itself deliberately never builds."""
+    global _TRIED
+    if not os.path.exists(_SO_PATH):
+        makefile = os.path.join(_REPO_ROOT, "native", "Makefile")
+        if os.path.exists(makefile):
+            try:
+                subprocess.run(["make", "-C", os.path.dirname(makefile)],
+                               capture_output=True, timeout=120, check=True)
+            except Exception:            # noqa: BLE001 — fall back to Python
+                return False
+        _TRIED = False                   # allow lib() to retry the load
+    return lib() is not None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """Load (once) and return the native library, or None if the .so is
+    absent (build it via ensure_built / ``make -C native``)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        L = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    vp = ctypes.c_void_p
+
+    # engine
+    _sig(L.neb_engine_create, vp, [])
+    _sig(L.neb_engine_destroy, None, [vp])
+    _sig(L.neb_buf_free, None, [u8p])
+    _sig(L.neb_put, ctypes.c_int,
+         [vp, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+          ctypes.c_uint64])
+    _sig(L.neb_multi_put, ctypes.c_int, [vp, ctypes.c_char_p,
+                                         ctypes.c_uint64])
+    _sig(L.neb_get, ctypes.c_int64,
+         [vp, ctypes.c_char_p, ctypes.c_uint64, ctypes.POINTER(u8p)])
+    _sig(L.neb_remove, ctypes.c_int, [vp, ctypes.c_char_p, ctypes.c_uint64])
+    _sig(L.neb_multi_remove, ctypes.c_int, [vp, ctypes.c_char_p,
+                                            ctypes.c_uint64])
+    _sig(L.neb_remove_range, ctypes.c_int64,
+         [vp, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+          ctypes.c_uint64])
+    _sig(L.neb_remove_prefix, ctypes.c_int64,
+         [vp, ctypes.c_char_p, ctypes.c_uint64])
+    _sig(L.neb_scan_prefix, u8p,
+         [vp, ctypes.c_char_p, ctypes.c_uint64, u64p, u64p])
+    _sig(L.neb_scan_range, u8p,
+         [vp, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+          ctypes.c_uint64, u64p, u64p])
+    _sig(L.neb_total_keys, ctypes.c_int64, [vp])
+    _sig(L.neb_flush, ctypes.c_int, [vp, ctypes.c_char_p])
+    _sig(L.neb_ingest, ctypes.c_int, [vp, ctypes.c_char_p])
+
+    # codec
+    _sig(L.neb_decode_field, ctypes.c_int64,
+         [u8p, u64p, u64p, ctypes.c_int64, u8p, ctypes.c_int32,
+          ctypes.c_int32, ctypes.c_uint64, i64p, f64p, u64p, u64p, u8p])
+    _sig(L.neb_parse_keys, None,
+         [u8p, u64p, u64p, ctypes.c_int64, u8p, i32p, i64p, i32p, i64p,
+          i64p, i64p])
+    _sig(L.neb_split_frames, ctypes.c_int64,
+         [u8p, ctypes.c_uint64, u64p, u64p, u64p, u64p, ctypes.c_int64])
+
+    _LIB = L
+    return _LIB
+
+
+def available() -> bool:
+    return lib() is not None

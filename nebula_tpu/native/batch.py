@@ -1,0 +1,129 @@
+"""Batch codec wrappers — numpy in, numpy out, one C call per column.
+
+These are the vectorized equivalents of per-row RowReader/KeyUtils loops
+(reference RowReader.h / NebulaKeyUtils.h), used by the CSR mirror fold
+(tpu/csr.py) where Python-loop decode dominates build time.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..interface.common import Schema, SupportedType
+from . import lib
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_F64P = ctypes.POINTER(ctypes.c_double)
+
+
+def _p(arr: np.ndarray, ptype):
+    return arr.ctypes.data_as(ptype)
+
+
+def concat_blobs(blobs: List[bytes]) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """-> (concatenated, offsets u64[n], lengths u64[n])."""
+    lens = np.fromiter((len(b) for b in blobs), dtype=np.uint64,
+                       count=len(blobs))
+    offs = np.zeros(len(blobs), dtype=np.uint64)
+    if len(blobs):
+        np.cumsum(lens[:-1], out=offs[1:])
+    return b"".join(blobs), offs, lens
+
+
+def schema_types(schema: Schema) -> np.ndarray:
+    return np.asarray([int(c.type) for c in schema.columns], dtype=np.uint8)
+
+
+class FieldColumns:
+    """Result of one neb_decode_field call."""
+
+    __slots__ = ("i64", "f64", "str_off", "str_len", "valid", "blob")
+
+    def __init__(self, n: int, blob: bytes):
+        self.i64 = np.zeros(n, dtype=np.int64)
+        self.f64 = np.zeros(n, dtype=np.float64)
+        self.str_off = np.zeros(n, dtype=np.uint64)
+        self.str_len = np.zeros(n, dtype=np.uint64)
+        self.valid = np.zeros(n, dtype=np.uint8)
+        self.blob = blob
+
+    def strings(self) -> List[str]:
+        out = []
+        for off, ln, ok in zip(self.str_off, self.str_len, self.valid):
+            out.append(self.blob[int(off):int(off + ln)].decode()
+                       if ok == 1 else "")
+        return out
+
+
+def decode_field(blob: bytes, offs: np.ndarray, lens: np.ndarray,
+                 schema: Schema, field: int) -> Optional[FieldColumns]:
+    """Decode one schema column across all rows; None if lib missing."""
+    L = lib()
+    if L is None:
+        return None
+    n = len(offs)
+    res = FieldColumns(n, blob)
+    if n == 0:
+        return res
+    types = schema_types(schema)
+    L.neb_decode_field(
+        ctypes.cast(ctypes.c_char_p(blob), _U8P), _p(offs, _U64P),
+        _p(lens, _U64P), n, _p(types, _U8P), len(types), field,
+        schema.version, _p(res.i64, _I64P), _p(res.f64, _F64P),
+        _p(res.str_off, _U64P), _p(res.str_len, _U64P), _p(res.valid, _U8P))
+    return res
+
+
+class ParsedKeys:
+    __slots__ = ("kind", "part", "a", "b", "c", "d", "ver")
+
+    def __init__(self, n: int):
+        self.kind = np.zeros(n, dtype=np.uint8)   # 1 vertex, 2 edge
+        self.part = np.zeros(n, dtype=np.int32)
+        self.a = np.zeros(n, dtype=np.int64)      # vid / src
+        self.b = np.zeros(n, dtype=np.int32)      # tag / etype
+        self.c = np.zeros(n, dtype=np.int64)      # rank
+        self.d = np.zeros(n, dtype=np.int64)      # dst
+        self.ver = np.zeros(n, dtype=np.int64)
+
+
+def parse_keys(blob: bytes, offs: np.ndarray,
+               lens: np.ndarray) -> Optional[ParsedKeys]:
+    L = lib()
+    if L is None:
+        return None
+    n = len(offs)
+    out = ParsedKeys(n)
+    if n == 0:
+        return out
+    L.neb_parse_keys(
+        ctypes.cast(ctypes.c_char_p(blob), _U8P), _p(offs, _U64P),
+        _p(lens, _U64P), n, _p(out.kind, _U8P), _p(out.part, _I32P),
+        _p(out.a, _I64P), _p(out.b, _I32P), _p(out.c, _I64P),
+        _p(out.d, _I64P), _p(out.ver, _I64P))
+    return out
+
+
+def split_frames(packed: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                                  np.ndarray, np.ndarray]]:
+    """Split a packed (klen,vlen,k,v)* scan buffer -> key/value slices."""
+    L = lib()
+    if L is None:
+        return None
+    # capacity: every frame needs >= 8 bytes of header
+    cap = max(len(packed) // 8, 1)
+    ko = np.zeros(cap, dtype=np.uint64)
+    kl = np.zeros(cap, dtype=np.uint64)
+    vo = np.zeros(cap, dtype=np.uint64)
+    vl = np.zeros(cap, dtype=np.uint64)
+    n = L.neb_split_frames(
+        ctypes.cast(ctypes.c_char_p(packed), _U8P), len(packed),
+        _p(ko, _U64P), _p(kl, _U64P), _p(vo, _U64P), _p(vl, _U64P), cap)
+    if n < 0:
+        return None
+    return ko[:n], kl[:n], vo[:n], vl[:n]

@@ -98,7 +98,7 @@ class RaftPart:
         self.cm = client_manager
         self.executor = executor
         self._lock = threading.RLock()
-        self.wal = FileBasedWal(wal_dir) if wal_dir else _MemWal()
+        self.wal = FileBasedWal(wal_dir)
 
         self.role = Role.LEARNER if as_learner else Role.FOLLOWER
         self.term = self.wal.last_log_term()
@@ -312,7 +312,10 @@ class RaftPart:
     def _replicate(self, term: int, prev_id: int, prev_term: int,
                    entries: List[LogEntry], committed: int,
                    peers: List[Peer]) -> bool:
-        quorum = self._quorum()
+        # quorum from the snapshot taken under the lock in _drive —
+        # self.peers may be mutated concurrently (update_peers)
+        voters_n = 1 + sum(1 for p in peers if not p.is_learner)
+        quorum = voters_n // 2 + 1
         if quorum <= 1 and not peers:
             return True
         needed = quorum - 1
@@ -814,77 +817,3 @@ class RaftPart:
                         self.wal.last_log_id() - 1)
             if floor > 0:
                 self.wal.clean_up_to(floor)
-
-
-class _MemWal:
-    """In-memory WAL (tests / metad's transient parts): same interface as
-    FileBasedWal minus durability."""
-
-    def __init__(self):
-        self._entries: List[LogEntry] = []
-
-    def first_log_id(self) -> int:
-        return self._entries[0].log_id if self._entries else 0
-
-    def last_log_id(self) -> int:
-        return self._entries[-1].log_id if self._entries else 0
-
-    def last_log_term(self) -> int:
-        return self._entries[-1].term if self._entries else 0
-
-    def get_term(self, log_id: int) -> int:
-        if not self._entries:
-            return 0
-        idx = log_id - self._entries[0].log_id
-        if 0 <= idx < len(self._entries):
-            return self._entries[idx].term
-        return 0
-
-    def append_log(self, log_id: int, term: int, msg: bytes) -> bool:
-        if self._entries and log_id != self._entries[-1].log_id + 1:
-            return False
-        self._entries.append(LogEntry(log_id, term, msg))
-        return True
-
-    def append_logs(self, entries: List[LogEntry]) -> bool:
-        for e in entries:
-            if not self.append_log(e.log_id, e.term, e.msg):
-                return False
-        return True
-
-    def rollback_to_log(self, log_id: int) -> bool:
-        if not self._entries:
-            return True
-        first = self._entries[0].log_id
-        keep = max(log_id - first + 1, 0)
-        del self._entries[keep:]
-        return True
-
-    def reset(self) -> None:
-        self._entries.clear()
-
-    def clean_up_to(self, log_id: int) -> None:
-        if not self._entries:
-            return
-        first = self._entries[0].log_id
-        drop = log_id - first + 1
-        if drop > 0:
-            self._entries = self._entries[drop:]
-
-    def iterate(self, first: int, last: Optional[int] = None):
-        if not self._entries:
-            return
-        lo = self._entries[0].log_id
-        hi = self._entries[-1].log_id
-        if last is None or last > hi:
-            last = hi
-        i = max(first, lo) - lo
-        while i < len(self._entries) and self._entries[i].log_id <= last:
-            yield self._entries[i]
-            i += 1
-
-    def flush(self) -> None:
-        pass
-
-    def close(self) -> None:
-        pass

@@ -75,14 +75,24 @@ class RaftexService:
             return self.parts.get((space_id, part_id))
 
     # ---------------------------------------------------------- polling
+    _WAL_CLEAN_EVERY_TICKS = 200          # ~10 s at the 50 ms tick
+
     def _status_polling(self) -> None:
+        ticks = 0
         while not self._stop.wait(_TICK_S):
             now = time.monotonic()
+            ticks += 1
+            clean = ticks % self._WAL_CLEAN_EVERY_TICKS == 0
             with self._lock:
                 parts = list(self.parts.values())
             for p in parts:
                 try:
                     p.tick(now)
+                    if clean:
+                        # bound WAL growth (keeps raft_wal_keep_logs of
+                        # catch-up window; snapshot transfer covers peers
+                        # lagging further)
+                        p.cleanup_wal()
                 except Exception:     # noqa: BLE001 — polling must survive
                     pass
 

@@ -51,15 +51,34 @@ class Importer:
         if not resp.ok():
             raise RuntimeError(f"{resp.error_msg}\n  in: {stmt[:200]}")
 
+    def _string_props(self, kind: str, name: str, props: List[str]):
+        """(string-typed props, describe_ok) — DESCRIBE drives quoting so
+        numeric-looking string values ('007', 'true') stay quoted; only
+        when DESCRIBE fails do we fall back to per-value sniffing."""
+        resp = self.client.execute(f"DESCRIBE {kind} {name}")
+        if resp.ok() and resp.rows:
+            types = {row[0]: str(row[1]).lower() for row in resp.rows}
+            return {p for p in props if types.get(p) == "string"}, True
+        return set(), False
+
+    def _fmt_values(self, rest, props: List[str], str_props: set,
+                    sniff: bool) -> str:
+        out = []
+        for p, v in zip(props, rest):
+            is_str = p in str_props if not sniff else not _looks_numeric(v)
+            out.append(_lit(v, is_str))
+        return ", ".join(out)
+
     def load_vertices(self, rows, tag: str, props: List[str]) -> int:
+        str_props, described = self._string_props("TAG", tag, props)
+        sniff = not described
         n = 0
         for chunk in _chunks(rows, self.batch):
             values = []
             for row in chunk:
                 vid, rest = row[0], row[1:len(props) + 1]
-                vals = ", ".join(_lit(v, not _looks_numeric(v))
-                                 for v in rest)
-                values.append(f"{vid}:({vals})")
+                values.append(
+                    f"{vid}:({self._fmt_values(rest, props, str_props, sniff)})")
             self._run(f"INSERT VERTEX {tag}({', '.join(props)}) "
                       f"VALUES {', '.join(values)}")
             n += len(chunk)
@@ -67,6 +86,8 @@ class Importer:
 
     def load_edges(self, rows, edge: str, props: List[str],
                    with_rank: bool = False) -> int:
+        str_props, described = self._string_props("EDGE", edge, props)
+        sniff = not described
         n = 0
         for chunk in _chunks(rows, self.batch):
             values = []
@@ -78,9 +99,8 @@ class Importer:
                     rank = f"@{row[2]}"
                     off = 3
                 rest = row[off:off + len(props)]
-                vals = ", ".join(_lit(v, not _looks_numeric(v))
-                                 for v in rest)
-                values.append(f"{src} -> {dst}{rank}:({vals})")
+                values.append(f"{src} -> {dst}{rank}:"
+                              f"({self._fmt_values(rest, props, str_props, sniff)})")
             self._run(f"INSERT EDGE {edge}({', '.join(props)}) "
                       f"VALUES {', '.join(values)}")
             n += len(chunk)

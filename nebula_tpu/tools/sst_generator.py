@@ -1,0 +1,183 @@
+"""SST generator — offline CSV → engine-snapshot bulk load files.
+
+Capability parity with the reference's Spark SST generator + native
+codec (tools/spark-sstfile-generator SparkSstFileGenerator.scala,
+tools/native-client): partitions input rows by the same ``id_hash`` the
+cluster uses, encodes storage keys/rows with the production codec, and
+writes per-engine snapshot files (the flush/ingest frame format shared
+by MemEngine and the C++ NativeEngine) ready for
+``INGEST`` / ``NebulaStore.ingest``.
+
+Vertex CSV: vid,prop1,...      Edge CSV: src,dst[,rank],prop1,...
+
+Run: ``python -m nebula_tpu.tools.sst_generator --out dir \
+      --parts 6 --schema '{"tag": {...}}' ...`` (see --help)
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import struct
+import sys
+import time
+from typing import Dict, List, Tuple
+
+from ..codec.rows import encode_row
+from ..common.clock import inverted_version
+from ..common.keys import KeyUtils, id_hash
+from ..interface.common import ColumnDef, Schema, SupportedType
+
+_FRAME = struct.Struct(">II")
+
+_TYPES = {
+    "int": SupportedType.INT,
+    "string": SupportedType.STRING,
+    "double": SupportedType.DOUBLE,
+    "float": SupportedType.FLOAT,
+    "bool": SupportedType.BOOL,
+    "timestamp": SupportedType.TIMESTAMP,
+}
+
+
+def parse_schema(spec: str) -> Schema:
+    """"name:string,age:int" -> Schema (version 0)."""
+    cols = []
+    for part in spec.split(","):
+        name, _, t = part.partition(":")
+        cols.append(ColumnDef(name.strip(), _TYPES[t.strip() or "string"]))
+    return Schema(columns=cols)
+
+
+def _coerce(v: str, t: SupportedType):
+    if t in (SupportedType.INT, SupportedType.TIMESTAMP,
+             SupportedType.VID):
+        return int(v)
+    if t in (SupportedType.DOUBLE, SupportedType.FLOAT):
+        return float(v)
+    if t == SupportedType.BOOL:
+        return v.lower() in ("1", "true", "yes")
+    return v
+
+
+class SstGenerator:
+    def __init__(self, num_parts: int):
+        self.num_parts = num_parts
+        # part -> sorted rows accumulate here; one output file per part
+        self.parts: Dict[int, List[Tuple[bytes, bytes]]] = {
+            p: [] for p in range(1, num_parts + 1)}
+        self.count = 0
+
+    def add_vertex(self, vid: int, tag_id: int, schema: Schema,
+                   values: dict) -> None:
+        part = id_hash(vid, self.num_parts)
+        key = KeyUtils.vertex_key(part, vid, tag_id, inverted_version())
+        self.parts[part].append((key, encode_row(schema, values)))
+        self.count += 1
+
+    def add_edge(self, src: int, etype: int, rank: int, dst: int,
+                 schema: Schema, values: dict) -> None:
+        """Writes BOTH directions like the mutate executors (out-edge
+        under +etype at src's part, in-edge under -etype at dst's part)."""
+        ver = inverted_version()
+        row = encode_row(schema, values)
+        out_part = id_hash(src, self.num_parts)
+        self.parts[out_part].append(
+            (KeyUtils.edge_key(out_part, src, etype, rank, dst, ver), row))
+        in_part = id_hash(dst, self.num_parts)
+        self.parts[in_part].append(
+            (KeyUtils.edge_key(in_part, dst, -etype, rank, src, ver), row))
+        self.count += 1
+
+    def load_vertex_csv(self, path: str, tag_id: int, schema: Schema,
+                        skip_header: bool = False) -> int:
+        n = 0
+        with open(path, newline="") as f:
+            rows = csv.reader(f)
+            if skip_header:
+                next(rows, None)
+            for row in rows:
+                values = {c.name: _coerce(row[1 + i], c.type)
+                          for i, c in enumerate(schema.columns)}
+                self.add_vertex(int(row[0]), tag_id, schema, values)
+                n += 1
+        return n
+
+    def load_edge_csv(self, path: str, etype: int, schema: Schema,
+                      with_rank: bool = False,
+                      skip_header: bool = False) -> int:
+        n = 0
+        off = 3 if with_rank else 2
+        with open(path, newline="") as f:
+            rows = csv.reader(f)
+            if skip_header:
+                next(rows, None)
+            for row in rows:
+                rank = int(row[2]) if with_rank else 0
+                values = {c.name: _coerce(row[off + i], c.type)
+                          for i, c in enumerate(schema.columns)}
+                self.add_edge(int(row[0]), etype, rank, int(row[1]),
+                              schema, values)
+                n += 1
+        return n
+
+    def write(self, out_dir: str) -> List[str]:
+        """One snapshot file per PART (``bulk.partN.snap``). The names
+        deliberately carry no ``.engineN`` suffix: a host's part→engine
+        assignment is add-order-dependent (NebulaStore.add_part round-
+        robins by arrival), which an offline generator cannot know —
+        suffixed files would route into the wrong engine and the rows
+        would be invisible. Unsuffixed files load into every engine;
+        reads are part-prefix-filtered so extra copies are unreachable
+        (only memory is spent), and operators can feed each node only the
+        part files it hosts."""
+        import os
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        for part in sorted(self.parts):
+            rows = self.parts[part]
+            if not rows:
+                continue
+            rows.sort()
+            path = os.path.join(out_dir, f"bulk.part{part}.snap")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                for k, v in rows:
+                    f.write(_FRAME.pack(len(k), len(v)))
+                    f.write(k)
+                    f.write(v)
+            os.replace(tmp, path)
+            paths.append(path)
+        return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="sst-generator")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--parts", type=int, required=True)
+    p.add_argument("--vertex", action="append", default=[], nargs=3,
+                   metavar=("CSV", "TAG_ID", "SCHEMA"),
+                   help='e.g. players.csv 10 "name:string,age:int"')
+    p.add_argument("--edge", action="append", default=[], nargs=3,
+                   metavar=("CSV", "ETYPE", "SCHEMA"))
+    p.add_argument("--skip-header", action="store_true")
+    args = p.parse_args(argv)
+
+    gen = SstGenerator(args.parts)
+    t0 = time.perf_counter()
+    for path, tag_id, spec in args.vertex:
+        gen.load_vertex_csv(path, int(tag_id), parse_schema(spec),
+                            args.skip_header)
+    for path, etype, spec in args.edge:
+        gen.load_edge_csv(path, int(etype), parse_schema(spec),
+                          skip_header=args.skip_header)
+    paths = gen.write(args.out)
+    dt = time.perf_counter() - t0
+    print(f"wrote {gen.count} rows to {len(paths)} snapshot files "
+          f"in {dt:.2f}s", file=sys.stderr)
+    for pth in paths:
+        print(pth)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,3 +15,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Build the native library once per test session (engine default is
+# "auto": C++ engine when built, MemEngine otherwise).
+try:
+    from nebula_tpu.native import ensure_built
+    ensure_built()
+except Exception:    # noqa: BLE001 — tests fall back to the Python paths
+    pass

@@ -107,6 +107,23 @@ class TestImporter:
         assert resp.ok()
         assert [list(r) for r in resp.rows] == [[101, 5]]
 
+    def test_numeric_looking_string_stays_string(self, seeded, tmp_path):
+        """Schema-driven quoting: a string prop valued '007' must not be
+        coerced to the integer 7 (DESCRIBE drives the quoting)."""
+        from nebula_tpu.tools.importer import Importer
+        vfile = tmp_path / "agents.csv"
+        vfile.write_text("200,007,35\n201,true,41\n")
+        client = seeded.client()
+        imp = Importer(client, "toolspace")
+        import csv
+        with open(vfile, newline="") as f:
+            assert imp.load_vertices(csv.reader(f), "person",
+                                     ["name", "age"]) == 2
+        resp = client.execute("FETCH PROP ON person 200 YIELD person.name")
+        assert resp.ok() and resp.rows[0][-1] == "007"
+        resp = client.execute("FETCH PROP ON person 201 YIELD person.name")
+        assert resp.ok() and resp.rows[0][-1] == "true"
+
 
 class TestWebService:
     def test_status_flags_stats(self):
