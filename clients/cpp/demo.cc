@@ -1,0 +1,43 @@
+// demo — run nGQL statements from argv against a graphd and print rows.
+// Used by tests/test_cpp_client.py against an in-process TCP cluster;
+// doubles as the C++ usage example (reference client/cpp usage).
+//
+//   ./nebula_cpp_demo <host> <port> "STMT" ["STMT" ...]
+#include <cstdio>
+#include <cstdlib>
+
+#include "graph_client.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <host> <port> <stmt>...\n", argv[0]);
+    return 2;
+  }
+  nebula_tpu::GraphClient client(argv[1], uint16_t(atoi(argv[2])));
+  auto rc = client.connect();
+  if (rc != nebula_tpu::ErrorCode::SUCCEEDED) {
+    fprintf(stderr, "connect failed (%d)\n", int(rc));
+    return 1;
+  }
+  for (int i = 3; i < argc; i++) {
+    nebula_tpu::ExecutionResponse resp;
+    client.execute(argv[i], &resp);
+    if (!resp.ok()) {
+      fprintf(stderr, "[ERROR %d]: %s\n", int(resp.error_code),
+              resp.error_msg.c_str());
+      return 1;
+    }
+    for (size_t c = 0; c < resp.column_names.size(); c++)
+      printf("%s%s", c ? "\t" : "", resp.column_names[c].c_str());
+    if (!resp.column_names.empty()) printf("\n");
+    for (auto& row : resp.rows) {
+      for (size_t c = 0; c < row.size(); c++)
+        printf("%s%s", c ? "\t" : "", row[c].to_string().c_str());
+      printf("\n");
+    }
+    printf("-- OK (%lld us)\n",
+           static_cast<long long>(resp.latency_in_us));
+  }
+  client.disconnect();
+  return 0;
+}

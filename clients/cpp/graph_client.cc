@@ -1,0 +1,238 @@
+// GraphClient implementation — see graph_client.h.
+#include "graph_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace nebula_tpu {
+
+using mplite::Value;
+using mplite::ValuePtr;
+
+std::string ColValue::to_string() const {
+  char buf[64];
+  switch (kind) {
+    case NIL:
+      return "NULL";
+    case BOOL:
+      return b ? "true" : "false";
+    case INT:
+      snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(i));
+      return buf;
+    case FLOAT:
+      snprintf(buf, sizeof(buf), "%g", d);
+      return buf;
+    case STR:
+      return s;
+  }
+  return "";
+}
+
+GraphClient::GraphClient(const std::string& host, uint16_t port)
+    : host_(host), port_(port) {}
+
+GraphClient::~GraphClient() { disconnect(); }
+
+bool GraphClient::ensure_socket() {
+  if (fd_ >= 0) return true;
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[8];
+  snprintf(portstr, sizeof(portstr), "%u", unsigned(port_));
+  if (getaddrinfo(host_.c_str(), portstr, &hints, &res) != 0 || !res)
+    return false;
+  fd_ = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  bool ok = fd_ >= 0 && ::connect(fd_, res->ai_addr, res->ai_addrlen) == 0;
+  freeaddrinfo(res);
+  if (!ok) {
+    if (fd_ >= 0) close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return true;
+}
+
+static bool write_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= size_t(w);
+  }
+  return true;
+}
+
+static bool read_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= size_t(r);
+  }
+  return true;
+}
+
+bool GraphClient::call(const std::string& method, const ValuePtr& payload,
+                       ValuePtr* out, std::string* err) {
+  if (!ensure_socket()) {
+    *err = "connect failed";
+    return false;
+  }
+  auto frame = Value::array();
+  frame->arr.push_back(Value::str(method));
+  frame->arr.push_back(payload);
+  std::string body;
+  mplite::encode(*frame, &body);
+  char hdr[4] = {char(uint8_t(body.size() >> 24)),
+                 char(uint8_t(body.size() >> 16)),
+                 char(uint8_t(body.size() >> 8)), char(uint8_t(body.size()))};
+  if (!write_all(fd_, hdr, 4) || !write_all(fd_, body.data(), body.size())) {
+    close(fd_);
+    fd_ = -1;
+    *err = "send failed";
+    return false;
+  }
+  char rhdr[4];
+  if (!read_all(fd_, rhdr, 4)) {
+    close(fd_);
+    fd_ = -1;
+    *err = "recv failed";
+    return false;
+  }
+  uint32_t rlen = (uint32_t(uint8_t(rhdr[0])) << 24) |
+                  (uint32_t(uint8_t(rhdr[1])) << 16) |
+                  (uint32_t(uint8_t(rhdr[2])) << 8) | uint32_t(uint8_t(rhdr[3]));
+  std::string rbody(rlen, '\0');
+  if (!read_all(fd_, rbody.data(), rlen)) {
+    close(fd_);
+    fd_ = -1;
+    *err = "recv failed";
+    return false;
+  }
+  bool ok = false;
+  *out = mplite::decode(rbody, &ok);
+  if (!ok) {
+    *err = "bad response encoding";
+    return false;
+  }
+  const Value* e = (*out)->get("__error__");
+  if (e != nullptr) {
+    const Value* msg = (*out)->get("msg");
+    *err = msg && msg->kind == Value::STR ? msg->s : "server error";
+    return false;
+  }
+  return true;
+}
+
+ErrorCode GraphClient::connect(const std::string& username,
+                               const std::string& password) {
+  auto payload = Value::dict();
+  payload->map.emplace_back(Value::str("username"), Value::str(username));
+  payload->map.emplace_back(Value::str("password"), Value::str(password));
+  ValuePtr resp;
+  std::string err;
+  if (!call("authenticate", payload, &resp, &err))
+    return ErrorCode::E_FAIL_TO_CONNECT;
+  const Value* code = resp->get("error_code");
+  if (code && code->i != 0) return ErrorCode(code->i);
+  const Value* sid = resp->get("session_id");
+  if (!sid || sid->kind != Value::INT) return ErrorCode::E_RPC_FAILURE;
+  session_id_ = sid->i;
+  return ErrorCode::SUCCEEDED;
+}
+
+void GraphClient::disconnect() {
+  if (session_id_ >= 0 && fd_ >= 0) {
+    auto payload = Value::dict();
+    payload->map.emplace_back(Value::str("session_id"),
+                              Value::integer(session_id_));
+    ValuePtr resp;
+    std::string err;
+    call("signout", payload, &resp, &err);
+    session_id_ = -1;
+  }
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+static ColValue to_col(const Value& v) {
+  ColValue c;
+  switch (v.kind) {
+    case Value::BOOL:
+      c.kind = ColValue::BOOL;
+      c.b = v.b;
+      break;
+    case Value::INT:
+      c.kind = ColValue::INT;
+      c.i = v.i;
+      break;
+    case Value::FLOAT:
+      c.kind = ColValue::FLOAT;
+      c.d = v.d;
+      break;
+    case Value::STR:
+    case Value::BIN:
+      c.kind = ColValue::STR;
+      c.s = v.s;
+      break;
+    default:
+      break;
+  }
+  return c;
+}
+
+ErrorCode GraphClient::execute(const std::string& stmt,
+                               ExecutionResponse* resp) {
+  *resp = ExecutionResponse();
+  if (session_id_ < 0) {
+    resp->error_code = ErrorCode::E_DISCONNECTED;
+    resp->error_msg = "not connected";
+    return resp->error_code;
+  }
+  auto payload = Value::dict();
+  payload->map.emplace_back(Value::str("session_id"),
+                            Value::integer(session_id_));
+  payload->map.emplace_back(Value::str("stmt"), Value::str(stmt));
+  ValuePtr out;
+  std::string err;
+  if (!call("execute", payload, &out, &err)) {
+    resp->error_code = ErrorCode::E_RPC_FAILURE;
+    resp->error_msg = err;
+    return resp->error_code;
+  }
+  const Value* code = out->get("error_code");
+  resp->error_code = code ? ErrorCode(code->i) : ErrorCode::SUCCEEDED;
+  const Value* msg = out->get("error_msg");
+  if (msg && msg->kind == Value::STR) resp->error_msg = msg->s;
+  const Value* lat = out->get("latency_in_us");
+  if (lat) resp->latency_in_us = lat->i;
+  const Value* cols = out->get("column_names");
+  if (cols && cols->kind == Value::ARRAY) {
+    for (auto& c : cols->arr)
+      resp->column_names.push_back(c->kind == Value::STR ? c->s : "");
+  }
+  const Value* rows = out->get("rows");
+  if (rows && rows->kind == Value::ARRAY) {
+    for (auto& r : rows->arr) {
+      if (r->kind != Value::ARRAY) continue;
+      std::vector<ColValue> row;
+      for (auto& cell : r->arr) row.push_back(to_col(*cell));
+      resp->rows.push_back(std::move(row));
+    }
+  }
+  return resp->error_code;
+}
+
+}  // namespace nebula_tpu

@@ -1,0 +1,76 @@
+// GraphClient — native C++ client for the nebula-tpu graph service.
+//
+// Capability parity with the reference's C++ client
+// (/root/reference/src/client/cpp/GraphClient.h): blocking
+// connect / execute / disconnect against graphd, returning typed result
+// rows. Speaks the framework's wire protocol (interface/rpc.py:
+// 4-byte BE length | msgpack [method, payload]) over a plain TCP
+// socket — no generated stubs needed.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+namespace nebula_tpu {
+
+enum class ErrorCode {
+  SUCCEEDED = 0,
+  E_DISCONNECTED = -1,
+  E_FAIL_TO_CONNECT = -2,
+  E_RPC_FAILURE = -3,
+  E_BAD_USERNAME_PASSWORD = -4,
+  E_SESSION_INVALID = -5,
+  E_SYNTAX_ERROR = -7,
+  E_EXECUTION_ERROR = -8,
+  E_STATEMENT_EMPTY = -9,
+};
+
+struct ColValue {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+
+  std::string to_string() const;
+};
+
+struct ExecutionResponse {
+  ErrorCode error_code = ErrorCode::SUCCEEDED;
+  std::string error_msg;
+  int64_t latency_in_us = 0;
+  std::vector<std::string> column_names;
+  std::vector<std::vector<ColValue>> rows;
+
+  bool ok() const { return error_code == ErrorCode::SUCCEEDED; }
+};
+
+class GraphClient {
+ public:
+  GraphClient(const std::string& host, uint16_t port);
+  ~GraphClient();
+
+  GraphClient(const GraphClient&) = delete;
+  GraphClient& operator=(const GraphClient&) = delete;
+
+  // authenticate + open a session (reference GraphClient::connect)
+  ErrorCode connect(const std::string& username = "user",
+                    const std::string& password = "password");
+  void disconnect();  // oneway signout + close (reference signout)
+  ErrorCode execute(const std::string& stmt, ExecutionResponse* resp);
+
+ private:
+  bool ensure_socket();
+  bool call(const std::string& method, const mplite::ValuePtr& payload,
+            mplite::ValuePtr* out, std::string* err);
+
+  std::string host_;
+  uint16_t port_;
+  int fd_ = -1;
+  int64_t session_id_ = -1;
+};
+
+}  // namespace nebula_tpu

@@ -1,0 +1,284 @@
+// msgpack_lite — minimal msgpack encode/decode for the nebula-tpu wire
+// protocol (interface/rpc.py: 4-byte BE length | msgpack [method, payload]).
+//
+// Covers exactly the types the protocol uses: nil, bool, int64, double,
+// str, bin, array, map. Not a general msgpack library — unknown/ext
+// types fail decode with ok=false (the server never sends them).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace mplite {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARRAY, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;                       // STR and BIN payloads
+  std::vector<ValuePtr> arr;
+  std::vector<std::pair<ValuePtr, ValuePtr>> map;
+
+  static ValuePtr nil() { return std::make_shared<Value>(); }
+  static ValuePtr boolean(bool v) {
+    auto p = std::make_shared<Value>();
+    p->kind = BOOL;
+    p->b = v;
+    return p;
+  }
+  static ValuePtr integer(int64_t v) {
+    auto p = std::make_shared<Value>();
+    p->kind = INT;
+    p->i = v;
+    return p;
+  }
+  static ValuePtr real(double v) {
+    auto p = std::make_shared<Value>();
+    p->kind = FLOAT;
+    p->d = v;
+    return p;
+  }
+  static ValuePtr str(const std::string& v) {
+    auto p = std::make_shared<Value>();
+    p->kind = STR;
+    p->s = v;
+    return p;
+  }
+  static ValuePtr array() {
+    auto p = std::make_shared<Value>();
+    p->kind = ARRAY;
+    return p;
+  }
+  static ValuePtr dict() {
+    auto p = std::make_shared<Value>();
+    p->kind = MAP;
+    return p;
+  }
+
+  const Value* get(const std::string& key) const {
+    if (kind != MAP) return nullptr;
+    for (auto& kv : map) {
+      if (kv.first->kind == STR && kv.first->s == key)
+        return kv.second.get();
+    }
+    return nullptr;
+  }
+};
+
+// ----------------------------------------------------------------- encode
+inline void put_be(std::string* out, uint64_t v, int bytes) {
+  for (int i = bytes - 1; i >= 0; i--)
+    out->push_back(char(uint8_t(v >> (8 * i))));
+}
+
+inline void encode(const Value& v, std::string* out) {
+  switch (v.kind) {
+    case Value::NIL:
+      out->push_back(char(0xC0));
+      break;
+    case Value::BOOL:
+      out->push_back(char(v.b ? 0xC3 : 0xC2));
+      break;
+    case Value::INT: {
+      int64_t x = v.i;
+      if (x >= 0 && x < 128) {
+        out->push_back(char(uint8_t(x)));
+      } else if (x < 0 && x >= -32) {
+        out->push_back(char(uint8_t(0xE0 | (x + 32))));
+      } else {
+        out->push_back(char(0xD3));  // int64
+        put_be(out, uint64_t(x), 8);
+      }
+      break;
+    }
+    case Value::FLOAT: {
+      out->push_back(char(0xCB));
+      uint64_t bits;
+      memcpy(&bits, &v.d, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Value::STR: {
+      size_t n = v.s.size();
+      if (n < 32) {
+        out->push_back(char(uint8_t(0xA0 | n)));
+      } else if (n < 256) {
+        out->push_back(char(0xD9));
+        put_be(out, n, 1);
+      } else if (n < 65536) {
+        out->push_back(char(0xDA));
+        put_be(out, n, 2);
+      } else {
+        out->push_back(char(0xDB));
+        put_be(out, n, 4);
+      }
+      out->append(v.s);
+      break;
+    }
+    case Value::BIN: {
+      size_t n = v.s.size();
+      if (n < 256) {
+        out->push_back(char(0xC4));
+        put_be(out, n, 1);
+      } else if (n < 65536) {
+        out->push_back(char(0xC5));
+        put_be(out, n, 2);
+      } else {
+        out->push_back(char(0xC6));
+        put_be(out, n, 4);
+      }
+      out->append(v.s);
+      break;
+    }
+    case Value::ARRAY: {
+      size_t n = v.arr.size();
+      if (n < 16) {
+        out->push_back(char(uint8_t(0x90 | n)));
+      } else if (n < 65536) {
+        out->push_back(char(0xDC));
+        put_be(out, n, 2);
+      } else {
+        out->push_back(char(0xDD));
+        put_be(out, n, 4);
+      }
+      for (auto& e : v.arr) encode(*e, out);
+      break;
+    }
+    case Value::MAP: {
+      size_t n = v.map.size();
+      if (n < 16) {
+        out->push_back(char(uint8_t(0x80 | n)));
+      } else if (n < 65536) {
+        out->push_back(char(0xDE));
+        put_be(out, n, 2);
+      } else {
+        out->push_back(char(0xDF));
+        put_be(out, n, 4);
+      }
+      for (auto& kv : v.map) {
+        encode(*kv.first, out);
+        encode(*kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+// ----------------------------------------------------------------- decode
+struct Decoder {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool ok = true;
+
+  uint64_t be(int bytes) {
+    if (pos + size_t(bytes) > n) {
+      ok = false;
+      return 0;
+    }
+    uint64_t v = 0;
+    for (int i = 0; i < bytes; i++) v = (v << 8) | p[pos++];
+    return v;
+  }
+
+  std::string bytes(size_t len) {
+    if (pos + len > n) {
+      ok = false;
+      return "";
+    }
+    std::string s(reinterpret_cast<const char*>(p + pos), len);
+    pos += len;
+    return s;
+  }
+
+  ValuePtr value() {
+    if (!ok || pos >= n) {
+      ok = false;
+      return Value::nil();
+    }
+    uint8_t t = p[pos++];
+    if (t < 0x80) return Value::integer(t);
+    if (t >= 0xE0) return Value::integer(int8_t(t));
+    if ((t & 0xF0) == 0x80) return map_(t & 0x0F);
+    if ((t & 0xF0) == 0x90) return array_(t & 0x0F);
+    if ((t & 0xE0) == 0xA0) return Value::str(bytes(t & 0x1F));
+    switch (t) {
+      case 0xC0: return Value::nil();
+      case 0xC2: return Value::boolean(false);
+      case 0xC3: return Value::boolean(true);
+      case 0xC4: return bin_(be(1));
+      case 0xC5: return bin_(be(2));
+      case 0xC6: return bin_(be(4));
+      case 0xCA: {
+        uint32_t bits = uint32_t(be(4));
+        float f;
+        memcpy(&f, &bits, 4);
+        return Value::real(double(f));
+      }
+      case 0xCB: {
+        uint64_t bits = be(8);
+        double d;
+        memcpy(&d, &bits, 8);
+        return Value::real(d);
+      }
+      case 0xCC: return Value::integer(int64_t(be(1)));
+      case 0xCD: return Value::integer(int64_t(be(2)));
+      case 0xCE: return Value::integer(int64_t(be(4)));
+      case 0xCF: return Value::integer(int64_t(be(8)));
+      case 0xD0: return Value::integer(int8_t(be(1)));
+      case 0xD1: return Value::integer(int16_t(be(2)));
+      case 0xD2: return Value::integer(int32_t(be(4)));
+      case 0xD3: return Value::integer(int64_t(be(8)));
+      case 0xD9: return Value::str(bytes(be(1)));
+      case 0xDA: return Value::str(bytes(be(2)));
+      case 0xDB: return Value::str(bytes(be(4)));
+      case 0xDC: return array_(be(2));
+      case 0xDD: return array_(be(4));
+      case 0xDE: return map_(be(2));
+      case 0xDF: return map_(be(4));
+      default:
+        ok = false;  // ext/unused types — protocol never sends them
+        return Value::nil();
+    }
+  }
+
+  ValuePtr bin_(size_t len) {
+    auto v = std::make_shared<Value>();
+    v->kind = Value::BIN;
+    v->s = bytes(len);
+    return v;
+  }
+
+  ValuePtr array_(size_t len) {
+    auto v = Value::array();
+    for (size_t i = 0; i < len && ok; i++) v->arr.push_back(value());
+    return v;
+  }
+
+  ValuePtr map_(size_t len) {
+    auto v = Value::dict();
+    for (size_t i = 0; i < len && ok; i++) {
+      auto k = value();
+      auto val = value();
+      v->map.emplace_back(k, val);
+    }
+    return v;
+  }
+};
+
+inline ValuePtr decode(const std::string& buf, bool* ok) {
+  Decoder d{reinterpret_cast<const uint8_t*>(buf.data()), buf.size()};
+  auto v = d.value();
+  *ok = d.ok;
+  return v;
+}
+
+}  // namespace mplite
