@@ -7,6 +7,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <cstdio>
 #include <cstring>
 
@@ -65,6 +66,7 @@ bool GraphClient::ensure_socket() {
 static bool write_all(int fd, const char* p, size_t n) {
   while (n) {
     ssize_t w = ::write(fd, p, n);
+    if (w < 0 && errno == EINTR) continue;
     if (w <= 0) return false;
     p += w;
     n -= size_t(w);
@@ -75,12 +77,17 @@ static bool write_all(int fd, const char* p, size_t n) {
 static bool read_all(int fd, char* p, size_t n) {
   while (n) {
     ssize_t r = ::read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
     if (r <= 0) return false;
     p += r;
     n -= size_t(r);
   }
   return true;
 }
+
+// server side caps frames at 1 GiB (interface/rpc.py _MAX_FRAME); a
+// longer announced length means a desynced or corrupt stream
+static constexpr uint32_t kMaxFrame = 1u << 30;
 
 bool GraphClient::call(const std::string& method, const ValuePtr& payload,
                        ValuePtr* out, std::string* err) {
@@ -112,6 +119,12 @@ bool GraphClient::call(const std::string& method, const ValuePtr& payload,
   uint32_t rlen = (uint32_t(uint8_t(rhdr[0])) << 24) |
                   (uint32_t(uint8_t(rhdr[1])) << 16) |
                   (uint32_t(uint8_t(rhdr[2])) << 8) | uint32_t(uint8_t(rhdr[3]));
+  if (rlen > kMaxFrame) {
+    close(fd_);
+    fd_ = -1;
+    *err = "oversized response frame";
+    return false;
+  }
   std::string rbody(rlen, '\0');
   if (!read_all(fd_, rbody.data(), rlen)) {
     close(fd_);

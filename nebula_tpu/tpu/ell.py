@@ -1,0 +1,373 @@
+"""Batched ELL traversal engine — the TPU-fast path for multi-hop GO/BFS.
+
+Why this exists: on TPU, XLA lowers arbitrary gather/scatter to a
+*serial* per-element loop (~30 ns per accessed row, measured on v5e —
+the per-row cost is flat whether the row is 1 byte or 2 KB).  A
+single-query BFS hop over an m-edge graph therefore costs m x 30 ns no
+matter how it is phrased, and loses to host numpy.  The TPU-native
+answer is to *batch queries*: B concurrent traversals share one
+[n, B] int8 frontier matrix, so each (unavoidable) row access moves B
+query-bits at once and the 30 ns is amortised B ways.  A hop becomes
+
+    next[v, :] = max_j  f[in_slot[v, j], :] * etype_ok[v, j]
+
+which is D row-gathers plus a free reshape-reduce — no scatter at all.
+This mirrors how the reference amortises per-request cost by bulking
+vertices per StorageService RPC (storage.thrift GetNeighborsRequest
+carries *lists* of vids per part; QueryBaseProcessor.inl:433-460
+buckets them across worker threads) — here the bulking axis is queries
+and the workers are TPU lanes.
+
+Structure built host-side from the CsrMirror (build_ell):
+
+  * vertices are **relabeled** so that all vertices of one degree
+    bucket are contiguous (new id = rank in (bucket_D, old_id) order);
+    bucket outputs then concatenate into the next frontier with zero
+    data movement.
+  * per bucket a dense slot table ``nbr[rows, D]`` holds *new* ids of
+    the vertex's neighbors over BOTH edge directions (the mirror stores
+    a reverse edge under -etype, csr.py), padded with a sentinel row
+    ``n`` whose frontier value is pinned to 0; ``et[rows, D]`` holds the
+    signed etype of each slot so one static mask per query selects the
+    OVER set (padding uses etype 0 which is never a real etype).
+  * hub vertices (degree > cap) own several rows in the largest bucket;
+    the extra rows are appended after all real vertices and max-merged
+    back into their owner row by a tiny scatter (hubs are rare, the
+    scatter is O(#extra rows)).
+
+The reference's analogue of this file is the storaged read hot loop
+(QueryBoundProcessor::processVertex + QueryBaseProcessor.inl:336-405
+per-vertex RocksDB prefix scans); the multi-chip variant replaces the
+graphd scatter-gather + dedup (StorageClient.inl:74-159,
+GoExecutor.cpp:377-431) with row-sharded expansion + an ICI all-gather
+of the replicated frontier.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+INT16_INF = np.int16(2**15 - 1)
+
+
+def _next_pow2(x: np.ndarray) -> np.ndarray:
+    x = np.maximum(x.astype(np.int64), 1)
+    return (1 << np.ceil(np.log2(x)).astype(np.int64)).astype(np.int64)
+
+
+class EllIndex:
+    """Degree-bucketed in-slot table over relabeled dense vertex ids."""
+
+    __slots__ = ("n", "m", "perm", "inv", "bucket_D", "bucket_nbr",
+                 "bucket_et", "extra_owner", "n_rows", "_device")
+
+    def __init__(self):
+        self.n = 0                     # real vertices
+        self.m = 0                     # slots filled (edge rows, both dirs)
+        self.perm = np.zeros(0, np.int32)   # old dense id -> new id
+        self.inv = np.zeros(0, np.int32)    # new id -> old dense id
+        self.bucket_D: List[int] = []       # slot width per bucket (asc)
+        self.bucket_nbr: List[np.ndarray] = []  # [rows_b, D_b] new ids
+        self.bucket_et: List[np.ndarray] = []   # [rows_b, D_b] signed etype
+        self.extra_owner = np.zeros(0, np.int32)  # hub extra row -> new id
+        self.n_rows = 0                # n + len(extra_owner)
+        self._device = None            # lazy jnp copies of bucket arrays
+
+    # -------------------------------------------------------------- build
+    @staticmethod
+    def build(edge_src: np.ndarray, edge_dst: np.ndarray,
+              edge_etype: np.ndarray, n: int, cap: int = 512,
+              min_d: int = 8) -> "EllIndex":
+        """Group the mirror's edge rows by dst into bucketed slot tables.
+
+        ``edge_*`` are the CsrMirror arrays (dense ids, signed etypes,
+        both directions present).  ``cap`` bounds slot width; vertices
+        with more slots get extra rows merged by the fix-up scatter.
+        ``min_d`` floors the bucket width — fewer buckets compile into
+        fewer fori kernels at the price of a little padding.
+        """
+        ell = EllIndex()
+        ell.n = n
+        m = len(edge_src)
+        ell.m = m
+        if n == 0:
+            ell.n_rows = 0
+            return ell
+
+        # rows are grouped by DST (slots = in-edges): a hop pulls
+        # next[v] = max over in-slots of f[src], so ``deg`` here is the
+        # in-degree over both stored directions.
+        order = np.argsort(edge_dst, kind="stable")
+        es = np.asarray(edge_dst, np.int64)[order]   # row owner (dst)
+        ed = np.asarray(edge_src, np.int64)[order]   # slot neighbor (src)
+        ee = np.asarray(edge_etype, np.int32)[order]
+        deg = np.bincount(es, minlength=n).astype(np.int64)
+
+        cap = max(cap, min_d)
+        per_row = np.minimum(deg, cap)
+        D_v = np.clip(_next_pow2(per_row), min_d, cap)
+        vorder = np.lexsort((np.arange(n), D_v))         # stable by bucket
+        perm = np.empty(n, np.int32)
+        perm[vorder] = np.arange(n, dtype=np.int32)
+        ell.perm = perm
+        ell.inv = np.asarray(vorder, np.int32)
+
+        # hub extra rows (degree > cap), appended after all real vertices
+        hub_vs = np.nonzero(deg > cap)[0]
+        n_extra_v = np.zeros(n, dtype=np.int64)          # extra rows per v
+        n_extra_v[hub_vs] = np.ceil(deg[hub_vs] / cap).astype(np.int64) - 1
+        first_extra = np.zeros(n, dtype=np.int64)        # v -> its 1st extra
+        first_extra[1:] = np.cumsum(n_extra_v)[:-1]
+        first_extra += n
+        n_extras = int(n_extra_v.sum())
+        ell.extra_owner = perm[np.repeat(np.arange(n), n_extra_v)] \
+            .astype(np.int32)
+        ell.n_rows = n + n_extras
+
+        # per-edge (row, col) destination slot
+        row_start = np.concatenate([[0], np.cumsum(deg)])
+        off = np.arange(m, dtype=np.int64) - row_start[es]
+        k_of = off // cap
+        col = np.where(k_of == 0, off, off % cap).astype(np.int64)
+        row = np.where(k_of == 0, perm[es].astype(np.int64),
+                       first_extra[es] + k_of - 1)
+
+        # bucket layout: new ids are contiguous per D (vorder sorted by D_v)
+        Ds = sorted(set(D_v.tolist()))
+        sentinel = np.int32(ell.n_rows)  # frontier row pinned to 0
+        D_new = D_v[vorder]              # slot width per new id
+        bstart = 0
+        for D in Ds:
+            nb = int(np.count_nonzero(D_new == D))
+            if D == cap:
+                nb += n_extras           # extras live in the cap bucket
+            nbr = np.full((nb, D), sentinel, dtype=np.int32)
+            et = np.zeros((nb, D), dtype=np.int32)
+            # buckets are contiguous in new-id order, and extra rows
+            # (>= n) all belong to the last (cap) bucket
+            sel = np.nonzero((row >= bstart) & (row < bstart + nb))[0]
+            if len(sel):
+                flat = (row[sel] - bstart) * D + col[sel]
+                nbr.reshape(-1)[flat] = perm[ed[sel]]
+                et.reshape(-1)[flat] = ee[sel]
+            ell.bucket_D.append(int(D))
+            ell.bucket_nbr.append(nbr)
+            ell.bucket_et.append(et)
+            bstart += nb
+        return ell
+
+    # -------------------------------------------------------------- device
+    def device_arrays(self):
+        """jnp copies of the bucket tables (cached)."""
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = (
+                [jnp.asarray(a) for a in self.bucket_nbr],
+                [jnp.asarray(a) for a in self.bucket_et],
+                jnp.asarray(self.extra_owner),
+            )
+        return self._device
+
+    # ----------------------------------------------------------- frontiers
+    def start_frontier(self, start_dense_per_query: Sequence[np.ndarray],
+                       B: Optional[int] = None) -> np.ndarray:
+        """[n_rows+1, B] int8 frontier from per-query old-dense-id lists."""
+        nq = len(start_dense_per_query)
+        B = B or max(128, nq)
+        f = np.zeros((self.n_rows + 1, B), dtype=np.int8)
+        for q, starts in enumerate(start_dense_per_query):
+            s = np.asarray(starts)
+            s = s[(s >= 0) & (s < self.n)]
+            f[self.perm[s], q] = 1
+        return f
+
+    def to_old(self, frontier_new: np.ndarray) -> np.ndarray:
+        """[.., B] rows in new-id space -> old dense-id space."""
+        return frontier_new[self.perm]
+
+
+# ====================================================================
+# Kernels.  All are built per (ell identity, steps/etypes, B) and cached
+# by the runtime; shapes and the etype set are static under jit.
+# ====================================================================
+def _etype_ok(jnp, et_col, etypes: Tuple[int, ...]):
+    ok = jnp.zeros(et_col.shape, dtype=bool)
+    for t in etypes:
+        ok = ok | (et_col == t)
+    return ok
+
+
+def _hop_body(jnp, jax, ell: EllIndex, etypes: Tuple[int, ...],
+              nbr_dev, et_dev, extra_owner_dev, f):
+    """One frontier advance: f [n_rows+1, B] int8 -> same shape."""
+    outs = []
+    for nbr, et in zip(nbr_dev, et_dev):
+        nb, D = nbr.shape
+        nbr_T = nbr.T                      # [D, nb] static transposes
+        ok_T = _etype_ok(jnp, et, etypes).T.astype(jnp.int8)
+
+        def body(j, acc):
+            g = f[nbr_T[j]]                # [nb, B] row-gather
+            return jnp.maximum(acc, g * ok_T[j][:, None])
+
+        acc0 = jnp.zeros((nb, f.shape[1]), dtype=jnp.int8)
+        outs.append(jax.lax.fori_loop(0, D, body, acc0))
+    if not outs:                           # empty graph: nothing moves
+        return jnp.zeros_like(f)
+    nxt = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    if len(ell.extra_owner):               # hub fix-up (tiny scatter)
+        extras = nxt[ell.n:]
+        nxt = nxt.at[extra_owner_dev].max(extras)
+        # extra rows keep their value; they are ignored as gather
+        # sources (no slot ever points at row >= n) and re-derived
+        # next hop, so no need to zero them.
+    pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
+    return jnp.concatenate([nxt, pad], axis=0)
+
+
+def make_batched_go_kernel(ell: EllIndex, steps: int,
+                           etypes: Tuple[int, ...]):
+    """fn(f0 [n_rows+1, B] int8) -> frontier after ``steps-1`` advances
+    (the final hop's edge set is frontier[src] & etype_ok, materialised
+    by the caller — same split as kernels._go_body)."""
+    import jax
+    import jax.numpy as jnp
+    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+
+    @jax.jit
+    def go(f0):
+        def one(_, f):
+            return _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
+                             owner_dev, f)
+        if steps <= 1:
+            return f0
+        return jax.lax.fori_loop(0, steps - 1, one, f0)
+
+    return go
+
+
+def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
+                            etypes: Tuple[int, ...],
+                            stop_when_found: bool = True):
+    """fn(f0, targets) -> depth int16 [n_rows+1, B] (INT16_INF =
+    unreachable within max_steps).  Batched analogue of
+    kernels.make_bfs_kernel; early exit when every query either stalled
+    or (shortest mode) covered its targets."""
+    import jax
+    import jax.numpy as jnp
+    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+
+    @jax.jit
+    def bfs(f0, targets):
+        d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
+
+        def cond(state):
+            d, f, step = state
+            alive = (f > 0).any()
+            go_on = (step < max_steps) & alive
+            if stop_when_found:
+                unfound = ((targets > 0) & (d == INT16_INF)).any()
+                go_on = go_on & unfound
+            return go_on
+
+        def body(state):
+            d, f, step = state
+            nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
+                            owner_dev, f)
+            newly = (nxt > 0) & (d == INT16_INF)
+            d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
+            return d, newly.astype(jnp.int8), step + 1
+
+        d, _, _ = jax.lax.while_loop(cond, body, (d0, f0, jnp.int32(0)))
+        return d
+
+    return bfs
+
+
+# ====================================================================
+# Multi-chip: bucket rows sharded over a 1-D mesh axis, frontier
+# replicated; each device expands its row shard, the merged next
+# frontier is re-replicated (XLA all-gather over ICI).  This is the TPU
+# analogue of per-part storaged expansion + graphd-side merge
+# (SURVEY.md SS2.12, SS5.7).
+# ====================================================================
+def shard_ell(mesh, axis: str, ell: EllIndex):
+    """Pad each bucket's rows to a multiple of the axis size and place
+    the tables row-sharded.  Returns (nbr_shards, et_shards, real_rows)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    k = mesh.shape[axis]
+    sharding = NamedSharding(mesh, P(axis))
+    nbrs, ets, reals = [], [], []
+    sentinel = np.int32(ell.n_rows)
+    for nbr, et in zip(ell.bucket_nbr, ell.bucket_et):
+        nb, D = nbr.shape
+        padded = ((nb + k - 1) // k) * k if nb else k
+        if padded != nb:
+            nbr = np.concatenate(
+                [nbr, np.full((padded - nb, D), sentinel, np.int32)])
+            et = np.concatenate(
+                [et, np.zeros((padded - nb, D), np.int32)])
+        nbrs.append(jax.device_put(nbr, sharding))
+        ets.append(jax.device_put(et, sharding))
+        reals.append(nb)
+    return nbrs, ets, reals
+
+
+def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
+                                   steps: int, etypes: Tuple[int, ...],
+                                   nbr_shards, et_shards, real_rows):
+    """Sharded-bucket batched GO.  f0 replicated [n_rows+1, B] int8."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    owner = jnp.asarray(ell.extra_owner)
+    n_buckets = len(nbr_shards)
+
+    def per_shard(f, *tables):
+        nbrs, ets = tables[:n_buckets], tables[n_buckets:]
+        outs = []
+        for nbr, et in zip(nbrs, ets):
+            nb, D = nbr.shape
+            nbr_T, ok_T = nbr.T, _etype_ok(jnp, et, etypes).T \
+                .astype(jnp.int8)
+
+            def body(j, acc, nbr_T=nbr_T, ok_T=ok_T):
+                return jnp.maximum(acc, f[nbr_T[j]] * ok_T[j][:, None])
+
+            acc0 = jnp.zeros((nb, f.shape[1]), dtype=jnp.int8)
+            outs.append(jax.lax.fori_loop(0, D, body, acc0))
+        return tuple(outs)
+
+    sharded_hop = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(),) + (P(axis),) * (2 * n_buckets),
+        out_specs=(P(axis),) * n_buckets,
+        check_vma=False)
+
+    replicate = NamedSharding(mesh, P())
+
+    @jax.jit
+    def go(f0, *tables):
+        if n_buckets == 0:                   # empty graph: nothing moves
+            return f0 if steps <= 1 else jnp.zeros_like(f0)
+        def one(_, f):
+            outs = sharded_hop(f, *tables)
+            trimmed = [o[:r] for o, r in zip(outs, real_rows)]
+            nxt = jnp.concatenate(trimmed, axis=0) \
+                if len(trimmed) > 1 else trimmed[0]
+            if len(ell.extra_owner):
+                extras = nxt[ell.n:]
+                nxt = nxt.at[owner].max(extras)
+            pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
+            nxt = jnp.concatenate([nxt, pad], axis=0)
+            return jax.lax.with_sharding_constraint(nxt, replicate)
+        if steps <= 1:
+            return f0
+        return jax.lax.fori_loop(0, steps - 1, one, f0)
+
+    return go

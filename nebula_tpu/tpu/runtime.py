@@ -31,6 +31,7 @@ from .csr import CsrMirror, build_mirror
 from .expr_compile import (CompileError, CVal, Env, ExprCompiler, K_BOOL,
                            K_FLOAT, K_INT, K_STR, K_STRCODE, K_VIDRANK)
 from . import kernels
+from .ell import EllIndex
 
 
 class _GoPlan:
@@ -468,6 +469,75 @@ class TpuQueryRuntime:
             except ExprError as ex:
                 raise exc_type(str(ex))
         return rows
+
+    # ================================================== batched GO/BFS
+    # The throughput path: B concurrent queries share one [rows, B]
+    # int8 frontier so the per-row-access cost (the TPU's serial
+    # gather floor) is amortised across the whole batch — see
+    # ell.py's module docstring.  graphd-level batching (many client
+    # sessions, one device dispatch) and the perf tool drive these.
+    @staticmethod
+    def ell(m: CsrMirror) -> EllIndex:
+        """EllIndex for an already-fetched mirror (cached on it — a
+        single fetch keeps perm and dense-id space consistent even if
+        the space version moves concurrently)."""
+        ix = getattr(m, "_ell", None)
+        if ix is None:
+            ix = EllIndex.build(m.edge_src, m.edge_dst, m.edge_etype, m.n)
+            m._ell = ix
+        return ix
+
+    def go_batch(self, space_id: int, starts_per_query, etypes: List[int],
+                 steps: int) -> np.ndarray:
+        """Run B concurrent multi-hop GOs; returns bool [B, n] final
+        frontiers in the mirror's dense-id space."""
+        import jax.numpy as jnp
+        from .ell import make_batched_go_kernel
+        m = self.mirror(space_id)
+        ix = self.ell(m)
+        et_tuple = tuple(sorted(set(etypes)))
+        nq = len(starts_per_query)
+        B = max(128, 1 << (nq - 1).bit_length())
+        key = (space_id, m.build_version, "ell_go", et_tuple, steps, B)
+        kern = self._kernels.get(key)
+        if kern is None:
+            # the kernel's ``steps`` counts like kernels._go_body: it
+            # advances steps-1 times and leaves the final hop to edge
+            # materialisation; go_batch returns the final-hop
+            # *destinations*, i.e. ``steps`` advances
+            kern = make_batched_go_kernel(ix, steps + 1, et_tuple)
+            self._kernels[key] = kern
+        f0 = ix.start_frontier(
+            [m.to_dense(s) for s in starts_per_query], B=B)
+        self.stats["go_device"] += nq
+        out = np.asarray(kern(jnp.asarray(f0)))
+        return ix.to_old(out)[:, :nq].T > 0
+
+    def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
+                  etypes: List[int], max_steps: int,
+                  shortest: bool = True) -> np.ndarray:
+        """Batched BFS depths: int16 [B, n] (INT16_INF = unreached)."""
+        import jax.numpy as jnp
+        from .ell import make_batched_bfs_kernel
+        m = self.mirror(space_id)
+        ix = self.ell(m)
+        et_tuple = tuple(sorted(set(etypes)))
+        nq = len(starts_per_query)
+        B = max(128, 1 << (nq - 1).bit_length())
+        key = (space_id, m.build_version, "ell_bfs", et_tuple, max_steps,
+               shortest, B)
+        kern = self._kernels.get(key)
+        if kern is None:
+            kern = make_batched_bfs_kernel(ix, max_steps, et_tuple,
+                                           stop_when_found=shortest)
+            self._kernels[key] = kern
+        f0 = ix.start_frontier(
+            [m.to_dense(s) for s in starts_per_query], B=B)
+        t0 = ix.start_frontier(
+            [m.to_dense(t) for t in targets_per_query], B=B)
+        self.stats["path_device"] += nq
+        d = np.asarray(kern(jnp.asarray(f0), jnp.asarray(t0)))
+        return ix.to_old(d)[:, :nq].T
 
     # ================================================== FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
