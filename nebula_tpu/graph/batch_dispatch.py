@@ -1,0 +1,133 @@
+"""GoBatchDispatcher — coalesce concurrent GO queries into one device
+dispatch.
+
+The batched ELL engine (tpu/ell.py) amortises the TPU's per-row-access
+floor across a [n, B] frontier matrix, so the serving layer must feed
+it batches.  graphd's RPC server runs each query on its own thread
+(interface/rpc.py ThreadingTCPServer — the analogue of the reference's
+IOThreadPool + worker pools, StorageServer.cpp:92-96); this dispatcher
+is the seam where those threads merge: requests with the same
+(space, OVER set, steps) shape queue up, one waiter at a time becomes
+the dispatching leader, and everyone blocks until their own result is
+filled in.
+
+Only one dispatch per key runs at a time, so requests arriving while a
+kernel is in flight pile up and ride the *next* batch — natural
+adaptive batching with zero added latency for a lone query.  A
+positive ``go_batch_window_ms`` additionally makes the leader sleep
+before popping the queue, trading p50 for larger batches.
+
+The reference has no cross-query batching (each GO is its own RPC
+fan-out); this is TPU-native serving the same way the reference's
+per-request vertex bucketing (QueryBaseProcessor.inl:433-460) is
+CPU-native parallelism.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ..common.flags import flags
+
+flags.define("go_batch_window_ms", 0,
+             "batch-leader wait before dispatching coalesced GO queries"
+             " (0: dispatch immediately; in-flight kernels still"
+             " coalesce whatever queues up behind them)")
+flags.define("go_batch_max", 1024, "max GO queries per device dispatch")
+
+
+class _Request:
+    __slots__ = ("start_vids", "done", "frontier", "mirror", "error")
+
+    def __init__(self, start_vids):
+        self.start_vids = start_vids     # raw vids — mapped by the leader
+        self.done = False                # against ONE consistent mirror
+        self.frontier = None             # bool[n] (leader's mirror space)
+        self.mirror = None
+        self.error = None
+
+
+class _KeyState:
+    __slots__ = ("cond", "queue", "dispatching")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.queue: List[_Request] = []
+        self.dispatching = False
+
+
+class GoBatchDispatcher:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self._lock = threading.Lock()
+        self._keys: Dict[Tuple, _KeyState] = {}
+        self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0}
+
+    def _state(self, key: Tuple) -> _KeyState:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = _KeyState()
+            return st
+
+    def submit(self, space_id: int, start_vids, et_tuple: Tuple[int, ...],
+               steps: int):
+        """Blocking: returns (frontier bool[n] after steps-1 advances,
+        mirror it is expressed in)."""
+        key = (space_id, et_tuple, steps)
+        st = self._state(key)
+        req = _Request(start_vids)
+        st.cond.acquire()
+        try:
+            st.queue.append(req)
+            while not req.done:
+                if st.dispatching or not st.queue:
+                    st.cond.wait()
+                    continue
+                # become the leader for the next batch
+                st.dispatching = True
+                window = flags.get("go_batch_window_ms") or 0
+                if window > 0:
+                    st.cond.release()
+                    try:
+                        time.sleep(window / 1000.0)
+                    finally:
+                        st.cond.acquire()
+                max_b = int(flags.get("go_batch_max") or 1024)
+                batch = st.queue[:max_b]
+                del st.queue[:max_b]
+                st.cond.release()
+                try:
+                    self._run(key, batch)
+                finally:
+                    st.cond.acquire()
+                    st.dispatching = False
+                    st.cond.notify_all()
+        finally:
+            st.cond.release()
+        if req.error is not None:
+            raise req.error
+        return req.frontier, req.mirror
+
+    # ------------------------------------------------------------------
+    def _run(self, key: Tuple, batch: List[_Request]) -> None:
+        space_id, et_tuple, steps = key
+        try:
+            frontiers, mirror = self.runtime.go_batch_frontier(
+                space_id, [r.start_vids for r in batch], et_tuple, steps)
+            for i, r in enumerate(batch):
+                r.frontier = frontiers[i]
+                r.mirror = mirror
+        except BaseException as ex:        # noqa: BLE001 — every waiter
+            for r in batch:                # must wake with the error
+                r.error = ex
+            if not isinstance(ex, Exception):
+                raise                      # KeyboardInterrupt etc.
+        finally:
+            self.stats["batches"] += 1
+            self.stats["batched_queries"] += len(batch)
+            self.stats["max_batch"] = max(self.stats["max_batch"],
+                                          len(batch))
+            for r in batch:
+                r.done = True

@@ -198,21 +198,27 @@ def _etype_ok(jnp, et_col, etypes: Tuple[int, ...]):
     return ok
 
 
+def _bucket_expand(jnp, jax, f, nbr, et, etypes: Tuple[int, ...]):
+    """Expand one bucket: max over D in-slots of f[slot src] (masked by
+    the OVER etype set).  THE hop inner loop — shared by the
+    single-chip and sharded kernels so their semantics cannot skew."""
+    nb, D = nbr.shape
+    nbr_T = nbr.T                          # [D, nb] static transposes
+    ok_T = _etype_ok(jnp, et, etypes).T.astype(jnp.int8)
+
+    def body(j, acc):
+        g = f[nbr_T[j]]                    # [nb, B] row-gather
+        return jnp.maximum(acc, g * ok_T[j][:, None])
+
+    acc0 = jnp.zeros((nb, f.shape[1]), dtype=jnp.int8)
+    return jax.lax.fori_loop(0, D, body, acc0)
+
+
 def _hop_body(jnp, jax, ell: EllIndex, etypes: Tuple[int, ...],
               nbr_dev, et_dev, extra_owner_dev, f):
     """One frontier advance: f [n_rows+1, B] int8 -> same shape."""
-    outs = []
-    for nbr, et in zip(nbr_dev, et_dev):
-        nb, D = nbr.shape
-        nbr_T = nbr.T                      # [D, nb] static transposes
-        ok_T = _etype_ok(jnp, et, etypes).T.astype(jnp.int8)
-
-        def body(j, acc):
-            g = f[nbr_T[j]]                # [nb, B] row-gather
-            return jnp.maximum(acc, g * ok_T[j][:, None])
-
-        acc0 = jnp.zeros((nb, f.shape[1]), dtype=jnp.int8)
-        outs.append(jax.lax.fori_loop(0, D, body, acc0))
+    outs = [_bucket_expand(jnp, jax, f, nbr, et, etypes)
+            for nbr, et in zip(nbr_dev, et_dev)]
     if not outs:                           # empty graph: nothing moves
         return jnp.zeros_like(f)
     nxt = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
@@ -330,18 +336,8 @@ def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
 
     def per_shard(f, *tables):
         nbrs, ets = tables[:n_buckets], tables[n_buckets:]
-        outs = []
-        for nbr, et in zip(nbrs, ets):
-            nb, D = nbr.shape
-            nbr_T, ok_T = nbr.T, _etype_ok(jnp, et, etypes).T \
-                .astype(jnp.int8)
-
-            def body(j, acc, nbr_T=nbr_T, ok_T=ok_T):
-                return jnp.maximum(acc, f[nbr_T[j]] * ok_T[j][:, None])
-
-            acc0 = jnp.zeros((nb, f.shape[1]), dtype=jnp.int8)
-            outs.append(jax.lax.fori_loop(0, D, body, acc0))
-        return tuple(outs)
+        return tuple(_bucket_expand(jnp, jax, f, nbr, et, etypes)
+                     for nbr, et in zip(nbrs, ets))
 
     sharded_hop = shard_map(
         per_shard, mesh=mesh,

@@ -66,9 +66,19 @@ class TpuQueryRuntime:
         self._plans: Dict[int, _GoPlan] = {}
         self._kernels: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
+        self._dispatcher = None   # lazy GoBatchDispatcher
         # observability (tests assert the device path actually ran;
         # webservice /get_stats exports these)
         self.stats = {"go_device": 0, "path_device": 0, "mirror_builds": 0}
+
+    @property
+    def dispatcher(self):
+        """Coalesces concurrent GO queries into one device dispatch
+        (graph/batch_dispatch.py)."""
+        if self._dispatcher is None:
+            from ..graph.batch_dispatch import GoBatchDispatcher
+            self._dispatcher = GoBatchDispatcher(self)
+        return self._dispatcher
 
     # ================================================== mirror lifecycle
     def _space_version(self, space_id: int) -> int:
@@ -176,19 +186,31 @@ class TpuQueryRuntime:
             return InterimResult(columns)
 
         et_tuple = tuple(sorted(set(etypes)))
-        start_idx = m.to_dense(start_vids)
-        start_idx = _pad_pow2(start_idx)
         self.stats["go_device"] += 1
 
-        final_mask, frontier = self._run_go_kernel(
-            m, space_id, steps, et_tuple, plan, start_idx)
-
-        final_mask = np.asarray(final_mask)
-        frontier = np.asarray(frontier)
-
-        # candidate edges of the final hop (pre-filter) — parity checks
-        etype_ok = np.isin(m.edge_etype, np.asarray(et_tuple, dtype=np.int32))
-        candidates = frontier[m.edge_src] & etype_ok
+        if plan.filter_cval is None:
+            # unfiltered GO rides the batch dispatcher: concurrent
+            # queries with the same shape coalesce into one ELL kernel
+            # launch; the final-hop edge mask is a host-side gather
+            frontier, disp_m = self.dispatcher.submit(
+                space_id, start_vids, et_tuple, steps)
+            if disp_m is not m:
+                # space version moved between planning and dispatch —
+                # materialize against the mirror the frontier lives in
+                m = disp_m
+            etype_ok = np.isin(m.edge_etype,
+                               np.asarray(et_tuple, dtype=np.int32))
+            final_mask = candidates = frontier[m.edge_src] & etype_ok
+        else:
+            start_idx = _pad_pow2(m.to_dense(start_vids))
+            final_mask, frontier = self._run_go_kernel(
+                m, space_id, steps, et_tuple, plan, start_idx)
+            final_mask = np.asarray(final_mask)
+            frontier = np.asarray(frontier)
+            # candidate edges of the final hop (pre-filter) — parity
+            etype_ok = np.isin(m.edge_etype,
+                               np.asarray(et_tuple, dtype=np.int32))
+            candidates = frontier[m.edge_src] & etype_ok
 
         if plan.filter_cval is not None and not plan.pushed_mode:
             # graphd-side WHERE raises on per-row missing props
@@ -487,31 +509,56 @@ class TpuQueryRuntime:
             m._ell = ix
         return ix
 
-    def go_batch(self, space_id: int, starts_per_query, etypes: List[int],
-                 steps: int) -> np.ndarray:
-        """Run B concurrent multi-hop GOs; returns bool [B, n] final
-        frontiers in the mirror's dense-id space."""
+    @staticmethod
+    def _batch_width(nq: int) -> int:
+        """Pad the query count to a pow-2, lane-friendly batch width so
+        kernel shapes (and the jit cache) stay stable across nq."""
+        return max(128, 1 << (nq - 1).bit_length())
+
+    def _kernel(self, key: Tuple, builder):
+        kern = self._kernels.get(key)
+        if kern is None:
+            kern = self._kernels[key] = builder()
+        return kern
+
+    def _go_batch_frontiers(self, space_id: int, starts_per_query,
+                            et_tuple: Tuple[int, ...], kernel_steps: int):
+        """Shared batched-GO core: run ``kernel_steps - 1`` frontier
+        advances for B queries; returns (bool [B, n] frontiers in the
+        mirror's dense-id space, mirror)."""
         import jax.numpy as jnp
         from .ell import make_batched_go_kernel
         m = self.mirror(space_id)
         ix = self.ell(m)
-        et_tuple = tuple(sorted(set(etypes)))
         nq = len(starts_per_query)
-        B = max(128, 1 << (nq - 1).bit_length())
-        key = (space_id, m.build_version, "ell_go", et_tuple, steps, B)
-        kern = self._kernels.get(key)
-        if kern is None:
-            # the kernel's ``steps`` counts like kernels._go_body: it
-            # advances steps-1 times and leaves the final hop to edge
-            # materialisation; go_batch returns the final-hop
-            # *destinations*, i.e. ``steps`` advances
-            kern = make_batched_go_kernel(ix, steps + 1, et_tuple)
-            self._kernels[key] = kern
+        B = self._batch_width(nq)
+        kern = self._kernel(
+            (space_id, m.build_version, "ell_go", et_tuple, kernel_steps, B),
+            lambda: make_batched_go_kernel(ix, kernel_steps, et_tuple))
         f0 = ix.start_frontier(
             [m.to_dense(s) for s in starts_per_query], B=B)
-        self.stats["go_device"] += nq
         out = np.asarray(kern(jnp.asarray(f0)))
-        return ix.to_old(out)[:, :nq].T > 0
+        return ix.to_old(out)[:, :nq].T > 0, m
+
+    def go_batch(self, space_id: int, starts_per_query, etypes: List[int],
+                 steps: int) -> np.ndarray:
+        """Run B concurrent multi-hop GOs; returns bool [B, n] final
+        frontiers (the final-hop *destinations*, i.e. ``steps``
+        advances — the kernel's steps counts like kernels._go_body, so
+        pass steps + 1) in the mirror's dense-id space."""
+        et_tuple = tuple(sorted(set(etypes)))
+        self.stats["go_device"] += len(starts_per_query)
+        out, _ = self._go_batch_frontiers(space_id, starts_per_query,
+                                          et_tuple, steps + 1)
+        return out
+
+    def go_batch_frontier(self, space_id: int, starts_per_query,
+                          et_tuple: Tuple[int, ...], steps: int):
+        """Dispatcher entry (graph/batch_dispatch.py): frontiers after
+        ``steps - 1`` advances — where a GO stands before its final
+        hop — plus the mirror they are expressed in."""
+        return self._go_batch_frontiers(space_id, starts_per_query,
+                                        et_tuple, steps)
 
     def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
                   etypes: List[int], max_steps: int,
@@ -523,14 +570,12 @@ class TpuQueryRuntime:
         ix = self.ell(m)
         et_tuple = tuple(sorted(set(etypes)))
         nq = len(starts_per_query)
-        B = max(128, 1 << (nq - 1).bit_length())
-        key = (space_id, m.build_version, "ell_bfs", et_tuple, max_steps,
-               shortest, B)
-        kern = self._kernels.get(key)
-        if kern is None:
-            kern = make_batched_bfs_kernel(ix, max_steps, et_tuple,
-                                           stop_when_found=shortest)
-            self._kernels[key] = kern
+        B = self._batch_width(nq)
+        kern = self._kernel(
+            (space_id, m.build_version, "ell_bfs", et_tuple, max_steps,
+             shortest, B),
+            lambda: make_batched_bfs_kernel(ix, max_steps, et_tuple,
+                                            stop_when_found=shortest))
         f0 = ix.start_frontier(
             [m.to_dense(s) for s in starts_per_query], B=B)
         t0 = ix.start_frontier(

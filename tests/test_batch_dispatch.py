@@ -1,0 +1,107 @@
+"""GoBatchDispatcher — concurrent GO queries must coalesce into fewer
+device dispatches while returning exactly the per-query results.
+(The reference has no cross-query batching; the parity oracle is the
+CPU executor path on an identical cluster, as in test_tpu_backend.)"""
+import threading
+
+import numpy as np
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.flags import flags
+
+
+@pytest.fixture
+def nba():
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    g = c.client()
+
+    def ok(stmt):
+        r = g.execute(stmt)
+        assert r.ok(), f"{stmt}: {r.error_msg}"
+        return r
+
+    ok("CREATE SPACE s(partition_num=3, replica_factor=1)")
+    c.refresh_all()
+    ok("USE s")
+    ok("CREATE EDGE follow(w int)")
+    c.refresh_all()
+    ok("INSERT EDGE follow(w) VALUES 1->2:(1), 2->3:(1), 3->4:(1), "
+       "4->5:(1), 1->6:(1), 6->7:(1), 2->7:(1)")
+    yield c, ok
+    c.stop()
+    flags.set("go_batch_window_ms", 0)
+
+
+def test_unfiltered_go_uses_dispatcher(nba):
+    c, ok = nba
+    r = ok("GO 2 STEPS FROM 1 OVER follow YIELD follow._dst")
+    assert sorted(x[0] for x in r.rows) == [3, 7, 7]
+    d = c.tpu_runtime.dispatcher
+    assert d.stats["batches"] >= 1
+    assert d.stats["batched_queries"] >= 1
+
+
+def test_concurrent_queries_coalesce(nba):
+    c, ok = nba
+    ok("GO 1 STEPS FROM 1 OVER follow")     # warm mirror + kernel cache
+    d = c.tpu_runtime.dispatcher
+    flags.set("go_batch_window_ms", 120)    # force a coalescing window
+
+    results = {}
+    errors = []
+
+    def worker(vid):
+        try:
+            g2 = c.client()
+            g2.execute("USE s")
+            r = g2.execute(f"GO 2 STEPS FROM {vid} OVER follow "
+                           f"YIELD follow._dst")
+            assert r.ok(), r.error_msg
+            results[vid] = sorted(x[0] for x in r.rows)
+        except Exception as ex:             # noqa: BLE001
+            errors.append(ex)
+
+    before = d.stats["batches"]
+    threads = [threading.Thread(target=worker, args=(v,))
+               for v in (1, 2, 1, 6, 2, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    flags.set("go_batch_window_ms", 0)
+
+    assert not errors, errors
+    assert results[1] == [3, 7, 7]
+    assert results[2] == [4]                # 2->3->4 (and 2->7->nothing)
+    assert results[6] == []                 # 6->7-> nothing
+    batches = d.stats["batches"] - before
+    assert batches < 6, f"no coalescing: {batches} batches for 6 queries"
+    assert d.stats["max_batch"] >= 2
+
+
+def test_dispatcher_parity_with_cpu_path(nba):
+    c, ok = nba
+    r_tpu = ok("GO 3 STEPS FROM 1 OVER follow YIELD follow._dst")
+    flags.set("storage_backend", "cpu")
+    try:
+        r_cpu = ok("GO 3 STEPS FROM 1 OVER follow YIELD follow._dst")
+    finally:
+        flags.set("storage_backend", "tpu")
+    assert sorted(map(tuple, r_tpu.rows)) == sorted(map(tuple, r_cpu.rows))
+
+
+def test_dispatcher_error_propagates():
+    """A failing kernel run must wake every waiter with the error."""
+    class Boom(RuntimeError):
+        pass
+
+    class FakeRuntime:
+        def go_batch_frontier(self, *a):
+            raise Boom("device fell over")
+
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher
+    d = GoBatchDispatcher(FakeRuntime())
+    with pytest.raises(Boom):
+        d.submit(1, [1], (1,), 2)
+    assert d.stats["batches"] == 1
