@@ -1,0 +1,138 @@
+"""query-perf — concurrent multi-hop GO latency/QPS against graphd.
+
+The query-level counterpart of storage_perf (reference
+StoragePerfTool drives StorageService; nothing in the reference drives
+GraphService under concurrency).  N client threads issue
+``GO <steps> STEPS FROM <random vid> OVER rel`` through the full serving
+path — parser, executor, TPU runtime, GO batch dispatcher — and the
+tool reports achieved QPS, p50/p95/p99 latency, and how well the
+dispatcher coalesced.  ``--backend cpu`` pins the CPU executor path for
+an apples-to-apples comparison on the same cluster and dataset.
+
+Run: ``python -m nebula_tpu.tools.query_perf [--edges 50000 ...]``
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+from .storage_perf import percentile
+
+
+def build_cluster(n_vertices: int, n_edges: int, seed: int = 7):
+    """In-process cluster with a random follow-graph, via bulk KV writes
+    (the statement path would dominate setup time)."""
+    from ..cluster import LocalCluster
+    from .perf_fixture import ensure_perf_space, edge
+
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    space_id, tag_id, etype = ensure_perf_space(c.graph_meta_client)
+    c.refresh_all()
+    sc = c.storage_client
+    rng = np.random.default_rng(seed)
+    src = rng.integers(1, n_vertices + 1, n_edges)
+    dst = rng.integers(1, n_vertices + 1, n_edges)
+    batch = []
+    for i in range(n_edges):
+        batch.append(edge(int(src[i]), etype, int(dst[i]), i))
+        if len(batch) >= 4096:
+            sc.add_edges(space_id, batch)
+            batch = []
+    if batch:
+        sc.add_edges(space_id, batch)
+    return c, space_id
+
+
+def run(c, steps: int, threads: int, total: int, n_vertices: int,
+        backend: str, seed: int = 11) -> dict:
+    from ..common.flags import flags
+    flags.set("storage_backend", backend)
+    space_name = "perf"
+    lat_us: List[float] = []
+    lock = threading.Lock()
+    counter = [0]
+    errors: List[str] = []
+    rng = np.random.default_rng(seed)
+    vids = rng.integers(1, n_vertices + 1, total).tolist()
+
+    # warm the mirror + kernel cache outside the timed region
+    g0 = c.client()
+    g0.execute(f"USE {space_name}")
+    g0.execute(f"GO {steps} STEPS FROM 1 OVER rel")
+
+    def worker():
+        g = c.client()
+        g.execute(f"USE {space_name}")
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= total:
+                    return
+                counter[0] += 1
+            t0 = time.perf_counter()
+            r = g.execute(f"GO {steps} STEPS FROM {vids[i]} OVER rel")
+            dt = (time.perf_counter() - t0) * 1e6
+            if not r.ok():
+                with lock:
+                    errors.append(r.error_msg)
+                continue
+            with lock:
+                lat_us.append(dt)
+
+    start = time.perf_counter()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - start
+    out = {
+        "backend": backend,
+        "steps": steps,
+        "threads": threads,
+        "requests": len(lat_us),
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "qps": round(len(lat_us) / wall, 1) if wall else 0.0,
+        "p50_us": round(percentile(lat_us, 50), 1),
+        "p95_us": round(percentile(lat_us, 95), 1),
+        "p99_us": round(percentile(lat_us, 99), 1),
+    }
+    rt = getattr(c, "tpu_runtime", None)
+    if backend == "tpu" and rt is not None and rt._dispatcher is not None:
+        out["batches"] = rt.dispatcher.stats["batches"]
+        out["max_batch"] = rt.dispatcher.stats["max_batch"]
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="query-perf")
+    p.add_argument("--vertices", type=int, default=10000)
+    p.add_argument("--edges", type=int, default=50000)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--threads", type=int, default=8)
+    p.add_argument("--totalReqs", type=int, default=200)
+    p.add_argument("--backend", default="both",
+                   choices=["tpu", "cpu", "both"])
+    args = p.parse_args(argv)
+
+    c, _ = build_cluster(args.vertices, args.edges)
+    try:
+        backends = ["cpu", "tpu"] if args.backend == "both" \
+            else [args.backend]
+        for b in backends:
+            print(run(c, args.steps, args.threads, args.totalReqs,
+                      args.vertices, b))
+    finally:
+        from ..common.flags import flags
+        flags.set("storage_backend", "tpu")
+        c.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,16 @@ def _pad_pow2(arr: np.ndarray, fill=-1, min_size: int = 8) -> np.ndarray:
     return kernels.pad_to(arr, size, fill)
 
 
+flags.define(
+    "mirror_refresh_mode", "sync",
+    "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
+    "next device query (always fresh — the test/parity default); "
+    "'async' keeps serving the stale mirror while a background thread "
+    "rebuilds (bounded staleness — the reference's own consistency "
+    "model: graphd/storaged caches refresh every "
+    "load_data_interval_secs=120s, MetaClient.cpp:13-14)")
+
+
 class TpuQueryRuntime:
     def __init__(self, storage_nodes, schema_man):
         # storage_nodes: objects with .kv (NebulaStore); the runtime is the
@@ -66,6 +76,7 @@ class TpuQueryRuntime:
         self._plans: Dict[int, _GoPlan] = {}
         self._kernels: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
+        self._rebuilding: Dict[int, int] = {}   # space -> version in flight
         self._dispatcher = None   # lazy GoBatchDispatcher
         # observability (tests assert the device path actually ran;
         # webservice /get_stats exports these)
@@ -95,15 +106,49 @@ class TpuQueryRuntime:
             if m is not None and m.build_version == ver \
                     and not m.expired_now():
                 return m
+            if m is not None and flags.get("mirror_refresh_mode") == "async":
+                # serve the stale mirror; rebuild off-thread (bounded
+                # staleness, like the reference's 120s cache refresh).
+                # At most ONE rebuild per space is in flight — later
+                # version bumps are picked up by the re-check on publish
+                if space_id not in self._rebuilding:
+                    self._rebuilding[space_id] = ver
+                    t = threading.Thread(
+                        target=self._rebuild_async,
+                        args=(space_id, ver, m),
+                        daemon=True, name=f"mirror-rebuild-{space_id}")
+                    t.start()
+                return m
             m = build_mirror(space_id, self.stores, self.sm)
-            m.build_version = ver
-            self.stats["mirror_builds"] += 1
             m._device = self._to_device(m)
-            self.mirrors[space_id] = m
-            # CSR changed: every cached kernel for this space is stale
-            self._kernels = {k: v for k, v in self._kernels.items()
-                             if k[0] != space_id}
-            return m
+            return self._publish(space_id, m, ver)
+
+    def _publish(self, space_id: int, m: CsrMirror, ver: int) -> CsrMirror:
+        """Install a built mirror (caller holds the lock)."""
+        m.build_version = ver
+        self.stats["mirror_builds"] += 1
+        self.mirrors[space_id] = m
+        # CSR changed: every cached kernel for this space is stale
+        self._kernels = {k: v for k, v in self._kernels.items()
+                         if k[0] != space_id}
+        return m
+
+    def _rebuild_async(self, space_id: int, ver: int,
+                       stale: CsrMirror) -> None:
+        try:
+            m = build_mirror(space_id, self.stores, self.sm)
+            m._device = self._to_device(m)
+            with self._lock:
+                # publish only if the mirror we set out to replace is
+                # still the installed one — anything else means a sync
+                # install (possibly newer) won the race; don't regress
+                if self.mirrors.get(space_id) is stale:
+                    self._publish(space_id, m, ver)
+        except Exception:      # noqa: BLE001 — a failed refresh keeps
+            pass               # serving the stale mirror; next query retries
+        finally:
+            with self._lock:
+                self._rebuilding.pop(space_id, None)
 
     @staticmethod
     def _to_device(m: CsrMirror) -> Dict[str, object]:

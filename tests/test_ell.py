@@ -176,3 +176,44 @@ def test_runtime_go_batch_small_cluster():
     d = rt.bfs_batch(sid, [[1]], [[4]], [et], 10, shortest=True)
     dense4 = int(m.to_dense([4])[0])
     assert d[0, dense4] == 3
+
+
+def test_async_mirror_refresh_serves_stale_then_updates():
+    """mirror_refresh_mode=async keeps answering from the stale mirror
+    and swaps in the rebuilt one off-thread (the reference's bounded
+    staleness: caches refresh every load_data_interval_secs)."""
+    import time
+    from nebula_tpu.cluster import LocalCluster
+    from nebula_tpu.common.flags import flags
+
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    g = c.client()
+    for stmt in ("CREATE SPACE s2(partition_num=3, replica_factor=1)",):
+        assert g.execute(stmt).ok()
+    c.refresh_all()
+    assert g.execute("USE s2").ok()
+    assert g.execute("CREATE EDGE e(w int)").ok()
+    c.refresh_all()
+    assert g.execute("INSERT EDGE e(w) VALUES 1->2:(1)").ok()
+
+    rt = c.tpu_runtime
+    sid = c.graph_meta_client.get_space_id_by_name("s2").value()
+    m1 = rt.mirror(sid)
+    assert m1.m >= 1
+
+    flags.set("mirror_refresh_mode", "async")
+    try:
+        assert g.execute("INSERT EDGE e(w) VALUES 2->3:(1)").ok()
+        stale = rt.mirror(sid)          # triggers bg rebuild, serves stale
+        assert stale is m1
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            m2 = rt.mirror(sid)
+            if m2 is not m1:
+                break
+            time.sleep(0.05)
+        assert m2 is not m1, "background rebuild never landed"
+        assert m2.m > m1.m
+    finally:
+        flags.set("mirror_refresh_mode", "sync")
+    c.stop()

@@ -1,0 +1,20 @@
+"""query-perf tool smoke: the concurrent GO load generator must drive
+both backends error-free on a small cluster and report sane stats."""
+from nebula_tpu.tools import query_perf
+
+
+def test_query_perf_both_backends():
+    c, _ = query_perf.build_cluster(n_vertices=300, n_edges=1500)
+    try:
+        for backend in ("cpu", "tpu"):
+            out = query_perf.run(c, steps=2, threads=4, total=24,
+                                 n_vertices=300, backend=backend)
+            assert out["errors"] == 0, out
+            assert out["requests"] == 24
+            assert out["p50_us"] > 0
+        # the dispatcher must have seen the tpu queries
+        assert c.tpu_runtime.dispatcher.stats["batched_queries"] >= 24
+    finally:
+        from nebula_tpu.common.flags import flags
+        flags.set("storage_backend", "tpu")
+        c.stop()
