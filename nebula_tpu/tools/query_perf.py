@@ -58,6 +58,7 @@ def run(c, steps: int, threads: int, total: int, n_vertices: int,
     errors: List[str] = []
     rng = np.random.default_rng(seed)
     vids = rng.integers(1, n_vertices + 1, total).tolist()
+    rt = getattr(c, "tpu_runtime", None)
 
     # warm the mirror + kernel cache outside the timed region
     g0 = c.client()
@@ -83,6 +84,8 @@ def run(c, steps: int, threads: int, total: int, n_vertices: int,
             with lock:
                 lat_us.append(dt)
 
+    disp_before = (rt.dispatcher.stats["batches"]
+                   if rt is not None and rt._dispatcher is not None else 0)
     start = time.perf_counter()
     ts = [threading.Thread(target=worker) for _ in range(threads)]
     for t in ts:
@@ -102,9 +105,10 @@ def run(c, steps: int, threads: int, total: int, n_vertices: int,
         "p95_us": round(percentile(lat_us, 95), 1),
         "p99_us": round(percentile(lat_us, 99), 1),
     }
-    rt = getattr(c, "tpu_runtime", None)
     if backend == "tpu" and rt is not None and rt._dispatcher is not None:
-        out["batches"] = rt.dispatcher.stats["batches"]
+        # per-run delta, not cumulative totals (run() may be called
+        # repeatedly on one cluster)
+        out["batches"] = rt.dispatcher.stats["batches"] - disp_before
         out["max_batch"] = rt.dispatcher.stats["max_batch"]
     return out
 

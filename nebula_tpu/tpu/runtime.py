@@ -57,6 +57,13 @@ def _pad_pow2(arr: np.ndarray, fill=-1, min_size: int = 8) -> np.ndarray:
 
 
 flags.define(
+    "tpu_filter_mode", "host",
+    "where a GO's WHERE filter evaluates on the device path: 'host' "
+    "(default — float64 numpy over the candidate edges, bit-identical "
+    "to the CPU executor path, and every GO shape batches through the "
+    "dispatcher) or 'device' (the mask fuses into the XLA hop program; "
+    "no cross-query batching)")
+flags.define(
     "mirror_refresh_mode", "sync",
     "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
     "next device query (always fresh — the test/parity default); "
@@ -76,7 +83,7 @@ class TpuQueryRuntime:
         self._plans: Dict[int, _GoPlan] = {}
         self._kernels: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
-        self._rebuilding: Dict[int, int] = {}   # space -> version in flight
+        self._rebuilding: set = set()           # spaces rebuilding now
         self._dispatcher = None   # lazy GoBatchDispatcher
         # observability (tests assert the device path actually ran;
         # webservice /get_stats exports these)
@@ -109,10 +116,12 @@ class TpuQueryRuntime:
             if m is not None and flags.get("mirror_refresh_mode") == "async":
                 # serve the stale mirror; rebuild off-thread (bounded
                 # staleness, like the reference's 120s cache refresh).
-                # At most ONE rebuild per space is in flight — later
-                # version bumps are picked up by the re-check on publish
+                # At most ONE rebuild per space is in flight; a
+                # version bump during the rebuild re-triggers on the
+                # next query because the published build_version won't
+                # match _space_version then
                 if space_id not in self._rebuilding:
-                    self._rebuilding[space_id] = ver
+                    self._rebuilding.add(space_id)
                     t = threading.Thread(
                         target=self._rebuild_async,
                         args=(space_id, ver, m),
@@ -148,7 +157,7 @@ class TpuQueryRuntime:
             pass               # serving the stale mirror; next query retries
         finally:
             with self._lock:
-                self._rebuilding.pop(space_id, None)
+                self._rebuilding.discard(space_id)
 
     @staticmethod
     def _to_device(m: CsrMirror) -> Dict[str, object]:
@@ -233,29 +242,52 @@ class TpuQueryRuntime:
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
 
-        if plan.filter_cval is None:
-            # unfiltered GO rides the batch dispatcher: concurrent
-            # queries with the same shape coalesce into one ELL kernel
-            # launch; the final-hop edge mask is a host-side gather
-            frontier, disp_m = self.dispatcher.submit(
-                space_id, start_vids, et_tuple, steps)
-            if disp_m is not m:
-                # space version moved between planning and dispatch —
-                # materialize against the mirror the frontier lives in
-                m = disp_m
-            etype_ok = np.isin(m.edge_etype,
-                               np.asarray(et_tuple, dtype=np.int32))
-            final_mask = candidates = frontier[m.edge_src] & etype_ok
-        else:
+        if plan.filter_cval is not None \
+                and flags.get("tpu_filter_mode") == "device":
+            # fused path: the WHERE mask compiles into the same XLA
+            # program as the hop loop (expression pushdown -> device,
+            # SURVEY.md §7 hard part (c)); no cross-query batching
             start_idx = _pad_pow2(m.to_dense(start_vids))
             final_mask, frontier = self._run_go_kernel(
                 m, space_id, steps, et_tuple, plan, start_idx)
             final_mask = np.asarray(final_mask)
             frontier = np.asarray(frontier)
-            # candidate edges of the final hop (pre-filter) — parity
             etype_ok = np.isin(m.edge_etype,
                                np.asarray(et_tuple, dtype=np.int32))
             candidates = frontier[m.edge_src] & etype_ok
+        else:
+            # default: every GO rides the batch dispatcher — concurrent
+            # queries with the same shape coalesce into one ELL kernel
+            # launch; the final-hop edge mask is a host-side gather and
+            # the WHERE filter evaluates host-side in float64, which is
+            # bit-identical to the CPU executor path
+            frontier, disp_m = self.dispatcher.submit(
+                space_id, start_vids, et_tuple, steps)
+            if disp_m is not m:
+                # space version moved between planning and dispatch —
+                # materialize against the mirror the frontier lives in,
+                # and recompile the filter against it: compiled cvals
+                # bake mirror-specific constants (dictionary-code ranks,
+                # vid ranks) that are stale in the new mirror
+                m = disp_m
+                if plan.filter_cval is not None:
+                    compiler = ExprCompiler(m, space_id, self.sm,
+                                            plan.alias_to_etype)
+                    try:
+                        plan.filter_cval = compiler.compile(where_expr)
+                    except CompileError:
+                        raise ExecError(
+                            "schema changed while the query ran")
+                    plan.filter_used = dict(compiler.used)
+                    plan.compiler = compiler
+            etype_ok = np.isin(m.edge_etype,
+                               np.asarray(et_tuple, dtype=np.int32))
+            candidates = frontier[m.edge_src] & etype_ok
+            final_mask = candidates
+            if plan.filter_cval is not None:
+                final_mask = candidates.copy()
+                final_mask[candidates] = self._host_filter(
+                    m, plan, np.nonzero(candidates)[0])
 
         if plan.filter_cval is not None and not plan.pushed_mode:
             # graphd-side WHERE raises on per-row missing props
@@ -274,6 +306,62 @@ class TpuQueryRuntime:
                     out.append(r)
             rows = out
         return InterimResult(columns, rows)
+
+    # -------------------------------------------------- host columns
+    def _gather_cols(self, m: CsrMirror, alias_to_etype: Dict[str, int],
+                     used: Dict[str, Tuple],
+                     idx: np.ndarray) -> Dict[str, np.ndarray]:
+        """numpy columns for compiled-expression eval over edge rows
+        ``idx`` — the one descriptor->array mapping shared by the host
+        WHERE filter and YIELD materialization."""
+        cols: Dict[str, np.ndarray] = {}
+        for k, desc in used.items():
+            if desc[0] == "edge":
+                cols[k] = m.edge_cols[(desc[1], desc[2])].values[idx]
+            elif desc[0] == "vertex":
+                col = m.vertex_cols[(desc[1], desc[2])]
+                gather = m.edge_src[idx] if desc[3] == "src" \
+                    else m.edge_dst[idx]
+                cols[k] = col.values[gather]
+            elif desc[0] == "rank":
+                cols["rank"] = m.edge_rank[idx]
+            elif desc[0] == "src_idx":
+                cols["src_idx"] = m.edge_src[idx]
+            elif desc[0] == "dst_idx":
+                cols["dst_idx"] = m.edge_dst[idx]
+            elif desc[0] == "etype_alias":
+                cols["etype_alias"] = \
+                    self._etype_alias_codes(m, alias_to_etype)[idx]
+        return cols
+
+    # -------------------------------------------------- host filter
+    def _host_filter(self, m: CsrMirror, plan: _GoPlan,
+                     idx: np.ndarray) -> np.ndarray:
+        """Evaluate the compiled WHERE over candidate edges ``idx`` in
+        numpy float64 — the same cval the device path would run, with
+        the same pushed-mode validity/div-guard semantics, but with the
+        CPU executor's exact precision."""
+        if len(idx) == 0:
+            return np.zeros(0, dtype=bool)
+        env = Env(np, self._gather_cols(m, plan.alias_to_etype,
+                                        plan.filter_used, idx))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            mask = np.broadcast_to(np.asarray(plan.filter_cval.fn(env)),
+                                   idx.shape).copy()
+            for g in plan.compiler.div_guards:
+                # a real x/0 drops the row in pushed mode (can_run_go
+                # declines div guards in graphd/remnant mode)
+                mask &= ~np.broadcast_to(np.asarray(g(env)), idx.shape)
+        if plan.pushed_mode:
+            for k, desc in plan.filter_used.items():
+                if desc[0] == "edge":
+                    mask &= m.edge_cols[(desc[1], desc[2])].valid[idx]
+                elif desc[0] == "vertex":
+                    col = m.vertex_cols[(desc[1], desc[2])]
+                    gather = m.edge_src[idx] if desc[3] == "src" \
+                        else m.edge_dst[idx]
+                    mask &= col.valid[gather]
+        return mask
 
     # -------------------------------------------------- kernel dispatch
     def _run_go_kernel(self, m: CsrMirror, space_id: int, steps: int,
@@ -434,25 +522,8 @@ class TpuQueryRuntime:
                         m, space_id, alias_to_etype, etype_to_alias,
                         yield_cols, idx, exc_type)
 
-        cols_np: Dict[str, np.ndarray] = {}
-        for k, desc in compiler.used.items():
-            if desc[0] == "edge":
-                cols_np[k] = m.edge_cols[(desc[1], desc[2])].values[idx]
-            elif desc[0] == "vertex":
-                col = m.vertex_cols[(desc[1], desc[2])]
-                gather = m.edge_src[idx] if desc[3] == "src" \
-                    else m.edge_dst[idx]
-                cols_np[k] = col.values[gather]
-            elif desc[0] == "rank":
-                cols_np["rank"] = m.edge_rank[idx]
-            elif desc[0] == "src_idx":
-                cols_np["src_idx"] = m.edge_src[idx]
-            elif desc[0] == "dst_idx":
-                cols_np["dst_idx"] = m.edge_dst[idx]
-            elif desc[0] == "etype_alias":
-                cols_np["etype_alias"] = \
-                    self._etype_alias_codes(m, alias_to_etype)[idx]
-        env = Env(np, cols_np)
+        env = Env(np, self._gather_cols(m, alias_to_etype, compiler.used,
+                                        idx))
 
         # a real x/0 in a YIELD raises on the CPU path — per-row eval
         # reproduces the exact error
@@ -605,15 +676,14 @@ class TpuQueryRuntime:
         return self._go_batch_frontiers(space_id, starts_per_query,
                                         et_tuple, steps)
 
-    def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
-                  etypes: List[int], max_steps: int,
-                  shortest: bool = True) -> np.ndarray:
-        """Batched BFS depths: int16 [B, n] (INT16_INF = unreached)."""
+    def _bfs_depths(self, space_id: int, m: CsrMirror, starts_per_query,
+                    targets_per_query, et_tuple: Tuple[int, ...],
+                    max_steps: int, shortest: bool) -> np.ndarray:
+        """Batched BFS core against an already-fetched mirror: int16
+        [B, n] depths (INT16_INF = unreached)."""
         import jax.numpy as jnp
         from .ell import make_batched_bfs_kernel
-        m = self.mirror(space_id)
         ix = self.ell(m)
-        et_tuple = tuple(sorted(set(etypes)))
         nq = len(starts_per_query)
         B = self._batch_width(nq)
         kern = self._kernel(
@@ -629,6 +699,16 @@ class TpuQueryRuntime:
         d = np.asarray(kern(jnp.asarray(f0), jnp.asarray(t0)))
         return ix.to_old(d)[:, :nq].T
 
+    def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
+                  etypes: List[int], max_steps: int,
+                  shortest: bool = True) -> np.ndarray:
+        """Batched BFS depths: int16 [B, n] (INT16_INF = unreached)."""
+        m = self.mirror(space_id)
+        return self._bfs_depths(space_id, m, starts_per_query,
+                                targets_per_query,
+                                tuple(sorted(set(etypes))), max_steps,
+                                shortest)
+
     # ================================================== FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
         if flags.get("storage_backend") == "cpu":
@@ -643,27 +723,17 @@ class TpuQueryRuntime:
                       dsts: List[int], etypes: List[int], max_steps: int,
                       shortest: bool, etype_names: Dict[int, str]
                       ) -> InterimResult:
-        import jax.numpy as jnp
+        from .ell import INT16_INF
         m = self.mirror(space_id)
         if m.m == 0 or not srcs or not dsts:
             return InterimResult(["path"])
         et_tuple = tuple(sorted(set(etypes)))
-        self.stats["path_device"] += 1
 
-        # --- device half: BFS depths --------------------------------
-        key = (space_id, m.build_version, "bfs", et_tuple, max_steps,
-               shortest)
-        kern = self._kernels.get(key)
-        if kern is None:
-            kern = kernels.make_bfs_kernel(m.n, max_steps, et_tuple,
-                                           stop_when_found=shortest)
-            self._kernels[key] = kern
-        dev = m._device
-        start_idx = _pad_pow2(m.to_dense(srcs))
-        target_idx = _pad_pow2(m.to_dense(dsts))
-        depth = np.asarray(kern(dev["edge_src"], dev["edge_dst"],
-                                dev["edge_etype"], jnp.asarray(start_idx),
-                                jnp.asarray(target_idx)))
+        # --- device half: batched ELL BFS depths --------------------
+        d16 = self._bfs_depths(space_id, m, [srcs], [dsts], et_tuple,
+                               max_steps, shortest)[0]
+        depth = np.where(d16 == INT16_INF, kernels.INT32_INF,
+                         d16.astype(np.int32))
 
         # --- host half: parent-DAG reconstruction -------------------
         return _reconstruct_paths(m, depth, srcs, dsts, et_tuple, max_steps,

@@ -252,3 +252,28 @@ class TestKernels:
         assert np.array_equal(np.asarray(f1), np.asarray(f8))
         assert np.array_equal(np.asarray(mask1),
                               np.asarray(mask8)[:m])
+
+
+class TestFilterModeParity:
+    """tpu_filter_mode=host (dispatcher + float64 host filter) and
+    =device (WHERE fused into the XLA hop program) must produce
+    identical rows for every WHERE-carrying parity query."""
+
+    def test_same_rows_both_filter_modes(self, clusters):
+        from nebula_tpu.common.flags import flags
+        _, _, tpu_c, tpu = clusters
+        where_queries = [q for q in PARITY_QUERIES if "WHERE" in q]
+        assert where_queries
+        host_rows = {}
+        for q in where_queries:
+            r = tpu.execute(q)
+            assert r.ok(), f"{q}: {r.error_msg}"
+            host_rows[q] = sorted(map(tuple, r.rows))
+        flags.set("tpu_filter_mode", "device")
+        try:
+            for q in where_queries:
+                r = tpu.execute(q)
+                assert r.ok(), f"{q}: {r.error_msg}"
+                assert sorted(map(tuple, r.rows)) == host_rows[q], q
+        finally:
+            flags.set("tpu_filter_mode", "host")
