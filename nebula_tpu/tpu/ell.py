@@ -327,6 +327,27 @@ def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
                                    nbr_shards, et_shards, real_rows):
     """Sharded-bucket batched GO.  f0 replicated [n_rows+1, B] int8."""
     import jax
+    hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
+                            real_rows)
+
+    @jax.jit
+    def go(f0, *tables):
+        if steps <= 1:
+            return f0
+        return jax.lax.fori_loop(0, steps - 1,
+                                 lambda _, f: hop(f, *tables), f0)
+
+    return go
+
+
+def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
+                      etypes: Tuple[int, ...], nbr_shards, et_shards,
+                      real_rows):
+    """hop(f, *tables) -> next frontier, with bucket rows expanded on
+    their owning device and the result re-replicated over ICI.  Shared
+    by the sharded GO and BFS builders (same split as _hop_body vs its
+    callers on the single-chip side)."""
+    import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
@@ -347,23 +368,55 @@ def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
 
     replicate = NamedSharding(mesh, P())
 
-    @jax.jit
-    def go(f0, *tables):
+    def hop(f, *tables):
         if n_buckets == 0:                   # empty graph: nothing moves
-            return f0 if steps <= 1 else jnp.zeros_like(f0)
-        def one(_, f):
-            outs = sharded_hop(f, *tables)
-            trimmed = [o[:r] for o, r in zip(outs, real_rows)]
-            nxt = jnp.concatenate(trimmed, axis=0) \
-                if len(trimmed) > 1 else trimmed[0]
-            if len(ell.extra_owner):
-                extras = nxt[ell.n:]
-                nxt = nxt.at[owner].max(extras)
-            pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
-            nxt = jnp.concatenate([nxt, pad], axis=0)
-            return jax.lax.with_sharding_constraint(nxt, replicate)
-        if steps <= 1:
-            return f0
-        return jax.lax.fori_loop(0, steps - 1, one, f0)
+            return jnp.zeros_like(f)
+        outs = sharded_hop(f, *tables)
+        trimmed = [o[:r] for o, r in zip(outs, real_rows)]
+        nxt = jnp.concatenate(trimmed, axis=0) \
+            if len(trimmed) > 1 else trimmed[0]
+        if len(ell.extra_owner):
+            extras = nxt[ell.n:]
+            nxt = nxt.at[owner].max(extras)
+        pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
+        nxt = jnp.concatenate([nxt, pad], axis=0)
+        return jax.lax.with_sharding_constraint(nxt, replicate)
 
-    return go
+    return hop
+
+
+def make_sharded_batched_bfs_kernel(mesh, axis: str, ell: EllIndex,
+                                    max_steps: int,
+                                    etypes: Tuple[int, ...],
+                                    nbr_shards, et_shards, real_rows,
+                                    stop_when_found: bool = True):
+    """Sharded-bucket batched BFS depths — the multi-chip counterpart of
+    make_batched_bfs_kernel, same depth/early-exit semantics."""
+    import jax
+    import jax.numpy as jnp
+    hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
+                            real_rows)
+
+    @jax.jit
+    def bfs(f0, targets, *tables):
+        d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
+
+        def cond(state):
+            d, f, step = state
+            go_on = (step < max_steps) & (f > 0).any()
+            if stop_when_found:
+                go_on = go_on & ((targets > 0) & (d == INT16_INF)).any()
+            return go_on
+
+        def body(state):
+            d, f, step = state
+            nxt = hop(f, *tables)
+            newly = (nxt > 0) & (d == INT16_INF)
+            d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
+            return d, newly.astype(jnp.int8), step + 1
+
+        d, _, _ = jax.lax.while_loop(
+            cond, body, (d0, f0, jnp.int32(0)))
+        return d
+
+    return bfs

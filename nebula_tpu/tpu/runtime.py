@@ -64,6 +64,12 @@ flags.define(
     "dispatcher) or 'device' (the mask fuses into the XLA hop program; "
     "no cross-query batching)")
 flags.define(
+    "tpu_mesh_devices", 0,
+    "shard the ELL tables over this many devices (a 1-D 'parts' Mesh; "
+    "per-hop frontier re-replication rides ICI). 0 = single-device. "
+    "The TPU analogue of the reference's multi-storaged partition "
+    "spread (SURVEY.md §2.12)")
+flags.define(
     "mirror_refresh_mode", "sync",
     "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
     "next device query (always fresh — the test/parity default); "
@@ -625,6 +631,54 @@ class TpuQueryRuntime:
             m._ell = ix
         return ix
 
+    def _mesh_tables(self, m: CsrMirror, ix: EllIndex):
+        """(mesh, nbr_shards, et_shards, real_rows) when
+        tpu_mesh_devices > 1, else None.  Sharded tables are cached on
+        the mirror alongside the ELL so they follow its lifecycle."""
+        k = int(flags.get("tpu_mesh_devices") or 0)
+        if k <= 1:
+            return None
+        cached = getattr(m, "_mesh_tables_cache", None)
+        if cached is not None and cached[0] == k:
+            return cached[1]
+        import jax
+        from jax.sharding import Mesh
+        from .ell import shard_ell
+        devs = jax.devices()
+        if len(devs) < k:
+            # misconfiguration must be visible, not a silent slow path
+            if not getattr(self, "_mesh_warned", False):
+                self._mesh_warned = True
+                import sys
+                sys.stderr.write(
+                    f"tpu_mesh_devices={k} but only {len(devs)} devices "
+                    f"visible — running single-device\n")
+            m._mesh_tables_cache = (k, None)
+            return None
+        mesh = Mesh(np.array(devs[:k]), ("parts",))
+        tables = (mesh,) + shard_ell(mesh, "parts", ix)
+        m._mesh_tables_cache = (k, tables)
+        return tables
+
+    def _batched_runner(self, space_id: int, m: CsrMirror, ix: EllIndex,
+                        tag: str, key_tail: Tuple, single_builder,
+                        sharded_builder):
+        """Pick the single-device or mesh-sharded kernel for a batched
+        GO/BFS launch — one cache-key/table-passing convention for both
+        (the sharded kernel gets the shard tables appended to its
+        positional args)."""
+        mt = self._mesh_tables(m, ix)
+        if mt is None:
+            return self._kernel(
+                (space_id, m.build_version, tag) + key_tail,
+                single_builder)
+        mesh, nbrs, ets, reals = mt
+        kern = self._kernel(
+            (space_id, m.build_version, tag + "_sharded") + key_tail
+            + (mesh.shape["parts"],),
+            lambda: sharded_builder(mesh, nbrs, ets, reals))
+        return lambda *arrays: kern(*arrays, *nbrs, *ets)
+
     @staticmethod
     def _batch_width(nq: int) -> int:
         """Pad the query count to a pow-2, lane-friendly batch width so
@@ -643,17 +697,21 @@ class TpuQueryRuntime:
         advances for B queries; returns (bool [B, n] frontiers in the
         mirror's dense-id space, mirror)."""
         import jax.numpy as jnp
-        from .ell import make_batched_go_kernel
+        from .ell import (make_batched_go_kernel,
+                          make_sharded_batched_go_kernel)
         m = self.mirror(space_id)
         ix = self.ell(m)
         nq = len(starts_per_query)
         B = self._batch_width(nq)
-        kern = self._kernel(
-            (space_id, m.build_version, "ell_go", et_tuple, kernel_steps, B),
-            lambda: make_batched_go_kernel(ix, kernel_steps, et_tuple))
+        run = self._batched_runner(
+            space_id, m, ix, "ell_go", (et_tuple, kernel_steps, B),
+            lambda: make_batched_go_kernel(ix, kernel_steps, et_tuple),
+            lambda mesh, nbrs, ets, reals: make_sharded_batched_go_kernel(
+                mesh, "parts", ix, kernel_steps, et_tuple, nbrs, ets,
+                reals))
         f0 = ix.start_frontier(
             [m.to_dense(s) for s in starts_per_query], B=B)
-        out = np.asarray(kern(jnp.asarray(f0)))
+        out = np.asarray(run(jnp.asarray(f0)))
         return ix.to_old(out)[:, :nq].T > 0, m
 
     def go_batch(self, space_id: int, starts_per_query, etypes: List[int],
@@ -682,21 +740,24 @@ class TpuQueryRuntime:
         """Batched BFS core against an already-fetched mirror: int16
         [B, n] depths (INT16_INF = unreached)."""
         import jax.numpy as jnp
-        from .ell import make_batched_bfs_kernel
+        from .ell import (make_batched_bfs_kernel,
+                          make_sharded_batched_bfs_kernel)
         ix = self.ell(m)
         nq = len(starts_per_query)
         B = self._batch_width(nq)
-        kern = self._kernel(
-            (space_id, m.build_version, "ell_bfs", et_tuple, max_steps,
-             shortest, B),
+        run = self._batched_runner(
+            space_id, m, ix, "ell_bfs", (et_tuple, max_steps, shortest, B),
             lambda: make_batched_bfs_kernel(ix, max_steps, et_tuple,
-                                            stop_when_found=shortest))
+                                            stop_when_found=shortest),
+            lambda mesh, nbrs, ets, reals: make_sharded_batched_bfs_kernel(
+                mesh, "parts", ix, max_steps, et_tuple, nbrs, ets, reals,
+                stop_when_found=shortest))
         f0 = ix.start_frontier(
             [m.to_dense(s) for s in starts_per_query], B=B)
         t0 = ix.start_frontier(
             [m.to_dense(t) for t in targets_per_query], B=B)
         self.stats["path_device"] += nq
-        d = np.asarray(kern(jnp.asarray(f0), jnp.asarray(t0)))
+        d = np.asarray(run(jnp.asarray(f0), jnp.asarray(t0)))
         return ix.to_old(d)[:, :nq].T
 
     def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,

@@ -217,3 +217,41 @@ def test_async_mirror_refresh_serves_stale_then_updates():
     finally:
         flags.set("mirror_refresh_mode", "sync")
     c.stop()
+
+
+def test_runtime_mesh_sharded_parity():
+    """tpu_mesh_devices=8 must produce the same nGQL results as the
+    single-device path — the runtime-level multi-chip check (the
+    kernel-level one is test_sharded_batched_go_parity)."""
+    from nebula_tpu.cluster import LocalCluster
+    from nebula_tpu.common.flags import flags
+
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    g = c.client()
+    assert g.execute(
+        "CREATE SPACE sm(partition_num=3, replica_factor=1)").ok()
+    c.refresh_all()
+    assert g.execute("USE sm").ok()
+    assert g.execute("CREATE EDGE e(w int)").ok()
+    c.refresh_all()
+    rng = np.random.default_rng(13)
+    vals = ", ".join(f"{a}->{b}:({i})" for i, (a, b) in
+                     enumerate(zip(rng.integers(1, 60, 300),
+                                   rng.integers(1, 60, 300))))
+    assert g.execute(f"INSERT EDGE e(w) VALUES {vals}").ok()
+
+    queries = [
+        "GO 3 STEPS FROM 1 OVER e YIELD e._dst",
+        "GO 2 STEPS FROM 5 OVER e WHERE e.w > 100 YIELD e._dst, e.w",
+        "FIND SHORTEST PATH FROM 1 TO 59 OVER e",
+    ]
+    single = [sorted(map(tuple, g.execute(q).rows)) for q in queries]
+    flags.set("tpu_mesh_devices", 8)
+    try:
+        for q, exp in zip(queries, single):
+            r = g.execute(q)
+            assert r.ok(), f"{q}: {r.error_msg}"
+            assert sorted(map(tuple, r.rows)) == exp, q
+    finally:
+        flags.set("tpu_mesh_devices", 0)
+    c.stop()
