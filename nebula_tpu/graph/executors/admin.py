@@ -272,9 +272,63 @@ class ShowExecutor(Executor):
             resp = _meta_call(self, "listUsers", {})
             return InterimResult(["Account"],
                                  [[u["account"]] for u in resp["users"]])
-        if t == ast.ShowTarget.VARIABLES:
-            return InterimResult(["Variable"], [])
+        if t == ast.ShowTarget.USER:
+            resp = _meta_call(self, "listUsers", {})
+            rows = [[u["account"]] for u in resp["users"]
+                    if u["account"] == s.name]
+            if not rows:
+                raise ExecError(f"user `{s.name}' not found")
+            return InterimResult(["Account"], rows)
+        if t == ast.ShowTarget.ROLES:
+            from ...interface.common import RoleType
+            sp = _meta_call(self, "getSpace", {"space_name": s.name})
+            sid = str(sp["id"])
+            resp = _meta_call(self, "listUsers", {})
+            rows = []
+            for u in resp["users"]:
+                role = u.get("roles", {}).get(sid)
+                if role is not None:
+                    rows.append([u["account"], RoleType(int(role)).name])
+            return InterimResult(["Account", "Role Type"], sorted(rows))
+        if t in (ast.ShowTarget.CREATE_SPACE, ast.ShowTarget.CREATE_TAG,
+                 ast.ShowTarget.CREATE_EDGE):
+            return self._show_create(t, s.name)
         raise ExecError(f"SHOW {t.value} not supported")
+
+    def _show_create(self, t: "ast.ShowTarget", name: str) -> InterimResult:
+        """Render the statement that would recreate the object — the
+        reference reserves kShowCreate* ShowTypes (Sentence.h) for this."""
+        if t == ast.ShowTarget.CREATE_SPACE:
+            sp = _meta_call(self, "getSpace", {"space_name": name})
+            stmt = (f"CREATE SPACE {name}(partition_num="
+                    f"{sp['partition_num']}, replica_factor="
+                    f"{sp['replica_factor']})")
+            return InterimResult(["Space", "Create Space"], [[name, stmt]])
+        self.check_space_chosen()
+        sm = self.ectx.schema_man
+        space = self.ectx.space_id()
+        kind = "TAG" if t == ast.ShowTarget.CREATE_TAG else "EDGE"
+        if kind == "TAG":
+            r = sm.to_tag_id(space, name)
+            schema = sm.get_tag_schema(space, r.value()) if r.ok() else None
+        else:
+            r = sm.to_edge_type(space, name)
+            schema = sm.get_edge_schema(space, r.value()) if r.ok() else None
+        if schema is None:
+            raise ExecError(f"{kind.lower()} `{name}' not found")
+        cols = ", ".join(f"{c.name} {c.type.name.lower()}"
+                         for c in schema.columns)
+        stmt = f"CREATE {kind} {name}({cols})"
+        prop = schema.schema_prop
+        if prop is not None and (prop.ttl_col or prop.ttl_duration):
+            extras = []
+            if prop.ttl_duration:
+                extras.append(f"ttl_duration = {prop.ttl_duration}")
+            if prop.ttl_col:
+                extras.append(f"ttl_col = {prop.ttl_col}")
+            stmt += " " + ", ".join(extras)
+        return InterimResult([kind.capitalize(), f"Create {kind.capitalize()}"],
+                             [[name, stmt]])
 
 
 class AddHostsExecutor(Executor):

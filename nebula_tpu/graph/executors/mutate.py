@@ -201,10 +201,21 @@ class UpdateEdgeExecutor(Executor):
         s: ast.UpdateEdgeSentence = self.sentence
         space = self.ectx.space_id()
         sm = self.ectx.schema_man
-        er = sm.to_edge_type(space, s.edge)
-        if not er.ok():
-            raise ExecError(f"unknown edge `{s.edge}'")
-        etype = er.value()
+        if s.edge:
+            er = sm.to_edge_type(space, s.edge)
+            if not er.ok():
+                raise ExecError(f"unknown edge `{s.edge}'")
+            etype = er.value()
+        else:
+            # reference form has no edge name (update_edge_sentence
+            # parser.yy:1108) — usable when the space has exactly one
+            # edge type; ambiguous otherwise
+            all_ets = sm.all_edge_types(space)
+            if len(all_ets) != 1:
+                raise ExecError(
+                    "UPDATE EDGE without an edge name needs OF <edge> "
+                    "when the space has multiple edge types")
+            etype = all_ets[0]
         schema = sm.get_edge_schema(space, etype)
         src = self.eval_const(s.src)
         dst = self.eval_const(s.dst)
@@ -261,6 +272,11 @@ class DeleteVertexExecutor(Executor):
     def execute(self) -> None:
         self.check_space_chosen()
         s: ast.DeleteVertexSentence = self.sentence
+        if s.where is not None:
+            # the reference parses but never executes DELETE ... WHERE
+            # (no executor exists, SURVEY.md §2.2); refusing loudly beats
+            # silently deleting unconditionally
+            raise ExecError("WHERE in DELETE VERTEX is not supported")
         space = self.ectx.space_id()
         sm = self.ectx.schema_man
         etypes = sm.all_edge_types(space)
@@ -300,18 +316,29 @@ class DeleteEdgeExecutor(Executor):
     def execute(self) -> None:
         self.check_space_chosen()
         s: ast.DeleteEdgeSentence = self.sentence
+        if s.where is not None:
+            # parse-parity with the reference, which never executes
+            # DELETE ... WHERE — refuse instead of deleting everything
+            raise ExecError("WHERE in DELETE EDGE is not supported")
         space = self.ectx.space_id()
         sm = self.ectx.schema_man
-        er = sm.to_edge_type(space, s.edge)
-        if not er.ok():
-            raise ExecError(f"unknown edge `{s.edge}'")
-        etype = er.value()
+        if s.edge:
+            er = sm.to_edge_type(space, s.edge)
+            if not er.ok():
+                raise ExecError(f"unknown edge `{s.edge}'")
+            etypes = [er.value()]
+        else:
+            # the reference's DELETE EDGE carries no edge name
+            # (delete_edge_sentence parser.yy:1182) — match keys across
+            # every edge type in the space
+            etypes = sm.all_edge_types(space)
         keys = []
         for k in s.keys:
             src = self.eval_const(k.src)
             dst = self.eval_const(k.dst)
-            keys.append((src, etype, k.rank, dst))
-            keys.append((dst, -etype, k.rank, src))  # reverse edge too
+            for etype in etypes:
+                keys.append((src, etype, k.rank, dst))
+                keys.append((dst, -etype, k.rank, src))  # reverse edge too
         resp = self.ectx.storage.delete_edges(space, keys)
         if not resp.succeeded():
             raise ExecError("delete edges failed")

@@ -431,8 +431,12 @@ class ShowTarget(enum.Enum):
     HOSTS = "hosts"
     PARTS = "parts"
     USERS = "users"
+    USER = "user"                  # SHOW USER <account>
+    ROLES = "roles"                # SHOW ROLES IN <space>
+    CREATE_SPACE = "create space"  # SHOW CREATE SPACE <name>
+    CREATE_TAG = "create tag"
+    CREATE_EDGE = "create edge"
     CONFIGS = "configs"
-    VARIABLES = "variables"
 
 
 @dataclass
@@ -440,6 +444,7 @@ class ShowSentence(Sentence):
     kind = Kind.SHOW
     target: ShowTarget = ShowTarget.SPACES
     module: Optional[str] = None  # SHOW CONFIGS graph
+    name: Optional[str] = None    # SHOW USER/ROLES IN/CREATE * <name>
 
 
 @dataclass

@@ -4,8 +4,10 @@ Capability parity with the reference's flex scanner
 (/root/reference/src/parser/scanner.lex): case-insensitive keywords,
 identifiers, dec/hex int literals, doubles, single/double-quoted strings
 with escapes, the full operator set (incl. ``->``, ``|`` vs ``||``,
-``$-``/``$^``/``$$``/``$var`` references), and line comments (``--``, ``#``,
-``//``).
+``$-``/``$^``/``$$``/``$var`` references), line comments (``--``, ``#``,
+``//``), block comments (``/* */``, unterminated -> error,
+scanner.lex:399-408), and bare IPv4 literals for host lists
+(``ADD HOSTS 127.0.0.1:1000``).
 """
 from __future__ import annotations
 
@@ -34,7 +36,8 @@ KEYWORDS = {
     "desc", "tag", "edge", "space", "if", "not", "exists", "insert",
     "vertex", "values", "update", "upsert", "set", "delete", "order", "by",
     "asc", "change", "int", "double", "string", "bool", "timestamp", "true",
-    "false", "user", "password", "with", "grant", "revoke", "role", "god",
+    "false", "user", "password", "with", "grant", "revoke", "role", "roles",
+    "god",
     "admin", "guest", "balance", "data", "leader", "stop", "download",
     "hdfs", "ingest", "get", "group", "limit", "offset", "when", "of",
     "graph", "meta", "storage", "uuid", "or", "and", "xor", "no",
@@ -43,7 +46,9 @@ KEYWORDS = {
 
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
-  | (?P<comment>--[^\n]*|\#[^\n]*|//[^\n]*)
+  | (?P<comment>--[^\n]*|\#[^\n]*|//[^\n]*|/\*(?:[^*]|\*(?!/))*\*/)
+  | (?P<badcomment>/\*)
+  | (?P<ipv4>\d+\.\d+\.\d+\.\d+)
   | (?P<float>\d+\.\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?|\d+[eE][-+]?\d+)
   | (?P<int>0[xX][0-9a-fA-F]+|\d+)
   | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
@@ -84,6 +89,10 @@ def tokenize(text: str) -> List[Token]:
         val = m.group()
         if kind == "ws" or kind == "comment":
             pass
+        elif kind == "badcomment":
+            raise LexError("unterminated comment")    # scanner.lex parity
+        elif kind == "ipv4":
+            tokens.append(Token("IPV4", val, pos))
         elif kind == "float":
             tokens.append(Token("FLOAT", float(val), pos))
         elif kind == "int":

@@ -79,15 +79,22 @@ class _Parser:
             self.fail(f"expected {sym!r}")
         return v
 
+    # keywords usable as names — the reference's unreserved_keyword set
+    # (parser.yy:211-227: space/hosts/spaces/user/users/password/role/
+    # roles/god/admin/guest) plus our own contextual extras
+    UNRESERVED = frozenset({
+        "space", "hosts", "spaces", "user", "users", "password", "role",
+        "roles", "god", "admin", "guest", "balance", "data", "leader",
+        "graph", "meta",
+        "storage", "path", "all", "in", "out", "both", "step", "of",
+    })
+
     def expect_id(self, what: str = "identifier") -> str:
         t = self.peek()
-        # contextual keywords usable as names (e.g. a tag named `data`)
         if t.type == "ID":
             self.next()
             return t.value
-        if t.type == "KW" and t.value in ("data", "leader", "graph", "meta",
-                                          "storage", "user", "path", "all",
-                                          "in", "out", "both", "step", "of"):
+        if t.type == "KW" and t.value in self.UNRESERVED:
             self.next()
             return t.value
         self.fail(f"expected {what}")
@@ -490,7 +497,11 @@ class _Parser:
 
     def p_update(self) -> ast.Sentence:
         insertable = self.next().value == "upsert"
-        if self.accept_kw("configs"):  # UPDATE CONFIGS module:name = value
+        if self.accept_kw("or"):              # UPDATE OR INSERT (parser.yy
+            self.expect_kw("insert")          # update_*_sentence variants)
+            insertable = True
+        if self.accept_kw("configs", "variables"):
+            # UPDATE CONFIGS|VARIABLES [module:]name = value
             module, name = self._config_item()
             self.expect_sym("=")
             return ast.ConfigSentence(action="update", module=module,
@@ -516,8 +527,11 @@ class _Parser:
             if rt.type != "INT":
                 self.fail("expected rank")
             s2.rank = rt.value
-        self.expect_kw("of")
-        s2.edge = self.expect_id("edge name")
+        # the reference addresses the edge purely by key (update_edge
+        # parser.yy:1108: no edge name); our extended form allows
+        # `OF <edge>` to disambiguate explicitly
+        if self.accept_kw("of"):
+            s2.edge = self.expect_id("edge name")
         self.expect_kw("set")
         s2.items = self._update_items()
         if self.at_kw("when", "where"):
@@ -551,7 +565,14 @@ class _Parser:
             return s
         self.expect_kw("edge")
         s2 = ast.DeleteEdgeSentence()
-        s2.edge = self.expect_id("edge name")
+        # the reference's form carries no edge name (delete_edge_sentence
+        # parser.yy:1182-1188: DELETE EDGE <src> -> <dst>, ...); our
+        # extended form names the edge type first
+        t = self.peek()
+        if (t.type == "ID" or (t.type == "KW" and t.value in self.UNRESERVED)) \
+                and not (self.peek(1).type == "SYM"
+                         and self.peek(1).value == "("):
+            s2.edge = self.expect_id("edge name")
         while True:
             src = self.p_expression()
             self.expect_sym("->")
@@ -617,11 +638,12 @@ class _Parser:
         s = cls(name=self.expect_id("schema name"))
         s.if_not_exists = ine
         self.expect_sym("(")
-        if not self.at_sym(")"):
-            while True:
-                s.columns.append(self._column_spec())
-                if not self.accept_sym(","):
-                    break
+        # empty column lists and trailing commas are legal
+        # (create_tag_sentence parser.yy:713-732)
+        while not self.at_sym(")"):
+            s.columns.append(self._column_spec())
+            if not self.accept_sym(","):
+                break
         self.expect_sym(")")
         # schema props: ttl_duration = n, ttl_col = name
         while self.peek().type == "ID" or self.at_sym(","):
@@ -738,27 +760,58 @@ class _Parser:
 
     def p_show(self) -> ast.Sentence:
         self.expect_kw("show")
-        if self.accept_kw("configs"):
+        # SHOW VARIABLES is the reference's alias for SHOW CONFIGS
+        # (parser.yy:1219-1221)
+        if self.accept_kw("configs", "variables"):
             module = None
             if self.at_kw("graph", "meta", "storage"):
                 module = self.next().value
             return ast.ConfigSentence(action="show", module=module)
+        if self.accept_kw("create"):          # parser.yy:1222-1230
+            if self.accept_kw("space"):
+                target = ast.ShowTarget.CREATE_SPACE
+            elif self.accept_kw("tag"):
+                target = ast.ShowTarget.CREATE_TAG
+            else:
+                self.expect_kw("edge")
+                target = ast.ShowTarget.CREATE_EDGE
+            return ast.ShowSentence(target=target,
+                                    name=self.expect_id("name"))
+        if self.accept_kw("user"):
+            return ast.ShowSentence(target=ast.ShowTarget.USER,
+                                    name=self.expect_id("account"))
+        if self.accept_kw("roles"):
+            self.expect_kw("in")
+            return ast.ShowSentence(target=ast.ShowTarget.ROLES,
+                                    name=self.expect_id("space name"))
         mapping = {"spaces": ast.ShowTarget.SPACES, "tags": ast.ShowTarget.TAGS,
                    "edges": ast.ShowTarget.EDGES, "hosts": ast.ShowTarget.HOSTS,
-                   "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS,
-                   "variables": ast.ShowTarget.VARIABLES}
+                   "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS}
         kw = self.next()
         if kw.type != "KW" or kw.value not in mapping:
             self.fail("expected SHOW target")
         return ast.ShowSentence(target=mapping[kw.value])
 
     def _host_list(self) -> List[str]:
+        """Quoted "ip:port" strings or bare 127.0.0.1:port literals
+        (host_item parser.yy; trailing commas tolerated like host_list)."""
         hosts = []
         while True:
-            t = self.next()
-            if t.type != "STRING":
-                self.fail('expected "ip:port" string')
-            hosts.append(t.value)
+            t = self.peek()
+            if t.type == "STRING":
+                self.next()
+                hosts.append(t.value)
+            elif t.type == "IPV4":
+                self.next()
+                self.expect_sym(":")
+                pt = self.next()
+                if pt.type != "INT":
+                    self.fail("expected port")
+                hosts.append(f"{t.value}:{pt.value}")
+            elif hosts:                        # trailing comma case
+                break
+            else:
+                self.fail('expected "ip:port"')
             if not self.accept_sym(","):
                 break
         return hosts
@@ -775,7 +828,7 @@ class _Parser:
 
     def p_get_config(self) -> ast.ConfigSentence:
         self.expect_kw("get")
-        self.expect_kw("configs")
+        self.expect_kw("configs", "variables")   # VARIABLES = alias
         module, name = self._config_item()
         return ast.ConfigSentence(action="get", module=module, name=name)
 

@@ -100,20 +100,28 @@ def test_write_replicates_to_all_copies(cluster, client):
     # Follower catch-up is async; a loaded CI box can take a while, so
     # the deadline is generous (the loop exits as soon as it converges)
     deadline = time.monotonic() + 30.0
+    counts = []
     while time.monotonic() < deadline:
-        counts = []
-        for node in cluster.storage_nodes:
-            n = 0
-            for sid in node.kv.spaces:
-                for pid in node.kv.part_ids(sid):
-                    part = node.kv.part(sid, pid)
-                    n += sum(1 for k, _v in part.engine.prefix(b"")
-                             if not k.startswith(b"__"))
-            counts.append(n)
+        try:
+            counts = []
+            for node in cluster.storage_nodes:
+                n = 0
+                for sid in list(node.kv.spaces):
+                    for pid in node.kv.part_ids(sid):
+                        part = node.kv.part(sid, pid)
+                        if part is None:       # part still spinning up
+                            raise LookupError(pid)
+                        n += sum(1 for k, _v in part.engine.prefix(b"")
+                                 if not k.startswith(b"__"))
+                counts.append(n)
+        except (LookupError, RuntimeError):    # transient mid-replication
+            counts = []                        # partial scan — not a verdict
+            time.sleep(0.05)
+            continue
         if all(c == counts[0] and c > 0 for c in counts):
             break
         time.sleep(0.05)
-    assert all(c == counts[0] and c > 0 for c in counts), counts
+    assert counts and all(c == counts[0] and c > 0 for c in counts), counts
 
 
 def test_query_reads_through_leaders(client):

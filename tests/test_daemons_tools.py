@@ -248,3 +248,70 @@ class TestStoragePerfTool:
             assert w["requests"] == 30
         finally:
             cluster.stop()
+
+
+def test_show_create_and_roles_end_to_end():
+    """SHOW CREATE TAG/EDGE/SPACE, SHOW USER, SHOW ROLES IN through a
+    live cluster (executor halves of the reference-syntax parity)."""
+    from nebula_tpu.cluster import LocalCluster
+    c = LocalCluster(num_storage=1)
+    g = c.client()
+
+    def ok(stmt):
+        r = g.execute(stmt)
+        assert r.ok(), f"{stmt}: {r.error_msg}"
+        return r
+
+    ok("CREATE SPACE sc(partition_num=3, replica_factor=1)")
+    c.refresh_all()
+    ok("USE sc")
+    ok("CREATE TAG person(name string, age int) ttl_duration = 100, "
+       "ttl_col = age")
+    ok("CREATE EDGE likes(w double)")
+    c.refresh_all()
+
+    r = ok("SHOW CREATE TAG person")
+    assert r.rows[0][0] == "person"
+    assert "CREATE TAG person(name string, age int)" in r.rows[0][1]
+    assert "ttl_duration = 100" in r.rows[0][1]
+    r = ok("SHOW CREATE EDGE likes")
+    assert "CREATE EDGE likes(w double)" in r.rows[0][1]
+    r = ok("SHOW CREATE SPACE sc")
+    assert "partition_num=3" in r.rows[0][1]
+
+    ok("CREATE USER alice WITH PASSWORD \"pw\"")
+    ok("GRANT ROLE ADMIN ON sc TO alice")
+    r = ok("SHOW USER alice")
+    assert r.rows == [["alice"]]
+    r = ok("SHOW ROLES IN sc")
+    assert ["alice", "ADMIN"] in [list(x) for x in r.rows]
+
+    # nameless DELETE EDGE across etypes
+    ok('INSERT EDGE likes(w) VALUES 1->2:(0.5)')
+    r = ok("GO FROM 1 OVER likes")
+    assert len(r.rows) == 1
+    ok("DELETE EDGE 1 -> 2")
+    r = ok("GO FROM 1 OVER likes")
+    assert len(r.rows) == 0
+    c.stop()
+
+
+def test_delete_with_where_refuses():
+    """DELETE ... WHERE parses (reference grammar) but must refuse at
+    execution rather than deleting unconditionally."""
+    from nebula_tpu.cluster import LocalCluster
+    c = LocalCluster(num_storage=1)
+    g = c.client()
+    assert g.execute("CREATE SPACE dw(partition_num=1, replica_factor=1)").ok()
+    c.refresh_all()
+    assert g.execute("USE dw").ok()
+    assert g.execute("CREATE EDGE e(w int)").ok()
+    c.refresh_all()
+    assert g.execute("INSERT EDGE e(w) VALUES 1->2:(5)").ok()
+    r = g.execute("DELETE EDGE 1 -> 2 WHERE w > 3")
+    assert not r.ok() and "not supported" in r.error_msg
+    # nothing was deleted
+    assert len(g.execute("GO FROM 1 OVER e").rows) == 1
+    r = g.execute("DELETE VERTEX 1 WHERE w > 3")
+    assert not r.ok() and "not supported" in r.error_msg
+    c.stop()

@@ -328,3 +328,80 @@ class TestSequencesAndErrors:
     def test_case_insensitive_keywords(self):
         s = parse1("go from 1 over follow yield follow._dst")
         assert isinstance(s, ast.GoSentence)
+
+
+class TestReferenceSyntaxParity:
+    """Syntax forms harvested from the reference's own test suite
+    (ParserTest.cpp / SchemaTest.cpp / graph tests)."""
+
+    def _ok(self, q):
+        from nebula_tpu.graph.parser import GQLParser
+        r = GQLParser().parse(q)
+        assert r.ok(), f"{q}: {r.status.msg}"
+        return r.value()
+
+    def _bad(self, q):
+        from nebula_tpu.graph.parser import GQLParser
+        assert not GQLParser().parse(q).ok(), q
+
+    def test_comments(self):
+        self._ok("CREATE TAG t1(x int) # trailing")
+        self._ok("CREATE TAG t1(x int) -- trailing")
+        self._ok("CREATE TAG t1(x int) // trailing")
+        self._ok("CREATE TAG t1/* inline */(x int)")
+        self._bad("CREATE TAG t1 /* unterminated (x int)")
+
+    def test_unreserved_keywords_as_names(self):
+        self._ok("CREATE TAG TAG1(space string, user int, balance double)")
+        self._ok("GO FROM 1 OVER follow YIELD follow.space")
+
+    def test_empty_and_trailing_comma_schemas(self):
+        self._ok("CREATE TAG empty_tag()")
+        self._ok("CREATE EDGE empty_edge()")
+        self._ok("CREATE TAG t(x int, y string,)")
+        self._bad("CREATE TAG t")            # parens required (parser.yy)
+        self._bad("CREATE TAG t(x)")         # type required
+
+    def test_show_variants(self):
+        import nebula_tpu.graph.parser.ast as ast
+        s = self._ok("SHOW CREATE TAG person").sentences[0]
+        assert s.target == ast.ShowTarget.CREATE_TAG and s.name == "person"
+        s = self._ok("SHOW CREATE EDGE e1").sentences[0]
+        assert s.target == ast.ShowTarget.CREATE_EDGE
+        s = self._ok("SHOW CREATE SPACE default_space").sentences[0]
+        assert s.target == ast.ShowTarget.CREATE_SPACE
+        s = self._ok("SHOW USER account").sentences[0]
+        assert s.target == ast.ShowTarget.USER and s.name == "account"
+        s = self._ok("SHOW ROLES IN spacename").sentences[0]
+        assert s.target == ast.ShowTarget.ROLES and s.name == "spacename"
+        s = self._ok("SHOW VARIABLES storage").sentences[0]
+        assert s.kind == ast.Kind.CONFIG
+
+    def test_variables_config_aliases(self):
+        s = self._ok("UPDATE VARIABLES storage:k0=123").sentences[0]
+        assert s.action == "update" and s.module == "storage"
+        s = self._ok("GET VARIABLES storage:k1").sentences[0]
+        assert s.action == "get"
+
+    def test_bare_host_lists(self):
+        s = self._ok("ADD HOSTS 127.0.0.1:1000, 127.0.0.1:9000").sentences[0]
+        assert s.hosts == ["127.0.0.1:1000", "127.0.0.1:9000"]
+        s = self._ok("REMOVE HOSTS 127.0.0.1:1000,").sentences[0]
+        assert s.hosts == ["127.0.0.1:1000"]
+
+    def test_nameless_delete_and_update_edge(self):
+        s = self._ok("DELETE EDGE 123 -> 321,456 -> 654 "
+                     "WHERE amount > 3.14").sentences[0]
+        assert s.edge == "" and len(s.keys) == 2 and s.where is not None
+        s = self._ok("UPDATE EDGE 12345 -> 54321 "
+                     "SET amount=3.14,time=1537408527").sentences[0]
+        assert s.edge == "" and len(s.items) == 2
+        s = self._ok("UPDATE OR INSERT VERTEX 1 SET x=2").sentences[0]
+        assert s.insertable
+
+    def test_reference_negatives_still_fail(self):
+        self._bad("ALTER EDGE woman ADD (col6)  ttl_duration = 200")
+        self._bad("ALTER EDGE woman DROP (col6 int)  ttl_duration = 200")
+        self._bad("CREATE TAG man(name string, age)")
+        self._bad("YIELD $^[manager].name")
+        self._bad("USE dumy tag_name")
