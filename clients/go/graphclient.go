@@ -1,0 +1,376 @@
+// Package nebulatpu — Go GraphClient for the nebula-tpu graph service.
+//
+// Capability parity with the reference's client/go thin wrapper
+// (/root/reference/src/client/go): blocking Connect/Execute over the
+// framed wire protocol (interface/rpc.py: 4-byte big-endian length |
+// msgpack [method, payload]).  Self-contained: includes the minimal
+// msgpack subset the protocol uses (nil, bool, int, double, str, bin,
+// array, map) — no external dependencies.
+//
+// Usage:
+//
+//	c := nebulatpu.NewGraphClient("127.0.0.1:3699")
+//	if err := c.Connect("user", "password"); err != nil { ... }
+//	resp, err := c.Execute("USE nba; GO FROM 100 OVER follow")
+//	for _, row := range resp.Rows { ... }
+package nebulatpu
+
+import (
+	"encoding/binary"
+	"errors"
+	"fmt"
+	"io"
+	"math"
+	"net"
+)
+
+const maxFrame = 1 << 30 // server cap (interface/rpc.py _MAX_FRAME)
+
+// ---------------------------------------------------------------- msgpack
+func packInto(buf []byte, v interface{}) ([]byte, error) {
+	switch x := v.(type) {
+	case nil:
+		return append(buf, 0xc0), nil
+	case bool:
+		if x {
+			return append(buf, 0xc3), nil
+		}
+		return append(buf, 0xc2), nil
+	case int:
+		return packInt(buf, int64(x)), nil
+	case int64:
+		return packInt(buf, x), nil
+	case float64:
+		buf = append(buf, 0xcb)
+		var b [8]byte
+		binary.BigEndian.PutUint64(b[:], math.Float64bits(x))
+		return append(buf, b[:]...), nil
+	case string:
+		b := []byte(x)
+		switch {
+		case len(b) < 32:
+			buf = append(buf, 0xa0|byte(len(b)))
+		case len(b) < 256:
+			buf = append(buf, 0xd9, byte(len(b)))
+		case len(b) < 1<<16:
+			buf = append(buf, 0xda, byte(len(b)>>8), byte(len(b)))
+		default:
+			buf = append(buf, 0xdb, byte(len(b)>>24), byte(len(b)>>16),
+				byte(len(b)>>8), byte(len(b)))
+		}
+		return append(buf, b...), nil
+	case []interface{}:
+		buf = packLen(buf, len(x), 0x90, 0xdc, 0xdd)
+		var err error
+		for _, e := range x {
+			if buf, err = packInto(buf, e); err != nil {
+				return nil, err
+			}
+		}
+		return buf, nil
+	case map[string]interface{}:
+		buf = packLen(buf, len(x), 0x80, 0xde, 0xdf)
+		var err error
+		for k, e := range x {
+			if buf, err = packInto(buf, k); err != nil {
+				return nil, err
+			}
+			if buf, err = packInto(buf, e); err != nil {
+				return nil, err
+			}
+		}
+		return buf, nil
+	}
+	return nil, fmt.Errorf("msgpack: unsupported type %T", v)
+}
+
+func packInt(buf []byte, x int64) []byte {
+	switch {
+	case x >= 0 && x < 128:
+		return append(buf, byte(x))
+	case x < 0 && x >= -32:
+		return append(buf, byte(x))
+	default:
+		var b [8]byte
+		binary.BigEndian.PutUint64(b[:], uint64(x))
+		return append(append(buf, 0xd3), b[:]...)
+	}
+}
+
+func packLen(buf []byte, n int, fix, m16, m32 byte) []byte {
+	switch {
+	case n < 16:
+		return append(buf, fix|byte(n))
+	case n < 1<<16:
+		return append(buf, m16, byte(n>>8), byte(n))
+	default:
+		return append(buf, m32, byte(n>>24), byte(n>>16), byte(n>>8), byte(n))
+	}
+}
+
+type decoder struct {
+	b []byte
+	i int
+}
+
+func (d *decoder) u8() (byte, error) {
+	if d.i >= len(d.b) {
+		return 0, io.ErrUnexpectedEOF
+	}
+	v := d.b[d.i]
+	d.i++
+	return v, nil
+}
+
+func (d *decoder) take(n int) ([]byte, error) {
+	if d.i+n > len(d.b) {
+		return nil, io.ErrUnexpectedEOF
+	}
+	v := d.b[d.i : d.i+n]
+	d.i += n
+	return v, nil
+}
+
+func (d *decoder) uN(n int) (uint64, error) {
+	b, err := d.take(n)
+	if err != nil {
+		return 0, err
+	}
+	var v uint64
+	for _, c := range b {
+		v = v<<8 | uint64(c)
+	}
+	return v, nil
+}
+
+func (d *decoder) decode() (interface{}, error) {
+	t, err := d.u8()
+	if err != nil {
+		return nil, err
+	}
+	switch {
+	case t < 0x80:
+		return int64(t), nil
+	case t >= 0xe0:
+		return int64(int8(t)), nil
+	case t >= 0xa0 && t < 0xc0:
+		b, err := d.take(int(t & 0x1f))
+		return string(b), err
+	case t >= 0x90 && t < 0xa0:
+		return d.array(int(t & 0x0f))
+	case t >= 0x80 && t < 0x90:
+		return d.mapN(int(t & 0x0f))
+	}
+	switch t {
+	case 0xc0:
+		return nil, nil
+	case 0xc2:
+		return false, nil
+	case 0xc3:
+		return true, nil
+	case 0xcc, 0xcd, 0xce, 0xcf:
+		v, err := d.uN(1 << (t - 0xcc))
+		return int64(v), err
+	case 0xd0, 0xd1, 0xd2, 0xd3:
+		n := 1 << (t - 0xd0)
+		v, err := d.uN(n)
+		if err != nil {
+			return nil, err
+		}
+		shift := uint(64 - 8*n)
+		return int64(v<<shift) >> shift, nil
+	case 0xca:
+		v, err := d.uN(4)
+		return float64(math.Float32frombits(uint32(v))), err
+	case 0xcb:
+		v, err := d.uN(8)
+		return math.Float64frombits(v), err
+	case 0xd9, 0xda, 0xdb:
+		n, err := d.uN(1 << (t - 0xd9))
+		if err != nil {
+			return nil, err
+		}
+		b, err := d.take(int(n))
+		return string(b), err
+	case 0xc4, 0xc5, 0xc6:
+		n, err := d.uN(1 << (t - 0xc4))
+		if err != nil {
+			return nil, err
+		}
+		b, err := d.take(int(n))
+		return append([]byte(nil), b...), err
+	case 0xdc, 0xdd:
+		n, err := d.uN(2 << (t - 0xdc) / 1)
+		if err != nil {
+			return nil, err
+		}
+		return d.array(int(n))
+	case 0xde, 0xdf:
+		n, err := d.uN(2 * (1 << (t - 0xde)))
+		if err != nil {
+			return nil, err
+		}
+		return d.mapN(int(n))
+	}
+	return nil, fmt.Errorf("msgpack: unsupported tag 0x%02x", t)
+}
+
+func (d *decoder) array(n int) ([]interface{}, error) {
+	out := make([]interface{}, 0, n)
+	for k := 0; k < n; k++ {
+		v, err := d.decode()
+		if err != nil {
+			return nil, err
+		}
+		out = append(out, v)
+	}
+	return out, nil
+}
+
+func (d *decoder) mapN(n int) (map[string]interface{}, error) {
+	out := make(map[string]interface{}, n)
+	for k := 0; k < n; k++ {
+		kv, err := d.decode()
+		if err != nil {
+			return nil, err
+		}
+		vv, err := d.decode()
+		if err != nil {
+			return nil, err
+		}
+		ks, ok := kv.(string)
+		if !ok {
+			ks = fmt.Sprint(kv)
+		}
+		out[ks] = vv
+	}
+	return out, nil
+}
+
+// ---------------------------------------------------------------- client
+// ExecutionResponse mirrors graph.thrift's ExecutionResponse fields.
+type ExecutionResponse struct {
+	ErrorCode   int64
+	ErrorMsg    string
+	LatencyInUs int64
+	SpaceName   string
+	ColumnNames []string
+	Rows        [][]interface{}
+}
+
+func (r *ExecutionResponse) OK() bool { return r.ErrorCode == 0 }
+
+type GraphClient struct {
+	addr      string
+	conn      net.Conn
+	sessionID int64
+}
+
+func NewGraphClient(addr string) *GraphClient { return &GraphClient{addr: addr} }
+
+func (c *GraphClient) call(method string, payload map[string]interface{}) (map[string]interface{}, error) {
+	if c.conn == nil {
+		conn, err := net.Dial("tcp", c.addr)
+		if err != nil {
+			return nil, err
+		}
+		c.conn = conn
+	}
+	body, err := packInto(nil, []interface{}{method, payload})
+	if err != nil {
+		return nil, err
+	}
+	var hdr [4]byte
+	binary.BigEndian.PutUint32(hdr[:], uint32(len(body)))
+	if _, err = c.conn.Write(append(hdr[:], body...)); err != nil {
+		c.close()
+		return nil, err
+	}
+	if _, err = io.ReadFull(c.conn, hdr[:]); err != nil {
+		c.close()
+		return nil, err
+	}
+	n := binary.BigEndian.Uint32(hdr[:])
+	if n > maxFrame {
+		c.close()
+		return nil, errors.New("oversized response frame")
+	}
+	rbody := make([]byte, n)
+	if _, err = io.ReadFull(c.conn, rbody); err != nil {
+		c.close()
+		return nil, err
+	}
+	v, err := (&decoder{b: rbody}).decode()
+	if err != nil {
+		return nil, err
+	}
+	m, ok := v.(map[string]interface{})
+	if !ok {
+		return nil, errors.New("malformed response")
+	}
+	if code, bad := m["__error__"]; bad {
+		msg, _ := m["msg"].(string)
+		return nil, fmt.Errorf("rpc error %v: %s", code, msg)
+	}
+	return m, nil
+}
+
+func (c *GraphClient) close() {
+	if c.conn != nil {
+		c.conn.Close()
+		c.conn = nil
+	}
+}
+
+// Connect authenticates and opens a session (GraphService::authenticate).
+func (c *GraphClient) Connect(username, password string) error {
+	m, err := c.call("authenticate",
+		map[string]interface{}{"username": username, "password": password})
+	if err != nil {
+		return err
+	}
+	if code, _ := m["error_code"].(int64); code != 0 {
+		msg, _ := m["error_msg"].(string)
+		return fmt.Errorf("auth failed (%d): %s", code, msg)
+	}
+	sid, _ := m["session_id"].(int64)
+	c.sessionID = sid
+	return nil
+}
+
+// Execute runs one or more ;-separated nGQL statements.
+func (c *GraphClient) Execute(stmt string) (*ExecutionResponse, error) {
+	m, err := c.call("execute", map[string]interface{}{
+		"session_id": c.sessionID, "stmt": stmt})
+	if err != nil {
+		return nil, err
+	}
+	resp := &ExecutionResponse{}
+	resp.ErrorCode, _ = m["error_code"].(int64)
+	resp.ErrorMsg, _ = m["error_msg"].(string)
+	resp.LatencyInUs, _ = m["latency_in_us"].(int64)
+	resp.SpaceName, _ = m["space_name"].(string)
+	if cols, ok := m["column_names"].([]interface{}); ok {
+		for _, col := range cols {
+			s, _ := col.(string)
+			resp.ColumnNames = append(resp.ColumnNames, s)
+		}
+	}
+	if rows, ok := m["rows"].([]interface{}); ok {
+		for _, row := range rows {
+			r, _ := row.([]interface{})
+			resp.Rows = append(resp.Rows, r)
+		}
+	}
+	return resp, nil
+}
+
+// Disconnect signs out and closes the connection (oneway signout).
+func (c *GraphClient) Disconnect() {
+	if c.sessionID != 0 {
+		_, _ = c.call("signout", map[string]interface{}{
+			"session_id": c.sessionID})
+		c.sessionID = 0
+	}
+	c.close()
+}

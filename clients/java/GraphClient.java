@@ -1,0 +1,286 @@
+// Java GraphClient for the nebula-tpu graph service.
+//
+// Capability parity with the reference's client/java thin wrapper
+// (/root/reference/src/client/java): blocking connect/execute over the
+// framed wire protocol (interface/rpc.py: 4-byte big-endian length |
+// msgpack [method, payload]).  Self-contained: includes the minimal
+// msgpack subset the protocol uses — no external dependencies.
+//
+//   GraphClient c = new GraphClient("127.0.0.1", 3699);
+//   c.connect("user", "password");
+//   GraphClient.ExecutionResponse r = c.execute("SHOW SPACES");
+//   for (List<Object> row : r.rows) { ... }
+package com.nebulatpu.client;
+
+import java.io.ByteArrayOutputStream;
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.HashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class GraphClient implements AutoCloseable {
+    private static final int MAX_FRAME = 1 << 30;  // server _MAX_FRAME
+
+    private final String host;
+    private final int port;
+    private Socket sock;
+    private DataInputStream in;
+    private DataOutputStream out;
+    private long sessionId;
+
+    public GraphClient(String host, int port) {
+        this.host = host;
+        this.port = port;
+    }
+
+    public static final class ExecutionResponse {
+        public long errorCode;
+        public String errorMsg = "";
+        public long latencyInUs;
+        public String spaceName = "";
+        public List<String> columnNames = new ArrayList<>();
+        public List<List<Object>> rows = new ArrayList<>();
+
+        public boolean ok() { return errorCode == 0; }
+    }
+
+    public static final class RpcException extends IOException {
+        public RpcException(String msg) { super(msg); }
+    }
+
+    // ------------------------------------------------------------ session
+    public void connect(String username, String password) throws IOException {
+        Map<String, Object> payload = new HashMap<>();
+        payload.put("username", username);
+        payload.put("password", password);
+        Map<?, ?> m = call("authenticate", payload);
+        long code = asLong(m.get("error_code"));
+        if (code != 0) {
+            throw new RpcException("auth failed (" + code + "): "
+                    + m.get("error_msg"));
+        }
+        sessionId = asLong(m.get("session_id"));
+    }
+
+    public ExecutionResponse execute(String stmt) throws IOException {
+        Map<String, Object> payload = new HashMap<>();
+        payload.put("session_id", sessionId);
+        payload.put("stmt", stmt);
+        Map<?, ?> m = call("execute", payload);
+        ExecutionResponse r = new ExecutionResponse();
+        r.errorCode = asLong(m.get("error_code"));
+        r.errorMsg = m.get("error_msg") == null ? "" : m.get("error_msg").toString();
+        r.latencyInUs = asLong(m.get("latency_in_us"));
+        r.spaceName = m.get("space_name") == null ? "" : m.get("space_name").toString();
+        Object cols = m.get("column_names");
+        if (cols instanceof List) {
+            for (Object c : (List<?>) cols) r.columnNames.add(String.valueOf(c));
+        }
+        Object rows = m.get("rows");
+        if (rows instanceof List) {
+            for (Object row : (List<?>) rows) {
+                List<Object> outRow = new ArrayList<>();
+                if (row instanceof List) outRow.addAll((List<Object>) row);
+                r.rows.add(outRow);
+            }
+        }
+        return r;
+    }
+
+    @Override
+    public void close() throws IOException {
+        if (sessionId != 0) {
+            Map<String, Object> payload = new HashMap<>();
+            payload.put("session_id", sessionId);
+            try { call("signout", payload); } catch (IOException ignored) { }
+            sessionId = 0;
+        }
+        if (sock != null) { sock.close(); sock = null; }
+    }
+
+    // ------------------------------------------------------------ framing
+    private Map<?, ?> call(String method, Map<String, Object> payload)
+            throws IOException {
+        if (sock == null) {
+            sock = new Socket(host, port);
+            sock.setTcpNoDelay(true);
+            in = new DataInputStream(sock.getInputStream());
+            out = new DataOutputStream(sock.getOutputStream());
+        }
+        ByteArrayOutputStream body = new ByteArrayOutputStream();
+        List<Object> frame = new ArrayList<>();
+        frame.add(method);
+        frame.add(payload);
+        pack(body, frame);
+        byte[] b = body.toByteArray();
+        try {
+            out.writeInt(b.length);
+            out.write(b);
+            out.flush();
+            int n = in.readInt();
+            if (n < 0 || n > MAX_FRAME) {
+                throw new RpcException("oversized response frame");
+            }
+            byte[] rbody = new byte[n];
+            in.readFully(rbody);
+            Object v = new Decoder(rbody).decode();
+            if (!(v instanceof Map)) throw new RpcException("malformed response");
+            Map<?, ?> m = (Map<?, ?>) v;
+            if (m.containsKey("__error__")) {
+                throw new RpcException("rpc error " + m.get("__error__")
+                        + ": " + m.get("msg"));
+            }
+            return m;
+        } catch (IOException e) {
+            sock.close();
+            sock = null;
+            throw e;
+        }
+    }
+
+    private static long asLong(Object o) {
+        return o instanceof Number ? ((Number) o).longValue() : 0L;
+    }
+
+    // ------------------------------------------------------------ msgpack
+    private static void pack(ByteArrayOutputStream o, Object v)
+            throws IOException {
+        if (v == null) { o.write(0xc0); return; }
+        if (v instanceof Boolean) { o.write((Boolean) v ? 0xc3 : 0xc2); return; }
+        if (v instanceof Number && !(v instanceof Double) && !(v instanceof Float)) {
+            long x = ((Number) v).longValue();
+            if (x >= 0 && x < 128) { o.write((int) x); return; }
+            if (x < 0 && x >= -32) { o.write((int) x & 0xff); return; }
+            o.write(0xd3);
+            for (int s = 56; s >= 0; s -= 8) o.write((int) (x >> s) & 0xff);
+            return;
+        }
+        if (v instanceof Double || v instanceof Float) {
+            long bits = Double.doubleToLongBits(((Number) v).doubleValue());
+            o.write(0xcb);
+            for (int s = 56; s >= 0; s -= 8) o.write((int) (bits >> s) & 0xff);
+            return;
+        }
+        if (v instanceof String) {
+            byte[] b = ((String) v).getBytes(StandardCharsets.UTF_8);
+            if (b.length < 32) o.write(0xa0 | b.length);
+            else if (b.length < 256) { o.write(0xd9); o.write(b.length); }
+            else if (b.length < (1 << 16)) {
+                o.write(0xda); o.write(b.length >> 8); o.write(b.length & 0xff);
+            } else {
+                o.write(0xdb);
+                for (int s = 24; s >= 0; s -= 8) o.write((b.length >> s) & 0xff);
+            }
+            o.write(b);
+            return;
+        }
+        if (v instanceof List) {
+            List<?> a = (List<?>) v;
+            packLen(o, a.size(), 0x90, 0xdc, 0xdd);
+            for (Object e : a) pack(o, e);
+            return;
+        }
+        if (v instanceof Map) {
+            Map<?, ?> m = (Map<?, ?>) v;
+            packLen(o, m.size(), 0x80, 0xde, 0xdf);
+            for (Map.Entry<?, ?> e : m.entrySet()) {
+                pack(o, e.getKey());
+                pack(o, e.getValue());
+            }
+            return;
+        }
+        throw new IOException("msgpack: unsupported type " + v.getClass());
+    }
+
+    private static void packLen(ByteArrayOutputStream o, int n,
+                                int fix, int m16, int m32) {
+        if (n < 16) o.write(fix | n);
+        else if (n < (1 << 16)) { o.write(m16); o.write(n >> 8); o.write(n & 0xff); }
+        else {
+            o.write(m32);
+            for (int s = 24; s >= 0; s -= 8) o.write((n >> s) & 0xff);
+        }
+    }
+
+    private static final class Decoder {
+        private final byte[] b;
+        private int i;
+
+        Decoder(byte[] b) { this.b = b; }
+
+        private int u8() throws IOException {
+            if (i >= b.length) throw new RpcException("truncated frame");
+            return b[i++] & 0xff;
+        }
+
+        private long uN(int n) throws IOException {
+            long v = 0;
+            for (int k = 0; k < n; k++) v = (v << 8) | u8();
+            return v;
+        }
+
+        private byte[] take(int n) throws IOException {
+            if (i + n > b.length) throw new RpcException("truncated frame");
+            byte[] out = new byte[n];
+            System.arraycopy(b, i, out, 0, n);
+            i += n;
+            return out;
+        }
+
+        Object decode() throws IOException {
+            int t = u8();
+            if (t < 0x80) return (long) t;
+            if (t >= 0xe0) return (long) (byte) t;
+            if (t >= 0xa0 && t < 0xc0)
+                return new String(take(t & 0x1f), StandardCharsets.UTF_8);
+            if (t >= 0x90 && t < 0xa0) return array(t & 0x0f);
+            if (t >= 0x80 && t < 0x90) return map(t & 0x0f);
+            switch (t) {
+                case 0xc0: return null;
+                case 0xc2: return Boolean.FALSE;
+                case 0xc3: return Boolean.TRUE;
+                case 0xcc: case 0xcd: case 0xce: case 0xcf:
+                    return uN(1 << (t - 0xcc));
+                case 0xd0: case 0xd1: case 0xd2: case 0xd3: {
+                    int n = 1 << (t - 0xd0);
+                    long v = uN(n);
+                    int shift = 64 - 8 * n;
+                    return (v << shift) >> shift;
+                }
+                case 0xca: return (double) Float.intBitsToFloat((int) uN(4));
+                case 0xcb: return Double.longBitsToDouble(uN(8));
+                case 0xd9: case 0xda: case 0xdb:
+                    return new String(take((int) uN(1 << (t - 0xd9))),
+                            StandardCharsets.UTF_8);
+                case 0xc4: case 0xc5: case 0xc6:
+                    return take((int) uN(1 << (t - 0xc4)));
+                case 0xdc: return array((int) uN(2));
+                case 0xdd: return array((int) uN(4));
+                case 0xde: return map((int) uN(2));
+                case 0xdf: return map((int) uN(4));
+                default:
+                    throw new RpcException("unsupported msgpack tag " + t);
+            }
+        }
+
+        private List<Object> array(int n) throws IOException {
+            List<Object> out = new ArrayList<>(n);
+            for (int k = 0; k < n; k++) out.add(decode());
+            return out;
+        }
+
+        private Map<Object, Object> map(int n) throws IOException {
+            Map<Object, Object> out = new HashMap<>(n * 2);
+            for (int k = 0; k < n; k++) {
+                Object key = decode();
+                out.put(key, decode());
+            }
+            return out;
+        }
+    }
+}
