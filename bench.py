@@ -61,7 +61,7 @@ def main():
 
     platform = jax.devices()[0].platform
     if platform == "tpu":
-        n, m, B = 1 << 20, 1 << 24, 1024
+        n, m, B = 1 << 20, 1 << 24, 2048
     else:  # CI/dev fallback — keep the run minutes-scale on CPU
         n, m, B = 1 << 14, 1 << 17, 128
     steps = 4
@@ -87,8 +87,10 @@ def main():
     out = go(f0)                                   # compile + warmup
     _ = int(jnp.sum(out, dtype=jnp.int32))         # force completion
 
-    # result parity with the CPU path on the sampled queries
-    got = ix.to_old(np.asarray(out))[:, :sample] > 0
+    # result parity with the CPU path on the sampled queries (slice on
+    # device first — pulling the whole [rows, B] matrix through the
+    # tunnel would dominate wall time without informing the check)
+    got = ix.to_old(np.asarray(out[:, :sample])) > 0
     for q in range(sample):
         np.testing.assert_array_equal(got[:, q], cpu_frontiers[q])
 

@@ -1,0 +1,211 @@
+// ell_build — native ELL slot-table construction for the TPU batched
+// traversal engine (the C++ counterpart of nebula_tpu/tpu/ell.py
+// EllIndex.build; the numpy path stays as the fallback and as the
+// differential-test oracle).
+//
+// Same layout contract as the Python builder:
+//   * rows grouped by DST (slots = in-edges over both stored
+//     directions), vertices relabeled so each degree bucket is
+//     contiguous (new id = rank in (bucket_D, old_id) order)
+//   * bucket width D = clamp(next_pow2(min(deg, cap)), min_d, cap)
+//   * hub vertices (deg > cap) get extra rows appended after all real
+//     vertices; extra_owner maps each extra row to its owner's new id
+//   * slot padding: nbr = n_rows (the pinned-zero frontier row),
+//     etype = 0 (never a real etype)
+//
+// ABI (ctypes, two-phase):
+//   ell_build(src, dst, et, m, n, cap, min_d) -> handle (>=0) or -1
+//   ell_counts(handle, out int64[4])   -> {n_rows, n_extras, n_buckets,
+//                                          total_cells}
+//   ell_bucket_dims(handle, out int64[2*n_buckets])  (rows_b, D_b)...
+//   ell_fill(handle, perm, inv, extra_owner, nbr_flat, et_flat)
+//       fills caller-allocated buffers; bucket tables are concatenated
+//       row-major in ascending-D order inside nbr_flat/et_flat.
+//   ell_free(handle)
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <numeric>
+#include <vector>
+
+namespace {
+
+struct EllResult {
+  int64_t n = 0;
+  int64_t n_rows = 0;
+  std::vector<int32_t> perm, inv, extra_owner;
+  std::vector<int64_t> bucket_rows, bucket_D;
+  std::vector<int32_t> nbr_flat, et_flat;   // concatenated bucket tables
+};
+
+std::mutex g_mu;
+std::map<int64_t, EllResult*> g_results;
+int64_t g_next = 1;
+
+int64_t next_pow2(int64_t x) {
+  if (x <= 1) return 1;
+  int64_t p = 1;
+  while (p < x) p <<= 1;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t ell_build(const int32_t* src, const int32_t* dst,
+                  const int32_t* et, int64_t m, int64_t n,
+                  int64_t cap, int64_t min_d) {
+  if (n < 0 || m < 0 || cap <= 0 || min_d <= 0) return -1;
+  if (cap < min_d) cap = min_d;
+  // out-of-range vertex ids would corrupt the heap here where the
+  // numpy fallback raises cleanly — reject so the wrapper falls back
+  for (int64_t i = 0; i < m; i++) {
+    if (src[i] < 0 || src[i] >= n || dst[i] < 0 || dst[i] >= n) return -1;
+  }
+  auto* r = new EllResult();
+  r->n = n;
+  if (n == 0) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_results[g_next] = r;
+    return g_next++;
+  }
+
+  // order edges by dst (stable; counting sort via per-vertex offsets)
+  std::vector<int64_t> deg(n, 0);
+  for (int64_t i = 0; i < m; i++) deg[dst[i]]++;
+  std::vector<int64_t> row_start(n + 1, 0);
+  for (int64_t v = 0; v < n; v++) row_start[v + 1] = row_start[v] + deg[v];
+
+  // bucket width per vertex + relabeling (stable sort by D, old id)
+  std::vector<int64_t> D_v(n);
+  for (int64_t v = 0; v < n; v++) {
+    int64_t per_row = std::min(deg[v], cap);
+    D_v[v] = std::min(std::max(next_pow2(per_row), min_d), cap);
+  }
+  std::vector<int32_t> vorder(n);
+  std::iota(vorder.begin(), vorder.end(), 0);
+  std::stable_sort(vorder.begin(), vorder.end(),
+                   [&](int32_t a, int32_t b) { return D_v[a] < D_v[b]; });
+  r->inv.assign(vorder.begin(), vorder.end());
+  r->perm.resize(n);
+  for (int64_t i = 0; i < n; i++) r->perm[vorder[i]] = int32_t(i);
+
+  // hub extra rows
+  std::vector<int64_t> first_extra(n, 0);
+  int64_t n_extras = 0;
+  for (int64_t v = 0; v < n; v++) {
+    first_extra[v] = n + n_extras;
+    if (deg[v] > cap) n_extras += (deg[v] + cap - 1) / cap - 1;
+  }
+  r->n_rows = n + n_extras;
+  r->extra_owner.reserve(n_extras);
+  for (int64_t v = 0; v < n; v++) {
+    int64_t k = (deg[v] > cap) ? (deg[v] + cap - 1) / cap - 1 : 0;
+    for (int64_t j = 0; j < k; j++) r->extra_owner.push_back(r->perm[v]);
+  }
+
+  // bucket layout (ascending D; extras live in the cap bucket)
+  std::vector<int64_t> Ds;
+  for (int64_t v = 0; v < n; v++) Ds.push_back(D_v[v]);
+  std::sort(Ds.begin(), Ds.end());
+  Ds.erase(std::unique(Ds.begin(), Ds.end()), Ds.end());
+  std::map<int64_t, int64_t> rows_of;   // D -> row count
+  for (int64_t v = 0; v < n; v++) rows_of[D_v[v]]++;
+  if (n_extras) rows_of[cap] += n_extras;
+
+  int64_t total_cells = 0;
+  std::map<int64_t, int64_t> cell_base;  // D -> offset into flat arrays
+  std::map<int64_t, int64_t> row_base;   // D -> first global row index
+  int64_t row_cursor = 0;
+  for (int64_t D : Ds) {
+    cell_base[D] = total_cells;
+    row_base[D] = row_cursor;
+    total_cells += rows_of[D] * D;
+    row_cursor += rows_of[D];
+    r->bucket_rows.push_back(rows_of[D]);
+    r->bucket_D.push_back(D);
+  }
+  int32_t sentinel = int32_t(r->n_rows);
+  r->nbr_flat.assign(total_cells, sentinel);
+  r->et_flat.assign(total_cells, 0);
+
+  // fill slots: bucket-local row = global row - row_base[D]
+  std::vector<int64_t> fill(n, 0);
+  for (int64_t i = 0; i < m; i++) {
+    int64_t v = dst[i];
+    int64_t off = fill[v]++;
+    int64_t k_of = off / cap;
+    int64_t col = (k_of == 0) ? off : off % cap;
+    int64_t D = D_v[v];
+    int64_t grow = (k_of == 0) ? int64_t(r->perm[v])
+                               : first_extra[v] + k_of - 1;
+    // extra rows sit in the cap bucket after its real vertices
+    int64_t base = (k_of == 0) ? row_base[D] : row_base[cap];
+    int64_t local = grow - ((k_of == 0) ? base : row_base[cap]);
+    int64_t cell = cell_base[(k_of == 0) ? D : cap]
+        + local * ((k_of == 0) ? D : cap) + col;
+    r->nbr_flat[size_t(cell)] = r->perm[src[i]];
+    r->et_flat[size_t(cell)] = et[i];
+  }
+
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_results[g_next] = r;
+  return g_next++;
+}
+
+int64_t ell_counts(int64_t handle, int64_t* out4) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_results.find(handle);
+  if (it == g_results.end()) return -1;
+  auto* r = it->second;
+  out4[0] = r->n_rows;
+  out4[1] = int64_t(r->extra_owner.size());
+  out4[2] = int64_t(r->bucket_D.size());
+  out4[3] = int64_t(r->nbr_flat.size());
+  return 0;
+}
+
+int64_t ell_bucket_dims(int64_t handle, int64_t* out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_results.find(handle);
+  if (it == g_results.end()) return -1;
+  auto* r = it->second;
+  for (size_t b = 0; b < r->bucket_D.size(); b++) {
+    out[2 * b] = r->bucket_rows[b];
+    out[2 * b + 1] = r->bucket_D[b];
+  }
+  return 0;
+}
+
+int64_t ell_fill(int64_t handle, int32_t* perm, int32_t* inv,
+                 int32_t* extra_owner, int32_t* nbr_flat,
+                 int32_t* et_flat) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_results.find(handle);
+  if (it == g_results.end()) return -1;
+  auto* r = it->second;
+  std::memcpy(perm, r->perm.data(), r->perm.size() * 4);
+  std::memcpy(inv, r->inv.data(), r->inv.size() * 4);
+  if (!r->extra_owner.empty())
+    std::memcpy(extra_owner, r->extra_owner.data(),
+                r->extra_owner.size() * 4);
+  if (!r->nbr_flat.empty()) {
+    std::memcpy(nbr_flat, r->nbr_flat.data(), r->nbr_flat.size() * 4);
+    std::memcpy(et_flat, r->et_flat.data(), r->et_flat.size() * 4);
+  }
+  return 0;
+}
+
+void ell_free(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_results.find(handle);
+  if (it != g_results.end()) {
+    delete it->second;
+    g_results.erase(it);
+  }
+}
+
+}  // extern "C"

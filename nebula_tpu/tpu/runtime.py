@@ -719,12 +719,22 @@ class TpuQueryRuntime:
         """Run B concurrent multi-hop GOs; returns bool [B, n] final
         frontiers (the final-hop *destinations*, i.e. ``steps``
         advances — the kernel's steps counts like kernels._go_body, so
-        pass steps + 1) in the mirror's dense-id space."""
+        pass steps + 1) in the mirror's dense-id space.  Oversized
+        batches run in go_batch_max chunks so the frontier matrix stays
+        memory-bounded."""
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += len(starts_per_query)
-        out, _ = self._go_batch_frontiers(space_id, starts_per_query,
-                                          et_tuple, steps + 1)
-        return out
+        if not starts_per_query:
+            m = self.mirror(space_id)
+            return np.zeros((0, m.n), dtype=bool)
+        max_b = int(flags.get("go_batch_max") or 1024)
+        outs = []
+        for lo in range(0, len(starts_per_query), max_b):
+            out, _ = self._go_batch_frontiers(
+                space_id, starts_per_query[lo:lo + max_b], et_tuple,
+                steps + 1)
+            outs.append(out)
+        return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def go_batch_frontier(self, space_id: int, starts_per_query,
                           et_tuple: Tuple[int, ...], steps: int):
