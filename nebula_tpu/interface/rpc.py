@@ -256,15 +256,31 @@ class ClientManager:
     def __init__(self):
         self._channels: Dict[HostAddr, Any] = {}
         self._loopbacks: Dict[HostAddr, Any] = {}
+        self._dead: set = set()          # crash-simulated addrs
         self._lock = threading.Lock()
 
     def register_loopback(self, addr: HostAddr, handler: Any) -> None:
         with self._lock:
             self._loopbacks[addr] = handler
             self._channels.pop(addr, None)
+            self._dead.discard(addr)
+
+    def unregister_loopback(self, addr: HostAddr) -> None:
+        """Drop a loopback route and mark the address dead — subsequent
+        calls fail immediately like a crashed host (deterministic: the
+        addr must NOT fall through to a real TCP dial of the fabricated
+        loopback port, where an unrelated listener or a slow connect
+        timeout would skew failover tests)."""
+        with self._lock:
+            self._loopbacks.pop(addr, None)
+            self._channels.pop(addr, None)
+            self._dead.add(addr)
 
     def channel(self, addr: HostAddr):
         with self._lock:
+            if addr in self._dead:
+                raise RpcError(Status(ErrorCode.E_FAIL_TO_CONNECT,
+                                      f"{addr} is down"))
             ch = self._channels.get(addr)
             if ch is None:
                 if addr in self._loopbacks:

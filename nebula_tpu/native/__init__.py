@@ -19,7 +19,10 @@ _TRIED = False
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_SO_PATH = os.path.join(_REPO_ROOT, "native", "libnebula_native.so")
+# NEBULA_NATIVE_SO overrides the artifact (e.g. the ASAN build —
+# native/Makefile `make asan`)
+_SO_PATH = os.environ.get("NEBULA_NATIVE_SO") or os.path.join(
+    _REPO_ROOT, "native", "libnebula_native.so")
 
 
 def _sig(fn, restype, argtypes):

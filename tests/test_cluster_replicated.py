@@ -18,8 +18,10 @@ from nebula_tpu.common.flags import flags
 def fast_raft():
     saved = {n: flags.get(n) for n in
              ("raft_heartbeat_interval_s", "raft_election_timeout_s")}
-    flags.set("raft_heartbeat_interval_s", 0.05)
-    flags.set("raft_election_timeout_s", 0.3)
+    # fast enough for quick tests, loose enough that full-suite CPU
+    # contention doesn't make elections flap (0.3s proved too tight)
+    flags.set("raft_heartbeat_interval_s", 0.1)
+    flags.set("raft_election_timeout_s", 0.8)
     yield
     for k, v in saved.items():
         flags.set(k, v)
@@ -36,10 +38,16 @@ def cluster():
 def client(cluster):
     client = cluster.client()
 
-    def ok(stmt):
-        resp = client.execute(stmt)
-        assert resp.ok(), f"{stmt}: {resp.error_msg}"
-        return resp
+    def ok(stmt, tries=40):
+        # raft leadership may still be settling right after elections;
+        # storage-client retries are bounded, so retry here too
+        last = None
+        for _ in range(tries):
+            last = client.execute(stmt)
+            if last.ok():
+                return last
+            time.sleep(0.25)
+        raise AssertionError(f"{stmt}: {last.error_msg}")
 
     client.ok = ok
     ok("CREATE SPACE rep(partition_num=4, replica_factor=3)")
@@ -152,3 +160,49 @@ def test_leader_transfer_keeps_queries_working(cluster, client):
     client.ok('INSERT VERTEX person(name) VALUES 3:("carol")')
     resp = client.ok("FETCH PROP ON person 3 YIELD person.name")
     assert resp.rows and resp.rows[0][-1] == "carol"
+
+
+def test_node_crash_failover():
+    """Kill one of three storage nodes mid-traffic: reads and writes
+    must keep working through the remaining 2/3 quorum (the reference's
+    failure-detection + leader-chase loop, SURVEY.md §5.3 — clients
+    retry on E_LEADER_CHANGED / RPC failure and raft re-elects)."""
+    c = LocalCluster(num_storage=3, use_raft=True)
+    try:
+        g = c.client()
+
+        def ok(stmt, tries=40):
+            last = None
+            for _ in range(tries):       # leaders may be re-electing
+                r = g.execute(stmt)
+                if r.ok():
+                    return r
+                last = r
+                time.sleep(0.25)
+            raise AssertionError(f"{stmt}: {last.error_msg}")
+
+        ok("CREATE SPACE fo(partition_num=4, replica_factor=3)")
+        c.refresh_all()
+        _wait_leaders(c, space_parts=4)
+        ok("USE fo")
+        ok("CREATE EDGE e(w int)")
+        c.refresh_all()
+        ok("INSERT EDGE e(w) VALUES 1->2:(7), 2->3:(8)")
+        assert sorted(x[0] for x in
+                      ok("GO FROM 1 OVER e YIELD e._dst").rows) == [2]
+
+        # crash node 2: hard stop AND unroute it — a dead process is
+        # unreachable, not politely error-returning
+        from nebula_tpu.interface.common import HostAddr
+        dead = c.storage_nodes[2]
+        c.cm.unregister_loopback(HostAddr.parse(dead.host))
+        dead.stop()
+
+        # reads and writes still work through the surviving quorum
+        r = ok("GO FROM 2 OVER e YIELD e._dst")
+        assert sorted(x[0] for x in r.rows) == [3]
+        ok("INSERT EDGE e(w) VALUES 3->4:(9)")
+        r = ok("GO FROM 3 OVER e YIELD e._dst")
+        assert sorted(x[0] for x in r.rows) == [4]
+    finally:
+        c.stop()
