@@ -343,8 +343,65 @@ class MetaService:
                                               mk.tag_id_from_key,
                                               mk.tag_version_from_key)}
 
+    # -- reference-IDL name aliases (meta.thrift:504-536 uses createTag/
+    # listTags/getTag/... where our canonical names carry a Schema
+    # suffix; both spellings answer so either client generation works)
+    def rpc_createTag(self, req: dict) -> dict:
+        return self.rpc_createTagSchema(req)
+
+    def rpc_alterTag(self, req: dict) -> dict:
+        return self.rpc_alterTagSchema(req)
+
+    def rpc_dropTag(self, req: dict) -> dict:
+        return self.rpc_dropTagSchema(req)
+
+    def rpc_listTags(self, req: dict) -> dict:
+        return self.rpc_listTagSchemas(req)
+
+    def rpc_getTag(self, req: dict) -> dict:
+        """Single-schema fetch (meta.thrift getTag): newest or exact
+        version from the same records listTagSchemas serves."""
+        return self._get_schema(req, self.rpc_listTagSchemas)
+
+    def rpc_getEdge(self, req: dict) -> dict:
+        return self._get_schema(req, self.rpc_listEdgeSchemas)
+
+    def _get_schema(self, req: dict, lister) -> dict:
+        name = req["name"]
+        want_ver = req.get("version", -1)
+        best = None
+        for rec in lister(req)["schemas"]:
+            if rec["name"] != name:
+                continue
+            if want_ver >= 0:
+                if rec.get("version", 0) == want_ver:
+                    return {"schema": rec["schema"], "version": want_ver,
+                            "id": rec["id"]}
+                continue       # exact version asked: newest is NOT a match
+            if best is None or rec.get("version", 0) > best.get("version", 0):
+                best = rec
+        if best is None:
+            # reference GetTagProcessor errors on a missing exact version
+            # rather than substituting the newest
+            raise _err(ErrorCode.E_NOT_FOUND,
+                       name if want_ver < 0 else f"{name} v{want_ver}")
+        return {"schema": best["schema"], "version": best.get("version", 0),
+                "id": best["id"]}
+
     def rpc_createEdgeSchema(self, req: dict) -> dict:
         return self._create_schema(req, mk.edge_index_key, mk.edge_key)
+
+    def rpc_createEdge(self, req: dict) -> dict:
+        return self.rpc_createEdgeSchema(req)
+
+    def rpc_alterEdge(self, req: dict) -> dict:
+        return self.rpc_alterEdgeSchema(req)
+
+    def rpc_dropEdge(self, req: dict) -> dict:
+        return self.rpc_dropEdgeSchema(req)
+
+    def rpc_listEdges(self, req: dict) -> dict:
+        return self.rpc_listEdgeSchemas(req)
 
     def rpc_alterEdgeSchema(self, req: dict) -> dict:
         return self._alter_schema(req, mk.edge_index_key, mk.edge_key, mk.edge_prefix)
@@ -421,6 +478,33 @@ class MetaService:
             raise _err(ErrorCode.E_NOT_FOUND, req["account"])
         self.kv.remove(META_SPACE, META_PART, key)
         return {}
+
+    def rpc_getUser(self, req: dict) -> dict:
+        """meta.thrift getUser: one account's record (direct key
+        lookup, like the other user RPCs)."""
+        raw, _ = self.kv.get(META_SPACE, META_PART,
+                             mk.user_key(req["account"]))
+        if raw is None:
+            raise _err(ErrorCode.E_NOT_FOUND, req["account"])
+        rec = _unpk(raw)
+        return {"user": {"account": req["account"],
+                         "roles": rec.get("roles", {})}}
+
+    def rpc_listRoles(self, req: dict) -> dict:
+        """meta.thrift listRoles: role grants in one space."""
+        sid = str(int(req["space_id"]))
+        roles = []
+        for u in self.rpc_listUsers({})["users"]:
+            role = u.get("roles", {}).get(sid)
+            if role is not None:
+                roles.append({"account": u["account"], "role": int(role)})
+        return {"roles": roles}
+
+    def rpc_alterUser(self, req: dict) -> dict:
+        """meta.thrift alterUser: password change without the old-password
+        check (ALTER USER ... WITH PASSWORD)."""
+        return self.rpc_changePassword({"account": req["account"],
+                                        "new_password": req["new_password"]})
 
     def rpc_changePassword(self, req: dict) -> dict:
         key = mk.user_key(req["account"])

@@ -1,0 +1,202 @@
+"""ldbc-gen — LDBC-SNB-flavoured social-graph generator + bulk loader.
+
+The measurement configs (BASELINE.md) are phrased over LDBC SNB's
+person-knows-person core.  This tool generates a structurally similar
+graph — community-clustered, heavy-tailed degrees, person props — at a
+chosen scale, writes importer-compatible CSVs, and/or bulk-loads an
+in-process cluster through the storage client for immediate
+benchmarking (the counterpart of the reference's Java importer +
+spark-sstfile-generator pair for getting test corpora in,
+SURVEY.md §2.11).
+
+  python -m nebula_tpu.tools.ldbc_gen --persons 10000 --out /tmp/ldbc
+  python -m nebula_tpu.tools.ldbc_gen --persons 10000 --bench
+
+Graph model (a pragmatic stand-in for the SNB datagen, not a clone):
+persons partitioned into sqrt(n)-sized communities; each person draws
+a Zipf out-degree; ~80% of knows-edges stay intra-community (the
+locality that makes LDBC traversals clusterable), the rest land
+uniformly.  Props: firstName, lastName, birthday (epoch days),
+locationIP — enough to drive prop filters and YIELDs.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+FIRST = ["Jan", "Yang", "Ada", "Bob", "Chen", "Dana", "Eve", "Finn",
+         "Gita", "Hugo", "Iris", "Jose", "Kim", "Lars", "Mona", "Nils"]
+LAST = ["Smith", "Garcia", "Mueller", "Tanaka", "Okafor", "Ivanov",
+        "Silva", "Kumar", "Dubois", "Novak", "Haddad", "Olsen"]
+
+
+def generate(persons: int, seed: int = 7,
+             intra_p: float = 0.8, zipf_a: float = 2.0,
+             mean_deg: int = 16) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Returns (src vids, dst vids, props dict keyed by vid arrays)."""
+    rng = np.random.default_rng(seed)
+    n = persons
+    comm = max(1, int(np.sqrt(n)))
+    community = rng.integers(0, comm, n)
+
+    # heavy-tailed out-degrees, rescaled to the requested mean
+    deg = rng.zipf(zipf_a, n).astype(np.int64)
+    deg = np.minimum(deg, n - 1)
+    deg = np.maximum(1, (deg * (mean_deg / max(deg.mean(), 1e-9)))
+                     .astype(np.int64))
+    m = int(deg.sum())
+
+    src = np.repeat(np.arange(n), deg)
+    # intra-community targets: pick within the src's community
+    intra = rng.random(m) < intra_p
+    # per-community member lists for local draws
+    order = np.argsort(community, kind="stable")
+    comm_sorted = community[order]
+    starts = np.searchsorted(comm_sorted, np.arange(comm))
+    ends = np.searchsorted(comm_sorted, np.arange(comm), side="right")
+    csize = np.maximum(ends - starts, 1)
+    c_of_src = community[src]
+    local_pick = starts[c_of_src] + (
+        rng.random(m) * csize[c_of_src]).astype(np.int64)
+    dst = np.where(intra, order[np.minimum(local_pick, len(order) - 1)],
+                   rng.integers(0, n, m))
+    # drop self-loops
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+
+    props = {
+        "firstName": [FIRST[i % len(FIRST)] for i in range(n)],
+        "lastName": [LAST[(i // len(FIRST)) % len(LAST)] for i in range(n)],
+        "birthday": rng.integers(3650, 18250, n),   # epoch days
+        "locationIP": [f"10.{i >> 16 & 255}.{i >> 8 & 255}.{i & 255}"
+                       for i in range(n)],
+    }
+    return src + 1, dst + 1, props          # vids are 1-based
+
+
+def write_csv(out_dir: str, src, dst, props) -> Tuple[str, str]:
+    os.makedirs(out_dir, exist_ok=True)
+    n = len(props["firstName"])
+    ppath = os.path.join(out_dir, "person.csv")
+    kpath = os.path.join(out_dir, "person_knows_person.csv")
+    with open(ppath, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["id", "firstName", "lastName", "birthday", "locationIP"])
+        for i in range(n):
+            w.writerow([i + 1, props["firstName"][i], props["lastName"][i],
+                        int(props["birthday"][i]), props["locationIP"][i]])
+    with open(kpath, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["src", "dst"])
+        for s, d in zip(src.tolist(), dst.tolist()):
+            w.writerow([s, d])
+    return ppath, kpath
+
+
+SCHEMA_STMTS = [
+    "CREATE TAG person(firstName string, lastName string, birthday int, "
+    "locationIP string)",
+    "CREATE EDGE knows(since int)",
+]
+
+
+def load_cluster(cluster, space: str, src, dst, props,
+                 batch: int = 4096) -> int:
+    """Bulk-load through the storage client (fast path — the statement
+    pipeline would dominate)."""
+    from ..codec.rows import encode_row
+    g = cluster.client()
+    assert g.execute(
+        f"CREATE SPACE {space}(partition_num=6, replica_factor=1)").ok()
+    cluster.refresh_all()
+    assert g.execute(f"USE {space}").ok()
+    for stmt in SCHEMA_STMTS:
+        assert g.execute(stmt).ok(), stmt
+    cluster.refresh_all()
+
+    mc = cluster.graph_meta_client
+    sid = mc.get_space_id_by_name(space).value()
+    sm = cluster.schema_man
+    tag_id = sm.to_tag_id(sid, "person").value()
+    etype = sm.to_edge_type(sid, "knows").value()
+    tag_schema = sm.get_tag_schema(sid, tag_id)
+    edge_schema = sm.get_edge_schema(sid, etype)
+    sc = cluster.storage_client
+
+    n = len(props["firstName"])
+    buf = []
+    for i in range(n):
+        row = encode_row(tag_schema, {
+            "firstName": props["firstName"][i],
+            "lastName": props["lastName"][i],
+            "birthday": int(props["birthday"][i]),
+            "locationIP": props["locationIP"][i]})
+        buf.append({"id": i + 1, "tags": [[tag_id, row]]})
+        if len(buf) >= batch:
+            assert sc.add_vertices(sid, buf).succeeded()
+            buf = []
+    if buf:
+        assert sc.add_vertices(sid, buf).succeeded()
+
+    eb = []
+    for k, (s, d) in enumerate(zip(src.tolist(), dst.tolist())):
+        erow = encode_row(edge_schema, {"since": 2000 + (k % 20)})
+        eb.append({"src": s, "etype": etype, "rank": 0, "dst": d,
+                   "props": erow})
+        eb.append({"src": d, "etype": -etype, "rank": 0, "dst": s,
+                   "props": erow})
+        if len(eb) >= batch:
+            assert sc.add_edges(sid, eb).succeeded()
+            eb = []
+    if eb:
+        assert sc.add_edges(sid, eb).succeeded()
+    return sid
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ldbc-gen")
+    p.add_argument("--persons", type=int, default=10000)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--out", default=None, help="write CSVs here")
+    p.add_argument("--bench", action="store_true",
+                   help="load an in-process TPU-backed cluster and time "
+                        "batched multi-hop GO over the generated graph")
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--queries", type=int, default=256)
+    args = p.parse_args(argv)
+
+    src, dst, props = generate(args.persons, args.seed)
+    print(f"generated {args.persons} persons, {len(src)} knows edges")
+    if args.out:
+        ppath, kpath = write_csv(args.out, src, dst, props)
+        print(f"wrote {ppath} and {kpath}")
+    if args.bench:
+        from ..cluster import LocalCluster
+        rng = np.random.default_rng(11)
+        c = LocalCluster(num_storage=1, tpu_backend=True)
+        try:
+            sid = load_cluster(c, "ldbc", src, dst, props)
+            rt = c.tpu_runtime
+            et = c.schema_man.to_edge_type(sid, "knows").value()
+            starts = [[int(v)] for v in
+                      rng.integers(1, args.persons + 1, args.queries)]
+            t0 = time.perf_counter()
+            out = rt.go_batch(sid, starts, [et], args.steps)
+            wall = time.perf_counter() - t0
+            reached = int(out.sum())
+            print({"queries": args.queries, "steps": args.steps,
+                   "wall_s": round(wall, 3),
+                   "per_query_ms": round(wall / args.queries * 1e3, 3),
+                   "total_reached": reached})
+        finally:
+            c.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -315,3 +315,37 @@ def test_delete_with_where_refuses():
     r = g.execute("DELETE VERTEX 1 WHERE w > 3")
     assert not r.ok() and "not supported" in r.error_msg
     c.stop()
+
+
+def test_ldbc_gen_load_and_query(tmp_path):
+    """ldbc-gen: generate a community-clustered graph, write CSVs, load
+    a cluster, and check TPU/CPU GO parity over the loaded data."""
+    from nebula_tpu.cluster import LocalCluster
+    from nebula_tpu.common.flags import flags
+    from nebula_tpu.tools import ldbc_gen
+
+    src, dst, props = ldbc_gen.generate(300, seed=3)
+    assert len(src) and (src != dst).all()
+    ppath, kpath = ldbc_gen.write_csv(str(tmp_path), src, dst, props)
+    assert sum(1 for _ in open(ppath)) == 301        # header + rows
+    assert sum(1 for _ in open(kpath)) == len(src) + 1
+
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        ldbc_gen.load_cluster(c, "ldbc", src, dst, props, batch=512)
+        g = c.client()
+        assert g.execute("USE ldbc").ok()
+        q = ("GO 2 STEPS FROM 1 OVER knows WHERE $$.person.birthday > 4000 "
+             "YIELD knows._dst, $$.person.firstName")
+        r_tpu = g.execute(q)
+        assert r_tpu.ok(), r_tpu.error_msg
+        prev = flags.get("storage_backend")
+        flags.set("storage_backend", "cpu")
+        try:
+            r_cpu = g.execute(q)
+        finally:
+            flags.set("storage_backend", prev)
+        assert sorted(map(tuple, r_tpu.rows)) == sorted(map(tuple, r_cpu.rows))
+        assert c.tpu_runtime.stats["go_device"] >= 1
+    finally:
+        c.stop()

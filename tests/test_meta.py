@@ -263,3 +263,38 @@ class TestAdHocSchemaManager:
         assert sm.all_edge_types(1) == [100]
         assert sm.all_tag_ids(1) == [10]
         assert sm.tag_name(1, 10) == "t"
+
+
+def test_reference_idl_name_aliases():
+    """meta.thrift:499-546 method names (createTag/listTags/getTag/
+    getUser/listRoles/alterUser...) must answer alongside our canonical
+    Schema-suffixed spellings."""
+    from nebula_tpu.meta.service import MetaService
+    from nebula_tpu.interface.common import schema_to_wire, Schema, ColumnDef, SupportedType
+    ms = MetaService()
+    ms.rpc_heartBeat({"host": "127.0.0.1:1"})
+    sid = ms.rpc_createSpace({"space_name": "al", "partition_num": 1,
+                              "replica_factor": 1})["id"]
+    wire = schema_to_wire(Schema(columns=[ColumnDef("x", SupportedType.INT)]))
+    ms.rpc_createTag({"space_id": sid, "name": "t", "schema": wire})
+    ms.rpc_createEdge({"space_id": sid, "name": "e", "schema": wire})
+    assert any(r["name"] == "t" for r in ms.rpc_listTags({"space_id": sid})["schemas"])
+    assert any(r["name"] == "e" for r in ms.rpc_listEdges({"space_id": sid})["schemas"])
+    got = ms.rpc_getTag({"space_id": sid, "name": "t"})
+    assert got["schema"]["columns"][0][0] == "x"
+    got = ms.rpc_getEdge({"space_id": sid, "name": "e", "version": 0})
+    assert got["version"] == 0
+    # a missing exact version must error, not substitute the newest
+    # (reference GetTagProcessor semantics)
+    import pytest as _pytest
+    from nebula_tpu.interface.rpc import RpcError
+    with _pytest.raises(RpcError):
+        ms.rpc_getTag({"space_id": sid, "name": "t", "version": 99})
+
+    ms.rpc_createUser({"account": "bob", "password": "p1"})
+    ms.rpc_grantRole({"account": "bob", "space_id": sid, "role": 3})
+    assert ms.rpc_getUser({"account": "bob"})["user"]["account"] == "bob"
+    roles = ms.rpc_listRoles({"space_id": sid})["roles"]
+    assert roles == [{"account": "bob", "role": 3}]
+    ms.rpc_alterUser({"account": "bob", "new_password": "p2"})
+    assert ms.rpc_checkPassword({"account": "bob", "password": "p2"})["ok"]
