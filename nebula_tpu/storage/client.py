@@ -133,6 +133,25 @@ class StorageClient:
                     resp.responses.append(result)
                     resp.max_latency_us = max(resp.max_latency_us,
                                               result.get("latency_us", 0))
+                    # per-part failures (reference ResultCode list): the
+                    # host served the parts it leads and hinted the rest
+                    # — retry ONLY those, each with its own hint, so the
+                    # good parts' cache entries stay intact
+                    for part_s, info in (result.get("failed_parts")
+                                         or {}).items():
+                        part = int(part_s)
+                        if part not in parts:
+                            continue
+                        code = ErrorCode(int(info.get("code", 0)))
+                        if code == ErrorCode.E_LEADER_CHANGED \
+                                and info.get("leader"):
+                            self.update_leader(space_id, part,
+                                               info["leader"])
+                        else:
+                            self.invalidate_leader(space_id, part)
+                        next_pending[part] = parts[part]
+                        last_status[part] = Status(code,
+                                                   info.get("leader", ""))
                 elif status.code == ErrorCode.E_LEADER_CHANGED:
                     for part in parts:
                         if status.msg:  # leader hint

@@ -415,8 +415,11 @@ class QueryStatsProcessor(QueryBaseProcessor):
     def process(self, req: dict) -> dict:
         dur = Duration()
         space_id = int(req["space_id"])
-        edge_types = [int(e) for e in req.get("edge_types", [])] or \
-            self.schema_man.all_edge_types(space_id)
+        edge_types = [int(e) for e in req.get("edge_types", [])]
+        if not edge_types:
+            edge_types = self.schema_man.all_edge_types(space_id)
+            if req.get("reverse"):
+                edge_types = [-e for e in edge_types]
         stat_props = {alias: (int(et), prop)
                       for alias, (et, prop) in req.get("stat_props", {}).items()}
         sums: Dict[str, float] = {a: 0.0 for a in stat_props}

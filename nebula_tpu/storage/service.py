@@ -44,6 +44,7 @@ class StorageService:
 
     # ---- ownership / leadership gate --------------------------------
     def _check_parts(self, space_id: int, part_ids) -> None:
+        """Whole-request leadership check (single-part RPCs)."""
         for part_id in part_ids:
             part = self.kv.part(space_id, int(part_id))
             if part is None:
@@ -55,56 +56,124 @@ class StorageService:
                     ErrorCode.E_LEADER_CHANGED,
                     str(leader) if leader else ""))
 
+    def _split_req(self, req: dict):
+        """Per-part leadership routing for bulk RPCs (the reference
+        returns a per-part ResultCode with a leader hint rather than
+        failing the whole request — storage.thrift:57-62): parts this
+        host leads stay in the request; the rest come back as
+        ``failed {part: {"code", "leader"}}``.  Failing the whole bulk
+        request on the first bad part would make the client poison its
+        leader cache for the GOOD parts with that one hint and
+        ping-pong between hosts."""
+        space = req["space_id"]
+        led, failed = {}, {}
+        for part_id, items in req["parts"].items():
+            part = self.kv.part(space, int(part_id))
+            if part is None:
+                failed[str(part_id)] = {
+                    "code": int(ErrorCode.E_PART_NOT_FOUND), "leader": ""}
+            elif not part.is_leader():
+                leader = part.leader()
+                failed[str(part_id)] = {
+                    "code": int(ErrorCode.E_LEADER_CHANGED),
+                    "leader": str(leader) if leader else ""}
+            else:
+                led[part_id] = items
+        if failed:
+            req = dict(req)
+            req["parts"] = led
+        return req, failed
+
+    def _bulk(self, req: dict, process):
+        """Split -> process led parts -> attach per-part failures.
+        Skips the processor entirely when this host leads none of the
+        addressed parts (common right after an election or a balancer
+        move)."""
+        req, failed = self._split_req(req)
+        if failed and not req["parts"]:
+            return {"failed_parts": failed, "latency_us": 0}
+        resp = process(req)
+        if failed:
+            resp["failed_parts"] = failed
+        return resp
+
     # ---- reads ------------------------------------------------------
     def rpc_getBound(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        if self.backend is not None and self.backend.serves(int(req["space_id"])):
-            resp = self.backend.get_bound(req)
-        else:
-            resp = QueryBoundProcessor(self.kv, self.schema_man,
-                                       self.pool).process(req)
+
+        def run(r):
+            if self.backend is not None and                     self.backend.serves(int(r["space_id"])):
+                return self.backend.get_bound(r)
+            return QueryBoundProcessor(self.kv, self.schema_man,
+                                       self.pool).process(r)
+
+        resp = self._bulk(req, run)
         stats.add_value("storage.get_bound.latency_us",
                         resp.get("latency_us", 0))
         return resp
 
+    # reference-IDL spellings (storage.thrift:207-228): direction is a
+    # sign on the request's edge types for us, so In/Out collapse onto
+    # the same processors
+    def rpc_getOutBound(self, req: dict) -> dict:
+        return self.rpc_getBound(req)
+
+    def rpc_getInBound(self, req: dict) -> dict:
+        neg = dict(req)
+        neg["edge_types"] = [-abs(int(t)) for t in req.get("edge_types", [])]
+        neg["reverse"] = True        # all-edge-types default negates too
+        return self.rpc_getBound(neg)
+
+    def rpc_outBoundStats(self, req: dict) -> dict:
+        return self.rpc_boundStats(req)
+
+    def rpc_inBoundStats(self, req: dict) -> dict:
+        neg = dict(req)
+        neg["edge_types"] = [-abs(int(t)) for t in req.get("edge_types", [])]
+        neg["reverse"] = True
+        # aggregate targets match signed etypes exactly — flip them too
+        neg["stat_props"] = {a: [-abs(int(et)), prop] for a, (et, prop)
+                             in req.get("stat_props", {}).items()}
+        return self.rpc_boundStats(neg)
+
     def rpc_getProps(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        return QueryVertexPropsProcessor(self.kv, self.schema_man,
-                                         self.pool).process(req)
+        return self._bulk(req, QueryVertexPropsProcessor(
+            self.kv, self.schema_man, self.pool).process)
 
     def rpc_getEdgeProps(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        return QueryEdgePropsProcessor(self.kv, self.schema_man).process(req)
+        return self._bulk(req, QueryEdgePropsProcessor(
+            self.kv, self.schema_man).process)
 
     def rpc_boundStats(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        if self.backend is not None and self.backend.serves(int(req["space_id"])):
-            return self.backend.bound_stats(req)
-        return QueryStatsProcessor(self.kv, self.schema_man).process(req)
+
+        def run(r):
+            if self.backend is not None and                     self.backend.serves(int(r["space_id"])):
+                return self.backend.bound_stats(r)
+            return QueryStatsProcessor(self.kv, self.schema_man).process(r)
+
+        return self._bulk(req, run)
 
     # ---- writes -----------------------------------------------------
     def rpc_addVertices(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        resp = AddVerticesProcessor(self.kv, self.schema_man).process(req)
-        return resp
+        return self._bulk(req, AddVerticesProcessor(
+            self.kv, self.schema_man).process)
 
     def rpc_addEdges(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        self._check_parts(req["space_id"], req["parts"].keys())
-        return AddEdgesProcessor(self.kv, self.schema_man).process(req)
+        return self._bulk(req, AddEdgesProcessor(
+            self.kv, self.schema_man).process)
 
     def rpc_deleteVertex(self, req: dict) -> dict:
         self._check_parts(req["space_id"], [req["part"]])
         return DeleteProcessor(self.kv, self.schema_man).delete_vertex(req)
 
     def rpc_deleteEdges(self, req: dict) -> dict:
-        self._check_parts(req["space_id"], req["parts"].keys())
-        return DeleteProcessor(self.kv, self.schema_man).delete_edges(req)
+        return self._bulk(req, DeleteProcessor(
+            self.kv, self.schema_man).delete_edges)
 
     # ---- admin (raft membership — driven by meta's balancer) --------
     def _raft(self, req: dict):

@@ -74,7 +74,7 @@ def _space_id(cluster, name="rep"):
     return 1
 
 
-def _wait_leaders(cluster, space_parts, timeout=10.0):
+def _wait_leaders(cluster, space_parts, timeout=30.0):
     """Every raft group must elect before writes can quorum."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
