@@ -326,6 +326,135 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
     return go
 
 
+def make_adaptive_go_kernel(ell: EllIndex, steps: int,
+                            etypes: Tuple[int, ...], K: int = 2048):
+    """Single-query GO with sparse-frontier hops — the interactive
+    short-read path (LDBC IS-style): while the frontier fits in K ids,
+    a hop is a push over just the frontier's slot rows (a few K row
+    gathers + a list-sized sort/dedup, ~ms) instead of the dense pull
+    over every vertex row (n*D row gathers, ~100s of ms at 16M edges).
+    When a hop's result overflows K — or the frontier contains a hub
+    vertex whose slots spill into extra rows, which would make the
+    push's cost scale with the hub's degree instead of the frontier —
+    the kernel switches permanently to the dense pull on a complete
+    bitmap, so results are exact for any frontier size.
+
+    Direction note: table slots of row-owner v are v's IN-edges over
+    +et plus v's OUT-edges recorded under -et (csr.py writes both
+    directions), so pushing OUT of a frontier member means selecting
+    slots with NEGATED etypes.
+
+    fn(start_new_ids int32[K], padded with n_rows — pad host-side so
+    one compiled program serves every start count) ->
+    frontier bitmap int8[n_rows+1] after steps-1 advances (same
+    contract as make_batched_go_kernel's column 0; hub extra rows may
+    hold junk exactly like the batched kernel's)."""
+    import jax
+    import jax.numpy as jnp
+    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+    n_rows = ell.n_rows
+    sentinel = n_rows
+    neg = tuple(-t for t in etypes)
+    d_max = max(ell.bucket_D) if ell.bucket_D else 1
+
+    # bucket start rows (static) — new ids are contiguous per bucket
+    bstarts = []
+    acc = 0
+    for nbr in ell.bucket_nbr:
+        bstarts.append(acc)
+        acc += nbr.shape[0]
+
+    # hub vertices (slots spilling into extra rows) force the dense
+    # path for the hop that sees them — bounded cost either way
+    if len(ell.extra_owner):
+        is_hub = np.zeros(ell.n + 1, dtype=bool)
+        is_hub[np.unique(ell.extra_owner)] = True
+        hub_dev = jnp.asarray(is_hub)
+    else:
+        hub_dev = None
+
+    def slot_rows(fr):
+        """[K, d_max] slot targets of each frontier row (sentinel where
+        absent), OVER-set mask applied."""
+        cand = jnp.full((fr.shape[0], d_max), jnp.int32(sentinel))
+        for nbr, et, bstart in zip(nbr_dev, et_dev, bstarts):
+            nb, D = nbr.shape
+            loc = fr - bstart
+            inb = (loc >= 0) & (loc < nb)
+            safe = jnp.where(inb, loc, 0)
+            rows = nbr[safe]                     # [K, D] row gathers
+            ets = et[safe]
+            ok = inb[:, None] & _etype_ok(jnp, ets, neg)
+            block = jnp.where(ok, rows, sentinel)
+            if D < d_max:
+                block = jnp.pad(block, ((0, 0), (0, d_max - D)),
+                                constant_values=sentinel)
+            cand = jnp.where(inb[:, None], block, cand)
+        return cand
+
+    def bitmap_of(ids):
+        return jnp.zeros((n_rows + 1,), jnp.int8) \
+            .at[ids].max(jnp.int8(1)).at[sentinel].set(0)
+
+    def sparse_hop(state):
+        fr, cnt, bitmap, sparse = state
+        cand = slot_rows(fr).reshape(-1)
+        srt = jnp.sort(cand)
+        uniq = (srt != jnp.roll(srt, 1)) & (srt != sentinel)
+        # index 0 is always a first occurrence (roll compares it to the
+        # LAST element, which is wrong for it)
+        uniq = uniq.at[0].set(srt[0] != sentinel)
+        pref = jnp.cumsum(uniq.astype(jnp.int32))
+        cnt2 = pref[-1]
+        pos = jnp.where(uniq & (pref <= K), pref - 1, K)
+        fr2 = jnp.full((K,), jnp.int32(sentinel)) \
+            .at[pos].set(srt, mode="drop")
+        overflow = cnt2 > K
+        # invariant: bitmap always reflects the current frontier, so
+        # the dense branch can take over at any hop (cheap: K-scatter
+        # when staying sparse, full-cand scatter on overflow)
+        bitmap2 = jax.lax.cond(
+            overflow,
+            lambda: bitmap_of(cand),
+            lambda: bitmap_of(fr2))
+        return fr2, cnt2, bitmap2, jnp.logical_not(overflow)
+
+    def dense_hop(state):
+        fr, cnt, bitmap, sparse = state
+        nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
+                        owner_dev, bitmap[:, None])[:, 0]
+        return (jnp.full((K,), jnp.int32(sentinel)),
+                jnp.int32(K + 1), nxt, jnp.bool_(False))
+
+    @jax.jit
+    def go(fr0):
+        bm0 = bitmap_of(fr0)
+        cnt0 = jnp.sum(fr0 != sentinel).astype(jnp.int32)
+        state = (fr0, cnt0, bm0, cnt0 <= K)
+
+        def one(_, st):
+            sparse_ok = st[3]
+            if hub_dev is not None:
+                fr = st[0]
+                hub_in_frontier = jnp.any(
+                    hub_dev[jnp.where(fr < ell.n, fr, ell.n)])
+                sparse_ok = sparse_ok & jnp.logical_not(hub_in_frontier)
+            return jax.lax.cond(sparse_ok, sparse_hop, dense_hop, st)
+
+        if steps > 1:
+            state = jax.lax.fori_loop(0, steps - 1, one, state)
+        fr, cnt, bitmap, sparse = state
+        return bitmap
+
+    def entry(start_ids):
+        ids = np.asarray(start_ids, np.int32)[:K]
+        fr0 = np.full((K,), np.int32(sentinel))
+        fr0[: len(ids)] = ids
+        return go(jnp.asarray(fr0))
+
+    return entry
+
+
 def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
                             etypes: Tuple[int, ...],
                             stop_when_found: bool = True):

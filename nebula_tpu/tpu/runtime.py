@@ -64,6 +64,15 @@ flags.define(
     "dispatcher) or 'device' (the mask fuses into the XLA hop program; "
     "no cross-query batching)")
 flags.define(
+    "tpu_adaptive_single", True,
+    "single-query GO runs the adaptive sparse-frontier kernel "
+    "(ell.make_adaptive_go_kernel): while the frontier fits in "
+    "tpu_adaptive_k ids a hop costs ~ms instead of a full dense pull — "
+    "the interactive short-read path. Exact for any frontier size "
+    "(overflow switches to the dense pull mid-query)")
+flags.define("tpu_adaptive_k", 2048,
+             "sparse-frontier capacity for tpu_adaptive_single")
+flags.define(
     "tpu_mesh_devices", 0,
     "shard the ELL tables over this many devices (a 1-D 'parts' Mesh; "
     "per-hop frontier re-replication rides ICI). 0 = single-device. "
@@ -697,11 +706,29 @@ class TpuQueryRuntime:
         advances for B queries; returns (bool [B, n] frontiers in the
         mirror's dense-id space, mirror)."""
         import jax.numpy as jnp
-        from .ell import (make_batched_go_kernel,
+        from .ell import (make_adaptive_go_kernel, make_batched_go_kernel,
                           make_sharded_batched_go_kernel)
         m = self.mirror(space_id)
         ix = self.ell(m)
         nq = len(starts_per_query)
+
+        # lone interactive query: sparse-frontier adaptive kernel
+        # (mesh-sharded mode keeps the batched path — the adaptive
+        # kernel is single-device)
+        K = int(flags.get("tpu_adaptive_k") or 2048)
+        if nq == 1 and flags.get("tpu_adaptive_single") \
+                and self._mesh_tables(m, ix) is None \
+                and len(starts_per_query[0]) <= K:
+            kern = self._kernel(
+                (space_id, m.build_version, "ell_go_adaptive", et_tuple,
+                 kernel_steps, K),
+                lambda: make_adaptive_go_kernel(ix, kernel_steps,
+                                                et_tuple, K=K))
+            dense = m.to_dense(starts_per_query[0])
+            dense = dense[dense >= 0]
+            bitmap = np.asarray(kern(jnp.asarray(ix.perm[dense])))
+            return (ix.to_old(bitmap) > 0)[None, :], m
+
         B = self._batch_width(nq)
         run = self._batched_runner(
             space_id, m, ix, "ell_go", (et_tuple, kernel_steps, B),
