@@ -96,8 +96,10 @@ class FlagsRegistry:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            if line.startswith("--") and "=" in line:
-                k, v = line[2:].split("=", 1)
+            if line.startswith("--"):
+                line = line[2:]
+            if "=" in line:
+                k, v = line.split("=", 1)
                 for cast in (int, float):
                     try:
                         v = cast(v)

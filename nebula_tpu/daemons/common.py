@@ -36,19 +36,13 @@ def base_parser(name: str, default_port: int) -> argparse.ArgumentParser:
 
 
 def load_flagfile(path: Optional[str]) -> None:
+    """Delegates to FlagsRegistry.load_file — values are CAST
+    (int/float/bool) there, so a flag defined lazily after the flagfile
+    loads (import-time defines in graph/tpu modules) still compares
+    against properly-typed values."""
     if not path:
         return
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            if line.startswith("--"):
-                line = line[2:]
-            if "=" in line:
-                k, v = line.split("=", 1)
-                flags.define(k.strip(), v.strip())
-                flags.set(k.strip(), v.strip(), force=True)
+    flags.load_file(path)
 
 
 def apply_flag_overrides(pairs: List[str]) -> None:

@@ -2,11 +2,14 @@
 
 Reference wiring (GraphDaemon.cpp:36-162): init → pidfile → WebService →
 GraphService::init (MetaClient → waitForMetadReady → SchemaManager /
-GflagsManager / StorageClient) → serve. ``--enable_tpu_backend`` attaches
-the TpuQueryRuntime so GO / FIND PATH run on the device CSR mirror
-(BASELINE.json north star) — storage nodes must be reachable in-process
-for the mirror fold in this deployment; remote-storage mirroring rides
-the storage service's scan RPCs.
+GflagsManager / StorageClient) → serve.
+
+Deployment note: this standalone daemon serves the CPU executor path.
+The TpuQueryRuntime needs in-process access to the storage stores for
+the CSR-mirror fold, so the device path runs in embedded deployments
+(cluster.LocalCluster(tpu_backend=True) — the serving form bench.py
+and the TPU tests measure); a device-backed *storaged* answers
+getBound from HBM via the StorageService.backend seam either way.
 
 Run: ``python -m nebula_tpu.daemons.graphd --port 43699 \
       --meta_server_addrs 127.0.0.1:45500``

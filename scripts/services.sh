@@ -1,0 +1,124 @@
+#!/usr/bin/env bash
+# Cluster start/stop — the reference's scripts/services.sh equivalent.
+#   scripts/services.sh start|stop|status|restart [graphd|storaged|metad|all]
+# Env: NEBULA_HOME (repo root, default: script's parent), NEBULA_DATA
+# (default $NEBULA_HOME/data), NEBULA_LOGS, PYTHON.
+set -u
+HERE="$(cd "$(dirname "$0")/.." && pwd)"
+NEBULA_HOME="${NEBULA_HOME:-$HERE}"
+NEBULA_DATA="${NEBULA_DATA:-$NEBULA_HOME/data}"
+NEBULA_LOGS="${NEBULA_LOGS:-$NEBULA_HOME/logs}"
+PYTHON="${PYTHON:-python3}"
+EXTRA_FLAGS="${EXTRA_FLAGS:-}"   # e.g. "--flag load_data_interval_secs=1"
+mkdir -p "$NEBULA_DATA" "$NEBULA_LOGS"
+
+META_PORT="${META_PORT:-45500}"
+STORAGE_PORT="${STORAGE_PORT:-44500}"
+GRAPH_PORT="${GRAPH_PORT:-3699}"
+META_ADDRS="127.0.0.1:${META_PORT}"
+
+pidfile() { echo "$NEBULA_DATA/nebula-$1.pid"; }
+
+start_one() {
+    local name="$1"; shift
+    local pf; pf="$(pidfile "$name")"
+    if [ -f "$pf" ] && kill -0 "$(cat "$pf")" 2>/dev/null; then
+        echo "[$name] already running (pid $(cat "$pf"))"
+        return 0
+    fi
+    # setsid + full fd redirection: the daemon must not keep the
+    # launcher's stdio alive (a caller capturing our output would
+    # otherwise block on pipe EOF until the daemon dies)
+    (cd "$NEBULA_HOME" && setsid nohup "$PYTHON" \
+        -m "nebula_tpu.daemons.$name" \
+        --flagfile "$NEBULA_HOME/etc/nebula-$name.conf.default" \
+        --pid_file "$pf" "$@" $EXTRA_FLAGS \
+        </dev/null >"$NEBULA_LOGS/nebula-$name.log" 2>&1 &)
+    # first import of the device stack can take tens of seconds
+    for _ in $(seq 1 600); do
+        [ -f "$pf" ] && kill -0 "$(cat "$pf")" 2>/dev/null && break
+        sleep 0.1
+    done
+    if [ -f "$pf" ] && kill -0 "$(cat "$pf")" 2>/dev/null; then
+        echo "[$name] started (pid $(cat "$pf"))"
+    else
+        echo "[$name] FAILED to start — see $NEBULA_LOGS/nebula-$name.log"
+        return 1
+    fi
+}
+
+stop_one() {
+    local name="$1"
+    local pf; pf="$(pidfile "$name")"
+    if [ -f "$pf" ] && kill -0 "$(cat "$pf")" 2>/dev/null; then
+        kill "$(cat "$pf")"
+        for _ in $(seq 1 100); do
+            kill -0 "$(cat "$pf")" 2>/dev/null || break
+            sleep 0.1
+        done
+        if kill -0 "$(cat "$pf")" 2>/dev/null; then
+            kill -9 "$(cat "$pf")" 2>/dev/null   # graceful window expired
+            sleep 0.2
+        fi
+        echo "[$name] stopped"
+    else
+        echo "[$name] not running"
+    fi
+    rm -f "$pf"
+}
+
+status_one() {
+    local name="$1"
+    local pf; pf="$(pidfile "$name")"
+    if [ -f "$pf" ] && kill -0 "$(cat "$pf")" 2>/dev/null; then
+        echo "[$name] running (pid $(cat "$pf"))"
+    else
+        echo "[$name] stopped"
+    fi
+}
+
+cmd="${1:-status}"
+target="${2:-all}"
+
+run() {
+    local action="$1" name="$2"
+    case "$name" in
+        metad)    case "$action" in
+                      start) start_one metad --port "$META_PORT" ;;
+                      stop) stop_one metad ;;
+                      status) status_one metad ;;
+                  esac ;;
+        storaged) case "$action" in
+                      start) start_one storaged --port "$STORAGE_PORT" \
+                          --meta_server_addrs "$META_ADDRS" \
+                          --data_path "$NEBULA_DATA/storage" ;;
+                      stop) stop_one storaged ;;
+                      status) status_one storaged ;;
+                  esac ;;
+        graphd)   case "$action" in
+                      start) start_one graphd --port "$GRAPH_PORT" \
+                          --meta_server_addrs "$META_ADDRS" ;;
+                      stop) stop_one graphd ;;
+                      status) status_one graphd ;;
+                  esac ;;
+    esac
+}
+
+names() {
+    case "$target" in
+        all) echo "metad storaged graphd" ;;
+        *)   echo "$target" ;;
+    esac
+}
+
+case "$cmd" in
+    start)   for n in $(names); do run start "$n" || exit 1; done ;;
+    stop)    # stop in reverse dependency order
+             for n in graphd storaged metad; do
+                 case " $(names) " in *" $n "*) run stop "$n" ;; esac
+             done ;;
+    status)  for n in $(names); do run status "$n"; done ;;
+    restart) "$0" stop "$target"; "$0" start "$target" ;;
+    *) echo "usage: $0 start|stop|status|restart [graphd|storaged|metad|all]"
+       exit 2 ;;
+esac

@@ -349,3 +349,69 @@ def test_ldbc_gen_load_and_query(tmp_path):
         assert c.tpu_runtime.stats["go_device"] >= 1
     finally:
         c.stop()
+
+
+def test_services_sh_cluster(tmp_path):
+    """scripts/services.sh boots real metad/storaged/graphd processes
+    (the reference's services.sh equivalent) and a client can run the
+    full DDL+DML+GO flow against them."""
+    import os
+    import subprocess
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               NEBULA_HOME=repo,
+               NEBULA_DATA=str(tmp_path / "data"),
+               NEBULA_LOGS=str(tmp_path / "logs"),
+               JAX_PLATFORMS="cpu",
+               META_PORT="45611", STORAGE_PORT="44611", GRAPH_PORT="3799",
+               EXTRA_FLAGS="--flag load_data_interval_secs=1")
+    sh = os.path.join(repo, "scripts", "services.sh")
+
+    # a previous timed-out run may have leaked daemons whose pidfiles
+    # died with its tmp dir — sweep them so this run starts clean
+    import signal
+    ps = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                        text=True).stdout
+    for line in ps.splitlines():
+        if "nebula_tpu.daemons" in line:
+            try:
+                os.kill(int(line.split()[0]), signal.SIGKILL)
+            except (ProcessLookupError, ValueError, PermissionError):
+                pass
+    # file-redirected Popen: the launcher must never share pipes with
+    # the daemons it spawns (a capture_output pipe held open by any
+    # descendant would block communicate() until the daemons die)
+    start_log = tmp_path / "start.log"
+    with open(start_log, "w") as lf:
+        p = subprocess.Popen(["bash", sh, "start", "all"], env=env,
+                             stdout=lf, stderr=lf,
+                             stdin=subprocess.DEVNULL)
+        rc = p.wait(timeout=420)
+    try:
+        assert rc == 0, start_log.read_text()
+        time.sleep(2)
+        from nebula_tpu.clients.graph_client import GraphClient
+        from nebula_tpu.interface.common import HostAddr
+        from nebula_tpu.interface.rpc import ClientManager
+        c = GraphClient(HostAddr("127.0.0.1", 3799),
+                        client_manager=ClientManager())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if c.connect().ok():
+                break
+            time.sleep(0.5)
+        assert c.execute("CREATE SPACE IF NOT EXISTS "
+                         "svc(partition_num=2, replica_factor=1)").ok()
+        time.sleep(2.5)
+        assert c.execute("USE svc; CREATE EDGE e(w int)").ok()
+        time.sleep(2.5)
+        rr = c.execute("USE svc; INSERT EDGE e(w) VALUES 1->2:(5)")
+        assert rr.ok(), rr.error_msg
+        rr = c.execute("USE svc; GO FROM 1 OVER e YIELD e._dst, e.w")
+        assert rr.ok() and [list(x) for x in rr.rows] == [[2, 5]]
+    finally:
+        with open(tmp_path / "stop.log", "w") as lf:
+            subprocess.Popen(["bash", sh, "stop", "all"], env=env,
+                             stdout=lf, stderr=lf,
+                             stdin=subprocess.DEVNULL).wait(timeout=60)
