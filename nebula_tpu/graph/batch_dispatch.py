@@ -1,5 +1,5 @@
-"""GoBatchDispatcher — coalesce concurrent GO queries into one device
-dispatch.
+"""GoBatchDispatcher — coalesce concurrent device queries into one
+dispatch (GO frontiers and FIND PATH BFS depths share the seam).
 
 The batched ELL engine (tpu/ell.py) amortises the TPU's per-row-access
 floor across a [n, B] frontier matrix, so the serving layer must feed
@@ -31,19 +31,22 @@ from typing import Dict, List, Tuple
 from ..common.flags import flags
 
 flags.define("go_batch_window_ms", 0,
-             "batch-leader wait before dispatching coalesced GO queries"
-             " (0: dispatch immediately; in-flight kernels still"
-             " coalesce whatever queues up behind them)")
-flags.define("go_batch_max", 1024, "max GO queries per device dispatch")
+             "batch-leader wait before dispatching coalesced device "
+             "queries — GO and FIND PATH both (0: dispatch immediately; "
+             "in-flight kernels still coalesce whatever queues up "
+             "behind them)")
+flags.define("go_batch_max", 1024,
+             "max coalesced queries (GO or FIND PATH) per device dispatch")
 
 
 class _Request:
-    __slots__ = ("start_vids", "done", "frontier", "mirror", "error")
+    __slots__ = ("payload", "done", "result", "mirror", "error")
 
-    def __init__(self, start_vids):
-        self.start_vids = start_vids     # raw vids — mapped by the leader
-        self.done = False                # against ONE consistent mirror
-        self.frontier = None             # bool[n] (leader's mirror space)
+    def __init__(self, payload):
+        self.payload = payload   # per-query input, method-defined (GO:
+        self.done = False        # start vids; BFS: (srcs, dsts)); the
+                                 # leader maps ids against ONE mirror
+        self.result = None               # per-query row of the batch
         self.mirror = None
         self.error = None
 
@@ -73,11 +76,19 @@ class GoBatchDispatcher:
 
     def submit(self, space_id: int, start_vids, et_tuple: Tuple[int, ...],
                steps: int):
-        """Blocking: returns (frontier bool[n] after steps-1 advances,
-        mirror it is expressed in)."""
-        key = (space_id, et_tuple, steps)
+        """Blocking GO submit: returns (frontier bool[n] after steps-1
+        advances, mirror it is expressed in)."""
+        return self.submit_batched(
+            ("go_batch_frontier", space_id, et_tuple, steps), start_vids)
+
+    def submit_batched(self, key: Tuple, payload):
+        """Coalesce any batched runtime entry point: ``key[0]`` names a
+        runtime method with signature ``fn(space_id, payloads, *key[2:])
+        -> (per-query results, mirror)``; requests sharing the key ride
+        one device dispatch (GO frontiers and FIND PATH BFS depths both
+        route here)."""
         st = self._state(key)
-        req = _Request(start_vids)
+        req = _Request(payload)
         st.cond.acquire()
         try:
             st.queue.append(req)
@@ -108,16 +119,17 @@ class GoBatchDispatcher:
             st.cond.release()
         if req.error is not None:
             raise req.error
-        return req.frontier, req.mirror
+        return req.result, req.mirror
 
     # ------------------------------------------------------------------
     def _run(self, key: Tuple, batch: List[_Request]) -> None:
-        space_id, et_tuple, steps = key
+        method, space_id = key[0], key[1]
         try:
-            frontiers, mirror = self.runtime.go_batch_frontier(
-                space_id, [r.start_vids for r in batch], et_tuple, steps)
+            fn = getattr(self.runtime, method)
+            results, mirror = fn(space_id, [r.payload for r in batch],
+                                 *key[2:])
             for i, r in enumerate(batch):
-                r.frontier = frontiers[i]
+                r.result = results[i]
                 r.mirror = mirror
         except BaseException as ex:        # noqa: BLE001 — every waiter
             for r in batch:                # must wake with the error

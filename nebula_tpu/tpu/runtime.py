@@ -801,11 +801,21 @@ class TpuQueryRuntime:
                   etypes: List[int], max_steps: int,
                   shortest: bool = True) -> np.ndarray:
         """Batched BFS depths: int16 [B, n] (INT16_INF = unreached)."""
+        rows, _ = self.bfs_batch_dispatch(
+            space_id, list(zip(starts_per_query, targets_per_query)),
+            tuple(sorted(set(etypes))), max_steps, shortest)
+        return np.asarray(rows)
+
+    def bfs_batch_dispatch(self, space_id: int, pairs,
+                           et_tuple: Tuple[int, ...], max_steps: int,
+                           shortest: bool):
+        """Dispatcher entry (graph/batch_dispatch.py submit_batched):
+        ``pairs`` is [(srcs, dsts), ...]; returns (depth rows, mirror)."""
         m = self.mirror(space_id)
-        return self._bfs_depths(space_id, m, starts_per_query,
-                                targets_per_query,
-                                tuple(sorted(set(etypes))), max_steps,
-                                shortest)
+        d = self._bfs_depths(space_id, m, [p[0] for p in pairs],
+                             [p[1] for p in pairs], et_tuple, max_steps,
+                             shortest)
+        return list(d), m
 
     # ================================================== FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
@@ -827,9 +837,12 @@ class TpuQueryRuntime:
             return InterimResult(["path"])
         et_tuple = tuple(sorted(set(etypes)))
 
-        # --- device half: batched ELL BFS depths --------------------
-        d16 = self._bfs_depths(space_id, m, [srcs], [dsts], et_tuple,
-                               max_steps, shortest)[0]
+        # --- device half: batched ELL BFS depths, coalesced with any
+        # concurrent same-shaped FIND PATHs (same dispatcher the GO
+        # path uses)
+        d16, m = self.dispatcher.submit_batched(
+            ("bfs_batch_dispatch", space_id, et_tuple, max_steps,
+             shortest), (srcs, dsts))
         depth = np.where(d16 == INT16_INF, kernels.INT32_INF,
                          d16.astype(np.int32))
 

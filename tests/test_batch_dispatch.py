@@ -105,3 +105,42 @@ def test_dispatcher_error_propagates():
     with pytest.raises(Boom):
         d.submit(1, [1], (1,), 2)
     assert d.stats["batches"] == 1
+
+
+def test_concurrent_find_path_coalesce(nba):
+    """Concurrent same-shaped FIND PATH queries must coalesce into one
+    BFS dispatch (submit_batched generalization), with exact per-query
+    paths."""
+    c, ok = nba
+    ok("FIND SHORTEST PATH FROM 1 TO 4 OVER follow")   # warm kernel
+    d = c.tpu_runtime.dispatcher
+    flags.set("go_batch_window_ms", 120)
+    results = {}
+    errors = []
+
+    def worker(src, dst):
+        try:
+            g2 = c.client()
+            g2.execute("USE s")
+            r = g2.execute(f"FIND SHORTEST PATH FROM {src} TO {dst} "
+                           f"OVER follow")
+            assert r.ok(), r.error_msg
+            results[(src, dst)] = sorted(x[0] for x in r.rows)
+        except Exception as ex:            # noqa: BLE001
+            errors.append(ex)
+
+    before = d.stats["batches"]
+    pairs = [(1, 4), (2, 5), (1, 7), (6, 7)]
+    ts = [threading.Thread(target=worker, args=p) for p in pairs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    flags.set("go_batch_window_ms", 0)
+    assert not errors, errors
+    assert results[(1, 4)] == ["1 <follow,0> 2 <follow,0> 3 <follow,0> 4"]
+    assert results[(2, 5)] == ["2 <follow,0> 3 <follow,0> 4 <follow,0> 5"]
+    assert results[(6, 7)] == ["6 <follow,0> 7"]
+    assert results[(1, 7)]                      # 1->2->7 and/or 1->6->7
+    batches = d.stats["batches"] - before
+    assert batches < 4, f"no coalescing: {batches} for 4 path queries"
