@@ -137,9 +137,10 @@ class GoBatchDispatcher:
             if not isinstance(ex, Exception):
                 raise                      # KeyboardInterrupt etc.
         finally:
-            self.stats["batches"] += 1
-            self.stats["batched_queries"] += len(batch)
-            self.stats["max_batch"] = max(self.stats["max_batch"],
-                                          len(batch))
+            with self._lock:   # leaders for different keys run concurrently
+                self.stats["batches"] += 1
+                self.stats["batched_queries"] += len(batch)
+                self.stats["max_batch"] = max(self.stats["max_batch"],
+                                              len(batch))
             for r in batch:
                 r.done = True

@@ -801,6 +801,10 @@ class TpuQueryRuntime:
                   etypes: List[int], max_steps: int,
                   shortest: bool = True) -> np.ndarray:
         """Batched BFS depths: int16 [B, n] (INT16_INF = unreached)."""
+        if len(starts_per_query) != len(targets_per_query):
+            raise ValueError(
+                f"bfs_batch: {len(starts_per_query)} start lists vs "
+                f"{len(targets_per_query)} target lists")
         rows, _ = self.bfs_batch_dispatch(
             space_id, list(zip(starts_per_query, targets_per_query)),
             tuple(sorted(set(etypes))), max_steps, shortest)
@@ -832,17 +836,20 @@ class TpuQueryRuntime:
                       shortest: bool, etype_names: Dict[int, str]
                       ) -> InterimResult:
         from .ell import INT16_INF
-        m = self.mirror(space_id)
-        if m.m == 0 or not srcs or not dsts:
+        if not srcs or not dsts:
             return InterimResult(["path"])
         et_tuple = tuple(sorted(set(etypes)))
 
         # --- device half: batched ELL BFS depths, coalesced with any
         # concurrent same-shaped FIND PATHs (same dispatcher the GO
-        # path uses)
+        # path uses).  The dispatch's mirror is the single source of
+        # truth — evaluating emptiness against a separately fetched
+        # mirror could disagree with the one the BFS actually used.
         d16, m = self.dispatcher.submit_batched(
             ("bfs_batch_dispatch", space_id, et_tuple, max_steps,
              shortest), (srcs, dsts))
+        if m.m == 0:
+            return InterimResult(["path"])
         depth = np.where(d16 == INT16_INF, kernels.INT32_INF,
                          d16.astype(np.int32))
 
