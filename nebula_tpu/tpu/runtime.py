@@ -267,9 +267,10 @@ class TpuQueryRuntime:
                 m, space_id, steps, et_tuple, plan, start_idx)
             final_mask = np.asarray(final_mask)
             frontier = np.asarray(frontier)
-            etype_ok = np.isin(m.edge_etype,
-                               np.asarray(et_tuple, dtype=np.int32))
-            candidates = frontier[m.edge_src] & etype_ok
+            # cand_idx only feeds the non-pushed validity check below
+            cand_idx = (self._frontier_edges(m, frontier, et_tuple)
+                        if not plan.pushed_mode else None)
+            idx = np.nonzero(final_mask)[0]
         else:
             # default: every GO rides the batch dispatcher — concurrent
             # queries with the same shape coalesce into one ELL kernel
@@ -295,20 +296,16 @@ class TpuQueryRuntime:
                             "schema changed while the query ran")
                     plan.filter_used = dict(compiler.used)
                     plan.compiler = compiler
-            etype_ok = np.isin(m.edge_etype,
-                               np.asarray(et_tuple, dtype=np.int32))
-            candidates = frontier[m.edge_src] & etype_ok
-            final_mask = candidates
+            cand_idx = self._frontier_edges(m, frontier, et_tuple)
             if plan.filter_cval is not None:
-                final_mask = candidates.copy()
-                final_mask[candidates] = self._host_filter(
-                    m, plan, np.nonzero(candidates)[0])
+                idx = cand_idx[self._host_filter(m, plan, cand_idx)]
+            else:
+                idx = cand_idx
 
         if plan.filter_cval is not None and not plan.pushed_mode:
             # graphd-side WHERE raises on per-row missing props
-            self._check_valid(m, plan.filter_used, candidates, ExecError)
+            self._check_valid(m, plan.filter_used, cand_idx, ExecError)
 
-        idx = np.nonzero(final_mask)[0]
         rows = self._materialize(m, space_id, plan.alias_to_etype,
                                  etype_to_alias, yield_cols, idx, ExecError)
         if distinct:
@@ -362,7 +359,14 @@ class TpuQueryRuntime:
                                         plan.filter_used, idx))
         with np.errstate(divide="ignore", invalid="ignore"):
             mask = np.broadcast_to(np.asarray(plan.filter_cval.fn(env)),
-                                   idx.shape).copy()
+                                   idx.shape)
+            if mask.dtype != np.bool_:
+                # numeric WHERE: CPU-path truthiness (nonzero = keep) —
+                # and callers fancy-index with this mask, so it MUST be
+                # bool, never int/float
+                mask = mask != 0
+            else:
+                mask = mask.copy()
             for g in plan.compiler.div_guards:
                 # a real x/0 drops the row in pushed mode (can_run_go
                 # declines div guards in graphd/remnant mode)
@@ -421,7 +425,9 @@ class TpuQueryRuntime:
                     elif desc2[0] == "dst_idx":
                         cols[k2] = edge_dst
                 env = Env(jnp, cols)
-                mask = cval.fn(env)
+                mask = jnp.asarray(cval.fn(env))
+                if mask.dtype != jnp.bool_:
+                    mask = mask != 0   # numeric WHERE: nonzero = truthy
                 mask = jnp.broadcast_to(mask, edge_src.shape)
                 # x/0 raises ExprError on the CPU path; in pushed mode
                 # that drops the row (can_run_go declines remnant mode)
@@ -474,29 +480,92 @@ class TpuQueryRuntime:
     @staticmethod
     def _etype_alias_codes(m: CsrMirror,
                            alias_to_etype: Dict[str, int]) -> np.ndarray:
-        """int32[m]: per-edge code into the sorted alias dictionary."""
+        """int32[m]: per-edge code into the sorted alias dictionary
+        (cached per mirror+alias map — O(m) to build, reused across
+        queries)."""
+        cache = getattr(m, "_alias_code_cache", None)
+        if cache is None:
+            cache = m._alias_code_cache = {}
+        key = tuple(sorted(alias_to_etype.items()))
+        codes = cache.get(key)
+        if codes is not None:
+            return codes
+        if len(cache) >= 8:   # each entry is O(m) — bound the memory
+            cache.clear()
         alias_pos = {a: i for i, a in enumerate(sorted(alias_to_etype))}
         et_to_code = {et: alias_pos[a] for a, et in alias_to_etype.items()}
         codes = np.zeros(m.m, dtype=np.int32)
         for et, code in et_to_code.items():
             codes[m.edge_etype == et] = code
+        cache[key] = codes
         return codes
+
+    # -------------------------------------------------- final-hop edges
+    @staticmethod
+    def _etype_edge_mask(m: CsrMirror,
+                         et_tuple: Tuple[int, ...]) -> np.ndarray:
+        """bool[m]: edge etype in the OVER set — cached per mirror so
+        the O(m) isin pass is paid once per (mirror, OVER), not per
+        query."""
+        cache = getattr(m, "_etype_mask_cache", None)
+        if cache is None:
+            cache = m._etype_mask_cache = {}
+        mask = cache.get(et_tuple)
+        if mask is None:
+            if len(cache) >= 8:   # each entry is O(m) — bound the memory
+                cache.clear()
+            mask = np.isin(m.edge_etype,
+                           np.asarray(et_tuple, dtype=np.int32))
+            cache[et_tuple] = mask
+        return mask
+
+    def _frontier_edges(self, m: CsrMirror, frontier: np.ndarray,
+                        et_tuple: Tuple[int, ...]) -> np.ndarray:
+        """Final-hop candidate edges (src in ``frontier``, etype in the
+        OVER set) as an ascending index array.
+
+        Walks CSR row slices of only the frontier vertices —
+        O(|frontier| + candidates) instead of the O(m)
+        ``frontier[edge_src]`` gather over every edge that round 1 paid
+        per query (the reference's analogue is the per-vertex prefix
+        scan, QueryBaseProcessor.inl:336-405: it also only touches the
+        frontier's own edges)."""
+        vs = np.nonzero(frontier[:m.n])[0]
+        if len(vs) == 0:
+            return np.zeros(0, dtype=np.int64)
+        et_ok = self._etype_edge_mask(m, et_tuple)
+        starts = m.row_ptr[vs].astype(np.int64)
+        counts = (m.row_ptr[vs + 1].astype(np.int64) - starts)
+        total = int(counts.sum())
+        if total == 0:
+            return np.zeros(0, dtype=np.int64)
+        if total * 5 >= m.m:   # measured break-even ~20% density
+            # saturated frontier: a flat bool gather over all m edges is
+            # one vectorized pass and beats per-row index assembly
+            return np.nonzero(frontier[m.edge_src] & et_ok)[0]
+        nz = counts > 0
+        starts, counts = starts[nz], counts[nz]
+        # multi-range arange: global position -> within-range offset +
+        # range start, fully vectorized
+        excl = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        idx = np.repeat(starts - excl, counts) \
+            + np.arange(total, dtype=np.int64)
+        return idx[et_ok[idx]]
 
     # -------------------------------------------------- validity parity
     @staticmethod
     def _check_valid(m: CsrMirror, used: Dict[str, Tuple],
-                     candidates: np.ndarray, exc_type) -> None:
+                     cand_idx: np.ndarray, exc_type) -> None:
         for k, desc in used.items():
             if desc[0] == "edge":
                 col = m.edge_cols[(desc[1], desc[2])]
-                bad = candidates & ~col.valid
-                if bad.any():
+                if not col.valid[cand_idx].all():
                     raise exc_type(f"{desc[2]} unavailable")
             elif desc[0] == "vertex":
                 col = m.vertex_cols[(desc[1], desc[2])]
-                gather = m.edge_src if desc[3] == "src" else m.edge_dst
-                bad = candidates & ~col.valid[gather]
-                if bad.any():
+                gather = m.edge_src[cand_idx] if desc[3] == "src" \
+                    else m.edge_dst[cand_idx]
+                if not col.valid[gather].all():
                     raise exc_type(f"{desc[2]} unavailable")
 
     # -------------------------------------------------- materialization

@@ -81,6 +81,10 @@ PARITY_QUERIES = [
     f"GO 2 STEPS FROM {TIM} OVER follow",
     f"GO 3 STEPS FROM {TIM} OVER follow",
     f"GO FROM {TONY} OVER follow WHERE follow.degree > 92 YIELD follow._dst",
+    # numeric (non-bool) WHERE: nonzero = truthy, and the host-filter
+    # mask must be bool before it fancy-indexes candidate edges
+    f"GO 2 STEPS FROM {TIM} OVER follow WHERE follow.degree "
+    f"YIELD follow._dst",
     f"GO FROM {TIM},{TONY} OVER follow WHERE $^.player.age > 40 "
     f"YIELD follow._dst",
     f"GO FROM {TIM} OVER follow WHERE $$.player.age > 40 YIELD follow._dst",
@@ -277,3 +281,42 @@ class TestFilterModeParity:
                 assert sorted(map(tuple, r.rows)) == host_rows[q], q
         finally:
             flags.set("tpu_filter_mode", "host")
+
+
+class TestFrontierEdges:
+    """_frontier_edges (CSR row-slice final-hop candidate assembly) must
+    equal the flat frontier[edge_src] gather in both density regimes —
+    it replaces round 1's per-query O(m) host pass."""
+
+    def _mirror(self, n, m, seed=0):
+        from nebula_tpu.tpu.csr import CsrMirror
+        rng = np.random.default_rng(seed)
+        mir = CsrMirror(1)
+        mir.n = n
+        mir.m = m
+        mir.vids = np.arange(n, dtype=np.int64)
+        mir.edge_src = np.sort(rng.integers(0, n, m).astype(np.int32))
+        mir.edge_dst = rng.integers(0, n, m).astype(np.int32)
+        mir.edge_etype = rng.choice([1, 2], m).astype(np.int32)
+        counts = np.bincount(mir.edge_src, minlength=n)
+        mir.row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(
+            np.int32)
+        return mir
+
+    @pytest.mark.parametrize("density", [0.0, 0.002, 0.05, 0.6, 1.0])
+    @pytest.mark.parametrize("et_tuple", [(1,), (1, 2)])
+    def test_matches_flat_gather(self, density, et_tuple):
+        from nebula_tpu.tpu.runtime import TpuQueryRuntime
+        n, m = 4096, 32768
+        mir = self._mirror(n, m)
+        rng = np.random.default_rng(1)
+        frontier = np.zeros(n, dtype=bool)
+        k = int(n * density)
+        if k:
+            frontier[rng.choice(n, k, replace=False)] = True
+        flat = np.nonzero(
+            frontier[mir.edge_src]
+            & np.isin(mir.edge_etype, np.asarray(et_tuple, np.int32)))[0]
+        got = TpuQueryRuntime._frontier_edges(
+            TpuQueryRuntime.__new__(TpuQueryRuntime), mir, frontier, et_tuple)
+        assert np.array_equal(got, flat)
