@@ -143,7 +143,15 @@ class LocalCluster:
         self.storage_client = StorageClient(self.graph_meta_client,
                                             client_manager=self.cm)
         self.tpu_runtime = None
-        if tpu_backend:
+        if tpu_backend == "remote":
+            # cross-process serving shape inside one process: graphd
+            # ships whole GO/FIND PATH queries over the (loopback or
+            # TCP) StorageService RPC boundary to storaged's device
+            # runtime — the daemons' topology, testable in-suite
+            from .storage.device import RemoteDeviceRuntime
+            self.tpu_runtime = RemoteDeviceRuntime(
+                self.graph_meta_client, self.schema_man, self.cm)
+        elif tpu_backend:
             from .tpu.runtime import TpuQueryRuntime
             self.tpu_runtime = TpuQueryRuntime(self.storage_nodes,
                                                self.schema_man)

@@ -4,12 +4,14 @@ Reference wiring (GraphDaemon.cpp:36-162): init → pidfile → WebService →
 GraphService::init (MetaClient → waitForMetadReady → SchemaManager /
 GflagsManager / StorageClient) → serve.
 
-Deployment note: this standalone daemon serves the CPU executor path.
-The TpuQueryRuntime needs in-process access to the storage stores for
-the CSR-mirror fold, so the device path runs in embedded deployments
-(cluster.LocalCluster(tpu_backend=True) — the serving form bench.py
-and the TPU tests measure); a device-backed *storaged* answers
-getBound from HBM via the StorageService.backend seam either way.
+Deployment note: the standalone daemon serves the device path across
+the process boundary — GO / FIND PATH ship whole to the storaged that
+leads the space's parts (storage/device.py RemoteDeviceRuntime →
+storaged rpc_deviceGo), where the HBM-resident CSR mirror answers in
+one dispatch; anything the device declines falls back to the per-hop
+CPU getNeighbors loop.  Embedded deployments
+(cluster.LocalCluster(tpu_backend=True)) attach the runtime in-process
+instead.
 
 Run: ``python -m nebula_tpu.daemons.graphd --port 43699 \
       --meta_server_addrs 127.0.0.1:45500``
@@ -25,6 +27,7 @@ from ..meta.client import MetaClient
 from ..meta.gflags_manager import GflagsManager
 from ..meta.schema_manager import ServerBasedSchemaManager
 from ..storage.client import StorageClient
+from ..storage.device import RemoteDeviceRuntime
 from ..webservice import WebService
 from .common import (apply_flag_overrides, base_parser, load_flagfile,
                      parse_meta_addrs, serve_forever, write_pidfile)
@@ -44,7 +47,14 @@ def main(argv=None) -> int:
     GflagsManager(meta_client, ConfigModule.GRAPH).declare_gflags()
     schema_man = ServerBasedSchemaManager(meta_client)
     storage_client = StorageClient(meta_client, client_manager=cm)
-    engine = ExecutionEngine(meta_client, schema_man, storage_client)
+    # Device serving across the process boundary: GO / FIND PATH ship
+    # whole to the storaged that leads the space's parts
+    # (storage/device.py); declines fall back to the CPU per-hop loop.
+    # Gated by the storage_backend flag (tpu by default in the shipped
+    # conf, hot-togglable via UPDATE CONFIGS).
+    device_rt = RemoteDeviceRuntime(meta_client, schema_man, cm)
+    engine = ExecutionEngine(meta_client, schema_man, storage_client,
+                             tpu_runtime=device_rt)
     service = GraphService(engine)
     meta_client.start()
 

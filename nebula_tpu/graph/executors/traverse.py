@@ -28,6 +28,7 @@ from ...filter.expressions import (AliasPropExpr, DestPropExpr,
                                    SourcePropExpr, VariablePropExpr,
                                    encode_expr)
 from ...interface.common import schema_from_wire
+from ...storage.device import TpuDecline
 from ..interim import InterimResult
 from ..parser import ast
 from .base import ExecError, Executor
@@ -194,9 +195,12 @@ class GoExecutor(Executor):
         if rt is not None and rt.can_run_go(space, etypes, s, pushed,
                                             remnant, src_refs, dst_refs,
                                             has_input or has_var):
-            return rt.run_go(self, space, start_vids, etypes, steps,
-                             etype_to_alias, yield_cols, distinct,
-                             where_expr, edge_props, vertex_props)
+            try:
+                return rt.run_go(self, space, start_vids, etypes, steps,
+                                 etype_to_alias, yield_cols, distinct,
+                                 where_expr, edge_props, vertex_props)
+            except TpuDecline:
+                pass   # remote device runtime declined — CPU loop below
 
         # ---- input mapping (pipe/$var semantics) --------------------
         input_map: Dict[int, Dict[str, object]] = {}
@@ -775,8 +779,11 @@ class FindPathExecutor(Executor):
 
         rt = self.ectx.tpu_runtime
         if rt is not None and rt.can_run_path(space, etypes):
-            return rt.run_find_path(self, space, srcs, dsts, etypes,
-                                    max_steps, s.shortest, etype_names)
+            try:
+                return rt.run_find_path(self, space, srcs, dsts, etypes,
+                                        max_steps, s.shortest, etype_names)
+            except TpuDecline:
+                pass   # remote device runtime declined — CPU BFS below
 
         # BFS recording predecessor edges. SHORTEST keeps only edges that
         # advance depth (depth-layered DAG); ALL keeps every discovered

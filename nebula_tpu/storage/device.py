@@ -1,0 +1,167 @@
+"""Cross-process device serving — the graphd half.
+
+The reference's seam for swapping storage backends is the StorageService
+RPC surface (StorageServiceHandler.cpp:1-119).  This module is graphd's
+client for the device-backed half of that surface
+(``rpc_deviceGo`` / ``rpc_deviceFindPath``, storage/service.py): the
+standalone graphd daemon ships a WHOLE multi-hop GO (or FIND PATH) —
+encoded start vids, OVER set, WHERE and YIELD expression trees — to the
+storaged that leads every part of the space, where the HBM-resident CSR
+mirror answers it in one device dispatch (tpu/runtime.py serve_go).
+That replaces the reference's per-hop getNeighbors RPC fan-out
+(GoExecutor.cpp:334-431) with ONE round trip per query.
+
+Fallback contract: when the storaged declines (device disabled,
+non-leader, uncompilable filter, schema drift) the proxy raises
+``TpuDecline`` and the executor falls back to the per-hop CPU loop —
+the same "backend can't serve → CPU storaged path" behavior the
+reference's architecture implies (SURVEY.md §7 step 5).
+
+This module must stay jax-free: it is imported by the stateless graphd
+daemon, which never touches the device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..common.flags import flags
+from ..filter.expressions import encode_expr
+from ..graph.interim import InterimResult
+from ..interface.common import HostAddr
+from ..interface.rpc import RpcError
+
+
+class TpuDecline(Exception):
+    """The device path cannot serve this query — fall back to the CPU
+    executor loop.  Raised by both the remote proxy (this module) and
+    the storaged-side runtime (tpu/runtime.py serve_go)."""
+
+
+class DeviceExecError(Exception):
+    """A real query error on the storaged-side device path (schema
+    drift mid-query, per-row missing props under graphd WHERE
+    semantics) — maps to ExecutionResponse error, NOT a CPU fallback."""
+
+
+class RemoteDeviceRuntime:
+    """Duck-type of TpuQueryRuntime's executor-facing surface
+    (can_run_go/run_go/can_run_path/run_find_path) that delegates over
+    the StorageService RPC boundary instead of in-process stores."""
+
+    def __init__(self, meta_client, schema_man, client_manager):
+        self.meta = meta_client
+        self.sm = schema_man
+        self.cm = client_manager
+        # id(sentence) -> (pushed_mode, (host, parts)) stashed by
+        # can_run_go for the immediately following run_go
+        self._stash: Dict[int, Tuple] = {}
+
+    # ------------------------------------------------------------ placement
+    def _device_host(self, space_id: int
+                     ) -> Optional[Tuple[HostAddr, List[int]]]:
+        """The one storaged hosting EVERY part of the space (the mirror
+        fold needs the whole edge set locally), or None.  Multi-host
+        placements stay on the CPU scatter-gather path."""
+        alloc = self.meta.parts_alloc(space_id)
+        if not alloc:
+            return None
+        hosts = {h for peers in alloc.values() for h in peers}
+        if len(hosts) != 1:
+            return None
+        return HostAddr.parse(next(iter(hosts))), sorted(alloc.keys())
+
+    # ------------------------------------------------------------ rpc
+    def _call(self, host: HostAddr, method: str, req: dict,
+              ExecError) -> dict:
+        """One deviceGo/deviceFindPath round trip with the shared
+        decline/error contract: transport failure or an explicit
+        decline → TpuDecline (CPU fallback); a served-side query error
+        → ExecError."""
+        try:
+            resp = self.cm.call(host, method, req)
+        except RpcError as e:
+            # storaged down / old build without the method — CPU path
+            raise TpuDecline(f"{method} rpc failed: {e.status.msg}")
+        if not resp.get("ok"):
+            if resp.get("error"):
+                raise ExecError(resp["error"])
+            raise TpuDecline(resp.get("reason", "declined"))
+        return resp
+
+    # ------------------------------------------------------------ GO
+    def can_run_go(self, space_id: int, etypes, sentence, pushed,
+                   remnant, src_refs, dst_refs, has_input: bool) -> bool:
+        if flags.get("storage_backend") == "cpu":
+            return False
+        if has_input:      # per-root $-/$var inputs never run on device
+            return False
+        placement = self._device_host(space_id)
+        if placement is None:
+            return False
+        self._stash[id(sentence)] = (pushed is not None, placement)
+        return True
+
+    def run_go(self, executor, space_id: int, start_vids: List[int],
+               etypes: List[int], steps: int,
+               etype_to_alias: Dict[int, str], yield_cols, distinct: bool,
+               where_expr, edge_props, vertex_props) -> InterimResult:
+        from ..graph.executors.base import ExecError
+
+        pushed_mode, placement = self._stash.pop(
+            id(executor.sentence), (False, None))
+        if placement is None:
+            placement = self._device_host(space_id)
+        if placement is None:
+            raise TpuDecline("space is not single-host placed")
+        host, parts = placement
+        try:
+            yspecs = [[encode_expr(c.expr), c.alias] for c in yield_cols]
+            wblob = (encode_expr(where_expr)
+                     if where_expr is not None else None)
+        except Exception as e:      # noqa: BLE001 — unencodable AST node
+            raise TpuDecline(f"unencodable expression: {e}")
+        req = {
+            "space_id": space_id,
+            "parts": parts,
+            "start_vids": list(start_vids),
+            "etypes": list(etypes),
+            "steps": steps,
+            "etype_to_alias": {int(k): v for k, v in etype_to_alias.items()},
+            "yield": yspecs,
+            "distinct": bool(distinct),
+            "where": wblob,
+            "pushed_mode": pushed_mode,
+        }
+        resp = self._call(host, "deviceGo", req, ExecError)
+        return InterimResult(list(resp["columns"]),
+                             [list(r) for r in resp["rows"]])
+
+    # ------------------------------------------------------------ FIND PATH
+    def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
+        if flags.get("storage_backend") == "cpu":
+            return False
+        return self._device_host(space_id) is not None
+
+    def run_find_path(self, executor, space_id: int, srcs: List[int],
+                      dsts: List[int], etypes: List[int], max_steps: int,
+                      shortest: bool, etype_names: Dict[int, str]
+                      ) -> InterimResult:
+        from ..graph.executors.base import ExecError
+
+        placement = self._device_host(space_id)
+        if placement is None:
+            raise TpuDecline("space is not single-host placed")
+        host, parts = placement
+        req = {
+            "space_id": space_id,
+            "parts": parts,
+            "srcs": list(srcs),
+            "dsts": list(dsts),
+            "etypes": list(etypes),
+            "max_steps": max_steps,
+            "shortest": bool(shortest),
+            "etype_names": {int(k): v for k, v in etype_names.items()},
+        }
+        resp = self._call(host, "deviceFindPath", req, ExecError)
+        return InterimResult(list(resp["columns"]),
+                             [list(r) for r in resp["rows"]])

@@ -14,6 +14,7 @@ same results (BASELINE.json north star).
 from __future__ import annotations
 
 import concurrent.futures
+import threading
 from typing import Dict, List, Optional
 
 from ..common.flags import flags
@@ -38,9 +39,13 @@ class StorageService:
         self.pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix="storage-worker")
         self.backend = None  # TpuStorageBackend when attached
+        self._device_rt = None      # lazy TpuQueryRuntime (device serving)
+        self._device_rt_lock = threading.Lock()
         stats.register_stats("storage.get_bound.latency_us")
         stats.register_stats("storage.add.latency_us")
         stats.register_stats("storage.qps")
+        stats.register_stats("storage.device_go.qps")
+        stats.register_stats("storage.device_path.qps")
 
     # ---- ownership / leadership gate --------------------------------
     def _check_parts(self, space_id: int, part_ids) -> None:
@@ -155,6 +160,87 @@ class StorageService:
             return QueryStatsProcessor(self.kv, self.schema_man).process(r)
 
         return self._bulk(req, run)
+
+    # ---- device-backed whole-query serving ---------------------------
+    # The cross-process TpuStorageServiceHandler seam (SURVEY.md §7 step
+    # 5; reference seam StorageServiceHandler.cpp:1-119): graphd ships a
+    # whole GO / FIND PATH here (storage/device.py RemoteDeviceRuntime)
+    # and the HBM-resident CSR mirror answers it in one dispatch instead
+    # of one getBound fan-out per hop.
+    def _device_runtime(self):
+        with self._device_rt_lock:
+            if self._device_rt is None:
+                import types
+                from ..tpu.runtime import TpuQueryRuntime
+                self._device_rt = TpuQueryRuntime(
+                    [types.SimpleNamespace(kv=self.kv)], self.schema_man)
+            return self._device_rt
+
+    def _device_gate(self, space_id: int, parts) -> Optional[str]:
+        """Reason this host can't device-serve the space, or None.  The
+        mirror folds only locally-led parts, so serving is only correct
+        when this host leads EVERY part the client's meta view lists."""
+        if flags.get("storage_backend") == "cpu":
+            return "storage_backend=cpu"
+        for part_id in parts:
+            part = self.kv.part(space_id, int(part_id))
+            if part is None:
+                return f"part {part_id} not on this host"
+            if not part.is_leader():
+                return f"not leader for part {part_id}"
+        return None
+
+    def rpc_deviceGo(self, req: dict) -> dict:
+        from .device import DeviceExecError, TpuDecline
+        reason = self._device_gate(req["space_id"], req.get("parts", []))
+        if reason is not None:
+            return {"ok": False, "reason": reason}
+        try:
+            columns, rows = self._device_runtime().serve_go(
+                space_id=int(req["space_id"]),
+                start_vids=req["start_vids"],
+                etypes=req["etypes"],
+                steps=int(req["steps"]),
+                etype_to_alias={int(k): v
+                                for k, v in req["etype_to_alias"].items()},
+                yield_specs=req["yield"],
+                distinct=bool(req["distinct"]),
+                where_blob=req.get("where"),
+                pushed_mode=bool(req["pushed_mode"]))
+        except TpuDecline as d:
+            return {"ok": False, "reason": str(d)}
+        except DeviceExecError as e:
+            return {"ok": False, "error": str(e)}
+        except Exception as e:      # noqa: BLE001 — device-infra failure
+            # (jax missing/broken, HBM OOM, ...): decline so graphd's
+            # CPU per-hop loop still answers the query
+            return {"ok": False,
+                    "reason": f"device failure: {type(e).__name__}: {e}"}
+        stats.add_value("storage.device_go.qps")
+        return {"ok": True, "columns": columns, "rows": rows}
+
+    def rpc_deviceFindPath(self, req: dict) -> dict:
+        from .device import DeviceExecError, TpuDecline
+        reason = self._device_gate(req["space_id"], req.get("parts", []))
+        if reason is not None:
+            return {"ok": False, "reason": reason}
+        try:
+            columns, rows = self._device_runtime().serve_find_path(
+                space_id=int(req["space_id"]),
+                srcs=req["srcs"], dsts=req["dsts"],
+                etypes=req["etypes"], max_steps=int(req["max_steps"]),
+                shortest=bool(req["shortest"]),
+                etype_names={int(k): v
+                             for k, v in req["etype_names"].items()})
+        except TpuDecline as d:
+            return {"ok": False, "reason": str(d)}
+        except DeviceExecError as e:
+            return {"ok": False, "error": str(e)}
+        except Exception as e:      # noqa: BLE001 — device-infra failure
+            return {"ok": False,
+                    "reason": f"device failure: {type(e).__name__}: {e}"}
+        stats.add_value("storage.device_path.qps")
+        return {"ok": True, "columns": columns, "rows": rows}
 
     # ---- writes -----------------------------------------------------
     def rpc_addVertices(self, req: dict) -> dict:

@@ -191,16 +191,43 @@ class TpuQueryRuntime:
         return dev
 
     # ================================================== GO
+    def _plan_go(self, space_id: int, alias_to_etype: Dict[str, int],
+                 where_expr: Optional[Expression],
+                 pushed_mode: bool) -> Optional[_GoPlan]:
+        """Compile a GO plan against the space's current mirror, or None
+        when the device can't reproduce CPU semantics bit-for-bit.
+        Shared by the in-process executor gate (can_run_go) and the
+        cross-process RPC entry (serve_go)."""
+        try:
+            m = self.mirror(space_id)
+        except Exception:
+            return None
+        filter_cval = None
+        filter_used: Dict[str, Tuple] = {}
+        compiler = ExprCompiler(m, space_id, self.sm, alias_to_etype)
+        if where_expr is not None:
+            try:
+                filter_cval = compiler.compile(where_expr)
+            except CompileError:
+                return None
+            filter_used = dict(compiler.used)
+            if "rank" in filter_used and m._device.get("rank") is None:
+                return None
+            if compiler.div_guards and not pushed_mode:
+                # graphd-side WHERE raises ExprError on a real x/0; the
+                # device can't raise mid-jit — let the CPU path run it
+                return None
+        return _GoPlan(
+            m, alias_to_etype, filter_cval, filter_used,
+            pushed_mode=pushed_mode, compiler=compiler,
+            expr_str=(str(where_expr) if where_expr is not None else None))
+
     def can_run_go(self, space_id: int, etypes: List[int], sentence,
                    pushed: Optional[bytes], remnant: Optional[Expression],
                    src_refs, dst_refs, has_input: bool) -> bool:
         if flags.get("storage_backend") == "cpu":
             return False
         if has_input:
-            return False
-        try:
-            m = self.mirror(space_id)
-        except Exception:
             return False
         # alias map (same resolution GoExecutor did)
         alias_to_etype: Dict[str, int] = {}
@@ -218,25 +245,11 @@ class TpuQueryRuntime:
                     -r.value() if s.over.reversely else r.value()
 
         where_expr = s.where.filter if s.where else None
-        filter_cval = None
-        filter_used: Dict[str, Tuple] = {}
-        compiler = ExprCompiler(m, space_id, self.sm, alias_to_etype)
-        if where_expr is not None:
-            try:
-                filter_cval = compiler.compile(where_expr)
-            except CompileError:
-                return False
-            filter_used = dict(compiler.used)
-            if "rank" in filter_used and m._device.get("rank") is None:
-                return False
-            if compiler.div_guards and pushed is None:
-                # graphd-side WHERE raises ExprError on a real x/0; the
-                # device can't raise mid-jit — let the CPU path run it
-                return False
-        self._plans[id(sentence)] = _GoPlan(
-            m, alias_to_etype, filter_cval, filter_used,
-            pushed_mode=(pushed is not None), compiler=compiler,
-            expr_str=(str(where_expr) if where_expr is not None else None))
+        plan = self._plan_go(space_id, alias_to_etype, where_expr,
+                             pushed_mode=(pushed is not None))
+        if plan is None:
+            return False
+        self._plans[id(sentence)] = plan
         return True
 
     def run_go(self, executor, space_id: int, start_vids: List[int],
@@ -249,10 +262,56 @@ class TpuQueryRuntime:
         plan = self._plans.pop(id(s), None)
         if plan is None:   # defensive: re-prepare
             raise ExecError("TPU plan missing (can_run_go not called)")
+        columns, rows = self._execute_plan(
+            space_id, plan, start_vids, etypes, steps, etype_to_alias,
+            yield_cols, distinct, where_expr, ExecError)
+        return InterimResult(columns, rows)
+
+    def serve_go(self, space_id: int, start_vids: List[int],
+                 etypes: List[int], steps: int,
+                 etype_to_alias: Dict[int, str], yield_specs,
+                 distinct: bool, where_blob: Optional[bytes],
+                 pushed_mode: bool):
+        """storaged-side RPC half of the cross-process device path
+        (storage/service.py rpc_deviceGo → here): decode the shipped
+        WHERE/YIELD expression trees, plan against the local mirror and
+        execute.  Returns (columns, rows); raises TpuDecline when the
+        CPU path must take over, DeviceExecError for real query errors
+        (both defined jax-free in storage/device.py)."""
+        from types import SimpleNamespace
+        from ..filter.expressions import decode_expr
+        from ..storage.device import DeviceExecError, TpuDecline
+
+        try:
+            where_expr = (decode_expr(where_blob)
+                          if where_blob else None)
+            yield_cols = [SimpleNamespace(expr=decode_expr(blob),
+                                          alias=alias)
+                          for blob, alias in yield_specs]
+        except Exception as e:      # noqa: BLE001 — undecodable tree
+            raise TpuDecline(f"undecodable expression: {e}")
+        alias_to_etype = {a: et for et, a in etype_to_alias.items()}
+        plan = self._plan_go(space_id, alias_to_etype, where_expr,
+                             pushed_mode)
+        if plan is None:
+            raise TpuDecline("device cannot reproduce this query")
+        return self._execute_plan(
+            space_id, plan, start_vids, etypes, steps, etype_to_alias,
+            yield_cols, distinct, where_expr, DeviceExecError)
+
+    def _execute_plan(self, space_id: int, plan: _GoPlan,
+                      start_vids: List[int], etypes: List[int], steps: int,
+                      etype_to_alias: Dict[int, str], yield_cols,
+                      distinct: bool, where_expr, ExecError):
+        """The GO device execution core: dispatcher (or fused-kernel)
+        frontier advance, final-hop candidate assembly, WHERE filter,
+        row materialization.  ``ExecError`` is the caller's error type
+        (graphd executor's ExecError in-process, a wire-mapped error
+        for serve_go)."""
         m = plan.mirror
         columns = [c.alias or _default_col_name(c.expr) for c in yield_cols]
         if steps < 1 or not start_vids or m.m == 0:
-            return InterimResult(columns)
+            return columns, []
 
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
@@ -317,7 +376,7 @@ class TpuQueryRuntime:
                     seen.add(key)
                     out.append(r)
             rows = out
-        return InterimResult(columns, rows)
+        return columns, rows
 
     # -------------------------------------------------- host columns
     def _gather_cols(self, m: CsrMirror, alias_to_etype: Dict[str, int],
@@ -925,6 +984,20 @@ class TpuQueryRuntime:
         # --- host half: parent-DAG reconstruction -------------------
         return _reconstruct_paths(m, depth, srcs, dsts, et_tuple, max_steps,
                                   shortest, etype_names)
+
+    def serve_find_path(self, space_id: int, srcs: List[int],
+                        dsts: List[int], etypes: List[int], max_steps: int,
+                        shortest: bool, etype_names: Dict[int, str]):
+        """storaged-side RPC half of cross-process FIND PATH
+        (storage/service.py rpc_deviceFindPath).  Returns
+        (columns, rows); raises TpuDecline when the device can't serve
+        the space."""
+        from ..storage.device import TpuDecline
+        if not self.can_run_path(space_id, etypes):
+            raise TpuDecline("device path unavailable for space")
+        interim = self.run_find_path(None, space_id, srcs, dsts, etypes,
+                                     max_steps, shortest, etype_names)
+        return interim.columns, interim.rows
 
 
 # ================================================== path reconstruction

@@ -91,12 +91,14 @@ run() {
         storaged) case "$action" in
                       start) start_one storaged --port "$STORAGE_PORT" \
                           --meta_server_addrs "$META_ADDRS" \
+                          ${STORAGE_WS_PORT:+--ws_http_port "$STORAGE_WS_PORT"} \
                           --data_path "$NEBULA_DATA/storage" ;;
                       stop) stop_one storaged ;;
                       status) status_one storaged ;;
                   esac ;;
         graphd)   case "$action" in
                       start) start_one graphd --port "$GRAPH_PORT" \
+                          ${GRAPH_WS_PORT:+--ws_http_port "$GRAPH_WS_PORT"} \
                           --meta_server_addrs "$META_ADDRS" ;;
                       stop) stop_one graphd ;;
                       status) status_one graphd ;;
