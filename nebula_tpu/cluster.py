@@ -48,7 +48,14 @@ class StorageNode:
         self.meta_client = MetaClient(meta_addrs, local_host=host,
                                       send_heartbeat=True, client_manager=cm)
         self.meta_client.wait_for_metad_ready()
-        self.meta_client.heartbeat()  # register immediately
+        # register immediately — but a freshly booted metad may still be
+        # electing its catalog raft leader, so retry briefly rather than
+        # waiting a full heartbeat interval to become schedulable
+        import time as _time
+        deadline = _time.time() + 15
+        while not self.meta_client.heartbeat().ok() \
+                and _time.time() < deadline:
+            _time.sleep(0.5)
         self.schema_man = ServerBasedSchemaManager(self.meta_client)
         self.part_man = MetaServerBasedPartManager(self.meta_client, host)
         self.raft_service = None

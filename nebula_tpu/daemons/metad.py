@@ -22,17 +22,30 @@ from .common import (apply_flag_overrides, base_parser, load_flagfile,
 
 
 def build(args, cm=None):
+    import os
     cm = cm or ClientManager()
     local = f"{args.local_ip}:{args.port}"
     metas = [str(a) for a in parse_meta_addrs(args.meta_server_addrs)]
+    if local not in metas and len(metas) <= 1:
+        # a lone metad whose --meta_server_addrs was left at the default
+        # while --port moved: the catalog raft group is just us — a peer
+        # list without the local address would never elect
+        metas = [local]
+    data_path = getattr(args, "data_path", None)
+    wal_path = getattr(args, "wal_path", None)
+    if wal_path is None and data_path:
+        wal_path = os.path.join(data_path, "wal")
     raft_service = None
-    if len(metas) > 1:
-        # replicated catalog: one raft group over all metad peers
+    if len(metas) > 1 or wal_path:
+        # replicated catalog: one raft group over all metad peers.  A
+        # single metad with a wal/data path still runs raft (quorum 1) —
+        # the WAL is what replays acked DDL after a crash, exactly the
+        # reference's single-metad shape (MetaDaemon.cpp:58-78)
         from ..raftex import RaftexService
-        raft_service = RaftexService(local, cm,
-                                     wal_root=getattr(args, "wal_path", None))
+        raft_service = RaftexService(local, cm, wal_root=wal_path)
     pm = MemPartManager()
-    kv = NebulaStore(KVOptions(part_man=pm, snapshot_whole_engine=True),
+    kv = NebulaStore(KVOptions(part_man=pm, snapshot_whole_engine=True,
+                               data_paths=[data_path] if data_path else []),
                      raft_service=raft_service)
     pm.add_part(META_SPACE, META_PART, peers=metas if raft_service else None)
     service = MetaService(kv)
@@ -50,6 +63,9 @@ def build(args, cm=None):
 def main(argv=None) -> int:
     p = base_parser("nebula-metad", 45500)
     p.add_argument("--wal_path", default=None)
+    p.add_argument("--data_path", default=None,
+                   help="catalog data dir (enables the persistent "
+                        "engine + durable WAL)")
     args = p.parse_args(argv)
     load_flagfile(args.flagfile)
     apply_flag_overrides(args.flag)

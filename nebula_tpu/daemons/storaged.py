@@ -38,10 +38,17 @@ def main(argv=None) -> int:
     cm = ClientManager()
     local = f"{args.local_ip}:{args.port}"
     metas = parse_meta_addrs(args.meta_server_addrs)
+    wal_root = args.wal_path
+    if wal_root is None and args.data_path:
+        # a data path means the operator wants durability — the raft WAL
+        # must survive restarts too (it is the redo log above the disk
+        # engine's flushed runs), so default it under the data dir
+        import os
+        wal_root = os.path.join(args.data_path.split(",")[0], "wal")
     node = StorageNode(
         local, metas, cm,
         data_paths=args.data_path.split(",") if args.data_path else None,
-        use_raft=not args.no_raft, wal_root=args.wal_path)
+        use_raft=not args.no_raft, wal_root=wal_root)
     rpc = RpcServer(node.handler, host=args.local_ip,
                     port=args.port).start()
     node.start_loops()

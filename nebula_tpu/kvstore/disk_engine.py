@@ -1,0 +1,458 @@
+"""DiskEngine — persistent LSM-style KVEngine.
+
+The on-disk counterpart of MemEngine, closing round 1's "RAM-only
+storage" gap.  Capability parity with the reference's RocksEngine
+(/root/reference/src/kvstore/RocksEngine.h:94-156) at the KVEngine seam:
+point reads, batched writes, ordered prefix/range scans, range deletes,
+snapshot flush/ingest, compaction with a pluggable drop filter.
+
+Structure (one directory per engine):
+
+    MANIFEST            json: ordered run list (oldest → newest)
+    run.<n>.sst         immutable sorted frames:
+                        klen(4BE) vlen(4BE) key value   — vlen of
+                        0xFFFFFFFF marks a tombstone
+
+Writes land in a bounded memtable (SortedDict; tombstones as a
+sentinel); when it exceeds ``mem_limit_bytes`` it is flushed to a new
+run (written, fsynced, then committed by an atomic MANIFEST replace).
+Reads consult memtable first, then runs newest → oldest.  Scans k-way
+merge the memtable slice with per-run streaming cursors, newest source
+winning per key — the same shadowing RocksDB levels give.  compact()
+merges everything into a single run, applying the compaction filter and
+dropping tombstones (reference CompactionFilter seam,
+storage/CompactionFilter.h).
+
+Durability model mirrors the reference's "RocksDB WAL off" deployment
+(RocksEngineConfig.cpp rocksdb_disable_wal): the raft WAL is the redo
+log.  The engine only guarantees that whatever a committed MANIFEST
+references survives; the raft layer replays WAL entries above the
+engine's durable commit watermark (Part.durable_commit_id →
+RaftPart.cleanup_wal floor) after a crash.
+
+Run files carry a sparse in-RAM index (every ``index_every``-th key with
+its file offset), so memory stays O(keys / index_every) — the dataset
+itself lives on disk.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import struct
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from sortedcontainers import SortedDict
+
+from ..common.status import ErrorCode, Status
+from .engine import KVEngine
+
+KV = Tuple[bytes, bytes]
+_FRAME = struct.Struct(">II")     # klen, vlen
+_TOMBSTONE_LEN = 0xFFFFFFFF
+_TOMBSTONE = object()             # memtable sentinel
+
+
+class _Run:
+    """One immutable sorted run file with a sparse key index."""
+
+    __slots__ = ("path", "index_keys", "index_offs", "size")
+
+    def __init__(self, path: str, index_every: int = 64):
+        self.path = path
+        self.index_keys: List[bytes] = []
+        self.index_offs: List[int] = []
+        self.size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            off = 0
+            i = 0
+            while off + _FRAME.size <= self.size:
+                hdr = f.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    break
+                klen, vlen = _FRAME.unpack(hdr)
+                real_vlen = 0 if vlen == _TOMBSTONE_LEN else vlen
+                if off + _FRAME.size + klen + real_vlen > self.size:
+                    break                     # torn tail — ignore
+                if i % index_every == 0:
+                    key = f.read(klen)
+                    self.index_keys.append(key)
+                    self.index_offs.append(off)
+                    f.seek(real_vlen, os.SEEK_CUR)
+                else:
+                    f.seek(klen + real_vlen, os.SEEK_CUR)
+                off += _FRAME.size + klen + real_vlen
+                i += 1
+            self.size = off                   # exclude any torn tail
+
+    def _seek_offset(self, key: bytes) -> int:
+        """Largest indexed offset whose key <= key (0 if none)."""
+        i = bisect.bisect_right(self.index_keys, key) - 1
+        return self.index_offs[i] if i >= 0 else 0
+
+    def scan(self, start: bytes = b"",
+             from_offset: Optional[int] = None) -> Iterator[Tuple[bytes, object]]:
+        """Frames with key >= start; tombstones yield _TOMBSTONE."""
+        off = self._seek_offset(start) if from_offset is None else from_offset
+        with open(self.path, "rb") as f:
+            f.seek(off)
+            while off + _FRAME.size <= self.size:
+                hdr = f.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    break
+                klen, vlen = _FRAME.unpack(hdr)
+                key = f.read(klen)
+                if vlen == _TOMBSTONE_LEN:
+                    val: object = _TOMBSTONE
+                    off += _FRAME.size + klen
+                else:
+                    val = f.read(vlen)
+                    off += _FRAME.size + klen + vlen
+                if key >= start:
+                    yield key, val
+
+    def get(self, key: bytes) -> Optional[object]:
+        """value bytes, _TOMBSTONE, or None (absent in this run)."""
+        for k, v in self.scan(key):
+            if k == key:
+                return v
+            if k > key:
+                return None
+        return None
+
+
+def _merge_sources(sources: List[Iterator[Tuple[bytes, object]]]
+                   ) -> Iterator[Tuple[bytes, object]]:
+    """K-way merge, sources[0] newest; per key the newest source wins."""
+    import heapq
+    heap = []     # (key, source_rank, value, iterator)
+    for rank, it in enumerate(sources):
+        for k, v in it:
+            heap.append((k, rank, v, it))
+            break
+    heapq.heapify(heap)
+    last_key = None
+    while heap:
+        k, rank, v, it = heapq.heappop(heap)
+        if k != last_key:
+            last_key = k
+            yield k, v
+        for nk, nv in it:
+            heapq.heappush(heap, (nk, rank, nv, it))
+            break
+
+
+class DiskEngine(KVEngine):
+    def __init__(self, directory: str,
+                 compaction_filter: Optional[Callable[[bytes, bytes], bool]] = None,
+                 mem_limit_bytes: int = 8 * 1024 * 1024,
+                 index_every: int = 64,
+                 compact_after_runs: int = 16):
+        import threading
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.compaction_filter = compaction_filter
+        self.mem_limit_bytes = mem_limit_bytes
+        self.index_every = index_every
+        # auto-compaction trigger: reads probe runs newest→oldest, so an
+        # unbounded run count degrades every get(); merge once we pass
+        # this many (the WAL-floor flush emits small runs periodically)
+        self.compact_after_runs = compact_after_runs
+        self._mem: SortedDict = SortedDict()
+        self._mem_bytes = 0
+        self._runs: List[_Run] = []           # oldest → newest
+        self._next_run = 1
+        self._lock = threading.RLock()
+        self._batch_depth = 0     # >0: suppress auto-flush (write_batch)
+        self._load_manifest()
+
+    # ---- manifest ----------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, "MANIFEST")
+
+    def _load_manifest(self) -> None:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            m = json.load(f)
+        self._next_run = int(m.get("next_run", 1))
+        for name in m.get("runs", []):
+            rp = os.path.join(self.dir, name)
+            if os.path.exists(rp):
+                self._runs.append(_Run(rp, self.index_every))
+
+    def _commit_manifest(self) -> None:
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"runs": [os.path.basename(r.path)
+                                for r in self._runs],
+                       "next_run": self._next_run}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path())
+
+    # ---- memtable flush ----------------------------------------------
+    def _write_run(self, items: Iterator[Tuple[bytes, object]]) -> Optional[_Run]:
+        """Write sorted (key, value|_TOMBSTONE) items to a new fsynced
+        run file; returns the loaded _Run (None if empty)."""
+        name = f"run.{self._next_run:06d}.sst"
+        self._next_run += 1
+        path = os.path.join(self.dir, name)
+        wrote = False
+        with open(path, "wb") as f:
+            for k, v in items:
+                if v is _TOMBSTONE:
+                    f.write(_FRAME.pack(len(k), _TOMBSTONE_LEN))
+                    f.write(k)
+                else:
+                    f.write(_FRAME.pack(len(k), len(v)))
+                    f.write(k)
+                    f.write(v)
+                wrote = True
+            f.flush()
+            os.fsync(f.fileno())
+        if not wrote:
+            os.remove(path)
+            return None
+        return _Run(path, self.index_every)
+
+    def _flush_mem_locked(self) -> None:
+        if not self._mem:
+            return
+        run = self._write_run(iter(self._mem.items()))
+        if run is not None:
+            self._runs.append(run)
+            self._commit_manifest()
+        self._mem = SortedDict()
+        self._mem_bytes = 0
+        if len(self._runs) >= self.compact_after_runs:
+            self._compact_locked()
+
+    def flush_memtable(self) -> None:
+        """Persist the memtable now (used by tests and the durable
+        watermark)."""
+        with self._lock:
+            self._flush_mem_locked()
+
+    def _maybe_flush(self) -> None:
+        if self._mem_bytes >= self.mem_limit_bytes \
+                and self._batch_depth == 0:
+            self._flush_mem_locked()
+
+    def write_batch(self):
+        """Context manager: everything written inside lands in ONE
+        memtable generation — no auto-flush boundary can split the
+        batch (Part._apply uses this so the commit watermark is never
+        persisted apart from the ops it covers, the WriteBatch property
+        RocksEngine gets natively)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _batch():
+            with self._lock:
+                self._batch_depth += 1
+            try:
+                yield self
+            finally:
+                with self._lock:
+                    self._batch_depth -= 1
+                    self._maybe_flush()
+        return _batch()
+
+    # ---- reads -------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            v = self._mem.get(key, None)
+            if v is not None:                 # values are bytes (possibly
+                return None if v is _TOMBSTONE else v   # b"") or sentinel
+            runs = list(self._runs)
+        for run in reversed(runs):
+            v = run.get(key)
+            if v is not None:
+                return None if v is _TOMBSTONE else v
+        return None
+
+    def get_durable(self, key: bytes) -> Optional[bytes]:
+        """Read ONLY the flushed runs (the crash-surviving view) — the
+        raft layer uses this for its WAL-retention floor."""
+        with self._lock:
+            runs = list(self._runs)
+        for run in reversed(runs):
+            v = run.get(key)
+            if v is not None:
+                return None if v is _TOMBSTONE else v
+        return None
+
+    def _merged(self, start: bytes,
+                stop: Optional[bytes] = None) -> Iterator[Tuple[bytes, object]]:
+        with self._lock:
+            # memtable slice snapshot: bounded by [start, stop) so small
+            # point scans (per-vertex getNeighbors prefixes) don't copy
+            # the whole memtable; scans see a consistent view even under
+            # concurrent writes (stronger than MemEngine)
+            if stop is None:
+                it = self._mem.irange(minimum=start)
+            else:
+                it = self._mem.irange(minimum=start, maximum=stop,
+                                      inclusive=(True, False))
+            mem_items = [(k, self._mem[k]) for k in it]
+            runs = list(self._runs)
+        sources: List[Iterator[Tuple[bytes, object]]] = [iter(mem_items)]
+        for run in reversed(runs):            # newest first
+            sources.append(run.scan(start))
+        for k, v in _merge_sources(sources):
+            if stop is not None and k >= stop:
+                break
+            if v is not _TOMBSTONE:
+                yield k, v
+
+    @staticmethod
+    def _prefix_stop(prefix: bytes) -> Optional[bytes]:
+        """Smallest key > every key with this prefix (None = unbounded)."""
+        p = bytearray(prefix)
+        while p and p[-1] == 0xFF:
+            p.pop()
+        if not p:
+            return None
+        p[-1] += 1
+        return bytes(p)
+
+    def prefix(self, prefix: bytes) -> Iterator[KV]:
+        yield from self._merged(prefix, self._prefix_stop(prefix))
+
+    def range(self, start: bytes, end: bytes) -> Iterator[KV]:
+        yield from self._merged(start, end)
+
+    def total_keys(self) -> int:
+        return sum(1 for _ in self._merged(b""))
+
+    # ---- writes ------------------------------------------------------
+    def _put_mem(self, key: bytes, value: object) -> None:
+        old = self._mem.get(key)
+        self._mem[key] = value
+        vlen = 0 if value is _TOMBSTONE else len(value)
+        if old is None:
+            self._mem_bytes += len(key) + vlen + 32
+        else:
+            self._mem_bytes += vlen - (0 if old is _TOMBSTONE else len(old))
+
+    def put(self, key: bytes, value: bytes) -> Status:
+        with self._lock:
+            self._put_mem(key, value)
+            self._maybe_flush()
+        return Status.OK()
+
+    def multi_put(self, kvs: List[KV]) -> Status:
+        with self._lock:
+            for k, v in kvs:
+                self._put_mem(k, v)
+            self._maybe_flush()
+        return Status.OK()
+
+    def remove(self, key: bytes) -> Status:
+        with self._lock:
+            self._put_mem(key, _TOMBSTONE)
+            self._maybe_flush()
+        return Status.OK()
+
+    def multi_remove(self, keys: List[bytes]) -> Status:
+        with self._lock:
+            for k in keys:
+                self._put_mem(k, _TOMBSTONE)
+            self._maybe_flush()
+        return Status.OK()
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        doomed = [k for k, _ in self.prefix(prefix)]
+        return self.multi_remove(doomed)
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        doomed = [k for k, _ in self.range(start, end)]
+        return self.multi_remove(doomed)
+
+    # ---- files -------------------------------------------------------
+    def flush(self, path: str) -> Status:
+        """Full merged snapshot to ``path`` (MemEngine-compatible frame
+        format — raft snapshots and bulk load read these)."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for k, v in self._merged(b""):
+                f.write(_FRAME.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return Status.OK()
+
+    def ingest(self, path: str) -> Status:
+        """Bulk-load a snapshot file.  Frames must be sorted by key
+        (flush() and the SST generator both write sorted); the file
+        becomes a new run directly — RocksEngine::ingest semantics."""
+        if not os.path.exists(path):
+            return Status.Error(f"no such file {path}", ErrorCode.E_NOT_FOUND)
+
+        def frames():
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(_FRAME.size)
+                    if len(hdr) < _FRAME.size:
+                        return
+                    klen, vlen = _FRAME.unpack(hdr)
+                    k = f.read(klen)
+                    v = f.read(vlen) if vlen != _TOMBSTONE_LEN else _TOMBSTONE
+                    yield k, v
+
+        # cheap first pass: sorted files stream straight into a run;
+        # unsorted ones (hand-built snapshots) sort in memory first
+        sorted_ok = True
+        prev = None
+        for k, _ in frames():
+            if prev is not None and k <= prev:   # dup keys need last-wins
+                sorted_ok = False                # dedup too — not "sorted"
+                break
+            prev = k
+        with self._lock:
+            # shadowing: the ingested run must rank newer than the
+            # current memtable contents, so flush the memtable first
+            self._flush_mem_locked()
+            if sorted_ok:
+                run = self._write_run(frames())
+            else:
+                dedup = {}                    # file order: last wins
+                for k, v in frames():
+                    dedup[k] = v
+                run = self._write_run(iter(sorted(dedup.items())))
+            if run is not None:
+                self._runs.append(run)
+                self._commit_manifest()
+        return Status.OK()
+
+    def compact(self) -> Status:
+        """Merge memtable + every run into one, dropping tombstones and
+        filter-rejected rows (reference NebulaCompactionFilterFactory)."""
+        with self._lock:
+            self._compact_locked()
+        return Status.OK()
+
+    def _compact_locked(self) -> None:
+        cf = self.compaction_filter
+
+        def survivors():
+            for k, v in self._merged(b""):
+                if cf is not None and cf(k, v):
+                    continue
+                yield k, v
+
+        run = self._write_run(survivors())
+        old = self._runs
+        self._runs = [run] if run is not None else []
+        self._mem = SortedDict()
+        self._mem_bytes = 0
+        self._commit_manifest()
+        for r in old:
+            try:
+                os.remove(r.path)
+            except OSError:
+                pass

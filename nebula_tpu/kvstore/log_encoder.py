@@ -26,6 +26,7 @@ class LogOp(enum.IntEnum):
     OP_TRANS_LEADER = 8
     OP_ADD_PEER = 9
     OP_REMOVE_PEER = 10
+    OP_MERGE = 11
 
 
 def _write_blob(buf: bytearray, b: bytes) -> None:
@@ -41,7 +42,7 @@ def _read_blob(data: bytes, pos: int) -> Tuple[bytes, int]:
 def encode_single(op: LogOp, key: bytes, value: bytes = b"") -> bytes:
     buf = bytearray([op])
     _write_blob(buf, key)
-    if op == LogOp.OP_PUT:
+    if op in (LogOp.OP_PUT, LogOp.OP_MERGE):
         _write_blob(buf, value)
     return bytes(buf)
 
@@ -78,7 +79,7 @@ def decode(data: bytes):
     """-> (LogOp, payload) where payload matches the encoder's shape."""
     op = LogOp(data[0])
     pos = 1
-    if op in (LogOp.OP_PUT,):
+    if op in (LogOp.OP_PUT, LogOp.OP_MERGE):
         key, pos = _read_blob(data, pos)
         value, pos = _read_blob(data, pos)
         return op, (key, value)

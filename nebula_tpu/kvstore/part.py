@@ -30,11 +30,16 @@ def _commit_key(part_id: int) -> bytes:
 
 class Part:
     def __init__(self, space_id: int, part_id: int, engine: KVEngine,
-                 raft=None, snapshot_scan: Optional[Callable] = None):
+                 raft=None, snapshot_scan: Optional[Callable] = None,
+                 merge_op: Optional[Callable] = None):
         self.space_id = space_id
         self.part_id = part_id
         self.engine = engine
         self.raft = raft  # raftex.RaftPart or None (single replica)
+        # merge_op(existing: Optional[bytes], operand: bytes) -> bytes —
+        # the reference's MergeOperator seam (storage/MergeOperator.h,
+        # plugged through KVOptions like RocksDB's merge operator)
+        self.merge_op = merge_op
         # engine rows belonging to this part (for raft snapshot transfer);
         # None → whole engine (single-part spaces like metad's)
         self.snapshot_scan = snapshot_scan
@@ -46,6 +51,11 @@ class Part:
             raft.install_handler = self.install_snapshot
             raft.snapshot_source = self.snapshot_rows
             raft.cas_reader = self.engine.get
+            # WAL-retention floor: raft must keep every log above what
+            # the engine can re-serve after a crash (disk engines lag
+            # the committed id by their unflushed memtable)
+            raft.durable_floor = self.durable_commit_id
+            raft.make_durable = self.make_durable
             raft.recover(self.last_committed_log_id()[0])
 
     # ---- recovery ----------------------------------------------------
@@ -54,6 +64,26 @@ class Part:
         if raw is None or len(raw) != _COMMIT.size:
             return 0, 0
         return _COMMIT.unpack(raw)
+
+    def durable_commit_id(self) -> int:
+        """Commit watermark the engine would recover to after a crash.
+        Disk engines answer from flushed runs only; RAM engines recover
+        via raft snapshot transfer instead, so their committed id
+        stands in (pre-disk-engine behavior)."""
+        g = getattr(self.engine, "get_durable", None)
+        if g is None:
+            return self.last_committed_log_id()[0]
+        raw = g(_commit_key(self.part_id))
+        if raw is None or len(raw) != _COMMIT.size:
+            return 0
+        return _COMMIT.unpack(raw)[0]
+
+    def make_durable(self) -> None:
+        """Push the engine's volatile state to disk so the durable
+        watermark catches up (lets raft trim its WAL)."""
+        fm = getattr(self.engine, "flush_memtable", None)
+        if fm is not None:
+            fm()
 
     # ---- write api (storage processors call these) -------------------
     def put(self, key: bytes, value: bytes) -> Status:
@@ -73,6 +103,15 @@ class Part:
 
     def remove_range(self, start: bytes, end: bytes) -> Status:
         return self._submit(encode_multi(LogOp.OP_REMOVE_RANGE, (start, end)))
+
+    def merge(self, key: bytes, operand: bytes) -> Status:
+        """Read-merge-write through the log (reference MergeOperator —
+        the operand, not the merged value, is replicated, so every
+        replica applies the same deterministic merge)."""
+        if self.merge_op is None:
+            return Status.Error("no merge operator configured",
+                                ErrorCode.E_UNSUPPORTED)
+        return self._submit(encode_single(LogOp.OP_MERGE, key, operand))
 
     def cas(self, expected: bytes, key: bytes, value: bytes) -> Status:
         """Atomic compare-and-set through the log (reference CAS log type,
@@ -106,6 +145,17 @@ class Part:
         logs = [(lid, msg) for lid, _t, msg in entries if msg]
         return self._apply(logs, log_id=last_id, term=last_term)
 
+    def _batch_ctx(self):
+        """Engine write-batch context when supported (DiskEngine): the
+        whole committed batch INCLUDING the watermark lands in one
+        memtable generation, so a crash can never persist the data
+        without the watermark (or vice versa) — WAL replay then
+        re-applies exactly the unpersisted suffix, which keeps even
+        non-idempotent ops (OP_MERGE) applied exactly once."""
+        import contextlib
+        wb = getattr(self.engine, "write_batch", None)
+        return wb() if wb is not None else contextlib.nullcontext()
+
     def _apply(self, logs: List[Tuple[int, bytes]], log_id: int, term: int) -> Status:
         # Ops MUST apply in log order (a PUT then REMOVE of the same key
         # must end absent). Consecutive puts/removes coalesce into engine
@@ -122,35 +172,49 @@ class Part:
                 self.engine.multi_put(batch_put)
                 batch_put.clear()
 
-        for _lid, msg in logs:
-            op, payload = decode(msg)
-            decoded.append((op, payload))
-            if op == LogOp.OP_PUT:
-                if batch_del:
+        with self._batch_ctx():
+            for _lid, msg in logs:
+                op, payload = decode(msg)
+                decoded.append((op, payload))
+                if op == LogOp.OP_PUT:
+                    if batch_del:
+                        flush()
+                    batch_put.append(payload)
+                elif op == LogOp.OP_MULTI_PUT:
+                    if batch_del:
+                        flush()
+                    batch_put.extend(payload)
+                elif op == LogOp.OP_REMOVE:
+                    if batch_put:
+                        flush()
+                    batch_del.append(payload)
+                elif op == LogOp.OP_MULTI_REMOVE:
+                    if batch_put:
+                        flush()
+                    batch_del.extend(payload)
+                elif op == LogOp.OP_MERGE:
+                    flush()   # merge reads current state — order-sensitive
+                    if self.merge_op is None:
+                        # applying the raw operand would silently diverge
+                        # this replica from peers that merged properly
+                        raise RuntimeError(
+                            f"part {self.space_id}/{self.part_id}: "
+                            "OP_MERGE in log but no merge operator "
+                            "configured — refusing to corrupt state")
+                    k, operand = payload
+                    self.engine.put(k, self.merge_op(self.engine.get(k),
+                                                     operand))
+                elif op == LogOp.OP_REMOVE_PREFIX:
                     flush()
-                batch_put.append(payload)
-            elif op == LogOp.OP_MULTI_PUT:
-                if batch_del:
+                    self.engine.remove_prefix(payload)
+                elif op == LogOp.OP_REMOVE_RANGE:
                     flush()
-                batch_put.extend(payload)
-            elif op == LogOp.OP_REMOVE:
-                if batch_put:
-                    flush()
-                batch_del.append(payload)
-            elif op == LogOp.OP_MULTI_REMOVE:
-                if batch_put:
-                    flush()
-                batch_del.extend(payload)
-            elif op == LogOp.OP_REMOVE_PREFIX:
-                flush()
-                self.engine.remove_prefix(payload)
-            elif op == LogOp.OP_REMOVE_RANGE:
-                flush()
-                self.engine.remove_range(*payload)
-            # membership ops are handled in pre_process_log
-        flush()
-        if log_id > 0:
-            self.engine.put(_commit_key(self.part_id), _COMMIT.pack(log_id, term))
+                    self.engine.remove_range(*payload)
+                # membership ops are handled in pre_process_log
+            if log_id > 0:
+                batch_put.append((_commit_key(self.part_id),
+                                  _COMMIT.pack(log_id, term)))
+            flush()
         for listener in self.listeners:
             listener(self, decoded)
         return Status.OK()
@@ -170,13 +234,14 @@ class Part:
         """Replace this part's state with a leader snapshot (follower
         side); completes the reference's reserved snapshot path
         (raftex.thrift:109, SURVEY.md §5.4)."""
-        stale = [k for k, _v in self.snapshot_rows()]
-        if stale:
-            self.engine.multi_remove(stale)
-        if rows:
-            self.engine.multi_put(rows)
-        self.engine.put(_commit_key(self.part_id),
-                        _COMMIT.pack(log_id, term))
+        with self._batch_ctx():
+            stale = [k for k, _v in self.snapshot_rows()]
+            if stale:
+                self.engine.multi_remove(stale)
+            if rows:
+                self.engine.multi_put(rows)
+            self.engine.put(_commit_key(self.part_id),
+                            _COMMIT.pack(log_id, term))
         for listener in self.listeners:
             listener(self, [])
 

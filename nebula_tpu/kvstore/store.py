@@ -33,6 +33,10 @@ class KVOptions:
     part_man: Optional[PartManager] = None
     compaction_filter_factory: Optional[object] = None  # fn(space_id) -> filter
     engine_factory: Optional[object] = None  # fn(space, path, cf) -> KVEngine
+    # merge_op(existing: Optional[bytes], operand: bytes) -> bytes — the
+    # reference's MergeOperator option (storage/MergeOperator.h wired
+    # through KVOptions like RocksDB's merge operator)
+    merge_op: Optional[object] = None
     # raft snapshots stream the whole engine instead of the part's key
     # prefix (single-part catalogs whose keys aren't part-prefixed — metad)
     snapshot_whole_engine: bool = False
@@ -100,11 +104,17 @@ class NebulaStore:
             cf = factory(space_id)
         if self.options.engine_factory is not None:
             return self.options.engine_factory(space_id, path, cf)
-        if path:
-            os.makedirs(os.path.join(path, f"nebula_space_{space_id}"),
-                        exist_ok=True)
         from ..common.flags import flags
         kind = flags.get("storage_engine", "auto")
+        if path and kind in ("auto", "disk"):
+            # a data path means the operator wants persistence — the
+            # on-disk LSM engine (reference: RocksEngine over the
+            # configured data dirs, RocksEngine.h:94-156)
+            from .disk_engine import DiskEngine
+            return DiskEngine(os.path.join(path, f"nebula_space_{space_id}"),
+                              compaction_filter=cf)
+        if kind == "disk":
+            raise ValueError("storage_engine=disk requires a data path")
         if kind in ("auto", "native"):
             try:
                 from .native import NativeEngine
@@ -141,7 +151,8 @@ class NebulaStore:
                 snapshot_scan = (lambda _e=engine, _p=part_id:
                                  _e.prefix(KeyUtils.part_prefix(_p)))
         part = Part(space_id, part_id, engine, raft=raft,
-                    snapshot_scan=snapshot_scan)
+                    snapshot_scan=snapshot_scan,
+                    merge_op=self.options.merge_op)
         # committed-batch listener: advance the space's mutation version
         # only once the batch hit the engine (see __init__ comment)
         part.listeners.append(
@@ -238,6 +249,10 @@ class NebulaStore:
             value: bytes) -> Status:
         p, st = self._check(space_id, part_id)
         return p.cas(expected, key, value) if st.ok() else st
+
+    def merge(self, space_id, part_id, key: bytes, operand: bytes) -> Status:
+        p, st = self._check(space_id, part_id)
+        return p.merge(key, operand) if st.ok() else st
 
     # ---- maintenance -------------------------------------------------
     def compact(self, space_id: GraphSpaceID) -> Status:

@@ -19,8 +19,17 @@ import os
 import struct
 from typing import Iterator, List, Optional, Tuple
 
+from ..common.flags import flags
+
 _HDR = struct.Struct(">QQI")
 _SEGMENT_BYTES = 16 * 1024 * 1024
+
+flags.define(
+    "wal_sync", False,
+    "fsync WAL segments on every flush (power-loss durability). The "
+    "flush-to-OS itself always happens before raft acks an append, so "
+    "kill -9 / process crashes never lose acked writes either way; "
+    "fsync additionally covers kernel crashes and power loss")
 
 
 class LogEntry:
@@ -134,7 +143,11 @@ class FileBasedWal:
                 return False
         return True
 
-    def flush(self) -> None:
+    def flush(self, sync: Optional[bool] = None) -> None:
+        """Push buffered appends to the OS (and fsync when ``sync`` —
+        default: the wal_sync flag).  Raft calls this before every
+        append ack, so acked entries survive process death; fsync
+        extends that to kernel crash / power loss."""
         if not self._buf or not self.dir:
             self._buf.clear()
             return
@@ -149,6 +162,9 @@ class FileBasedWal:
             self._cur_seg_bytes = os.path.getsize(self._cur_seg_path)
         self._fh.write(self._buf)
         self._fh.flush()
+        do_sync = flags.get("wal_sync") if sync is None else sync
+        if do_sync:
+            os.fsync(self._fh.fileno())
         self._cur_seg_bytes += len(self._buf)
         self._buf.clear()
 

@@ -187,6 +187,13 @@ class RaftPart:
         with self._lock:
             self.committed_id = min(committed_id, self.wal.last_log_id()) \
                 if self.wal.last_log_id() else committed_id
+            if self.role == Role.LEADER and not self.peers \
+                    and self.wal.last_log_id() > self.committed_id:
+                # single-replica group (immediate leader, no election):
+                # every WAL entry is quorum-committed by definition, so
+                # apply the crash backlog now — the elected-leader path
+                # gets the same effect from its post-election no-op
+                self._commit_to(self.wal.last_log_id())
 
     def status(self) -> dict:
         with self._lock:
@@ -808,12 +815,37 @@ class RaftPart:
 
     def cleanup_wal(self) -> None:
         """Forget WAL entries already covered by applied state, keeping a
-        catch-up window (snapshot transfer covers peers further behind)."""
+        catch-up window (snapshot transfer covers peers further behind).
+        Never trims past the state machine's DURABLE watermark — crash
+        recovery replays the WAL from there (disk engines lag committed
+        by their unflushed memtable; Part.durable_commit_id)."""
         with self._lock:
             keep = int(flags.get("raft_wal_keep_logs"))
             # never drop the WAL's last entry: the (last_id, last_term)
             # position seeds future appends and append-consistency checks
             floor = min(self.committed_id - keep,
+                        self.wal.last_log_id() - 1)
+        if floor <= 0:
+            return
+        durable_fn = getattr(self, "durable_floor", None)
+        if durable_fn is not None:
+            durable = durable_fn()
+            if durable < floor:
+                # ask the state machine to persist so the floor can
+                # advance instead of pinning the WAL forever.  The flush
+                # (disk write + fsync) runs OUTSIDE the raft lock — a
+                # slow disk must not stall appends or delay the shared
+                # polling thread past election timeouts
+                md = getattr(self, "make_durable", None)
+                if md is not None:
+                    md()
+                    durable = durable_fn()
+            floor = min(floor, durable)
+        if floor <= 0:
+            return
+        with self._lock:
+            # re-clamp: state may have moved while we flushed unlocked
+            floor = min(floor, self.committed_id - keep,
                         self.wal.last_log_id() - 1)
             if floor > 0:
                 self.wal.clean_up_to(floor)

@@ -46,6 +46,7 @@ class StorageService:
         stats.register_stats("storage.qps")
         stats.register_stats("storage.device_go.qps")
         stats.register_stats("storage.device_path.qps")
+        stats.register_stats("storage.device_decline.qps")
 
     # ---- ownership / leadership gate --------------------------------
     def _check_parts(self, space_id: int, part_ids) -> None:
@@ -190,6 +191,23 @@ class StorageService:
                 return f"not leader for part {part_id}"
         return None
 
+    def _log_device_failure(self, method: str, exc: Exception) -> None:
+        """Rate-limited stderr log for unexpected device failures (one
+        line per distinct failure type per minute — enough signal to
+        diagnose a silently-CPU-only cluster without log flood)."""
+        import sys
+        import time as _time
+        key = (method, type(exc).__name__)
+        now = _time.time()
+        seen = getattr(self, "_device_fail_log", None)
+        if seen is None:
+            seen = self._device_fail_log = {}
+        if now - seen.get(key, 0) >= 60:
+            seen[key] = now
+            sys.stderr.write(
+                f"[storage] {method} device failure — queries fall back "
+                f"to the CPU path: {type(exc).__name__}: {exc}\n")
+
     def rpc_deviceGo(self, req: dict) -> dict:
         from .device import DeviceExecError, TpuDecline
         reason = self._device_gate(req["space_id"], req.get("parts", []))
@@ -208,12 +226,16 @@ class StorageService:
                 where_blob=req.get("where"),
                 pushed_mode=bool(req["pushed_mode"]))
         except TpuDecline as d:
+            stats.add_value("storage.device_decline.qps")
             return {"ok": False, "reason": str(d)}
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
         except Exception as e:      # noqa: BLE001 — device-infra failure
             # (jax missing/broken, HBM OOM, ...): decline so graphd's
-            # CPU per-hop loop still answers the query
+            # CPU per-hop loop still answers the query — but loudly, or
+            # a permanently broken device path would be invisible
+            self._log_device_failure("deviceGo", e)
+            stats.add_value("storage.device_decline.qps")
             return {"ok": False,
                     "reason": f"device failure: {type(e).__name__}: {e}"}
         stats.add_value("storage.device_go.qps")
@@ -233,10 +255,13 @@ class StorageService:
                 etype_names={int(k): v
                              for k, v in req["etype_names"].items()})
         except TpuDecline as d:
+            stats.add_value("storage.device_decline.qps")
             return {"ok": False, "reason": str(d)}
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
         except Exception as e:      # noqa: BLE001 — device-infra failure
+            self._log_device_failure("deviceFindPath", e)
+            stats.add_value("storage.device_decline.qps")
             return {"ok": False,
                     "reason": f"device failure: {type(e).__name__}: {e}"}
         stats.add_value("storage.device_path.qps")

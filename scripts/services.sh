@@ -84,7 +84,9 @@ run() {
     local action="$1" name="$2"
     case "$name" in
         metad)    case "$action" in
-                      start) start_one metad --port "$META_PORT" ;;
+                      start) start_one metad --port "$META_PORT" \
+                          --meta_server_addrs "$META_ADDRS" \
+                          --data_path "$NEBULA_DATA/meta" ;;
                       stop) stop_one metad ;;
                       status) status_one metad ;;
                   esac ;;

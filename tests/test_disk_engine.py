@@ -1,0 +1,405 @@
+"""DiskEngine (persistent LSM) + durability seams.
+
+Mirrors the reference's RocksEngine expectations at the KVEngine seam
+(RocksEngine.h:94-156): persistence across reopen, ordered scans over
+memtable+runs, tombstone shadowing, compaction with a drop filter,
+snapshot flush/ingest — plus the raft-WAL-retention contract
+(Part.durable_commit_id / RaftPart.cleanup_wal floor) and the
+MergeOperator seam (storage/MergeOperator.h equivalent).
+"""
+import os
+import random
+
+import pytest
+
+from nebula_tpu.common.flags import flags
+from nebula_tpu.kvstore.disk_engine import DiskEngine
+from nebula_tpu.kvstore.engine import MemEngine
+from nebula_tpu.kvstore.part import Part
+from nebula_tpu.kvstore.store import KVOptions, NebulaStore
+from nebula_tpu.kvstore.partman import MemPartManager
+from nebula_tpu.interface.common import HostAddr
+
+
+class TestDiskEngineBasics:
+    def test_crud_and_reopen(self, tmp_path):
+        d = str(tmp_path / "e")
+        e = DiskEngine(d)
+        e.put(b"a", b"1")
+        e.multi_put([(b"b", b"2"), (b"c", b"3")])
+        assert e.get(b"b") == b"2"
+        e.remove(b"a")
+        assert e.get(b"a") is None
+        e.flush_memtable()
+        # reopen: state must come back from runs alone
+        e2 = DiskEngine(d)
+        assert e2.get(b"a") is None
+        assert e2.get(b"b") == b"2"
+        assert e2.get(b"c") == b"3"
+
+    def test_unflushed_memtable_lost_on_reopen(self, tmp_path):
+        """The documented durability model: raft WAL replays what the
+        runs don't have (RocksDB-WAL-off deployment)."""
+        d = str(tmp_path / "e")
+        e = DiskEngine(d)
+        e.put(b"k", b"v")
+        e2 = DiskEngine(d)          # no flush — simulated crash
+        assert e2.get(b"k") is None
+
+    def test_tombstone_shadows_run_across_reopen(self, tmp_path):
+        d = str(tmp_path / "e")
+        e = DiskEngine(d)
+        e.put(b"k", b"v")
+        e.flush_memtable()
+        e.remove(b"k")
+        e.flush_memtable()
+        e2 = DiskEngine(d)
+        assert e2.get(b"k") is None
+        assert list(e2.prefix(b"k")) == []
+
+    def test_newer_run_wins(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"))
+        e.put(b"k", b"old")
+        e.flush_memtable()
+        e.put(b"k", b"new")
+        e.flush_memtable()
+        assert e.get(b"k") == b"new"
+        assert list(e.prefix(b"k")) == [(b"k", b"new")]
+
+    def test_memtable_shadows_runs(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"))
+        e.put(b"k", b"run")
+        e.flush_memtable()
+        e.put(b"k", b"mem")
+        assert e.get(b"k") == b"mem"
+
+    def test_prefix_range_merge_order(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"), index_every=2)
+        for i in range(0, 100, 2):
+            e.put(b"k%03d" % i, b"run")
+        e.flush_memtable()
+        for i in range(1, 100, 2):
+            e.put(b"k%03d" % i, b"mem")
+        keys = [k for k, _ in e.prefix(b"k")]
+        assert keys == [b"k%03d" % i for i in range(100)]
+        sub = list(e.range(b"k010", b"k015"))
+        assert [k for k, _ in sub] == [b"k010", b"k011", b"k012",
+                                       b"k013", b"k014"]
+
+    def test_auto_flush_on_mem_limit(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"), mem_limit_bytes=1024)
+        for i in range(200):
+            e.put(b"key%04d" % i, b"x" * 64)
+        assert len(e._runs) >= 1
+        assert e.get(b"key0000") == b"x" * 64
+        assert e.total_keys() == 200
+
+    def test_remove_prefix_and_range(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"))
+        for i in range(10):
+            e.put(b"a%d" % i, b"v")
+            e.put(b"b%d" % i, b"v")
+        e.flush_memtable()
+        e.remove_prefix(b"a")
+        e.remove_range(b"b0", b"b5")
+        assert list(e.prefix(b"a")) == []
+        assert [k for k, _ in e.prefix(b"b")] == \
+            [b"b%d" % i for i in range(5, 10)]
+
+    def test_compact_drops_tombstones_and_filtered(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"),
+                       compaction_filter=lambda k, v: k.startswith(b"ttl"))
+        e.put(b"keep", b"1")
+        e.put(b"ttl1", b"x")
+        e.put(b"dead", b"y")
+        e.flush_memtable()
+        e.remove(b"dead")
+        e.compact()
+        assert len(e._runs) == 1
+        assert e.get(b"keep") == b"1"
+        assert e.get(b"ttl1") is None
+        assert e.get(b"dead") is None
+        # reopen sees compacted state
+        e2 = DiskEngine(str(tmp_path / "e"))
+        assert e2.get(b"keep") == b"1" and e2.get(b"ttl1") is None
+
+    def test_flush_and_ingest_roundtrip(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"))
+        for i in range(20):
+            e.put(b"k%02d" % i, b"v%d" % i)
+        snap = str(tmp_path / "snap")
+        e.flush(snap)
+        e2 = DiskEngine(str(tmp_path / "e2"))
+        e2.put(b"k05", b"shadowed")     # ingest must win over memtable
+        assert e2.ingest(snap).ok()
+        assert e2.get(b"k05") == b"v5"
+        assert e2.total_keys() == 20
+
+    def test_ingest_unsorted_file(self, tmp_path):
+        mem = MemEngine()
+        # MemEngine.flush writes sorted; build an unsorted file by hand
+        import struct
+        frame = struct.Struct(">II")
+        path = str(tmp_path / "unsorted")
+        with open(path, "wb") as f:
+            for k, v in [(b"z", b"1"), (b"a", b"2"), (b"z", b"3")]:
+                f.write(frame.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+        e = DiskEngine(str(tmp_path / "e"))
+        assert e.ingest(path).ok()
+        assert e.get(b"a") == b"2"
+        assert e.get(b"z") == b"3"      # last occurrence wins
+
+    def test_get_durable_reads_runs_only(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"))
+        e.put(b"k", b"flushed")
+        e.flush_memtable()
+        e.put(b"k", b"volatile")
+        assert e.get(b"k") == b"volatile"
+        assert e.get_durable(b"k") == b"flushed"
+
+
+class TestDiskVsMemEquivalence:
+    """Randomized op sequence: DiskEngine (with aggressive auto-flush)
+    must match MemEngine on every read."""
+
+    def test_fuzz(self, tmp_path):
+        rng = random.Random(7)
+        disk = DiskEngine(str(tmp_path / "e"), mem_limit_bytes=512,
+                          index_every=4)
+        mem = MemEngine()
+        keys = [b"key%02d" % i for i in range(30)]
+        for step in range(600):
+            op = rng.random()
+            k = rng.choice(keys)
+            if op < 0.5:
+                v = b"v%d" % step
+                disk.put(k, v)
+                mem.put(k, v)
+            elif op < 0.7:
+                disk.remove(k)
+                mem.remove(k)
+            elif op < 0.8:
+                p = k[:4]
+                disk.remove_prefix(p)
+                mem.remove_prefix(p)
+            elif op < 0.9:
+                assert disk.get(k) == mem.get(k)
+            else:
+                assert list(disk.prefix(b"key1")) == \
+                    list(mem.prefix(b"key1"))
+        assert list(disk.prefix(b"")) == list(mem.prefix(b""))
+        # and across a reopen after full flush
+        disk.flush_memtable()
+        disk2 = DiskEngine(str(tmp_path / "e"))
+        assert list(disk2.prefix(b"")) == list(mem.prefix(b""))
+
+
+class TestStoreWiring:
+    def _store(self, tmp_path, merge_op=None):
+        pm = MemPartManager()
+        host = HostAddr("127.0.0.1", 44500)
+        pm.add_part(1, 1, [host])
+        st = NebulaStore(KVOptions(part_man=pm,
+                                   data_paths=[str(tmp_path / "data")],
+                                   merge_op=merge_op),
+                         local_host=host)
+        st.init()
+        return st
+
+    def test_data_path_gets_disk_engine(self, tmp_path):
+        st = self._store(tmp_path)
+        assert isinstance(st.spaces[1].engines[0], DiskEngine)
+        assert st.multi_put(1, 1, [(b"a", b"1")]).ok()
+        assert st.get(1, 1, b"a")[0] == b"1"
+
+    def test_merge_operator_seam(self, tmp_path):
+        st = self._store(
+            tmp_path,
+            merge_op=lambda cur, operand: (cur or b"") + operand)
+        assert st.merge(1, 1, b"m", b"ab").ok()
+        assert st.merge(1, 1, b"m", b"cd").ok()
+        assert st.get(1, 1, b"m")[0] == b"abcd"
+
+    def test_merge_without_operator_errors(self, tmp_path):
+        st = self._store(tmp_path)
+        assert not st.merge(1, 1, b"m", b"x").ok()
+
+
+class TestWalSync:
+    def test_wal_sync_flag_fsyncs(self, tmp_path, monkeypatch):
+        from nebula_tpu.kvstore.wal import FileBasedWal
+        calls = []
+        real_fsync = os.fsync
+        monkeypatch.setattr(os, "fsync",
+                            lambda fd: (calls.append(fd), real_fsync(fd)))
+        prev = flags.get("wal_sync")
+        flags.set("wal_sync", True)
+        try:
+            w = FileBasedWal(str(tmp_path / "wal"))
+            w.append_log(1, 1, b"x")
+            w.flush()
+            assert calls, "wal_sync=true must fsync on flush"
+            n = len(calls)
+            flags.set("wal_sync", False)
+            w.append_log(2, 1, b"y")
+            w.flush()
+            assert len(calls) == n, "wal_sync=false must not fsync"
+        finally:
+            flags.set("wal_sync", prev)
+            w.close()
+
+
+def test_kill9_storaged_recovers_acked_writes(tmp_path):
+    """The VERDICT round-1 durability criterion: boot the real
+    3-process cluster on disk engines, write through graphd, kill -9
+    both storaged and metad mid-flight, restart them, and every acked
+    write must still answer.  (Acked = raft-quorum committed; the WAL
+    flush-to-OS before each ack is what survives SIGKILL.)"""
+    import json
+    import signal
+    import subprocess
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               NEBULA_HOME=repo,
+               NEBULA_DATA=str(tmp_path / "data"),
+               NEBULA_LOGS=str(tmp_path / "logs"),
+               JAX_PLATFORMS="cpu",
+               META_PORT="45621", STORAGE_PORT="44621", GRAPH_PORT="3821",
+               EXTRA_FLAGS="--flag load_data_interval_secs=1 "
+                           "--flag wal_sync=true")
+    sh = os.path.join(repo, "scripts", "services.sh")
+
+    def run_sh(*argv, timeout=420):
+        with open(tmp_path / "sh.log", "a") as lf:
+            p = subprocess.Popen(["bash", sh, *argv], env=env,
+                                 stdout=lf, stderr=lf,
+                                 stdin=subprocess.DEVNULL)
+            assert p.wait(timeout=timeout) == 0, \
+                (tmp_path / "sh.log").read_text()
+
+    # sweep leaked daemons from previous timed-out runs
+    ps = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                        text=True).stdout
+    for line in ps.splitlines():
+        if "nebula_tpu.daemons" in line and ("45621" in line
+                                             or "44621" in line
+                                             or "3821" in line):
+            try:
+                os.kill(int(line.split()[0]), signal.SIGKILL)
+            except (ProcessLookupError, ValueError, PermissionError):
+                pass
+
+    run_sh("start", "all")
+    try:
+        from nebula_tpu.clients.graph_client import GraphClient
+        from nebula_tpu.interface.common import HostAddr
+        from nebula_tpu.interface.rpc import ClientManager
+        c = GraphClient(HostAddr("127.0.0.1", 3821),
+                        client_manager=ClientManager())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if c.connect().ok():
+                break
+            time.sleep(0.5)
+        assert c.execute("CREATE SPACE IF NOT EXISTS "
+                         "dur(partition_num=2, replica_factor=1)").ok()
+        time.sleep(2.5)
+        assert c.execute("USE dur; CREATE EDGE e(w int)").ok()
+        time.sleep(2.5)
+        acked = []
+        for i in range(50):
+            r = c.execute(f"USE dur; INSERT EDGE e(w) VALUES 1->{i + 10}:({i})")
+            assert r.ok(), r.error_msg
+            acked.append(i + 10)
+
+        # SIGKILL storaged AND metad mid-life (no graceful shutdown)
+        for name in ("storaged", "metad"):
+            pid = int((tmp_path / "data" / f"nebula-{name}.pid").read_text())
+            os.kill(pid, signal.SIGKILL)
+        time.sleep(1)
+        run_sh("start", "metad")
+        run_sh("start", "storaged")
+        # storaged re-registers + graphd cache refreshes (1s interval)
+        time.sleep(6)
+
+        # generous window: restarted storaged cold-starts jax + rebuilds
+        # the CSR mirror on its first device query, and each timed-out
+        # RPC attempt burns its full 30s budget
+        r = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            r = c.execute("USE dur; GO FROM 1 OVER e YIELD e._dst")
+            if r.ok() and len(r.rows) == len(acked):
+                break
+            time.sleep(1)
+        assert r is not None and r.ok(), getattr(r, "error_msg", "no resp")
+        assert sorted(x[0] for x in r.rows) == sorted(acked), \
+            f"lost {set(acked) - {x[0] for x in r.rows}}"
+    finally:
+        with open(tmp_path / "stop.log", "w") as lf:
+            subprocess.Popen(["bash", sh, "stop", "all"], env=env,
+                             stdout=lf, stderr=lf,
+                             stdin=subprocess.DEVNULL).wait(timeout=60)
+
+
+class TestBatchAtomicity:
+    def test_auto_compaction_bounds_run_count(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"), compact_after_runs=4)
+        for i in range(20):
+            e.put(b"k%02d" % i, b"v")
+            e.flush_memtable()
+        assert len(e._runs) < 4
+        assert e.total_keys() == 20
+
+    def test_write_batch_suppresses_flush_boundary(self, tmp_path):
+        e = DiskEngine(str(tmp_path / "e"), mem_limit_bytes=64)
+        with e.write_batch():
+            e.put(b"big", b"x" * 256)     # over limit — must NOT flush yet
+            assert len(e._runs) == 0
+            e.put(b"mark", b"m")
+        assert len(e._runs) == 1          # one run holding BOTH keys
+        e2 = DiskEngine(str(tmp_path / "e"))
+        assert e2.get(b"big") == b"x" * 256 and e2.get(b"mark") == b"m"
+
+    def test_merge_replay_exactly_once_across_crash(self, tmp_path):
+        """The watermark is batched with the ops it covers, so crash
+        replay applies a non-idempotent merge exactly once."""
+        import struct
+        count_op = lambda cur, operand: struct.pack(
+            ">q", struct.unpack(">q", cur or b"\0" * 8)[0]
+            + struct.unpack(">q", operand)[0])
+
+        def make_part(d):
+            eng = DiskEngine(d, mem_limit_bytes=64)   # flush mid-batch
+            return Part(1, 1, eng, merge_op=count_op), eng
+
+        part, eng = make_part(str(tmp_path / "e"))
+        ops = []
+        # build one committed batch: big put (crosses mem limit) + merge
+        from nebula_tpu.kvstore.log_encoder import (LogOp, encode_multi,
+                                                    encode_single)
+        logs = [
+            (1, encode_single(LogOp.OP_PUT, b"pad", b"x" * 256)),
+            (2, encode_single(LogOp.OP_MERGE, b"ctr", struct.pack(">q", 5))),
+        ]
+        part._apply(logs, log_id=2, term=1)
+        assert struct.unpack(">q", eng.get(b"ctr"))[0] == 5
+        # crash: reopen from runs only (memtable dropped)
+        part2, eng2 = make_part(str(tmp_path / "e"))
+        durable = part2.durable_commit_id()
+        if durable < 2:
+            # replay the suffix the WAL would re-deliver
+            part2._apply(logs[durable:], log_id=2, term=1)
+        assert struct.unpack(">q", eng2.get(b"ctr"))[0] == 5, \
+            "merge must not double-apply on replay"
+
+    def test_merge_without_op_refuses(self, tmp_path):
+        from nebula_tpu.kvstore.log_encoder import LogOp, encode_single
+        part = Part(1, 1, DiskEngine(str(tmp_path / "e")))
+        import struct
+        with pytest.raises(RuntimeError):
+            part._apply([(1, encode_single(LogOp.OP_MERGE, b"k", b"v"))],
+                        log_id=1, term=1)
