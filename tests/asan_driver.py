@@ -1,0 +1,84 @@
+"""Lean native-ABI exerciser for the ASAN/UBSAN build.
+
+Run by tests/test_native.py::test_native_suite_under_asan inside an
+instrumented process (LD_PRELOAD=libasan, NEBULA_NATIVE_SO pointing at
+the `make asan` artifact).  Deliberately avoids pytest and jax device
+work — the instrumented interpreter makes those minutes-slow — while
+still driving every native entry point: engine CRUD/scans/snapshot
+ingest (fuzzed against MemEngine), the batch column decoder, and the
+C++ ELL builder.
+"""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from nebula_tpu.codec.rows import encode_row
+from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+from nebula_tpu.kvstore.engine import MemEngine
+from nebula_tpu.kvstore.native import NativeEngine
+from nebula_tpu.native import available, batch
+from nebula_tpu.tpu.ell import EllIndex
+
+
+def main(tmp_dir: str) -> None:
+    assert available(), "native lib did not load under ASAN"
+
+    # engine: fuzz CRUD + scans against MemEngine
+    rng = random.Random(3)
+    e, m = NativeEngine(), MemEngine()
+    keys = [b"k%02d" % i for i in range(40)]
+    for step in range(2000):
+        k = rng.choice(keys)
+        roll = rng.random()
+        if roll < 0.5:
+            v = bytes(rng.getrandbits(8)
+                      for _ in range(rng.randrange(0, 64)))
+            e.put(k, v)
+            m.put(k, v)
+        elif roll < 0.7:
+            e.remove(k)
+            m.remove(k)
+        elif roll < 0.8:
+            e.remove_prefix(k[:2])
+            m.remove_prefix(k[:2])
+        else:
+            assert e.get(k) == m.get(k)
+    assert list(e.prefix(b"")) == list(m.prefix(b""))
+    snap = os.path.join(tmp_dir, "snap")
+    e.flush(snap)
+    e2 = NativeEngine()
+    e2.ingest(snap)
+    assert list(e2.prefix(b"")) == list(m.prefix(b""))
+
+    # batch codec over the ABI (decode_field + parse_keys)
+    schema = Schema(columns=[ColumnDef("a", SupportedType.INT),
+                             ColumnDef("s", SupportedType.STRING)])
+    rows = [encode_row(schema, {"a": i, "s": "x" * (i % 7)})
+            for i in range(500)]
+    blob, offs, lens = batch.concat_blobs(rows)
+    cols = batch.decode_field(blob, offs, lens, schema, 0)
+    if cols is not None:
+        assert [int(v) for v in cols.i64[:500]] == list(range(500))
+    from nebula_tpu.common.keys import KeyUtils
+    ekeys = [KeyUtils.edge_key(1, s, 7, 0, d, 5)
+             for s, d in [(1, 2), (3, 4), (5, 6)]]
+    kb, ko, kl = batch.concat_blobs(ekeys)
+    parsed = batch.parse_keys(kb, ko, kl)
+    if parsed is not None:
+        assert [int(x) for x in parsed.a[:3]] == [1, 3, 5]
+
+    # C++ ELL builder
+    es = np.asarray(rng.choices(range(64), k=600), dtype=np.int32)
+    ed = np.asarray(rng.choices(range(64), k=600), dtype=np.int32)
+    ee = np.ones(600, np.int32)
+    ix = EllIndex.build(es, ed, ee, 64)
+    assert ix.n == 64
+    print("ASAN DRIVER OK")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp")

@@ -233,3 +233,45 @@ class TestNativeEngineInStore:
         kv.put(1, 1, b"k", b"v")
         got, st = kv.get(1, 1, b"k")
         assert st.ok() and got == b"v"
+
+
+def test_native_suite_under_asan(tmp_path):
+    """Exercise the full native C ABI (engine CRUD/scan/ingest, batch
+    codec, ELL builder) under the ASAN+UBSAN build (reference
+    ENABLE_ASAN + SanitizerOptions.cpp:8-50 spirit): any heap overflow
+    or UB at the ctypes boundary aborts the run.  Runs the lean
+    asan_driver.py script, not pytest — the instrumented interpreter is
+    too slow for the whole suite."""
+    import shutil
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    if shutil.which("g++") is None or shutil.which("gcc") is None:
+        pytest.skip("no g++/gcc")
+    libasan = subprocess.run(
+        ["gcc", "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("no libasan")
+    r = subprocess.run(["make", "-C", native, "asan"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    env = dict(
+        os.environ,
+        LD_PRELOAD=libasan,
+        NEBULA_NATIVE_SO=os.path.join(native, "libnebula_native_asan.so"),
+        JAX_PLATFORMS="cpu",
+        # reference SanitizerOptions.cpp defaults; leak check off — the
+        # Python interpreter itself reports benign leaks at exit
+        ASAN_OPTIONS=("strict_init_order=true:"
+                      "detect_stack_use_after_return=true:"
+                      "detect_container_overflow=true:detect_leaks=0"),
+        UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tests", "asan_driver.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300)
+    assert r.returncode == 0, f"ASAN run failed:\n{r.stdout}\n{r.stderr}"
+    assert "ASAN DRIVER OK" in r.stdout
+    assert "AddressSanitizer" not in r.stderr, r.stderr
