@@ -45,6 +45,7 @@ class StorageNode:
                  cm: ClientManager, data_paths: Optional[List[str]] = None,
                  use_raft: bool = False, wal_root: Optional[str] = None):
         self.host = host
+        self.data_paths = data_paths or []
         self.meta_client = MetaClient(meta_addrs, local_host=host,
                                       send_heartbeat=True, client_manager=cm)
         self.meta_client.wait_for_metad_ready()
@@ -120,7 +121,11 @@ class LocalCluster:
             self.meta_service.rpc_heartBeat({"host": node_host})
             node = StorageNode(
                 node_host, [self.meta_addr], self.cm,
-                data_paths=data_paths, use_raft=use_raft,
+                # per-node subdirs: nodes must never share an engine
+                # directory (the disk engine's manifest is single-owner)
+                data_paths=([f"{p}/{i}" for p in data_paths]
+                            if data_paths else None),
+                use_raft=use_raft,
                 wal_root=(f"{wal_root}/{i}" if wal_root else None))
             if use_tcp:
                 srv.handler = node.handler

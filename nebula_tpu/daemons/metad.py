@@ -81,6 +81,8 @@ def main(argv=None) -> int:
     ws.register_handler(
         "/balance", lambda q, b: (200, service.rpc_balance(
             {k: v for k, v in q.items() if not k.startswith("__")})))
+    from ..meta.http_dispatch import register_dispatch_handlers
+    register_dispatch_handlers(ws, service)
     sys.stderr.write(f"metad serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

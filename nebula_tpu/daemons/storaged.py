@@ -55,15 +55,11 @@ def main(argv=None) -> int:
 
     ws = WebService("nebula-storaged", host=args.local_ip,
                     port=args.ws_http_port).start()
-    ws.register_handler(
-        "/admin", lambda q, b: (200, node.service.rpc_raftPartStatus({})))
-    ws.register_handler(
-        "/ingest", lambda q, b: (200, {"ok": node.kv.ingest(
-            int(q.get("space", 0)),
-            q.get("path", "").split(",")).ok()}))
-    ws.register_handler(
-        "/download", lambda q, b: (200, {"error": "use local paths with "
-                                         "/ingest (no HDFS in this build)"}))
+    from ..storage.web import register_web_handlers
+    register_web_handlers(ws, node)
+    # advertise the web port to metad so /ingest-dispatch can reach us
+    node.meta_client.hb_info["ws_port"] = ws.port
+    node.meta_client.heartbeat()
     sys.stderr.write(f"storaged serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

@@ -55,6 +55,7 @@ class MetaClient:
         self.cm = client_manager or default_client_manager
         self.listener: Optional[MetaChangedListener] = None
         self.cluster_id = 0
+        self.hb_info: dict = {}   # advertised in heartbeats (ws_port...)
         self.last_update_time = -1
 
         self._cache_lock = threading.RLock()
@@ -136,8 +137,11 @@ class MetaClient:
     def heartbeat(self) -> Status:
         if not self.local_host:
             return Status.Error("no local host for heartbeat")
-        r = self._call_status("heartBeat", {"host": self.local_host,
-                                            "cluster_id": self.cluster_id})
+        payload = {"host": self.local_host, "cluster_id": self.cluster_id}
+        if self.hb_info:
+            # daemon-advertised metadata (ws_port for bulk-load dispatch)
+            payload["info"] = dict(self.hb_info)
+        r = self._call_status("heartBeat", payload)
         if r.ok():
             self.cluster_id = r.value().get("cluster_id", self.cluster_id)
             # cheap change detection (reference uses last_update_time the

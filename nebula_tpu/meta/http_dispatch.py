@@ -1,0 +1,72 @@
+"""metad bulk-load dispatch — fan /download and /ingest out to every
+storaged (reference MetaHttpDownloadHandler.cpp /
+MetaHttpIngestHandler.cpp, SURVEY.md §2.8):
+
+  GET /download-dispatch?space=N&url=file:///dir
+  GET /ingest-dispatch?space=N
+
+Each active storage host advertises its web port in its heartbeat info
+(MetaClient.hb_info → ActiveHostsMan), so the dispatcher addresses
+``http://<host-ip>:<ws_port>/download|ingest`` directly — the same
+discovery the reference does through its stored host metadata.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from urllib.parse import quote
+
+
+def _fan_out(service, path_fn) -> dict:
+    """GET path_fn(ip, ws_port) on every ACTIVE host, concurrently
+    (per-host latency is max, not sum — a blackholed host costs one
+    timeout, not the whole dispatch); aggregate per-host results."""
+    import concurrent.futures
+
+    all_hosts = service.active_hosts.hosts()
+    # only hosts with a live heartbeat — stale records of dead or
+    # decommissioned storaged would fail (or hang) every dispatch
+    live = service.active_hosts.active_hosts()
+    hosts = {h: all_hosts[h] for h in live if h in all_hosts}
+    if not hosts:
+        return {"ok": False, "error": "no active storage hosts"}
+
+    def one(host, rec):
+        ws_port = rec.get("ws_port")
+        if not ws_port:
+            return host, {"ok": False,
+                          "error": "host did not advertise ws_port"}
+        ip = host.rsplit(":", 1)[0]
+        try:
+            with urllib.request.urlopen(path_fn(ip, ws_port),
+                                        timeout=120) as resp:
+                return host, json.loads(resp.read())
+        except Exception as e:      # noqa: BLE001 — per-host failure
+            return host, {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"}
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(hosts))) as pool:
+        results = dict(pool.map(lambda kv: one(*kv), sorted(hosts.items())))
+    ok = all(r.get("ok", False) for r in results.values())
+    return {"ok": ok, "hosts": results}
+
+
+def register_dispatch_handlers(ws, service) -> None:
+    """Wire /download-dispatch and /ingest-dispatch onto metad's
+    WebService (daemons/metad.py and the in-process test cluster)."""
+
+    def download(q, b):
+        space = int(q.get("space", 0))
+        url = q.get("url", "")
+        return (200, _fan_out(service, lambda ip, p: (
+            f"http://{ip}:{p}/download?space={space}"
+            f"&url={quote(url, safe='')}")))
+
+    def ingest(q, b):
+        space = int(q.get("space", 0))
+        return (200, _fan_out(service, lambda ip, p: (
+            f"http://{ip}:{p}/ingest?space={space}")))
+
+    ws.register_handler("/download-dispatch", download)
+    ws.register_handler("/ingest-dispatch", ingest)

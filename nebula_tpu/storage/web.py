@@ -1,0 +1,89 @@
+"""storaged web handlers — /status is WebService-builtin; this module
+adds the bulk-load pair the reference serves from storaged's proxygen
+server (StorageHttpDownloadHandler / StorageHttpIngestHandler,
+StorageServer.cpp:60-89):
+
+  GET /download?space=N&url=file:///dir   stage bulk-load files locally
+  GET /ingest?space=N[&path=a,b]          ingest staged (or explicit)
+                                          snapshot files into the space
+  GET /admin                              raft part status
+
+The reference's /download shells out to ``hdfs dfs -get``; this build
+has no HDFS, so the transfer half accepts ``file://`` source
+directories (shared filesystem — the common on-prem layout) and plain
+local paths.  Everything else — staging dir per space, separate
+download/ingest phases, meta-side fan-out (meta/http_dispatch.py) —
+matches the reference flow.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+from urllib.parse import urlparse
+
+
+def _staging_dir(node, space_id: int) -> str:
+    root = (node.data_paths[0] if getattr(node, "data_paths", None)
+            else os.path.join(os.path.expanduser("~"), ".nebula_tpu"))
+    # node-qualified: co-located storaged sharing a data root must not
+    # share staging (each would re-ingest the others' files)
+    node_tag = str(getattr(node, "host", "local")).replace(":", "_")
+    d = os.path.join(root, "download", node_tag, f"space_{space_id}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _download(node, space_id: int, url: str) -> dict:
+    p = urlparse(url)
+    if p.scheme not in ("", "file"):
+        return {"ok": False,
+                "error": f"unsupported url scheme {p.scheme!r} "
+                         "(file:// or local path)"}
+    src = p.path if p.scheme == "file" else url
+    if not os.path.isdir(src):
+        return {"ok": False, "error": f"no such directory {src}"}
+    dest = _staging_dir(node, space_id)
+    copied = []
+    for name in sorted(os.listdir(src)):
+        full = os.path.join(src, name)
+        if os.path.isfile(full):
+            shutil.copy2(full, os.path.join(dest, name))
+            copied.append(name)
+    return {"ok": True, "staged": copied, "dest": dest}
+
+
+def _ingest(node, space_id: int, path: Optional[str]) -> dict:
+    staged = path is None
+    if path:
+        files = path.split(",")
+    else:
+        dest = _staging_dir(node, space_id)
+        files = [os.path.join(dest, n) for n in sorted(os.listdir(dest))
+                 if os.path.isfile(os.path.join(dest, n))]
+    if not files:
+        return {"ok": False, "error": "nothing staged to ingest"}
+    st = node.kv.ingest(space_id, files)
+    if st.ok() and staged:
+        # consume the staging area — a later dispatch must not silently
+        # re-ingest superseded snapshots
+        for f in files:
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+    return {"ok": st.ok(), "files": len(files),
+            **({} if st.ok() else {"error": st.msg})}
+
+
+def register_web_handlers(ws, node) -> None:
+    """Wire the storaged handlers onto a WebService (shared by
+    daemons/storaged.py and the in-process test clusters)."""
+    ws.register_handler(
+        "/admin", lambda q, b: (200, node.service.rpc_raftPartStatus({})))
+    ws.register_handler(
+        "/download", lambda q, b: (200, _download(
+            node, int(q.get("space", 0)), q.get("url", ""))))
+    ws.register_handler(
+        "/ingest", lambda q, b: (200, _ingest(
+            node, int(q.get("space", 0)), q.get("path"))))

@@ -86,6 +86,7 @@ run() {
         metad)    case "$action" in
                       start) start_one metad --port "$META_PORT" \
                           --meta_server_addrs "$META_ADDRS" \
+                          ${META_WS_PORT:+--ws_http_port "$META_WS_PORT"} \
                           --data_path "$NEBULA_DATA/meta" ;;
                       stop) stop_one metad ;;
                       status) status_one metad ;;

@@ -442,3 +442,80 @@ def test_services_sh_cluster(tmp_path):
             subprocess.Popen(["bash", sh, "stop", "all"], env=env,
                              stdout=lf, stderr=lf,
                              stdin=subprocess.DEVNULL).wait(timeout=60)
+
+
+def test_meta_dispatched_bulk_load(tmp_path):
+    """metad /download-dispatch + /ingest-dispatch fan bulk-load files
+    out to EVERY storaged's web endpoints (reference
+    MetaHttpDownloadHandler/MetaHttpIngestHandler): two storage nodes
+    each stage from a shared source dir and ingest, and the loaded
+    edges answer a real GO afterwards."""
+    import struct
+    from nebula_tpu.common.clock import inverted_version
+    from nebula_tpu.common.keys import KeyUtils, id_hash
+    from nebula_tpu.codec.rows import encode_row
+    from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+    from nebula_tpu.meta.http_dispatch import register_dispatch_handlers
+    from nebula_tpu.storage.web import register_web_handlers
+
+    c = LocalCluster(num_storage=2, use_tcp=True,
+                     data_paths=[str(tmp_path / "data")])
+    web_services = []
+    try:
+        client = c.client()
+        assert client.execute("CREATE SPACE bulk(partition_num=4, "
+                              "replica_factor=1)").ok()
+        c.refresh_all()
+        assert client.execute("USE bulk; CREATE EDGE e(w int)").ok()
+        c.refresh_all()
+        space_id = c.graph_meta_client.get_space_id_by_name("bulk").value()
+        etype = c.graph_meta_client.get_edge_type(space_id, "e").value()
+
+        # per-node web services + ws_port registration via heartbeat info
+        for node in c.storage_nodes:
+            ws = WebService("storaged-test", host="127.0.0.1").start()
+            register_web_handlers(ws, node)
+            web_services.append(ws)
+            node.meta_client.hb_info["ws_port"] = ws.port
+            node.meta_client.heartbeat()
+        meta_ws = WebService("metad-test", host="127.0.0.1").start()
+        web_services.append(meta_ws)
+        register_dispatch_handlers(meta_ws, c.meta_service)
+
+        # build a bulk-load snapshot: 40 edges 1 -> (100..139)
+        schema = Schema(columns=[ColumnDef("w", SupportedType.INT)])
+        frame = struct.Struct(">II")
+        src_dir = tmp_path / "bulk_src"
+        src_dir.mkdir()
+        kvs = []
+        for i in range(40):
+            part = id_hash(1, 4)
+            key = KeyUtils.edge_key(part, 1, etype, 0, 100 + i,
+                                    inverted_version())
+            kvs.append((key, encode_row(schema, {"w": i})))
+        kvs.sort()
+        with open(src_dir / "edges.snap", "wb") as f:
+            for k, v in kvs:
+                f.write(frame.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+
+        def get(url):
+            return json.loads(urllib.request.urlopen(url, timeout=60).read())
+
+        base = f"http://127.0.0.1:{meta_ws.port}"
+        r = get(f"{base}/download-dispatch?space={space_id}"
+                f"&url=file://{src_dir}")
+        assert r["ok"], r
+        assert len(r["hosts"]) == 2
+        r = get(f"{base}/ingest-dispatch?space={space_id}")
+        assert r["ok"], r
+
+        resp = client.execute("USE bulk; GO FROM 1 OVER e YIELD e._dst")
+        assert resp.ok(), resp.error_msg
+        assert sorted(x[0] for x in resp.rows) == [100 + i
+                                                   for i in range(40)]
+    finally:
+        for ws in web_services:
+            ws.stop()
+        c.stop()
