@@ -52,6 +52,15 @@ flags.define("raft_snapshot_rows_per_chunk", 4096,
              "rows per sendSnapshot RPC chunk")
 flags.define("raft_wal_keep_logs", 10000,
              "WAL entries to keep after a snapshot-eligible cleanup")
+flags.define("raft_pipeline_depth", 4,
+             "max concurrently replicating append batches per part "
+             "(reference Host request pipelining, Host.h:26-118); 1 = "
+             "round 1's one-batch-in-flight behavior")
+flags.define("raft_reorder_wait_s", 0.05,
+             "follower hold-back for out-of-order pipelined appends: "
+             "wait this long for the preceding batch before answering "
+             "E_LOG_GAP (pipelined batches ride parallel connections, "
+             "so arrival order is not send order)")
 
 
 class Role:
@@ -98,6 +107,9 @@ class RaftPart:
         self.cm = client_manager
         self.executor = executor
         self._lock = threading.RLock()
+        # signaled whenever the WAL tail advances — pipelined appends
+        # arriving out of order wait here for the gap to fill
+        self._wal_advanced = threading.Condition(self._lock)
         self.wal = FileBasedWal(wal_dir)
 
         self.role = Role.LEARNER if as_learner else Role.FOLLOWER
@@ -124,7 +136,7 @@ class RaftPart:
         self.snapshot_source: Optional[Callable] = None   # snapshot rows
 
         self._pending: List[Tuple[bytes, _Waiter]] = []
-        self._replicating = False
+        self._driving = 0     # concurrent batch drivers (pipelining)
         self._electing = False
         self._stopped = False
         self._snap_rows: List[Tuple[bytes, bytes]] = []
@@ -248,18 +260,50 @@ class RaftPart:
 
     # ==================================================== batch driver
     def _drive(self) -> None:
+        """Pull pending appends into WAL-ordered batches and replicate.
+
+        Up to ``raft_pipeline_depth`` driver threads run concurrently —
+        driver B builds and ships batch N+1 while driver A still awaits
+        batch N's quorum (the reference pipelines the same way through
+        Host's cachingPromise_/pendingReq_, Host.h:26-118).  Safety:
+        batches are WAL-appended under the lock (ordered ids), the
+        follower handler skips same-term duplicates and repairs gaps
+        from the leader WAL (so out-of-order arrival costs one catch-up
+        round, never correctness), and _commit_to is monotonic under
+        the lock — a later batch's quorum commits earlier batches too
+        (its append-consistency ack implies the follower holds them)."""
         with self._lock:
-            if self._replicating:
+            depth = max(1, int(flags.get("raft_pipeline_depth") or 1))
+            if self._driving >= depth:
                 return
-            self._replicating = True
+            self._driving += 1
         try:
             while True:
                 with self._lock:
                     if not self._pending or self.role != Role.LEADER \
                             or self._stopped:
                         break
-                    batch = self._pending
-                    self._pending = []
+                    # CAS evaluates against APPLIED state, so with
+                    # pipelining it must wait until every in-flight
+                    # batch has applied (they are WAL-appended first) —
+                    # else the compare could see a stale value.  A CAS
+                    # runs as its own single-op batch; ops queued behind
+                    # other ops keep pipelining.
+                    first_cas = next(
+                        (i for i, (log, _w) in enumerate(self._pending)
+                         if isinstance(log, tuple)), None)
+                    if first_cas == 0:
+                        if self.wal.last_log_id() > self.committed_id:
+                            self._wal_advanced.wait(0.05)
+                            continue
+                        batch = self._pending[:1]
+                        self._pending = self._pending[1:]
+                    elif first_cas is not None:
+                        batch = self._pending[:first_cas]
+                        self._pending = self._pending[first_cas:]
+                    else:
+                        batch = self._pending
+                        self._pending = []
                     term = self.term
                     prev_id = self.wal.last_log_id()
                     prev_term = self.wal.last_log_term()
@@ -295,6 +339,10 @@ class RaftPart:
                 with self._lock:
                     if ok and self.role == Role.LEADER and self.term == term:
                         self._commit_to(entries[-1].log_id)
+                    if self.term == term \
+                            and self.committed_id >= entries[-1].log_id:
+                        # committed — by our own quorum or by a later
+                        # pipelined batch's (which covers ours)
                         st = Status.OK()
                     elif self.role != Role.LEADER:
                         st = self._not_leader()
@@ -305,7 +353,7 @@ class RaftPart:
                     w.set(st)
         finally:
             with self._lock:
-                self._replicating = False
+                self._driving -= 1
                 again = bool(self._pending) and self.role == Role.LEADER
             if again:
                 self.executor.submit(self._drive)
@@ -362,26 +410,61 @@ class RaftPart:
                         committed: int, max_rounds: int = 64) -> bool:
         """One conversation with one peer: append, then walk back through
         gaps/divergence (reference Host::appendLogs request pipelining +
-        WAL catch-up), falling to snapshot when the WAL no longer reaches."""
+        WAL catch-up), falling to snapshot when the WAL no longer reaches.
+
+        The first, optimistic send goes WITHOUT the conversation lock so
+        pipelined batches ride parallel connections concurrently — the
+        follower's reorder hold-back restores log order.  Only the
+        catch-up walk serializes on peer.lock (two threads walking the
+        same peer's history would duplicate work)."""
+        payload = {
+            "space": self.space_id, "part": self.part_id,
+            "term": term, "leader": self.addr, "committed": committed,
+            "prev_id": prev_id, "prev_term": prev_term,
+            "entries": [[e.log_id, e.term, e.msg] for e in entries],
+        }
+        try:
+            resp = self.cm.call(HostAddr.parse(peer.addr),
+                                "raftAppendLog", payload)
+        except Exception:                # noqa: BLE001 — peer down
+            return False
+        code = resp.get("code", int(ErrorCode.E_INTERNAL_ERROR))
+        if code == 0:
+            # advance match only to the index this round VERIFIED
+            # (prev check + entries); the follower's reported tail may
+            # include a divergent suffix we have not examined
+            verified = entries[-1].log_id if entries else prev_id
+            peer.match_id = max(peer.match_id, verified)
+            return True
+        if code == int(ErrorCode.E_TERM_OUT_OF_DATE):
+            self._maybe_step_down(resp.get("term", 0))
+            return False
         with peer.lock:
             s_prev_id, s_prev_term, s_entries = prev_id, prev_term, entries
-            for _ in range(max_rounds):
-                payload = {
-                    "space": self.space_id, "part": self.part_id,
-                    "term": term, "leader": self.addr,
-                    "committed": committed,
-                    "prev_id": s_prev_id, "prev_term": s_prev_term,
-                    "entries": [[e.log_id, e.term, e.msg]
-                                for e in s_entries],
-                }
-                try:
-                    resp = self.cm.call(HostAddr.parse(peer.addr),
-                                        "raftAppendLog", payload)
-                except Exception:            # noqa: BLE001 — peer down
-                    return False
+            for round_i in range(max_rounds):
+                if round_i > 0 or resp is None:
+                    payload = {
+                        "space": self.space_id, "part": self.part_id,
+                        "term": term, "leader": self.addr,
+                        "committed": committed,
+                        "prev_id": s_prev_id, "prev_term": s_prev_term,
+                        "entries": [[e.log_id, e.term, e.msg]
+                                    for e in s_entries],
+                    }
+                    try:
+                        resp = self.cm.call(HostAddr.parse(peer.addr),
+                                            "raftAppendLog", payload)
+                    except Exception:        # noqa: BLE001 — peer down
+                        return False
+                # round 0 reuses the optimistic send's response — its
+                # last_log_id seeds the catch-up window directly instead
+                # of re-sending into the same gap (which would hold the
+                # follower's reorder wait again)
                 code = resp.get("code", int(ErrorCode.E_INTERNAL_ERROR))
                 if code == 0:
-                    peer.match_id = resp.get("last_log_id", 0)
+                    verified = s_entries[-1].log_id if s_entries \
+                        else s_prev_id
+                    peer.match_id = max(peer.match_id, verified)
                     return True
                 if code == int(ErrorCode.E_TERM_OUT_OF_DATE):
                     self._maybe_step_down(resp.get("term", 0))
@@ -471,6 +554,7 @@ class RaftPart:
         if self.commit_handler is not None and entries:
             self.commit_handler(entries)
         self.committed_id = to_id
+        self._wal_advanced.notify_all()   # CAS batches wait for drain
 
     def _pre_process(self, log_id: int, term: int, msg: bytes) -> None:
         if self.pre_process_handler is not None and msg:
@@ -521,7 +605,24 @@ class RaftPart:
 
             prev_id = req["prev_id"]
             last = self.wal.last_log_id()
-            if prev_id > last:
+            if prev_id > last and req["entries"]:
+                # pipelined leaders keep several batches in flight over
+                # parallel connections, so the batch before this one may
+                # simply not have been processed yet — wait briefly for
+                # the tail to catch up before declaring a real gap
+                # (reference Host pipelining relies on its ordered evb;
+                # our transport reorders, the hold-back restores order).
+                # Empty-entry heartbeats skip the wait: they are position
+                # probes and must answer immediately
+                deadline = time.monotonic() + float(
+                    flags.get("raft_reorder_wait_s") or 0)
+                while prev_id > self.wal.last_log_id() \
+                        and time.monotonic() < deadline:
+                    self._wal_advanced.wait(
+                        max(0.0, deadline - time.monotonic()))
+                if req["term"] < self.term:   # term moved during the wait
+                    return self._append_resp(ErrorCode.E_TERM_OUT_OF_DATE)
+            if prev_id > self.wal.last_log_id():
                 return self._append_resp(ErrorCode.E_LOG_GAP)
             if prev_id > 0 and prev_id >= self.wal.first_log_id():
                 my_term = self.wal.get_term(prev_id)
@@ -548,8 +649,15 @@ class RaftPart:
                     return self._append_resp(ErrorCode.E_LOG_GAP)
                 self._pre_process(lid, lterm, msg)
             self.wal.flush()
+            self._wal_advanced.notify_all()   # unblock held-back batches
 
-            new_commit = min(req["committed"], self.wal.last_log_id())
+            # Raft commit rule: only up to the index THIS request
+            # verified (prev consistency check + its own entries) — our
+            # tail beyond that may be a divergent leftover suffix that
+            # merely hasn't been repaired yet; wal.last_log_id() would
+            # wrongly commit it
+            verified = req["entries"][-1][0] if req["entries"] else prev_id
+            new_commit = min(req["committed"], verified)
             if new_commit > self.committed_id:
                 self._commit_to(new_commit)
             return self._append_resp(None)
@@ -624,13 +732,30 @@ class RaftPart:
             prev_id = self.wal.last_log_id()
             prev_term = self.wal.last_log_term()
             peers = list(self.peers.values())
+            replicating = self._driving > 0
 
         def hb(peer: Peer):
             if peer.inflight_hb:
                 return
             peer.inflight_hb = True
             try:
-                self._append_to_peer(peer, term, prev_id, prev_term, [],
+                p_id, p_term = prev_id, prev_term
+                if replicating and peer.match_id > 0:
+                    # liveness-only probe anchored at the peer's VERIFIED
+                    # matched position: while batches are in flight the
+                    # WAL tail is ahead of every peer, and a tail probe
+                    # would look like a gap and start a catch-up that
+                    # duplicates the in-flight sends.  match_id==0
+                    # (unknown) keeps the tail probe — anchoring at 0
+                    # would skip the follower's consistency check
+                    # entirely.  Idle leaders also keep tail probes so a
+                    # healed follower gets repaired without waiting for
+                    # the next write.
+                    m = peer.match_id
+                    with self._lock:
+                        if m >= self.wal.first_log_id():
+                            p_id, p_term = m, self.wal.get_term(m)
+                self._append_to_peer(peer, term, p_id, p_term, [],
                                      committed)
             finally:
                 peer.inflight_hb = False

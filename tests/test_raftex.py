@@ -360,3 +360,107 @@ class TestRecovery:
         for nd in cluster3.nodes:
             log_id, _term = nd.part.last_committed_log_id()
             assert log_id >= 1
+
+
+class TestPipelinedReplication:
+    """raft_pipeline_depth > 1: concurrent client appends replicate as
+    multiple in-flight batches (reference Host request pipelining).
+    Apply order must stay exactly log order on every replica, with no
+    gaps, under full concurrency — and a mid-stream leader loss must
+    not corrupt anything."""
+
+    def test_concurrent_appends_apply_in_order(self, cluster3):
+        import threading
+        lead = cluster3.leader()
+        applied = []   # log ids in apply order on the leader
+        orig = lead.part.raft.commit_handler
+
+        def wrapped(entries):
+            applied.extend(lid for lid, _t, _m in entries)
+            return orig(entries)
+        lead.part.raft.commit_handler = wrapped
+
+        errs = []
+        def writer(t):
+            try:
+                for i in range(25):
+                    st = lead.part.put(b"t%02d-%03d" % (t, i),
+                                       b"v%d" % i)
+                    assert st.ok(), st.to_string()
+            except Exception as e:      # noqa: BLE001
+                errs.append(e)
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        # apply order is strictly ascending with no duplicates
+        assert applied == sorted(applied)
+        assert len(set(applied)) == len(applied)
+        # all 200 writes on every replica (quorum may have excluded a
+        # lagging follower; heartbeat catch-up converges it)
+        deadline = time.monotonic() + 10.0
+        missing = None
+        while time.monotonic() < deadline:
+            missing = [(nd.addr, t, i)
+                       for nd in cluster3.nodes
+                       for t in range(8) for i in range(25)
+                       if nd.engine.get(b"t%02d-%03d" % (t, i))
+                       != b"v%d" % i]
+            if not missing:
+                break
+            time.sleep(0.05)
+        assert not missing, missing[:5]
+
+    def test_pipeline_survives_leader_isolation(self, cluster3):
+        import threading
+        lead = cluster3.leader()
+        stop = threading.Event()
+        results = {"ok": 0, "err": 0}
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                st = lead.part.put(b"p%05d" % i, b"x")
+                results["ok" if st.ok() else "err"] += 1   # single writer
+                i += 1
+        th = threading.Thread(target=writer)
+        th.start()
+        time.sleep(0.3)
+        lead.gate.open = False       # partition the leader mid-stream
+        time.sleep(1.0)
+        stop.set()
+        th.join()
+        lead.gate.open = True
+        # a new leader exists and the cluster still accepts writes
+        new_lead = cluster3.leader(timeout=10.0)
+        assert new_lead.part.put(b"after", b"ok").ok()
+        assert wait_converged(cluster3.nodes, b"after", b"ok",
+                              timeout=10.0)
+
+
+class TestPipelinedCAS:
+    def test_cas_sees_pipelined_put(self, cluster3):
+        """A CAS queued behind a put of the same key must compare
+        against the put's value even while the put's batch is still in
+        flight (pipelined batches apply after WAL append)."""
+        flags.set("raft_pipeline_depth", 4)
+        lead = cluster3.leader()
+        assert lead.part.put(b"ck", b"v1").ok()
+        # interleave: put v2 then CAS expecting v2, racing from threads
+        import threading
+        res = {}
+        def put():
+            res["put"] = lead.part.put(b"ck", b"v2")
+        def cas():
+            # tiny stagger so the put's batch is built first
+            time.sleep(0.005)
+            res["cas"] = lead.part.cas(b"v2", b"ck", b"v3")
+        t1, t2 = threading.Thread(target=put), threading.Thread(target=cas)
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert res["put"].ok()
+        # the CAS must have seen v2 (never the stale v1)
+        assert res["cas"].ok(), res["cas"].to_string()
+        assert wait_converged(cluster3.nodes, b"ck", b"v3")
