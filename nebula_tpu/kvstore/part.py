@@ -243,7 +243,7 @@ class Part:
             self.engine.put(_commit_key(self.part_id),
                             _COMMIT.pack(log_id, term))
         for listener in self.listeners:
-            listener(self, [])
+            listener(self, None)   # None = wholesale state replacement
 
     # ---- membership (COMMAND logs) -----------------------------------
     def pre_process_log(self, log_id: int, term: int, msg: bytes) -> None:

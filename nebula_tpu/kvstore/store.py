@@ -62,18 +62,76 @@ class NebulaStore:
         # AFTER a batch is applied to the engine — leader or follower,
         # raft or single-replica — never on submit or on rejected writes.
         self.mutation_versions: Dict[GraphSpaceID, int] = {}
+        # per-space committed-mutation delta log: one entry per version
+        # bump — either a list of (key, value) pure edge-puts (the TPU
+        # mirror can apply these incrementally, SURVEY §7 hard part (a))
+        # or None for anything it can't describe (deletes, vertex
+        # writes, ingest, compaction) which forces a full mirror
+        # rebuild.  Bounded; trimming invalidates older cursors.
+        self.delta_logs: Dict[GraphSpaceID, List] = {}
+        self.delta_bases: Dict[GraphSpaceID, int] = {}
+        self.delta_cap = 4096
         self._version_lock = threading.Lock()
         if options.part_man is not None:
             options.part_man.register_handler(self)
 
-    def _bump(self, space_id: GraphSpaceID) -> None:
+    def _bump(self, space_id: GraphSpaceID, delta=None) -> None:
         with self._version_lock:
             self.mutation_versions[space_id] = \
                 self.mutation_versions.get(space_id, 0) + 1
+            log = self.delta_logs.setdefault(space_id, [])
+            log.append(delta)
+            if len(log) > self.delta_cap:
+                drop = len(log) - self.delta_cap
+                del log[:drop]
+                self.delta_bases[space_id] = \
+                    self.delta_bases.get(space_id, 0) + drop
 
     def mutation_version(self, space_id: GraphSpaceID) -> int:
         with self._version_lock:
             return self.mutation_versions.get(space_id, 0)
+
+    def delta_since(self, space_id: GraphSpaceID, from_version: int):
+        """Edge-put (key, value) pairs for every mutation after
+        ``from_version`` — or None when that range is unavailable
+        (trimmed) or contains anything but pure edge inserts."""
+        with self._version_lock:
+            base = self.delta_bases.get(space_id, 0)
+            log = self.delta_logs.get(space_id, [])
+            if from_version < base:
+                return None
+            out = []
+            for entry in log[from_version - base:]:
+                if entry is None:
+                    return None
+                out.extend(entry)
+            return out
+
+    @staticmethod
+    def _classify_commit(decoded) -> Optional[List[KV]]:
+        """Committed batch -> edge-put kvs, or None (opaque)."""
+        from ..common.keys import KeyUtils
+        from .log_encoder import LogOp
+        if decoded is None:        # snapshot install: everything changed
+            return None
+        kvs: List[KV] = []
+        for op, payload in decoded:
+            if op == LogOp.OP_PUT:
+                items = [payload]
+            elif op == LogOp.OP_MULTI_PUT:
+                items = payload
+            elif op in (LogOp.OP_ADD_LEARNER, LogOp.OP_TRANS_LEADER,
+                        LogOp.OP_ADD_PEER, LogOp.OP_REMOVE_PEER):
+                continue               # membership — no data change
+            else:
+                return None            # removes / merges: opaque
+            for key, value in items:
+                if key.startswith(b"__system"):
+                    continue           # commit watermark bookkeeping
+                if not KeyUtils.is_edge(key):
+                    return None        # vertex/prop writes: opaque
+                kvs.append((key, value))
+        return kvs
 
     def init(self) -> None:
         """Adopt parts the PartManager says belong to this host
@@ -154,9 +212,11 @@ class NebulaStore:
                     snapshot_scan=snapshot_scan,
                     merge_op=self.options.merge_op)
         # committed-batch listener: advance the space's mutation version
-        # only once the batch hit the engine (see __init__ comment)
+        # only once the batch hit the engine (see __init__ comment),
+        # recording the batch's delta when it is pure edge inserts
         part.listeners.append(
-            lambda _p, _logs, _sid=space_id: self._bump(_sid))
+            lambda _p, decoded, _sid=space_id: self._bump(
+                _sid, self._classify_commit(decoded)))
         sd.parts[part_id] = part
         if raft is not None:
             self.raft_service.register_part(raft)

@@ -1,0 +1,207 @@
+"""bench-suite — run the BASELINE.md measurement configs and print a
+markdown table + JSON.
+
+Configs (BASELINE.md "Targets to establish", from BASELINE.json):
+  1. 1-hop GO — basketballplayer fixture, cpu vs tpu, p50/p99.
+  2. 3-hop GO + edge/vertex filter — basketballplayer.
+  3. FIND SHORTEST PATH — LDBC-SNB-flavoured SF1-ish graph (ldbc_gen).
+  4. batched interactive 3-hop GO — LDBC-shaped skewed-degree graph at
+     100k persons (the round-1 weak spot: only uniform-random was
+     recorded), cpu vs tpu served path, QPS + p50/p99.
+
+Everything runs the FULL serving path: nGQL through graphd, executor,
+batch dispatcher, device kernels, row materialization.
+
+Run: ``python -m nebula_tpu.tools.bench_suite [--quick]``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+from .storage_perf import percentile
+
+
+def _ok(cl, stmt):
+    r = cl.execute(stmt)
+    assert r.ok(), f"{stmt}: {r.error_msg}"
+    return r
+
+
+def _timed_queries(c, queries: List[str], threads: int, backend: str,
+                   space: str) -> dict:
+    from ..common.flags import flags
+    flags.set("storage_backend", backend)
+    # warm mirror + kernels outside the timed region
+    w = c.client()
+    _ok(w, f"USE {space}")
+    w.execute(queries[0])
+    lat_us: List[float] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+    counter = [0]
+
+    def worker():
+        g = c.client()
+        g.execute(f"USE {space}")
+        while True:
+            with lock:
+                i = counter[0]
+                if i >= len(queries):
+                    return
+                counter[0] += 1
+            t0 = time.perf_counter()
+            r = g.execute(queries[i])
+            dt = (time.perf_counter() - t0) * 1e6
+            with lock:
+                if r.ok():
+                    lat_us.append(dt)
+                else:
+                    errors.append(r.error_msg)
+
+    start = time.perf_counter()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.perf_counter() - start
+    assert not errors, errors[:3]
+    return {
+        "backend": backend, "requests": len(lat_us),
+        "wall_s": round(wall, 3),
+        "qps": round(len(lat_us) / wall, 1),
+        "p50_ms": round(percentile(lat_us, 50) / 1000, 3),
+        "p99_ms": round(percentile(lat_us, 99) / 1000, 3),
+    }
+
+
+def _parity(c, queries: List[str], space: str) -> None:
+    from ..common.flags import flags
+    g = c.client()
+    _ok(g, f"USE {space}")
+    for q in queries:
+        flags.set("storage_backend", "cpu")
+        a = sorted(map(tuple, _ok(g, q).rows))
+        flags.set("storage_backend", "tpu")
+        b = sorted(map(tuple, _ok(g, q).rows))
+        assert a == b, f"parity broke on {q!r}"
+
+
+def bench_basketball(results: list) -> None:
+    """Configs 1-2: the canonical small fixture, interactive latency."""
+    from ..cluster import LocalCluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        cl = c.client()
+        _ok(cl, "CREATE SPACE nba(partition_num=6, replica_factor=1)")
+        c.refresh_all()
+        _ok(cl, "USE nba")
+        _ok(cl, "CREATE TAG player(name string, age int)")
+        _ok(cl, "CREATE EDGE follow(degree int)")
+        c.refresh_all()
+        rng = np.random.default_rng(5)
+        players = ", ".join(f'{100 + i}:("p{i}", {20 + i % 25})'
+                            for i in range(50))
+        _ok(cl, f"INSERT VERTEX player(name, age) VALUES {players}")
+        edges = ", ".join(
+            f"{100 + int(s)} -> {100 + int(d)}:({60 + int(d) % 40})"
+            for s, d in zip(rng.integers(0, 50, 400),
+                            rng.integers(0, 50, 400)))
+        _ok(cl, f"INSERT EDGE follow(degree) VALUES {edges}")
+
+        one_hop = [f"GO FROM {100 + i % 50} OVER follow" for i in range(400)]
+        three_hop = [f"GO 3 STEPS FROM {100 + i % 50} OVER follow "
+                     f"WHERE $$.player.age > 30 "
+                     f"YIELD follow._dst, follow.degree"
+                     for i in range(400)]
+        _parity(c, one_hop[:8] + three_hop[:8], "nba")
+        for name, qs in (("1-hop GO (basketballplayer)", one_hop),
+                         ("3-hop GO + filter (basketballplayer)",
+                          three_hop)):
+            for backend in ("cpu", "tpu"):
+                r = _timed_queries(c, qs, 16, backend, "nba")
+                r["config"] = name
+                results.append(r)
+                print(r, file=sys.stderr)
+    finally:
+        c.stop()
+
+
+def bench_ldbc_paths(results: list, persons: int) -> None:
+    """Config 3: FIND SHORTEST PATH on the LDBC-flavoured graph."""
+    from ..cluster import LocalCluster
+    from .ldbc_gen import generate, load_cluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        src, dst, props = generate(persons)
+        load_cluster(c, "ldbc", src, dst, props)
+        rng = np.random.default_rng(3)
+        pairs = rng.integers(1, persons + 1, (200, 2))
+        qs = [f"FIND SHORTEST PATH FROM {a} TO {b} OVER knows "
+              f"UPTO 4 STEPS" for a, b in pairs]
+        _parity(c, qs[:6], "ldbc")
+        for backend in ("cpu", "tpu"):
+            r = _timed_queries(c, qs, 16, backend, "ldbc")
+            r["config"] = f"FIND SHORTEST PATH (LDBC-ish, {persons:,} persons)"
+            results.append(r)
+            print(r, file=sys.stderr)
+    finally:
+        c.stop()
+
+
+def bench_ldbc_go(results: list, persons: int) -> None:
+    """Config 4: batched interactive multi-hop GO on the skewed graph."""
+    from ..cluster import LocalCluster
+    from .ldbc_gen import generate, load_cluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        src, dst, props = generate(persons)
+        load_cluster(c, "ldbc", src, dst, props)
+        rng = np.random.default_rng(9)
+        vids = rng.integers(1, persons + 1, 1000)
+        qs = [f"GO 3 STEPS FROM {v} OVER knows" for v in vids]
+        _parity(c, qs[:6], "ldbc")
+        for backend in ("cpu", "tpu"):
+            r = _timed_queries(c, qs, 64, backend, "ldbc")
+            r["config"] = (f"3-hop GO batched (LDBC-ish skewed, "
+                           f"{persons:,} persons, {len(src):,} edges)")
+            results.append(r)
+            print(r, file=sys.stderr)
+    finally:
+        c.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench-suite")
+    p.add_argument("--quick", action="store_true",
+                   help="small sizes (CI smoke)")
+    p.add_argument("--persons", type=int, default=None)
+    args = p.parse_args(argv)
+    persons_path = args.persons or (2000 if args.quick else 10000)
+    persons_go = args.persons or (2000 if args.quick else 100000)
+
+    results: list = []
+    bench_basketball(results)
+    bench_ldbc_paths(results, persons_path)
+    bench_ldbc_go(results, persons_go)
+
+    # markdown table
+    print("\n| Config | Backend | QPS | p50 | p99 |")
+    print("|---|---|---|---|---|")
+    for r in results:
+        print(f"| {r['config']} | {r['backend']} | {r['qps']:,} "
+              f"| {r['p50_ms']} ms | {r['p99_ms']} ms |")
+    print()
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

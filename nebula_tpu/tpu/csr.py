@@ -195,6 +195,104 @@ class CsrMirror:
         return p < self.n and int(self.vids[p]) == vid
 
 
+def build_delta_mirror(base: CsrMirror, edge_kvs, schema_man,
+                       space_id: int) -> Optional[CsrMirror]:
+    """Fold committed edge-insert KVs into a small overlay mirror that
+    shares ``base``'s dense-id space and vertex columns (SURVEY §7 hard
+    part (a): mutations without the O(m) rebuild).
+
+    Returns None — meaning "do the full rebuild" — whenever the delta
+    can't be expressed as a pure append over the base: an endpoint vid
+    the base doesn't know, an edge identity that already exists in the
+    base (a property update must supersede the base row), a TTL'd row,
+    or an unresolvable schema.  All host/device query machinery
+    (expression compiler, candidate assembly, materialization) treats
+    the overlay as just another CsrMirror.
+    """
+    sm = schema_man
+    # latest write per edge identity wins (commit order)
+    newest: Dict[Tuple[int, int, int, int], bytes] = {}
+    for key, val in edge_kvs:
+        _part, src, et, rank, dst, _ver = KeyUtils.parse_edge(key)
+        newest[(src, et, rank, dst)] = val
+
+    idents = list(newest.keys())
+    src_vids = np.asarray([i[0] for i in idents], dtype=np.int64)
+    dst_vids = np.asarray([i[3] for i in idents], dtype=np.int64)
+    src_d = base.to_dense(src_vids)
+    dst_d = base.to_dense(dst_vids)
+    if len(idents) and (int(src_d.min()) < 0 or int(dst_d.min()) < 0):
+        return None                    # new vertex: dense space changes
+
+    # identity collision with a base edge = in-place update, not append
+    for i, (src, et, rank, dst) in enumerate(idents):
+        s = int(src_d[i])
+        lo, hi = int(base.row_ptr[s]), int(base.row_ptr[s + 1])
+        for e in range(lo, hi):
+            if int(base.edge_etype[e]) == et \
+                    and int(base.edge_rank[e]) == rank \
+                    and int(base.edge_dst[e]) == int(dst_d[i]):
+                return None
+
+    d = CsrMirror(space_id)
+    d.vids = base.vids                 # shared dense-id space
+    d.n = base.n
+    d.vertex_cols = base.vertex_cols   # vertex side unchanged by
+    d.has_tag = base.has_tag           # edge inserts
+    m = len(idents)
+    d.m = m
+    if m == 0:
+        d.row_ptr = np.zeros(d.n + 1, dtype=np.int32)
+        return d
+    etype_a = np.asarray([i[1] for i in idents], dtype=np.int32)
+    rank_a = np.asarray([i[2] for i in idents], dtype=np.int64)
+    order = np.lexsort((dst_d, rank_a, etype_a, src_d))
+    d.edge_src = src_d[order].astype(np.int32)
+    d.edge_dst = dst_d[order].astype(np.int32)
+    d.edge_etype = etype_a[order]
+    d.edge_rank = rank_a[order]
+
+    cols: Dict[Tuple[int, str], Column] = {}
+    for et in np.unique(d.edge_etype).tolist():
+        schema = sm.get_edge_schema(space_id, abs(et), -1)
+        if schema is None:
+            return None
+        for col in schema.columns:
+            cols[(et, col.name)] = Column(col.name, col.type, m)
+    vals = [newest[idents[j]] for j in order]
+    for i, blob in enumerate(vals):
+        if not blob:
+            continue
+        et = int(d.edge_etype[i])
+        try:
+            reader = RowReader.from_resolver(
+                blob, lambda ver, _et=abs(et): sm.get_edge_schema(
+                    space_id, _et, ver))
+        except KeyError:
+            return None
+        if _ttl_expiry(reader) is not None:
+            return None                # TTL rows need the rebuild path
+        for cname in reader.schema.names():
+            c = cols.get((et, cname))
+            if c is None:
+                continue
+            try:
+                v = reader.get(cname)
+            except KeyError:
+                continue
+            if c.raw is not None:
+                c.raw[i] = v if isinstance(v, str) else str(v)
+            else:
+                c.values[i] = v
+            c.valid[i] = True
+    for c in cols.values():
+        c.finalize()
+    d.edge_cols = cols
+    counts = np.bincount(d.edge_src, minlength=d.n)
+    d.row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    return d
+
+
 def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:
     """Scan every part of ``space_id`` across the given NebulaStores and
     fold the KV ranges into a CsrMirror.

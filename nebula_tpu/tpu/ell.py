@@ -326,6 +326,33 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
     return go
 
 
+def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
+                                 etypes: Tuple[int, ...], cap: int):
+    """Batched GO over the base ELL plus up to ``cap`` overlay edges
+    (incremental CSR maintenance: freshly committed edge inserts ride
+    as (src, dst, etype) triples in the ell's NEW-id space instead of
+    forcing an O(m) table rebuild).  Padded slots use row index n_rows
+    (the always-zero pad row) and etype 0 (never in an OVER set)."""
+    import jax
+    import jax.numpy as jnp
+    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+
+    @jax.jit
+    def go(f0, dsrc, ddst, det):
+        ok = _etype_ok(jnp, det, etypes).astype(jnp.int8)
+
+        def one(_, f):
+            nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
+                            owner_dev, f)
+            act = f[dsrc] * ok[:, None]          # [cap, B]
+            return nxt.at[ddst].max(act)
+        if steps <= 1:
+            return f0
+        return jax.lax.fori_loop(0, steps - 1, one, f0)
+
+    return go
+
+
 def make_adaptive_go_kernel(ell: EllIndex, steps: int,
                             etypes: Tuple[int, ...], K: int = 2048):
     """Single-query GO with sparse-frontier hops — the interactive

@@ -79,6 +79,11 @@ flags.define(
     "The TPU analogue of the reference's multi-storaged partition "
     "spread (SURVEY.md §2.12)")
 flags.define(
+    "mirror_delta_max", 4096,
+    "max accumulated edge-insert overlay before the next device query "
+    "pays a full CSR/ELL rebuild (compaction); inserts below this ride "
+    "a small delta kernel instead of the O(m) rebuild")
+flags.define(
     "mirror_refresh_mode", "sync",
     "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
     "next device query (always fresh — the test/parity default); "
@@ -102,7 +107,8 @@ class TpuQueryRuntime:
         self._dispatcher = None   # lazy GoBatchDispatcher
         # observability (tests assert the device path actually ran;
         # webservice /get_stats exports these)
-        self.stats = {"go_device": 0, "path_device": 0, "mirror_builds": 0}
+        self.stats = {"go_device": 0, "path_device": 0, "mirror_builds": 0,
+                      "mirror_deltas": 0}
 
     @property
     def dispatcher(self):
@@ -125,9 +131,14 @@ class TpuQueryRuntime:
         ver = self._space_version(space_id)
         with self._lock:
             m = self.mirrors.get(space_id)
-            if m is not None and m.build_version == ver \
+            if m is not None \
+                    and getattr(m, "_fresh_version", m.build_version) == ver \
                     and not m.expired_now():
                 return m
+            if m is not None and not m.expired_now():
+                d = self._try_delta(space_id, m, ver)
+                if d is not None:
+                    return d
             if m is not None and flags.get("mirror_refresh_mode") == "async":
                 # serve the stale mirror; rebuild off-thread (bounded
                 # staleness, like the reference's 120s cache refresh).
@@ -150,12 +161,83 @@ class TpuQueryRuntime:
     def _publish(self, space_id: int, m: CsrMirror, ver: int) -> CsrMirror:
         """Install a built mirror (caller holds the lock)."""
         m.build_version = ver
+        m._fresh_version = ver       # advanced by delta application
+        m._delta = None              # overlay mirror (incremental edges)
+        m._delta_kvs = []
+        m._delta_gen = 0
+        m._delta_cursors = {i: s.mutation_version(space_id)
+                            for i, s in enumerate(self.stores)}
+        m._part_sig = tuple(len(s.part_ids(space_id))
+                            for s in self.stores)
         self.stats["mirror_builds"] += 1
         self.mirrors[space_id] = m
         # CSR changed: every cached kernel for this space is stale
         self._kernels = {k: v for k, v in self._kernels.items()
                          if k[0] != space_id}
         return m
+
+    def _try_delta(self, space_id: int, m: CsrMirror,
+                   ver: int) -> Optional[CsrMirror]:
+        """Absorb committed pure-edge-insert mutations into an overlay
+        mirror instead of the O(m) rebuild (SURVEY §7 hard part (a));
+        None = can't, caller falls back to the rebuild path.  Caller
+        holds the lock."""
+        if getattr(m, "_delta_cursors", None) is None:
+            return None
+        if flags.get("tpu_filter_mode") == "device" \
+                or int(flags.get("tpu_mesh_devices") or 0) > 1:
+            return None              # non-default modes keep rebuilds
+        sig = tuple(len(s.part_ids(space_id)) for s in self.stores)
+        if m._part_sig != sig:
+            return None              # part placement moved
+        new_kvs = []
+        cursors = dict(m._delta_cursors)
+        for i, s in enumerate(self.stores):
+            now_v = s.mutation_version(space_id)
+            if now_v == cursors[i]:
+                continue
+            kvs = s.delta_since(space_id, cursors[i])
+            if kvs is None:
+                return None          # opaque ops / trimmed log
+            new_kvs.extend(kvs)
+            cursors[i] = now_v
+        total = m._delta_kvs + new_kvs
+        if len(total) > int(flags.get("mirror_delta_max") or 4096):
+            return None              # compaction point: full rebuild
+        from .csr import build_delta_mirror
+        d = build_delta_mirror(m, total, self.sm, space_id) if total \
+            else None
+        if total and d is None:
+            return None
+        m._delta_kvs = total
+        if d is not None and d.m > 0:
+            m._delta = d
+            m._delta_gen += 1
+        m._delta_cursors = cursors
+        m._fresh_version = ver
+        self.stats["mirror_deltas"] = self.stats.get("mirror_deltas",
+                                                     0) + 1
+        return m
+
+    def mirror_full(self, space_id: int) -> Optional[CsrMirror]:
+        """A mirror with NO pending overlay — the BFS/FIND PATH device
+        half and the sharded path read raw base arrays, so they force
+        the rebuild when a delta is outstanding."""
+        m = self.mirror(space_id)
+        d = getattr(m, "_delta", None)
+        if d is None or d.m == 0:
+            return m
+        with self._lock:
+            ver = self._space_version(space_id)
+            cur = self.mirrors.get(space_id)
+            d = getattr(cur, "_delta", None)
+            if cur is not None and (d is None or d.m == 0) \
+                    and getattr(cur, "_fresh_version",
+                                cur.build_version) == ver:
+                return cur           # someone rebuilt while we waited
+            m2 = build_mirror(space_id, self.stores, self.sm)
+            m2._device = self._to_device(m2)
+            return self._publish(space_id, m2, ver)
 
     def _rebuild_async(self, space_id: int, ver: int,
                        stale: CsrMirror) -> None:
@@ -316,8 +398,13 @@ class TpuQueryRuntime:
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
 
-        if plan.filter_cval is not None \
-                and flags.get("tpu_filter_mode") == "device":
+        d0 = getattr(m, "_delta", None)
+        use_device_filter = (
+            plan.filter_cval is not None
+            and flags.get("tpu_filter_mode") == "device"
+            and (d0 is None or d0.m == 0))   # fused kernel has no overlay
+        delta = None
+        if use_device_filter:
             # fused path: the WHERE mask compiles into the same XLA
             # program as the hop loop (expression pushdown -> device,
             # SURVEY.md §7 hard part (c)); no cross-query batching
@@ -355,6 +442,9 @@ class TpuQueryRuntime:
                             "schema changed while the query ran")
                     plan.filter_used = dict(compiler.used)
                     plan.compiler = compiler
+            delta = getattr(m, "_delta", None)
+            if delta is not None and delta.m == 0:
+                delta = None
             cand_idx = self._frontier_edges(m, frontier, et_tuple)
             if plan.filter_cval is not None:
                 idx = cand_idx[self._host_filter(m, plan, cand_idx)]
@@ -367,6 +457,12 @@ class TpuQueryRuntime:
 
         rows = self._materialize(m, space_id, plan.alias_to_etype,
                                  etype_to_alias, yield_cols, idx, ExecError)
+        if delta is not None:
+            # freshly inserted edges ride the overlay mirror through the
+            # same candidate/filter/materialize machinery
+            rows = rows + self._delta_rows(
+                space_id, plan, delta, frontier, et_tuple,
+                etype_to_alias, yield_cols, where_expr, ExecError)
         if distinct:
             seen = set()
             out = []
@@ -377,6 +473,36 @@ class TpuQueryRuntime:
                     out.append(r)
             rows = out
         return columns, rows
+
+    def _delta_rows(self, space_id: int, plan: _GoPlan, d: CsrMirror,
+                    frontier: np.ndarray, et_tuple: Tuple[int, ...],
+                    etype_to_alias: Dict[int, str], yield_cols,
+                    where_expr, ExecError) -> List[List[object]]:
+        """Final-hop rows contributed by the insert-overlay mirror.  The
+        WHERE compiles separately against the overlay (its own string
+        dictionaries / value ranges); anything uncompilable falls back
+        to the CPU executor via TpuDecline."""
+        from ..storage.device import TpuDecline
+        cand = self._frontier_edges(d, frontier, et_tuple)
+        if len(cand) == 0:
+            return []
+        if plan.filter_cval is not None:
+            comp = ExprCompiler(d, space_id, self.sm, plan.alias_to_etype)
+            try:
+                cval = comp.compile(where_expr)
+            except CompileError:
+                raise TpuDecline("overlay filter uncompilable")
+            if comp.div_guards and not plan.pushed_mode:
+                raise TpuDecline("overlay div guard in graphd mode")
+            dplan = _GoPlan(d, plan.alias_to_etype, cval, dict(comp.used),
+                            plan.pushed_mode, comp, plan.expr_str)
+            if not plan.pushed_mode:
+                self._check_valid(d, dplan.filter_used, cand, ExecError)
+            idx = cand[self._host_filter(d, dplan, cand)]
+        else:
+            idx = cand
+        return self._materialize(d, space_id, plan.alias_to_etype,
+                                 etype_to_alias, yield_cols, idx, ExecError)
 
     # -------------------------------------------------- host columns
     def _gather_cols(self, m: CsrMirror, alias_to_etype: Dict[str, int],
@@ -828,6 +954,29 @@ class TpuQueryRuntime:
             kern = self._kernels[key] = builder()
         return kern
 
+    def _delta_device(self, m: CsrMirror, ix: EllIndex):
+        """(dsrc, ddst, det) device arrays for the insert overlay in the
+        ELL's new-id space, padded to a pow-2 capacity (cached per delta
+        generation)."""
+        import jax.numpy as jnp
+        gen = m._delta_gen
+        cached = getattr(m, "_delta_dev_cache", None)
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        d = m._delta
+        cap = max(8, 1 << (max(d.m, 1) - 1).bit_length())
+        pad = ix.n_rows            # the always-zero pad row
+        dsrc = np.full(cap, pad, dtype=np.int32)
+        ddst = np.full(cap, pad, dtype=np.int32)
+        det = np.zeros(cap, dtype=np.int32)   # 0 never in an OVER set
+        dsrc[:d.m] = ix.perm[d.edge_src]
+        ddst[:d.m] = ix.perm[d.edge_dst]
+        det[:d.m] = d.edge_etype
+        out = (cap, jnp.asarray(dsrc), jnp.asarray(ddst),
+               jnp.asarray(det))
+        m._delta_dev_cache = (gen, out)
+        return out
+
     def _go_batch_frontiers(self, space_id: int, starts_per_query,
                             et_tuple: Tuple[int, ...], kernel_steps: int):
         """Shared batched-GO core: run ``kernel_steps - 1`` frontier
@@ -835,10 +984,29 @@ class TpuQueryRuntime:
         mirror's dense-id space, mirror)."""
         import jax.numpy as jnp
         from .ell import (make_adaptive_go_kernel, make_batched_go_kernel,
+                          make_batched_go_delta_kernel,
                           make_sharded_batched_go_kernel)
         m = self.mirror(space_id)
         ix = self.ell(m)
         nq = len(starts_per_query)
+        delta = getattr(m, "_delta", None)
+        if delta is not None and delta.m == 0:
+            delta = None
+
+        if delta is not None:
+            # insert overlay: base ELL + a small edge-triple side table
+            # in one jitted program (no O(m) rebuild per mutation)
+            B = self._batch_width(nq)
+            cap, dsrc, ddst, det = self._delta_device(m, ix)
+            kern = self._kernel(
+                (space_id, m.build_version, "ell_go_delta", et_tuple,
+                 kernel_steps, B, cap),
+                lambda: make_batched_go_delta_kernel(
+                    ix, kernel_steps, et_tuple, cap))
+            f0_dev = self._upload_frontier(ix, m, starts_per_query, B)
+            out_dev = kern(f0_dev, dsrc, ddst, det)
+            out = self._fetch_bitmap(out_dev, nq)   # bit-packed transfer
+            return ix.to_old(out).T, m
 
         # lone interactive query: sparse-frontier adaptive kernel
         # (mesh-sharded mode keeps the batched path — the adaptive
@@ -864,10 +1032,62 @@ class TpuQueryRuntime:
             lambda mesh, nbrs, ets, reals: make_sharded_batched_go_kernel(
                 mesh, "parts", ix, kernel_steps, et_tuple, nbrs, ets,
                 reals))
-        f0 = ix.start_frontier(
-            [m.to_dense(s) for s in starts_per_query], B=B)
-        out = np.asarray(run(jnp.asarray(f0)))
-        return ix.to_old(out)[:, :nq].T > 0, m
+        f0_dev = self._upload_frontier(ix, m, starts_per_query, B)
+        out_dev = run(f0_dev)
+        out = self._fetch_bitmap(out_dev, nq)       # bit-packed transfer
+        return ix.to_old(out).T, m
+
+    @staticmethod
+    def _upload_frontier(ix: EllIndex, m: CsrMirror, starts_per_query,
+                         B: int):
+        """Device [rows+1, B] start frontier built ON the device from
+        (row, col) start coordinates — the host→device transfer is the
+        start list (bytes), not the dense mostly-zero matrix (tens of
+        MB at million-vertex scale; on the remote-tunnel device that
+        transfer dominated the whole dispatch)."""
+        import jax.numpy as jnp
+        rows_l, cols_l = [], []
+        for q, s in enumerate(starts_per_query):
+            dense = m.to_dense(s)
+            dense = dense[dense >= 0]
+            ids = ix.perm[dense]
+            rows_l.append(ids.astype(np.int32))
+            cols_l.append(np.full(len(ids), q, np.int32))
+        rows_a = np.concatenate(rows_l) if rows_l else \
+            np.zeros(0, np.int32)
+        cols_a = np.concatenate(cols_l) if cols_l else \
+            np.zeros(0, np.int32)
+        S = len(rows_a)
+        Sp = max(8, 1 << (max(S, 1) - 1).bit_length())   # stable shapes
+        pad_row = ix.n_rows                              # always-zero row
+        rows_p = np.full(Sp, pad_row, np.int32)
+        cols_p = np.zeros(Sp, np.int32)
+        vals_p = np.zeros(Sp, np.int8)
+        rows_p[:S] = rows_a
+        cols_p[:S] = cols_a
+        vals_p[:S] = 1
+        f0 = jnp.zeros((ix.n_rows + 1, B), jnp.int8)
+        return f0.at[jnp.asarray(rows_p), jnp.asarray(cols_p)].max(
+            jnp.asarray(vals_p))
+
+    @staticmethod
+    def _fetch_bitmap(out_dev, nq: int) -> np.ndarray:
+        """device [R+1, B] int8 frontier -> host bool [R+1, nq], moved
+        across the link bit-packed (8 rows per byte) — the result
+        matrix is the other transfer that dominated remote dispatches."""
+        import jax.numpy as jnp
+        nqp = max(8, 1 << (max(nq, 1) - 1).bit_length())
+        sub = (out_dev[:, :nqp] > 0)
+        R1 = sub.shape[0]
+        G = -(-R1 // 8)
+        padded = jnp.pad(sub, ((0, G * 8 - R1), (0, 0)))
+        w = jnp.asarray((1 << np.arange(8)).astype(np.int32))
+        packed = jnp.sum(
+            padded.reshape(G, 8, nqp).astype(jnp.int32) * w[None, :, None],
+            axis=1).astype(jnp.uint8)
+        host = np.asarray(packed)
+        bits = np.unpackbits(host, axis=0, bitorder="little")[:R1]
+        return bits[:, :nq].astype(bool)
 
     def go_batch(self, space_id: int, starts_per_query, etypes: List[int],
                  steps: int) -> np.ndarray:
@@ -917,13 +1137,21 @@ class TpuQueryRuntime:
             lambda mesh, nbrs, ets, reals: make_sharded_batched_bfs_kernel(
                 mesh, "parts", ix, max_steps, et_tuple, nbrs, ets, reals,
                 stop_when_found=shortest))
-        f0 = ix.start_frontier(
-            [m.to_dense(s) for s in starts_per_query], B=B)
-        t0 = ix.start_frontier(
-            [m.to_dense(t) for t in targets_per_query], B=B)
+        f0_dev = self._upload_frontier(ix, m, starts_per_query, B)
+        t0_dev = self._upload_frontier(ix, m, targets_per_query, B)
         self.stats["path_device"] += nq
-        d = np.asarray(run(jnp.asarray(f0), jnp.asarray(t0)))
-        return ix.to_old(d)[:, :nq].T
+        d_dev = run(f0_dev, t0_dev)
+        # depths are small ints; ship int8 (INT16_INF -> -1), not int16
+        from .ell import INT16_INF
+        if max_steps > 120:          # int8 can't carry the depth range
+            return ix.to_old(np.asarray(d_dev))[:, :nq].T
+        nqp = max(8, 1 << (max(nq, 1) - 1).bit_length())
+        import jax.numpy as jnp
+        small = jnp.where(d_dev[:, :nqp] == INT16_INF, -1,
+                          d_dev[:, :nqp]).astype(jnp.int8)
+        d8 = np.asarray(small)[:, :nq]
+        d = np.where(d8 < 0, INT16_INF, d8).astype(np.int16)
+        return ix.to_old(d).T
 
     def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
                   etypes: List[int], max_steps: int,
@@ -942,8 +1170,10 @@ class TpuQueryRuntime:
                            et_tuple: Tuple[int, ...], max_steps: int,
                            shortest: bool):
         """Dispatcher entry (graph/batch_dispatch.py submit_batched):
-        ``pairs`` is [(srcs, dsts), ...]; returns (depth rows, mirror)."""
-        m = self.mirror(space_id)
+        ``pairs`` is [(srcs, dsts), ...]; returns (depth rows, mirror).
+        BFS reads raw base arrays, so an outstanding insert overlay
+        forces the rebuild here (mirror_full)."""
+        m = self.mirror_full(space_id)
         d = self._bfs_depths(space_id, m, [p[0] for p in pairs],
                              [p[1] for p in pairs], et_tuple, max_steps,
                              shortest)
