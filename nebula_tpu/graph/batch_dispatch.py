@@ -1,9 +1,9 @@
 """GoBatchDispatcher — coalesce concurrent device queries into one
-dispatch (GO frontiers and FIND PATH BFS depths share the seam).
+dispatch (GO executions and FIND PATH BFS depths share the seam).
 
 The batched ELL engine (tpu/ell.py) amortises the TPU's per-row-access
-floor across a [n, B] frontier matrix, so the serving layer must feed
-it batches.  graphd's RPC server runs each query on its own thread
+floor across the whole batch, so the serving layer must feed it
+batches.  graphd's RPC server runs each query on its own thread
 (interface/rpc.py ThreadingTCPServer — the analogue of the reference's
 IOThreadPool + worker pools, StorageServer.cpp:92-96); this dispatcher
 is the seam where those threads merge: requests with the same
@@ -11,11 +11,19 @@ is the seam where those threads merge: requests with the same
 the dispatching leader, and everyone blocks until their own result is
 filled in.
 
-Only one dispatch per key runs at a time, so requests arriving while a
-kernel is in flight pile up and ride the *next* batch — natural
-adaptive batching with zero added latency for a lone query.  A
-positive ``go_batch_window_ms`` additionally makes the leader sleep
-before popping the queue, trading p50 for larger batches.
+Pipelining (round 3): a batch runs in two phases.  The leader LAUNCHES
+the device work (async under JAX), then immediately releases
+leadership so the next batch's leader can launch while this batch's
+transfer + host assembly (`finish`) complete — device compute and
+host post-processing overlap instead of serializing.  In-flight
+batches are bounded by ``go_batch_inflight``.
+
+Failure isolation (round 3): the runtime returns per-query results in
+which individual entries may be Exception instances; only their own
+waiters see them.  A batch-level failure (device error, infra) still
+wakes everyone with the error — but a poisoned query no longer fails
+its 1023 innocent neighbours (the reference's semantics are per-request
+partial failure, StorageClient.h:22-72).
 
 The reference has no cross-query batching (each GO is its own RPC
 fan-out); this is TPU-native serving the same way the reference's
@@ -37,6 +45,10 @@ flags.define("go_batch_window_ms", 0,
              "behind them)")
 flags.define("go_batch_max", 1024,
              "max coalesced queries (GO or FIND PATH) per device dispatch")
+flags.define("go_batch_inflight", 2,
+             "max device batches in flight across the two-phase "
+             "dispatch pipeline (launch overlaps the previous batch's "
+             "transfer + host assembly)")
 
 
 class _Request:
@@ -44,9 +56,9 @@ class _Request:
 
     def __init__(self, payload):
         self.payload = payload   # per-query input, method-defined (GO:
-        self.done = False        # start vids; BFS: (srcs, dsts)); the
+        self.done = False        # _GoQuery; BFS: (srcs, dsts)); the
                                  # leader maps ids against ONE mirror
-        self.result = None               # per-query row of the batch
+        self.result = None               # per-query result of the batch
         self.mirror = None
         self.error = None
 
@@ -65,7 +77,10 @@ class GoBatchDispatcher:
         self.runtime = runtime
         self._lock = threading.Lock()
         self._keys: Dict[Tuple, _KeyState] = {}
-        self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0}
+        self._inflight = threading.Semaphore(
+            max(1, int(flags.get("go_batch_inflight") or 2)))
+        self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0,
+                      "query_errors": 0}
 
     def _state(self, key: Tuple) -> _KeyState:
         with self._lock:
@@ -74,19 +89,14 @@ class GoBatchDispatcher:
                 st = self._keys[key] = _KeyState()
             return st
 
-    def submit(self, space_id: int, start_vids, et_tuple: Tuple[int, ...],
-               steps: int):
-        """Blocking GO submit: returns (frontier bool[n] after steps-1
-        advances, mirror it is expressed in)."""
-        return self.submit_batched(
-            ("go_batch_frontier", space_id, et_tuple, steps), start_vids)
-
     def submit_batched(self, key: Tuple, payload):
         """Coalesce any batched runtime entry point: ``key[0]`` names a
         runtime method with signature ``fn(space_id, payloads, *key[2:])
-        -> (per-query results, mirror)``; requests sharing the key ride
-        one device dispatch (GO frontiers and FIND PATH BFS depths both
-        route here)."""
+        -> (per-query results, mirror)`` — or a two-phase ``_Pending``
+        (an object with ``.finish()``) whose launch half has already
+        run.  Requests sharing the key ride one device dispatch.  A
+        per-query result that is an Exception instance is raised only
+        for its own submitter."""
         st = self._state(key)
         req = _Request(payload)
         st.cond.acquire()
@@ -109,11 +119,23 @@ class GoBatchDispatcher:
                 batch = st.queue[:max_b]
                 del st.queue[:max_b]
                 st.cond.release()
+                released = [False]
+
+                def release_leadership():
+                    # device work for this batch is queued; the next
+                    # leader may launch while we finish the transfer +
+                    # host assembly
+                    with st.cond:
+                        st.dispatching = False
+                        st.cond.notify_all()
+                    released[0] = True
+
                 try:
-                    self._run(key, batch)
+                    self._run(key, batch, release_leadership)
                 finally:
                     st.cond.acquire()
-                    st.dispatching = False
+                    if not released[0]:
+                        st.dispatching = False
                     st.cond.notify_all()
         finally:
             st.cond.release()
@@ -122,24 +144,41 @@ class GoBatchDispatcher:
         return req.result, req.mirror
 
     # ------------------------------------------------------------------
-    def _run(self, key: Tuple, batch: List[_Request]) -> None:
+    def _run(self, key: Tuple, batch: List[_Request],
+             release_leadership) -> None:
         method, space_id = key[0], key[1]
+        n_errors = 0
         try:
             fn = getattr(self.runtime, method)
-            results, mirror = fn(space_id, [r.payload for r in batch],
-                                 *key[2:])
+            self._inflight.acquire()
+            try:
+                res = fn(space_id, [r.payload for r in batch], *key[2:])
+                if hasattr(res, "finish"):       # two-phase _Pending
+                    release_leadership()
+                    results, mirror = res.finish()
+                else:
+                    results, mirror = res
+            finally:
+                self._inflight.release()
             for i, r in enumerate(batch):
-                r.result = results[i]
-                r.mirror = mirror
-        except BaseException as ex:        # noqa: BLE001 — every waiter
-            for r in batch:                # must wake with the error
-                r.error = ex
+                out = results[i]
+                if isinstance(out, Exception):
+                    r.error = out                # only this waiter fails
+                    n_errors += 1
+                else:
+                    r.result = out
+                    r.mirror = mirror
+        except BaseException as ex:        # noqa: BLE001 — batch-level
+            for r in batch:                # failure wakes every waiter
+                if r.error is None and r.result is None:
+                    r.error = ex
             if not isinstance(ex, Exception):
                 raise                      # KeyboardInterrupt etc.
         finally:
             with self._lock:   # leaders for different keys run concurrently
                 self.stats["batches"] += 1
                 self.stats["batched_queries"] += len(batch)
+                self.stats["query_errors"] += n_errors
                 self.stats["max_batch"] = max(self.stats["max_batch"],
                                               len(batch))
             for r in batch:

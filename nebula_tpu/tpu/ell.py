@@ -259,10 +259,41 @@ class EllIndex:
         """[.., B] rows in new-id space -> old dense-id space."""
         return frontier_new[self.perm]
 
+    # -------------------------------------------------------------- shape
+    def shape_sig(self) -> Tuple:
+        """Static shape signature: two EllIndexes with equal signatures
+        can share one compiled kernel (tables ride as jit ARGUMENTS, so
+        the XLA program depends only on shapes — a mirror rebuild with
+        unchanged table shapes re-dispatches the cached executable
+        instead of recompiling; see the kernel builders below)."""
+        return (self.n, self.n_rows, len(self.extra_owner),
+                tuple((nbr.shape[0], nbr.shape[1])
+                      for nbr in self.bucket_nbr))
+
+    def hub_table(self) -> np.ndarray:
+        """bool[n+1]: vertex owns hub extra rows (slot spill) — such a
+        vertex forces sparse/adaptive kernels onto the dense path
+        because a push from its main row alone would miss the spilled
+        slots."""
+        is_hub = np.zeros(self.n + 1, dtype=bool)
+        if len(self.extra_owner):
+            is_hub[np.unique(self.extra_owner)] = True
+        return is_hub
+
+    def kernel_args(self):
+        """The device arrays every args-style kernel takes positionally:
+        (owner, *bucket_nbr, *bucket_et)."""
+        nbr_dev, et_dev, owner_dev = self.device_arrays()
+        return (owner_dev, *nbr_dev, *et_dev)
+
 
 # ====================================================================
-# Kernels.  All are built per (ell identity, steps/etypes, B) and cached
-# by the runtime; shapes and the etype set are static under jit.
+# Kernels.  Built per (shape_sig, steps, etypes) and cached by the
+# runtime; the ELL tables are passed as ARGUMENTS (not closed over), so
+# one jitted fn serves every mirror whose tables have the same shapes,
+# and the persistent compilation cache hits across processes.  (Closing
+# over the tables embeds ~100 MB as HLO constants — measured 64 s
+# compiles and 6x slower execution on v5e.)
 # ====================================================================
 def _etype_ok(jnp, et_col, etypes: Tuple[int, ...]):
     ok = jnp.zeros(et_col.shape, dtype=bool)
@@ -287,7 +318,7 @@ def _bucket_expand(jnp, jax, f, nbr, et, etypes: Tuple[int, ...]):
     return jax.lax.fori_loop(0, D, body, acc0)
 
 
-def _hop_body(jnp, jax, ell: EllIndex, etypes: Tuple[int, ...],
+def _hop_body(jnp, jax, n: int, n_extras: int, etypes: Tuple[int, ...],
               nbr_dev, et_dev, extra_owner_dev, f):
     """One frontier advance: f [n_rows+1, B] int8 -> same shape."""
     outs = [_bucket_expand(jnp, jax, f, nbr, et, etypes)
@@ -295,8 +326,8 @@ def _hop_body(jnp, jax, ell: EllIndex, etypes: Tuple[int, ...],
     if not outs:                           # empty graph: nothing moves
         return jnp.zeros_like(f)
     nxt = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
-    if len(ell.extra_owner):               # hub fix-up (tiny scatter)
-        extras = nxt[ell.n:]
+    if n_extras:                           # hub fix-up (tiny scatter)
+        extras = nxt[n:]
         nxt = nxt.at[extra_owner_dev].max(extras)
         # extra rows keep their value; they are ignored as gather
         # sources (no slot ever points at row >= n) and re-derived
@@ -305,29 +336,54 @@ def _hop_body(jnp, jax, ell: EllIndex, etypes: Tuple[int, ...],
     return jnp.concatenate([nxt, pad], axis=0)
 
 
+def pack_bits(jnp, x):
+    """[R, B] truthy -> bit-packed uint8 [ceil(R/8), B] (row-major bits,
+    little bit order — np.unpackbits(bitorder="little") inverts it).
+    Fused into kernels so the device->host transfer shrinks 8x; over a
+    remote-tunnel link the transfer, not the compute, dominated."""
+    R1, B = x.shape
+    G = -(-R1 // 8)
+    padded = jnp.pad((x > 0).astype(jnp.int32), ((0, G * 8 - R1), (0, 0)))
+    w = jnp.asarray((1 << np.arange(8)).astype(np.int32))
+    return jnp.sum(padded.reshape(G, 8, B) * w[None, :, None],
+                   axis=1).astype(jnp.uint8)
+
+
+def unpack_bits(packed: np.ndarray, R1: int) -> np.ndarray:
+    """Host half of pack_bits: uint8 [G, B] -> bool [R1, B]."""
+    return np.unpackbits(packed, axis=0, bitorder="little")[:R1] > 0
+
+
 def make_batched_go_kernel(ell: EllIndex, steps: int,
-                           etypes: Tuple[int, ...]):
-    """fn(f0 [n_rows+1, B] int8) -> frontier after ``steps-1`` advances
-    (the final hop's edge set is frontier[src] & etype_ok, materialised
-    by the caller — same split as kernels._go_body)."""
+                           etypes: Tuple[int, ...], pack: bool = False):
+    """fn(f0 [n_rows+1, B] int8, owner, *tables) -> frontier after
+    ``steps-1`` advances (the final hop's edge set is frontier[src] &
+    etype_ok, materialised by the caller — same split as
+    kernels._go_body).  ``tables`` = (*bucket_nbr, *bucket_et) from
+    EllIndex.kernel_args(); only static shapes are read off ``ell``, so
+    the compiled fn serves any mirror with the same shape_sig.  With
+    ``pack`` the output is bit-packed uint8 (see pack_bits)."""
     import jax
     import jax.numpy as jnp
-    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
 
     @jax.jit
-    def go(f0):
+    def go(f0, owner, *tables):
+        nbrs, ets = tables[:nb], tables[nb:]
+
         def one(_, f):
-            return _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
-                             owner_dev, f)
-        if steps <= 1:
-            return f0
-        return jax.lax.fori_loop(0, steps - 1, one, f0)
+            return _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
+                             owner, f)
+        out = f0 if steps <= 1 else \
+            jax.lax.fori_loop(0, steps - 1, one, f0)
+        return pack_bits(jnp, out) if pack else out
 
     return go
 
 
 def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
-                                 etypes: Tuple[int, ...], cap: int):
+                                 etypes: Tuple[int, ...], cap: int,
+                                 pack: bool = False):
     """Batched GO over the base ELL plus up to ``cap`` overlay edges
     (incremental CSR maintenance: freshly committed edge inserts ride
     as (src, dst, etype) triples in the ell's NEW-id space instead of
@@ -335,20 +391,166 @@ def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
     (the always-zero pad row) and etype 0 (never in an OVER set)."""
     import jax
     import jax.numpy as jnp
-    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
 
     @jax.jit
-    def go(f0, dsrc, ddst, det):
+    def go(f0, dsrc, ddst, det, owner, *tables):
+        nbrs, ets = tables[:nb], tables[nb:]
         ok = _etype_ok(jnp, det, etypes).astype(jnp.int8)
 
         def one(_, f):
-            nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
-                            owner_dev, f)
+            nxt = _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
+                            owner, f)
             act = f[dsrc] * ok[:, None]          # [cap, B]
             return nxt.at[ddst].max(act)
-        if steps <= 1:
-            return f0
-        return jax.lax.fori_loop(0, steps - 1, one, f0)
+        out = f0 if steps <= 1 else \
+            jax.lax.fori_loop(0, steps - 1, one, f0)
+        return pack_bits(jnp, out) if pack else out
+
+    return go
+
+
+def sparse_caps(c0: int, d_max: int, steps: int, cap: int,
+                growth: int = 8) -> Tuple[int, ...]:
+    """Static per-hop pair-list capacities for the sparse batched GO.
+
+    Per-hop sort size is caps[h] * d_max, so caps drive the kernel's
+    cost directly (measured on v5e: 131k-pair caps → 350 ms/dispatch,
+    8-growth caps → ~100 ms).  Intermediate caps grow geometrically
+    from the start capacity (``growth`` ~ the expected out-degree); the
+    FINAL cap gets the full budget since the last frontier is the
+    result.  A hop that outgrows its cap reports overflow and the
+    caller reruns dense — capacity tuning is a performance knob, never
+    a correctness one."""
+    caps = [max(8, c0)]
+    for h in range(max(steps - 1, 0)):
+        hard = max(8, caps[-1]) * max(d_max, 1)   # can't exceed expansion
+        if h == steps - 2:
+            caps.append(min(cap, hard))
+        else:
+            caps.append(min(cap, hard,
+                            max(8, c0) * (max(growth, 2) ** (h + 1))))
+    return tuple(caps)
+
+
+def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
+                                  etypes: Tuple[int, ...],
+                                  caps: Tuple[int, ...]):
+    """Sparse batched GO — B queries' frontiers ride ONE flat sorted
+    (query, vertex) pair list instead of a dense [n_rows, B] bitmap.
+
+    Per hop: bucketed row-gathers pull each pair's out-slots (etypes
+    negated — csr.py stores the reverse direction under -etype, so a
+    row's -T slots are its OUT-neighbors over T, exactly like
+    make_adaptive_go_kernel), then a lexicographic sort + shift-compare
+    dedups (query, vertex) pairs and compacts them to the next static
+    cap.  Work scales with the LIVE frontier (the reference's
+    per-vertex prefix scans touch only frontier vertices too —
+    QueryBaseProcessor.inl:336-405), not with the whole table the way
+    the dense pull does; at interactive frontier sizes this is an order
+    of magnitude less device work AND the result transfer is the pair
+    list, not a bitmap.
+
+    Exactness: overflow past ``caps[h]`` or any frontier contact with a
+    hub vertex (slot spill rows the push can't see) sets the overflow
+    flag; the caller MUST rerun the batch on the dense kernel then.
+
+    fn(ids int32[caps[0]] new-id space (sentinel n_rows = inactive),
+       qid int32[caps[0]], hub bool[n+1], *tables) ->
+    int32 [2 + 2*caps[-1]]: [count, overflow, qids..., ids...] with the
+    live pairs sorted by (qid, id) — a single array so the host pays one
+    transfer."""
+    import jax
+    import jax.numpy as jnp
+    n, n_rows = ell.n, ell.n_rows
+    sentinel = n_rows
+    neg = tuple(-t for t in etypes)
+    d_max = max(ell.bucket_D) if ell.bucket_D else 1
+    nb_count = len(ell.bucket_nbr)
+    bstarts = []
+    acc = 0
+    for nbr_np in ell.bucket_nbr:
+        bstarts.append(acc)
+        acc += nbr_np.shape[0]
+    BIG_Q = jnp.int32(2**30)
+    # when (query, vertex) packs into one int32, the per-hop dedup is a
+    # single-operand sort — measurably cheaper than the 2-key
+    # lexicographic sort (the sort IS the sparse kernel's cost center)
+    R1 = n_rows + 1
+    pack32 = caps[0] * R1 <= 2**31 - 1
+    I32_MAX = jnp.int32(2**31 - 1)
+
+    def hop(ids, qid, hub, nbrs, ets, c_out):
+        c_in = ids.shape[0]
+        cand = jnp.full((c_in, d_max), jnp.int32(sentinel))
+        for nbr, et, bstart in zip(nbrs, ets, bstarts):
+            nbk, D = nbr.shape
+            loc = ids - bstart
+            inb = (loc >= 0) & (loc < nbk)
+            safe = jnp.where(inb, loc, 0)
+            rows = nbr[safe]                      # [c_in, D] row-gathers
+            ok = inb[:, None] & _etype_ok(jnp, et[safe], neg)
+            block = jnp.where(ok, rows, sentinel)
+            if D < d_max:
+                block = jnp.pad(block, ((0, 0), (0, d_max - D)),
+                                constant_values=sentinel)
+            cand = jnp.where(inb[:, None], block, cand)
+        flat_i = cand.reshape(-1)
+        flat_q = jnp.repeat(qid, d_max)
+        valid = flat_i != sentinel
+        if pack32:
+            key = jnp.where(valid, flat_q * R1 + flat_i, I32_MAX)
+            srt = jnp.sort(key)
+            uniq = (srt != I32_MAX) & (srt != jnp.roll(srt, 1))
+            uniq = uniq.at[0].set(srt[0] != I32_MAX)
+            pref = jnp.cumsum(uniq.astype(jnp.int32))
+            cnt = pref[-1]
+            pos = jnp.where(uniq & (pref <= c_out), pref - 1, c_out)
+            out_k = jnp.full((c_out,), I32_MAX).at[pos].set(srt,
+                                                            mode="drop")
+            bad = out_k == I32_MAX
+            out_q = jnp.where(bad, BIG_Q, out_k // R1)
+            out_i = jnp.where(bad, sentinel, out_k % R1)
+        else:
+            key_q = jnp.where(valid, flat_q, BIG_Q)
+            key_i = jnp.where(valid, flat_i, jnp.int32(0))
+            sq, si = jax.lax.sort((key_q, key_i), num_keys=2, dimension=0)
+            prev_q = jnp.roll(sq, 1)
+            prev_i = jnp.roll(si, 1)
+            uniq = (sq != BIG_Q) & ((sq != prev_q) | (si != prev_i))
+            uniq = uniq.at[0].set(sq[0] != BIG_Q)
+            pref = jnp.cumsum(uniq.astype(jnp.int32))
+            cnt = pref[-1]
+            pos = jnp.where(uniq & (pref <= c_out), pref - 1, c_out)
+            out_q = jnp.full((c_out,), BIG_Q).at[pos].set(sq, mode="drop")
+            out_i = jnp.full((c_out,), jnp.int32(sentinel)) \
+                .at[pos].set(si, mode="drop")
+            out_i = jnp.where(out_q == BIG_Q, sentinel, out_i)
+        overflow = cnt > c_out
+        # hub contact check on the NEW frontier (a hub's own slots are
+        # incomplete in its main row)
+        touched_hub = jnp.any(hub[jnp.minimum(out_i, n)]
+                              & (out_i != sentinel))
+        return out_i, out_q, overflow | touched_hub, cnt
+
+    @jax.jit
+    def go(ids0, qid0, hub, *tables):
+        nbrs, ets = tables[:nb_count], tables[nb_count:]
+        ids, qid = ids0, jnp.where(ids0 == sentinel, BIG_Q, qid0)
+        overflow = jnp.any(hub[jnp.minimum(ids, n)] & (ids != sentinel))
+        cnt = jnp.sum(ids != sentinel).astype(jnp.int32)
+        for h in range(max(steps - 1, 0)):
+            ids, qid, ovf_h, cnt = hop(ids, qid, hub, nbrs, ets,
+                                       caps[h + 1])
+            overflow = overflow | ovf_h
+        c_fin = caps[-1]
+        if ids.shape[0] < c_fin:                 # steps == 1: pad up
+            padn = c_fin - ids.shape[0]
+            ids = jnp.pad(ids, (0, padn), constant_values=sentinel)
+            qid = jnp.pad(qid, (0, padn), constant_values=2**30)
+        head = jnp.stack([cnt, overflow.astype(jnp.int32)])
+        return jnp.concatenate(
+            [head, jnp.where(qid == BIG_Q, -1, qid), ids])
 
     return go
 
@@ -371,15 +573,16 @@ def make_adaptive_go_kernel(ell: EllIndex, steps: int,
     directions), so pushing OUT of a frontier member means selecting
     slots with NEGATED etypes.
 
-    fn(start_new_ids int32[K], padded with n_rows — pad host-side so
-    one compiled program serves every start count) ->
-    frontier bitmap int8[n_rows+1] after steps-1 advances (same
-    contract as make_batched_go_kernel's column 0; hub extra rows may
-    hold junk exactly like the batched kernel's)."""
+    fn(start_new_ids int32[K] (padded with n_rows — pad host-side so
+    one compiled program serves every start count), hub bool[n+1],
+    owner, *tables) -> bit-packed frontier uint8[ceil((n_rows+1)/8)]
+    after steps-1 advances (same contract as make_batched_go_kernel's
+    column 0 under pack_bits; hub extra rows may hold junk exactly like
+    the batched kernel's)."""
     import jax
     import jax.numpy as jnp
-    nbr_dev, et_dev, owner_dev = ell.device_arrays()
-    n_rows = ell.n_rows
+    n, n_rows = ell.n, ell.n_rows
+    n_extras, nb_count = len(ell.extra_owner), len(ell.bucket_nbr)
     sentinel = n_rows
     neg = tuple(-t for t in etypes)
     d_max = max(ell.bucket_D) if ell.bucket_D else 1
@@ -387,27 +590,18 @@ def make_adaptive_go_kernel(ell: EllIndex, steps: int,
     # bucket start rows (static) — new ids are contiguous per bucket
     bstarts = []
     acc = 0
-    for nbr in ell.bucket_nbr:
+    for nbr_np in ell.bucket_nbr:
         bstarts.append(acc)
-        acc += nbr.shape[0]
+        acc += nbr_np.shape[0]
 
-    # hub vertices (slots spilling into extra rows) force the dense
-    # path for the hop that sees them — bounded cost either way
-    if len(ell.extra_owner):
-        is_hub = np.zeros(ell.n + 1, dtype=bool)
-        is_hub[np.unique(ell.extra_owner)] = True
-        hub_dev = jnp.asarray(is_hub)
-    else:
-        hub_dev = None
-
-    def slot_rows(fr):
+    def slot_rows(fr, nbrs, ets_t):
         """[K, d_max] slot targets of each frontier row (sentinel where
         absent), OVER-set mask applied."""
         cand = jnp.full((fr.shape[0], d_max), jnp.int32(sentinel))
-        for nbr, et, bstart in zip(nbr_dev, et_dev, bstarts):
-            nb, D = nbr.shape
+        for nbr, et, bstart in zip(nbrs, ets_t, bstarts):
+            nbk, D = nbr.shape
             loc = fr - bstart
-            inb = (loc >= 0) & (loc < nb)
+            inb = (loc >= 0) & (loc < nbk)
             safe = jnp.where(inb, loc, 0)
             rows = nbr[safe]                     # [K, D] row gathers
             ets = et[safe]
@@ -423,61 +617,62 @@ def make_adaptive_go_kernel(ell: EllIndex, steps: int,
         return jnp.zeros((n_rows + 1,), jnp.int8) \
             .at[ids].max(jnp.int8(1)).at[sentinel].set(0)
 
-    def sparse_hop(state):
-        fr, cnt, bitmap, sparse = state
-        cand = slot_rows(fr).reshape(-1)
-        srt = jnp.sort(cand)
-        uniq = (srt != jnp.roll(srt, 1)) & (srt != sentinel)
-        # index 0 is always a first occurrence (roll compares it to the
-        # LAST element, which is wrong for it)
-        uniq = uniq.at[0].set(srt[0] != sentinel)
-        pref = jnp.cumsum(uniq.astype(jnp.int32))
-        cnt2 = pref[-1]
-        pos = jnp.where(uniq & (pref <= K), pref - 1, K)
-        fr2 = jnp.full((K,), jnp.int32(sentinel)) \
-            .at[pos].set(srt, mode="drop")
-        overflow = cnt2 > K
-        # invariant: bitmap always reflects the current frontier, so
-        # the dense branch can take over at any hop (cheap: K-scatter
-        # when staying sparse, full-cand scatter on overflow)
-        bitmap2 = jax.lax.cond(
-            overflow,
-            lambda: bitmap_of(cand),
-            lambda: bitmap_of(fr2))
-        return fr2, cnt2, bitmap2, jnp.logical_not(overflow)
-
-    def dense_hop(state):
-        fr, cnt, bitmap, sparse = state
-        nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
-                        owner_dev, bitmap[:, None])[:, 0]
-        return (jnp.full((K,), jnp.int32(sentinel)),
-                jnp.int32(K + 1), nxt, jnp.bool_(False))
-
     @jax.jit
-    def go(fr0):
+    def go(fr0, hub, owner, *tables):
+        nbrs, ets_t = tables[:nb_count], tables[nb_count:]
+
+        def sparse_hop(state):
+            fr, cnt, bitmap, sparse = state
+            cand = slot_rows(fr, nbrs, ets_t).reshape(-1)
+            srt = jnp.sort(cand)
+            uniq = (srt != jnp.roll(srt, 1)) & (srt != sentinel)
+            # index 0 is always a first occurrence (roll compares it to
+            # the LAST element, which is wrong for it)
+            uniq = uniq.at[0].set(srt[0] != sentinel)
+            pref = jnp.cumsum(uniq.astype(jnp.int32))
+            cnt2 = pref[-1]
+            pos = jnp.where(uniq & (pref <= K), pref - 1, K)
+            fr2 = jnp.full((K,), jnp.int32(sentinel)) \
+                .at[pos].set(srt, mode="drop")
+            overflow = cnt2 > K
+            # invariant: bitmap always reflects the current frontier, so
+            # the dense branch can take over at any hop (cheap: K-scatter
+            # when staying sparse, full-cand scatter on overflow)
+            bitmap2 = jax.lax.cond(
+                overflow,
+                lambda: bitmap_of(cand),
+                lambda: bitmap_of(fr2))
+            return fr2, cnt2, bitmap2, jnp.logical_not(overflow)
+
+        def dense_hop(state):
+            fr, cnt, bitmap, sparse = state
+            nxt = _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets_t,
+                            owner, bitmap[:, None])[:, 0]
+            return (jnp.full((K,), jnp.int32(sentinel)),
+                    jnp.int32(K + 1), nxt, jnp.bool_(False))
+
         bm0 = bitmap_of(fr0)
         cnt0 = jnp.sum(fr0 != sentinel).astype(jnp.int32)
         state = (fr0, cnt0, bm0, cnt0 <= K)
 
         def one(_, st):
-            sparse_ok = st[3]
-            if hub_dev is not None:
-                fr = st[0]
-                hub_in_frontier = jnp.any(
-                    hub_dev[jnp.where(fr < ell.n, fr, ell.n)])
-                sparse_ok = sparse_ok & jnp.logical_not(hub_in_frontier)
+            fr = st[0]
+            hub_in_frontier = jnp.any(
+                hub[jnp.where(fr < n, fr, n)] & (fr != sentinel))
+            sparse_ok = st[3] & jnp.logical_not(hub_in_frontier)
             return jax.lax.cond(sparse_ok, sparse_hop, dense_hop, st)
 
         if steps > 1:
             state = jax.lax.fori_loop(0, steps - 1, one, state)
         fr, cnt, bitmap, sparse = state
-        return bitmap
+        return pack_bits(jnp, bitmap[:, None])[:, 0]
 
-    def entry(start_ids):
+    def entry(start_ids, hub, owner, *tables):
         ids = np.asarray(start_ids, np.int32)[:K]
         fr0 = np.full((K,), np.int32(sentinel))
         fr0[: len(ids)] = ids
-        return go(jnp.asarray(fr0))
+        import jax.numpy as jnp2
+        return go(jnp2.asarray(fr0), hub, owner, *tables)
 
     return entry
 
@@ -485,16 +680,19 @@ def make_adaptive_go_kernel(ell: EllIndex, steps: int,
 def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
                             etypes: Tuple[int, ...],
                             stop_when_found: bool = True):
-    """fn(f0, targets) -> depth int16 [n_rows+1, B] (INT16_INF =
-    unreachable within max_steps).  Batched analogue of
-    kernels.make_bfs_kernel; early exit when every query either stalled
-    or (shortest mode) covered its targets."""
+    """fn(f0, targets, owner, *tables) -> depth [n_rows+1, B]:
+    int8 with -1 = unreachable when max_steps fits (the transfer is 2x
+    smaller and depths are tiny), else int16 with INT16_INF.  Batched
+    analogue of kernels.make_bfs_kernel; early exit when every query
+    either stalled or (shortest mode) covered its targets."""
     import jax
     import jax.numpy as jnp
-    nbr_dev, et_dev, owner_dev = ell.device_arrays()
+    n, n_extras, nb_count = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
+    small = max_steps <= 120
 
     @jax.jit
-    def bfs(f0, targets):
+    def bfs(f0, targets, owner, *tables):
+        nbrs, ets = tables[:nb_count], tables[nb_count:]
         d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
 
         def cond(state):
@@ -508,13 +706,15 @@ def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
 
         def body(state):
             d, f, step = state
-            nxt = _hop_body(jnp, jax, ell, etypes, nbr_dev, et_dev,
-                            owner_dev, f)
+            nxt = _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
+                            owner, f)
             newly = (nxt > 0) & (d == INT16_INF)
             d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
             return d, newly.astype(jnp.int8), step + 1
 
         d, _, _ = jax.lax.while_loop(cond, body, (d0, f0, jnp.int32(0)))
+        if small:
+            return jnp.where(d == INT16_INF, -1, d).astype(jnp.int8)
         return d
 
     return bfs
@@ -553,18 +753,20 @@ def shard_ell(mesh, axis: str, ell: EllIndex):
 
 def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
                                    steps: int, etypes: Tuple[int, ...],
-                                   nbr_shards, et_shards, real_rows):
-    """Sharded-bucket batched GO.  f0 replicated [n_rows+1, B] int8."""
+                                   nbr_shards, et_shards, real_rows,
+                                   pack: bool = False):
+    """Sharded-bucket batched GO.  fn(f0 replicated [n_rows+1, B] int8,
+    owner, *tables)."""
     import jax
+    import jax.numpy as jnp
     hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
                             real_rows)
 
     @jax.jit
-    def go(f0, *tables):
-        if steps <= 1:
-            return f0
-        return jax.lax.fori_loop(0, steps - 1,
-                                 lambda _, f: hop(f, *tables), f0)
+    def go(f0, owner, *tables):
+        out = f0 if steps <= 1 else jax.lax.fori_loop(
+            0, steps - 1, lambda _, f: hop(f, owner, *tables), f0)
+        return pack_bits(jnp, out) if pack else out
 
     return go
 
@@ -572,17 +774,18 @@ def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
 def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
                       etypes: Tuple[int, ...], nbr_shards, et_shards,
                       real_rows):
-    """hop(f, *tables) -> next frontier, with bucket rows expanded on
-    their owning device and the result re-replicated over ICI.  Shared
-    by the sharded GO and BFS builders (same split as _hop_body vs its
-    callers on the single-chip side)."""
+    """hop(f, owner, *tables) -> next frontier, with bucket rows
+    expanded on their owning device and the result re-replicated over
+    ICI.  Shared by the sharded GO and BFS builders (same split as
+    _hop_body vs its callers on the single-chip side)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
 
-    owner = jnp.asarray(ell.extra_owner)
     n_buckets = len(nbr_shards)
+    n_extras = len(ell.extra_owner)
+    n = ell.n
 
     def per_shard(f, *tables):
         nbrs, ets = tables[:n_buckets], tables[n_buckets:]
@@ -597,15 +800,15 @@ def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
 
     replicate = NamedSharding(mesh, P())
 
-    def hop(f, *tables):
+    def hop(f, owner, *tables):
         if n_buckets == 0:                   # empty graph: nothing moves
             return jnp.zeros_like(f)
         outs = sharded_hop(f, *tables)
         trimmed = [o[:r] for o, r in zip(outs, real_rows)]
         nxt = jnp.concatenate(trimmed, axis=0) \
             if len(trimmed) > 1 else trimmed[0]
-        if len(ell.extra_owner):
-            extras = nxt[ell.n:]
+        if n_extras:
+            extras = nxt[n:]
             nxt = nxt.at[owner].max(extras)
         pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
         nxt = jnp.concatenate([nxt, pad], axis=0)
@@ -620,14 +823,16 @@ def make_sharded_batched_bfs_kernel(mesh, axis: str, ell: EllIndex,
                                     nbr_shards, et_shards, real_rows,
                                     stop_when_found: bool = True):
     """Sharded-bucket batched BFS depths — the multi-chip counterpart of
-    make_batched_bfs_kernel, same depth/early-exit semantics."""
+    make_batched_bfs_kernel, same depth/early-exit/compression
+    semantics.  fn(f0, targets, owner, *tables)."""
     import jax
     import jax.numpy as jnp
     hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
                             real_rows)
+    small = max_steps <= 120
 
     @jax.jit
-    def bfs(f0, targets, *tables):
+    def bfs(f0, targets, owner, *tables):
         d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
 
         def cond(state):
@@ -639,13 +844,15 @@ def make_sharded_batched_bfs_kernel(mesh, axis: str, ell: EllIndex,
 
         def body(state):
             d, f, step = state
-            nxt = hop(f, *tables)
+            nxt = hop(f, owner, *tables)
             newly = (nxt > 0) & (d == INT16_INF)
             d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
             return d, newly.astype(jnp.int8), step + 1
 
         d, _, _ = jax.lax.while_loop(
             cond, body, (d0, f0, jnp.int32(0)))
+        if small:
+            return jnp.where(d == INT16_INF, -1, d).astype(jnp.int8)
         return d
 
     return bfs

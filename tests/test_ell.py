@@ -14,6 +14,29 @@ from nebula_tpu.tpu import ell as E  # noqa: E402
 from nebula_tpu.tpu import kernels as K  # noqa: E402
 
 
+def run_go(ix, steps, etypes, f0):
+    """Build + invoke the batched GO kernel with the round-3 calling
+    convention (tables as args); returns the raw int8 frontier."""
+    k = E.make_batched_go_kernel(ix, steps, etypes)
+    return np.asarray(k(jnp.asarray(f0), *ix.kernel_args()))
+
+
+def run_bfs(ix, max_steps, etypes, f0, t0, stop_when_found=True):
+    k = E.make_batched_bfs_kernel(ix, max_steps, etypes,
+                                  stop_when_found=stop_when_found)
+    d = np.asarray(k(jnp.asarray(f0), jnp.asarray(t0), *ix.kernel_args()))
+    if d.dtype == np.int8:           # in-kernel compression (-1 = INF)
+        d = np.where(d < 0, E.INT16_INF, d).astype(np.int16)
+    return d
+
+
+def run_adaptive(ix, steps, etypes, K, start_new_ids):
+    k = E.make_adaptive_go_kernel(ix, steps, etypes, K=K)
+    hub = jnp.asarray(ix.hub_table())
+    packed = np.asarray(k(start_new_ids, hub, *ix.kernel_args()))
+    return E.unpack_bits(packed[:, None], ix.n_rows + 1)[:, 0]
+
+
 def np_multi_hop(n, es, ed, ok, starts_per_query, steps):
     nq = len(starts_per_query)
     fr = np.zeros((n, nq), bool)
@@ -45,10 +68,15 @@ def test_batched_go_parity_random(cap, min_d):
         exp = np_multi_hop(n, es, ed, ok, starts, steps)
 
         ix = E.EllIndex.build(es, ed, ee, n, cap=cap, min_d=min_d)
-        go = E.make_batched_go_kernel(ix, steps, etypes)
         f0 = ix.start_frontier([np.asarray(s) for s in starts], B=128)
-        got = ix.to_old(np.asarray(go(jnp.asarray(f0))))[:, :5] > 0
+        got = ix.to_old(run_go(ix, steps, etypes, f0))[:, :5] > 0
         np.testing.assert_array_equal(got, exp)
+
+        # packed output variant must round-trip to the same frontier
+        kp = E.make_batched_go_kernel(ix, steps, etypes, pack=True)
+        packed = np.asarray(kp(jnp.asarray(f0), *ix.kernel_args()))
+        unp = E.unpack_bits(packed, ix.n_rows + 1)
+        np.testing.assert_array_equal(ix.to_old(unp)[:, :5], exp)
 
 
 def test_hub_rows_split_and_merge():
@@ -59,15 +87,14 @@ def test_hub_rows_split_and_merge():
     ee = np.ones(50, dtype=np.int32)
     ix = E.EllIndex.build(es, ed, ee, n, cap=8, min_d=1)
     assert len(ix.extra_owner) >= 1
-    go = E.make_batched_go_kernel(ix, 2, (1,))
     f0 = ix.start_frontier([np.asarray([49])], B=128)
-    got = ix.to_old(np.asarray(go(jnp.asarray(f0))))[:, 0] > 0
+    got = ix.to_old(run_go(ix, 2, (1,), f0))[:, 0] > 0
     exp = np.zeros(n, bool)
     exp[55] = True                               # only the hub reached
     np.testing.assert_array_equal(got, exp)
     # start that is NOT an in-neighbor reaches nothing
     f0 = ix.start_frontier([np.asarray([55])], B=128)
-    got = ix.to_old(np.asarray(go(jnp.asarray(f0))))[:, 0] > 0
+    got = ix.to_old(run_go(ix, 2, (1,), f0))[:, 0] > 0
     assert not got.any()
 
 
@@ -79,10 +106,9 @@ def test_batched_vs_edge_list_kernel():
     ee = rng.choice([1, 2], m).astype(np.int32)
     steps = 3
     ix = E.EllIndex.build(es, ed, ee, n, cap=16, min_d=4)
-    go = E.make_batched_go_kernel(ix, steps, (1,))
     start = np.arange(6, dtype=np.int32)
     f0 = ix.start_frontier([start], B=128)
-    got = ix.to_old(np.asarray(go(jnp.asarray(f0))))[:, 0] > 0
+    got = ix.to_old(run_go(ix, steps, (1,), f0))[:, 0] > 0
 
     ref = K.make_go_kernel(n, steps, (1,))(
         jnp.asarray(es), jnp.asarray(ed), jnp.asarray(ee),
@@ -97,10 +123,9 @@ def test_batched_bfs_depths():
     ee = np.ones(10, np.int32)
     n = 10
     ix = E.EllIndex.build(es, ed, ee, n, cap=4, min_d=1)
-    bfs = E.make_batched_bfs_kernel(ix, 8, (1,), stop_when_found=False)
     f0 = ix.start_frontier([np.asarray([0]), np.asarray([3])], B=128)
     t0 = ix.start_frontier([np.asarray([9]), np.asarray([9])], B=128)
-    d = np.asarray(bfs(jnp.asarray(f0), jnp.asarray(t0)))[ix.perm]
+    d = run_bfs(ix, 8, (1,), f0, t0, stop_when_found=False)[ix.perm]
     # query 0: depth of 9 is 0->5(1) ..9 => 1+4=5
     assert d[9, 0] == 5
     assert d[5, 0] == 1
@@ -114,10 +139,9 @@ def test_bfs_early_exit_shortest():
     ed = np.array([1, 2], np.int32)
     ee = np.ones(2, np.int32)
     ix = E.EllIndex.build(es, ed, ee, 3, cap=2, min_d=1)
-    bfs = E.make_batched_bfs_kernel(ix, 100, (1,), stop_when_found=True)
     f0 = ix.start_frontier([np.asarray([0])], B=128)
     t0 = ix.start_frontier([np.asarray([1])], B=128)
-    d = np.asarray(bfs(jnp.asarray(f0), jnp.asarray(t0)))[ix.perm]
+    d = run_bfs(ix, 100, (1,), f0, t0, stop_when_found=True)[ix.perm]
     assert d[1, 0] == 1     # target found; loop exited without error
 
 
@@ -133,14 +157,14 @@ def test_sharded_batched_go_parity():
     starts = [rng.integers(0, n, 3) for _ in range(4)]
     f0 = jnp.asarray(ix.start_frontier([np.asarray(s) for s in starts],
                                        B=128))
-    single = E.make_batched_go_kernel(ix, steps, (1,))
-    ref = np.asarray(single(f0))
+    ref = run_go(ix, steps, (1,), f0)
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("parts",))
     nbrs, ets, reals = E.shard_ell(mesh, "parts", ix)
     go = E.make_sharded_batched_go_kernel(mesh, "parts", ix, steps, (1,),
                                           nbrs, ets, reals)
-    got = np.asarray(go(f0, *nbrs, *ets))
+    owner = jnp.asarray(ix.extra_owner)
+    got = np.asarray(go(f0, owner, *nbrs, *ets))
     np.testing.assert_array_equal(got, ref)
 
 
@@ -312,11 +336,11 @@ def test_adaptive_kernel_parity_random():
         ix = E.EllIndex.build(es2, ed2, ee2, n, cap=int(rng.choice([8, 64])),
                               min_d=4)
         starts = rng.integers(0, n, int(rng.integers(1, 5)))
-        ref = E.make_batched_go_kernel(ix, steps, (1,))
-        exp = ix.to_old(np.asarray(
-            ref(jnp.asarray(ix.start_frontier([starts], B=128)))))[:, 0] > 0
-        ad = E.make_adaptive_go_kernel(ix, steps, (1,), K=K)
-        got = ix.to_old(np.asarray(ad(jnp.asarray(ix.perm[starts])))) > 0
+        exp = ix.to_old(run_go(ix, steps, (1,),
+                               ix.start_frontier([starts],
+                                                 B=128)))[:, 0] > 0
+        got = ix.to_old(run_adaptive(ix, steps, (1,), K,
+                                     ix.perm[starts])) > 0
         np.testing.assert_array_equal(got, exp)
 
 
@@ -364,9 +388,64 @@ def test_adaptive_hub_in_frontier_switches_dense():
     ix = E.EllIndex.build(es2, ed2, ee2, n, cap=16, min_d=4)
     assert len(ix.extra_owner) > 0                 # hub rows exist
     for steps in (2, 4):
-        ref = E.make_batched_go_kernel(ix, steps, (1,))
-        exp = ix.to_old(np.asarray(ref(jnp.asarray(
-            ix.start_frontier([np.asarray([7])], B=128)))))[:, 0] > 0
-        ad = E.make_adaptive_go_kernel(ix, steps, (1,), K=64)
-        got = ix.to_old(np.asarray(ad(ix.perm[np.asarray([7])]))) > 0
+        exp = ix.to_old(run_go(ix, steps, (1,),
+                               ix.start_frontier([np.asarray([7])],
+                                                 B=128)))[:, 0] > 0
+        got = ix.to_old(run_adaptive(ix, steps, (1,), 64,
+                                     ix.perm[np.asarray([7])])) > 0
         np.testing.assert_array_equal(got, exp)
+
+
+def test_sparse_batched_go_parity_random():
+    """Sparse pair-list batched GO vs the dense kernel on random
+    mirror-shaped graphs.  Small caps must REPORT overflow (the caller
+    then reruns dense) — never return silently-wrong pairs; roomy caps
+    must match the dense frontier exactly."""
+    rng = np.random.default_rng(31)
+    verified = 0
+    for trial in range(8):
+        n = int(rng.integers(10, 400))
+        m = int(rng.integers(0, 2500))
+        es = rng.integers(0, n, m).astype(np.int32)
+        ed = rng.integers(0, n, m).astype(np.int32)
+        ee = rng.choice([1, 2], m).astype(np.int32)
+        es2 = np.concatenate([es, ed])
+        ed2 = np.concatenate([ed, es])
+        ee2 = np.concatenate([ee, -ee])
+        steps = int(rng.integers(2, 5))
+        ix = E.EllIndex.build(es2, ed2, ee2, n,
+                              cap=int(rng.choice([16, 64])), min_d=4)
+        nq = int(rng.integers(1, 6))
+        starts = [np.unique(rng.integers(0, n, int(rng.integers(1, 4))))
+                  for _ in range(nq)]
+        exp = ix.to_old(run_go(ix, steps, (1,),
+                               ix.start_frontier(starts,
+                                                 B=128)))[:, :nq] > 0
+        d_max = max(ix.bucket_D) if ix.bucket_D else 1
+        c0 = 64
+        cap = int(rng.choice([64, 1 << 17]))     # tight cap forces overflow
+        caps = E.sparse_caps(c0, d_max, steps, cap)
+        kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
+        ids = np.full(c0, ix.n_rows, np.int32)
+        qid = np.zeros(c0, np.int32)
+        o = 0
+        for q, s in enumerate(starts):
+            newi = np.sort(ix.perm[s])
+            ids[o:o + len(newi)] = newi
+            qid[o:o + len(newi)] = q
+            o += len(newi)
+        hub = jnp.asarray(ix.hub_table())
+        out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
+                              *ix.kernel_args()[1:]))
+        c_fin = (len(out) - 2) // 2
+        if out[1]:      # overflow/hub reported — dense fallback covers it
+            continue
+        qids = out[2:2 + c_fin]
+        vnew = out[2 + c_fin:]
+        live = qids >= 0
+        got = np.zeros((n, nq), bool)
+        if live.any():
+            got[ix.inv[vnew[live]], qids[live]] = True
+        np.testing.assert_array_equal(got, exp, err_msg=f"trial {trial}")
+        verified += 1
+    assert verified >= 2, "every trial overflowed; caps too tight to test"

@@ -318,7 +318,8 @@ class TestFrontierEdges:
             frontier[mir.edge_src]
             & np.isin(mir.edge_etype, np.asarray(et_tuple, np.int32)))[0]
         got = TpuQueryRuntime._frontier_edges(
-            TpuQueryRuntime.__new__(TpuQueryRuntime), mir, frontier, et_tuple)
+            TpuQueryRuntime.__new__(TpuQueryRuntime), mir,
+            np.nonzero(frontier)[0], et_tuple)
         assert np.array_equal(got, flat)
 
 
