@@ -85,6 +85,7 @@ class StorageNode:
         self.service.shutdown()
         if self.raft_service is not None:
             self.raft_service.stop()
+        self.kv.stop()
 
 
 class LocalCluster:

@@ -48,6 +48,10 @@ class ErrorCode(enum.IntEnum):
     E_IMPROPER_DATA_TYPE = -108
     E_FILTER_OUT = -109
     E_INVALID_FILTER = -110
+    # consensus outcome is UNKNOWN (entries remain in the leader log and
+    # may still commit) — distinct from a definite rejection so clients
+    # don't blindly retry non-idempotent ops into a double-apply
+    E_RESULT_UNKNOWN = -111
 
     # Meta
     E_NO_HOSTS = -200

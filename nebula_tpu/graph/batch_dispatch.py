@@ -106,18 +106,26 @@ class GoBatchDispatcher:
                 if st.dispatching or not st.queue:
                     st.cond.wait()
                     continue
-                # become the leader for the next batch
+                # become the leader for the next batch.  ANY failure
+                # between taking leadership and entering _run (whose
+                # finally hands it back) must reset `dispatching`, or
+                # every future request on this key waits forever
                 st.dispatching = True
-                window = flags.get("go_batch_window_ms") or 0
-                if window > 0:
-                    st.cond.release()
-                    try:
-                        time.sleep(window / 1000.0)
-                    finally:
-                        st.cond.acquire()
-                max_b = int(flags.get("go_batch_max") or 1024)
-                batch = st.queue[:max_b]
-                del st.queue[:max_b]
+                try:
+                    window = float(flags.get("go_batch_window_ms") or 0)
+                    if window > 0:
+                        st.cond.release()
+                        try:
+                            time.sleep(window / 1000.0)
+                        finally:
+                            st.cond.acquire()
+                    max_b = int(flags.get("go_batch_max") or 1024)
+                    batch = st.queue[:max_b]
+                    del st.queue[:max_b]
+                except BaseException:       # cond is held here
+                    st.dispatching = False
+                    st.cond.notify_all()
+                    raise
                 st.cond.release()
                 released = [False]
 

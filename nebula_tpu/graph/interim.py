@@ -17,6 +17,72 @@ from ..common.status import ErrorCode, Status, StatusOr
 Value = object
 
 
+class ColumnarRows:
+    """Lazy list-of-rows facade over per-column value lists — the
+    serving path's result transport.
+
+    Why: the batched device path materializes ~half a million result
+    rows per dispatch; building that many single-row Python lists
+    eagerly dominated the assembly profile and fed the cyclic GC
+    millions of objects (collections grew with every batch).  Columns
+    stay flat until someone actually reads rows — most serving clients
+    (perf tools, piped executors that only count, the wire encoder)
+    never do, or do so once at the edge.
+
+    The reference has the same idea in reverse: responses carry encoded
+    RowSetReader blobs and clients decode rows lazily
+    (/root/reference/src/dataman/RowSetReader.h).
+    """
+
+    __slots__ = ("_cols", "_n", "_rows")
+
+    def __init__(self, cols: List[List[Value]], n: int):
+        self._cols = cols
+        self._n = n
+        self._rows: Optional[List[List[Value]]] = None
+
+    def _mat(self) -> List[List[Value]]:
+        if self._rows is None:
+            cols = self._cols
+            if len(cols) == 1:
+                self._rows = [[v] for v in cols[0]]
+            else:
+                self._rows = [list(t) for t in zip(*cols)]
+            self._cols = None       # columns die once rows exist
+        return self._rows
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __add__(self, other):
+        return self._mat() + list(other)
+
+    def __radd__(self, other):
+        return list(other) + self._mat()
+
+    def __eq__(self, other):
+        if isinstance(other, ColumnarRows):
+            other = other._mat()
+        return self._mat() == other
+
+    def to_wire(self):
+        """Plain list-of-lists for the msgpack boundary
+        (interface/rpc.py packs unknown objects via this hook)."""
+        return self._mat()
+
+    def __repr__(self) -> str:
+        return f"ColumnarRows({self._n} rows)"
+
+
 class InterimResult:
     __slots__ = ("columns", "rows", "_index")
 

@@ -38,8 +38,18 @@ class RpcError(Exception):
         self.status = status
 
 
+def _wire_default(o):
+    """Objects exposing ``to_wire()`` (e.g. graph.interim.ColumnarRows)
+    flatten to plain msgpack types only when a payload actually crosses
+    a socket — loopback channels pass them by reference."""
+    w = getattr(o, "to_wire", None)
+    if w is not None:
+        return w()
+    raise TypeError(f"cannot msgpack {type(o).__name__}")
+
+
 def _pack(obj: Any) -> bytes:
-    return msgpack.packb(obj, use_bin_type=True)
+    return msgpack.packb(obj, use_bin_type=True, default=_wire_default)
 
 
 def _unpack(data: bytes) -> Any:

@@ -53,37 +53,99 @@ _TOMBSTONE_LEN = 0xFFFFFFFF
 _TOMBSTONE = object()             # memtable sentinel
 
 
-class _Run:
-    """One immutable sorted run file with a sparse key index."""
+class _PreadReader:
+    """Buffered sequential reader over ``os.pread`` — every reader owns
+    its own position, so any number of concurrent scans share one file
+    descriptor without perturbing each other (``os.dup`` would NOT do:
+    dup'd descriptors share the file offset, and concurrent seeks
+    corrupt each other's reads)."""
 
-    __slots__ = ("path", "index_keys", "index_offs", "size")
+    __slots__ = ("_fd", "_off", "_buf", "_bo")
+    CHUNK = 1 << 16
+
+    def __init__(self, fd: int, off: int = 0):
+        self._fd = fd
+        self._off = off
+        self._buf = b""
+        self._bo = 0
+
+    def read(self, n: int) -> bytes:
+        out = []
+        need = n
+        while need > 0:
+            avail = len(self._buf) - self._bo
+            if avail == 0:
+                self._buf = os.pread(self._fd, max(self.CHUNK, need),
+                                     self._off)
+                self._bo = 0
+                if not self._buf:
+                    break
+                self._off += len(self._buf)
+                avail = len(self._buf)
+            take = min(avail, need)
+            out.append(self._buf[self._bo:self._bo + take])
+            self._bo += take
+            need -= take
+        return b"".join(out)
+
+    def skip(self, n: int) -> None:
+        avail = len(self._buf) - self._bo
+        if n <= avail:
+            self._bo += n
+        else:
+            self._off += n - avail
+            self._buf = b""
+            self._bo = 0
+
+
+class _Run:
+    """One immutable sorted run file with a sparse key index.
+
+    The run holds its file OPEN for its whole lifetime and every scan
+    reads through pread on that descriptor: a compaction may unlink the
+    file at any time (``_compact_offline``), but readers that captured
+    this run in their snapshot keep reading the unlinked inode — the
+    POSIX equivalent of RocksDB keeping SSTs alive via table readers
+    while a version edit drops them.  The descriptor closes when the
+    last reference to the run is garbage-collected."""
+
+    __slots__ = ("path", "index_keys", "index_offs", "size", "_fd")
 
     def __init__(self, path: str, index_every: int = 64):
         self.path = path
         self.index_keys: List[bytes] = []
         self.index_offs: List[int] = []
         self.size = os.path.getsize(path)
-        with open(path, "rb") as f:
-            off = 0
-            i = 0
-            while off + _FRAME.size <= self.size:
-                hdr = f.read(_FRAME.size)
-                if len(hdr) < _FRAME.size:
-                    break
-                klen, vlen = _FRAME.unpack(hdr)
-                real_vlen = 0 if vlen == _TOMBSTONE_LEN else vlen
-                if off + _FRAME.size + klen + real_vlen > self.size:
-                    break                     # torn tail — ignore
-                if i % index_every == 0:
-                    key = f.read(klen)
-                    self.index_keys.append(key)
-                    self.index_offs.append(off)
-                    f.seek(real_vlen, os.SEEK_CUR)
-                else:
-                    f.seek(klen + real_vlen, os.SEEK_CUR)
-                off += _FRAME.size + klen + real_vlen
-                i += 1
-            self.size = off                   # exclude any torn tail
+        self._fd = os.open(path, os.O_RDONLY)
+        f = _PreadReader(self._fd)
+        off = 0
+        i = 0
+        while off + _FRAME.size <= self.size:
+            hdr = f.read(_FRAME.size)
+            if len(hdr) < _FRAME.size:
+                break
+            klen, vlen = _FRAME.unpack(hdr)
+            real_vlen = 0 if vlen == _TOMBSTONE_LEN else vlen
+            if off + _FRAME.size + klen + real_vlen > self.size:
+                break                     # torn tail — ignore
+            if i % index_every == 0:
+                key = f.read(klen)
+                self.index_keys.append(key)
+                self.index_offs.append(off)
+                f.skip(real_vlen)
+            else:
+                f.skip(klen + real_vlen)
+            off += _FRAME.size + klen + real_vlen
+            i += 1
+        self.size = off                   # exclude any torn tail
+
+    def __del__(self, _close=os.close):
+        # _close bound at class-definition time: module globals may
+        # already be None during interpreter shutdown
+        try:
+            _close(self._fd)
+        except (OSError, AttributeError, TypeError):
+            pass
 
     def _seek_offset(self, key: bytes) -> int:
         """Largest indexed offset whose key <= key (0 if none)."""
@@ -92,24 +154,25 @@ class _Run:
 
     def scan(self, start: bytes = b"",
              from_offset: Optional[int] = None) -> Iterator[Tuple[bytes, object]]:
-        """Frames with key >= start; tombstones yield _TOMBSTONE."""
+        """Frames with key >= start; tombstones yield _TOMBSTONE.
+        Each scan owns an independent pread cursor — concurrent scans
+        (and compactions unlinking the file) cannot disturb it."""
         off = self._seek_offset(start) if from_offset is None else from_offset
-        with open(self.path, "rb") as f:
-            f.seek(off)
-            while off + _FRAME.size <= self.size:
-                hdr = f.read(_FRAME.size)
-                if len(hdr) < _FRAME.size:
-                    break
-                klen, vlen = _FRAME.unpack(hdr)
-                key = f.read(klen)
-                if vlen == _TOMBSTONE_LEN:
-                    val: object = _TOMBSTONE
-                    off += _FRAME.size + klen
-                else:
-                    val = f.read(vlen)
-                    off += _FRAME.size + klen + vlen
-                if key >= start:
-                    yield key, val
+        f = _PreadReader(self._fd, off)
+        while off + _FRAME.size <= self.size:
+            hdr = f.read(_FRAME.size)
+            if len(hdr) < _FRAME.size:
+                break
+            klen, vlen = _FRAME.unpack(hdr)
+            key = f.read(klen)
+            if vlen == _TOMBSTONE_LEN:
+                val: object = _TOMBSTONE
+                off += _FRAME.size + klen
+            else:
+                val = f.read(vlen)
+                off += _FRAME.size + klen + vlen
+            if key >= start:
+                yield key, val
 
     def get(self, key: bytes) -> Optional[object]:
         """value bytes, _TOMBSTONE, or None (absent in this run)."""
@@ -164,6 +227,7 @@ class DiskEngine(KVEngine):
         self._next_run = 1
         self._lock = threading.RLock()
         self._batch_depth = 0     # >0: suppress auto-flush (write_batch)
+        self._compacting = False  # one background compaction in flight
         self._load_manifest()
 
     # ---- manifest ----------------------------------------------------
@@ -177,10 +241,20 @@ class DiskEngine(KVEngine):
         with open(path) as f:
             m = json.load(f)
         self._next_run = int(m.get("next_run", 1))
+        listed = set(m.get("runs", []))
         for name in m.get("runs", []):
             rp = os.path.join(self.dir, name)
             if os.path.exists(rp):
                 self._runs.append(_Run(rp, self.index_every))
+        # crash hygiene: a compaction that died between writing its
+        # merged run and committing the manifest leaves an orphan file
+        for name in os.listdir(self.dir):
+            if name.startswith("run.") and name.endswith(".sst") \
+                    and name not in listed:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
 
     def _commit_manifest(self) -> None:
         tmp = self._manifest_path() + ".tmp"
@@ -195,9 +269,12 @@ class DiskEngine(KVEngine):
     # ---- memtable flush ----------------------------------------------
     def _write_run(self, items: Iterator[Tuple[bytes, object]]) -> Optional[_Run]:
         """Write sorted (key, value|_TOMBSTONE) items to a new fsynced
-        run file; returns the loaded _Run (None if empty)."""
-        name = f"run.{self._next_run:06d}.sst"
-        self._next_run += 1
+        run file; returns the loaded _Run (None if empty).  Only the
+        run-id draw takes the lock, so the O(items) write can run
+        outside it (background compaction)."""
+        with self._lock:
+            name = f"run.{self._next_run:06d}.sst"
+            self._next_run += 1
         path = os.path.join(self.dir, name)
         wrote = False
         with open(path, "wb") as f:
@@ -226,14 +303,37 @@ class DiskEngine(KVEngine):
             self._commit_manifest()
         self._mem = SortedDict()
         self._mem_bytes = 0
-        if len(self._runs) >= self.compact_after_runs:
-            self._compact_locked()
+        if len(self._runs) >= self.compact_after_runs \
+                and not self._compacting:
+            # compaction is O(dataset): run it on a background thread,
+            # NEVER inline — flushes happen on the raft commit path
+            # under the part lock, and a synchronous merge there stalls
+            # appends/heartbeats into election timeouts (ADVICE round 2)
+            import threading
+            self._compacting = True
+            threading.Thread(target=self._bg_compact, daemon=True,
+                             name="disk-compact").start()
 
     def flush_memtable(self) -> None:
         """Persist the memtable now (used by tests and the durable
         watermark)."""
         with self._lock:
             self._flush_mem_locked()
+
+    def close(self) -> None:
+        """Flush and quiesce: waits out any background compaction so
+        the directory can be handed to another DiskEngine (manifests
+        are single-owner — reopening while a background merge is live
+        races the manifest swap and the orphan cleanup, exactly like
+        reopening a RocksDB dir before Close())."""
+        import time
+        with self._lock:
+            self._flush_mem_locked()
+        while True:
+            with self._lock:
+                if not self._compacting:
+                    return
+            time.sleep(0.002)
 
     def _maybe_flush(self) -> None:
         if self._mem_bytes >= self.mem_limit_bytes \
@@ -397,61 +497,129 @@ class DiskEngine(KVEngine):
             with open(path, "rb") as f:
                 while True:
                     hdr = f.read(_FRAME.size)
-                    if len(hdr) < _FRAME.size:
+                    if not hdr:
                         return
+                    if len(hdr) < _FRAME.size:
+                        raise ValueError("torn frame header")
                     klen, vlen = _FRAME.unpack(hdr)
                     k = f.read(klen)
-                    v = f.read(vlen) if vlen != _TOMBSTONE_LEN else _TOMBSTONE
+                    if len(k) != klen:
+                        raise ValueError("torn key")
+                    if vlen == _TOMBSTONE_LEN:
+                        v: object = _TOMBSTONE
+                    else:
+                        v = f.read(vlen)
+                        if len(v) != vlen:
+                            raise ValueError("torn value")
                     yield k, v
 
         # cheap first pass: sorted files stream straight into a run;
-        # unsorted ones (hand-built snapshots) sort in memory first
-        sorted_ok = True
-        prev = None
-        for k, _ in frames():
-            if prev is not None and k <= prev:   # dup keys need last-wins
-                sorted_ok = False                # dedup too — not "sorted"
-                break
-            prev = k
-        with self._lock:
-            # shadowing: the ingested run must rank newer than the
-            # current memtable contents, so flush the memtable first
-            self._flush_mem_locked()
-            if sorted_ok:
-                run = self._write_run(frames())
-            else:
-                dedup = {}                    # file order: last wins
-                for k, v in frames():
-                    dedup[k] = v
-                run = self._write_run(iter(sorted(dedup.items())))
-            if run is not None:
-                self._runs.append(run)
-                self._commit_manifest()
+        # unsorted ones (hand-built snapshots) sort in memory first.
+        # Torn/short frames fail the WHOLE ingest up front — silently
+        # loading a truncated snapshot as garbage keys corrupts the
+        # space (ADVICE round 2)
+        try:
+            sorted_ok = True
+            prev = None
+            for k, _ in frames():
+                if prev is not None and k <= prev:   # dups need last-wins
+                    sorted_ok = False                # dedup — not "sorted"
+                    break
+                prev = k
+            with self._lock:
+                # shadowing: the ingested run must rank newer than the
+                # current memtable contents, so flush the memtable first
+                self._flush_mem_locked()
+                if sorted_ok:
+                    run = self._write_run(frames())
+                else:
+                    dedup = {}                    # file order: last wins
+                    for k, v in frames():
+                        dedup[k] = v
+                    run = self._write_run(iter(sorted(dedup.items())))
+                if run is not None:
+                    self._runs.append(run)
+                    self._commit_manifest()
+        except ValueError as e:
+            return Status.Error(f"malformed snapshot {path}: {e}",
+                                ErrorCode.E_UNKNOWN)
         return Status.OK()
 
     def compact(self) -> Status:
         """Merge memtable + every run into one, dropping tombstones and
-        filter-rejected rows (reference NebulaCompactionFilterFactory)."""
+        filter-rejected rows (reference NebulaCompactionFilterFactory).
+        Waits out any in-flight background compaction, then merges —
+        the engine lock is NOT held during the O(dataset) merge."""
+        import time
         with self._lock:
-            self._compact_locked()
+            self._flush_mem_locked()
+        while True:
+            with self._lock:
+                if not self._compacting:
+                    self._compacting = True
+                    break
+            time.sleep(0.002)
+        try:
+            self._compact_offline()
+        finally:
+            with self._lock:
+                self._compacting = False
         return Status.OK()
 
-    def _compact_locked(self) -> None:
+    def _bg_compact(self) -> None:
+        try:
+            while True:
+                self._compact_offline()
+                with self._lock:
+                    # runs flushed DURING the merge can push the count
+                    # back over the threshold; nothing else re-triggers
+                    # until the next flush, so re-check here
+                    if len(self._runs) < self.compact_after_runs:
+                        return
+        finally:
+            with self._lock:
+                self._compacting = False
+
+    def _compact_offline(self) -> None:
+        """Merge the run set captured at entry into one run without
+        holding the engine lock for the merge.  The merged run replaces
+        exactly the captured prefix of self._runs (runs only ever
+        append at the tail, and compactions are single-flight), so it
+        becomes the new BASE — which is what makes dropping tombstones
+        and filter-rejected rows safe: nothing older can resurface.
+        Readers that captured the old run list keep reading the
+        unlinked files through their open descriptors (_Run)."""
+        with self._lock:
+            base = list(self._runs)
+        if not base:
+            return
+        # a SINGLE run still compacts: it can hold tombstones and
+        # filter-rejected (e.g. TTL-expired) rows that only a rewrite
+        # drops — an admin COMPACT must purge them (the reference's
+        # CompactionFilter contract)
         cf = self.compaction_filter
 
         def survivors():
-            for k, v in self._merged(b""):
+            sources = [r.scan(b"") for r in reversed(base)]  # newest 1st
+            for k, v in _merge_sources(sources):
+                if v is _TOMBSTONE:
+                    continue
                 if cf is not None and cf(k, v):
                     continue
                 yield k, v
 
         run = self._write_run(survivors())
-        old = self._runs
-        self._runs = [run] if run is not None else []
-        self._mem = SortedDict()
-        self._mem_bytes = 0
-        self._commit_manifest()
-        for r in old:
+        with self._lock:
+            if self._runs[:len(base)] == base:
+                self._runs = (([run] if run is not None else [])
+                              + self._runs[len(base):])
+                self._commit_manifest()
+                doomed = base
+            else:
+                # lost a race (shouldn't happen under single-flight) —
+                # discard the merged run, keep state untouched
+                doomed = [run] if run is not None else []
+        for r in doomed:
             try:
                 os.remove(r.path)
             except OSError:

@@ -315,6 +315,16 @@ class NebulaStore:
         return p.merge(key, operand) if st.ok() else st
 
     # ---- maintenance -------------------------------------------------
+    def stop(self) -> None:
+        """Quiesce every engine (flush + wait out background
+        compactions) so the data directories can be reopened — the
+        RocksDB Close() analogue."""
+        for sd in self.spaces.values():
+            for e in sd.engines:
+                close = getattr(e, "close", None)
+                if close is not None:
+                    close()
+
     def compact(self, space_id: GraphSpaceID) -> Status:
         sd = self.spaces.get(space_id)
         if sd is None:

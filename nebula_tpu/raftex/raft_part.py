@@ -56,6 +56,10 @@ flags.define("raft_pipeline_depth", 4,
              "max concurrently replicating append batches per part "
              "(reference Host request pipelining, Host.h:26-118); 1 = "
              "round 1's one-batch-in-flight behavior")
+flags.define("raft_commit_recheck_ms", 300,
+             "how long a leader re-checks the commit watermark after a "
+             "failed quorum round before reporting E_RESULT_UNKNOWN "
+             "(the entries stay in the WAL and may commit late)")
 flags.define("raft_reorder_wait_s", 0.05,
              "follower hold-back for out-of-order pipelined appends: "
              "wait this long for the preceding batch before answering "
@@ -347,8 +351,9 @@ class RaftPart:
                     elif self.role != Role.LEADER:
                         st = self._not_leader()
                     else:
-                        st = Status.Error("quorum not reached",
-                                          ErrorCode.E_CONSENSUS_ERROR)
+                        st = None      # ambiguous — recheck below
+                if st is None:
+                    st = self._await_late_commit(term, entries[-1].log_id)
                 for w in waiters:
                     w.set(st)
         finally:
@@ -357,6 +362,28 @@ class RaftPart:
                 again = bool(self._pending) and self.role == Role.LEADER
             if again:
                 self.executor.submit(self._drive)
+
+    def _await_late_commit(self, term: int, last_id: int) -> Status:
+        """A batch's own quorum round failed, but its entries remain in
+        the leader WAL and can still commit via a later pipelined batch
+        or heartbeat catch-up.  Re-check the commit watermark briefly
+        before reporting, and if still uncommitted return a DISTINCT
+        result-unknown code: a client that retries a non-idempotent op
+        (OP_MERGE) on a definite-failure code would double-apply if the
+        original lands after all (ADVICE round 2)."""
+        deadline = time.time() + \
+            (flags.get("raft_commit_recheck_ms", 300) / 1000.0)
+        while time.time() < deadline:
+            with self._lock:
+                if self.term != term or self.role != Role.LEADER:
+                    return self._not_leader()
+                if self.committed_id >= last_id:
+                    return Status.OK()
+            time.sleep(0.01)
+        return Status.Error(
+            "result unknown: quorum not reached — entries remain in the "
+            "leader log and may still commit; do not blindly retry "
+            "non-idempotent ops", ErrorCode.E_RESULT_UNKNOWN)
 
     def _cas_read(self, key: bytes) -> bytes:
         """Read applied state for CAS (engine read via commit handler's

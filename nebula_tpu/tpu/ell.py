@@ -480,7 +480,7 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
     pack32 = caps[0] * R1 <= 2**31 - 1
     I32_MAX = jnp.int32(2**31 - 1)
 
-    def hop(ids, qid, hub, nbrs, ets, c_out):
+    def hop(ids, qid, hub, nbrs, ets, c_out, check_hub):
         c_in = ids.shape[0]
         cand = jnp.full((c_in, d_max), jnp.int32(sentinel))
         for nbr, et, bstart in zip(nbrs, ets, bstarts):
@@ -527,11 +527,15 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                 .at[pos].set(si, mode="drop")
             out_i = jnp.where(out_q == BIG_Q, sentinel, out_i)
         overflow = cnt > c_out
-        # hub contact check on the NEW frontier (a hub's own slots are
-        # incomplete in its main row)
-        touched_hub = jnp.any(hub[jnp.minimum(out_i, n)]
-                              & (out_i != sentinel))
-        return out_i, out_q, overflow | touched_hub, cnt
+        if check_hub:
+            # hub contact invalidates the frontier only as a PUSH
+            # SOURCE (a hub's own slots are incomplete in its main
+            # row); the final hop's output is assembled host-side from
+            # the complete CSR, so it may freely contain hubs
+            touched_hub = jnp.any(hub[jnp.minimum(out_i, n)]
+                                  & (out_i != sentinel))
+            overflow = overflow | touched_hub
+        return out_i, out_q, overflow, cnt
 
     @jax.jit
     def go(ids0, qid0, hub, *tables):
@@ -541,7 +545,8 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
         cnt = jnp.sum(ids != sentinel).astype(jnp.int32)
         for h in range(max(steps - 1, 0)):
             ids, qid, ovf_h, cnt = hop(ids, qid, hub, nbrs, ets,
-                                       caps[h + 1])
+                                       caps[h + 1],
+                                       check_hub=h < steps - 2)
             overflow = overflow | ovf_h
         c_fin = caps[-1]
         if ids.shape[0] < c_fin:                 # steps == 1: pad up

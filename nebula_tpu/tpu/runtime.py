@@ -534,21 +534,40 @@ class TpuQueryRuntime:
         B queries; returns a zero-arg resolver -> (per-query ascending
         dense-id frontier arrays, mirror).  Selection order: host-only
         (steps==1) → sparse pair-list → adaptive single → dense
-        bit-packed, with sparse overflow re-running dense."""
+        bit-packed, with sparse overflow re-running dense.
+
+        The start sets ride ONE flat (dense_id, query) pair vector,
+        deduped with a single lexsort — per-query Python loops here ran
+        on the batch leader and each GIL re-acquisition cost up to a
+        thread switch interval under a hundred request threads."""
         m = self.mirror(space_id)
         nq = len(starts_per_query)
         if steps < 1:
             empty = [np.zeros(0, np.int64)] * nq
             return lambda: (empty, m)
 
-        dense_starts = []
+        lens = [len(s) for s in starts_per_query]
+        flat: List[int] = []
         for s in starts_per_query:
-            d = m.to_dense(s)
-            dense_starts.append(np.unique(d[d >= 0]))
+            flat.extend(int(v) for v in s)
+        d_all = m.to_dense(flat)
+        q_all = np.repeat(np.arange(nq, dtype=np.int64),
+                          np.asarray(lens, np.int64))
+        keep = d_all >= 0
+        d_all, q_all = d_all[keep].astype(np.int64), q_all[keep]
+        order = np.lexsort((d_all, q_all))
+        d_all, q_all = d_all[order], q_all[order]
+        if len(d_all):
+            first = np.ones(len(d_all), dtype=bool)
+            first[1:] = (q_all[1:] != q_all[:-1]) | (d_all[1:] != d_all[:-1])
+            d_all, q_all = d_all[first], q_all[first]
+        qbounds = np.searchsorted(q_all, np.arange(nq + 1))
 
         if steps == 1 or m.m == 0:
             # frontier before the final hop IS the start set
-            return lambda: (dense_starts, m)
+            starts_v = [d_all[qbounds[q]:qbounds[q + 1]]
+                        for q in range(nq)]
+            return lambda: (starts_v, m)
 
         ix = self.ell(m)
         delta = getattr(m, "_delta", None)
@@ -556,22 +575,20 @@ class TpuQueryRuntime:
             delta = None
         mesh_mt = self._mesh_tables(m, ix)
 
-        total_starts = sum(len(d) for d in dense_starts)
-        c0 = self._sparse_c0(total_starts)
+        c0 = self._sparse_c0(len(d_all))
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is not None:
-            return self._launch_sparse(space_id, m, ix, dense_starts,
+            return self._launch_sparse(space_id, m, ix, d_all, q_all, nq,
                                        et_tuple, steps, c0)
 
         if nq == 1 and delta is None and mesh_mt is None \
                 and flags.get("tpu_adaptive_single") \
-                and len(dense_starts[0]) <= int(
-                    flags.get("tpu_adaptive_k") or 2048):
-            return self._launch_adaptive(space_id, m, ix, dense_starts,
+                and len(d_all) <= int(flags.get("tpu_adaptive_k") or 2048):
+            return self._launch_adaptive(space_id, m, ix, d_all,
                                          et_tuple, steps)
 
-        return self._launch_dense(space_id, m, ix, dense_starts, et_tuple,
-                                  steps, delta, mesh_mt)
+        return self._launch_dense(space_id, m, ix, d_all, q_all, nq,
+                                  et_tuple, steps, delta, mesh_mt)
 
     @staticmethod
     def _sparse_c0(total_starts: int) -> Optional[int]:
@@ -588,11 +605,10 @@ class TpuQueryRuntime:
         return None
 
     def _launch_sparse(self, space_id: int, m: CsrMirror, ix: EllIndex,
-                       dense_starts, et_tuple: Tuple[int, ...],
-                       steps: int, c0: int):
+                       d_all: np.ndarray, q_all: np.ndarray, nq: int,
+                       et_tuple: Tuple[int, ...], steps: int, c0: int):
         from .ell import make_batched_sparse_go_kernel, sparse_caps
         import jax.numpy as jnp
-        nq = len(dense_starts)
         d_max = max(ix.bucket_D) if ix.bucket_D else 1
         cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
         caps = sparse_caps(c0, d_max, steps, cap,
@@ -601,14 +617,13 @@ class TpuQueryRuntime:
             ("sparse_go", ix.shape_sig(), et_tuple, steps, caps),
             lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
                                                   caps))
+        S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
         qid = np.zeros(c0, np.int32)
-        o = 0
-        for q, d in enumerate(dense_starts):
-            new = np.sort(ix.perm[d])
-            ids[o:o + len(new)] = new
-            qid[o:o + len(new)] = q
-            o += len(new)
+        new = ix.perm[d_all]
+        order = np.lexsort((new, q_all))     # per-query ascending new-ids
+        ids[:S] = new[order]
+        qid[:S] = q_all[order]
         hub = self._hub_dev(m, ix)
         out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), hub,
                        *ix.kernel_args()[1:])
@@ -620,8 +635,8 @@ class TpuQueryRuntime:
             overflow = out[1] != 0
             if overflow:
                 self.stats["sparse_overflows"] += 1
-                return self._launch_dense(space_id, m, ix, dense_starts,
-                                          et_tuple, steps, None,
+                return self._launch_dense(space_id, m, ix, d_all, q_all,
+                                          nq, et_tuple, steps, None,
                                           self._mesh_tables(m, ix))()
             qids = out[2:2 + c_fin]
             vids_new = out[2 + c_fin:]
@@ -630,8 +645,8 @@ class TpuQueryRuntime:
             vs_old = ix.inv[vids_new]
             # sorted by (query, old dense id): deterministic row order
             # identical to the dense path's ascending nonzero scan
-            order = np.lexsort((vs_old, qids))
-            qids, vs_old = qids[order], vs_old[order]
+            order2 = np.lexsort((vs_old, qids))
+            qids, vs_old = qids[order2], vs_old[order2]
             bounds = np.searchsorted(qids, np.arange(nq + 1))
             return [vs_old[bounds[q]:bounds[q + 1]]
                     for q in range(nq)], m
@@ -639,7 +654,7 @@ class TpuQueryRuntime:
         return resolve
 
     def _launch_adaptive(self, space_id: int, m: CsrMirror, ix: EllIndex,
-                         dense_starts, et_tuple: Tuple[int, ...],
+                         d_all: np.ndarray, et_tuple: Tuple[int, ...],
                          steps: int):
         from .ell import make_adaptive_go_kernel, unpack_bits
         K = int(flags.get("tpu_adaptive_k") or 2048)
@@ -647,7 +662,7 @@ class TpuQueryRuntime:
             ("adaptive_go", ix.shape_sig(), et_tuple, steps, K),
             lambda: make_adaptive_go_kernel(ix, steps, et_tuple, K=K))
         hub = self._hub_dev(m, ix)
-        out_dev = kern(ix.perm[dense_starts[0]], hub, *ix.kernel_args())
+        out_dev = kern(ix.perm[d_all], hub, *ix.kernel_args())
         self.stats["go_adaptive"] += 1
 
         def resolve():
@@ -659,14 +674,15 @@ class TpuQueryRuntime:
         return resolve
 
     def _launch_dense(self, space_id: int, m: CsrMirror, ix: EllIndex,
-                      dense_starts, et_tuple: Tuple[int, ...], steps: int,
+                      d_all: np.ndarray, q_all: np.ndarray, nq: int,
+                      et_tuple: Tuple[int, ...], steps: int,
                       delta, mesh_mt):
         from .ell import (make_batched_go_kernel,
                           make_batched_go_delta_kernel,
                           make_sharded_batched_go_kernel, unpack_bits)
-        nq = len(dense_starts)
         B = self._batch_width(nq)
-        f0_dev = self._upload_frontier(ix, dense_starts, B)
+        f0_dev = self._upload_frontier(ix, ix.perm[d_all],
+                                       q_all.astype(np.int32), B)
         args = ix.kernel_args()
         if delta is not None:
             cap, dsrc, ddst, det = self._delta_device(m, ix)
@@ -693,7 +709,11 @@ class TpuQueryRuntime:
         self.stats["go_dense"] += 1
 
         def resolve():
-            packed = np.asarray(out_dev)          # [G, B] uint8, one fetch
+            # slice to the live query columns ON DEVICE before the
+            # fetch — transferring all B padded columns at small nq
+            # re-pays the cost the bit-packing exists to remove
+            nqp = min(B, max(8, -(-nq // 8) * 8))
+            packed = np.asarray(out_dev[:, :nqp])     # [G, nqp] uint8
             bits = unpack_bits(packed[:, :nq], ix.n_rows + 1)
             old = bits[ix.perm]                   # [n, nq] old dense ids
             qs, vs = np.nonzero(old.T)
@@ -1261,6 +1281,7 @@ class TpuQueryRuntime:
             arr = cv.fn(env)
             out_cols.append(self._decode_col(m, cv, yc, arr, idx, k_edges,
                                              etype_to_alias))
+        from ..graph.interim import ColumnarRows
         results: List[object] = [None] * nq
         for g in range(nq):
             if irregular[g]:
@@ -1272,11 +1293,11 @@ class TpuQueryRuntime:
                     results[g] = ex
                 continue
             lo, hi = int(qbounds[g]), int(qbounds[g + 1])
-            if len(out_cols) == 1:
-                results[g] = [[v] for v in out_cols[0][lo:hi]]
-            else:
-                results[g] = [list(t) for t in
-                              zip(*(c[lo:hi] for c in out_cols))]
+            # columnar + lazy: building hi-lo row lists per query here
+            # was the assembly hot spot AND fed the cyclic GC millions
+            # of row objects per dispatch
+            results[g] = ColumnarRows([c[lo:hi] for c in out_cols],
+                                      hi - lo)
         return results
 
     def _materialize(self, m: CsrMirror, space_id: int,
@@ -1487,30 +1508,23 @@ class TpuQueryRuntime:
         return out
 
     @staticmethod
-    def _upload_frontier(ix: EllIndex, dense_starts, B: int):
+    def _upload_frontier(ix: EllIndex, new_ids: np.ndarray,
+                         qcols: np.ndarray, B: int):
         """Device [rows+1, B] start frontier built ON the device from
-        (row, col) start coordinates — the host→device transfer is the
-        start list (bytes), not the dense mostly-zero matrix (tens of
-        MB at million-vertex scale; on the remote-tunnel device that
-        transfer dominated the whole dispatch)."""
+        flat (new-id row, query col) coordinates — the host→device
+        transfer is the start list (bytes), not the dense mostly-zero
+        matrix (tens of MB at million-vertex scale; on the
+        remote-tunnel device that transfer dominated the whole
+        dispatch)."""
         import jax.numpy as jnp
-        rows_l, cols_l = [], []
-        for q, dense in enumerate(dense_starts):
-            ids = ix.perm[dense]
-            rows_l.append(ids.astype(np.int32))
-            cols_l.append(np.full(len(ids), q, np.int32))
-        rows_a = np.concatenate(rows_l) if rows_l else \
-            np.zeros(0, np.int32)
-        cols_a = np.concatenate(cols_l) if cols_l else \
-            np.zeros(0, np.int32)
-        S = len(rows_a)
+        S = len(new_ids)
         Sp = max(8, 1 << (max(S, 1) - 1).bit_length())   # stable shapes
         pad_row = ix.n_rows                              # always-zero row
         rows_p = np.full(Sp, pad_row, np.int32)
         cols_p = np.zeros(Sp, np.int32)
         vals_p = np.zeros(Sp, np.int8)
-        rows_p[:S] = rows_a
-        cols_p[:S] = cols_a
+        rows_p[:S] = new_ids
+        cols_p[:S] = qcols
         vals_p[:S] = 1
         f0 = jnp.zeros((ix.n_rows + 1, B), jnp.int8)
         return f0.at[jnp.asarray(rows_p), jnp.asarray(cols_p)].max(
@@ -1578,15 +1592,25 @@ class TpuQueryRuntime:
                     mesh, "parts", ix, max_steps, et_tuple, nbrs, ets,
                     reals, stop_when_found=shortest))
             table_args = (args[0], *nbrs, *ets)
-        ds = [m.to_dense(s) for s in starts_per_query]
-        ds = [d[d >= 0] for d in ds]
-        ts = [m.to_dense(t) for t in targets_per_query]
-        ts = [t[t >= 0] for t in ts]
-        f0_dev = self._upload_frontier(ix, ds, B)
-        t0_dev = self._upload_frontier(ix, ts, B)
+        def flat_coords(per_query):
+            lens = [len(s) for s in per_query]
+            flat: List[int] = []
+            for s in per_query:
+                flat.extend(int(v) for v in s)
+            d = m.to_dense(flat)
+            q = np.repeat(np.arange(nq, dtype=np.int32),
+                          np.asarray(lens, np.int64))
+            keep = d >= 0
+            return ix.perm[d[keep]], q[keep]
+
+        f0_dev = self._upload_frontier(ix, *flat_coords(starts_per_query),
+                                       B)
+        t0_dev = self._upload_frontier(ix, *flat_coords(targets_per_query),
+                                       B)
         self.stats["path_device"] += nq
         d_dev = kern(f0_dev, t0_dev, *table_args)
-        host = np.asarray(d_dev)[:, :nq]
+        nqp = min(B, max(8, -(-nq // 8) * 8))
+        host = np.asarray(d_dev[:, :nqp])[:, :nq]   # device-side slice
         if host.dtype == np.int8:        # in-kernel compression (-1=INF)
             d = np.where(host < 0, INT16_INF, host).astype(np.int16)
         else:

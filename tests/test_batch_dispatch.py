@@ -193,3 +193,29 @@ def test_per_query_error_isolation():
     assert len(outs) == 50
     assert outs[5] == (10, "mirror")
     assert d.stats["query_errors"] >= 1
+
+
+def test_leader_section_failure_resets_dispatching():
+    """An exception between taking leadership and entering _run must
+    hand leadership back — a stuck `dispatching` flag deadlocks every
+    future request on the key (found via a mistyped window flag: the
+    leader raised at `window > 0` and the dispatcher wedged forever)."""
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher
+
+    class FakeRuntime:
+        def exec_batch(self, space_id, payloads):
+            return [p for p in payloads], "m"
+
+    d = GoBatchDispatcher(FakeRuntime())
+    # simulate a corrupted flag value (flags.set coerces, so poke the
+    # registry directly — an early define() with the wrong type did
+    # exactly this in the wild)
+    flags._flags["go_batch_window_ms"].value = "boom"
+    try:
+        with pytest.raises(ValueError):
+            d.submit_batched(("exec_batch", 1), 7)
+    finally:
+        flags._flags["go_batch_window_ms"].value = 0
+    # the key must still be serviceable
+    r, m = d.submit_batched(("exec_batch", 1), 9)
+    assert (r, m) == (9, "m")

@@ -449,3 +449,41 @@ def test_sparse_batched_go_parity_random():
         np.testing.assert_array_equal(got, exp, err_msg=f"trial {trial}")
         verified += 1
     assert verified >= 2, "every trial overflowed; caps too tight to test"
+
+
+def test_sparse_hub_in_final_frontier_no_overflow():
+    """A hub vertex in the FINAL frontier must not force the dense
+    rerun — the final hop is assembled host-side from the complete
+    CSR; only push-source frontiers need hub-free slots."""
+    # chain: 0 -> 1 -> hub(2); hub has high in-degree so it spills
+    n = 200
+    es = [0, 1] + [i for i in range(3, 150)]
+    ed = [1, 2] + [2] * 147
+    ee = [1] * len(es)
+    es, ed, ee = (np.asarray(es, np.int32), np.asarray(ed, np.int32),
+                  np.asarray(ee, np.int32))
+    es2 = np.concatenate([es, ed]); ed2 = np.concatenate([ed, es])
+    ee2 = np.concatenate([ee, -ee])
+    ix = E.EllIndex.build(es2, ed2, ee2, n, cap=16, min_d=4)
+    assert len(ix.extra_owner) > 0
+    hub = jnp.asarray(ix.hub_table())
+    steps = 3        # 2 advances: 0 -> 1 -> hub; hub only in FINAL set
+    caps = E.sparse_caps(16, max(ix.bucket_D), steps, 1 << 12)
+    kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
+    ids = np.full(caps[0], ix.n_rows, np.int32)
+    qid = np.zeros(caps[0], np.int32)
+    ids[0] = ix.perm[0]
+    out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
+                          *ix.kernel_args()[1:]))
+    assert out[1] == 0, "hub in final frontier must not overflow"
+    c_fin = (len(out) - 2) // 2
+    vids = out[2 + c_fin:][out[2:2 + c_fin] >= 0]
+    assert list(ix.inv[vids]) == [2]          # exactly the hub
+
+    # but a hub as a PUSH SOURCE (intermediate hop) must bail to dense
+    steps = 4        # 3 advances: hub is a source on the last advance
+    caps = E.sparse_caps(16, max(ix.bucket_D), steps, 1 << 12)
+    kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
+    out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
+                          *ix.kernel_args()[1:]))
+    assert out[1] == 1, "hub as push source must report overflow"
