@@ -73,7 +73,9 @@ class StorageNode:
         self.part_man.register_handler(self.kv)
         self.kv.init()
         self.service = StorageService(self.kv, self.schema_man,
-                                      local_host=host)
+                                      local_host=host,
+                                      meta_client=self.meta_client,
+                                      client_manager=cm)
         self.handler = CompositeHandler(self.service, self.raft_service) \
             if self.raft_service else self.service
 

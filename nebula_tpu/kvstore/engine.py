@@ -15,7 +15,8 @@ Two implementations:
     built. See nebula_tpu/kvstore/native.py.
 
 The engine seam is deliberately tiny so the TPU CSR mirror can subscribe to
-writes (see storage/csr_mirror.py) without knowing the engine.
+writes (the CSR mirror's delta tracking, tpu/csr.py +
+tpu/runtime.py) without knowing the engine.
 """
 from __future__ import annotations
 

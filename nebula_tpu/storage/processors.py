@@ -439,8 +439,10 @@ class QueryStatsProcessor(QueryBaseProcessor):
                         if last_dedup == (rank, dst):
                             continue
                         last_dedup = (rank, dst)
-                        degree += 1
                         reader = self.edge_reader(space_id, et, val, schema)
+                        if _ttl_expired(reader, reader.schema):
+                            continue   # expired rows don't aggregate —
+                        degree += 1    # same read-skip as getBound
                         for alias, (target_et, prop) in stat_props.items():
                             if target_et == et and schema.field_index(prop) >= 0:
                                 v = reader.get(prop)

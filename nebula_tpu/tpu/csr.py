@@ -325,11 +325,18 @@ def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:
     verts: List[Tuple[int, int, bytes]] = []            # vid,tag,val
     seen_edge_prev: Optional[Tuple[int, int, int, int]] = None
     seen_vert_prev: Optional[Tuple[int, int]] = None
+    folded_parts: set = set()
     for store in stores:
         for part in sorted(store.part_ids(space_id)):
+            if part in folded_parts:
+                # two stores claiming leadership of one part (stale
+                # claim mid-leader-transfer; local store listed first
+                # wins) must not fold its edges twice
+                continue
             p = store.part(space_id, part)
             if p is None or not p.is_leader():
                 continue
+            folded_parts.add(part)
             seen_edge_prev = seen_vert_prev = None
             for key, val in store.prefix(space_id, part,
                                          KeyUtils.part_prefix(part)):
