@@ -247,4 +247,72 @@ int64_t neb_split_frames(const uint8_t* buf, uint64_t len,
   return n;
 }
 
+// Split a RowSetWriter blob (uvarint(row_len) | row)* into per-row
+// offsets (reference RowSetReader.h).  Returns row count, or -1 on
+// corrupt framing / insufficient capacity.  The graphd per-hop loop
+// decodes ONE column (_dst) out of every edge rowset — splitting +
+// neb_decode_field replaces a Python RowReader per row, which
+// dominated the CPU executor path's profile.
+int64_t neb_split_rowset(const uint8_t* blob, uint64_t len,
+                         uint64_t* row_off, uint64_t* row_len,
+                         int64_t capacity) {
+  uint64_t pos = 0;
+  int64_t n = 0;
+  while (pos < len) {
+    uint64_t rl;
+    if (!read_uvarint(blob, len, &pos, &rl)) return -1;
+    // rl > len - pos, NOT pos + rl > len: a corrupt varint near 2^64
+    // would wrap the addition past the bound and hand decode_field an
+    // out-of-bounds row length
+    if (rl > len - pos || n >= capacity) return -1;
+    row_off[n] = pos;
+    row_len[n] = rl;
+    pos += rl;
+    n++;
+  }
+  return n;
+}
+
+namespace {
+
+inline void put_uvarint(uint8_t* out, uint64_t* pos, uint64_t v) {
+  while (v >= 0x80) {
+    out[(*pos)++] = uint8_t(v) | 0x80;
+    v >>= 7;
+  }
+  out[(*pos)++] = uint8_t(v);
+}
+
+inline uint64_t zigzag(int64_t v) {
+  return (uint64_t(v) << 1) ^ uint64_t(v >> 63);
+}
+
+}  // namespace
+
+// Encode a whole pseudo-column edge rowset — rows of exactly
+// (_dst VID, _rank INT, _type INT) under schema version `ver` — in one
+// call: the intermediate hops of a GO request no real props, so the
+// storage side can skip RowReader/encode_row entirely and emit the
+// response blob straight from parsed keys.  Returns bytes written, or
+// -1 if `cap` is too small (caller sizes cap = n * 32 which always
+// fits: 3 varints <= 30 bytes + frame varint).
+int64_t neb_encode_pseudo_rowset(const int64_t* dst, const int64_t* rank,
+                                 int64_t etype, uint64_t ver, int64_t n,
+                                 uint8_t* out, int64_t cap) {
+  uint64_t pos = 0;
+  uint8_t row[40];
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t rp = 0;
+    put_uvarint(row, &rp, ver);
+    put_uvarint(row, &rp, zigzag(dst[i]));
+    put_uvarint(row, &rp, zigzag(rank[i]));
+    put_uvarint(row, &rp, zigzag(etype));
+    if (int64_t(pos + rp + 10) > cap) return -1;
+    put_uvarint(out, &pos, rp);
+    std::memcpy(out + pos, row, rp);
+    pos += rp;
+  }
+  return int64_t(pos);
+}
+
 }  // extern "C"

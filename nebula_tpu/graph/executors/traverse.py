@@ -237,7 +237,8 @@ class GoExecutor(Executor):
                 space, cur, etypes,
                 filter_bytes=pushed if is_final else None,
                 vertex_props=vertex_props if is_final else [],
-                edge_props=edge_props if is_final else {})
+                edge_props=edge_props if is_final else {},
+                dst_only=not is_final)
             if not resp.succeeded() and resp.completeness() == 0:
                 first = next(iter(resp.failed_parts.values()))
                 raise ExecError(f"storage error: {first.to_string()}")
@@ -247,14 +248,36 @@ class GoExecutor(Executor):
                 nxt: List[int] = []
                 seen: Set[int] = set()
                 new_bt: Dict[int, int] = {}
+                import numpy as _np
+                from ...native.batch import decode_rowset_column
                 for r in resp.responses:
+                    schemas = {int(k): schema_from_wire(v)
+                               for k, v in r.get("edge_schemas",
+                                                 {}).items()}
                     for v in r["vertices"]:
                         root = backtracker.get(v["id"], v["id"])
-                        for et_s, blob in v["edges"].items():
-                            schema = schema_from_wire(
-                                r["edge_schemas"][int(et_s)])
-                            for raw in RowSetReader(blob):
-                                dst = RowReader(raw, schema).get("_dst")
+                        if "dsts" in v:
+                            # lean dst_only response: one packed int64
+                            # array per vertex (already deduped by
+                            # (rank, dst) and TTL-checked server-side)
+                            per_et = [_np.frombuffer(
+                                v["dsts"], "<i8").tolist()]
+                        else:
+                            per_et = []
+                            for et_s, blob in v["edges"].items():
+                                schema = schemas[int(et_s)]
+                                # one C call per rowset instead of a
+                                # Python RowReader per row (reference
+                                # decodes per row too:
+                                # GoExecutor::getDstIdsFromResp:407-431)
+                                col = decode_rowset_column(blob, schema,
+                                                           "_dst")
+                                per_et.append(
+                                    col.tolist() if col is not None else
+                                    [RowReader(raw, schema).get("_dst")
+                                     for raw in RowSetReader(blob)])
+                        for dsts in per_et:
+                            for dst in dsts:
                                 if dst not in seen:
                                     seen.add(dst)
                                     nxt.append(dst)
@@ -270,11 +293,18 @@ class GoExecutor(Executor):
         # ---- second wave: dst props ---------------------------------
         dst_prop_map: Dict[int, Dict[Tuple[str, str], object]] = {}
         if dst_refs:
+            from ...native.batch import decode_rowset_column
             dst_ids: Set[int] = set()
             for r in final_resp.responses:
+                schemas = {int(k): schema_from_wire(v)
+                           for k, v in r["edge_schemas"].items()}
                 for v in r["vertices"]:
                     for et_s, blob in v["edges"].items():
-                        schema = schema_from_wire(r["edge_schemas"][int(et_s)])
+                        schema = schemas[int(et_s)]
+                        col = decode_rowset_column(blob, schema, "_dst")
+                        if col is not None:
+                            dst_ids.update(col.tolist())
+                            continue
                         for raw in RowSetReader(blob):
                             dst_ids.add(RowReader(raw, schema).get("_dst"))
             dst_vp: List[List] = []
@@ -301,13 +331,29 @@ class GoExecutor(Executor):
                     dst_prop_map[v["id"]] = vals
 
         # ---- final eval (processFinalResult) ------------------------
+        from ...native.batch import decode_rowset_rows, \
+            decode_rowsets_grouped
         ctx = _RowCtx()
         rows: List[List[object]] = []
         seen_rows: Set[Tuple] = set()
         for r in final_resp.responses:
             vschema = (schema_from_wire(r["vertex_schema"])
                        if r.get("vertex_schema") else None)
-            for v in r["vertices"]:
+            eschemas = {int(k): schema_from_wire(v)
+                        for k, v in r["edge_schemas"].items()}
+            # response-wide batch decode: per-vertex rowsets are tiny,
+            # so the C calls amortize across the whole response
+            grouped: Dict[int, Dict[int, List[dict]]] = {}
+            for et in eschemas:
+                vixs = [i for i, v in enumerate(r["vertices"])
+                        if str(et) in v["edges"] or et in v["edges"]]
+                blobs = [v["edges"].get(str(et), v["edges"].get(et))
+                         for v in r["vertices"]
+                         if str(et) in v["edges"] or et in v["edges"]]
+                dec = decode_rowsets_grouped(blobs, eschemas[et])
+                if dec is not None:
+                    grouped[et] = dict(zip(vixs, dec))
+            for vi, v in enumerate(r["vertices"]):
                 src_vid = v["id"]
                 ctx.src_vals = {}
                 if vschema is not None and v["vdata"]:
@@ -321,11 +367,18 @@ class GoExecutor(Executor):
                 ctx.input_row = input_map.get(root, {})
                 for et_s, blob in v["edges"].items():
                     et = int(et_s)
-                    schema = schema_from_wire(r["edge_schemas"][et])
+                    schema = eschemas[et]
                     alias = etype_to_alias.get(et, str(et))
-                    for raw in RowSetReader(blob):
-                        reader = RowReader(raw, schema)
-                        ctx.edge_vals = reader.to_dict()
+                    # response-wide batch decode, then per-blob batch,
+                    # then the per-row reader as semantic fallback
+                    row_dicts = grouped.get(et, {}).get(vi)
+                    if row_dicts is None:
+                        row_dicts = decode_rowset_rows(blob, schema)
+                    if row_dicts is None:
+                        row_dicts = (RowReader(raw, schema).to_dict()
+                                     for raw in RowSetReader(blob))
+                    for edge_vals in row_dicts:
+                        ctx.edge_vals = edge_vals
                         dst = ctx.edge_vals.get("_dst")
                         ctx.edge_meta = {"dst": dst, "src": src_vid,
                                          "rank": ctx.edge_vals.get("_rank", 0),
@@ -803,17 +856,29 @@ class FindPathExecutor(Executor):
             if not resp.succeeded() and resp.completeness() == 0:
                 first = next(iter(resp.failed_parts.values()))
                 raise ExecError(f"storage error: {first.to_string()}")
+            from ...native.batch import decode_rowset_column
             nxt: List[int] = []
             for r in resp.responses:
+                schemas = {int(k): schema_from_wire(v)
+                           for k, v in r["edge_schemas"].items()}
                 for v in r["vertices"]:
                     src = v["id"]
                     for et_s, blob in v["edges"].items():
                         et = int(et_s)
-                        schema = schema_from_wire(r["edge_schemas"][et])
-                        for raw in RowSetReader(blob):
-                            row = RowReader(raw, schema)
-                            dst = row.get("_dst")
-                            rank = row.get("_rank", 0)
+                        schema = schemas[et]
+                        dcol = decode_rowset_column(blob, schema, "_dst")
+                        rcol = (decode_rowset_column(blob, schema,
+                                                     "_rank")
+                                if dcol is not None else None)
+                        if dcol is not None and rcol is not None:
+                            pairs = zip(dcol.tolist(), rcol.tolist())
+                        else:
+                            pairs = ((row.get("_dst"),
+                                      row.get("_rank", 0))
+                                     for row in
+                                     (RowReader(raw, schema)
+                                      for raw in RowSetReader(blob)))
+                        for dst, rank in pairs:
                             if dst not in depth_of:
                                 depth_of[dst] = depth
                                 nxt.append(dst)

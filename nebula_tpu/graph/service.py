@@ -121,6 +121,21 @@ class ExecutionEngine:
         self.tpu_runtime = tpu_runtime
         self.parser = GQLParser()
 
+    _KIND_STATS_REGISTERED: set = set()
+
+    @classmethod
+    def _stmt_stat(cls, kind: str) -> str:
+        """Lazily-registered per-statement-kind latency histogram name
+        (reference scaffolding: StatsManager counters per RPC,
+        SURVEY.md §5.5 / StorageServer.cpp:93-94 — here filled in for
+        graphd: `graph.stmt.<Kind>.latency_us.{avg|p95|...}.<window>`
+        over /get_stats)."""
+        name = f"graph.stmt.{kind}.latency_us"
+        if kind not in cls._KIND_STATS_REGISTERED:
+            stats.register_stats(name)
+            cls._KIND_STATS_REGISTERED.add(kind)
+        return name
+
     def execute(self, session: ClientSession, text: str) -> dict:
         """-> ExecutionResponse dict (graph.thrift:89-96)."""
         dur = Duration()
@@ -128,6 +143,7 @@ class ExecutionEngine:
         resp = {"error_code": int(ErrorCode.SUCCEEDED)}
         parsed = self.parser.parse(text)
         if not parsed.ok():
+            stats.add_value("graph.error.qps")
             resp["error_code"] = int(ErrorCode.E_SYNTAX_ERROR)
             resp["error_msg"] = parsed.status.msg
             resp["latency_in_us"] = dur.elapsed_in_usec()
@@ -156,6 +172,13 @@ class ExecutionEngine:
         resp["space_name"] = session.space_name
         resp["latency_in_us"] = dur.elapsed_in_usec()
         stats.add_value("graph.latency_us", resp["latency_in_us"])
+        # per-statement-kind histogram + error counter (first sentence
+        # names a multi-statement input)
+        sentences = parsed.value().sentences
+        kind = type(sentences[0]).__name__ if sentences else "Empty"
+        stats.add_value(self._stmt_stat(kind), resp["latency_in_us"])
+        if resp["error_code"] != int(ErrorCode.SUCCEEDED):
+            stats.add_value("graph.error.qps")
         return resp
 
 
@@ -169,6 +192,7 @@ class GraphService:
         self.authenticator = authenticator or SimpleAuthenticator(engine.meta)
         stats.register_stats("graph.qps")
         stats.register_stats("graph.latency_us")
+        stats.register_stats("graph.error.qps")
 
     def rpc_authenticate(self, req: dict) -> dict:
         user = req.get("username", "")

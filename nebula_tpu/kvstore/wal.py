@@ -25,11 +25,15 @@ _HDR = struct.Struct(">QQI")
 _SEGMENT_BYTES = 16 * 1024 * 1024
 
 flags.define(
-    "wal_sync", False,
-    "fsync WAL segments on every flush (power-loss durability). The "
-    "flush-to-OS itself always happens before raft acks an append, so "
-    "kill -9 / process crashes never lose acked writes either way; "
-    "fsync additionally covers kernel crashes and power loss")
+    "wal_sync", True,
+    "fsync WAL segments on every flush (power-loss durability) — ON by "
+    "default: the raft WAL is the system's ONLY redo log (the disk "
+    "engine deliberately runs RocksDB-WAL-off semantics), so an acked "
+    "write must survive power loss, not just process death.  Measured "
+    "cost ~330us per flush; raft group commit amortizes one flush "
+    "across every append in the batch, so high-concurrency write "
+    "throughput is barely affected.  Benchmarks chasing loopback "
+    "numbers can turn it off")
 
 
 class LogEntry:

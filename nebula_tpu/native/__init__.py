@@ -107,6 +107,13 @@ def lib() -> Optional[ctypes.CDLL]:
           i64p, i64p])
     _sig(L.neb_split_frames, ctypes.c_int64,
          [u8p, ctypes.c_uint64, u64p, u64p, u64p, u64p, ctypes.c_int64])
+    # round-3 additions — guarded like ell_build below (stale .so)
+    if hasattr(L, "neb_split_rowset"):
+        _sig(L.neb_split_rowset, ctypes.c_int64,
+             [u8p, ctypes.c_uint64, u64p, u64p, ctypes.c_int64])
+        _sig(L.neb_encode_pseudo_rowset, ctypes.c_int64,
+             [i64p, i64p, ctypes.c_int64, ctypes.c_uint64,
+              ctypes.c_int64, u8p, ctypes.c_int64])
 
     # ELL slot-table builder (tpu/ell.py fast path). Guarded: a stale
     # .so built before ell_build.cc existed must degrade this feature,

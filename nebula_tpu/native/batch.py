@@ -127,3 +127,130 @@ def split_frames(packed: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
     if n < 0:
         return None
     return ko[:n], kl[:n], vo[:n], vl[:n]
+
+
+def split_rowset(blob: bytes) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """RowSetWriter blob -> (row offsets, row lengths); None when the
+    native library is unavailable or the blob framing is corrupt."""
+    L = lib()
+    if L is None or not hasattr(L, "neb_split_rowset"):
+        return None
+    cap = max(len(blob), 1)          # every row costs >= 1 framing byte
+    offs = np.zeros(cap, dtype=np.uint64)
+    lens = np.zeros(cap, dtype=np.uint64)
+    n = L.neb_split_rowset(
+        ctypes.cast(ctypes.c_char_p(blob), _U8P), len(blob),
+        _p(offs, _U64P), _p(lens, _U64P), cap)
+    if n < 0:
+        return None
+    return offs[:n], lens[:n]
+
+
+def decode_rowset_column(blob: bytes, schema, field_name: str
+                         ) -> Optional[np.ndarray]:
+    """One int64 column across every row of a rowset blob in two C
+    calls — the graphd per-hop `_dst` extraction (RowReader per row
+    dominated the CPU executor profile).  None -> caller's Python loop;
+    also None when any row needs per-row handling (schema-version
+    mismatch / short row), so semantics never fork."""
+    if len(blob) < 256:
+        return None          # ctypes call overhead beats tiny rowsets
+    idx = schema.field_index(field_name)
+    if idx < 0:
+        return None
+    sr = split_rowset(blob)
+    if sr is None:
+        return None
+    offs, lens = sr
+    cols = decode_field(blob, offs, lens, schema, idx)
+    if cols is None:
+        return None
+    if not np.all(cols.valid == 1):
+        return None
+    return cols.i64
+
+
+def encode_pseudo_rowset(dst: np.ndarray, rank: np.ndarray, etype: int,
+                         version: int) -> Optional[bytes]:
+    """Whole (_dst, _rank, _type) edge rowset in one C call — the
+    no-props intermediate-hop response (storage/processors.py fast
+    path)."""
+    L = lib()
+    if L is None or not hasattr(L, "neb_encode_pseudo_rowset"):
+        return None
+    n = len(dst)
+    out = np.zeros(max(n * 40, 1), dtype=np.uint8)
+    dst64 = np.ascontiguousarray(dst, dtype=np.int64)
+    rank64 = np.ascontiguousarray(rank, dtype=np.int64)
+    ln = L.neb_encode_pseudo_rowset(
+        _p(dst64, _I64P), _p(rank64, _I64P), int(etype), int(version),
+        n, _p(out, _U8P), len(out))
+    if ln < 0:
+        return None
+    return out[:ln].tobytes()
+
+
+def decode_rowset_rows(blob: bytes, schema) -> Optional[List[dict]]:
+    """Whole rowset -> list of {col: value} dicts — the single-blob
+    case of decode_rowsets_grouped (one body to keep the type dispatch
+    from forking)."""
+    g = decode_rowsets_grouped([blob], schema)
+    return g[0] if g else (g if g == [] else None)
+
+
+def decode_rowsets_grouped(blobs: List[bytes], schema
+                           ) -> Optional[List[List[dict]]]:
+    """Decode MANY rowset blobs sharing one schema with one C call per
+    column across all of them — per-vertex rowsets are tiny (a handful
+    of edges), so per-blob batching loses to ctypes call overhead; a
+    whole response batches across its vertices instead.  Returns one
+    list of row dicts per input blob; None -> per-row fallback."""
+    if not blobs:
+        return []
+    joined = b"".join(blobs)
+    if len(joined) < 256:
+        return None
+    counts = []
+    offs_l = []
+    lens_l = []
+    base = 0
+    for b in blobs:
+        sr = split_rowset(b)
+        if sr is None:
+            return None
+        o, ln = sr
+        counts.append(len(o))
+        offs_l.append(o + np.uint64(base))
+        lens_l.append(ln)
+        base += len(b)
+    offs = np.concatenate(offs_l)
+    lens = np.concatenate(lens_l)
+    names = []
+    col_vals = []
+    for i, c in enumerate(schema.columns):
+        fc = decode_field(joined, offs, lens, schema, i)
+        if fc is None:
+            return None
+        if not np.all(fc.valid == 1):
+            return None
+        t = c.type
+        if t in (SupportedType.INT, SupportedType.VID,
+                 SupportedType.TIMESTAMP):
+            vals = fc.i64.tolist()
+        elif t == SupportedType.BOOL:
+            vals = [x != 0 for x in fc.i64.tolist()]
+        elif t in (SupportedType.FLOAT, SupportedType.DOUBLE):
+            vals = fc.f64.tolist()
+        elif t == SupportedType.STRING:
+            vals = fc.strings()
+        else:
+            return None
+        names.append(c.name)
+        col_vals.append(vals)
+    rows = [dict(zip(names, row)) for row in zip(*col_vals)]
+    out = []
+    pos = 0
+    for n in counts:
+        out.append(rows[pos:pos + n])
+        pos += n
+    return out

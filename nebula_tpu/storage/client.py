@@ -197,8 +197,11 @@ class StorageClient:
                       filter_bytes: Optional[bytes] = None,
                       vertex_props: Optional[List[List]] = None,
                       edge_props: Optional[Dict[int, List[str]]] = None,
-                      reverse: bool = False,
+                      reverse: bool = False, dst_only: bool = False,
                       retries: int = 3) -> StorageRpcResponse:
+        """``dst_only``: lean intermediate-hop mode — the response
+        carries packed int64 destination arrays per vertex instead of
+        encoded rowsets (no props/filter may be requested with it)."""
         parts = self.cluster_by_part(space_id, vids)
 
         def make(parts_subset):
@@ -210,6 +213,7 @@ class StorageClient:
                 "vertex_props": vertex_props or [],
                 "edge_props": {str(k): v for k, v in (edge_props or {}).items()},
                 "reverse": reverse,
+                "dst_only": dst_only,
             }
 
         return self.collect(space_id, parts, make, retries=retries)

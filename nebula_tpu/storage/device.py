@@ -74,23 +74,29 @@ class RemoteStoreView:
     staleness the reference accepts from its 120 s meta cache refresh
     (MetaClient.cpp:13-14)."""
 
+    POLL_REUSE_S = 0.02
+
     def __init__(self, host: HostAddr, space_id: int, client_manager):
         self.host = host
         self.space_id = space_id
         self.cm = client_manager
         self._led: List[int] = []
         self._version = -1
+        self._polled_at = 0.0
 
     def refresh(self) -> bool:
         """Poll version + led parts; False when the peer is down."""
+        import time
         try:
             resp = self.cm.call(self.host, "deviceVersion",
                                 {"space_id": self.space_id})
         except RpcError:
             self._led = []
+            self._polled_at = 0.0
             return False
         self._led = [int(p) for p in resp.get("led_parts", [])]
         self._version = int(resp.get("version", 0))
+        self._polled_at = time.monotonic()
         return True
 
     # ---- store-shaped surface (what build_mirror + runtime touch) ----
@@ -101,6 +107,13 @@ class RemoteStoreView:
         return _LedPartStub() if part_id in self._led else None
 
     def mutation_version(self, space_id: int) -> int:
+        import time
+        # the serving gate refreshes unconditionally right before the
+        # runtime's version check — reuse that poll instead of paying a
+        # second identical round-trip per query.  Any poll taken after
+        # a committed write sees it, so reuse never hides one
+        if time.monotonic() - self._polled_at <= self.POLL_REUSE_S:
+            return self._version
         if not self.refresh():
             # an unreachable peer must FAIL the version check / mirror
             # build (callers decline to the CPU path) — quietly

@@ -133,7 +133,8 @@ class StorageService:
             if b is not None and b.serves(int(r["space_id"])):
                 from ..tpu.backend import BackendDecline
                 try:
-                    resp = b.get_bound(r)
+                    resp = (b.get_bound_dst_only(r)
+                            if r.get("dst_only") else b.get_bound(r))
                     stats.add_value("storage.backend_bound.qps")
                     return resp
                 except BackendDecline:
@@ -171,7 +172,15 @@ class StorageService:
                             self.schema_man)
                 self.backend = TpuStorageBackend(self._backend_rt,
                                                  self.schema_man)
-            except Exception:   # noqa: BLE001 — no jax / broken device
+            except Exception as e:  # noqa: BLE001 — no jax / broken dev
+                # loud, once: a silently-disabled backend is otherwise
+                # indistinguishable from a CPU-only deployment (same
+                # rationale as _log_device_failure)
+                import sys
+                sys.stderr.write(
+                    "[storage] mirror read backend unavailable — bulk "
+                    f"reads stay on the CPU processors: "
+                    f"{type(e).__name__}: {e}\n")
                 self._backend_broken = True
         return self.backend
 

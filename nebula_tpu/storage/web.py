@@ -8,17 +8,20 @@ StorageServer.cpp:60-89):
                                           snapshot files into the space
   GET /admin                              raft part status
 
-The reference's /download shells out to ``hdfs dfs -get``; this build
-has no HDFS, so the transfer half accepts ``file://`` source
-directories (shared filesystem — the common on-prem layout) and plain
-local paths.  Everything else — staging dir per space, separate
-download/ingest phases, meta-side fan-out (meta/http_dispatch.py) —
-matches the reference flow.
+The reference's /download shells out to ``hdfs dfs -get``
+(/root/reference/src/common/hdfs/HdfsCommandHelper.h); we do the same
+for ``hdfs://`` urls when an ``hdfs`` binary is on PATH (tests fake one,
+like the reference's MockHdfsHelper), and additionally accept
+``file://`` source directories (shared filesystem — the common on-prem
+layout) and plain local paths.  Everything else — staging dir per
+space, separate download/ingest phases, meta-side fan-out
+(meta/http_dispatch.py) — matches the reference flow.
 """
 from __future__ import annotations
 
 import os
 import shutil
+import subprocess
 from typing import Optional
 from urllib.parse import urlparse
 
@@ -34,12 +37,37 @@ def _staging_dir(node, space_id: int) -> str:
     return d
 
 
+def _hdfs_download(node, space_id: int, url: str) -> dict:
+    """``hdfs dfs -get <url>/* <staging>`` — the reference's transfer
+    verb (HdfsCommandHelper::copyToLocal).  Requires an ``hdfs`` binary
+    on PATH (a real Hadoop client, or a test shim)."""
+    if shutil.which("hdfs") is None:
+        return {"ok": False,
+                "error": "hdfs:// url but no `hdfs` binary on PATH"}
+    dest = _staging_dir(node, space_id)
+    before = set(os.listdir(dest))
+    try:
+        proc = subprocess.run(
+            ["hdfs", "dfs", "-get", url.rstrip("/") + "/*", dest],
+            capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "hdfs dfs -get timed out"}
+    if proc.returncode != 0:
+        return {"ok": False,
+                "error": f"hdfs dfs -get failed: {proc.stderr.strip()}"}
+    staged = sorted(set(os.listdir(dest)) - before) or sorted(
+        os.listdir(dest))
+    return {"ok": True, "staged": staged, "dest": dest}
+
+
 def _download(node, space_id: int, url: str) -> dict:
     p = urlparse(url)
+    if p.scheme == "hdfs":
+        return _hdfs_download(node, space_id, url)
     if p.scheme not in ("", "file"):
         return {"ok": False,
                 "error": f"unsupported url scheme {p.scheme!r} "
-                         "(file:// or local path)"}
+                         "(hdfs://, file:// or local path)"}
     src = p.path if p.scheme == "file" else url
     if not os.path.isdir(src):
         return {"ok": False, "error": f"no such directory {src}"}

@@ -230,6 +230,46 @@ class TpuStorageBackend:
         }
 
     # ------------------------------------------------------------------
+    def get_bound_dst_only(self, req: dict) -> dict:
+        """Lean intermediate-hop mode from the mirror: per requested
+        vertex, the deduped destination ids as one packed int64 array
+        (the mirror is already multi-version-deduped and TTL-fresh) —
+        same response shape as QueryBoundProcessor._process_dst_only,
+        no row encode at all."""
+        dur = Duration()
+        space_id = int(req["space_id"])
+        try:
+            m = self.rt.mirror_full(space_id)
+        except Exception as e:      # noqa: BLE001
+            self._decline(f"mirror unavailable: {e}")
+        sm = self.sm
+        edge_types = [int(e) for e in req.get("edge_types", [])]
+        if not edge_types:
+            edge_types = sm.all_edge_types(space_id)
+            if req.get("reverse"):
+                edge_types = [-e for e in edge_types]
+        items = [(int(part), int(vid))
+                 for part, vids in req["parts"].items() for vid in vids]
+        dense = m.to_dense([vid for _, vid in items])
+        vs_lists = [np.asarray([d], dtype=np.int64) if d >= 0
+                    else np.zeros(0, np.int64) for d in dense.tolist()]
+        et_tuple = tuple(sorted(set(edge_types)))
+        cand, qseg, qbounds = self.rt._frontier_edges_multi(m, vs_lists,
+                                                            et_tuple)
+        dst_vids = m.vids[m.edge_dst[cand]]
+        vertices = []
+        for q, (part, vid) in enumerate(items):
+            lo, hi = int(qbounds[q]), int(qbounds[q + 1])
+            if lo == hi:
+                continue
+            vertices.append({"id": vid, "dsts": np.ascontiguousarray(
+                dst_vids[lo:hi], dtype="<i8").tobytes()})
+        self.stats["get_bound"] += 1
+        return {"vertex_schema": None, "edge_schemas": {},
+                "vertices": vertices, "dst_only": True,
+                "latency_us": dur.elapsed_in_usec()}
+
+    # ------------------------------------------------------------------
     def bound_stats(self, req: dict) -> dict:
         """outBoundStats/inBoundStats from the mirror — the aggregation
         runs as numpy column reductions over the candidate edge set

@@ -36,7 +36,8 @@ from ..interface.common import Schema, SupportedType
 
 
 def _now_s() -> float:
-    return time.time()
+    from ..common.clock import now_s
+    return now_s()
 
 
 def _ttl_expiry(reader: RowReader):

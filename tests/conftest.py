@@ -7,8 +7,14 @@ Mirrors the reference's strategy of in-process multi-instance harnesses
 import os
 import sys
 
-# Must happen before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax is imported anywhere.  FORCE (not setdefault):
+# terminal environments ship a sitecustomize that registers a remote
+# TPU platform and pins jax_platforms via jax.config — the env var
+# alone is overridden, which silently degraded the "8 virtual device"
+# mesh tests to 1-device axes on the remote chip.  The config update
+# below wins because backends initialize lazily (first jax.devices()),
+# which hasn't happened at conftest import time.
+os.environ["JAX_PLATFORMS"] = "cpu"
 # Persistent compile cache: kernel-shape compiles dominate suite wall
 # time; warm reruns skip them (same mechanism serving uses, jax_setup.py)
 os.environ.setdefault(
@@ -23,6 +29,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", jax.devices()
+except ImportError:
+    pass
 
 # Build the native library once per test session (engine default is
 # "auto": C++ engine when built, MemEngine otherwise).

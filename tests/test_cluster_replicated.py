@@ -198,8 +198,16 @@ def test_node_crash_failover():
         c.cm.unregister_loopback(HostAddr.parse(dead.host))
         dead.stop()
 
-        # reads and writes still work through the surviving quorum
-        r = ok("GO FROM 2 OVER e YIELD e._dst")
+        # reads and writes still work through the surviving quorum.
+        # A read racing the re-election can return PARTIAL results
+        # (completeness < 100 is tolerated, reference
+        # GoExecutor.cpp:356-366) — retry until failover lands
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            r = ok("GO FROM 2 OVER e YIELD e._dst")
+            if sorted(x[0] for x in r.rows) == [3]:
+                break
+            time.sleep(0.2)
         assert sorted(x[0] for x in r.rows) == [3]
         ok("INSERT EDGE e(w) VALUES 3->4:(9)")
         r = ok("GO FROM 3 OVER e YIELD e._dst")

@@ -275,3 +275,25 @@ def test_native_suite_under_asan(tmp_path):
     assert r.returncode == 0, f"ASAN run failed:\n{r.stdout}\n{r.stderr}"
     assert "ASAN DRIVER OK" in r.stdout
     assert "AddressSanitizer" not in r.stderr, r.stderr
+
+
+def test_split_rowset_rejects_overflowing_varint():
+    """A corrupt row-length varint near 2^64 must fail the split, not
+    wrap the bounds check into an out-of-bounds row (review finding)."""
+    from nebula_tpu.native import ensure_built
+    from nebula_tpu.native.batch import split_rowset
+    if not ensure_built():
+        import pytest
+        pytest.skip("native lib unavailable")
+    # uvarint encoding ~2^64-6 (nine 0x80|x bytes + terminator) + junk
+    evil = bytes([0xFA, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                  0xFF, 0x01]) + b"abcdef" * 50
+    assert split_rowset(evil) is None
+    # sane blobs still split
+    from nebula_tpu.codec.rows import RowSetWriter, encode_row
+    from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+    sch = Schema(columns=[ColumnDef("x", SupportedType.INT)])
+    w = RowSetWriter()
+    w.add_row(encode_row(sch, {"x": 5}))
+    offs, lens = split_rowset(w.data())
+    assert len(offs) == 1
