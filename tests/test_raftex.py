@@ -564,3 +564,33 @@ class TestWalDurability:
             flags.set("wal_sync", True)
         w3 = FileBasedWal(str(tmp_path / "w"))
         assert w3.last_log_id() == 1     # flushed-to-OS still replays
+
+
+class TestAdaptivePipelining:
+    def test_depth_collapses_on_fast_links(self, cluster3):
+        """Loopback replication RTT is ~0: after a few writes the
+        leader's effective depth must drop to pure group commit
+        (pipelining only splits batches there — round-2 BASELINE
+        measured -25%); a slow measured RTT must restore the
+        configured depth."""
+        lead = cluster3.leader()
+        for i in range(20):
+            assert lead.part.put(b"a%02d" % i, b"v").ok()
+        raft = lead.part.raft
+        assert raft._rep_rtt is not None and raft._rep_rtt < 0.001
+        with raft._lock:
+            assert raft._effective_depth() == 1
+        # pretend the link got slow: configured depth comes back
+        with raft._lock:
+            raft._rep_rtt = 0.01
+            assert raft._effective_depth() == \
+                max(1, int(flags.get("raft_pipeline_depth")))
+        # and auto mode off pins the configured depth regardless
+        flags.set("raft_pipeline_auto", False)
+        try:
+            with raft._lock:
+                raft._rep_rtt = 0.0
+                assert raft._effective_depth() == \
+                    max(1, int(flags.get("raft_pipeline_depth")))
+        finally:
+            flags.set("raft_pipeline_auto", True)
