@@ -294,8 +294,8 @@ inline uint64_t zigzag(int64_t v) {
 // call: the intermediate hops of a GO request no real props, so the
 // storage side can skip RowReader/encode_row entirely and emit the
 // response blob straight from parsed keys.  Returns bytes written, or
-// -1 if `cap` is too small (caller sizes cap = n * 32 which always
-// fits: 3 varints <= 30 bytes + frame varint).
+// -1 if `cap` is too small (caller sizes cap = n * 48: worst-case row
+// is 4 max-width varints = 40 bytes + frame varint).
 int64_t neb_encode_pseudo_rowset(const int64_t* dst, const int64_t* rank,
                                  int64_t etype, uint64_t ver, int64_t n,
                                  uint8_t* out, int64_t cap) {

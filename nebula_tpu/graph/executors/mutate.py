@@ -119,12 +119,6 @@ class InsertEdgeExecutor(Executor):
         return None
 
 
-class _UpdateBase(Executor):
-    def _fetch_current(self, space, vid, tag_or_none):
-        """Read current props of the target (for SET expr eval + write-back)."""
-        raise NotImplementedError
-
-
 class UpdateVertexExecutor(Executor):
     NAME = "UpdateVertexExecutor"
 

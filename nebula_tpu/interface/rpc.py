@@ -159,13 +159,15 @@ class RpcChannel:
         self._lock = threading.Lock()
         self._idle: list = []
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
         s = socket.create_connection((self.addr.host, self.addr.port),
-                                     timeout=self.timeout)
+                                     timeout=(timeout if timeout is not None
+                                              else self.timeout))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def call(self, method: str, payload: Any) -> Any:
+    def call(self, method: str, payload: Any,
+             timeout: Optional[float] = None) -> Any:
         frame_out = _pack([method, payload])
         for attempt in (0, 1):
             pooled = False
@@ -178,11 +180,15 @@ class RpcChannel:
             try:
                 if sock is None:
                     try:
-                        sock = self._connect()
+                        sock = self._connect(timeout)
                     except OSError as e:
                         raise RpcError(Status.Error(
                             f"connect to {self.addr} failed: {e}",
                             ErrorCode.E_FAIL_TO_CONNECT)) from e
+                # per-call deadline override (mirror-build scans use a
+                # short one so a hung peer can't stall a rebuild long)
+                sock.settimeout(timeout if timeout is not None
+                                else self.timeout)
                 _write_frame(sock, frame_out)
                 sent = True
                 frame = _read_frame(sock)
@@ -242,7 +248,8 @@ class LoopbackChannel:
     def __init__(self, handler: Any):
         self.handler = handler
 
-    def call(self, method: str, payload: Any) -> Any:
+    def call(self, method: str, payload: Any,
+             timeout: Optional[float] = None) -> Any:
         payload = _unpack(_pack(payload))
         fn = getattr(self.handler, "rpc_" + method, None)
         if fn is None:
@@ -300,8 +307,9 @@ class ClientManager:
                 self._channels[addr] = ch
             return ch
 
-    def call(self, addr: HostAddr, method: str, payload: Any) -> Any:
-        return self.channel(addr).call(method, payload)
+    def call(self, addr: HostAddr, method: str, payload: Any,
+             timeout: Optional[float] = None) -> Any:
+        return self.channel(addr).call(method, payload, timeout=timeout)
 
     def close(self) -> None:
         with self._lock:

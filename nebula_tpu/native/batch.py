@@ -179,7 +179,10 @@ def encode_pseudo_rowset(dst: np.ndarray, rank: np.ndarray, etype: int,
     if L is None or not hasattr(L, "neb_encode_pseudo_rowset"):
         return None
     n = len(dst)
-    out = np.zeros(max(n * 40, 1), dtype=np.uint8)
+    # worst-case row: 4 max-width varints (40 B) + frame varint — n*40
+    # made large-magnitude dst/rank rowsets fail the cap check and fall
+    # silently to the slow per-row path
+    out = np.zeros(max(n * 48, 1), dtype=np.uint8)
     dst64 = np.ascontiguousarray(dst, dtype=np.int64)
     rank64 = np.ascontiguousarray(rank, dtype=np.int64)
     ln = L.neb_encode_pseudo_rowset(

@@ -75,6 +75,8 @@ class RemoteStoreView:
     (MetaClient.cpp:13-14)."""
 
     POLL_REUSE_S = 0.02
+    RPC_TIMEOUT_S = 10.0    # a hung peer fails the build fast instead of
+                            # stalling the rebuilding space for 30 s/call
 
     def __init__(self, host: HostAddr, space_id: int, client_manager):
         self.host = host
@@ -89,7 +91,8 @@ class RemoteStoreView:
         import time
         try:
             resp = self.cm.call(self.host, "deviceVersion",
-                                {"space_id": self.space_id})
+                                {"space_id": self.space_id},
+                                timeout=self.RPC_TIMEOUT_S)
         except RpcError:
             self._led = []
             self._polled_at = 0.0
@@ -129,17 +132,34 @@ class RemoteStoreView:
 
     def prefix(self, space_id: int, part_id: int, prefix: bytes):
         """Chunk-streamed remote scan; raises RpcError on peer failure
-        (mirror build then fails → the query declines to CPU)."""
+        (mirror build then fails → the query declines to CPU).
+
+        Torn-scan guard: each chunk echoes the peer's space mutation
+        version (sampled before its rows were read); a write landing
+        BETWEEN chunks would hand the mirror a torn view of a multi-key
+        commit, so a mid-scan version bump fails the scan — the build
+        fails, the query declines to the CPU path, and the next query's
+        rebuild retries.  Rows stream through chunk-at-a-time (no
+        whole-part buffering); a single-chunk scan is single-pass on
+        the peer, same window as a local build."""
         cursor = None
+        scan_ver = None
         while True:
             resp = self.cm.call(self.host, "deviceScan", {
                 "space_id": space_id, "part": part_id,
                 "prefix": prefix, "cursor": cursor,
-                "limit": 16384})
+                "limit": 16384}, timeout=self.RPC_TIMEOUT_S)
             if not resp.get("ok"):
                 raise RpcError(Status(
                     ErrorCode.E_LEADER_CHANGED,
                     f"deviceScan declined: {resp.get('reason')}"))
+            ver = resp.get("version")
+            if scan_ver is None:
+                scan_ver = ver
+            elif ver is not None and ver != scan_ver:
+                raise RpcError(Status(
+                    ErrorCode.E_RPC_FAILURE,
+                    f"deviceScan of part {part_id} raced a write"))
             for k, v in resp["rows"]:
                 yield k, v
             if resp.get("done"):

@@ -164,14 +164,18 @@ class StorageService:
                 # the backend's mirror never needs peer parts — using
                 # the remote-aware deviceGo runtime here would make
                 # every storaged mirror the whole space and pay peer
-                # version polls on the bulk-read hot path
+                # version polls on the bulk-read hot path.
+                # Construction is locked end to end: an unlocked
+                # check-then-set let two concurrent first RPCs build
+                # two backends (split stats, duplicate mirror builds)
                 with self._device_rt_lock:
                     if self._backend_rt is None:
                         self._backend_rt = TpuQueryRuntime(
                             [types.SimpleNamespace(kv=self.kv)],
                             self.schema_man)
-                self.backend = TpuStorageBackend(self._backend_rt,
-                                                 self.schema_man)
+                    if self.backend is None:
+                        self.backend = TpuStorageBackend(
+                            self._backend_rt, self.schema_man)
             except Exception as e:  # noqa: BLE001 — no jax / broken dev
                 # loud, once: a silently-disabled backend is otherwise
                 # indistinguishable from a CPU-only deployment (same
@@ -263,14 +267,25 @@ class StorageService:
         alloc = self.meta_client.parts_alloc(space_id) or {}
         hosts = sorted({h for peers in alloc.values() for h in peers}
                        - {self.local_host})
-        views = []
-        for h in hosts:
-            key = (space_id, h)
-            v = self._remote_views.get(key)
-            if v is None:
-                v = self._remote_views[key] = RemoteStoreView(
-                    HostAddr.parse(h), space_id, self.client_manager)
-            views.append(v)
+        # the view cache is shared across query threads (this runs
+        # outside the runtime's locks) — mutate it under one lock
+        with self._device_rt_lock:
+            # evict views whose host left the space's allocation (or
+            # whose space was dropped — empty alloc): stale entries
+            # otherwise leak forever and keep getting refreshed by
+            # _device_gate
+            live = {(space_id, h) for h in hosts}
+            for key in [k for k in list(self._remote_views)
+                        if k[0] == space_id and k not in live]:
+                self._remote_views.pop(key, None)
+            views = []
+            for h in hosts:
+                key = (space_id, h)
+                v = self._remote_views.get(key)
+                if v is None:
+                    v = self._remote_views[key] = RemoteStoreView(
+                        HostAddr.parse(h), space_id, self.client_manager)
+                views.append(v)
         return views
 
     def _device_gate(self, space_id: int, parts) -> Optional[str]:
@@ -334,6 +349,11 @@ class StorageService:
         p = self.kv.part(space_id, part_id)
         if p is None or not p.is_leader():
             return {"ok": False, "reason": f"not leader for {part_id}"}
+        # version echo sampled BEFORE the rows are read: a write landing
+        # after the read but before a post-iteration sample would stamp
+        # the pre-write rows with the post-write version and hide the
+        # very race the peer's torn-scan guard checks for
+        scan_version = self.kv.mutation_version(space_id)
         prefix = req["prefix"]
         cursor = req.get("cursor")
         limit = int(req.get("limit") or 16384)
@@ -350,8 +370,11 @@ class StorageService:
             last = k
             if len(rows) >= limit:
                 break
+        # version echo: the peer fails a scan whose chunks straddle a
+        # write (RemoteStoreView.prefix torn-scan guard)
         return {"ok": True, "rows": rows, "cursor": last,
-                "done": len(rows) < limit}
+                "done": len(rows) < limit,
+                "version": scan_version}
 
     def rpc_deviceGo(self, req: dict) -> dict:
         from .device import DeviceExecError, TpuDecline

@@ -186,6 +186,7 @@ class TpuQueryRuntime:
         self._plans: Dict[int, _GoPlan] = {}
         self._kernels: Dict[Tuple, object] = {}
         self._lock = threading.Lock()
+        self._build_locks: Dict[int, threading.Lock] = {}
         self._rebuilding: set = set()           # spaces rebuilding now
         self._dispatcher = None   # lazy GoBatchDispatcher
         # observability (tests assert the device path actually ran;
@@ -270,9 +271,37 @@ class TpuQueryRuntime:
                         daemon=True, name=f"mirror-rebuild-{space_id}")
                     t.start()
                 return m
-            m = build_mirror(space_id, stores, self.sm)
-            m._device = self._to_device(m)
-            return self._publish(space_id, m, ver, stores, vers)
+        # sync build OUTSIDE the global lock: a multi-host space streams
+        # full remote part scans over RPC here, and holding the runtime
+        # lock across that stalled every other space's dispatches (and a
+        # hung peer wedged the whole runtime).  The per-space build lock
+        # keeps concurrent first-queries from paying duplicate builds.
+        with self._build_lock(space_id):
+            # re-capture versions: they may have advanced while we
+            # waited for the previous builder, and publishing a build
+            # made for an older version over a newer mirror would
+            # regress freshness
+            stores = self._stores_for(space_id)
+            vers = self._store_versions(space_id, stores)
+            ver = self._space_version(space_id, stores, vers)
+            with self._lock:
+                m = self.mirrors.get(space_id)
+                if m is not None \
+                        and getattr(m, "_fresh_version",
+                                    m.build_version) == ver \
+                        and not m.expired_now():
+                    return m     # another thread built while we waited
+            built = build_mirror(space_id, stores, self.sm)
+            built._device = self._to_device(built)
+            with self._lock:
+                return self._publish(space_id, built, ver, stores, vers)
+
+    def _build_lock(self, space_id: int) -> threading.Lock:
+        with self._lock:
+            lk = self._build_locks.get(space_id)
+            if lk is None:
+                lk = self._build_locks[space_id] = threading.Lock()
+            return lk
 
     def _publish(self, space_id: int, m: CsrMirror, ver: int,
                  stores=None, vers: Optional[List[int]] = None
@@ -360,19 +389,21 @@ class TpuQueryRuntime:
         d = getattr(m, "_delta", None)
         if d is None or d.m == 0:
             return m
-        with self._lock:
+        with self._build_lock(space_id):
             stores = self._stores_for(space_id)
             vers = self._store_versions(space_id, stores)
             ver = self._space_version(space_id, stores, vers)
-            cur = self.mirrors.get(space_id)
-            d = getattr(cur, "_delta", None)
-            if cur is not None and (d is None or d.m == 0) \
-                    and getattr(cur, "_fresh_version",
-                                cur.build_version) == ver:
-                return cur           # someone rebuilt while we waited
+            with self._lock:
+                cur = self.mirrors.get(space_id)
+                d = getattr(cur, "_delta", None)
+                if cur is not None and (d is None or d.m == 0) \
+                        and getattr(cur, "_fresh_version",
+                                    cur.build_version) == ver:
+                    return cur       # someone rebuilt while we waited
             m2 = build_mirror(space_id, stores, self.sm)
             m2._device = self._to_device(m2)
-            return self._publish(space_id, m2, ver, stores, vers)
+            with self._lock:
+                return self._publish(space_id, m2, ver, stores, vers)
 
     def _rebuild_async(self, space_id: int, ver: int,
                        stale: CsrMirror) -> None:
