@@ -183,6 +183,30 @@ uint8_t* neb_scan_prefix(void* h, const uint8_t* p, uint64_t plen,
   return pack_kvs(rows, out_len);
 }
 
+// N prefix scans in one call (the getNeighbors hot path: every
+// requested vertex's edge range of one part in one lock acquisition and
+// one packed buffer).  Prefixes arrive concatenated with offsets and
+// (uniform or per-entry) lengths; out_counts[i] = rows of prefix i.
+uint8_t* neb_scan_multi_prefix(void* h, const uint8_t* blob,
+                               const uint64_t* offs, const uint64_t* lens,
+                               int64_t n, uint64_t* out_len,
+                               uint64_t* out_counts) {
+  auto* e = static_cast<Engine*>(h);
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  std::vector<std::pair<const std::string*, const std::string*>> rows;
+  std::string prefix, ub;
+  for (int64_t i = 0; i < n; i++) {
+    prefix.assign(reinterpret_cast<const char*>(blob + offs[i]), lens[i]);
+    bool bounded = prefix_upper_bound(prefix, &ub);
+    auto it = e->table.lower_bound(prefix);
+    auto end = bounded ? e->table.lower_bound(ub) : e->table.end();
+    uint64_t c = 0;
+    for (; it != end; ++it, ++c) rows.emplace_back(&it->first, &it->second);
+    out_counts[i] = c;
+  }
+  return pack_kvs(rows, out_len);
+}
+
 uint8_t* neb_scan_range(void* h, const uint8_t* s, uint64_t slen,
                         const uint8_t* t, uint64_t tlen, uint64_t* out_len,
                         uint64_t* out_count) {

@@ -37,7 +37,14 @@ class ExecutionResponse:
 
     @property
     def rows(self):
-        return self.raw.get("rows")
+        """Row list; columnar wire payloads (graph/interim.py
+        to_wire) reconstruct lazily — rows materialize on first read,
+        column buffers stay numpy until then."""
+        r = self.raw.get("rows")
+        if isinstance(r, dict) and "__ncols__" in r:
+            from ..graph.interim import rows_from_wire
+            r = self.raw["rows"] = rows_from_wire(r)
+        return r
 
     @property
     def space_name(self) -> str:
@@ -55,10 +62,15 @@ class ExecutionResponse:
 
 class GraphClient:
     def __init__(self, addr: HostAddr,
-                 client_manager: Optional[ClientManager] = None):
+                 client_manager: Optional[ClientManager] = None,
+                 execute_timeout_s: float = 180.0):
         self.addr = addr
         self.cm = client_manager or default_client_manager
         self.session_id: Optional[int] = None
+        # queries legitimately run long (first device compile on a cold
+        # graphd is tens of seconds) — the transport default of 30 s is
+        # for control RPCs, not statements
+        self.execute_timeout_s = execute_timeout_s
 
     def connect(self, username: str = "user",
                 password: str = "password") -> Status:
@@ -79,8 +91,13 @@ class GraphClient:
                 {"error_code": int(ErrorCode.E_DISCONNECTED),
                  "error_msg": "not connected"})
         try:
+            # columnar=True: this client understands the typed-buffer
+            # row payload (rows_from_wire) — plain protocol users that
+            # don't send it get row lists (graph/service.py rpc_execute)
             raw = self.cm.call(self.addr, "execute",
-                               {"session_id": self.session_id, "stmt": stmt})
+                               {"session_id": self.session_id,
+                                "stmt": stmt, "columnar": True},
+                               timeout=self.execute_timeout_s)
         except RpcError as e:
             raw = {"error_code": int(e.status.code),
                    "error_msg": e.status.msg}

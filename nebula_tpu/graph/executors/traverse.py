@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...codec.rows import RowReader, RowSetReader
+from ...common.flags import flags
 from ...common.status import ErrorCode
 from ...filter.expressions import (AliasPropExpr, DestPropExpr,
                                    EdgeDstIdExpr, EdgeRankExpr, EdgeSrcIdExpr,
@@ -37,6 +38,14 @@ _AGG_FNS = {"count", "sum", "avg", "max", "min", "collect"}
 
 
 # ---------------------------------------------------------------- helpers
+flags.define(
+    "flat_bound_mode", True,
+    "GO final hops whose YIELD maps onto flat columns request the "
+    "columnar getBound response (typed buffers, one batch decode) "
+    "instead of per-vertex rowsets; off = always per-vertex (the "
+    "per-row reference shape, kept as the universal fallback)")
+
+
 def walk_expr(expr: Expression):
     yield expr
     for c in expr.children():
@@ -68,6 +77,85 @@ def collect_prop_refs(exprs: List[Expression]):
 
 def default_col_name(expr: Expression) -> str:
     return str(expr)
+
+
+def _flat_yield_specs(yield_cols, over_aliases: Dict[str, int],
+                      etypes: List[int]):
+    """Map each YIELD column onto a flat-response column, or None when
+    any column needs per-row evaluation (composite expressions, and
+    alias props under a multi-edge OVER — those raise per-row on rows
+    of the other edge types, which a column mapping can't reproduce)."""
+    specs = []
+    for c in yield_cols:
+        e = c.expr
+        if isinstance(e, EdgeDstIdExpr) and e.alias in over_aliases:
+            specs.append(("dst",))
+        elif isinstance(e, EdgeSrcIdExpr) and e.alias in over_aliases:
+            specs.append(("src",))
+        elif isinstance(e, EdgeRankExpr) and e.alias in over_aliases:
+            specs.append(("rank",))
+        elif isinstance(e, EdgeTypeExpr) and e.alias in over_aliases:
+            specs.append(("type",))
+        elif isinstance(e, AliasPropExpr) and e.alias in over_aliases \
+                and len(etypes) == 1:
+            specs.append(("prop", e.prop))
+        else:
+            return None
+    return specs
+
+
+def _flat_assemble(responses, specs, etype_to_alias: Dict[int, str],
+                   distinct: bool):
+    """Build the GO result columns straight from flat-response chunks
+    (storage/processors.py _process_flat) — one numpy concatenate per
+    column for the whole result set."""
+    import numpy as np
+    from ..interim import ColumnarRows, ConstCol, _col_tolist
+
+    per_col: List[list] = [[] for _ in specs]
+    total = 0
+    for r in responses:
+        for ch in r.get("flat", ()):
+            n = int(ch["n"])
+            if n == 0:
+                continue
+            total += n
+            alias = etype_to_alias.get(int(ch["etype"]),
+                                       str(ch["etype"]))
+            for i, spec in enumerate(specs):
+                if spec[0] in ("dst", "src", "rank"):
+                    col = np.frombuffer(ch[spec[0]], "<i8")
+                elif spec[0] == "type":
+                    col = ConstCol(alias, n)
+                else:
+                    ps = ch["props"][spec[1]]
+                    col = (np.frombuffer(ps["b"], ps["d"])
+                           if "b" in ps else list(ps["l"]))
+                per_col[i].append(col)
+
+    cols: List[object] = []
+    for chunks in per_col:
+        if not chunks:
+            cols.append([])
+        elif len(chunks) == 1:
+            cols.append(chunks[0])
+        elif all(isinstance(c, np.ndarray) for c in chunks):
+            cols.append(np.concatenate(chunks))
+        else:
+            merged: list = []
+            for c in chunks:
+                merged.extend(_col_tolist(c))
+            cols.append(merged)
+    rows = ColumnarRows(cols, total)
+    if distinct:
+        out, seen = [], set()
+        for row in rows:
+            key = tuple(row)
+            if key not in seen:
+                seen.add(key)
+                out.append(row)
+        return out
+    return rows
 
 
 class _RowCtx(ExprContext):
@@ -225,6 +313,19 @@ class GoExecutor(Executor):
                     if isinstance(vid, int) and vid not in input_map:
                         input_map[vid] = dict(zip(src_interim.columns, row))
 
+        # ---- flat final hop eligibility -----------------------------
+        # columnar end-to-end: the final hop's edges cross as typed
+        # buffers and YIELD columns map straight onto them — no
+        # per-vertex rowsets, no per-row decode/eval.  Any shape the
+        # mapping can't reproduce bit-for-bit keeps the per-row path.
+        flat_specs = None
+        if flags.get("flat_bound_mode") \
+                and pushed is None and remnant is None \
+                and not vertex_props \
+                and not dst_refs and not (has_input or has_var):
+            flat_specs = _flat_yield_specs(yield_cols, over_aliases,
+                                           etypes)
+
         # ---- step loop (stepOut / onStepOutResponse) ----------------
         cur = start_vids
         backtracker: Dict[int, int] = {v: v for v in cur}
@@ -238,7 +339,8 @@ class GoExecutor(Executor):
                 filter_bytes=pushed if is_final else None,
                 vertex_props=vertex_props if is_final else [],
                 edge_props=edge_props if is_final else {},
-                dst_only=not is_final)
+                dst_only=not is_final,
+                flat=is_final and flat_specs is not None)
             if not resp.succeeded() and resp.completeness() == 0:
                 first = next(iter(resp.failed_parts.values()))
                 raise ExecError(f"storage error: {first.to_string()}")
@@ -290,6 +392,20 @@ class GoExecutor(Executor):
         if final_resp is None:
             return InterimResult(columns)
 
+        # ---- flat final eval: columns straight from typed buffers ---
+        flat_rows = None
+        if flat_specs is not None \
+                and any("flat" in r for r in final_resp.responses):
+            flat_rows = _flat_assemble(
+                [r for r in final_resp.responses if "flat" in r],
+                flat_specs, etype_to_alias, distinct)
+            if all("flat" in r for r in final_resp.responses):
+                return InterimResult(columns, flat_rows)
+            # mixed cluster (a host without the native lib answered
+            # per-vertex): the flat hosts' rows must combine with the
+            # per-row loop's — falling through with them dropped would
+            # be silent wrong results
+
         # ---- second wave: dst props ---------------------------------
         dst_prop_map: Dict[int, Dict[Tuple[str, str], object]] = {}
         if dst_refs:
@@ -336,6 +452,10 @@ class GoExecutor(Executor):
         ctx = _RowCtx()
         rows: List[List[object]] = []
         seen_rows: Set[Tuple] = set()
+        if flat_rows is not None:         # mixed flat/per-vertex cluster
+            rows = [list(r) for r in flat_rows]
+            if distinct:
+                seen_rows = {tuple(r) for r in rows}
         for r in final_resp.responses:
             vschema = (schema_from_wire(r["vertex_schema"])
                        if r.get("vertex_schema") else None)

@@ -25,7 +25,7 @@ from ..storage.client import StorageClient
 from .context import ClientSession, ExecutionContext
 from .executors import make_executor
 from .executors.base import ExecError
-from .interim import InterimResult
+from .interim import ColumnarRows, InterimResult
 from .parser import GQLParser
 from .parser.parser import ParseError
 
@@ -212,4 +212,14 @@ class GraphService:
         if session is None:
             return {"error_code": int(ErrorCode.E_SESSION_INVALID),
                     "error_msg": "invalid session"}
-        return self.engine.execute(session, req.get("stmt", ""))
+        resp = self.engine.execute(session, req.get("stmt", ""))
+        if not req.get("columnar"):
+            # wire compatibility: only clients that opted in receive
+            # the typed-buffer columnar row payload (graph/interim.py
+            # to_wire); everyone else (cpp/java/go clients, raw
+            # protocol users) gets the plain row-list shape
+            rows = resp.get("rows")
+            if isinstance(rows, ColumnarRows):
+                resp = dict(resp)
+                resp["rows"] = rows._mat()
+        return resp

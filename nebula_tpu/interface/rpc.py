@@ -180,7 +180,12 @@ class RpcChannel:
             try:
                 if sock is None:
                     try:
-                        sock = self._connect(timeout)
+                        # a short per-call deadline bounds connect too;
+                        # a LONG one (slow statements) must not inflate
+                        # dead-host detection past the transport default
+                        sock = self._connect(
+                            min(timeout, self.timeout)
+                            if timeout is not None else None)
                     except OSError as e:
                         raise RpcError(Status.Error(
                             f"connect to {self.addr} failed: {e}",

@@ -82,6 +82,33 @@ class NativeEngine(KVEngine):
                                      ctypes.byref(count))
         return self._unpack_scan(ptr, total.value)
 
+    def multi_prefix_packed(self, prefixes: List[bytes]):
+        """N prefix scans in ONE native call -> (packed frame buffer,
+        per-prefix row counts) — the getNeighbors hot path's bulk seam.
+        None when the loaded .so predates the entry point."""
+        if not hasattr(self._L, "neb_scan_multi_prefix"):
+            return None
+        import numpy as np
+        n = len(prefixes)
+        lens = np.fromiter((len(p) for p in prefixes), dtype=np.uint64,
+                           count=n)
+        offs = np.zeros(n, dtype=np.uint64)
+        if n:
+            np.cumsum(lens[:-1], out=offs[1:])
+        blob = b"".join(prefixes)
+        counts = np.zeros(n, dtype=np.uint64)
+        total = ctypes.c_uint64()
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        ptr = self._L.neb_scan_multi_prefix(
+            self._h, ctypes.cast(ctypes.c_char_p(blob),
+                                 ctypes.POINTER(ctypes.c_uint8)),
+            offs.ctypes.data_as(u64p), lens.ctypes.data_as(u64p), n,
+            ctypes.byref(total), counts.ctypes.data_as(u64p))
+        try:
+            return ctypes.string_at(ptr, total.value), counts
+        finally:
+            self._L.neb_buf_free(ptr)
+
     def scan_prefix_packed(self, prefix: bytes) -> bytes:
         """Raw packed frames of a prefix scan — zero-rework input for the
         native batch codec (CSR mirror fold)."""

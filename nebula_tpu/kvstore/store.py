@@ -280,6 +280,17 @@ class NebulaStore:
             return iter(())
         return p.engine.range(start, end)
 
+    def multi_prefix_packed(self, space_id, part_id,
+                            prefixes: List[bytes]):
+        """Bulk read seam: N prefix scans of one part in one engine
+        call -> (packed (klen,vlen,k,v)* buffer, per-prefix counts), or
+        None when the engine has no bulk path (callers loop prefix())."""
+        p, st = self._check(space_id, part_id)
+        if not st.ok():
+            return None
+        fn = getattr(p.engine, "multi_prefix_packed", None)
+        return fn(prefixes) if fn is not None else None
+
     # ---- writes (via Part → raft when attached) ----------------------
     def multi_put(self, space_id, part_id, kvs: List[KV]) -> Status:
         p, st = self._check(space_id, part_id)

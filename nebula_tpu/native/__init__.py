@@ -94,6 +94,10 @@ def lib() -> Optional[ctypes.CDLL]:
     _sig(L.neb_scan_range, u8p,
          [vp, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
           ctypes.c_uint64, u64p, u64p])
+    # round-4 addition — guarded like ell_build below (stale .so)
+    if hasattr(L, "neb_scan_multi_prefix"):
+        _sig(L.neb_scan_multi_prefix, u8p,
+             [vp, u8p, u64p, u64p, ctypes.c_int64, u64p, u64p])
     _sig(L.neb_total_keys, ctypes.c_int64, [vp])
     _sig(L.neb_flush, ctypes.c_int, [vp, ctypes.c_char_p])
     _sig(L.neb_ingest, ctypes.c_int, [vp, ctypes.c_char_p])

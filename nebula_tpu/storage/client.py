@@ -198,10 +198,15 @@ class StorageClient:
                       vertex_props: Optional[List[List]] = None,
                       edge_props: Optional[Dict[int, List[str]]] = None,
                       reverse: bool = False, dst_only: bool = False,
+                      flat: bool = False,
                       retries: int = 3) -> StorageRpcResponse:
         """``dst_only``: lean intermediate-hop mode — the response
         carries packed int64 destination arrays per vertex instead of
-        encoded rowsets (no props/filter may be requested with it)."""
+        encoded rowsets (no props/filter may be requested with it).
+        ``flat``: final-hop columnar mode — edges cross as typed
+        (src, rank, dst [, prop]) buffers when the storaged can cover
+        the shape (processors._process_flat); it falls back to the
+        per-vertex format otherwise, so callers must handle both."""
         parts = self.cluster_by_part(space_id, vids)
 
         def make(parts_subset):
@@ -214,6 +219,7 @@ class StorageClient:
                 "edge_props": {str(k): v for k, v in (edge_props or {}).items()},
                 "reverse": reverse,
                 "dst_only": dst_only,
+                "flat": flat,
             }
 
         return self.collect(space_id, parts, make, retries=retries)

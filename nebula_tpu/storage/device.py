@@ -264,8 +264,9 @@ class RemoteDeviceRuntime:
             "pushed_mode": pushed_mode,
         }
         resp = self._call(host, "deviceGo", req, ExecError)
+        from ..graph.interim import rows_from_wire
         return InterimResult(list(resp["columns"]),
-                             [list(r) for r in resp["rows"]])
+                             rows_from_wire(resp["rows"]))
 
     # ------------------------------------------------------------ FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:

@@ -207,6 +207,17 @@ class QueryBoundProcessor(QueryBaseProcessor):
             # instead of encoded rowsets, cutting both the wire bytes
             # (~4x) and every row decode on the graphd side
             return self._process_dst_only(dur, space_id, req, edge_types)
+        if req.get("flat") and not req.get("filter") \
+                and not req.get("vertex_props"):
+            # final-hop columnar mode: the whole request's edges cross
+            # as typed (src, rank, dst [, prop]) column buffers — ONE
+            # batch key-parse + dedup + prop decode for every vertex of
+            # the request, no per-vertex rowset encode and no per-row
+            # graphd decode.  None -> shape not coverable (TTL, missing
+            # native lib, invalid prop) -> the per-vertex path below
+            resp = self._process_flat(dur, space_id, req, edge_types)
+            if resp is not None:
+                return resp
         tcs = self.build_tag_contexts(space_id, req.get("vertex_props", []))
         filter_expr = self.decode_filter(space_id, req.get("filter"))
         edge_props: Dict[int, List[str]] = {
@@ -385,6 +396,160 @@ class QueryBoundProcessor(QueryBaseProcessor):
                     if v is not None]
         return {"vertex_schema": None, "edge_schemas": {},
                 "vertices": vertices, "dst_only": True,
+                "latency_us": dur.elapsed_in_usec()}
+
+    def flat_coverable(self, space_id: int,
+                       edge_types: List[int]) -> bool:
+        """Cheap probe: can _process_flat cover this shape?  (Native
+        lib present, no TTL'd schema in the OVER set.)  Callers route
+        non-coverable flat requests to the backend/per-vertex paths
+        without paying a failed flat attempt."""
+        from ..native import lib
+        if lib() is None:
+            return False
+        ets = edge_types or self.schema_man.all_edge_types(space_id)
+        for et in ets:
+            s = self.schema_man.get_edge_schema(space_id, abs(int(et)))
+            if s is None or s.schema_prop.ttl_col:
+                return False
+        return True
+
+    def _process_flat(self, dur: Duration, space_id: int, req: dict,
+                      edge_types: List[int]) -> Optional[dict]:
+        """getNeighbors columnar mode: per requested edge type, the
+        latest-version-deduped edges of EVERY requested vertex as typed
+        column buffers — (src, rank, dst) parsed from keys in one C
+        call, requested props decoded column-at-a-time in one C call
+        each.  Row semantics identical to process_vertex (same scan
+        order, same (rank, dst) dedup); only the representation is
+        columnar.  Returns None when the shape needs the per-vertex
+        path (TTL'd schema, native lib missing, schema-drifted rows,
+        invalid props)."""
+        import numpy as np
+        from ..native import lib
+        from ..native.batch import (concat_blobs, decode_field,
+                                    parse_keys, split_frames)
+        if lib() is None:
+            return None
+        edge_props: Dict[int, List[str]] = {
+            int(k): list(v) for k, v in req.get("edge_props", {}).items()}
+        chunks = []
+        for et in edge_types:
+            schema = self.schema_man.get_edge_schema(space_id, abs(et))
+            if schema is None:
+                raise _err(ErrorCode.E_EDGE_PROP_NOT_FOUND, f"edge {et}")
+            if schema.schema_prop.ttl_col:
+                return None          # TTL rows need per-row checks
+            req_props = edge_props.get(et, edge_props.get(abs(et), []))
+            for p in req_props:
+                if schema.field_index(p) < 0:
+                    raise _err(ErrorCode.E_EDGE_PROP_NOT_FOUND,
+                               f"edge {et} prop {p}")
+            # one engine call per part: every vertex's edge range in
+            # one packed buffer (the reference's analogue is the
+            # per-vertex prefix scan fan-out across its worker pool,
+            # QueryBaseProcessor.inl:433-460 — here the bulk is a
+            # single lock acquisition + buffer, no per-vertex Python)
+            per_part = []
+            for part, vids in req["parts"].items():
+                part = int(part)
+                pref = [KeyUtils.edge_prefix(part, int(v), et)
+                        for v in vids]
+                bulk = self.kv.multi_prefix_packed(space_id, part, pref)
+                if bulk is None:
+                    # engine without the bulk seam: per-vid loop
+                    keys_p: List[bytes] = []
+                    vals_p: List[bytes] = []
+                    cnts_p: List[int] = []
+                    for pfx in pref:
+                        n0 = len(keys_p)
+                        for k, v in self.kv.prefix(space_id, part, pfx):
+                            keys_p.append(k)
+                            vals_p.append(v)
+                        cnts_p.append(len(keys_p) - n0)
+                    blob_p, ko, kl = concat_blobs(keys_p)
+                    vblob_p, vo, vl = concat_blobs(vals_p)
+                    per_part.append((blob_p, ko, kl, vblob_p, vo, vl,
+                                     np.asarray(cnts_p, np.int64)))
+                else:
+                    packed, cnts = bulk
+                    sf = split_frames(packed)
+                    if sf is None:
+                        return None
+                    ko, kl, vo, vl = sf
+                    per_part.append((packed, ko, kl, packed, vo, vl,
+                                     cnts.astype(np.int64)))
+            total_rows = sum(len(pp[1]) for pp in per_part)
+            if total_rows == 0:
+                continue
+            # parse + dedup per part, then concatenate kept columns
+            kept_src, kept_rank, kept_dst = [], [], []
+            kept_val_src = []        # (blob, offs, lens) per part
+            for (blob_p, ko, kl, vblob_p, vo, vl, cnts) in per_part:
+                if len(ko) == 0:
+                    continue
+                pk = parse_keys(blob_p, ko, kl)
+                if pk is None or not np.all(pk.kind == 2):
+                    return None
+                rank, dst = pk.c, pk.d
+                # latest-version-first key order within each vertex
+                # run: keep the first of each consecutive
+                # (run, rank, dst) (QueryBaseProcessor.inl:352-361)
+                run = np.repeat(np.arange(len(cnts), dtype=np.int64),
+                                cnts)
+                keep = np.ones(len(ko), dtype=bool)
+                keep[1:] = ((rank[1:] != rank[:-1])
+                            | (dst[1:] != dst[:-1])
+                            | (run[1:] != run[:-1]))
+                kept_src.append(pk.a[keep])
+                kept_rank.append(rank[keep])
+                kept_dst.append(dst[keep])
+                if req_props:
+                    kept_val_src.append((vblob_p, vo[keep], vl[keep]))
+            if not kept_src:
+                continue
+            src_all = np.concatenate(kept_src)
+            rank_all = np.concatenate(kept_rank)
+            dst_all = np.concatenate(kept_dst)
+            props_out = {}
+            if req_props:
+                for p in req_props:
+                    fi = schema.field_index(p)
+                    pcols = []
+                    for (vblob_p, kvo, kvl) in kept_val_src:
+                        cols = decode_field(vblob_p, kvo, kvl, schema,
+                                            fi)
+                        if cols is None or not np.all(cols.valid == 1):
+                            return None   # schema drift -> per-row
+                        pcols.append(cols)
+                    t = schema.columns[fi].type
+                    if t in (SupportedType.INT, SupportedType.VID,
+                             SupportedType.TIMESTAMP):
+                        props_out[p] = {"d": "<i8", "b": np.concatenate(
+                            [c.i64 for c in pcols]).tobytes()}
+                    elif t == SupportedType.BOOL:
+                        props_out[p] = {"d": "|b1", "b": np.concatenate(
+                            [c.i64 for c in pcols]).astype(
+                                bool).tobytes()}
+                    elif t in (SupportedType.FLOAT, SupportedType.DOUBLE):
+                        props_out[p] = {"d": "<f8", "b": np.concatenate(
+                            [c.f64 for c in pcols]).tobytes()}
+                    elif t == SupportedType.STRING:
+                        strs: List[str] = []
+                        for c in pcols:
+                            strs.extend(c.strings())
+                        props_out[p] = {"l": strs}
+                    else:
+                        return None
+            chunks.append({
+                "etype": int(et), "n": int(len(src_all)),
+                "src": np.ascontiguousarray(src_all, "<i8").tobytes(),
+                "rank": np.ascontiguousarray(rank_all, "<i8").tobytes(),
+                "dst": np.ascontiguousarray(dst_all, "<i8").tobytes(),
+                "props": props_out,
+            })
+        return {"vertex_schema": None, "edge_schemas": {},
+                "vertices": [], "flat": chunks,
                 "latency_us": dur.elapsed_in_usec()}
 
     def _dst_only_slow(self, space_id: int, part: int, vid: int, et: int):

@@ -129,6 +129,18 @@ class StorageService:
         stats.add_value("storage.qps")
 
         def run(r):
+            proc = QueryBoundProcessor(self.kv, self.schema_man,
+                                       self.pool)
+            if r.get("flat") and not r.get("filter") \
+                    and not r.get("vertex_props") \
+                    and proc.flat_coverable(int(r["space_id"]),
+                                            r.get("edge_types") or []):
+                # columnar final hop beats both the per-vertex backend
+                # response and the per-vertex processor.  The cheap
+                # coverage probe keeps non-coverable shapes (TTL'd
+                # schemas, missing native lib) on the backend path
+                # below instead of regressing them to per-vertex CPU
+                return proc.process(r)
             b = self._ensure_backend()
             if b is not None and b.serves(int(r["space_id"])):
                 from ..tpu.backend import BackendDecline
@@ -139,8 +151,7 @@ class StorageService:
                     return resp
                 except BackendDecline:
                     pass          # mirror can't reproduce — CPU answers
-            return QueryBoundProcessor(self.kv, self.schema_man,
-                                       self.pool).process(r)
+            return proc.process(r)
 
         resp = self._bulk(req, run)
         stats.add_value("storage.get_bound.latency_us",

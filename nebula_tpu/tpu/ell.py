@@ -554,10 +554,37 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             ids = jnp.pad(ids, (0, padn), constant_values=sentinel)
             qid = jnp.pad(qid, (0, padn), constant_values=2**30)
         head = jnp.stack([cnt, overflow.astype(jnp.int32)])
+        if pack32:
+            # one packed q*R1+i word per pair — HALF the device->host
+            # transfer (the fetch is the serving profile's cost center;
+            # the link under the remote tunnel moves ~40 MB/s)
+            key = jnp.where(qid == BIG_Q, I32_MAX,
+                            qid * R1 + jnp.minimum(ids, sentinel))
+            return jnp.concatenate([head, key])
         return jnp.concatenate(
             [head, jnp.where(qid == BIG_Q, -1, qid), ids])
 
+    go.pack32 = pack32              # host resolve unpacks accordingly
+    go.R1 = R1
     return go
+
+
+def sparse_go_pairs(kern, out: np.ndarray):
+    """Decode a sparse-GO kernel's output array ->
+    (cnt, overflow, qids, new_ids) — the one place that knows whether
+    the kernel packed (q, i) into single words."""
+    out = np.asarray(out)
+    cnt, overflow = int(out[0]), bool(out[1])
+    if getattr(kern, "pack32", False):
+        keys = out[2:]
+        keys = keys[keys != np.int32(2**31 - 1)]
+        R1 = kern.R1
+        return cnt, overflow, keys // R1, keys % R1
+    c_fin = (len(out) - 2) // 2
+    qids = out[2:2 + c_fin]
+    ids = out[2 + c_fin:]
+    live = qids >= 0
+    return cnt, overflow, qids[live], ids[live]
 
 
 def make_adaptive_go_kernel(ell: EllIndex, steps: int,

@@ -705,18 +705,14 @@ class TpuQueryRuntime:
         self.stats["go_sparse"] += 1
 
         def resolve():
-            out = np.asarray(out_dev)
-            c_fin = (len(out) - 2) // 2
-            overflow = out[1] != 0
+            from .ell import sparse_go_pairs
+            _cnt, overflow, qids, vids_new = sparse_go_pairs(
+                kern, np.asarray(out_dev))
             if overflow:
                 self.stats["sparse_overflows"] += 1
                 return self._launch_dense(space_id, m, ix, d_all, q_all,
                                           nq, et_tuple, steps, None,
                                           self._mesh_tables(m, ix))()
-            qids = out[2:2 + c_fin]
-            vids_new = out[2 + c_fin:]
-            live = qids >= 0
-            qids, vids_new = qids[live], vids_new[live]
             vs_old = ix.inv[vids_new]
             # sorted by (query, old dense id): deterministic row order
             # identical to the dense path's ascending nonzero scan
@@ -1413,32 +1409,37 @@ class TpuQueryRuntime:
                     m, space_id, alias_to_etype, etype_to_alias,
                     yield_cols, idx, exc_type)
 
+        from ..graph.interim import _col_tolist
         out_cols: List[List[object]] = []
         k_edges = len(idx)
         for cv, yc in zip(cvals, yield_cols):
             arr = cv.fn(env)
-            out_cols.append(self._decode_col(m, cv, yc, arr, idx, k_edges,
-                                             etype_to_alias))
+            out_cols.append(_col_tolist(
+                self._decode_col(m, cv, yc, arr, idx, k_edges,
+                                 etype_to_alias)))
         if len(out_cols) == 1:
             return [[v] for v in out_cols[0]]
         return [list(t) for t in zip(*out_cols)]
 
     def _decode_col(self, m: CsrMirror, cv: CVal, yc, arr, idx: np.ndarray,
-                    k: int, etype_to_alias: Dict[int, str]) -> List[object]:
-        """One YIELD column -> python values (C-speed .tolist() paths)."""
+                    k: int, etype_to_alias: Dict[int, str]):
+        """One YIELD column -> a flat column container (numpy array /
+        ConstCol / DictCol) — rows materialize only at the edge, and
+        the wire carries typed buffers (graph/interim.py)."""
+        from ..graph.interim import ConstCol, DictCol
         if cv.kind == K_VIDRANK:
-            return m.vids[np.asarray(arr)].tolist()
+            return m.vids[np.asarray(arr)]
         if cv.kind == K_STR:
-            return [cv.const] * k
+            return ConstCol(cv.const, k)
         if cv.kind == K_STRCODE:
-            d = cv.dictionary
-            return [str(d[c]) for c in np.asarray(arr).tolist()]
+            return DictCol(np.asarray(arr),
+                           [str(v) for v in cv.dictionary])
         a = np.broadcast_to(np.asarray(arr), (k,))
         if cv.kind == K_BOOL:
-            return a.astype(bool).tolist()
+            return a.astype(bool)
         if cv.kind == K_FLOAT:
-            return a.astype(np.float64).tolist()
-        return a.astype(np.int64).tolist()
+            return a.astype(np.float64)
+        return a.astype(np.int64)
 
     def _materialize_per_row(self, m: CsrMirror, space_id: int,
                              alias_to_etype: Dict[str, int],

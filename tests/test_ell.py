@@ -437,15 +437,12 @@ def test_sparse_batched_go_parity_random():
         hub = jnp.asarray(ix.hub_table())
         out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
                               *ix.kernel_args()[1:]))
-        c_fin = (len(out) - 2) // 2
-        if out[1]:      # overflow/hub reported — dense fallback covers it
+        _cnt, overflow, qids, vnew = E.sparse_go_pairs(kern, out)
+        if overflow:    # overflow/hub reported — dense fallback covers it
             continue
-        qids = out[2:2 + c_fin]
-        vnew = out[2 + c_fin:]
-        live = qids >= 0
         got = np.zeros((n, nq), bool)
-        if live.any():
-            got[ix.inv[vnew[live]], qids[live]] = True
+        if len(qids):
+            got[ix.inv[vnew], qids] = True
         np.testing.assert_array_equal(got, exp, err_msg=f"trial {trial}")
         verified += 1
     assert verified >= 2, "every trial overflowed; caps too tight to test"
@@ -475,9 +472,8 @@ def test_sparse_hub_in_final_frontier_no_overflow():
     ids[0] = ix.perm[0]
     out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
                           *ix.kernel_args()[1:]))
-    assert out[1] == 0, "hub in final frontier must not overflow"
-    c_fin = (len(out) - 2) // 2
-    vids = out[2 + c_fin:][out[2:2 + c_fin] >= 0]
+    _cnt, overflow, _qids, vids = E.sparse_go_pairs(kern, out)
+    assert not overflow, "hub in final frontier must not overflow"
     assert list(ix.inv[vids]) == [2]          # exactly the hub
 
     # but a hub as a PUSH SOURCE (intermediate hop) must bail to dense

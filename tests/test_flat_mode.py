@@ -1,0 +1,116 @@
+"""Flat (columnar) final-hop mode: getBound with flat=True answers with
+typed column buffers (storage/processors.py _process_flat) and GO maps
+YIELD columns straight onto them (traverse.py _flat_assemble).
+
+Parity contract: every GO shape must return the same row SET whether the
+flat path serves it or the per-vertex path does (ordering may differ —
+flat emits etype-major, per-vertex emits vertex-major, and the reference
+makes no ordering promise for GO either).
+"""
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.flags import flags
+
+A, B, C, D = 1, 2, 3, 4
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(num_storage=1)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def client(cluster):
+    client = cluster.client()
+
+    def ok(stmt):
+        resp = client.execute(stmt)
+        assert resp.ok(), f"{stmt}: {resp.error_msg}"
+        return resp
+
+    client.ok = ok
+    ok("CREATE SPACE flat(partition_num=4)")
+    cluster.refresh_all()
+    ok("USE flat")
+    ok("CREATE TAG node(name string)")
+    ok("CREATE EDGE rel(w int, f double, label string, flagb bool)")
+    ok("CREATE EDGE other(x int)")
+    cluster.refresh_all()
+    ok('INSERT VERTEX node(name) VALUES '
+       f'{A}:("a"), {B}:("b"), {C}:("c"), {D}:("d")')
+    ok('INSERT EDGE rel(w, f, label, flagb) VALUES '
+       f'{A} -> {B}:(10, 1.5, "ab", true), '
+       f'{A} -> {C}:(20, 2.5, "ac", false), '
+       f'{B} -> {D}:(30, 3.5, "bd", true)')
+    ok(f'INSERT EDGE other(x) VALUES {A} -> {D}:(7)')
+    yield client
+    client.disconnect()
+
+
+def both_paths(cluster, client, stmt):
+    """Row sets via the normal path and via the flat-mode path (flat is
+    on by default; the switch here proves both agree)."""
+    flags.set("flat_bound_mode", False)
+    try:
+        slow = {tuple(r) for r in client.ok(stmt).rows}
+    finally:
+        flags.set("flat_bound_mode", True)
+    fast = {tuple(r) for r in client.ok(stmt).rows}
+    assert fast == slow, stmt
+    return fast
+
+
+def test_default_yield(cluster, client):
+    got = both_paths(cluster, client, f"GO FROM {A} OVER rel")
+    assert got == {(B,), (C,)}
+
+
+def test_pseudo_and_prop_yields(cluster, client):
+    got = both_paths(
+        cluster, client,
+        f"GO FROM {A} OVER rel YIELD rel._src, rel._dst, rel._rank, "
+        f"rel.w, rel.f, rel.label, rel.flagb")
+    assert got == {(A, B, 0, 10, 1.5, "ab", True),
+                   (A, C, 0, 20, 2.5, "ac", False)}
+
+
+def test_two_hops(cluster, client):
+    got = both_paths(cluster, client,
+                     f"GO 2 STEPS FROM {A} OVER rel YIELD rel._dst, rel.w")
+    assert got == {(D, 30)}
+
+
+def test_multi_etype_over_pseudo_only(cluster, client):
+    # multi-edge OVER with pseudo-col yields is flat-eligible
+    got = both_paths(cluster, client,
+                     f"GO FROM {A} OVER rel, other YIELD rel._dst")
+    assert got == {(B,), (C,), (D,)}
+
+
+def test_multi_etype_alias_prop_keeps_per_row_semantics(cluster, client):
+    # alias prop under multi-edge OVER must raise on the other edge's
+    # rows (per-row semantics) — flat mode must not change that
+    r = client.execute(f"GO FROM {A} OVER rel, other YIELD rel.w")
+    assert not r.ok()
+
+
+def test_distinct(cluster, client):
+    got = both_paths(cluster, client,
+                     f"GO FROM {A}, {B} OVER rel YIELD DISTINCT rel._rank")
+    assert got == {(0,)}
+
+
+def test_flat_response_shape(cluster, client):
+    """The storage response really is columnar for the eligible shape."""
+    space = cluster.graph_meta_client.get_space_id_by_name("flat").value()
+    sm = cluster.schema_man
+    et = sm.to_edge_type(space, "rel").value()
+    resp = cluster.storage_client.get_neighbors(
+        space, [A, B], [et], flat=True)
+    assert resp.succeeded()
+    assert all("flat" in r for r in resp.responses)
+    n = sum(ch["n"] for r in resp.responses for ch in r["flat"])
+    assert n == 3

@@ -62,17 +62,26 @@ class TestGetBoundParity:
     @pytest.mark.parametrize("q", PIPED)
     def test_piped_go_rows_match_cpu(self, cluster, q):
         c, ok = cluster
-        b0 = stats.read_stats("storage.backend_bound.qps.count.3600") or 0
-        r = ok(q)
-        backend_rows = sorted(map(tuple, r.rows))
-        assert (stats.read_stats("storage.backend_bound.qps.count.3600")
-                or 0) > b0, "backend did not serve the getBound hops"
-        flags.set("storage_backend", "cpu")
+        # pin the per-vertex response format: this test exercises the
+        # mirror-backed backend's getBound serving, which flat-eligible
+        # final hops would otherwise bypass for the columnar processor
+        prev_flat = flags.get("flat_bound_mode")
+        flags.set("flat_bound_mode", False)
         try:
-            r2 = ok(q)
+            b0 = stats.read_stats("storage.backend_bound.qps.count.3600") \
+                or 0
+            r = ok(q)
+            backend_rows = sorted(map(tuple, r.rows))
+            assert (stats.read_stats("storage.backend_bound.qps.count.3600")
+                    or 0) > b0, "backend did not serve the getBound hops"
+            flags.set("storage_backend", "cpu")
+            try:
+                r2 = ok(q)
+            finally:
+                flags.set("storage_backend", "tpu")
+            assert backend_rows == sorted(map(tuple, r2.rows)), q
         finally:
-            flags.set("storage_backend", "tpu")
-        assert backend_rows == sorted(map(tuple, r2.rows)), q
+            flags.set("flat_bound_mode", prev_flat)
 
     def test_get_bound_wire_parity_direct(self, cluster):
         """Byte-for-byte response parity backend vs CPU processor on the
