@@ -63,11 +63,13 @@ class NebulaStore:
         # raft or single-replica — never on submit or on rejected writes.
         self.mutation_versions: Dict[GraphSpaceID, int] = {}
         # per-space committed-mutation delta log: one entry per version
-        # bump — either a list of (key, value) pure edge-puts (the TPU
-        # mirror can apply these incrementally, SURVEY §7 hard part (a))
-        # or None for anything it can't describe (deletes, vertex
-        # writes, ingest, compaction) which forces a full mirror
-        # rebuild.  Bounded; trimming invalidates older cursors.
+        # bump — either a list of typed edge events
+        # (("put", key, value) inserts/updates, ("del", identity32)
+        # whole-edge deletes) the TPU mirror can apply incrementally
+        # (SURVEY §7 hard part (a)), or None for anything it can't
+        # describe (vertex writes, partial removes, ingest, compaction)
+        # which forces a full mirror rebuild.  Bounded; trimming
+        # invalidates older cursors.
         self.delta_logs: Dict[GraphSpaceID, List] = {}
         self.delta_bases: Dict[GraphSpaceID, int] = {}
         self.delta_cap = 4096
@@ -92,9 +94,10 @@ class NebulaStore:
             return self.mutation_versions.get(space_id, 0)
 
     def delta_since(self, space_id: GraphSpaceID, from_version: int):
-        """Edge-put (key, value) pairs for every mutation after
-        ``from_version`` — or None when that range is unavailable
-        (trimmed) or contains anything but pure edge inserts."""
+        """Typed edge events for every mutation after ``from_version``
+        — ("put", key, value) | ("del", identity32) — or None when that
+        range is unavailable (trimmed) or contains anything the event
+        stream can't describe."""
         with self._version_lock:
             base = self.delta_bases.get(space_id, 0)
             log = self.delta_logs.get(space_id, [])
@@ -107,31 +110,39 @@ class NebulaStore:
                 out.extend(entry)
             return out
 
+    # a remove_prefix whose prefix is a FULL edge identity
+    # (part+src+etype+rank+dst, no version) deletes all versions of one
+    # edge — the DELETE EDGE executor's shape (processors.delete_edges)
+    _EDGE_IDENT_LEN = 32
+
     @staticmethod
-    def _classify_commit(decoded) -> Optional[List[KV]]:
-        """Committed batch -> edge-put kvs, or None (opaque)."""
+    def _classify_commit(decoded):
+        """Committed batch -> typed edge events, or None (opaque)."""
         from ..common.keys import KeyUtils
         from .log_encoder import LogOp
         if decoded is None:        # snapshot install: everything changed
             return None
-        kvs: List[KV] = []
+        events: List = []
         for op, payload in decoded:
-            if op == LogOp.OP_PUT:
-                items = [payload]
-            elif op == LogOp.OP_MULTI_PUT:
-                items = payload
+            if op in (LogOp.OP_PUT, LogOp.OP_MULTI_PUT):
+                items = [payload] if op == LogOp.OP_PUT else payload
+                for key, value in items:
+                    if key.startswith(b"__system"):
+                        continue   # commit watermark bookkeeping
+                    if not KeyUtils.is_edge(key):
+                        return None    # vertex/prop writes: opaque
+                    events.append(("put", key, value))
+            elif op == LogOp.OP_REMOVE_PREFIX:
+                prefix = payload
+                if len(prefix) != NebulaStore._EDGE_IDENT_LEN:
+                    return None    # vertex-level / partial: opaque
+                events.append(("del", prefix))
             elif op in (LogOp.OP_ADD_LEARNER, LogOp.OP_TRANS_LEADER,
                         LogOp.OP_ADD_PEER, LogOp.OP_REMOVE_PEER):
                 continue               # membership — no data change
             else:
-                return None            # removes / merges: opaque
-            for key, value in items:
-                if key.startswith(b"__system"):
-                    continue           # commit watermark bookkeeping
-                if not KeyUtils.is_edge(key):
-                    return None        # vertex/prop writes: opaque
-                kvs.append((key, value))
-        return kvs
+                return None            # point removes / merges: opaque
+        return events
 
     def init(self) -> None:
         """Adopt parts the PartManager says belong to this host

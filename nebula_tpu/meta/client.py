@@ -71,9 +71,24 @@ class MetaClient:
     # ---------------- rpc plumbing ----------------
     def _call(self, method: str, payload: dict):
         last_exc: Optional[RpcError] = None
-        for addr in self.addrs:
+        # last known-good metad (the catalog leader) first; a follower's
+        # E_NOT_A_LEADER carries the leader hint in its message, which
+        # jumps the queue (reference MetaClient leader-change retry)
+        queue = list(self.addrs)
+        good = getattr(self, "_good_addr", None)
+        if good in queue:
+            queue.remove(good)
+            queue.insert(0, good)
+        tried = set()
+        while queue:
+            addr = queue.pop(0)
+            if addr in tried:
+                continue
+            tried.add(addr)
             try:
-                return self.cm.call(addr, method, payload)
+                resp = self.cm.call(addr, method, payload)
+                self._good_addr = addr
+                return resp
             except RpcError as e:
                 # Fail over to another metad only when the request provably
                 # never executed (connect failure) or this peer isn't the
@@ -83,6 +98,14 @@ class MetaClient:
                                      ErrorCode.E_LEADER_CHANGED,
                                      ErrorCode.E_NOT_A_LEADER):
                     last_exc = e
+                    if e.status.code == ErrorCode.E_NOT_A_LEADER \
+                            and e.status.msg:
+                        try:
+                            hint = HostAddr.parse(e.status.msg)
+                        except Exception:   # noqa: BLE001 — bad hint
+                            hint = None
+                        if hint is not None and hint not in tried:
+                            queue.insert(0, hint)
                     continue
                 raise
         raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))

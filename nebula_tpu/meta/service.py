@@ -87,7 +87,11 @@ class MetaService:
             pm.add_part(META_SPACE, META_PART)
         self.kv = kv
         self.active_hosts = ActiveHostsMan(kv)
-        self.cluster_id = ClusterIdMan.get_or_create(kv)
+        self._cluster_id: Optional[int] = None   # resolved lazily: at
+        # construction a replicated catalog has no raft leader yet, so
+        # the generate+persist write would be dropped on every node —
+        # the LEADER resolves it on first use (reference: MetaDaemon
+        # waits for election, then the leader persists the id)
         self.balancer = None  # wired by meta/balancer.py when admin client exists
         # RpcServer is threaded: one lock serializes catalog access
         # (id allocation + check-then-put DDL are read-modify-write).
@@ -99,10 +103,33 @@ class MetaService:
 
     def _locked(self, fn):
         def wrapper(req: dict):
+            self._check_catalog_leader()
             with self._write_lock:
                 return fn(req)
         wrapper.__name__ = fn.__name__
         return wrapper
+
+    @property
+    def cluster_id(self) -> int:
+        if self._cluster_id is None:
+            self._cluster_id = ClusterIdMan.get_or_create(self.kv)
+        return self._cluster_id
+
+    def _check_catalog_leader(self) -> None:
+        """Replicated metad: only the catalog raft leader serves —
+        followers answer E_NOT_A_LEADER (with the leader hint as the
+        message) so MetaClient fails over to the right peer.  The
+        reference gates the same way: MetaDaemon waits for the part-0
+        leader before serving and processors check leadership
+        (MetaDaemon.cpp:58-115).  Follower writes would otherwise be
+        silently dropped (the raft part refuses the append but DDL
+        handlers don't surface per-put status), and follower reads
+        could serve a stale catalog as authoritative."""
+        p = self.kv.part(META_SPACE, META_PART)
+        if p is not None and p.raft is not None and not p.is_leader():
+            from ..interface.rpc import RpcError
+            raise RpcError(Status(ErrorCode.E_NOT_A_LEADER,
+                                  p.leader() or ""))
 
     def wire_balancer(self, client_manager) -> None:
         """Attach the Balancer + AdminClient (needs a channel to the

@@ -159,6 +159,7 @@ class RaftPart:
         now = time.monotonic()
         self._last_heard = now + random.random() * 0.2   # stagger first wave
         self._last_hb = 0.0
+        self._last_tick: Optional[float] = None   # starvation guard
         self._reset_election_timeout()
 
         # single replica group: immediately leader
@@ -760,12 +761,31 @@ class RaftPart:
             return {"code": 0, "term": self.term}
 
     # ==================================================== elections
-    def tick(self, now: float) -> None:
+    def tick(self, now: float,
+             expected_interval: Optional[float] = None) -> None:
         """Called by the service's status-polling thread (reference
-        statusPolling RaftPart.cpp:966)."""
+        statusPolling RaftPart.cpp:966).
+
+        ``expected_interval``: the poller's nominal tick period.  When
+        the gap since the previous tick blows past it, THIS process was
+        starved (GIL convoy, CPU oversubscription) — during the stall
+        it could not have received the leader's heartbeats even if they
+        arrived, so the stalled time must not count toward the election
+        timeout.  Deferring an election is always safe (liveness-only);
+        starting one because we ourselves were descheduled is the
+        classic false-positive that made failover tests flake under
+        full-suite load."""
         with self._lock:
             if self._stopped:
                 return
+            if expected_interval is not None:
+                last = self._last_tick
+                self._last_tick = now
+                if last is not None:
+                    stall = (now - last) - expected_interval
+                    if stall > expected_interval:
+                        self._last_heard = min(
+                            now, self._last_heard + stall)
             role = self.role
             if role == Role.LEADER:
                 if now - self._last_hb >= float(

@@ -87,7 +87,7 @@ class RaftexService:
                 parts = list(self.parts.values())
             for p in parts:
                 try:
-                    p.tick(now)
+                    p.tick(now, expected_interval=_TICK_S)
                     if clean:
                         # bound WAL growth (keeps raft_wal_keep_logs of
                         # catch-up window; snapshot transfer covers peers

@@ -196,57 +196,160 @@ class CsrMirror:
         return p < self.n and int(self.vids[p]) == vid
 
 
-def build_delta_mirror(base: CsrMirror, edge_kvs, schema_man,
-                       space_id: int) -> Optional[CsrMirror]:
-    """Fold committed edge-insert KVs into a small overlay mirror that
-    shares ``base``'s dense-id space and vertex columns (SURVEY §7 hard
-    part (a): mutations without the O(m) rebuild).
+def _scatter_bool(src: np.ndarray, remap: np.ndarray,
+                  n: int) -> np.ndarray:
+    out = np.zeros(n, dtype=bool)
+    out[remap] = src
+    return out
 
-    Returns None — meaning "do the full rebuild" — whenever the delta
-    can't be expressed as a pure append over the base: an endpoint vid
-    the base doesn't know, an edge identity that already exists in the
-    base (a property update must supersede the base row), a TTL'd row,
-    or an unresolvable schema.  All host/device query machinery
-    (expression compiler, candidate assembly, materialization) treats
-    the overlay as just another CsrMirror.
+
+def _base_edge_index(base: CsrMirror, src_d: int, et: int, rank: int,
+                     dst_d: int) -> int:
+    """Base edge row for one identity, or -1.  Row slices are tiny
+    (one vertex's out-edges), so the linear probe is fine at delta
+    scale."""
+    lo, hi = int(base.row_ptr[src_d]), int(base.row_ptr[src_d + 1])
+    for e in range(lo, hi):
+        if int(base.edge_etype[e]) == et \
+                and int(base.edge_rank[e]) == rank \
+                and int(base.edge_dst[e]) == dst_d:
+            return e
+    return -1
+
+
+def build_delta_mirror(base: CsrMirror, events, schema_man,
+                       space_id: int) -> Optional[CsrMirror]:
+    """Fold committed edge mutation EVENTS into a small overlay mirror
+    over ``base`` (SURVEY §7 hard part (a): mutations without the O(m)
+    rebuild).  Events are the store's typed delta stream
+    (kvstore/store.py delta_since): ("put", key, value) inserts AND
+    in-place updates, ("del", identity32) whole-edge deletes.
+
+    The overlay carries, beyond its own appended rows:
+      * ``base_dead``   — sorted base edge rows superseded by an update
+                          or killed by a delete (candidate assembly
+                          excludes them);
+      * ``extra_vids``  — endpoint vids the base doesn't know (the
+                          overlay's dense space grows to the sorted
+                          union; ``remap_from_base`` translates base
+                          dense ids);
+      * ``has_deletes`` — a base edge died with no same-identity
+                          replacement, which changes reachability: the
+                          runtime must not run multi-hop frontier
+                          advances over the base ELL then (it forces
+                          the rebuild for those queries only).
+
+    Returns None — full rebuild — for TTL'd rows and unresolvable
+    schemas.  Same-identity overwrite ordering assumes the forward
+    wall clock that inverted-timestamp versioning itself relies on.
     """
     sm = schema_man
-    # latest write per edge identity wins (commit order)
-    newest: Dict[Tuple[int, int, int, int], bytes] = {}
-    for key, val in edge_kvs:
-        _part, src, et, rank, dst, _ver = KeyUtils.parse_edge(key)
-        newest[(src, et, rank, dst)] = val
+    # collapse in commit order: the last event per edge identity wins
+    final: Dict[Tuple[int, int, int, int], Optional[bytes]] = {}
+    for ev in events:
+        if ev[0] == "put":
+            _part, src, et, rank, dst, _ver = KeyUtils.parse_edge(ev[1])
+            final[(src, et, rank, dst)] = ev[2]
+        else:       # ("del", identity32): all versions of one edge
+            _part, src, et, rank, dst, _ = KeyUtils.parse_edge(
+                ev[1] + b"\x00" * 8)     # pad the absent version field
+            final[(src, et, rank, dst)] = None
 
-    idents = list(newest.keys())
-    src_vids = np.asarray([i[0] for i in idents], dtype=np.int64)
-    dst_vids = np.asarray([i[3] for i in idents], dtype=np.int64)
-    src_d = base.to_dense(src_vids)
-    dst_d = base.to_dense(dst_vids)
-    if len(idents) and (int(src_d.min()) < 0 or int(dst_d.min()) < 0):
-        return None                    # new vertex: dense space changes
+    puts = {k: v for k, v in final.items() if v is not None}
+    dels = [k for k, v in final.items() if v is None]
 
-    # identity collision with a base edge = in-place update, not append
-    for i, (src, et, rank, dst) in enumerate(idents):
-        s = int(src_d[i])
-        lo, hi = int(base.row_ptr[s]), int(base.row_ptr[s + 1])
-        for e in range(lo, hi):
-            if int(base.edge_etype[e]) == et \
-                    and int(base.edge_rank[e]) == rank \
-                    and int(base.edge_dst[e]) == int(dst_d[i]):
-                return None
+    # ---- extended dense vid space (new endpoint vids) ----------------
+    put_idents = list(puts.keys())
+    src_vids = np.asarray([i[0] for i in put_idents], dtype=np.int64)
+    dst_vids = np.asarray([i[3] for i in put_idents], dtype=np.int64)
+    known_src = base.to_dense(src_vids)
+    known_dst = base.to_dense(dst_vids)
+    extra = np.unique(np.concatenate([
+        src_vids[known_src < 0] if len(put_idents) else
+        np.zeros(0, np.int64),
+        dst_vids[known_dst < 0] if len(put_idents) else
+        np.zeros(0, np.int64)]))
 
     d = CsrMirror(space_id)
-    d.vids = base.vids                 # shared dense-id space
-    d.n = base.n
-    d.vertex_cols = base.vertex_cols   # vertex side unchanged by
-    d.has_tag = base.has_tag           # edge inserts
-    m = len(idents)
+    d.base_dead = np.zeros(0, dtype=np.int64)
+    d.extra_vids = extra
+    d.remap_from_base = None
+    d.has_deletes = False
+    if len(extra) == 0:
+        d.vids = base.vids             # shared dense-id space
+        d.n = base.n
+        d.vertex_cols = base.vertex_cols   # vertex side unchanged by
+        d.has_tag = base.has_tag           # edge mutations
+    else:
+        # re-seat the shared vertex side in the grown dense space.
+        # Vectorized scatters only (no per-row Python, no re-encode:
+        # dictionaries and device_ok carry over — added rows are
+        # invalid, never read), and cached on the base keyed by the
+        # extra set: absorptions repeat over the accumulated event
+        # list, and this runs under the runtime lock
+        ext_key = extra.tobytes()
+        cached = getattr(base, "_ext_vertex_cache", None)
+        if cached is not None and cached[0] == ext_key:
+            d.vids, d.n, d.remap_from_base, d.vertex_cols, d.has_tag = \
+                cached[1:]
+        else:
+            d.vids = np.unique(np.concatenate([base.vids, extra]))
+            d.n = len(d.vids)
+            remap = np.searchsorted(d.vids, base.vids).astype(np.int32)
+            d.remap_from_base = remap
+            d.vertex_cols = {}
+            for key, c in base.vertex_cols.items():
+                nc = Column(c.name, c.stype, d.n)
+                nc.valid[remap] = c.valid
+                nc.device_ok = c.device_ok
+                if c.raw is not None:
+                    raw = np.empty(d.n, dtype=object)
+                    raw[:] = ""
+                    raw[remap] = np.asarray(c.raw, dtype=object)
+                    nc.raw = raw
+                    nc.dictionary = c.dictionary
+                    codes = np.zeros(d.n, dtype=np.int32)
+                    codes[remap] = c.values
+                    nc.values = codes
+                else:
+                    nc.values[remap] = c.values
+                d.vertex_cols[key] = nc
+            d.has_tag = {t: _scatter_bool(flags_arr, remap, d.n)
+                         for t, flags_arr in base.has_tag.items()}
+            base._ext_vertex_cache = (ext_key, d.vids, d.n,
+                                      d.remap_from_base, d.vertex_cols,
+                                      d.has_tag)
+
+    # ---- base rows superseded / deleted ------------------------------
+    dead: List[int] = []
+    for src, et, rank, dst in put_idents:
+        sd = base.to_dense([src])[0]
+        dd = base.to_dense([dst])[0]
+        if sd < 0 or dd < 0:
+            continue                    # brand-new edge: nothing to kill
+        e = _base_edge_index(base, int(sd), et, rank, int(dd))
+        if e >= 0:
+            dead.append(e)              # in-place update: override
+    for src, et, rank, dst in dels:
+        sd = base.to_dense([src])[0]
+        dd = base.to_dense([dst])[0]
+        if sd < 0 or dd < 0:
+            continue                    # deleting an unknown edge: no-op
+        e = _base_edge_index(base, int(sd), et, rank, int(dd))
+        if e >= 0:
+            dead.append(e)
+            d.has_deletes = True        # reachability changed
+    d.base_dead = np.unique(np.asarray(dead, dtype=np.int64))
+
+    m = len(put_idents)
     d.m = m
     if m == 0:
         d.row_ptr = np.zeros(d.n + 1, dtype=np.int32)
         return d
-    etype_a = np.asarray([i[1] for i in idents], dtype=np.int32)
-    rank_a = np.asarray([i[2] for i in idents], dtype=np.int64)
+    src_d = d.to_dense(src_vids)
+    dst_d = d.to_dense(dst_vids)
+    etype_a = np.asarray([i[1] for i in put_idents], dtype=np.int32)
+    rank_a = np.asarray([i[2] for i in put_idents], dtype=np.int64)
     order = np.lexsort((dst_d, rank_a, etype_a, src_d))
     d.edge_src = src_d[order].astype(np.int32)
     d.edge_dst = dst_d[order].astype(np.int32)
@@ -260,7 +363,7 @@ def build_delta_mirror(base: CsrMirror, edge_kvs, schema_man,
             return None
         for col in schema.columns:
             cols[(et, col.name)] = Column(col.name, col.type, m)
-    vals = [newest[idents[j]] for j in order]
+    vals = [puts[put_idents[j]] for j in order]
     for i, blob in enumerate(vals):
         if not blob:
             continue

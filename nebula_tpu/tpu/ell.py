@@ -435,7 +435,8 @@ def sparse_caps(c0: int, d_max: int, steps: int, cap: int,
 
 def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                                   etypes: Tuple[int, ...],
-                                  caps: Tuple[int, ...]):
+                                  caps: Tuple[int, ...],
+                                  qmax: int = 1024):
     """Sparse batched GO — B queries' frontiers ride ONE flat sorted
     (query, vertex) pair list instead of a dense [n_rows, B] bitmap.
 
@@ -475,9 +476,13 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
     BIG_Q = jnp.int32(2**30)
     # when (query, vertex) packs into one int32, the per-hop dedup is a
     # single-operand sort — measurably cheaper than the 2-key
-    # lexicographic sort (the sort IS the sparse kernel's cost center)
+    # lexicographic sort (the sort IS the sparse kernel's cost center).
+    # The bound is qmax (the LARGEST query index a batch can carry, the
+    # dispatcher's go_batch_max), NOT caps[0]: fewer surviving starts
+    # than queries is common (unknown vids drop), and a qid above the
+    # gate would wrap the packed key and mis-attribute rows
     R1 = n_rows + 1
-    pack32 = caps[0] * R1 <= 2**31 - 1
+    pack32 = qmax * R1 <= 2**31 - 1
     I32_MAX = jnp.int32(2**31 - 1)
 
     def hop(ids, qid, hub, nbrs, ets, c_out, check_hub):

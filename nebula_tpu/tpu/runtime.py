@@ -309,9 +309,10 @@ class TpuQueryRuntime:
         """Install a built mirror (caller holds the lock).  ``vers``
         are the per-store versions captured BEFORE the build scan —
         they become the delta cursors, so a write racing the scan is
-        either re-delivered by delta_since (and the identity collision
-        in build_delta_mirror forces the rebuild) or surfaces as a
-        version mismatch; it can never be silently skipped."""
+        either re-delivered by delta_since (where a same-identity put
+        supersedes the already-scanned base row via base_dead + an
+        overlay override — build_delta_mirror) or surfaces as a version
+        mismatch; it can never be silently skipped."""
         if stores is None:
             stores = self._stores_for(space_id)
         if vers is None:
@@ -352,18 +353,18 @@ class TpuQueryRuntime:
             return None              # part placement moved
         if len(stores) != len(m._delta_cursors):
             return None              # peer set changed
-        new_kvs = []
+        new_events = []
         cursors = dict(m._delta_cursors)
         for i, s in enumerate(stores):
             now_v = s.mutation_version(space_id)
             if now_v == cursors[i]:
                 continue
-            kvs = s.delta_since(space_id, cursors[i])
-            if kvs is None:
+            evs = s.delta_since(space_id, cursors[i])
+            if evs is None:
                 return None          # opaque ops / trimmed log
-            new_kvs.extend(kvs)
+            new_events.extend(evs)
             cursors[i] = now_v
-        total = m._delta_kvs + new_kvs
+        total = m._delta_kvs + new_events
         if len(total) > int(flags.get("mirror_delta_max") or 4096):
             return None              # compaction point: full rebuild
         from .csr import build_delta_mirror
@@ -372,22 +373,34 @@ class TpuQueryRuntime:
         if total and d is None:
             return None
         m._delta_kvs = total
-        if d is not None and d.m > 0:
+        if d is not None and (d.m > 0 or len(d.base_dead)):
             m._delta = d
             m._delta_gen += 1
+        else:
+            m._delta = None
         m._delta_cursors = cursors
         m._fresh_version = ver
         self.stats["mirror_deltas"] = self.stats.get("mirror_deltas",
                                                      0) + 1
         return m
 
+    @staticmethod
+    def _live_delta(m: CsrMirror):
+        """The mirror's overlay when it has any effect (appended rows
+        or dead base rows), else None."""
+        d = getattr(m, "_delta", None)
+        if d is None:
+            return None
+        if d.m == 0 and not len(getattr(d, "base_dead", ())):
+            return None
+        return d
+
     def mirror_full(self, space_id: int) -> Optional[CsrMirror]:
         """A mirror with NO pending overlay — the BFS/FIND PATH device
         half and the sharded path read raw base arrays, so they force
         the rebuild when a delta is outstanding."""
         m = self.mirror(space_id)
-        d = getattr(m, "_delta", None)
-        if d is None or d.m == 0:
+        if self._live_delta(m) is None:
             return m
         with self._build_lock(space_id):
             stores = self._stores_for(space_id)
@@ -395,8 +408,7 @@ class TpuQueryRuntime:
             ver = self._space_version(space_id, stores, vers)
             with self._lock:
                 cur = self.mirrors.get(space_id)
-                d = getattr(cur, "_delta", None)
-                if cur is not None and (d is None or d.m == 0) \
+                if cur is not None and self._live_delta(cur) is None \
                         and getattr(cur, "_fresh_version",
                                     cur.build_version) == ver:
                     return cur       # someone rebuilt while we waited
@@ -616,6 +628,16 @@ class TpuQueryRuntime:
         on the batch leader and each GIL re-acquisition cost up to a
         thread switch interval under a hundred request threads."""
         m = self.mirror(space_id)
+        delta = self._live_delta(m)
+        if delta is not None and steps > 1 \
+                and (delta.has_deletes or len(delta.extra_vids)):
+            # reachability changed (a base edge died) or the dense-id
+            # space grew (new vertices): the base ELL can't answer a
+            # multi-hop frontier advance exactly — pay the rebuild for
+            # THIS query shape; the absorbed delta kept every 1-hop /
+            # update-only query serving meanwhile
+            m = self.mirror_full(space_id)
+            delta = None
         nq = len(starts_per_query)
         if steps < 1:
             empty = [np.zeros(0, np.int64)] * nq
@@ -625,7 +647,20 @@ class TpuQueryRuntime:
         flat: List[int] = []
         for s in starts_per_query:
             flat.extend(int(v) for v in s)
-        d_all = m.to_dense(flat)
+        flat_arr = np.asarray(flat, dtype=np.int64)
+        d_all = m.to_dense(flat_arr)
+        if delta is not None and len(delta.extra_vids) \
+                and len(d_all) and (d_all < 0).any():
+            # a start vid the base doesn't know but the overlay does
+            # (freshly inserted vertex used as a query start): serve it
+            # exactly via the rebuild
+            miss = flat_arr[d_all < 0]
+            pos = np.minimum(np.searchsorted(delta.extra_vids, miss),
+                             len(delta.extra_vids) - 1)
+            if (delta.extra_vids[pos] == miss).any():
+                m = self.mirror_full(space_id)
+                delta = None
+                d_all = m.to_dense(flat_arr)
         q_all = np.repeat(np.arange(nq, dtype=np.int64),
                           np.asarray(lens, np.int64))
         keep = d_all >= 0
@@ -645,9 +680,6 @@ class TpuQueryRuntime:
             return lambda: (starts_v, m)
 
         ix = self.ell(m)
-        delta = getattr(m, "_delta", None)
-        if delta is not None and delta.m == 0:
-            delta = None
         mesh_mt = self._mesh_tables(m, ix)
 
         c0 = self._sparse_c0(len(d_all))
@@ -688,10 +720,11 @@ class TpuQueryRuntime:
         cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
         caps = sparse_caps(c0, d_max, steps, cap,
                            growth=int(flags.get("tpu_sparse_growth") or 8))
+        qmax = max(int(flags.get("go_batch_max") or 1024), nq)
         kern = self._kernel(
-            ("sparse_go", ix.shape_sig(), et_tuple, steps, caps),
+            ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax),
             lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
-                                                  caps))
+                                                  caps, qmax=qmax))
         S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
         qid = np.zeros(c0, np.int32)
@@ -943,6 +976,10 @@ class TpuQueryRuntime:
         dictionaries / value ranges); anything uncompilable falls back
         to the CPU executor via TpuDecline."""
         from ..storage.device import TpuDecline
+        if getattr(d, "remap_from_base", None) is not None:
+            # overlay grew the dense space: translate the base-dense
+            # frontier into the overlay's ids
+            vs = d.remap_from_base[np.asarray(vs, dtype=np.int64)]
         cand = self._frontier_edges(d, vs, et_tuple)
         if len(cand) == 0:
             return []
@@ -978,8 +1015,7 @@ class TpuQueryRuntime:
         columns = [c.alias or _default_col_name(c.expr) for c in yield_cols]
         if steps < 1 or not start_vids or m.m == 0:
             return columns, []
-        d0 = getattr(m, "_delta", None)
-        if d0 is not None and d0.m > 0:
+        if self._live_delta(m) is not None:
             m = self.mirror_full(space_id)      # fused kernel: no overlay
             plan = self._replan_or_raise(space_id, plan, where_expr, m,
                                          ExcType)
@@ -1261,8 +1297,8 @@ class TpuQueryRuntime:
             frontier[vs] = True
             idx = np.nonzero(frontier[m.edge_src]
                              & self._etype_edge_mask(m, et_tuple))[0]
-            return (idx, np.zeros(len(idx), np.int64),
-                    np.asarray([0, len(idx)], np.int64))
+            qseg = np.zeros(len(idx), np.int64)
+            return self._drop_dead(m, idx, qseg, nq)
         nz = counts > 0
         s2, c2, q2 = starts[nz], counts[nz], vq[nz]
         # multi-range arange: global position -> within-range offset +
@@ -1272,8 +1308,22 @@ class TpuQueryRuntime:
         qseg = np.repeat(q2, c2)
         keep = self._etype_edge_mask(m, et_tuple)[idx]
         idx, qseg = idx[keep], qseg[keep]
-        qbounds = np.searchsorted(qseg, np.arange(nq + 1))
-        return idx, qseg, qbounds
+        return self._drop_dead(m, idx, qseg, nq)
+
+    @staticmethod
+    def _drop_dead(m: CsrMirror, idx: np.ndarray, qseg: np.ndarray,
+                   nq: int):
+        """Exclude base edges superseded/deleted by the insert overlay
+        (csr.build_delta_mirror base_dead) from a candidate set; returns
+        (idx, qseg, qbounds)."""
+        d = getattr(m, "_delta", None)
+        dead = getattr(d, "base_dead", None) if d is not None else None
+        if dead is not None and len(dead) and len(idx):
+            pos = np.minimum(np.searchsorted(dead, idx), len(dead) - 1)
+            hit = dead[pos] == idx
+            if hit.any():
+                idx, qseg = idx[~hit], qseg[~hit]
+        return idx, qseg, np.searchsorted(qseg, np.arange(nq + 1))
 
     # -------------------------------------------------- validity parity
     @staticmethod

@@ -195,6 +195,101 @@ def test_java_client_compiles(tmp_path):
                    check=True, capture_output=True)
 
 
+# ---- pre-generated client frames (replay harness) --------------------
+# Byte-exact frames a Go/Java client emits for one full session, fixed
+# session id 0x123456789AB (48-bit → the 0xD3 int64 form both encoders
+# use for values ≥ 128; clients/go/graphclient.go packInt,
+# clients/java/GraphClient.java pack).  Note the Go client may emit map
+# keys in any order (Go map iteration); these frames are one valid
+# ordering — the server must accept any, which the dynamic e2e below
+# also exercises.
+REPLAY_SID = 0x123456789AB
+REPLAY_FRAMES = [
+    ("authenticate", "92ac61757468656e74696361746582a8757365726e616d65a4"
+     "75736572a870617373776f7264a870617373776f7264"),
+    ("execute", "92a76578656375746582aa73657373696f6e5f6964d30000012345"
+     "6789aba473746d74d93243524541544520535041434520727028706172746974"
+     "696f6e5f6e756d3d322c207265706c6963615f666163746f723d3129"),
+    ("execute", "92a76578656375746582aa73657373696f6e5f6964d30000012345"
+     "6789aba473746d74a6555345207270"),
+    ("execute", "92a76578656375746582aa73657373696f6e5f6964d30000012345"
+     "6789aba473746d74b443524541544520454447452065287720696e7429"),
+    ("execute", "92a76578656375746582aa73657373696f6e5f6964d30000012345"
+     "6789aba473746d74d92a494e53455254204544474520652877292056414c5545"
+     "5320312d3e323a2837292c20322d3e333a283929"),
+    ("execute", "92a76578656375746582aa73657373696f6e5f6964d30000012345"
+     "6789aba473746d74d92a474f20322053544550532046524f4d2031204f564552"
+     "2065205949454c4420652e5f6473742c20652e77"),
+    ("signout", "92a77369676e6f757481aa73657373696f6e5f6964d30000012345"
+     "6789ab"),
+]
+
+
+def test_golden_frames_match_transcription():
+    """The stored replay bytes ARE what the transcribed encoders emit —
+    drift in either direction (fixture vs transcription) fails here."""
+    regenerated = [("authenticate", pack_scheme(
+        ["authenticate", {"username": "user", "password": "password"}]))]
+    for s in ("CREATE SPACE rp(partition_num=2, replica_factor=1)",
+              "USE rp", "CREATE EDGE e(w int)",
+              "INSERT EDGE e(w) VALUES 1->2:(7), 2->3:(9)",
+              "GO 2 STEPS FROM 1 OVER e YIELD e._dst, e.w"):
+        regenerated.append(("execute", pack_scheme(
+            ["execute", {"session_id": REPLAY_SID, "stmt": s}])))
+    regenerated.append(("signout", pack_scheme(
+        ["signout", {"session_id": REPLAY_SID}])))
+    got = [(m, b.hex()) for m, b in regenerated]
+    assert got == REPLAY_FRAMES
+
+
+def test_replay_pregenerated_frames_against_live_server():
+    """Protocol-replay harness: the PRE-GENERATED byte frames above are
+    sent verbatim to a live TCP graphd (session id pinned so the static
+    execute frames authenticate) and every response must decode and
+    succeed — the Go/Java clients' exact wire behavior, executed on a
+    box with no Go/Java toolchain."""
+    import contextlib
+    import socket
+    from nebula_tpu.cluster import LocalCluster
+    from nebula_tpu.graph.service import ClientSession
+
+    c = LocalCluster(num_storage=1, use_tcp=True)
+    try:
+        # pin the session the static frames carry
+        sm = c.graph_service.sessions
+        with sm._lock:
+            sm._sessions[REPLAY_SID] = ClientSession(REPLAY_SID, "user")
+        with contextlib.closing(socket.create_connection(
+                ("127.0.0.1", c.graph_addr.port), timeout=30)) as sock:
+            results = []
+            for method, hexframe in REPLAY_FRAMES:
+                body = bytes.fromhex(hexframe)
+                sock.sendall(struct.pack(">I", len(body)) + body)
+                if method == "signout":
+                    break                        # oneway
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = sock.recv(4 - len(hdr))
+                    assert chunk, "server closed"
+                    hdr += chunk
+                (n,) = struct.unpack(">I", hdr)
+                buf = b""
+                while len(buf) < n:
+                    chunk = sock.recv(n - len(buf))
+                    assert chunk, "server closed mid-frame"
+                    buf += chunk
+                resp = decode_scheme(buf)
+                results.append((method, resp))
+                if method == "execute":
+                    assert resp["error_code"] == 0, resp
+                c.refresh_all()    # propagate DDL between statements
+            go_resp = results[-1][1]
+            assert go_resp["column_names"] == ["e._dst", "e.w"]
+            assert [list(r) for r in go_resp["rows"]] == [[3, 9]]
+    finally:
+        c.stop()
+
+
 class TestTranscribedClientEndToEnd:
     """The strongest check possible without a Go/Java toolchain in the
     image: run a REAL session against a REAL TCP cluster using the

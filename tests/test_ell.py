@@ -216,6 +216,7 @@ def test_async_mirror_refresh_serves_stale_then_updates():
         assert g.execute(stmt).ok()
     c.refresh_all()
     assert g.execute("USE s2").ok()
+    assert g.execute("CREATE TAG p(x int)").ok()
     assert g.execute("CREATE EDGE e(w int)").ok()
     c.refresh_all()
     assert g.execute("INSERT EDGE e(w) VALUES 1->2:(1)").ok()
@@ -227,7 +228,10 @@ def test_async_mirror_refresh_serves_stale_then_updates():
 
     flags.set("mirror_refresh_mode", "async")
     try:
-        assert g.execute("INSERT EDGE e(w) VALUES 2->3:(1)").ok()
+        # a VERTEX write is opaque to the insert overlay (edge deltas
+        # absorb incrementally since round 4), so it exercises the
+        # async rebuild path
+        assert g.execute('INSERT VERTEX p(x) VALUES 9:(5)').ok()
         stale = rt.mirror(sid)          # triggers bg rebuild, serves stale
         assert stale is m1
         deadline = time.time() + 30
@@ -237,7 +241,7 @@ def test_async_mirror_refresh_serves_stale_then_updates():
                 break
             time.sleep(0.05)
         assert m2 is not m1, "background rebuild never landed"
-        assert m2.m > m1.m
+        assert m2.n > m1.n              # the new vertex landed
     finally:
         flags.set("mirror_refresh_mode", "sync")
     c.stop()

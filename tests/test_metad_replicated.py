@@ -1,0 +1,154 @@
+"""Replicated metad: a 3-instance catalog raft group in one process
+(the reference replicates metad over the same raftex as storage,
+MetaDaemon.cpp:58-78).  Proves: DDL through the leader replicates;
+followers refuse with the leader hint; killing the leader re-elects and
+DDL continues; clients (and their caches) follow the new leader.
+"""
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nebula_tpu.daemons import metad
+from nebula_tpu.interface.common import HostAddr, Schema, ColumnDef, \
+    SupportedType, schema_to_wire
+from nebula_tpu.interface.rpc import ClientManager, RpcError
+from nebula_tpu.meta.client import MetaClient
+from nebula_tpu.meta.schema_manager import ServerBasedSchemaManager
+from nebula_tpu.meta.service import META_PART, META_SPACE
+
+
+def _margs(port, metas, tmp_path):
+    return SimpleNamespace(
+        local_ip="127.0.0.1", port=port,
+        meta_server_addrs=",".join(metas),
+        data_path=None, wal_path=str(tmp_path / f"wal{port}"))
+
+
+class Quorum:
+    def __init__(self, tmp_path):
+        self.cm = ClientManager()
+        self.addrs = [f"127.0.0.1:{45600 + i}" for i in range(3)]
+        self.nodes = []
+        for i, a in enumerate(self.addrs):
+            svc, _cm, handler, raft = metad.build(
+                _margs(45600 + i, self.addrs, tmp_path), cm=self.cm)
+            self.cm.register_loopback(HostAddr.parse(a), handler)
+            self.nodes.append(SimpleNamespace(addr=a, service=svc,
+                                              raft=raft))
+
+    def leader_idx(self, deadline_s=15):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            for i, n in enumerate(self.nodes):
+                p = n.service.kv.part(META_SPACE, META_PART)
+                if p is not None and p.raft is not None and p.is_leader():
+                    return i
+            time.sleep(0.1)
+        raise AssertionError("no catalog leader elected")
+
+    def kill(self, idx):
+        n = self.nodes[idx]
+        self.cm.unregister_loopback(HostAddr.parse(n.addr))
+        n.raft.stop()
+
+    def stop(self):
+        for n in self.nodes:
+            if n.raft is not None:
+                try:
+                    n.raft.stop()
+                except Exception:   # noqa: BLE001 — already stopped
+                    pass
+
+
+@pytest.fixture()
+def quorum(tmp_path):
+    q = Quorum(tmp_path)
+    yield q
+    q.stop()
+
+
+def test_metad_quorum_failover(quorum):
+    q = quorum
+    lead = q.leader_idx()
+    assert all(n.raft is not None for n in q.nodes), \
+        "3-peer metads must boot the catalog raft group"
+
+    client = MetaClient([HostAddr.parse(a) for a in q.addrs],
+                        client_manager=q.cm)
+    assert client.wait_for_metad_ready()
+
+    # register fake storage hosts so createSpace can place parts
+    for h in ("127.0.0.1:47771", "127.0.0.1:47772"):
+        r = client._call_status("heartBeat", {"host": h, "cluster_id": 0})
+        assert r.ok(), r.status.to_string()
+
+    r = client.create_space("fo_space", partition_num=2, replica_factor=1)
+    assert r.ok(), r.status.to_string()
+    sid = r.value()
+
+    # DDL replicated to follower state machines (applied local kv)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if all(n.service._space_id("fo_space") == sid for n in q.nodes):
+            break
+        time.sleep(0.1)
+    assert all(n.service._space_id("fo_space") == sid for n in q.nodes), \
+        "create-space not applied on every catalog replica"
+
+    # a follower refuses with the leader's address as the hint
+    follower = next(i for i in range(3) if i != lead)
+    with pytest.raises(RpcError) as ei:
+        q.cm.call(HostAddr.parse(q.addrs[follower]), "listSpaces", {})
+    assert q.addrs[lead] in (ei.value.status.msg or ""), ei.value.status
+
+    # kill the leader: the survivors elect, DDL continues
+    q.kill(lead)
+    deadline = time.time() + 25
+    new_lead = None
+    while time.time() < deadline:
+        for i, n in enumerate(q.nodes):
+            if i == lead:
+                continue
+            p = n.service.kv.part(META_SPACE, META_PART)
+            if p.is_leader():
+                new_lead = i
+                break
+        if new_lead is not None:
+            break
+        time.sleep(0.2)
+    assert new_lead is not None, "no new catalog leader after the kill"
+
+    wire = schema_to_wire(Schema(
+        columns=[ColumnDef("name", SupportedType.STRING)]))
+    r = client.create_tag_schema(sid, "t1", wire)
+    assert r.ok(), r.status.to_string()
+
+    # client caches follow the new leader
+    client.load_data()
+    sp = client.get_space_id_by_name("fo_space")
+    assert sp.ok() and sp.value() == sid
+    sm = ServerBasedSchemaManager(client)
+    tr = sm.to_tag_id(sid, "t1")
+    assert tr.ok(), "post-failover DDL must be visible through caches"
+
+    # both survivors applied the post-failover DDL
+    tag_id = tr.value()
+    deadline = time.time() + 5
+    survivors = [n for i, n in enumerate(q.nodes) if i != lead]
+
+    def applied(n):
+        resp = None
+        p = n.service.kv.part(META_SPACE, META_PART)
+        # read the local applied state regardless of leadership
+        from nebula_tpu.meta import keys as mk
+        raw = list(n.service.kv.prefix(META_SPACE, META_PART,
+                                       mk.tag_prefix(sid)))
+        return len(raw) > 0
+
+    while time.time() < deadline:
+        if all(applied(n) for n in survivors):
+            break
+        time.sleep(0.1)
+    assert all(applied(n) for n in survivors), \
+        "post-failover DDL not replicated to the surviving follower"

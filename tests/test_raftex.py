@@ -54,11 +54,11 @@ class GatedCM:
         self.inner = inner
         self.gate = gate
 
-    def call(self, addr, method, payload):
+    def call(self, addr, method, payload, timeout=None):
         if not self.gate.open:
             raise RpcError(Status.Error("partitioned",
                                         ErrorCode.E_RPC_FAILURE))
-        return self.inner.call(addr, method, payload)
+        return self.inner.call(addr, method, payload, timeout=timeout)
 
 
 class Node:
@@ -340,6 +340,64 @@ class TestCommandLogs:
         assert newbie.part.raft.role == Role.FOLLOWER
         assert not lead.part.raft.peers[newbie.addr].is_learner
         newbie.raft_service.stop()
+
+
+class TestStarvationGuard:
+    """A follower whose own tick thread stalled (GIL convoy, CPU
+    oversubscription) must NOT charge the stalled time against the
+    election timeout — it could not have seen heartbeats while
+    descheduled, and a starvation-triggered election is the classic
+    full-suite failover flake (a liveness delay is always safe; a
+    spurious term bump is not free)."""
+
+    def _part(self, tmp_path):
+        from concurrent.futures import ThreadPoolExecutor
+        from nebula_tpu.raftex.raft_part import RaftPart
+        cm = ClientManager()       # peers unroutable: every RPC fails
+        ex = ThreadPoolExecutor(max_workers=2)
+        p = RaftPart(1, 1, "127.0.0.1:47101",
+                     ["127.0.0.1:47101", "127.0.0.1:47102",
+                      "127.0.0.1:47103"], cm, ex,
+                     wal_dir=str(tmp_path / "wal"))
+        return p, ex
+
+    def test_stalled_ticks_defer_election(self, tmp_path):
+        p, ex = self._part(tmp_path)
+        try:
+            tick = 0.05
+            now = time.monotonic()
+            p._last_heard = now
+            p.tick(now, expected_interval=tick)
+            # poller starved: next tick arrives a whole election
+            # timeout late — the stall is excluded, so no election
+            stall = p._election_timeout + 1.0
+            p.tick(now + stall, expected_interval=tick)
+            assert not p._electing
+            assert p.term == 0
+        finally:
+            p.stop()
+            ex.shutdown(wait=False)
+
+    def test_steady_ticks_still_elect(self, tmp_path):
+        p, ex = self._part(tmp_path)
+        try:
+            tick = 0.05
+            now = time.monotonic()
+            p._last_heard = now
+            t = now
+            deadline = now + p._election_timeout + 10 * tick
+            fired = False
+            while t < deadline:
+                t += tick            # healthy cadence, silent leader
+                p.tick(t, expected_interval=tick)
+                if p._electing or p.term > 0:
+                    fired = True
+                    break
+            assert fired, "healthy follower with a silent leader " \
+                          "must start an election"
+        finally:
+            p.stop()
+            ex.shutdown(wait=False)
 
 
 class TestRecovery:

@@ -459,7 +459,10 @@ class TestIncrementalDelta:
         finally:
             c.stop()
 
-    def test_delete_forces_rebuild_and_stays_correct(self):
+    def test_delete_absorbed_for_single_hop(self):
+        """An edge delete rides the overlay as a base-row tombstone:
+        1-hop queries keep serving from the mirror with NO rebuild and
+        must not see the dead edge."""
         c, cl, ok = self._boot()
         try:
             rt = c.tpu_runtime
@@ -471,7 +474,104 @@ class TestIncrementalDelta:
             ok("DELETE EDGE follow 100 -> 110@5")
             r = ok("GO FROM 100 OVER follow YIELD follow._dst")
             assert (110,) not in set(map(tuple, r.rows))
-            assert rt.stats["mirror_builds"] > builds0   # opaque op
+            assert rt.stats["mirror_builds"] == builds0, "tombstone " \
+                "should absorb a 1-hop-only delete without a rebuild"
+            # the pre-existing ring edge from 100 still serves
+            assert (101,) in set(map(tuple, r.rows))
+        finally:
+            c.stop()
+
+    def test_delete_with_multi_hop_rebuilds_and_stays_correct(self):
+        """Reachability-changing deletes must force the rebuild for
+        multi-hop queries (the base ELL can't subtract edges)."""
+        c, cl, ok = self._boot()
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow")
+            ok("DELETE EDGE follow 101 -> 102@0")
+            r = ok("GO 2 STEPS FROM 100 OVER follow YIELD follow._dst")
+            got = set(map(tuple, r.rows))
+            assert (102,) not in got, "deleted mid-path edge traversed"
+            from nebula_tpu.common.flags import flags
+            flags.set("storage_backend", "cpu")
+            r2 = ok("GO 2 STEPS FROM 100 OVER follow YIELD follow._dst")
+            flags.set("storage_backend", "tpu")
+            assert sorted(map(tuple, r.rows)) == sorted(map(tuple,
+                                                            r2.rows))
+        finally:
+            c.stop()
+
+    def test_update_absorbed_without_rebuild(self):
+        """An in-place UPDATE (same edge identity, new props) rides the
+        overlay as override rows — multi-hop safe (same dst), fresh
+        props visible, no rebuild."""
+        c, cl, ok = self._boot()
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow")
+            builds0 = rt.stats["mirror_builds"]
+            ok("INSERT EDGE follow(degree) VALUES 100 -> 101:(999)")
+            r = ok("GO FROM 100 OVER follow "
+                   "YIELD follow._dst, follow.degree")
+            got = set(map(tuple, r.rows))
+            assert (101, 999) in got, got
+            assert (101, 50) not in got, "stale pre-update row served"
+            # multi-hop still serves from the mirror (dst unchanged)
+            r = ok("GO 2 STEPS FROM 100 OVER follow YIELD follow._dst")
+            assert (102,) in set(map(tuple, r.rows))
+            assert rt.stats["mirror_builds"] == builds0, \
+                "updates must absorb without a rebuild"
+            # parity with the CPU path
+            from nebula_tpu.common.flags import flags
+            flags.set("storage_backend", "cpu")
+            r2 = ok("GO FROM 100 OVER follow "
+                    "YIELD follow._dst, follow.degree")
+            flags.set("storage_backend", "tpu")
+            r3 = ok("GO FROM 100 OVER follow "
+                    "YIELD follow._dst, follow.degree")
+            assert sorted(map(tuple, r3.rows)) == sorted(map(tuple,
+                                                             r2.rows))
+        finally:
+            c.stop()
+
+    def test_new_vertex_insert_absorbed_for_single_hop(self):
+        """Edges to brand-new vertices grow the overlay's dense space:
+        1-hop queries serve them from the mirror without a rebuild;
+        multi-hop and new-vertex starts pay the rebuild (exactness)."""
+        c, cl, ok = self._boot()
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow")
+            builds0 = rt.stats["mirror_builds"]
+            ok('INSERT VERTEX player(name, age) VALUES 500:("new", 1)')
+            # vertex-only write is opaque (rebuild) — anchor the count
+            ok("GO FROM 100 OVER follow")
+            builds1 = rt.stats["mirror_builds"]
+            ok("INSERT EDGE follow(degree) VALUES 100 -> 500:(42)")
+            r = ok("GO FROM 100 OVER follow YIELD follow._dst, "
+                   "follow.degree")
+            assert (500, 42) in set(map(tuple, r.rows))
+            assert rt.stats["mirror_builds"] == builds1, \
+                "new-dst edge should absorb for 1-hop without a rebuild"
+            # an edge to a vid with NO vertex record at all grows the
+            # overlay's dense space (extra_vids) — still no rebuild
+            ok("INSERT EDGE follow(degree) VALUES 100 -> 600:(44)")
+            r = ok("GO FROM 100 OVER follow YIELD follow._dst, "
+                   "follow.degree")
+            assert (600, 44) in set(map(tuple, r.rows))
+            assert rt.stats["mirror_builds"] == builds1, \
+                "extra-vid edge should absorb for 1-hop without a rebuild"
+            # starting AT the fresh vertex must be exact too (rebuild)
+            ok("INSERT EDGE follow(degree) VALUES 600 -> 103:(43)")
+            r = ok("GO FROM 600 OVER follow YIELD follow._dst")
+            assert set(map(tuple, r.rows)) == {(103,)}
+            from nebula_tpu.common.flags import flags
+            flags.set("storage_backend", "cpu")
+            r2 = ok("GO 2 STEPS FROM 100 OVER follow YIELD follow._dst")
+            flags.set("storage_backend", "tpu")
+            r3 = ok("GO 2 STEPS FROM 100 OVER follow YIELD follow._dst")
+            assert sorted(map(tuple, r3.rows)) == sorted(map(tuple,
+                                                             r2.rows))
         finally:
             c.stop()
 
