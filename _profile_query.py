@@ -1,0 +1,64 @@
+"""Scratch: per-query cProfile of the served GO path (tpu + cpu), uncontended."""
+import cProfile
+import pstats
+import sys
+import time
+
+import numpy as np
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.flags import flags
+from nebula_tpu.tools.perf_fixture import ensure_perf_space
+from nebula_tpu.codec.rows import encode_row
+from nebula_tpu.common.clock import inverted_version
+from nebula_tpu.common.keys import KeyUtils, id_hash
+
+n, m, steps = 1 << 17, 1 << 20, 4
+rng = np.random.default_rng(42)
+edge_src = rng.integers(0, n, m, dtype=np.int32)
+edge_dst = rng.integers(0, n, m, dtype=np.int32)
+
+c = LocalCluster(num_storage=1, tpu_backend=True)
+space_id, _tag, etype = ensure_perf_space(c.graph_meta_client)
+c.refresh_all()
+kv = c.storage_nodes[0].kv
+parts = kv.part_ids(space_id)
+nparts = len(parts)
+schema = c.schema_man.get_edge_schema(space_id, etype)
+ver = inverted_version()
+by_part = {p: [] for p in parts}
+for i in range(m):
+    s, d = int(edge_src[i]) + 1, int(edge_dst[i]) + 1
+    val = encode_row(schema, {"w": i % 97})
+    by_part[id_hash(s, nparts)].append(
+        (KeyUtils.edge_key(id_hash(s, nparts), s, etype, 0, d, ver), val))
+    by_part[id_hash(d, nparts)].append(
+        (KeyUtils.edge_key(id_hash(d, nparts), d, -etype, 0, s, ver), val))
+for p, kvs in by_part.items():
+    for lo in range(0, len(kvs), 65536):
+        kv.multi_put(space_id, p, kvs[lo:lo + 65536])
+
+vids = rng.integers(1, n + 1, 64)
+queries = [f"GO {steps} STEPS FROM {v} OVER rel" for v in vids]
+
+g = c.client()
+g.execute("USE perf")
+
+for backend, nq in (("tpu", 40), ("cpu", 12)):
+    flags.set("storage_backend", backend)
+    r = g.execute(queries[0])      # warm
+    assert r.ok(), r.error_msg
+    t0 = time.perf_counter()
+    pr = cProfile.Profile()
+    pr.enable()
+    for q in queries[1:1 + nq]:
+        r = g.execute(q)
+        assert r.ok(), r.error_msg
+    pr.disable()
+    dt = time.perf_counter() - t0
+    print(f"\n========== {backend}: {1e3 * dt / nq:.1f} ms/query ==========",
+          flush=True)
+    st = pstats.Stats(pr, stream=sys.stdout)
+    st.sort_stats("cumulative").print_stats(28)
+
+c.stop()

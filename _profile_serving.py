@@ -1,0 +1,76 @@
+"""Scratch: break down the sparse-GO launch/fetch costs on the real chip."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from nebula_tpu.tpu import ell as E
+
+n, m = 1 << 19, 1 << 22
+rng = np.random.default_rng(42)
+edge_src = rng.integers(0, n, m, dtype=np.int32)
+edge_dst = rng.integers(0, n, m, dtype=np.int32)
+edge_etype = np.ones(m, dtype=np.int32)
+
+print("building ELL...", flush=True)
+ix = E.EllIndex.build(edge_src, edge_dst, edge_etype, n)
+steps = 4
+c0 = 256
+cap = 1 << 17
+caps = E.sparse_caps(c0, max(ix.bucket_D), steps, cap, growth=8)
+print("caps:", caps, flush=True)
+kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
+
+hub_np = np.zeros(ix.n + 1, dtype=bool)  # fake hub table shape; use real
+# real hub table
+hub_np = ix.hub_table() if hasattr(ix, "hub_table") else hub_np
+hub = jnp.asarray(hub_np)
+args = ix.kernel_args()
+
+S = 119
+ids_np = np.full(c0, ix.n_rows, np.int32)
+qid_np = np.zeros(c0, np.int32)
+starts = rng.integers(0, n, S, dtype=np.int64)
+ids_np[:S] = ix.perm[starts]
+qid_np[:S] = np.arange(S, dtype=np.int32)
+
+# warmup / compile
+out = kern(jnp.asarray(ids_np), jnp.asarray(qid_np), hub, *args[1:])
+_ = np.asarray(out)
+print("compiled; timing...", flush=True)
+
+for rep in range(5):
+    t0 = time.perf_counter()
+    ids_d = jnp.asarray(ids_np)
+    qid_d = jnp.asarray(qid_np)
+    t1 = time.perf_counter()
+    out = kern(ids_d, qid_d, hub, *args[1:])
+    t2 = time.perf_counter()
+    res = np.asarray(out)
+    t3 = time.perf_counter()
+    print(f"rep{rep}: upload={1e3*(t1-t0):.1f}ms dispatch={1e3*(t2-t1):.1f}ms "
+          f"fetch={1e3*(t3-t2):.1f}ms total={1e3*(t3-t0):.1f}ms "
+          f"out_bytes={res.nbytes}", flush=True)
+
+# how long does the kernel actually compute? time a fetch of a 1-elem slice
+for rep in range(3):
+    t0 = time.perf_counter()
+    out = kern(jnp.asarray(ids_np), jnp.asarray(qid_np), hub, *args[1:])
+    cnt = int(out[0])          # tiny fetch forces completion
+    t1 = time.perf_counter()
+    res = np.asarray(out)      # full fetch after completion
+    t2 = time.perf_counter()
+    print(f"rep{rep}: compute+tinyfetch={1e3*(t1-t0):.1f}ms "
+          f"fullfetch_after={1e3*(t2-t1):.1f}ms cnt={cnt}", flush=True)
+
+# upload cost for a single combined array vs two
+comb = np.stack([ids_np, qid_np])
+for rep in range(3):
+    t0 = time.perf_counter()
+    a = jax.device_put(comb); a.block_until_ready()
+    t1 = time.perf_counter()
+    b = jax.device_put(ids_np); b.block_until_ready()
+    c = jax.device_put(qid_np); c.block_until_ready()
+    t2 = time.perf_counter()
+    print(f"rep{rep}: combined_upload={1e3*(t1-t0):.1f}ms "
+          f"two_uploads={1e3*(t2-t1):.1f}ms", flush=True)

@@ -1,0 +1,102 @@
+"""BackendRouter — adaptive device-vs-CPU choice per GO query family.
+
+Round 4 made the columnar CPU fallback fast enough that at small graph
+sizes (or hub-heavy shapes that force the dense kernel) it beats the
+device path's dispatch floor, while the device wins wherever batching
+amortizes it (BASELINE.md bench_suite tables show both regimes).  No
+static rule captures the crossover — it depends on graph shape, filter
+compilability, concurrency, and the link to the chip — so the router
+measures instead of guessing: per (space, OVER set, steps) family it
+keeps an EWMA of observed per-query wall time on each path, routes to
+the cheaper one, and keeps a small probe stream (1 in ``probe_every``)
+on the other so the estimate tracks regime changes.  Under concurrency
+the EWMA includes queueing delay, which makes the router a load
+balancer across the two compute resources rather than a winner-take-all
+switch.
+
+The reference has no analogue (single backend); the closest idea is a
+cost-based optimizer choosing physical plans.  Routing never affects
+results — both paths are exact (the parity suites pin that) — only
+where the work runs.  Off by default (`go_backend_router`); serving
+deployments that want the max of both curves turn it on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Tuple
+
+from ..common.flags import flags
+
+flags.define(
+    "go_backend_router", False,
+    "adaptively route each GO query family to the device or the "
+    "columnar CPU path by measured per-query wall time (EWMA + probe "
+    "stream); off = always prefer the device when it can serve")
+flags.define("go_router_probe_every", 25,
+             "route every Nth query of a family to the currently "
+             "slower path to keep its cost estimate fresh")
+flags.define("go_router_ewma_alpha", 0.25,
+             "EWMA smoothing for the router's per-path cost estimates")
+
+
+class _Family:
+    __slots__ = ("device_s", "cpu_s", "n")
+
+    def __init__(self):
+        self.device_s = None      # EWMA per-query seconds, device path
+        self.cpu_s = None         # EWMA per-query seconds, CPU path
+        self.n = 0
+
+
+class BackendRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fams: Dict[Tuple, _Family] = {}
+        self.stats = {"routed_device": 0, "routed_cpu": 0, "probes": 0}
+        from ..common.stats import stats as _stats
+        _stats.register_stats("graph.router.device.qps")
+        _stats.register_stats("graph.router.cpu.qps")
+
+    def choose(self, key: Tuple) -> str:
+        """-> "device" | "cpu" for this query (record() must follow)."""
+        probe_every = max(2, int(flags.get("go_router_probe_every")
+                                 or 25))
+        with self._lock:
+            f = self._fams.get(key)
+            if f is None:
+                f = self._fams[key] = _Family()
+            f.n += 1
+            # cold start: alternate until both paths have an estimate
+            if f.device_s is None:
+                pick = "device"
+            elif f.cpu_s is None:
+                pick = "cpu" if f.n % 3 == 0 else "device"
+            elif f.n % probe_every == 0:
+                # probe the slower path so its estimate stays live
+                pick = "device" if f.device_s > f.cpu_s else "cpu"
+                self.stats["probes"] += 1
+            else:
+                pick = "device" if f.device_s <= f.cpu_s else "cpu"
+            self.stats["routed_device" if pick == "device"
+                       else "routed_cpu"] += 1
+        from ..common.stats import stats as _stats
+        _stats.add_value("graph.router.device.qps" if pick == "device"
+                         else "graph.router.cpu.qps")
+        return pick
+
+    def record(self, key: Tuple, path: str, seconds: float) -> None:
+        a = float(flags.get("go_router_ewma_alpha") or 0.25)
+        with self._lock:
+            f = self._fams.get(key)
+            if f is None:
+                f = self._fams[key] = _Family()
+            if path == "device":
+                f.device_s = seconds if f.device_s is None else \
+                    (1 - a) * f.device_s + a * seconds
+            else:
+                f.cpu_s = seconds if f.cpu_s is None else \
+                    (1 - a) * f.cpu_s + a * seconds
+
+    def timer(self):
+        return time.perf_counter()

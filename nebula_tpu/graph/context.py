@@ -32,7 +32,7 @@ class ClientSession:
 class ExecutionContext:
     def __init__(self, session: ClientSession, meta: MetaClient,
                  schema_man: SchemaManager, storage: StorageClient,
-                 tpu_runtime=None):
+                 tpu_runtime=None, router=None):
         self.session = session
         self.meta = meta
         self.schema_man = schema_man
@@ -43,6 +43,9 @@ class ExecutionContext:
         # TPU query runtime (tpu/runtime.py) — executors prefer it when the
         # current space has a device CSR mirror and the flag allows
         self.tpu_runtime = tpu_runtime
+        # adaptive device-vs-CPU router (graph/backend_router.py),
+        # engine-scoped so estimates persist across queries
+        self.router = router
 
     def space_id(self) -> int:
         return self.session.space_id

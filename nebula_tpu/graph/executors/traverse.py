@@ -19,6 +19,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...codec.rows import RowReader, RowSetReader
+import time
+
 from ...common.flags import flags
 from ...common.status import ErrorCode
 from ...filter.expressions import (AliasPropExpr, DestPropExpr,
@@ -280,15 +282,28 @@ class GoExecutor(Executor):
 
         # ---- TPU fast path ------------------------------------------
         rt = self.ectx.tpu_runtime
-        if rt is not None and rt.can_run_go(space, etypes, s, pushed,
-                                            remnant, src_refs, dst_refs,
-                                            has_input or has_var):
+        router = self.ectx.router if flags.get("go_backend_router") \
+            else None
+        route_key = (space, tuple(sorted(set(etypes))), steps)
+        prefer_device = True
+        if rt is not None and router is not None:
+            prefer_device = router.choose(route_key) == "device"
+        if rt is not None and prefer_device \
+                and rt.can_run_go(space, etypes, s, pushed, remnant,
+                                  src_refs, dst_refs,
+                                  has_input or has_var):
+            t0 = time.perf_counter()
             try:
-                return rt.run_go(self, space, start_vids, etypes, steps,
-                                 etype_to_alias, yield_cols, distinct,
-                                 where_expr, edge_props, vertex_props)
+                out = rt.run_go(self, space, start_vids, etypes, steps,
+                                etype_to_alias, yield_cols, distinct,
+                                where_expr, edge_props, vertex_props)
+                if router is not None:
+                    router.record(route_key, "device",
+                                  time.perf_counter() - t0)
+                return out
             except TpuDecline:
                 pass   # remote device runtime declined — CPU loop below
+        t_cpu0 = time.perf_counter()
 
         # ---- input mapping (pipe/$var semantics) --------------------
         input_map: Dict[int, Dict[str, object]] = {}
@@ -388,9 +403,15 @@ class GoExecutor(Executor):
                 cur = nxt
                 backtracker = new_bt
 
+        def _rec(result: InterimResult) -> InterimResult:
+            if router is not None:
+                router.record(route_key, "cpu",
+                              time.perf_counter() - t_cpu0)
+            return result
+
         columns = [c.alias or default_col_name(c.expr) for c in yield_cols]
         if final_resp is None:
-            return InterimResult(columns)
+            return _rec(InterimResult(columns))
 
         # ---- flat final eval: columns straight from typed buffers ---
         flat_rows = None
@@ -400,7 +421,7 @@ class GoExecutor(Executor):
                 [r for r in final_resp.responses if "flat" in r],
                 flat_specs, etype_to_alias, distinct)
             if all("flat" in r for r in final_resp.responses):
-                return InterimResult(columns, flat_rows)
+                return _rec(InterimResult(columns, flat_rows))
             # mixed cluster (a host without the native lib answered
             # per-vertex): the flat hosts' rows must combine with the
             # per-row loop's — falling through with them dropped would
@@ -516,7 +537,7 @@ class GoExecutor(Executor):
                                 continue
                             seen_rows.add(key)
                         rows.append(row)
-        return InterimResult(columns, rows)
+        return _rec(InterimResult(columns, rows))
 
 
 # ================================================================== FETCH

@@ -120,6 +120,8 @@ class ExecutionEngine:
         self.storage = storage
         self.tpu_runtime = tpu_runtime
         self.parser = GQLParser()
+        from .backend_router import BackendRouter
+        self.router = BackendRouter()
 
     _KIND_STATS_REGISTERED: set = set()
 
@@ -150,7 +152,8 @@ class ExecutionEngine:
             return resp
 
         ectx = ExecutionContext(session, self.meta, self.schema_man,
-                                self.storage, tpu_runtime=self.tpu_runtime)
+                                self.storage, tpu_runtime=self.tpu_runtime,
+                                router=self.router)
         result: Optional[InterimResult] = None
         try:
             # SequentialExecutor semantics: run each; last rowset wins

@@ -69,45 +69,59 @@ class MetaClient:
         self._threads: List[threading.Thread] = []
 
     # ---------------- rpc plumbing ----------------
+    # election-window retry: when EVERY peer answers not-a-leader /
+    # unreachable (catalog leader just died), a survivor usually wins
+    # within a couple of seconds — retry the whole peer pass with a
+    # short sleep instead of surfacing a user-visible DDL error
+    # (reference MetaClient retries leader changes the same way)
+    _CALL_PASSES = 4
+    _CALL_RETRY_SLEEP_S = 0.5
+
     def _call(self, method: str, payload: dict):
         last_exc: Optional[RpcError] = None
-        # last known-good metad (the catalog leader) first; a follower's
-        # E_NOT_A_LEADER carries the leader hint in its message, which
-        # jumps the queue (reference MetaClient leader-change retry)
-        queue = list(self.addrs)
-        good = getattr(self, "_good_addr", None)
-        if good in queue:
-            queue.remove(good)
-            queue.insert(0, good)
-        tried = set()
-        while queue:
-            addr = queue.pop(0)
-            if addr in tried:
-                continue
-            tried.add(addr)
-            try:
-                resp = self.cm.call(addr, method, payload)
-                self._good_addr = addr
-                return resp
-            except RpcError as e:
-                # Fail over to another metad only when the request provably
-                # never executed (connect failure) or this peer isn't the
-                # leader. E_RPC_FAILURE means "may have executed" — a
-                # resend could duplicate non-idempotent DDL, so propagate.
-                if e.status.code in (ErrorCode.E_FAIL_TO_CONNECT,
-                                     ErrorCode.E_LEADER_CHANGED,
-                                     ErrorCode.E_NOT_A_LEADER):
-                    last_exc = e
-                    if e.status.code == ErrorCode.E_NOT_A_LEADER \
-                            and e.status.msg:
-                        try:
-                            hint = HostAddr.parse(e.status.msg)
-                        except Exception:   # noqa: BLE001 — bad hint
-                            hint = None
-                        if hint is not None and hint not in tried:
-                            queue.insert(0, hint)
+        for attempt in range(self._CALL_PASSES):
+            if attempt:
+                self._stop.wait(self._CALL_RETRY_SLEEP_S)
+                if self._stop.is_set():
+                    break
+            # last known-good metad (the catalog leader) first; a
+            # follower's E_NOT_A_LEADER carries the leader hint in its
+            # message, which jumps the queue
+            queue = list(self.addrs)
+            good = getattr(self, "_good_addr", None)
+            if good in queue:
+                queue.remove(good)
+                queue.insert(0, good)
+            tried = set()
+            while queue:
+                addr = queue.pop(0)
+                if addr in tried:
                     continue
-                raise
+                tried.add(addr)
+                try:
+                    resp = self.cm.call(addr, method, payload)
+                    self._good_addr = addr
+                    return resp
+                except RpcError as e:
+                    # Fail over to another metad only when the request
+                    # provably never executed (connect failure) or this
+                    # peer isn't the leader. E_RPC_FAILURE means "may
+                    # have executed" — a resend could duplicate
+                    # non-idempotent DDL, so propagate.
+                    if e.status.code in (ErrorCode.E_FAIL_TO_CONNECT,
+                                         ErrorCode.E_LEADER_CHANGED,
+                                         ErrorCode.E_NOT_A_LEADER):
+                        last_exc = e
+                        if e.status.code == ErrorCode.E_NOT_A_LEADER \
+                                and e.status.msg:
+                            try:
+                                hint = HostAddr.parse(e.status.msg)
+                            except Exception:  # noqa: BLE001 — bad hint
+                                hint = None
+                            if hint is not None and hint not in tried:
+                                queue.insert(0, hint)
+                        continue
+                    raise
         raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))
 
     def _call_status(self, method: str, payload: dict) -> StatusOr:

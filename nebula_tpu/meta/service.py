@@ -66,13 +66,18 @@ class ClusterIdMan:
     (reference ClusterIdMan.h:24)."""
 
     @staticmethod
-    def get_or_create(kv: NebulaStore) -> int:
+    def get_or_create(kv: NebulaStore):
+        """-> (cluster id, durable).  ``durable`` False means the
+        generate-and-persist write was refused (leadership moved
+        between the caller's gate and the put) — callers must NOT
+        cache the id then, or a later re-election would serve an id
+        the real leader never persisted (E_WRONGCLUSTER storms)."""
         raw, _ = kv.get(META_SPACE, META_PART, mk.CLUSTER_ID_KEY)
         if raw is not None:
-            return _unpk(raw)
+            return _unpk(raw), True
         cid = random.getrandbits(63)
-        kv.put(META_SPACE, META_PART, mk.CLUSTER_ID_KEY, _pk(cid))
-        return cid
+        st = kv.put(META_SPACE, META_PART, mk.CLUSTER_ID_KEY, _pk(cid))
+        return cid, bool(st.ok())
 
 
 class MetaService:
@@ -112,7 +117,10 @@ class MetaService:
     @property
     def cluster_id(self) -> int:
         if self._cluster_id is None:
-            self._cluster_id = ClusterIdMan.get_or_create(self.kv)
+            cid, durable = ClusterIdMan.get_or_create(self.kv)
+            if durable:
+                self._cluster_id = cid
+            return cid          # un-persisted: retry resolution next use
         return self._cluster_id
 
     def _check_catalog_leader(self) -> None:

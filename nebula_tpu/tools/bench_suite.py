@@ -35,13 +35,37 @@ def _ok(cl, stmt):
 
 
 def _timed_queries(c, queries: List[str], threads: int, backend: str,
-                   space: str) -> dict:
+                   space: str, router: bool = False) -> dict:
     from ..common.flags import flags
     flags.set("storage_backend", backend)
-    # warm mirror + kernels outside the timed region
+    flags.set("go_backend_router", router)
+    # warm mirror + kernels outside the timed region — with a
+    # CONCURRENT burst at the target thread count, because the batch
+    # widths/sparse-ladder shapes the timed region will hit are a
+    # function of concurrency, and a single warm query leaves their
+    # first XLA compiles inside the measurement
     w = c.client()
     _ok(w, f"USE {space}")
-    w.execute(queries[0])
+    warm = queries[:min(len(queries), 2 * threads)]
+    widx = [0]
+    wlock = threading.Lock()
+
+    def warm_worker():
+        g = c.client()
+        g.execute(f"USE {space}")
+        while True:
+            with wlock:
+                i = widx[0]
+                if i >= len(warm):
+                    return
+                widx[0] += 1
+            g.execute(warm[i])
+
+    wts = [threading.Thread(target=warm_worker) for _ in range(threads)]
+    for t in wts:
+        t.start()
+    for t in wts:
+        t.join()
     lat_us: List[float] = []
     errors: List[str] = []
     lock = threading.Lock()
@@ -125,8 +149,12 @@ def bench_basketball(results: list) -> None:
         for name, qs in (("1-hop GO (basketballplayer)", one_hop),
                          ("3-hop GO + filter (basketballplayer)",
                           three_hop)):
-            for backend in ("cpu", "tpu"):
-                r = _timed_queries(c, qs, 16, backend, "nba")
+            for backend, router in (("cpu", False), ("tpu", False),
+                                    ("auto", True)):
+                r = _timed_queries(c, qs, 16,
+                                   "tpu" if backend == "auto" else backend,
+                                   "nba", router=router)
+                r["backend"] = backend
                 r["config"] = name
                 results.append(r)
                 print(r, file=sys.stderr)
@@ -152,6 +180,15 @@ def bench_ldbc_paths(results: list, persons: int) -> None:
             r["config"] = f"FIND SHORTEST PATH (LDBC-ish, {persons:,} persons)"
             results.append(r)
             print(r, file=sys.stderr)
+        # concurrency scaling: concurrent FIND PATHs coalesce into one
+        # device BFS dispatch (batch_dispatch), so qps must grow with
+        # offered concurrency instead of serializing per query
+        for threads in (1, 4, 16, 64):
+            r = _timed_queries(c, qs, threads, "tpu", "ldbc")
+            r["config"] = (f"FIND SHORTEST PATH scaling "
+                           f"({threads} workers)")
+            results.append(r)
+            print(r, file=sys.stderr)
     finally:
         c.stop()
 
@@ -168,8 +205,12 @@ def bench_ldbc_go(results: list, persons: int) -> None:
         vids = rng.integers(1, persons + 1, 1000)
         qs = [f"GO 3 STEPS FROM {v} OVER knows" for v in vids]
         _parity(c, qs[:6], "ldbc")
-        for backend in ("cpu", "tpu"):
-            r = _timed_queries(c, qs, 64, backend, "ldbc")
+        for backend, router in (("cpu", False), ("tpu", False),
+                                ("auto", True)):
+            r = _timed_queries(c, qs, 64,
+                               "tpu" if backend == "auto" else backend,
+                               "ldbc", router=router)
+            r["backend"] = backend
             r["config"] = (f"3-hop GO batched (LDBC-ish skewed, "
                            f"{persons:,} persons, {len(src):,} edges)")
             results.append(r)

@@ -89,32 +89,42 @@ class SstGenerator:
         self.count += 1
 
     def load_vertex_csv(self, path: str, tag_id: int, schema: Schema,
-                        skip_header: bool = False) -> int:
+                        skip_header: bool = False, stride: int = 1,
+                        offset: int = 0) -> int:
+        """``stride``/``offset``: row-sharding for parallel generation
+        (worker ``offset`` of ``stride`` handles rows where
+        row_index % stride == offset — the mapper-side split of the
+        reference's Spark job)."""
         n = 0
         with open(path, newline="") as f:
             rows = csv.reader(f)
             if skip_header:
                 next(rows, None)
-            for row in rows:
-                values = {c.name: _coerce(row[1 + i], c.type)
-                          for i, c in enumerate(schema.columns)}
+            for i, row in enumerate(rows):
+                if i % stride != offset:
+                    continue
+                values = {c.name: _coerce(row[1 + j], c.type)
+                          for j, c in enumerate(schema.columns)}
                 self.add_vertex(int(row[0]), tag_id, schema, values)
                 n += 1
         return n
 
     def load_edge_csv(self, path: str, etype: int, schema: Schema,
                       with_rank: bool = False,
-                      skip_header: bool = False) -> int:
+                      skip_header: bool = False, stride: int = 1,
+                      offset: int = 0) -> int:
         n = 0
         off = 3 if with_rank else 2
         with open(path, newline="") as f:
             rows = csv.reader(f)
             if skip_header:
                 next(rows, None)
-            for row in rows:
+            for i, row in enumerate(rows):
+                if i % stride != offset:
+                    continue
                 rank = int(row[2]) if with_rank else 0
-                values = {c.name: _coerce(row[off + i], c.type)
-                          for i, c in enumerate(schema.columns)}
+                values = {c.name: _coerce(row[off + j], c.type)
+                          for j, c in enumerate(schema.columns)}
                 self.add_edge(int(row[0]), etype, rank, int(row[1]),
                               schema, values)
                 n += 1
@@ -150,6 +160,95 @@ class SstGenerator:
         return paths
 
 
+# ---------------------------------------------------------------- parallel
+def _worker_generate(args) -> Tuple[int, List[str], int]:
+    """One parallel shard: encode its stride of every input and write
+    partial per-part files (the mapper half of the reference's Spark
+    job, SparkSstFileGenerator.scala — hash-partition + local sort)."""
+    (out_dir, num_parts, vertex_specs, edge_specs, skip_header,
+     stride, offset) = args
+    import os
+    gen = SstGenerator(num_parts)
+    for path, tag_id, spec in vertex_specs:
+        gen.load_vertex_csv(path, int(tag_id), parse_schema(spec),
+                            skip_header, stride=stride, offset=offset)
+    for path, etype, spec in edge_specs:
+        gen.load_edge_csv(path, int(etype), parse_schema(spec),
+                          skip_header=skip_header, stride=stride,
+                          offset=offset)
+    paths = []
+    os.makedirs(out_dir, exist_ok=True)
+    for part in sorted(gen.parts):
+        rows = gen.parts[part]
+        if not rows:
+            continue
+        rows.sort()
+        path = os.path.join(out_dir, f"bulk.part{part}.w{offset}.partial")
+        with open(path, "wb") as f:
+            for k, v in rows:
+                f.write(_FRAME.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+        paths.append(path)
+    return offset, paths, gen.count
+
+
+def _read_frames(path: str):
+    """Incremental frame reader — the k-way merge holds every worker's
+    partial open at once, so each must stream (O(frame) memory), not
+    slurp the file."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                return
+            klen, vlen = _FRAME.unpack(hdr)
+            k = f.read(klen)
+            v = f.read(vlen)
+            if len(k) < klen or len(v) < vlen:
+                return               # truncated tail: stop at last frame
+            yield k, v
+
+
+def generate_parallel(out_dir: str, num_parts: int, vertex_specs,
+                      edge_specs, workers: int,
+                      skip_header: bool = False) -> Tuple[List[str], int]:
+    """Parallel bulk generation: ``workers`` processes each encode a
+    row-stride of every input and write sorted partial files; a
+    streaming k-way merge per part produces the final snapshot files —
+    the in-box equivalent of the reference's Spark map/sort/reduce
+    (SparkSstFileGenerator.scala).  Returns (final paths, total rows)."""
+    import heapq
+    import multiprocessing as mp
+    import os
+    import re
+    jobs = [(out_dir, num_parts, list(vertex_specs), list(edge_specs),
+             skip_header, workers, w) for w in range(workers)]
+    with mp.Pool(workers) as pool:
+        results = pool.map(_worker_generate, jobs)
+    total = sum(c for _w, _p, c in results)
+    by_part: Dict[int, List[str]] = {}
+    for _w, paths, _c in results:
+        for pth in paths:
+            m = re.search(r"bulk\.part(\d+)\.w\d+\.partial$", pth)
+            by_part.setdefault(int(m.group(1)), []).append(pth)
+    finals = []
+    for part in sorted(by_part):
+        partials = by_part[part]
+        final = os.path.join(out_dir, f"bulk.part{part}.snap")
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            for k, v in heapq.merge(*[_read_frames(p) for p in partials]):
+                f.write(_FRAME.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+        os.replace(tmp, final)
+        for p in partials:
+            os.remove(p)
+        finals.append(final)
+    return finals, total
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="sst-generator")
     p.add_argument("--out", required=True, help="output directory")
@@ -160,19 +259,28 @@ def main(argv=None) -> int:
     p.add_argument("--edge", action="append", default=[], nargs=3,
                    metavar=("CSV", "ETYPE", "SCHEMA"))
     p.add_argument("--skip-header", action="store_true")
+    p.add_argument("--workers", type=int, default=1,
+                   help="parallel generation processes (map/sort/merge "
+                        "like the reference's Spark SST job)")
     args = p.parse_args(argv)
 
-    gen = SstGenerator(args.parts)
     t0 = time.perf_counter()
-    for path, tag_id, spec in args.vertex:
-        gen.load_vertex_csv(path, int(tag_id), parse_schema(spec),
-                            args.skip_header)
-    for path, etype, spec in args.edge:
-        gen.load_edge_csv(path, int(etype), parse_schema(spec),
-                          skip_header=args.skip_header)
-    paths = gen.write(args.out)
+    if args.workers > 1:
+        paths, count = generate_parallel(
+            args.out, args.parts, args.vertex, args.edge, args.workers,
+            args.skip_header)
+    else:
+        gen = SstGenerator(args.parts)
+        for path, tag_id, spec in args.vertex:
+            gen.load_vertex_csv(path, int(tag_id), parse_schema(spec),
+                                args.skip_header)
+        for path, etype, spec in args.edge:
+            gen.load_edge_csv(path, int(etype), parse_schema(spec),
+                              skip_header=args.skip_header)
+        paths = gen.write(args.out)
+        count = gen.count
     dt = time.perf_counter() - t0
-    print(f"wrote {gen.count} rows to {len(paths)} snapshot files "
+    print(f"wrote {count} rows to {len(paths)} snapshot files "
           f"in {dt:.2f}s", file=sys.stderr)
     for pth in paths:
         print(pth)

@@ -321,24 +321,30 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
                                       d.has_tag)
 
     # ---- base rows superseded / deleted ------------------------------
+    # (vectorized endpoint translation — known_src/known_dst already
+    # cover the puts; one batch covers the dels.  The per-identity row
+    # probe stays Python but walks only one vertex's slice each.)
     dead: List[int] = []
-    for src, et, rank, dst in put_idents:
-        sd = base.to_dense([src])[0]
-        dd = base.to_dense([dst])[0]
+    for i, (src, et, rank, dst) in enumerate(put_idents):
+        sd, dd = int(known_src[i]), int(known_dst[i])
         if sd < 0 or dd < 0:
             continue                    # brand-new edge: nothing to kill
-        e = _base_edge_index(base, int(sd), et, rank, int(dd))
+        e = _base_edge_index(base, sd, et, rank, dd)
         if e >= 0:
             dead.append(e)              # in-place update: override
-    for src, et, rank, dst in dels:
-        sd = base.to_dense([src])[0]
-        dd = base.to_dense([dst])[0]
-        if sd < 0 or dd < 0:
-            continue                    # deleting an unknown edge: no-op
-        e = _base_edge_index(base, int(sd), et, rank, int(dd))
-        if e >= 0:
-            dead.append(e)
-            d.has_deletes = True        # reachability changed
+    if dels:
+        del_sd = base.to_dense(
+            np.asarray([k[0] for k in dels], dtype=np.int64))
+        del_dd = base.to_dense(
+            np.asarray([k[3] for k in dels], dtype=np.int64))
+        for i, (src, et, rank, dst) in enumerate(dels):
+            sd, dd = int(del_sd[i]), int(del_dd[i])
+            if sd < 0 or dd < 0:
+                continue                # deleting an unknown edge: no-op
+            e = _base_edge_index(base, sd, et, rank, dd)
+            if e >= 0:
+                dead.append(e)
+                d.has_deletes = True    # reachability changed
     d.base_dead = np.unique(np.asarray(dead, dtype=np.int64))
 
     m = len(put_idents)

@@ -157,6 +157,12 @@ flags.define(
     "The TPU analogue of the reference's multi-storaged partition "
     "spread (SURVEY.md §2.12)")
 flags.define(
+    "tpu_prewarm_kernels", True,
+    "after a query family's first kernel builds, background-compile "
+    "the family's OTHER pinned batch shapes (sparse c0 ladder, dense "
+    "widths) so fresh clusters don't pay first-compile seconds as p99 "
+    "spikes when concurrency shifts the batch shape")
+flags.define(
     "mirror_delta_max", 4096,
     "max accumulated edge-insert overlay before the next device query "
     "pays a full CSR/ELL rebuild (compaction); inserts below this ride "
@@ -725,6 +731,7 @@ class TpuQueryRuntime:
             ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax),
             lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
                                                   caps, qmax=qmax))
+        self._prewarm_family(m, ix, et_tuple, steps, skip_c0=c0)
         S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
         qid = np.zeros(c0, np.int32)
@@ -810,6 +817,7 @@ class TpuQueryRuntime:
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
                                                pack=True))
             out_dev = kern(f0_dev, *args)
+            self._prewarm_family(m, ix, et_tuple, steps)
         self.stats["go_dense"] += 1
 
         def resolve():
@@ -825,6 +833,74 @@ class TpuQueryRuntime:
             return [vs[bounds[q]:bounds[q + 1]] for q in range(nq)], m
 
         return resolve
+
+    def _prewarm_family(self, m: CsrMirror, ix: EllIndex,
+                        et_tuple: Tuple[int, ...], steps: int,
+                        skip_c0: Optional[int] = None) -> None:
+        """Background-compile the OTHER pinned batch shapes of a query
+        family (same OVER set + steps): the sparse c0 ladder rungs and
+        the dense batch widths the first live query didn't hit.  A new
+        shape's first XLA compile costs seconds and lands as a p99
+        spike on fresh clusters; compiling off-thread while the first
+        shape serves removes it.  One shot per (mirror, family)."""
+        if not flags.get("tpu_prewarm_kernels"):
+            return
+        key = (et_tuple, steps)
+        warmed = getattr(m, "_prewarm_done", None)
+        if warmed is None:
+            warmed = m._prewarm_done = set()
+        if key in warmed:
+            return
+        warmed.add(key)
+
+        def run():
+            try:
+                import jax.numpy as jnp
+                from .ell import (make_batched_go_kernel,
+                                  make_batched_sparse_go_kernel,
+                                  sparse_caps)
+                d_max = max(ix.bucket_D) if ix.bucket_D else 1
+                cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
+                growth = int(flags.get("tpu_sparse_growth") or 8)
+                qmax = int(flags.get("go_batch_max") or 1024)
+                hub = self._hub_dev(m, ix)
+                args = ix.kernel_args()
+                ladder = [int(x) for x in
+                          str(flags.get("tpu_sparse_c0s") or
+                              "256,2048").split(",") if x.strip()]
+                for c0 in ladder:
+                    if c0 == skip_c0 or steps <= 1:
+                        continue
+                    caps = sparse_caps(c0, d_max, steps, cap,
+                                       growth=growth)
+                    kern = self._kernel(
+                        ("sparse_go", ix.shape_sig(), et_tuple, steps,
+                         caps, qmax),
+                        lambda: make_batched_sparse_go_kernel(
+                            ix, steps, et_tuple, caps, qmax=qmax))
+                    ids = np.full(c0, ix.n_rows, np.int32)
+                    qid = np.zeros(c0, np.int32)
+                    # the call is what compiles; result discarded
+                    np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid),
+                                    hub, *args[1:]))
+                for B in sorted(int(w) for w in
+                                str(flags.get("go_batch_widths") or
+                                    "128,1024").split(",") if w.strip()):
+                    if steps <= 1:
+                        continue
+                    kern = self._kernel(
+                        ("ell_go", ix.shape_sig(), et_tuple, steps),
+                        lambda: make_batched_go_kernel(
+                            ix, steps, et_tuple, pack=True))
+                    f0 = self._upload_frontier(
+                        ix, np.zeros(0, np.int32), np.zeros(0, np.int32),
+                        B)
+                    np.asarray(kern(f0, *args))
+            except Exception:   # noqa: BLE001 — pre-warm must never
+                pass            # disturb serving
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"kernel-prewarm-{m.space_id}").start()
 
     def _hub_dev(self, m: CsrMirror, ix: EllIndex):
         import jax.numpy as jnp
@@ -842,9 +918,7 @@ class TpuQueryRuntime:
         assembly + filter + materialization over the concatenated
         frontier, splitting rows back per query.  Per-query failures
         become Exception entries."""
-        delta = getattr(m, "_delta", None)
-        if delta is not None and delta.m == 0:
-            delta = None
+        delta = self._live_delta(m)
         results: List[object] = [None] * len(queries)
         groups: Dict[Tuple, List[int]] = {}
         for i, q in enumerate(queries):

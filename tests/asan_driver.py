@@ -71,6 +71,23 @@ def main(tmp_dir: str) -> None:
     if parsed is not None:
         assert [int(x) for x in parsed.a[:3]] == [1, 3, 5]
 
+    # multi-prefix bulk scan (round 4): counts and content must match
+    # per-prefix scans, including empty and all-0xFF-adjacent prefixes
+    e2 = NativeEngine()
+    from nebula_tpu.common.keys import KeyUtils as KU
+    for part in (1, 2):
+        for vid in range(6):
+            for ver in (5, 6):
+                e2.put(KU.edge_key(part, vid, 3, 0, vid + 1, ver),
+                       b"v%d" % ver)
+    prefixes = [KU.edge_prefix(1, v, 3) for v in range(8)]   # 6,7 empty
+    got = e2.multi_prefix_packed(prefixes)
+    if got is not None:
+        packed, counts = got
+        assert [int(c) for c in counts] == [2] * 6 + [0, 0], counts
+        singles = b"".join(e2.scan_prefix_packed(p) for p in prefixes)
+        assert packed == singles
+
     # C++ ELL builder
     es = np.asarray(rng.choices(range(64), k=600), dtype=np.int32)
     ed = np.asarray(rng.choices(range(64), k=600), dtype=np.int32)

@@ -1,0 +1,63 @@
+"""Adaptive backend router (graph/backend_router.py): per query family
+it measures both paths and routes to the cheaper one, with a probe
+stream keeping the loser's estimate fresh.  Results never change —
+both paths are exact — only where the work runs.
+"""
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.flags import flags
+from nebula_tpu.graph.backend_router import BackendRouter
+
+
+def test_unit_converges_to_cheaper_path_and_probes():
+    r = BackendRouter()
+    key = (1, (2,), 3)
+    # feed: device consistently 10ms, cpu 2ms
+    for _ in range(60):
+        pick = r.choose(key)
+        r.record(key, pick, 0.010 if pick == "device" else 0.002)
+    # steady state: overwhelmingly cpu, with a live probe stream
+    routed = {"device": 0, "cpu": 0}
+    for _ in range(100):
+        pick = r.choose(key)
+        routed[pick] += 1
+        r.record(key, pick, 0.010 if pick == "device" else 0.002)
+    assert routed["cpu"] > 80, routed
+    assert routed["device"] >= 1, "probe stream must keep measuring"
+
+    # regime change: device becomes fast — the router must follow
+    for _ in range(200):
+        pick = r.choose(key)
+        r.record(key, pick, 0.001 if pick == "device" else 0.002)
+    routed = {"device": 0, "cpu": 0}
+    for _ in range(100):
+        pick = r.choose(key)
+        routed[pick] += 1
+        r.record(key, pick, 0.001 if pick == "device" else 0.002)
+    assert routed["device"] > 80, routed
+
+
+def test_e2e_routing_preserves_results():
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    prev = flags.get("go_backend_router")
+    flags.set("go_backend_router", True)
+    try:
+        g = c.client()
+        assert g.execute("CREATE SPACE rtr(partition_num=4)").ok()
+        c.refresh_all()
+        assert g.execute("USE rtr").ok()
+        assert g.execute("CREATE EDGE e(w int)").ok()
+        c.refresh_all()
+        assert g.execute(
+            "INSERT EDGE e(w) VALUES 1->2:(7), 2->3:(9), 3->4:(5)").ok()
+        expect = [(3,)]
+        for i in range(30):   # alternating warmup routes both paths
+            r = g.execute("GO 2 STEPS FROM 1 OVER e")
+            assert r.ok(), r.error_msg
+            assert sorted(map(tuple, r.rows)) == expect, f"iter {i}"
+        st = c.graph_service.engine.router.stats
+        assert st["routed_device"] > 0 and st["routed_cpu"] > 0, st
+    finally:
+        flags.set("go_backend_router", prev)
+        c.stop()
