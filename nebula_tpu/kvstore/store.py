@@ -63,13 +63,13 @@ class NebulaStore:
         # raft or single-replica — never on submit or on rejected writes.
         self.mutation_versions: Dict[GraphSpaceID, int] = {}
         # per-space committed-mutation delta log: one entry per version
-        # bump — either a list of typed edge events
-        # (("put", key, value) inserts/updates, ("del", identity32)
-        # whole-edge deletes) the TPU mirror can apply incrementally
-        # (SURVEY §7 hard part (a)), or None for anything it can't
-        # describe (vertex writes, partial removes, ingest, compaction)
-        # which forces a full mirror rebuild.  Bounded; trimming
-        # invalidates older cursors.
+        # bump — either a list of typed events
+        # (("put", key, value) edge inserts/updates, ("del", identity32)
+        # whole-edge deletes, ("vput", key, value) vertex-row writes)
+        # the TPU mirror can apply incrementally (SURVEY §7 hard part
+        # (a)), or None for anything it can't describe (partial
+        # removes, merges, ingest, compaction) which forces a full
+        # mirror rebuild.  Bounded; trimming invalidates older cursors.
         self.delta_logs: Dict[GraphSpaceID, List] = {}
         self.delta_bases: Dict[GraphSpaceID, int] = {}
         self.delta_cap = 4096
@@ -129,9 +129,12 @@ class NebulaStore:
                 for key, value in items:
                     if key.startswith(b"__system"):
                         continue   # commit watermark bookkeeping
-                    if not KeyUtils.is_edge(key):
-                        return None    # vertex/prop writes: opaque
-                    events.append(("put", key, value))
+                    if KeyUtils.is_edge(key):
+                        events.append(("put", key, value))
+                    elif KeyUtils.is_vertex(key):
+                        events.append(("vput", key, value))
+                    else:
+                        return None    # unknown key shape: opaque
             elif op == LogOp.OP_REMOVE_PREFIX:
                 prefix = payload
                 if len(prefix) != NebulaStore._EDGE_IDENT_LEN:

@@ -67,7 +67,7 @@ class Column:
     """
 
     __slots__ = ("name", "stype", "values", "valid", "dictionary",
-                 "device_ok", "raw")
+                 "device_ok", "raw", "_int32_ok")
 
     def __init__(self, name: str, stype: SupportedType, size: int):
         self.name = name
@@ -76,6 +76,8 @@ class Column:
         self.dictionary: Optional[np.ndarray] = None  # sorted unique strings
         self.device_ok = True
         self.raw: Optional[list] = None
+        self._int32_ok: Optional[bool] = None   # lazily cached: int64
+        # values all int32-representable (device uses int32, else f32)
         if stype == SupportedType.STRING:
             self.raw = [""] * size          # filled then dict-encoded
             self.values = None
@@ -245,8 +247,12 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
     """
     sm = schema_man
     # collapse in commit order: the last event per edge identity wins
+    # (vertex events are applied in place by apply_vertex_events, not
+    # through the edge overlay)
     final: Dict[Tuple[int, int, int, int], Optional[bytes]] = {}
     for ev in events:
+        if ev[0] == "vput":
+            continue
         if ev[0] == "put":
             _part, src, et, rank, dst, _ver = KeyUtils.parse_edge(ev[1])
             final[(src, et, rank, dst)] = ev[2]
@@ -401,6 +407,133 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
     counts = np.bincount(d.edge_src, minlength=d.n)
     d.row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
     return d
+
+
+def apply_vertex_events(base: CsrMirror, events, schema_man,
+                        space_id: int) -> bool:
+    """Apply committed vertex-row writes ("vput" events) to the base
+    mirror IN PLACE — the vertex-side half of incremental maintenance.
+    Returns False ("do the full rebuild") for any write the in-place
+    path can't reproduce exactly:
+
+      * a vid or tag the base doesn't know (dense space / column set
+        would change);
+      * string values NOT already in the column's dictionary (growing
+        or re-sorting a dictionary re-encodes every row's code, which
+        a concurrently evaluating plan would read torn; writing an
+        EXISTING value is a single-element code store, safe like the
+        numeric case — this covers the common re-insert-row-to-update-
+        one-field pattern);
+      * TTL'd schemas (need expiry tracking);
+      * values that break a column's device representability (the
+        compiled plans assume the checked range).
+
+    Numeric single-element stores are effectively atomic on the host;
+    queries racing an absorption see either the old or the new value —
+    the same bounded-staleness window every mirror refresh already has.
+    """
+    sm = schema_man
+    # newest write per (vid, tag) wins (commit order)
+    newest: Dict[Tuple[int, int], bytes] = {}
+    for ev in events:
+        if ev[0] != "vput":
+            continue
+        _part, vid, tag, _ver = KeyUtils.parse_vertex(ev[1])
+        newest[(vid, tag)] = ev[2]
+    if not newest:
+        return True
+    # phase 1 — validate EVERYTHING before touching the mirror: a
+    # mid-batch decline after partial application would expose a torn
+    # view of one commit batch
+    plan = []        # (dense, tag, tag_cols, present | None)
+    for (vid, tag), blob in newest.items():
+        dense = int(base.to_dense([vid])[0])
+        if dense < 0 or tag not in base.has_tag:
+            return False
+        tag_cols = {cname: c for (t, cname), c in base.vertex_cols.items()
+                    if t == tag}
+        if not blob:
+            plan.append((dense, tag, tag_cols, None))
+            continue
+        try:
+            reader = RowReader.from_resolver(
+                blob, lambda ver, _t=tag: sm.get_tag_schema(space_id, _t,
+                                                            ver))
+        except KeyError:
+            return False
+        if _ttl_expiry(reader) is not None:
+            return False
+        present: Dict[str, object] = {}
+        for cname in reader.schema.names():
+            c = tag_cols.get(cname)
+            if c is None:
+                return False            # schema drift: rebuild
+            try:
+                present[cname] = reader.get(cname)
+            except KeyError:
+                pass
+        for cname, v in list(present.items()):
+            c = tag_cols[cname]
+            if c.stype == SupportedType.STRING:
+                if c.dictionary is None:
+                    return False
+                s = v if isinstance(v, str) else str(v)
+                pos = int(np.searchsorted(c.dictionary, s))
+                if pos >= len(c.dictionary) \
+                        or str(c.dictionary[pos]) != s:
+                    return False        # new string: dictionary grows
+                present[cname] = (s, pos)   # (raw, code) to store
+                continue
+            if c.values.dtype == np.int64 and c.device_ok:
+                if c._int32_ok is None:
+                    if len(c.values):
+                        lo, hi = int(c.values.min()), int(c.values.max())
+                        c._int32_ok = -2**31 < lo and hi < 2**31
+                    else:
+                        c._int32_ok = True
+                if c._int32_ok:
+                    # device serves this column as int32 — the write
+                    # must keep that representation
+                    if not (-2**31 < int(v) < 2**31):
+                        return False
+                else:
+                    # device serves it as float32 (every value round-
+                    # trips) — the write must round-trip too, or
+                    # device/CPU comparisons diverge at the boundary
+                    if int(np.int64(np.float32(v))) != int(v):
+                        return False
+            if c.values.dtype == np.float64 and c.device_ok:
+                f32 = np.float32(v)
+                if float(np.float64(f32)) != float(v):
+                    return False
+        plan.append((dense, tag, tag_cols, present))
+    # phase 2 — apply.  Values first, validity flags LAST: a reader
+    # racing the absorption then sees each column as either its old
+    # state (stale valid bit) or its new state (fresh value + fresh
+    # bit) — never valid=True over a not-yet-written value
+    for dense, tag, tag_cols, present in plan:
+        if present is None:
+            # the newest committed row is empty: it REPLACES the old
+            # one, so no column survives (rebuild semantics —
+            # build_mirror's first-wins dedup never reads older rows)
+            for c in tag_cols.values():
+                c.valid[dense] = False
+        else:
+            for cname, v in present.items():
+                c = tag_cols[cname]
+                if c.stype == SupportedType.STRING:
+                    s, code = v
+                    c.raw[dense] = s
+                    c.values[dense] = code
+                else:
+                    c.values[dense] = v
+            for cname, c in tag_cols.items():
+                c.valid[dense] = cname in present
+        base.has_tag[tag][dense] = True
+    # grown-space vertex copies (extras cache) are now stale
+    if getattr(base, "_ext_vertex_cache", None) is not None:
+        base._ext_vertex_cache = None
+    return True
 
 
 def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:

@@ -373,12 +373,20 @@ class TpuQueryRuntime:
         total = m._delta_kvs + new_events
         if len(total) > int(flags.get("mirror_delta_max") or 4096):
             return None              # compaction point: full rebuild
-        from .csr import build_delta_mirror
+        from .csr import apply_vertex_events, build_delta_mirror
+        # vertex-row writes apply IN PLACE to the base (numeric props
+        # only — csr.apply_vertex_events documents the guards); only
+        # the NEW events apply, earlier ones already did
+        if not apply_vertex_events(m, new_events, self.sm, space_id):
+            return None
         d = build_delta_mirror(m, total, self.sm, space_id) if total \
             else None
         if total and d is None:
             return None
-        m._delta_kvs = total
+        # vput events are fully consumed by the in-place apply — keeping
+        # them would burn mirror_delta_max budget and re-scan dead
+        # events on every absorption
+        m._delta_kvs = [e for e in total if e[0] != "vput"]
         if d is not None and (d.m > 0 or len(d.base_dead)):
             m._delta = d
             m._delta_gen += 1

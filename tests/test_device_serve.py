@@ -212,3 +212,51 @@ class TestServeGoWire:
             "yield": [[b"\x00garbage", None]], "distinct": False,
             "where": None, "pushed_mode": False})
         assert resp["ok"] is False and resp.get("reason")
+
+
+class TestTornScanGuard:
+    """RemoteStoreView.prefix: a write landing BETWEEN scan chunks gives
+    the peer's mirror a torn view of a multi-key commit — the version
+    echo must fail the scan (build fails → CPU fallback → next query
+    rebuilds) instead of serving torn rows."""
+
+    class _FakeCM:
+        def __init__(self, rows_per_chunk=2, bump_at_chunk=None):
+            self.rows = [(b"k%02d" % i, b"v%d" % i) for i in range(6)]
+            self.per = rows_per_chunk
+            self.bump_at = bump_at_chunk
+            self.version = 7
+            self.chunks_served = 0
+
+        def call(self, addr, method, payload, timeout=None):
+            assert method == "deviceScan"
+            if self.bump_at is not None \
+                    and self.chunks_served == self.bump_at:
+                self.version += 1         # a commit landed mid-scan
+            cur = payload.get("cursor")
+            start = 0
+            if cur is not None:
+                start = next(i for i, (k, _v) in enumerate(self.rows)
+                             if k == cur) + 1
+            chunk = self.rows[start:start + self.per]
+            self.chunks_served += 1
+            return {"ok": True, "rows": chunk,
+                    "cursor": chunk[-1][0] if chunk else cur,
+                    "done": start + self.per >= len(self.rows),
+                    "version": self.version}
+
+    def _view(self, cm):
+        from nebula_tpu.interface.common import HostAddr
+        from nebula_tpu.storage.device import RemoteStoreView
+        return RemoteStoreView(HostAddr("p", 1), 1, cm)
+
+    def test_stable_version_streams_all_rows(self):
+        cm = self._FakeCM()
+        got = list(self._view(cm).prefix(1, 1, b"k"))
+        assert got == cm.rows
+
+    def test_mid_scan_version_bump_fails_the_scan(self):
+        from nebula_tpu.interface.rpc import RpcError
+        cm = self._FakeCM(bump_at_chunk=2)
+        with pytest.raises(RpcError):
+            list(self._view(cm).prefix(1, 1, b"k"))

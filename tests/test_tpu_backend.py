@@ -575,6 +575,49 @@ class TestIncrementalDelta:
         finally:
             c.stop()
 
+    def test_vertex_numeric_prop_update_absorbed(self):
+        """A numeric tag-prop update on a known vertex applies to the
+        mirror IN PLACE (csr.apply_vertex_events) — no rebuild, and
+        device-served $^-filtered queries see the fresh value."""
+        c, cl, ok = self._boot()
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow WHERE $^.player.age > 10 "
+               "YIELD follow._dst")            # build + device serve
+            builds0 = rt.stats["mirror_builds"]
+            # p0's age was 20; push it over the new threshold
+            ok('INSERT VERTEX player(name, age) VALUES 100:("p0", 77)')
+            q = ("GO FROM 100 OVER follow WHERE $^.player.age > 50 "
+                 "YIELD follow._dst, $^.player.age")
+            r = ok(q)
+            got = set(map(tuple, r.rows))
+            assert (101, 77) in got, got
+            assert rt.stats["mirror_builds"] == builds0, \
+                "numeric vertex update must absorb without a rebuild"
+            from nebula_tpu.common.flags import flags
+            flags.set("storage_backend", "cpu")
+            r2 = ok(q)
+            flags.set("storage_backend", "tpu")
+            assert sorted(map(tuple, r.rows)) == sorted(map(tuple,
+                                                            r2.rows))
+        finally:
+            c.stop()
+
+    def test_vertex_string_prop_update_rebuilds(self):
+        """String tag-prop updates stay opaque (dictionaries bake into
+        compiled plans) — must rebuild, and results must be fresh."""
+        c, cl, ok = self._boot()
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow")
+            builds0 = rt.stats["mirror_builds"]
+            ok('INSERT VERTEX player(name, age) VALUES 100:("zz", 20)')
+            r = ok("GO FROM 100 OVER follow YIELD $^.player.name")
+            assert set(map(tuple, r.rows)) == {("zz",)}
+            assert rt.stats["mirror_builds"] > builds0
+        finally:
+            c.stop()
+
     def test_find_path_sees_fresh_edges(self):
         """FIND PATH forces the rebuild (mirror_full) and must see the
         overlay's edges."""
