@@ -40,6 +40,9 @@ def _now_s() -> float:
     return now_s()
 
 
+_NO_ABSORB = object()   # Column.absorb_form: "this write needs a rebuild"
+
+
 def _ttl_expiry(reader: RowReader):
     """Absolute expiry time (seconds) of a row under its schema's TTL, or
     None when the schema has no TTL / the column is unusable (same
@@ -117,14 +120,58 @@ class Column:
         if self.stype == SupportedType.STRING:
             return self.values                      # int32 codes
         if self.values.dtype == np.int64:
-            lo = int(self.values.min()) if len(self.values) else 0
-            hi = int(self.values.max()) if len(self.values) else 0
-            if -2**31 < lo and hi < 2**31:
+            if self._is_int32_representable():
                 return self.values.astype(np.int32)
             return self.values.astype(np.float32)
         if self.values.dtype == np.float64:
             return self.values.astype(np.float32)
         return self.values
+
+    def _is_int32_representable(self) -> bool:
+        """Does the device serve this int64 column as int32 (vs the
+        float32-exact fallback)?  Cached; in-place absorption keeps the
+        invariant because absorb_form refuses representation-changing
+        writes."""
+        if self._int32_ok is None:
+            if len(self.values):
+                lo, hi = int(self.values.min()), int(self.values.max())
+                self._int32_ok = -2**31 < lo and hi < 2**31
+            else:
+                self._int32_ok = True
+        return self._int32_ok
+
+    def absorb_form(self, v):
+        """The storable form of an in-place write of ``v`` to this
+        column, or _NO_ABSORB when the write would change how the
+        device represents the column (the single source of the same
+        int32/float32 rules device_values serves by — keep in sync):
+
+          * strings: only values already in the dictionary (growing it
+            re-encodes every row's code, torn for racing readers) —
+            returns (raw, code);
+          * int64 on the int32 path: v must fit int32;
+          * int64 on the float32-exact path / float64: v must
+            round-trip through float32, or device and CPU comparisons
+            diverge at the boundary."""
+        if self.stype == SupportedType.STRING:
+            if self.dictionary is None:
+                return _NO_ABSORB
+            s = v if isinstance(v, str) else str(v)
+            pos = int(np.searchsorted(self.dictionary, s))
+            if pos >= len(self.dictionary) \
+                    or str(self.dictionary[pos]) != s:
+                return _NO_ABSORB       # new string: dictionary grows
+            return (s, pos)
+        if self.values.dtype == np.int64 and self.device_ok:
+            if self._is_int32_representable():
+                if not (-2**31 < int(v) < 2**31):
+                    return _NO_ABSORB
+            elif int(np.int64(np.float32(v))) != int(v):
+                return _NO_ABSORB
+        if self.values.dtype == np.float64 and self.device_ok:
+            if float(np.float64(np.float32(v))) != float(v):
+                return _NO_ABSORB
+        return v
 
     def host_value(self, i: int):
         """Python value at row i (for result rows)."""
@@ -409,12 +456,15 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
     return d
 
 
-def apply_vertex_events(base: CsrMirror, events, schema_man,
-                        space_id: int) -> bool:
-    """Apply committed vertex-row writes ("vput" events) to the base
-    mirror IN PLACE — the vertex-side half of incremental maintenance.
-    Returns False ("do the full rebuild") for any write the in-place
-    path can't reproduce exactly:
+def plan_vertex_events(base: CsrMirror, events, schema_man,
+                       space_id: int):
+    """Validate committed vertex-row writes ("vput" events) against the
+    base mirror and return an apply plan for commit_vertex_plan — the
+    vertex-side half of incremental maintenance.  NOTHING is mutated
+    here: the caller commits the plan only after every other
+    absorption step has succeeded, so no decline path can expose half
+    of a commit batch.  Returns None ("do the full rebuild") for any
+    write the in-place path can't reproduce exactly:
 
       * a vid or tag the base doesn't know (dense space / column set
         would change);
@@ -440,16 +490,11 @@ def apply_vertex_events(base: CsrMirror, events, schema_man,
             continue
         _part, vid, tag, _ver = KeyUtils.parse_vertex(ev[1])
         newest[(vid, tag)] = ev[2]
-    if not newest:
-        return True
-    # phase 1 — validate EVERYTHING before touching the mirror: a
-    # mid-batch decline after partial application would expose a torn
-    # view of one commit batch
     plan = []        # (dense, tag, tag_cols, present | None)
     for (vid, tag), blob in newest.items():
         dense = int(base.to_dense([vid])[0])
         if dense < 0 or tag not in base.has_tag:
-            return False
+            return None
         tag_cols = {cname: c for (t, cname), c in base.vertex_cols.items()
                     if t == tag}
         if not blob:
@@ -460,57 +505,33 @@ def apply_vertex_events(base: CsrMirror, events, schema_man,
                 blob, lambda ver, _t=tag: sm.get_tag_schema(space_id, _t,
                                                             ver))
         except KeyError:
-            return False
+            return None
         if _ttl_expiry(reader) is not None:
-            return False
+            return None
         present: Dict[str, object] = {}
         for cname in reader.schema.names():
             c = tag_cols.get(cname)
             if c is None:
-                return False            # schema drift: rebuild
+                return None             # schema drift: rebuild
             try:
                 present[cname] = reader.get(cname)
             except KeyError:
                 pass
         for cname, v in list(present.items()):
-            c = tag_cols[cname]
-            if c.stype == SupportedType.STRING:
-                if c.dictionary is None:
-                    return False
-                s = v if isinstance(v, str) else str(v)
-                pos = int(np.searchsorted(c.dictionary, s))
-                if pos >= len(c.dictionary) \
-                        or str(c.dictionary[pos]) != s:
-                    return False        # new string: dictionary grows
-                present[cname] = (s, pos)   # (raw, code) to store
-                continue
-            if c.values.dtype == np.int64 and c.device_ok:
-                if c._int32_ok is None:
-                    if len(c.values):
-                        lo, hi = int(c.values.min()), int(c.values.max())
-                        c._int32_ok = -2**31 < lo and hi < 2**31
-                    else:
-                        c._int32_ok = True
-                if c._int32_ok:
-                    # device serves this column as int32 — the write
-                    # must keep that representation
-                    if not (-2**31 < int(v) < 2**31):
-                        return False
-                else:
-                    # device serves it as float32 (every value round-
-                    # trips) — the write must round-trip too, or
-                    # device/CPU comparisons diverge at the boundary
-                    if int(np.int64(np.float32(v))) != int(v):
-                        return False
-            if c.values.dtype == np.float64 and c.device_ok:
-                f32 = np.float32(v)
-                if float(np.float64(f32)) != float(v):
-                    return False
+            absorbed = tag_cols[cname].absorb_form(v)
+            if absorbed is _NO_ABSORB:
+                return None
+            present[cname] = absorbed
         plan.append((dense, tag, tag_cols, present))
-    # phase 2 — apply.  Values first, validity flags LAST: a reader
-    # racing the absorption then sees each column as either its old
-    # state (stale valid bit) or its new state (fresh value + fresh
-    # bit) — never valid=True over a not-yet-written value
+    return plan
+
+
+def commit_vertex_plan(base: CsrMirror, plan) -> None:
+    """Apply a plan_vertex_events plan IN PLACE.  Values first,
+    validity flags LAST: a reader racing the absorption then sees each
+    column as either its old state (stale valid bit) or its new state
+    (fresh value + fresh bit) — never valid=True over a not-yet-written
+    value."""
     for dense, tag, tag_cols, present in plan:
         if present is None:
             # the newest committed row is empty: it REPLACES the old
@@ -531,9 +552,8 @@ def apply_vertex_events(base: CsrMirror, events, schema_man,
                 c.valid[dense] = cname in present
         base.has_tag[tag][dense] = True
     # grown-space vertex copies (extras cache) are now stale
-    if getattr(base, "_ext_vertex_cache", None) is not None:
+    if plan and getattr(base, "_ext_vertex_cache", None) is not None:
         base._ext_vertex_cache = None
-    return True
 
 
 def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:

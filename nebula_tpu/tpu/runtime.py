@@ -370,23 +370,34 @@ class TpuQueryRuntime:
                 return None          # opaque ops / trimmed log
             new_events.extend(evs)
             cursors[i] = now_v
-        total = m._delta_kvs + new_events
-        if len(total) > int(flags.get("mirror_delta_max") or 4096):
+        # vput events are consumed by the in-place commit below; only
+        # EDGE events persist in (and count against) the delta budget
+        edge_events = m._delta_kvs + [e for e in new_events
+                                      if e[0] != "vput"]
+        if len(edge_events) > int(flags.get("mirror_delta_max") or 4096):
             return None              # compaction point: full rebuild
-        from .csr import apply_vertex_events, build_delta_mirror
-        # vertex-row writes apply IN PLACE to the base (numeric props
-        # only — csr.apply_vertex_events documents the guards); only
-        # the NEW events apply, earlier ones already did
-        if not apply_vertex_events(m, new_events, self.sm, space_id):
+        from .csr import (build_delta_mirror, commit_vertex_plan,
+                          plan_vertex_events)
+        # ORDER MATTERS for commit atomicity: plan the vertex writes
+        # (no mutation), build the edge overlay (pure), and only when
+        # NOTHING can decline anymore commit the in-place vertex plan —
+        # a decline after mutating would expose half of a commit batch
+        # (the device-side analogue of the torn-scan guard)
+        vplan = plan_vertex_events(m, new_events, self.sm, space_id)
+        if vplan is None:
             return None
-        d = build_delta_mirror(m, total, self.sm, space_id) if total \
-            else None
-        if total and d is None:
+        d = build_delta_mirror(m, edge_events, self.sm, space_id) \
+            if edge_events else None
+        if edge_events and d is None:
             return None
-        # vput events are fully consumed by the in-place apply — keeping
-        # them would burn mirror_delta_max budget and re-scan dead
-        # events on every absorption
-        m._delta_kvs = [e for e in total if e[0] != "vput"]
+        if vplan and d is not None \
+                and getattr(d, "remap_from_base", None) is not None:
+            # grown-space overlays carry COPIES of the vertex columns,
+            # built before the commit below would land — serving them
+            # would show stale vertex props; rebuild instead
+            return None
+        commit_vertex_plan(m, vplan)
+        m._delta_kvs = edge_events
         if d is not None and (d.m > 0 or len(d.base_dead)):
             m._delta = d
             m._delta_gen += 1
@@ -849,8 +860,15 @@ class TpuQueryRuntime:
         family (same OVER set + steps): the sparse c0 ladder rungs and
         the dense batch widths the first live query didn't hit.  A new
         shape's first XLA compile costs seconds and lands as a p99
-        spike on fresh clusters; compiling off-thread while the first
-        shape serves removes it.  One shot per (mirror, family)."""
+        spike on fresh clusters.
+
+        AOT-only: each shape is ``lower(...).compile()``d on shape
+        specs — NO device execution and no transfers (an earlier
+        version EXECUTED the warm shapes, and the dense pulls stole
+        whole seconds of device time from live batches mid-burst).
+        The compiled binary lands in the persistent XLA cache
+        (jax_setup), so the live first call of the shape deserializes
+        instead of compiling.  One shot per (mirror, family)."""
         if not flags.get("tpu_prewarm_kernels"):
             return
         key = (et_tuple, steps)
@@ -863,7 +881,7 @@ class TpuQueryRuntime:
 
         def run():
             try:
-                import jax.numpy as jnp
+                import jax
                 from .ell import (make_batched_go_kernel,
                                   make_batched_sparse_go_kernel,
                                   sparse_caps)
@@ -873,6 +891,7 @@ class TpuQueryRuntime:
                 qmax = int(flags.get("go_batch_max") or 1024)
                 hub = self._hub_dev(m, ix)
                 args = ix.kernel_args()
+                i32 = jax.ShapeDtypeStruct
                 ladder = [int(x) for x in
                           str(flags.get("tpu_sparse_c0s") or
                               "256,2048").split(",") if x.strip()]
@@ -886,11 +905,8 @@ class TpuQueryRuntime:
                          caps, qmax),
                         lambda: make_batched_sparse_go_kernel(
                             ix, steps, et_tuple, caps, qmax=qmax))
-                    ids = np.full(c0, ix.n_rows, np.int32)
-                    qid = np.zeros(c0, np.int32)
-                    # the call is what compiles; result discarded
-                    np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid),
-                                    hub, *args[1:]))
+                    kern.lower(i32((c0,), np.int32), i32((c0,), np.int32),
+                               hub, *args[1:]).compile()
                 for B in sorted(int(w) for w in
                                 str(flags.get("go_batch_widths") or
                                     "128,1024").split(",") if w.strip()):
@@ -900,10 +916,8 @@ class TpuQueryRuntime:
                         ("ell_go", ix.shape_sig(), et_tuple, steps),
                         lambda: make_batched_go_kernel(
                             ix, steps, et_tuple, pack=True))
-                    f0 = self._upload_frontier(
-                        ix, np.zeros(0, np.int32), np.zeros(0, np.int32),
-                        B)
-                    np.asarray(kern(f0, *args))
+                    kern.lower(i32((ix.n_rows + 1, B), np.int8),
+                               *args).compile()
             except Exception:   # noqa: BLE001 — pre-warm must never
                 pass            # disturb serving
 

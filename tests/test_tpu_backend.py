@@ -630,3 +630,91 @@ class TestIncrementalDelta:
             assert r.rows and "117" in r.rows[0][0]
         finally:
             c.stop()
+
+
+class TestColumnarInterimSeams:
+    """Device-served GO results are ColumnarRows (lazy columns); every
+    downstream consumer — pipes, $var, ORDER BY, GROUP BY, LIMIT, set
+    ops — must read them identically to plain row lists (parity with
+    the CPU path pins it)."""
+
+    def _boot(self):
+        from nebula_tpu.common.flags import flags
+        flags.set("storage_backend", "tpu")
+        c = LocalCluster(num_storage=1, tpu_backend=True)
+        cl = c.client()
+
+        def ok(s):
+            r = cl.execute(s)
+            assert r.ok(), f"{s}: {r.error_msg}"
+            return r
+        ok("CREATE SPACE ci(partition_num=4)")
+        c.refresh_all()
+        ok("USE ci")
+        ok("CREATE EDGE e(w int)")
+        c.refresh_all()
+        ok("INSERT EDGE e(w) VALUES 1->2:(5), 1->3:(9), 2->4:(7), "
+           "3->4:(1), 4->1:(3)")
+        return c, ok
+
+    @staticmethod
+    def _parity(c, ok, q, expect_rows=None):
+        from nebula_tpu.common.flags import flags
+        rt = c.tpu_runtime
+        dev0 = rt.stats["go_device"]
+        a = [tuple(r) for r in ok(q).rows]
+        assert rt.stats["go_device"] > dev0, f"device did not serve: {q}"
+        flags.set("storage_backend", "cpu")
+        b = [tuple(r) for r in ok(q).rows]
+        flags.set("storage_backend", "tpu")
+        assert a == b, (q, a, b)
+        if expect_rows is not None:
+            assert a == expect_rows, (q, a)
+        return a
+
+    def test_pipe_order_by_limit(self):
+        c, ok = self._boot()
+        try:
+            self._parity(
+                c, ok,
+                "GO FROM 1 OVER e YIELD e._dst AS id, e.w AS w | "
+                "ORDER BY $-.w DESC | LIMIT 1",
+                expect_rows=[(3, 9)])
+        finally:
+            c.stop()
+
+    def test_pipe_group_by_aggregate(self):
+        c, ok = self._boot()
+        try:
+            rows = self._parity(
+                c, ok,
+                "GO FROM 1, 2, 3 OVER e YIELD e._dst AS id, e.w AS w | "
+                "GROUP BY $-.id YIELD $-.id AS id, count(1) AS n, "
+                "sum($-.w) AS s")
+            assert sorted(rows) == [(2, 1, 5), (3, 1, 9), (4, 2, 8)]
+        finally:
+            c.stop()
+
+    def test_var_assignment_and_set_op(self):
+        c, ok = self._boot()
+        try:
+            from nebula_tpu.common.flags import flags
+            rt = c.tpu_runtime
+            dev0 = rt.stats["go_device"]
+            r = ok("$a = GO FROM 1 OVER e YIELD e._dst AS id; "
+                   "GO FROM $a.id OVER e YIELD e._dst")
+            assert rt.stats["go_device"] > dev0
+            got = sorted(map(tuple, r.rows))
+            flags.set("storage_backend", "cpu")
+            r2 = ok("$a = GO FROM 1 OVER e YIELD e._dst AS id; "
+                    "GO FROM $a.id OVER e YIELD e._dst")
+            flags.set("storage_backend", "tpu")
+            assert got == sorted(map(tuple, r2.rows))
+            assert got == [(4,), (4,)]
+            u = self._parity(
+                c, ok,
+                "GO FROM 1 OVER e YIELD e._dst AS id UNION "
+                "GO FROM 2 OVER e YIELD e._dst AS id")
+            assert sorted(u) == [(2,), (3,), (4,)]
+        finally:
+            c.stop()
