@@ -148,19 +148,33 @@ class ColumnarRows:
             if isinstance(c, ConstCol):
                 specs.append({"c": c.val})
             elif isinstance(c, DictCol):
-                codes = np.ascontiguousarray(c.codes)
-                specs.append({"dd": str(codes.dtype),
+                # explicit little-endian on the wire (matching the flat
+                # getBound chunks' pinned "<i8"/"<f8" convention) — a
+                # native-endian dtype string like "int64" would silently
+                # mis-decode on a cross-endian peer
+                codes = _to_le(np.ascontiguousarray(c.codes))
+                specs.append({"dd": codes.dtype.str,
                               "db": codes.tobytes(),
                               "dv": list(c.dictionary)})
             elif isinstance(c, np.ndarray):
-                a = np.ascontiguousarray(c)
-                specs.append({"d": str(a.dtype), "b": a.tobytes()})
+                a = _to_le(np.ascontiguousarray(c))
+                specs.append({"d": a.dtype.str, "b": a.tobytes()})
             else:
                 specs.append({"l": list(c)})
         return {"__ncols__": {"n": self._n, "cols": specs}}
 
     def __repr__(self) -> str:
         return f"ColumnarRows({self._n} rows)"
+
+
+def _to_le(a):
+    """Little-endian view/copy of a numpy array for the wire (bool and
+    1-byte dtypes pass through; '=' byte order is resolved first)."""
+    import numpy as np
+    if a.dtype.itemsize == 1:
+        return a
+    le = a.dtype.newbyteorder("<")
+    return a.astype(le) if a.dtype != le else a
 
 
 def rows_from_wire(rows):

@@ -162,15 +162,22 @@ class Column:
                     or str(self.dictionary[pos]) != s:
                 return _NO_ABSORB       # new string: dictionary grows
             return (s, pos)
-        if self.values.dtype == np.int64 and self.device_ok:
-            if self._is_int32_representable():
-                if not (-2**31 < int(v) < 2**31):
+        try:
+            if self.values.dtype == np.int64 and self.device_ok:
+                if self._is_int32_representable():
+                    if not (-2**31 < int(v) < 2**31):
+                        return _NO_ABSORB
+                elif int(np.int64(np.float32(v))) != int(v):
                     return _NO_ABSORB
-            elif int(np.int64(np.float32(v))) != int(v):
-                return _NO_ABSORB
-        if self.values.dtype == np.float64 and self.device_ok:
-            if float(np.float64(np.float32(v))) != float(v):
-                return _NO_ABSORB
+            if self.values.dtype == np.float64 and self.device_ok:
+                if float(np.float64(np.float32(v))) != float(v):
+                    return _NO_ABSORB
+        except (OverflowError, ValueError):
+            # e.g. int64-max values where np.float32 rounds UP to 2^63
+            # and the int64() round-trip overflows (raises on NumPy 2):
+            # any conversion failure means "can't absorb", never an
+            # exception escaping into the live query's mirror() call
+            return _NO_ABSORB
         return v
 
     def host_value(self, i: int):
@@ -294,7 +301,7 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
     """
     sm = schema_man
     # collapse in commit order: the last event per edge identity wins
-    # (vertex events are applied in place by apply_vertex_events, not
+    # (vertex events are applied in place by plan_vertex_events + commit_vertex_plan, not
     # through the edge overlay)
     final: Dict[Tuple[int, int, int, int], Optional[bytes]] = {}
     for ev in events:

@@ -259,7 +259,7 @@ class TpuQueryRuntime:
                     and not m.expired_now():
                 return m
             if m is not None and not m.expired_now():
-                d = self._try_delta(space_id, m, ver, stores)
+                d = self._try_delta(space_id, m, ver, stores, vers)
                 if d is not None:
                     return d
             if m is not None and flags.get("mirror_refresh_mode") == "async":
@@ -342,13 +342,19 @@ class TpuQueryRuntime:
         return m
 
     def _try_delta(self, space_id: int, m: CsrMirror, ver: int,
-                   stores=None) -> Optional[CsrMirror]:
+                   stores=None, vers: Optional[List[int]] = None
+                   ) -> Optional[CsrMirror]:
         """Absorb committed pure-edge-insert mutations into an overlay
         mirror instead of the O(m) rebuild (SURVEY §7 hard part (a));
         None = can't, caller falls back to the rebuild path.  Caller
-        holds the lock."""
+        holds the lock, so ``vers`` (per-store versions the caller
+        captured OUTSIDE the lock) must be passed for remote-backed
+        spaces — a mutation_version RPC issued here would stall every
+        space's dispatch behind a slow peer."""
         if stores is None:
             stores = self._stores_for(space_id)
+        if vers is None:
+            vers = self._store_versions(space_id, stores)
         if getattr(m, "_delta_cursors", None) is None:
             return None
         if flags.get("tpu_filter_mode") == "device" \
@@ -362,7 +368,7 @@ class TpuQueryRuntime:
         new_events = []
         cursors = dict(m._delta_cursors)
         for i, s in enumerate(stores):
-            now_v = s.mutation_version(space_id)
+            now_v = vers[i]
             if now_v == cursors[i]:
                 continue
             evs = s.delta_since(space_id, cursors[i])
@@ -1188,6 +1194,23 @@ class TpuQueryRuntime:
         CPU executor's exact precision."""
         if len(idx) == 0:
             return np.zeros(0, dtype=bool)
+        # pushed-mode validity is snapshotted BEFORE the value gather:
+        # commit_vertex_plan absorbs in place values-first/valid-last,
+        # so a reader must never hold a valid bit fresher than the
+        # value it gates (stale-valid over fresh-value only hides a
+        # just-committed row — the same bounded staleness a racing scan
+        # has; fresh-valid over stale-value would serve garbage)
+        valid_snap: Dict[str, np.ndarray] = {}
+        if plan.pushed_mode:
+            for k, desc in plan.filter_used.items():
+                if desc[0] == "edge":
+                    valid_snap[k] = \
+                        m.edge_cols[(desc[1], desc[2])].valid[idx]
+                elif desc[0] == "vertex":
+                    gather = m.edge_src[idx] if desc[3] == "src" \
+                        else m.edge_dst[idx]
+                    valid_snap[k] = \
+                        m.vertex_cols[(desc[1], desc[2])].valid[gather]
         env = Env(np, self._gather_cols(m, plan.alias_to_etype,
                                         plan.filter_used, idx))
         with np.errstate(divide="ignore", invalid="ignore"):
@@ -1205,14 +1228,8 @@ class TpuQueryRuntime:
                 # declines div guards in graphd/remnant mode)
                 mask &= ~np.broadcast_to(np.asarray(g(env)), idx.shape)
         if plan.pushed_mode:
-            for k, desc in plan.filter_used.items():
-                if desc[0] == "edge":
-                    mask &= m.edge_cols[(desc[1], desc[2])].valid[idx]
-                elif desc[0] == "vertex":
-                    col = m.vertex_cols[(desc[1], desc[2])]
-                    gather = m.edge_src[idx] if desc[3] == "src" \
-                        else m.edge_dst[idx]
-                    mask &= col.valid[gather]
+            for k in valid_snap:
+                mask &= valid_snap[k]
         return mask
 
     # -------------------------------------------------- kernel dispatch
@@ -1293,16 +1310,17 @@ class TpuQueryRuntime:
         import jax.numpy as jnp
         env: Dict[str, object] = {}
         for k, desc in used.items():
-            if desc[0] == "edge":
-                col = m.edge_cols[(desc[1], desc[2])]
-                env[k] = jnp.asarray(col.device_values())
+            if desc[0] in ("edge", "vertex"):
+                col = m.edge_cols[(desc[1], desc[2])] \
+                    if desc[0] == "edge" \
+                    else m.vertex_cols[(desc[1], desc[2])]
+                # valid is snapshotted BEFORE the values are read:
+                # in-place absorption commits values-first/valid-last
+                # (csr.commit_vertex_plan), so validity read here must
+                # never be fresher than the value it gates
                 if with_valid:
-                    env["valid:" + k] = jnp.asarray(col.valid)
-            elif desc[0] == "vertex":
-                col = m.vertex_cols[(desc[1], desc[2])]
+                    env["valid:" + k] = jnp.asarray(col.valid.copy())
                 env[k] = jnp.asarray(col.device_values())
-                if with_valid:
-                    env["valid:" + k] = jnp.asarray(col.valid)
             elif desc[0] == "rank":
                 env["rank"] = m._device["rank"]
             elif desc[0] == "etype_alias":

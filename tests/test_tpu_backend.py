@@ -577,7 +577,7 @@ class TestIncrementalDelta:
 
     def test_vertex_numeric_prop_update_absorbed(self):
         """A numeric tag-prop update on a known vertex applies to the
-        mirror IN PLACE (csr.apply_vertex_events) — no rebuild, and
+        mirror IN PLACE (csr.commit_vertex_plan) — no rebuild, and
         device-served $^-filtered queries see the fresh value."""
         c, cl, ok = self._boot()
         try:
