@@ -271,14 +271,32 @@ class EllIndex:
                       for nbr in self.bucket_nbr))
 
     def hub_table(self) -> np.ndarray:
-        """bool[n+1]: vertex owns hub extra rows (slot spill) — such a
-        vertex forces sparse/adaptive kernels onto the dense path
-        because a push from its main row alone would miss the spilled
-        slots."""
+        """bool[n+1]: vertex owns hub extra rows (slot spill) — the
+        adaptive single-query kernel switches to the dense pull when
+        one enters its frontier, because a push from the main row
+        alone would miss the spilled slots.  (The batched sparse
+        kernel instead EXPANDS hubs into their extra rows on device —
+        hub_expansion below.)"""
         is_hub = np.zeros(self.n + 1, dtype=bool)
         if len(self.extra_owner):
             is_hub[np.unique(self.extra_owner)] = True
         return is_hub
+
+    def hub_expansion(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(ecnt int32[n+1], e0 int32[n+1]): per-vertex extra-row run —
+        hub vertex v owns rows [e0[v], e0[v] + ecnt[v]) in addition to
+        its main row v (extras of one owner are contiguous by
+        construction: EllIndex.build appends them in owner order).
+        Non-hubs: ecnt 0, e0 n_rows.  The batched sparse kernel uses
+        this to push out of a hub's spilled slots exactly."""
+        ecnt = np.zeros(self.n + 1, np.int32)
+        e0 = np.full(self.n + 1, self.n_rows, np.int32)
+        if len(self.extra_owner):
+            owners, first = np.unique(self.extra_owner, return_index=True)
+            cnts = np.bincount(self.extra_owner, minlength=self.n)
+            ecnt[:self.n] = cnts[:self.n].astype(np.int32)
+            e0[owners] = (self.n + first).astype(np.int32)
+        return ecnt, e0
 
     def kernel_args(self):
         """The device arrays every args-style kernel takes positionally:
@@ -340,13 +358,18 @@ def pack_bits(jnp, x):
     """[R, B] truthy -> bit-packed uint8 [ceil(R/8), B] (row-major bits,
     little bit order — np.unpackbits(bitorder="little") inverts it).
     Fused into kernels so the device->host transfer shrinks 8x; over a
-    remote-tunnel link the transfer, not the compute, dominated."""
+    remote-tunnel link the transfer, not the compute, dominated.
+
+    All-uint8 arithmetic: products are <= 128 and the 8-term sum < 256,
+    so uint8 accumulation is exact — int32 intermediates here cost
+    GIGABYTES of HLO temp at 10M+-row frontiers (measured: the
+    int32 version OOM'd a 16.7M-row B=256 pack on v5e)."""
     R1, B = x.shape
     G = -(-R1 // 8)
-    padded = jnp.pad((x > 0).astype(jnp.int32), ((0, G * 8 - R1), (0, 0)))
-    w = jnp.asarray((1 << np.arange(8)).astype(np.int32))
+    padded = jnp.pad((x > 0).astype(jnp.uint8), ((0, G * 8 - R1), (0, 0)))
+    w = jnp.asarray((1 << np.arange(8)).astype(np.uint8))
     return jnp.sum(padded.reshape(G, 8, B) * w[None, :, None],
-                   axis=1).astype(jnp.uint8)
+                   axis=1, dtype=jnp.uint8)
 
 
 def unpack_bits(packed: np.ndarray, R1: int) -> np.ndarray:
@@ -452,12 +475,21 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
     of magnitude less device work AND the result transfer is the pair
     list, not a bitmap.
 
-    Exactness: overflow past ``caps[h]`` or any frontier contact with a
-    hub vertex (slot spill rows the push can't see) sets the overflow
-    flag; the caller MUST rerun the batch on the dense kernel then.
+    Hub vertices (slot spill: extra rows in the cap bucket) are pushed
+    EXACTLY: before each hop's gather, every frontier vertex expands
+    into its extra-row run ((ecnt, e0) from EllIndex.hub_expansion) via
+    a bounded segmented-iota, so the gather sees the spilled slots too.
+    The expansion budget per hop equals the hop's pair cap; exceeding
+    it (a frontier touching hubs with more total extra rows than the
+    cap) sets the overflow flag — exactness, never correctness, is the
+    only thing capacity tuning trades.
+
+    Overflow past ``caps[h]`` (deduped pairs) or past the hub budget
+    sets the overflow flag; the caller MUST rerun the batch on the
+    dense kernel then.
 
     fn(ids int32[caps[0]] new-id space (sentinel n_rows = inactive),
-       qid int32[caps[0]], hub bool[n+1], *tables) ->
+       qid int32[caps[0]], ecnt int32[n+1], e0 int32[n+1], *tables) ->
     int32 [2 + 2*caps[-1]]: [count, overflow, qids..., ids...] with the
     live pairs sorted by (qid, id) — a single array so the host pays one
     transfer."""
@@ -468,6 +500,7 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
     neg = tuple(-t for t in etypes)
     d_max = max(ell.bucket_D) if ell.bucket_D else 1
     nb_count = len(ell.bucket_nbr)
+    has_hubs = len(ell.extra_owner) > 0
     bstarts = []
     acc = 0
     for nbr_np in ell.bucket_nbr:
@@ -485,15 +518,65 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
     pack32 = qmax * R1 <= 2**31 - 1
     I32_MAX = jnp.int32(2**31 - 1)
 
-    def hop(ids, qid, hub, nbrs, ets, c_out, check_hub):
+    def expand_hubs(ids, qid, ecnt, e0, EX):
+        """Bounded hub expansion: (q, v) pairs -> up to EX extra-row
+        pairs (q, e) covering every frontier hub's spilled slot rows.
+        Segmented-iota over the compacted hub runs: run r of vertex v
+        starts at output offset s_r = cumsum-exclusive of per-pair
+        extra counts and emits rows e0[v] + 0..ecnt[v]-1.  Dropped
+        runs (rank >= EX) imply total > EX, so they always coincide
+        with the overflow flag."""
+        raw = jnp.where(ids == sentinel, 0, ecnt[jnp.minimum(ids, n)])
+        # wrap-free budget check: int32 cumsum over unclamped counts
+        # could wrap past 2^31 on hub-heavy frontiers and silently
+        # CLEAR the overflow flag.  Clamp each count to c_lim (chosen
+        # so the clamped total cannot wrap) and flag any clamped entry
+        # directly — a single count > c_lim already exceeds any EX
+        # this kernel is built with, or is caught by the total check.
+        c_in_sz = raw.shape[0]
+        c_lim = jnp.int32(max(1, (2**31 - 1) // max(c_in_sz, 1)))
+        over_big = jnp.any(raw > c_lim)
+        cnt = jnp.minimum(raw, c_lim)
+        tot = jnp.cumsum(cnt)
+        total = tot[-1]
+        overflow = over_big | (total > EX)
+        s = (tot - cnt).astype(jnp.int32)
+        has = cnt > 0
+        rank = jnp.cumsum(has.astype(jnp.int32)) - 1
+        pos = jnp.where(has, rank, EX)
+        run_e0 = jnp.zeros((EX,), jnp.int32).at[pos].set(
+            e0[jnp.minimum(ids, n)], mode="drop")
+        run_q = jnp.full((EX,), BIG_Q).at[pos].set(qid, mode="drop")
+        run_s = jnp.full((EX,), jnp.int32(2**30)).at[pos].set(
+            s, mode="drop")
+        j = jnp.arange(EX, dtype=jnp.int32)
+        seg = jnp.searchsorted(run_s, j, side="right").astype(jnp.int32) - 1
+        segc = jnp.clip(seg, 0, EX - 1)
+        live = (j < jnp.minimum(total, EX)) & (seg >= 0)
+        rows = jnp.where(live, run_e0[segc] + (j - run_s[segc]),
+                         jnp.int32(sentinel))
+        qs = jnp.where(live, run_q[segc], BIG_Q)
+        return rows, qs, overflow
+
+    def hop(ids, qid, ecnt, e0, nbrs, ets, c_out):
         c_in = ids.shape[0]
-        cand = jnp.full((c_in, d_max), jnp.int32(sentinel))
+        if has_hubs:
+            # push sources = main rows + every frontier hub's extra
+            # rows, so a hub's spilled slots are visited exactly
+            ext_rows, ext_q, ovf_hub = expand_hubs(ids, qid, ecnt, e0,
+                                                   EX=c_in)
+            gids = jnp.concatenate([ids, ext_rows])
+            gqs = jnp.concatenate([qid, ext_q])
+        else:
+            gids, gqs, ovf_hub = ids, qid, jnp.bool_(False)
+        g_in = gids.shape[0]
+        cand = jnp.full((g_in, d_max), jnp.int32(sentinel))
         for nbr, et, bstart in zip(nbrs, ets, bstarts):
             nbk, D = nbr.shape
-            loc = ids - bstart
+            loc = gids - bstart
             inb = (loc >= 0) & (loc < nbk)
             safe = jnp.where(inb, loc, 0)
-            rows = nbr[safe]                      # [c_in, D] row-gathers
+            rows = nbr[safe]                      # [g_in, D] row-gathers
             ok = inb[:, None] & _etype_ok(jnp, et[safe], neg)
             block = jnp.where(ok, rows, sentinel)
             if D < d_max:
@@ -501,7 +584,7 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                                 constant_values=sentinel)
             cand = jnp.where(inb[:, None], block, cand)
         flat_i = cand.reshape(-1)
-        flat_q = jnp.repeat(qid, d_max)
+        flat_q = jnp.repeat(gqs, d_max)
         valid = flat_i != sentinel
         if pack32:
             key = jnp.where(valid, flat_q * R1 + flat_i, I32_MAX)
@@ -531,27 +614,18 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             out_i = jnp.full((c_out,), jnp.int32(sentinel)) \
                 .at[pos].set(si, mode="drop")
             out_i = jnp.where(out_q == BIG_Q, sentinel, out_i)
-        overflow = cnt > c_out
-        if check_hub:
-            # hub contact invalidates the frontier only as a PUSH
-            # SOURCE (a hub's own slots are incomplete in its main
-            # row); the final hop's output is assembled host-side from
-            # the complete CSR, so it may freely contain hubs
-            touched_hub = jnp.any(hub[jnp.minimum(out_i, n)]
-                                  & (out_i != sentinel))
-            overflow = overflow | touched_hub
+        overflow = (cnt > c_out) | ovf_hub
         return out_i, out_q, overflow, cnt
 
     @jax.jit
-    def go(ids0, qid0, hub, *tables):
+    def go(ids0, qid0, ecnt, e0, *tables):
         nbrs, ets = tables[:nb_count], tables[nb_count:]
         ids, qid = ids0, jnp.where(ids0 == sentinel, BIG_Q, qid0)
-        overflow = jnp.any(hub[jnp.minimum(ids, n)] & (ids != sentinel))
+        overflow = jnp.bool_(False)
         cnt = jnp.sum(ids != sentinel).astype(jnp.int32)
         for h in range(max(steps - 1, 0)):
-            ids, qid, ovf_h, cnt = hop(ids, qid, hub, nbrs, ets,
-                                       caps[h + 1],
-                                       check_hub=h < steps - 2)
+            ids, qid, ovf_h, cnt = hop(ids, qid, ecnt, e0, nbrs, ets,
+                                       caps[h + 1])
             overflow = overflow | ovf_h
         c_fin = caps[-1]
         if ids.shape[0] < c_fin:                 # steps == 1: pad up

@@ -764,8 +764,8 @@ class TpuQueryRuntime:
         order = np.lexsort((new, q_all))     # per-query ascending new-ids
         ids[:S] = new[order]
         qid[:S] = q_all[order]
-        hub = self._hub_dev(m, ix)
-        out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), hub,
+        ecnt, e0 = self._hub_expansion_dev(m, ix)
+        out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                        *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
 
@@ -895,7 +895,7 @@ class TpuQueryRuntime:
                 cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
                 growth = int(flags.get("tpu_sparse_growth") or 8)
                 qmax = int(flags.get("go_batch_max") or 1024)
-                hub = self._hub_dev(m, ix)
+                ecnt, e0 = self._hub_expansion_dev(m, ix)
                 args = ix.kernel_args()
                 i32 = jax.ShapeDtypeStruct
                 ladder = [int(x) for x in
@@ -912,7 +912,7 @@ class TpuQueryRuntime:
                         lambda: make_batched_sparse_go_kernel(
                             ix, steps, et_tuple, caps, qmax=qmax))
                     kern.lower(i32((c0,), np.int32), i32((c0,), np.int32),
-                               hub, *args[1:]).compile()
+                               ecnt, e0, *args[1:]).compile()
                 for B in sorted(int(w) for w in
                                 str(flags.get("go_batch_widths") or
                                     "128,1024").split(",") if w.strip()):
@@ -935,6 +935,17 @@ class TpuQueryRuntime:
         cached = getattr(m, "_hub_dev_cache", None)
         if cached is None:
             cached = m._hub_dev_cache = jnp.asarray(ix.hub_table())
+        return cached
+
+    def _hub_expansion_dev(self, m: CsrMirror, ix: EllIndex):
+        """(ecnt, e0) device arrays for the sparse kernel's exact hub
+        push (ell.EllIndex.hub_expansion), cached per mirror."""
+        import jax.numpy as jnp
+        cached = getattr(m, "_hub_exp_cache", None)
+        if cached is None:
+            ecnt, e0 = ix.hub_expansion()
+            cached = m._hub_exp_cache = (jnp.asarray(ecnt),
+                                         jnp.asarray(e0))
         return cached
 
     # ------------------------------------------------ host assembly

@@ -438,11 +438,11 @@ def test_sparse_batched_go_parity_random():
             ids[o:o + len(newi)] = newi
             qid[o:o + len(newi)] = q
             o += len(newi)
-        hub = jnp.asarray(ix.hub_table())
-        out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
-                              *ix.kernel_args()[1:]))
+        ecnt, e0 = (jnp.asarray(a) for a in ix.hub_expansion())
+        out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), ecnt,
+                              e0, *ix.kernel_args()[1:]))
         _cnt, overflow, qids, vnew = E.sparse_go_pairs(kern, out)
-        if overflow:    # overflow/hub reported — dense fallback covers it
+        if overflow:    # overflow reported — dense fallback covers it
             continue
         got = np.zeros((n, nq), bool)
         if len(qids):
@@ -452,14 +452,16 @@ def test_sparse_batched_go_parity_random():
     assert verified >= 2, "every trial overflowed; caps too tight to test"
 
 
-def test_sparse_hub_in_final_frontier_no_overflow():
-    """A hub vertex in the FINAL frontier must not force the dense
-    rerun — the final hop is assembled host-side from the complete
-    CSR; only push-source frontiers need hub-free slots."""
-    # chain: 0 -> 1 -> hub(2); hub has high in-degree so it spills
+def test_sparse_hub_push_exact():
+    """Hub vertices (slot-spill extra rows) are pushed EXACTLY by the
+    sparse kernel: the device expands every frontier hub into its
+    extra-row run before the gather, so a hub as a push source is no
+    longer an overflow condition (round-4 behavior) — the kernel's
+    answer must bit-match the dense pull."""
+    # chain: 0 -> 1 -> hub(2) -> {3..149}; hub spills at cap=16
     n = 200
-    es = [0, 1] + [i for i in range(3, 150)]
-    ed = [1, 2] + [2] * 147
+    es = [0, 1] + [2] * 147
+    ed = [1, 2] + [i for i in range(3, 150)]
     ee = [1] * len(es)
     es, ed, ee = (np.asarray(es, np.int32), np.asarray(ed, np.int32),
                   np.asarray(ee, np.int32))
@@ -467,23 +469,47 @@ def test_sparse_hub_in_final_frontier_no_overflow():
     ee2 = np.concatenate([ee, -ee])
     ix = E.EllIndex.build(es2, ed2, ee2, n, cap=16, min_d=4)
     assert len(ix.extra_owner) > 0
-    hub = jnp.asarray(ix.hub_table())
-    steps = 3        # 2 advances: 0 -> 1 -> hub; hub only in FINAL set
-    caps = E.sparse_caps(16, max(ix.bucket_D), steps, 1 << 12)
+    ecnt, e0 = (jnp.asarray(a) for a in ix.hub_expansion())
+    for steps in (3, 4):    # hub in final set; hub as a push SOURCE
+        caps = E.sparse_caps(64, max(ix.bucket_D), steps, 1 << 12)
+        kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
+        ids = np.full(caps[0], ix.n_rows, np.int32)
+        qid = np.zeros(caps[0], np.int32)
+        ids[0] = ix.perm[0]
+        out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), ecnt,
+                              e0, *ix.kernel_args()[1:]))
+        _cnt, overflow, qids, vids = E.sparse_go_pairs(kern, out)
+        assert not overflow, f"steps={steps}: hub push must not overflow"
+        got = np.zeros(n, bool)
+        got[ix.inv[vids]] = True
+        exp = ix.to_old(run_go(ix, steps, (1,),
+                               ix.start_frontier([np.asarray([0])],
+                                                 B=128)))[:, 0] > 0
+        np.testing.assert_array_equal(got, exp, err_msg=f"steps={steps}")
+
+
+def test_sparse_hub_expansion_overflow_reported():
+    """A frontier whose hubs carry more extra rows than the hop budget
+    must REPORT overflow (dense rerun), never drop slots silently."""
+    # one vertex with in-degree 8 at cap=4 -> extra rows; budget c0=4
+    # is smaller than the expansion
+    n = 40
+    es = list(range(1, 33))
+    ed = [0] * 32
+    ee = [1] * 32
+    es, ed, ee = (np.asarray(es, np.int32), np.asarray(ed, np.int32),
+                  np.asarray(ee, np.int32))
+    es2 = np.concatenate([es, ed]); ed2 = np.concatenate([ed, es])
+    ee2 = np.concatenate([ee, -ee])
+    ix = E.EllIndex.build(es2, ed2, ee2, n, cap=4, min_d=4)
+    assert len(ix.extra_owner) >= 4
+    ecnt, e0 = (jnp.asarray(a) for a in ix.hub_expansion())
+    steps = 2
+    caps = (4, 1 << 10)     # hub expansion (7 extras) exceeds EX=c0=4
     kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
     ids = np.full(caps[0], ix.n_rows, np.int32)
     qid = np.zeros(caps[0], np.int32)
-    ids[0] = ix.perm[0]
-    out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
+    ids[0] = ix.perm[0]     # start ON the hub
+    out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                           *ix.kernel_args()[1:]))
-    _cnt, overflow, _qids, vids = E.sparse_go_pairs(kern, out)
-    assert not overflow, "hub in final frontier must not overflow"
-    assert list(ix.inv[vids]) == [2]          # exactly the hub
-
-    # but a hub as a PUSH SOURCE (intermediate hop) must bail to dense
-    steps = 4        # 3 advances: hub is a source on the last advance
-    caps = E.sparse_caps(16, max(ix.bucket_D), steps, 1 << 12)
-    kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps)
-    out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), hub,
-                          *ix.kernel_args()[1:]))
-    assert out[1] == 1, "hub as push source must report overflow"
+    assert out[1] == 1, "hub expansion past the budget must overflow"
