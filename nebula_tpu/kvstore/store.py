@@ -265,6 +265,23 @@ class NebulaStore:
                                       ErrorCode.E_PART_NOT_FOUND)
         return p, Status.OK()
 
+    def engine_index_of_part(self, space_id: GraphSpaceID,
+                             part_id: PartitionID) -> Optional[int]:
+        """Index into the space's engine list that backs ``part_id`` —
+        bulk ingest generators name their files *.engineN.snap with
+        this so ingest() routes each file to exactly the engine whose
+        parts read it (tools/bulk_load.py)."""
+        sd = self.spaces.get(space_id)
+        if sd is None:
+            return None
+        p = sd.parts.get(part_id)
+        if p is None:
+            return None
+        for i, e in enumerate(sd.engines):
+            if e is p.engine:
+                return i
+        return None
+
     def part_ids(self, space_id: GraphSpaceID) -> List[PartitionID]:
         sd = self.spaces.get(space_id)
         return sorted(sd.parts) if sd else []

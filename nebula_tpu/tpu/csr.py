@@ -31,8 +31,15 @@ import numpy as np
 import time
 
 from ..codec.rows import RowReader
+from ..common.flags import flags
 from ..common.keys import KeyUtils
 from ..interface.common import Schema, SupportedType
+
+flags.define(
+    "mirror_bulk_build", True,
+    "CSR mirror builds use the vectorized bulk path (csr_bulk.py: "
+    "packed engine scans + native batch codec) when the native library "
+    "is available; off = always the per-row reference builder")
 
 
 def _now_s() -> float:
@@ -250,6 +257,25 @@ class CsrMirror:
     def has_vid(self, vid: int) -> bool:
         p = self.vid_rank(vid)
         return p < self.n and int(self.vids[p]) == vid
+
+
+def iter_leader_parts(space_id: int, stores):
+    """Yield (store, part_id) for every part this scan must fold: parts
+    sorted per store, leaders only, first claiming store wins (a stale
+    leadership claim mid-transfer must not fold a part twice).  The
+    SINGLE source of the part-selection rule shared by the per-row and
+    bulk mirror builders — their bit-identical contract depends on
+    scanning the same part set."""
+    folded: set = set()
+    for store in stores:
+        for part in sorted(store.part_ids(space_id)):
+            if part in folded:
+                continue
+            p = store.part(space_id, part)
+            if p is None or not p.is_leader():
+                continue
+            folded.add(part)
+            yield store, part
 
 
 def _scatter_bool(src: np.ndarray, remap: np.ndarray,
@@ -570,7 +596,26 @@ def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:
     ``stores`` — list of kvstore.store.NebulaStore (one per storage node;
     in-process the runtime sees them all — this is the storaged-side
     "CSR mirror fold" of SURVEY.md §7 step 5 run centrally).
+
+    Dispatch: the vectorized bulk builder (csr_bulk.py — packed engine
+    scans + native batch codec; the 10^8-row scale path) runs first and
+    must produce a bit-identical mirror; anything it can't take
+    verbatim falls through to the per-row reference flow below (which
+    doubles as the differential-test oracle, tests/test_csr_bulk.py).
     """
+    if flags.get("mirror_bulk_build"):
+        # scan/RPC failures propagate from here unchanged (the
+        # decline-to-CPU contract); a None return means "shape the bulk
+        # path doesn't take" and falls through to the per-row builder
+        from .csr_bulk import build_mirror_bulk
+        m = build_mirror_bulk(space_id, stores, schema_man)
+        if m is not None:
+            return m
+    return _build_mirror_slow(space_id, stores, schema_man)
+
+
+def _build_mirror_slow(space_id: int, stores, schema_man) -> CsrMirror:
+    """The per-row reference builder (see build_mirror)."""
     sm = schema_man
     edge_schema_cache: Dict[Tuple[int, int], Optional[Schema]] = {}
     tag_schema_cache: Dict[Tuple[int, int], Optional[Schema]] = {}
@@ -595,35 +640,24 @@ def build_mirror(space_id: int, stores, schema_man) -> CsrMirror:
     verts: List[Tuple[int, int, bytes]] = []            # vid,tag,val
     seen_edge_prev: Optional[Tuple[int, int, int, int]] = None
     seen_vert_prev: Optional[Tuple[int, int]] = None
-    folded_parts: set = set()
-    for store in stores:
-        for part in sorted(store.part_ids(space_id)):
-            if part in folded_parts:
-                # two stores claiming leadership of one part (stale
-                # claim mid-leader-transfer; local store listed first
-                # wins) must not fold its edges twice
-                continue
-            p = store.part(space_id, part)
-            if p is None or not p.is_leader():
-                continue
-            folded_parts.add(part)
-            seen_edge_prev = seen_vert_prev = None
-            for key, val in store.prefix(space_id, part,
-                                         KeyUtils.part_prefix(part)):
-                if KeyUtils.is_edge(key):
-                    _, src, et, rank, dst, _ = KeyUtils.parse_edge(key)
-                    ident = (src, et, rank, dst)
-                    if ident == seen_edge_prev:
-                        continue          # older version of same edge
-                    seen_edge_prev = ident
-                    edges.append((src, et, rank, dst, val))
-                elif KeyUtils.is_vertex(key):
-                    _, vid, tag, _ = KeyUtils.parse_vertex(key)
-                    ident = (vid, tag)
-                    if ident == seen_vert_prev:
-                        continue
-                    seen_vert_prev = ident
-                    verts.append((vid, tag, val))
+    for store, part in iter_leader_parts(space_id, stores):
+        seen_edge_prev = seen_vert_prev = None
+        for key, val in store.prefix(space_id, part,
+                                     KeyUtils.part_prefix(part)):
+            if KeyUtils.is_edge(key):
+                _, src, et, rank, dst, _ = KeyUtils.parse_edge(key)
+                ident = (src, et, rank, dst)
+                if ident == seen_edge_prev:
+                    continue          # older version of same edge
+                seen_edge_prev = ident
+                edges.append((src, et, rank, dst, val))
+            elif KeyUtils.is_vertex(key):
+                _, vid, tag, _ = KeyUtils.parse_vertex(key)
+                ident = (vid, tag)
+                if ident == seen_vert_prev:
+                    continue
+                seen_vert_prev = ident
+                verts.append((vid, tag, val))
 
     mirror = CsrMirror(space_id)
 

@@ -1,0 +1,457 @@
+"""Vectorized CSR mirror fold — the scale path of csr.build_mirror.
+
+The per-row builder (csr.py) walks a Python iterator over every KV pair
+and runs a Python RowReader per row; at 10^8-row spaces that is hours.
+This module folds the same scan into numpy + the native batch codec
+(native/codec.cc — the reference's dataman moved to a batch ABI,
+RowReaderBenchmark.cpp's cost center done one-column-across-N-rows):
+
+  1. each leader part's whole range arrives as ONE packed frame buffer
+     (engine scan — native/kv_engine.cc neb_scan_prefix keeps it a
+     single lock acquisition and a single memcpy stream);
+  2. neb_split_frames / neb_parse_keys turn the arena into flat numpy
+     key-field arrays (the order-preserving key codec of common/keys.py
+     decodes with two vector ops);
+  3. multi-version dedup is a shift-compare (keys sort
+     latest-version-first within an identity — same "first wins" the
+     reference applies while scanning RocksDB,
+     QueryBaseProcessor.inl:352-361);
+  4. property columns decode via neb_decode_field, one schema column
+     across all rows of an edge type / tag at once.
+
+Rows the batch codec cannot take verbatim — older schema versions,
+truncated rows (defaults!), undecodable blobs — fall back to the exact
+per-row RowReader flow of the slow builder, so the two builders are
+bit-identical by construction (tests/test_csr_bulk.py diffs them on
+adversarial fixtures).  Any structural surprise returns None and the
+caller runs the per-row builder instead.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..codec.rows import RowReader
+from ..common.keys import KeyUtils
+from ..interface.common import SupportedType
+from .csr import Column, CsrMirror, _now_s, _ttl_expiry
+
+_U64P = None  # lazily created ctypes pointer types
+_NUMERIC_I64 = (SupportedType.BOOL, SupportedType.INT, SupportedType.VID,
+                SupportedType.TIMESTAMP)
+
+
+def _ptrs():
+    global _U64P
+    if _U64P is None:
+        _U64P = {
+            "u8": ctypes.POINTER(ctypes.c_uint8),
+            "u64": ctypes.POINTER(ctypes.c_uint64),
+            "i64": ctypes.POINTER(ctypes.c_int64),
+            "i32": ctypes.POINTER(ctypes.c_int32),
+            "f64": ctypes.POINTER(ctypes.c_double),
+        }
+    return _U64P
+
+
+def _as(a: np.ndarray, kind: str):
+    return a.ctypes.data_as(_ptrs()[kind])
+
+
+def _packed_part_buffers(space_id: int, stores) -> List[bytes]:
+    """One packed (u32be klen | u32be vlen | k | v)* buffer per led
+    part; the part-selection rule is SHARED with the per-row builder
+    (csr.iter_leader_parts) — the bit-identical contract depends on
+    both scanning the same part set."""
+    import struct
+    from .csr import iter_leader_parts
+    out: List[bytes] = []
+    for store, part in iter_leader_parts(space_id, stores):
+        prefix = KeyUtils.part_prefix(part)
+        buf = None
+        p = store.part(space_id, part)
+        eng = getattr(p, "engine", None)
+        if eng is not None and hasattr(eng, "scan_prefix_packed"):
+            buf = eng.scan_prefix_packed(prefix)
+        if buf is None:
+            # engines without the packed scan (MemEngine, remote
+            # part views) stream rows; pack them once here so the
+            # downstream stays one code path
+            chunks: List[bytes] = []
+            for k, v in store.prefix(space_id, part, prefix):
+                chunks.append(struct.pack(">II", len(k), len(v)))
+                chunks.append(k)
+                chunks.append(v)
+            buf = b"".join(chunks)
+        out.append(buf)
+    return out
+
+
+class _Arena:
+    """The concatenated scan buffer plus its parsed key-field arrays."""
+
+    __slots__ = ("buf", "vo", "vl", "kind", "a", "b", "c", "d")
+
+    def __init__(self, buf, vo, vl, kind, a, b, c, d):
+        self.buf = buf          # np.uint8 contiguous
+        self.vo = vo            # value offsets into buf (uint64)
+        self.vl = vl            # value lengths (uint64)
+        self.kind = kind        # 1 vertex | 2 edge
+        self.a = a              # vid / src
+        self.b = b              # tag / etype
+        self.c = c              # - / rank
+        self.d = d              # - / dst
+
+    def blob(self, i: int) -> bytes:
+        o, l = int(self.vo[i]), int(self.vl[i])
+        return self.buf[o:o + l].tobytes()
+
+
+def _parse_arena(L, space_id: int, stores) -> Optional[_Arena]:
+    bufs = _packed_part_buffers(space_id, stores)
+    # copy part buffers into one preallocated arena, freeing each as it
+    # lands — a b"".join would hold a SECOND full copy of the scanned
+    # dataset at the peak (tens of GB at 10^8-row scale)
+    total = sum(len(b) for b in bufs)
+    buf = np.empty(total, dtype=np.uint8)
+    pos = 0
+    while bufs:
+        b0 = bufs.pop(0)
+        buf[pos:pos + len(b0)] = np.frombuffer(b0, dtype=np.uint8)
+        pos += len(b0)
+        del b0
+    cap = total // 32 + 2       # min frame: 8B header + 24B vertex key
+    ko = np.zeros(cap, np.uint64)
+    kl = np.zeros(cap, np.uint64)
+    vo = np.zeros(cap, np.uint64)
+    vl = np.zeros(cap, np.uint64)
+    nrows = int(L.neb_split_frames(_as(buf, "u8"), total, _as(ko, "u64"),
+                                   _as(kl, "u64"), _as(vo, "u64"),
+                                   _as(vl, "u64"), cap))
+    if nrows < 0:
+        return None             # corrupt framing: slow path decides
+    ko, kl = ko[:nrows], kl[:nrows]
+    vo, vl = vo[:nrows].copy(), vl[:nrows].copy()
+    kind = np.zeros(nrows, np.uint8)
+    part = np.zeros(nrows, np.int32)
+    a = np.zeros(nrows, np.int64)
+    b = np.zeros(nrows, np.int32)
+    c = np.zeros(nrows, np.int64)
+    d = np.zeros(nrows, np.int64)
+    ver = np.zeros(nrows, np.int64)
+    L.neb_parse_keys(_as(buf, "u8"), _as(ko, "u64"), _as(kl, "u64"),
+                     nrows, _as(kind, "u8"), _as(part, "i32"),
+                     _as(a, "i64"), _as(b, "i32"), _as(c, "i64"),
+                     _as(d, "i64"), _as(ver, "i64"))
+    return _Arena(buf, vo, vl, kind, a, b, c, d)
+
+
+def _dedup_first(*ident: np.ndarray) -> np.ndarray:
+    """bool keep-mask: first row of each consecutive identity run wins
+    (scan order sorts versions inverted, so first = latest)."""
+    n = len(ident[0])
+    keep = np.ones(n, dtype=bool)
+    if n > 1:
+        same = np.ones(n - 1, dtype=bool)
+        for f in ident:
+            same &= f[1:] == f[:-1]
+        keep[1:] = ~same
+    return keep
+
+
+def _edge_sort_order(src_d, etype, rank, dst_d) -> np.ndarray:
+    """Order matching the slow builder's
+    np.lexsort((dst_d, rank, etype, src_d)); single-key argsort on a
+    packed u64 when the common shapes allow (rank constant, id ranges
+    small) — several times faster at 10^8 rows."""
+    m = len(src_d)
+    if m and (rank == rank[0]).all():
+        ets = np.unique(etype)
+        be = max(int(ets.searchsorted(ets[-1]) + 1).bit_length(), 1)
+        n_hint = int(max(int(src_d.max()), int(dst_d.max()))) + 1
+        bd = max(n_hint.bit_length(), 1)
+        if bd + be + bd <= 63:
+            et_idx = ets.searchsorted(etype).astype(np.uint64)
+            key = ((src_d.astype(np.uint64) << np.uint64(be + bd))
+                   | (et_idx << np.uint64(bd))
+                   | dst_d.astype(np.uint64))
+            return np.argsort(key, kind="stable")
+    return np.lexsort((dst_d, rank, etype, src_d))
+
+
+def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
+                  schema_resolver, target_idx: np.ndarray,
+                  cols: Dict[str, Column], mirror: CsrMirror,
+                  is_vertex: bool, has_tag_row: Optional[np.ndarray]
+                  ) -> Optional[np.ndarray]:
+    """Decode all columns of ``schema`` for the arena ``rows`` of one
+    edge type / tag, writing into ``cols`` at ``target_idx`` positions.
+
+    Returns a drop-mask over ``rows`` (TTL-expired), or None for
+    structural trouble (caller falls back to the slow builder).
+    ``has_tag_row`` (vertex side) is set True per surviving row.
+    """
+    k = len(rows)
+    drop = np.zeros(k, dtype=bool)
+    if k == 0:
+        return drop
+    vo = arena.vo[rows]
+    vl = arena.vl[rows]
+    empty = vl == 0
+    nf = len(schema.columns)
+    types = np.asarray([int(col.type) for col in schema.columns],
+                       dtype=np.uint8)
+    expect_ver = int(schema.version)
+
+    field_i64: List[np.ndarray] = []
+    field_f64: List[np.ndarray] = []
+    field_so: List[np.ndarray] = []
+    field_sl: List[np.ndarray] = []
+    allv = np.ones(k, dtype=bool)      # every field decoded natively
+    for fi in range(nf):
+        oi = np.zeros(k, np.int64)
+        of = np.zeros(k, np.float64)
+        so = np.zeros(k, np.uint64)
+        sl = np.zeros(k, np.uint64)
+        va = np.zeros(k, np.uint8)
+        L.neb_decode_field(_as(arena.buf, "u8"), _as(vo, "u64"),
+                           _as(vl, "u64"), k, _as(types, "u8"), nf, fi,
+                           expect_ver, _as(oi, "i64"), _as(of, "f64"),
+                           _as(so, "u64"), _as(sl, "u64"), _as(va, "u8"))
+        allv &= va == 1
+        field_i64.append(oi)
+        field_f64.append(of)
+        field_so.append(so)
+        field_sl.append(sl)
+    fast = allv & ~empty
+    slow_rows = np.nonzero(~allv & ~empty)[0]
+
+    # ---- TTL on the fast rows (vectorized) ---------------------------
+    now = _now_s()
+    prop = schema.schema_prop
+    if prop.ttl_col and prop.ttl_duration:
+        ti = next((i for i, col in enumerate(schema.columns)
+                   if col.name == prop.ttl_col), -1)
+        if ti >= 0:
+            t = schema.columns[ti].type
+            if t in (SupportedType.INT, SupportedType.VID,
+                     SupportedType.TIMESTAMP):
+                base = field_i64[ti].astype(np.float64)
+            elif t in (SupportedType.FLOAT, SupportedType.DOUBLE):
+                base = field_f64[ti]
+            else:
+                base = None             # bool/string: no expiry
+            if base is not None:
+                exp = base + float(prop.ttl_duration)
+                expired = fast & (exp < now)
+                drop |= expired
+                fast = fast & ~expired
+                alive = exp[fast]
+                if len(alive):
+                    mirror.note_expiry(float(alive.min()))
+
+    # ---- write the fast rows into the columns ------------------------
+    tsel = target_idx[fast]
+    for fi, coldef in enumerate(schema.columns):
+        col = cols.get(coldef.name)
+        if col is None:
+            continue
+        if col.stype == SupportedType.STRING:
+            so, sl = field_so[fi], field_sl[fi]
+            buf = arena.buf
+            raw = col.raw
+            for r in np.nonzero(fast)[0].tolist():
+                o, l = int(so[r]), int(sl[r])
+                raw[int(target_idx[r])] = \
+                    buf[o:o + l].tobytes().decode()
+        elif col.stype == SupportedType.BOOL:
+            col.values[tsel] = field_i64[fi][fast] != 0
+        elif col.values.dtype == np.float64:
+            col.values[tsel] = field_f64[fi][fast]
+        else:
+            col.values[tsel] = field_i64[fi][fast]
+        col.valid[tsel] = True
+    if has_tag_row is not None:
+        has_tag_row[fast | empty] = True
+
+    # ---- per-row fallback: old versions / truncation / corruption ----
+    # replicates the slow builder's flow exactly (RowReader against the
+    # row's OWN schema version; truncated fields read as defaults)
+    for r in slow_rows.tolist():
+        blob = arena.blob(rows[r])
+        try:
+            reader = RowReader.from_resolver(blob, schema_resolver)
+        except KeyError:
+            # slow-path parity: vertex rows get no has_tag and no cols;
+            # edge rows stay in the arrays with no cols
+            continue
+        exp = _ttl_expiry(reader)
+        if exp is not None:
+            if exp < now:
+                if is_vertex:
+                    continue        # expired tag row: absent
+                drop[r] = True      # expired edge: drop the row
+                continue
+            mirror.note_expiry(exp)
+        if has_tag_row is not None:
+            has_tag_row[r] = True
+        ti = int(target_idx[r])
+        for cname in reader.schema.names():
+            col = cols.get(cname)
+            if col is None:
+                continue
+            try:
+                v = reader.get(cname)
+            except KeyError:
+                continue
+            if col.raw is not None:
+                col.raw[ti] = v if isinstance(v, str) else str(v)
+            else:
+                col.values[ti] = v
+            col.valid[ti] = True
+    return drop
+
+
+def build_mirror_bulk(space_id: int, stores, schema_man
+                      ) -> Optional[CsrMirror]:
+    """Vectorized equivalent of csr.build_mirror, or None when the
+    native codec is unavailable / the scan looks structurally wrong
+    (caller then runs the per-row builder)."""
+    from ..native import lib
+    L = lib()
+    if L is None or not hasattr(L, "neb_parse_keys"):
+        return None
+    sm = schema_man
+    arena = _parse_arena(L, space_id, stores)
+    if arena is None:
+        return None
+    if (arena.kind == 0).any():
+        return None                  # unknown key shapes: slow path
+
+    em = arena.kind == 2
+    vm = arena.kind == 1
+    e_rows = np.nonzero(em)[0]
+    v_rows = np.nonzero(vm)[0]
+
+    # multi-version dedup (first wins in scan order, per identity)
+    if len(e_rows):
+        keep_e = _dedup_first(arena.a[e_rows], arena.b[e_rows],
+                              arena.c[e_rows], arena.d[e_rows])
+        e_rows = e_rows[keep_e]
+    if len(v_rows):
+        keep_v = _dedup_first(arena.a[v_rows], arena.b[v_rows])
+        v_rows = v_rows[keep_v]
+
+    e_src = arena.a[e_rows]
+    e_dst = arena.d[e_rows]
+    mirror = CsrMirror(space_id)
+
+    # ---- dense vertex space (slow-path parity: endpoints of even
+    # TTL-dropped edges participate — the filter runs after) ----------
+    mirror.vids = np.unique(np.concatenate(
+        [arena.a[v_rows], e_src, e_dst])) if (len(v_rows) or len(e_rows)) \
+        else np.zeros(0, dtype=np.int64)
+    mirror.n = n = len(mirror.vids)
+
+    m = len(e_rows)
+    mirror.m = m
+    if m:
+        src_d = np.searchsorted(mirror.vids, e_src).astype(np.int32)
+        dst_d = np.searchsorted(mirror.vids, e_dst).astype(np.int32)
+        etype_a = arena.b[e_rows]
+        rank_a = arena.c[e_rows]
+        order = _edge_sort_order(src_d, etype_a, rank_a, dst_d)
+        mirror.edge_src = src_d[order]
+        mirror.edge_dst = dst_d[order]
+        mirror.edge_etype = etype_a[order].astype(np.int32)
+        mirror.edge_rank = rank_a[order]
+        e_rows_sorted = e_rows[order]
+
+        etypes_present = np.unique(mirror.edge_etype)
+        cols: Dict[Tuple[int, str], Column] = {}
+        schemas = {}
+        for et in etypes_present.tolist():
+            schema = sm.get_edge_schema(space_id, abs(et), -1)
+            schemas[et] = schema
+            if schema is None:
+                continue
+            for col in schema.columns:
+                cols[(et, col.name)] = Column(col.name, col.type, m)
+        keep = np.ones(m, dtype=bool)
+        for et in etypes_present.tolist():
+            schema = schemas[et]
+            if schema is None:
+                continue
+            grp = np.nonzero(mirror.edge_etype == et)[0]
+            et_cols = {name: c for (e2, name), c in cols.items()
+                       if e2 == et}
+
+            def resolver(ver, _et=abs(et)):
+                return sm.get_edge_schema(space_id, _et, ver)
+
+            drop = _decode_group(L, arena, e_rows_sorted[grp], schema,
+                                 resolver, grp, et_cols, mirror,
+                                 is_vertex=False, has_tag_row=None)
+            if drop is None:
+                return None
+            if drop.any():
+                keep[grp[drop]] = False
+        if not keep.all():
+            mirror.edge_src = mirror.edge_src[keep]
+            mirror.edge_dst = mirror.edge_dst[keep]
+            mirror.edge_etype = mirror.edge_etype[keep]
+            mirror.edge_rank = mirror.edge_rank[keep]
+            kept_idx = np.nonzero(keep)[0]
+            for c in cols.values():
+                c.valid = c.valid[keep]
+                if c.raw is not None:
+                    c.raw = [c.raw[j] for j in kept_idx]
+                else:
+                    c.values = c.values[keep]
+            m = len(mirror.edge_src)
+            mirror.m = m
+        for c in cols.values():
+            c.finalize()
+        mirror.edge_cols = cols
+        counts = np.bincount(mirror.edge_src, minlength=n)
+        mirror.row_ptr = np.concatenate(
+            [[0], np.cumsum(counts)]).astype(np.int32)
+    else:
+        mirror.row_ptr = np.zeros(n + 1, dtype=np.int32)
+
+    # ---- vertex (tag) columns ---------------------------------------
+    vcols: Dict[Tuple[int, str], Column] = {}
+    v_vid = arena.a[v_rows]
+    v_tag = arena.b[v_rows]
+    tag_ids = np.unique(v_tag).tolist() if len(v_rows) else []
+    for t in tag_ids:
+        schema = sm.get_tag_schema(space_id, t, -1)
+        if schema is None:
+            continue
+        for col in schema.columns:
+            vcols[(t, col.name)] = Column(col.name, col.type, n)
+        mirror.has_tag[t] = np.zeros(n, dtype=bool)
+    for t in tag_ids:
+        schema = sm.get_tag_schema(space_id, t, -1)
+        if schema is None:
+            continue
+        grp = np.nonzero(v_tag == t)[0]
+        di = np.searchsorted(mirror.vids, v_vid[grp]).astype(np.int64)
+        t_cols = {name: c for (t2, name), c in vcols.items() if t2 == t}
+        has_row = np.zeros(len(grp), dtype=bool)
+
+        def vresolver(ver, _t=t):
+            return sm.get_tag_schema(space_id, _t, ver)
+
+        drop = _decode_group(L, arena, v_rows[grp], schema, vresolver,
+                             di, t_cols, mirror, is_vertex=True,
+                             has_tag_row=has_row)
+        if drop is None:
+            return None
+        mirror.has_tag[t][di[has_row]] = True
+    for c in vcols.values():
+        c.finalize()
+    mirror.vertex_cols = vcols
+    return mirror

@@ -558,13 +558,24 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
         qs = jnp.where(live, run_q[segc], BIG_Q)
         return rows, qs, overflow
 
+    # hub-expansion budget: each of the batch's <= qmax queries can
+    # expand each of the graph's extra rows at most once, so
+    # n_extras_total * qmax is a TRUE upper bound — a nearly-hub-free
+    # graph then pays almost nothing per hop, instead of statically
+    # doubling every gather+sort (the kernel's cost center) just
+    # because one hub exists somewhere.  Rounded to a power of two for
+    # shape stability; capped at c_in (past that, overflow -> dense).
+    n_extras_total = len(ell.extra_owner)
+    ex_pow2 = 1 << max(n_extras_total * max(qmax, 1) - 1, 1).bit_length() \
+        if n_extras_total else 0
+
     def hop(ids, qid, ecnt, e0, nbrs, ets, c_out):
         c_in = ids.shape[0]
         if has_hubs:
             # push sources = main rows + every frontier hub's extra
             # rows, so a hub's spilled slots are visited exactly
             ext_rows, ext_q, ovf_hub = expand_hubs(ids, qid, ecnt, e0,
-                                                   EX=c_in)
+                                                   EX=min(c_in, ex_pow2))
             gids = jnp.concatenate([ids, ext_rows])
             gqs = jnp.concatenate([qid, ext_q])
         else:
