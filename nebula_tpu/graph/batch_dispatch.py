@@ -111,18 +111,34 @@ class GoBatchDispatcher:
                 # finally hands it back) must reset `dispatching`, or
                 # every future request on this key waits forever
                 st.dispatching = True
+                sem_held = False
                 try:
-                    window = float(flags.get("go_batch_window_ms") or 0)
-                    if window > 0:
-                        st.cond.release()
-                        try:
+                    # take the pipeline slot BEFORE snapshotting the
+                    # batch: while go_batch_inflight batches are already
+                    # on the device, arrivals pool in the queue and the
+                    # next leader takes them ALL — batching self-clocks
+                    # to the device's cadence with no timer and no idle
+                    # latency penalty (measured: avg batch 5 -> ~16 at
+                    # 16 request threads over a 100 ms-RTT link)
+                    st.cond.release()
+                    try:
+                        # any configured window runs BEFORE taking the
+                        # slot — sleeping while holding it would park
+                        # pipeline capacity the device could be using
+                        window = float(flags.get("go_batch_window_ms")
+                                       or 0)
+                        if window > 0:
                             time.sleep(window / 1000.0)
-                        finally:
-                            st.cond.acquire()
+                        self._inflight.acquire()
+                        sem_held = True
+                    finally:
+                        st.cond.acquire()
                     max_b = int(flags.get("go_batch_max") or 1024)
                     batch = st.queue[:max_b]
                     del st.queue[:max_b]
                 except BaseException:       # cond is held here
+                    if sem_held:
+                        self._inflight.release()
                     st.dispatching = False
                     st.cond.notify_all()
                     raise
@@ -157,9 +173,10 @@ class GoBatchDispatcher:
         method, space_id = key[0], key[1]
         n_errors = 0
         try:
-            fn = getattr(self.runtime, method)
-            self._inflight.acquire()
+            # the leader already holds an in-flight slot (acquired
+            # before the batch snapshot in submit_batched)
             try:
+                fn = getattr(self.runtime, method)
                 res = fn(space_id, [r.payload for r in batch], *key[2:])
                 if hasattr(res, "finish"):       # two-phase _Pending
                     release_leadership()

@@ -25,6 +25,19 @@ def _p(arr: np.ndarray, ptype):
     return arr.ctypes.data_as(ptype)
 
 
+def _blob_ptr(blob):
+    """uint8 pointer over a bytes object OR a contiguous numpy uint8
+    arena (the bulk mirror fold passes multi-GB arenas; converting to
+    bytes would copy them)."""
+    if isinstance(blob, np.ndarray):
+        return _p(blob, _U8P)
+    return ctypes.cast(ctypes.c_char_p(blob), _U8P)
+
+
+def _blob_len(blob) -> int:
+    return blob.nbytes if isinstance(blob, np.ndarray) else len(blob)
+
+
 def concat_blobs(blobs: List[bytes]) -> Tuple[bytes, np.ndarray, np.ndarray]:
     """-> (concatenated, offsets u64[n], lengths u64[n])."""
     lens = np.fromiter((len(b) for b in blobs), dtype=np.uint64,
@@ -53,10 +66,16 @@ class FieldColumns:
         self.blob = blob
 
     def strings(self) -> List[str]:
+        blob = self.blob
+        if isinstance(blob, np.ndarray):
+            def dec(off, ln):
+                return blob[int(off):int(off + ln)].tobytes().decode()
+        else:
+            def dec(off, ln):
+                return blob[int(off):int(off + ln)].decode()
         out = []
         for off, ln, ok in zip(self.str_off, self.str_len, self.valid):
-            out.append(self.blob[int(off):int(off + ln)].decode()
-                       if ok == 1 else "")
+            out.append(dec(off, ln) if ok == 1 else "")
         return out
 
 
@@ -72,7 +91,7 @@ def decode_field(blob: bytes, offs: np.ndarray, lens: np.ndarray,
         return res
     types = schema_types(schema)
     L.neb_decode_field(
-        ctypes.cast(ctypes.c_char_p(blob), _U8P), _p(offs, _U64P),
+        _blob_ptr(blob), _p(offs, _U64P),
         _p(lens, _U64P), n, _p(types, _U8P), len(types), field,
         schema.version, _p(res.i64, _I64P), _p(res.f64, _F64P),
         _p(res.str_off, _U64P), _p(res.str_len, _U64P), _p(res.valid, _U8P))
@@ -102,27 +121,31 @@ def parse_keys(blob: bytes, offs: np.ndarray,
     if n == 0:
         return out
     L.neb_parse_keys(
-        ctypes.cast(ctypes.c_char_p(blob), _U8P), _p(offs, _U64P),
+        _blob_ptr(blob), _p(offs, _U64P),
         _p(lens, _U64P), n, _p(out.kind, _U8P), _p(out.part, _I32P),
         _p(out.a, _I64P), _p(out.b, _I32P), _p(out.c, _I64P),
         _p(out.d, _I64P), _p(out.ver, _I64P))
     return out
 
 
-def split_frames(packed: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
-                                                  np.ndarray, np.ndarray]]:
-    """Split a packed (klen,vlen,k,v)* scan buffer -> key/value slices."""
+def split_frames(packed, min_frame_bytes: int = 8
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray, np.ndarray]]:
+    """Split a packed (klen,vlen,k,v)* scan buffer -> key/value slices.
+    ``min_frame_bytes`` tightens the row-capacity estimate (a storage
+    scan's smallest frame is 8B header + 24B vertex key = 32 — at
+    multi-GB arenas the default 8 would allocate 4x the offset
+    temp memory)."""
     L = lib()
     if L is None:
         return None
-    # capacity: every frame needs >= 8 bytes of header
-    cap = max(len(packed) // 8, 1)
+    cap = max(_blob_len(packed) // max(min_frame_bytes, 8), 1) + 1
     ko = np.zeros(cap, dtype=np.uint64)
     kl = np.zeros(cap, dtype=np.uint64)
     vo = np.zeros(cap, dtype=np.uint64)
     vl = np.zeros(cap, dtype=np.uint64)
     n = L.neb_split_frames(
-        ctypes.cast(ctypes.c_char_p(packed), _U8P), len(packed),
+        _blob_ptr(packed), _blob_len(packed),
         _p(ko, _U64P), _p(kl, _U64P), _p(vo, _U64P), _p(vl, _U64P), cap)
     if n < 0:
         return None

@@ -28,7 +28,6 @@ caller runs the per-row builder instead.
 """
 from __future__ import annotations
 
-import ctypes
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -36,28 +35,8 @@ import numpy as np
 from ..codec.rows import RowReader
 from ..common.keys import KeyUtils
 from ..interface.common import SupportedType
+from ..native import batch as NB
 from .csr import Column, CsrMirror, _now_s, _ttl_expiry
-
-_U64P = None  # lazily created ctypes pointer types
-_NUMERIC_I64 = (SupportedType.BOOL, SupportedType.INT, SupportedType.VID,
-                SupportedType.TIMESTAMP)
-
-
-def _ptrs():
-    global _U64P
-    if _U64P is None:
-        _U64P = {
-            "u8": ctypes.POINTER(ctypes.c_uint8),
-            "u64": ctypes.POINTER(ctypes.c_uint64),
-            "i64": ctypes.POINTER(ctypes.c_int64),
-            "i32": ctypes.POINTER(ctypes.c_int32),
-            "f64": ctypes.POINTER(ctypes.c_double),
-        }
-    return _U64P
-
-
-def _as(a: np.ndarray, kind: str):
-    return a.ctypes.data_as(_ptrs()[kind])
 
 
 def _packed_part_buffers(space_id: int, stores) -> List[bytes]:
@@ -109,7 +88,7 @@ class _Arena:
         return self.buf[o:o + l].tobytes()
 
 
-def _parse_arena(L, space_id: int, stores) -> Optional[_Arena]:
+def _parse_arena(space_id: int, stores) -> Optional[_Arena]:
     bufs = _packed_part_buffers(space_id, stores)
     # copy part buffers into one preallocated arena, freeing each as it
     # lands — a b"".join would hold a SECOND full copy of the scanned
@@ -122,30 +101,16 @@ def _parse_arena(L, space_id: int, stores) -> Optional[_Arena]:
         buf[pos:pos + len(b0)] = np.frombuffer(b0, dtype=np.uint8)
         pos += len(b0)
         del b0
-    cap = total // 32 + 2       # min frame: 8B header + 24B vertex key
-    ko = np.zeros(cap, np.uint64)
-    kl = np.zeros(cap, np.uint64)
-    vo = np.zeros(cap, np.uint64)
-    vl = np.zeros(cap, np.uint64)
-    nrows = int(L.neb_split_frames(_as(buf, "u8"), total, _as(ko, "u64"),
-                                   _as(kl, "u64"), _as(vo, "u64"),
-                                   _as(vl, "u64"), cap))
-    if nrows < 0:
+    # min storage frame: 8B header + 24B vertex key
+    split = NB.split_frames(buf, min_frame_bytes=32)
+    if split is None:
         return None             # corrupt framing: slow path decides
-    ko, kl = ko[:nrows], kl[:nrows]
-    vo, vl = vo[:nrows].copy(), vl[:nrows].copy()
-    kind = np.zeros(nrows, np.uint8)
-    part = np.zeros(nrows, np.int32)
-    a = np.zeros(nrows, np.int64)
-    b = np.zeros(nrows, np.int32)
-    c = np.zeros(nrows, np.int64)
-    d = np.zeros(nrows, np.int64)
-    ver = np.zeros(nrows, np.int64)
-    L.neb_parse_keys(_as(buf, "u8"), _as(ko, "u64"), _as(kl, "u64"),
-                     nrows, _as(kind, "u8"), _as(part, "i32"),
-                     _as(a, "i64"), _as(b, "i32"), _as(c, "i64"),
-                     _as(d, "i64"), _as(ver, "i64"))
-    return _Arena(buf, vo, vl, kind, a, b, c, d)
+    ko, kl, vo, vl = split
+    vo, vl = vo.copy(), vl.copy()
+    keys = NB.parse_keys(buf, ko, kl)
+    if keys is None:
+        return None
+    return _Arena(buf, vo, vl, keys.kind, keys.a, keys.b, keys.c, keys.d)
 
 
 def _dedup_first(*ident: np.ndarray) -> np.ndarray:
@@ -181,7 +146,7 @@ def _edge_sort_order(src_d, etype, rank, dst_d) -> np.ndarray:
     return np.lexsort((dst_d, rank, etype, src_d))
 
 
-def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
+def _decode_group(arena: _Arena, rows: np.ndarray, schema,
                   schema_resolver, target_idx: np.ndarray,
                   cols: Dict[str, Column], mirror: CsrMirror,
                   is_vertex: bool, has_tag_row: Optional[np.ndarray]
@@ -201,30 +166,15 @@ def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
     vl = arena.vl[rows]
     empty = vl == 0
     nf = len(schema.columns)
-    types = np.asarray([int(col.type) for col in schema.columns],
-                       dtype=np.uint8)
-    expect_ver = int(schema.version)
 
-    field_i64: List[np.ndarray] = []
-    field_f64: List[np.ndarray] = []
-    field_so: List[np.ndarray] = []
-    field_sl: List[np.ndarray] = []
+    fields: List[NB.FieldColumns] = []
     allv = np.ones(k, dtype=bool)      # every field decoded natively
     for fi in range(nf):
-        oi = np.zeros(k, np.int64)
-        of = np.zeros(k, np.float64)
-        so = np.zeros(k, np.uint64)
-        sl = np.zeros(k, np.uint64)
-        va = np.zeros(k, np.uint8)
-        L.neb_decode_field(_as(arena.buf, "u8"), _as(vo, "u64"),
-                           _as(vl, "u64"), k, _as(types, "u8"), nf, fi,
-                           expect_ver, _as(oi, "i64"), _as(of, "f64"),
-                           _as(so, "u64"), _as(sl, "u64"), _as(va, "u8"))
-        allv &= va == 1
-        field_i64.append(oi)
-        field_f64.append(of)
-        field_so.append(so)
-        field_sl.append(sl)
+        fc = NB.decode_field(arena.buf, vo, vl, schema, fi)
+        if fc is None:
+            return None               # lib vanished mid-build
+        allv &= fc.valid == 1
+        fields.append(fc)
     fast = allv & ~empty
     slow_rows = np.nonzero(~allv & ~empty)[0]
 
@@ -238,9 +188,9 @@ def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
             t = schema.columns[ti].type
             if t in (SupportedType.INT, SupportedType.VID,
                      SupportedType.TIMESTAMP):
-                base = field_i64[ti].astype(np.float64)
+                base = fields[ti].i64.astype(np.float64)
             elif t in (SupportedType.FLOAT, SupportedType.DOUBLE):
-                base = field_f64[ti]
+                base = fields[ti].f64
             else:
                 base = None             # bool/string: no expiry
             if base is not None:
@@ -259,7 +209,7 @@ def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
         if col is None:
             continue
         if col.stype == SupportedType.STRING:
-            so, sl = field_so[fi], field_sl[fi]
+            so, sl = fields[fi].str_off, fields[fi].str_len
             buf = arena.buf
             raw = col.raw
             for r in np.nonzero(fast)[0].tolist():
@@ -267,11 +217,11 @@ def _decode_group(L, arena: _Arena, rows: np.ndarray, schema,
                 raw[int(target_idx[r])] = \
                     buf[o:o + l].tobytes().decode()
         elif col.stype == SupportedType.BOOL:
-            col.values[tsel] = field_i64[fi][fast] != 0
+            col.values[tsel] = fields[fi].i64[fast] != 0
         elif col.values.dtype == np.float64:
-            col.values[tsel] = field_f64[fi][fast]
+            col.values[tsel] = fields[fi].f64[fast]
         else:
-            col.values[tsel] = field_i64[fi][fast]
+            col.values[tsel] = fields[fi].i64[fast]
         col.valid[tsel] = True
     if has_tag_row is not None:
         has_tag_row[fast | empty] = True
@@ -324,7 +274,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
     if L is None or not hasattr(L, "neb_parse_keys"):
         return None
     sm = schema_man
-    arena = _parse_arena(L, space_id, stores)
+    arena = _parse_arena(space_id, stores)
     if arena is None:
         return None
     if (arena.kind == 0).any():
@@ -391,7 +341,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
             def resolver(ver, _et=abs(et)):
                 return sm.get_edge_schema(space_id, _et, ver)
 
-            drop = _decode_group(L, arena, e_rows_sorted[grp], schema,
+            drop = _decode_group(arena, e_rows_sorted[grp], schema,
                                  resolver, grp, et_cols, mirror,
                                  is_vertex=False, has_tag_row=None)
             if drop is None:
@@ -445,7 +395,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
         def vresolver(ver, _t=t):
             return sm.get_tag_schema(space_id, _t, ver)
 
-        drop = _decode_group(L, arena, v_rows[grp], schema, vresolver,
+        drop = _decode_group(arena, v_rows[grp], schema, vresolver,
                              di, t_cols, mirror, is_vertex=True,
                              has_tag_row=has_row)
         if drop is None:

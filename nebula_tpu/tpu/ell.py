@@ -843,11 +843,25 @@ def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
 
 
 # ====================================================================
-# Multi-chip: bucket rows sharded over a 1-D mesh axis, frontier
-# replicated; each device expands its row shard, the merged next
-# frontier is re-replicated (XLA all-gather over ICI).  This is the TPU
-# analogue of per-part storaged expansion + graphd-side merge
-# (SURVEY.md SS2.12, SS5.7).
+# Multi-chip, two designs:
+#
+# 1. REPLICATED-FRONTIER dense (shard_ell + make_sharded_batched_*):
+#    bucket rows sharded, the [n_rows+1, B] frontier replicated and
+#    re-replicated per hop (all-gather over ICI).  Adding chips adds
+#    FLOPs but not servable scale — every chip still holds the whole
+#    frontier matrix.  Kept for the batched-BFS path.
+#
+# 2. FRONTIER-SHARDED sparse (build_sharded_ell +
+#    make_frontier_sharded_sparse_go_kernel): the new-id row space is
+#    split into k contiguous chunks; each device holds ONLY its chunk's
+#    table rows, hub-run metadata, and live frontier pairs.  Each hop:
+#    local gather -> route candidate (query, vertex) pairs to the
+#    destination vertex's owner with jax.lax.all_to_all over ICI ->
+#    owner-side dedup/compact -> local hub expansion (+ a second
+#    all_to_all for spilled hub rows).  Per-chip memory is graph/k +
+#    frontier/k, so 8 chips serve 8x the graph+frontier — the TPU form
+#    of the reference's ID_HASH scatter-gather regrouping per hop
+#    (StorageClient.h:176-196, GoExecutor.cpp:377-431; SURVEY §5.7).
 # ====================================================================
 def shard_ell(mesh, axis: str, ell: EllIndex):
     """Pad each bucket's rows to a multiple of the axis size and place
@@ -978,3 +992,333 @@ def make_sharded_batched_bfs_kernel(mesh, axis: str, ell: EllIndex,
         return d
 
     return bfs
+
+
+# --------------------------------------------------------------------
+# Frontier-sharded sparse GO (design 2 above)
+# --------------------------------------------------------------------
+class ShardedEll:
+    """Per-device view of an EllIndex for the frontier-sharded kernel.
+
+    The new-id row space [0, n_rows] splits into k contiguous chunks of
+    ``chunk`` rows; device d owns rows [d*chunk, (d+1)*chunk).  Every
+    bucket's intersection with a device's chunk becomes one local table
+    block (padded to the max block size across devices so the stacked
+    arrays [k, mx_b, D_b] shard evenly on the mesh axis).  Hub
+    expansion metadata (ecnt, e0 per owner vertex) shards by the same
+    chunks, so NOTHING a device holds scales with the whole graph or
+    the whole frontier.
+    """
+
+    __slots__ = ("k", "chunk", "bstarts", "mx", "D", "nbr_s", "et_s",
+                 "starts_s", "ecnt_s", "e0_s", "n", "n_rows",
+                 "n_extras", "_device")
+
+    def __init__(self):
+        self._device = None
+
+
+def build_sharded_ell(ell: EllIndex, k: int) -> ShardedEll:
+    """Split ``ell`` into k per-device chunks (host-side numpy)."""
+    sh = ShardedEll()
+    sh.k = k
+    R1 = ell.n_rows + 1
+    sh.chunk = -(-R1 // k)
+    sh.n, sh.n_rows = ell.n, ell.n_rows
+    sh.n_extras = len(ell.extra_owner)
+    sh.bstarts, sh.mx, sh.D = [], [], []
+    sh.nbr_s, sh.et_s = [], []
+    starts = np.zeros((k, len(ell.bucket_nbr)), np.int32)
+    sentinel = np.int32(ell.n_rows)
+    bstart = 0
+    for b, (nbr, et) in enumerate(zip(ell.bucket_nbr, ell.bucket_et)):
+        nb, D = nbr.shape
+        lo = np.maximum(bstart, np.arange(k, dtype=np.int64) * sh.chunk)
+        hi = np.minimum(bstart + nb,
+                        (np.arange(k, dtype=np.int64) + 1) * sh.chunk)
+        cnt = np.maximum(hi - lo, 0)
+        mx = max(int(cnt.max()), 1) if nb else 1
+        nbr_k = np.full((k, mx, D), sentinel, np.int32)
+        et_k = np.zeros((k, mx, D), np.int32)
+        for d in range(k):
+            c = int(cnt[d])
+            if c:
+                s = int(lo[d]) - bstart
+                nbr_k[d, :c] = nbr[s:s + c]
+                et_k[d, :c] = et[s:s + c]
+            starts[d, b] = int(lo[d])     # global row id of my block
+        sh.bstarts.append(bstart)
+        sh.mx.append(mx)
+        sh.D.append(D)
+        sh.nbr_s.append(nbr_k)
+        sh.et_s.append(et_k)
+        bstart += nb
+    sh.starts_s = starts
+    ecnt, e0 = ell.hub_expansion()        # length n+1, indexed by row<n
+    pad = k * sh.chunk
+    ec = np.zeros(pad, np.int32)
+    ez = np.full(pad, ell.n_rows, np.int32)
+    ec[:len(ecnt) - 1] = ecnt[:-1]        # rows >= n never expand
+    ez[:len(e0) - 1] = e0[:-1]
+    sh.ecnt_s = ec.reshape(k, sh.chunk)
+    sh.e0_s = ez.reshape(k, sh.chunk)
+    return sh
+
+
+def sharded_device_args(mesh, axis: str, sh: ShardedEll):
+    """device_put the per-device arrays with P(axis) on their leading
+    dim (cached on the ShardedEll)."""
+    if sh._device is None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        s = NamedSharding(mesh, P(axis))
+        sh._device = (
+            jax.device_put(sh.starts_s, s),
+            jax.device_put(sh.ecnt_s, s),
+            jax.device_put(sh.e0_s, s),
+            tuple(jax.device_put(a, s) for a in sh.nbr_s),
+            tuple(jax.device_put(a, s) for a in sh.et_s),
+        )
+    return sh._device
+
+
+def split_start_pairs_by_owner(sh: ShardedEll, new_ids: np.ndarray,
+                               qids: np.ndarray, c0: int):
+    """Host half of the launch: place each (query, start row) pair on
+    the device owning the row.  Returns (ids [k, c0], qid [k, c0]);
+    None when any device's share exceeds c0 (caller falls back)."""
+    k, chunk = sh.k, sh.chunk
+    sentinel = sh.n_rows
+    ids = np.full((k, c0), sentinel, np.int32)
+    qid = np.zeros((k, c0), np.int32)
+    owner = new_ids // chunk
+    for d in range(k):
+        sel = owner == d
+        c = int(sel.sum())
+        if c > c0:
+            return None
+        ids[d, :c] = new_ids[sel]
+        qid[d, :c] = qids[sel]
+    return ids, qid
+
+
+def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
+                                           ell: EllIndex,
+                                           sh: ShardedEll, steps: int,
+                                           etypes: Tuple[int, ...],
+                                           caps: Tuple[int, ...],
+                                           cap_x: int, cap_e: int):
+    """Frontier-sharded sparse batched GO over a 1-D mesh.
+
+    ``caps`` are PER-DEVICE pair capacities per hop (total frontier
+    capacity = k * caps[h]); ``cap_x`` bounds candidates shipped
+    between any (source, destination) device pair per hop; ``cap_e``
+    bounds hub extra-row pairs shipped per device pair.  Any exceeded
+    bound sets the overflow flag on every device — exactness falls
+    back, never correctness.
+
+    fn(ids0 [k, caps[0]], qid0 [k, caps[0]], starts, ecnt, e0,
+       *bucket tables) -> int32 [k, 2 + 2*caps[-1]] — per device
+    [count, overflow, qids..., global row ids...], pairs sorted by
+    (qid, row).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    # static metadata is COPIED out of ``sh`` here: the jitted kernel
+    # lives in the runtime's kernel cache keyed by table SHAPES, so
+    # closing over the ShardedEll itself would pin its cached device
+    # tables (gigabytes) long after the mirror it came from is replaced
+    k, chunk = sh.k, sh.chunk
+    n, n_rows = sh.n, sh.n_rows
+    bstarts = list(sh.bstarts)
+    Ds = list(sh.D)
+    sentinel = n_rows
+    neg = tuple(-t for t in etypes)
+    d_max = max(Ds) if Ds else 1
+    nb_count = len(Ds)
+    has_hubs = sh.n_extras > 0
+    BIG_Q = jnp.int32(2**30)
+    del sh
+
+    # static global [start, end) of each bucket's rows
+    bucket_end = [bstarts[b + 1] if b + 1 < nb_count else n_rows
+                  for b in range(nb_count)]
+
+    def local_gather(rows, nbrs, ets, starts):
+        """[g, d_max] candidate MAIN-row ids of each local row's
+        out-slots (neg etypes), sentinel elsewhere.  Rows are owned by
+        this device by invariant; each selects exactly one bucket's
+        local block by its global bucket range."""
+        g = rows.shape[0]
+        cand = jnp.full((g, d_max), jnp.int32(sentinel))
+        for b in range(nb_count):
+            nbr, et = nbrs[b], ets[b]          # [mx_b, D_b]
+            mxb, D = nbr.shape
+            loc = rows - starts[b]
+            inb = (loc >= 0) & (loc < mxb) \
+                & (rows >= bstarts[b]) & (rows < bucket_end[b])
+            safe = jnp.where(inb, loc, 0)
+            rr = nbr[safe]
+            ok = inb[:, None] & _etype_ok(jnp, et[safe], neg)
+            block = jnp.where(ok, rr, sentinel)
+            if D < d_max:
+                block = jnp.pad(block, ((0, 0), (0, d_max - D)),
+                                constant_values=sentinel)
+            cand = jnp.where(inb[:, None], block, cand)
+        return cand
+
+    def route(q, u, slot_cap):
+        """Sort (q, u) pairs by destination owner and pack them into
+        [k, slot_cap] per-destination slots (BIG_Q/sentinel padding).
+        Returns (q_x, u_x, overflow)."""
+        valid = u != sentinel
+        dest = jnp.where(valid, u // chunk, jnp.int32(k))
+        sd, sq, su = jax.lax.sort((dest, q, u), num_keys=3, dimension=0)
+        off = jnp.searchsorted(sd, jnp.arange(k, dtype=jnp.int32))
+        end = jnp.searchsorted(sd, jnp.arange(k, dtype=jnp.int32),
+                               side="right")
+        cnt = end - off
+        overflow = jnp.any(cnt > slot_cap)
+        idx = off[:, None] + jnp.arange(slot_cap)[None, :]
+        take = jnp.arange(slot_cap)[None, :] < cnt[:, None]
+        idxc = jnp.minimum(idx, sd.shape[0] - 1)
+        q_x = jnp.where(take, sq[idxc], BIG_Q)
+        u_x = jnp.where(take, su[idxc], sentinel)
+        return q_x, u_x, overflow
+
+    def dedup_compact(q, u, c_out):
+        """Sort + unique (q, u) pairs, compact to c_out."""
+        valid = u != sentinel
+        kq = jnp.where(valid, q, BIG_Q)
+        ku = jnp.where(valid, u, jnp.int32(0))
+        sq, su = jax.lax.sort((kq, ku), num_keys=2, dimension=0)
+        uniq = (sq != BIG_Q) & ((sq != jnp.roll(sq, 1))
+                                | (su != jnp.roll(su, 1)))
+        uniq = uniq.at[0].set(sq[0] != BIG_Q)
+        pref = jnp.cumsum(uniq.astype(jnp.int32))
+        cnt = pref[-1]
+        pos = jnp.where(uniq & (pref <= c_out), pref - 1, c_out)
+        out_q = jnp.full((c_out,), BIG_Q).at[pos].set(sq, mode="drop")
+        out_u = jnp.full((c_out,), jnp.int32(sentinel)) \
+            .at[pos].set(su, mode="drop")
+        out_u = jnp.where(out_q == BIG_Q, sentinel, out_u)
+        return out_q, out_u, cnt > c_out, cnt
+
+    def expand_local_hubs(q, u, ecnt_l, e0_l, base, EX):
+        """Local segmented-iota hub expansion (same trick as the
+        single-device kernel) over the device's OWN pairs; emitted
+        extra-row pairs may be remote and are routed by the caller."""
+        li = jnp.where(u == sentinel, 0, u - base)
+        li = jnp.clip(li, 0, ecnt_l.shape[0] - 1)
+        raw = jnp.where(u == sentinel, 0, ecnt_l[li])
+        c_lim = jnp.int32(max(1, (2**31 - 1) // max(u.shape[0], 1)))
+        over_big = jnp.any(raw > c_lim)
+        cnt = jnp.minimum(raw, c_lim)
+        tot = jnp.cumsum(cnt)
+        total = tot[-1]
+        overflow = over_big | (total > EX)
+        s = (tot - cnt).astype(jnp.int32)
+        has = cnt > 0
+        rank = jnp.cumsum(has.astype(jnp.int32)) - 1
+        pos = jnp.where(has, rank, EX)
+        run_e0 = jnp.zeros((EX,), jnp.int32).at[pos].set(
+            e0_l[li], mode="drop")
+        run_q = jnp.full((EX,), BIG_Q).at[pos].set(q, mode="drop")
+        run_s = jnp.full((EX,), jnp.int32(2**30)).at[pos].set(
+            s, mode="drop")
+        j = jnp.arange(EX, dtype=jnp.int32)
+        seg = jnp.searchsorted(run_s, j, side="right") \
+            .astype(jnp.int32) - 1
+        segc = jnp.clip(seg, 0, EX - 1)
+        live = (j < jnp.minimum(total, EX)) & (seg >= 0)
+        rows = jnp.where(live, run_e0[segc] + (j - run_s[segc]),
+                         jnp.int32(sentinel))
+        qs = jnp.where(live, run_q[segc], BIG_Q)
+        return rows, qs, overflow
+
+    def per_device(ids0, qid0, starts, ecnt_l, e0_l, *tables):
+        # leading mesh dim of 1 from shard_map: squeeze
+        ids = ids0[0]
+        qid = jnp.where(ids == sentinel, BIG_Q, qid0[0])
+        starts = starts[0]
+        ecnt_l, e0_l = ecnt_l[0], e0_l[0]
+        nbrs = [t[0] for t in tables[:nb_count]]
+        ets = [t[0] for t in tables[nb_count:]]
+        d = jax.lax.axis_index(axis)
+        base = (d * chunk).astype(jnp.int32)
+        overflow = jnp.bool_(False)
+        cnt = jnp.sum(ids != sentinel).astype(jnp.int32)
+        ext_rows = None
+        ext_q = None
+        if has_hubs:                       # starts can be hubs too
+            ext_rows, ext_q, ovf0 = expand_local_hubs(
+                qid, ids, ecnt_l, e0_l, base, EX=ids.shape[0])
+            rq, ru, ovf_r = route(ext_q, ext_rows, cap_e)
+            ext_q_x = jax.lax.all_to_all(rq, axis, 0, 0, tiled=False)
+            ext_u_x = jax.lax.all_to_all(ru, axis, 0, 0, tiled=False)
+            ext_q = ext_q_x.reshape(-1)
+            ext_rows = ext_u_x.reshape(-1)
+            overflow = ovf0 | ovf_r
+
+        for h in range(max(steps - 1, 0)):
+            if has_hubs:
+                g_rows = jnp.concatenate([ids, ext_rows])
+                g_q = jnp.concatenate([qid, ext_q])
+            else:
+                g_rows, g_q = ids, qid
+            cand = local_gather(g_rows, nbrs, ets, starts)
+            flat_u = cand.reshape(-1)
+            flat_q = jnp.repeat(g_q, d_max)
+            qx, ux, ovf_x = route(flat_q, flat_u, cap_x)
+            qr = jax.lax.all_to_all(qx, axis, 0, 0, tiled=False)
+            ur = jax.lax.all_to_all(ux, axis, 0, 0, tiled=False)
+            qid, ids, ovf_c, cnt = dedup_compact(
+                qr.reshape(-1), ur.reshape(-1), caps[h + 1])
+            overflow = overflow | ovf_x | ovf_c
+            if has_hubs and h < steps - 2:
+                er, eq, ovf_e = expand_local_hubs(
+                    qid, ids, ecnt_l, e0_l, base, EX=ids.shape[0])
+                rq, ru, ovf_r = route(eq, er, cap_e)
+                eq_x = jax.lax.all_to_all(rq, axis, 0, 0, tiled=False)
+                eu_x = jax.lax.all_to_all(ru, axis, 0, 0, tiled=False)
+                ext_q = eq_x.reshape(-1)
+                ext_rows = eu_x.reshape(-1)
+                overflow = overflow | ovf_e | ovf_r
+
+        c_fin = caps[-1]
+        if ids.shape[0] < c_fin:
+            padn = c_fin - ids.shape[0]
+            ids = jnp.pad(ids, (0, padn), constant_values=sentinel)
+            qid = jnp.pad(qid, (0, padn), constant_values=2**30)
+        # overflow anywhere poisons the whole dispatch (host reruns):
+        ovf_all = jax.lax.psum(overflow.astype(jnp.int32), axis) > 0
+        head = jnp.stack([cnt, ovf_all.astype(jnp.int32)])
+        out = jnp.concatenate(
+            [head, jnp.where(qid == BIG_Q, -1, qid), ids])
+        return out[None, :]
+
+    import jax as _jax
+    in_spec = (P(axis),) * (5 + 2 * nb_count)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_spec,
+                   out_specs=P(axis), check_vma=False)
+    return _jax.jit(fn)
+
+
+def sharded_sparse_pairs(out: np.ndarray):
+    """Decode the [k, 2+2c] kernel output -> (overflow, qids, row_ids)
+    merged across devices."""
+    out = np.asarray(out)
+    k = out.shape[0]
+    c = (out.shape[1] - 2) // 2
+    overflow = bool(out[:, 1].any())
+    qs, us = [], []
+    for d in range(k):
+        q = out[d, 2:2 + c]
+        u = out[d, 2 + c:]
+        live = q >= 0
+        qs.append(q[live])
+        us.append(u[live])
+    return overflow, np.concatenate(qs), np.concatenate(us)

@@ -152,10 +152,18 @@ flags.define(
     "state never compiles a new program shape")
 flags.define(
     "tpu_mesh_devices", 0,
-    "shard the ELL tables over this many devices (a 1-D 'parts' Mesh; "
-    "per-hop frontier re-replication rides ICI). 0 = single-device. "
-    "The TPU analogue of the reference's multi-storaged partition "
-    "spread (SURVEY.md §2.12)")
+    "shard the ELL tables over this many devices (a 1-D 'parts' Mesh). "
+    "0 = single-device. The TPU analogue of the reference's "
+    "multi-storaged partition spread (SURVEY.md §2.12)")
+flags.define(
+    "tpu_mesh_mode", "sparse",
+    "multi-chip GO strategy: 'sparse' (frontier partitioned by vertex "
+    "range per chip, candidate pairs exchanged via all_to_all over ICI "
+    "— per-chip memory is graph/k + frontier/k, so chips ADD servable "
+    "scale; ell.make_frontier_sharded_sparse_go_kernel) or 'dense' "
+    "(tables sharded, frontier replicated + re-replicated per hop — "
+    "the round-4 design, kept as the overflow fallback and the BFS "
+    "path)")
 flags.define(
     "tpu_prewarm_kernels", True,
     "after a query family's first kernel builds, background-compile "
@@ -167,6 +175,12 @@ flags.define(
     "max accumulated edge-insert overlay before the next device query "
     "pays a full CSR/ELL rebuild (compaction); inserts below this ride "
     "a small delta kernel instead of the O(m) rebuild")
+flags.define(
+    "tpu_ell_cap", 512,
+    "ELL slot-table width cap (ell.EllIndex.build): vertices above it "
+    "spill into hub extra rows. Smaller halves the sparse kernel's "
+    "per-hop candidate/sort width (d_max) at the price of more hub "
+    "rows — worth tuning down on heavy-tailed graphs")
 flags.define(
     "mirror_refresh_mode", "sync",
     "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
@@ -711,9 +725,21 @@ class TpuQueryRuntime:
             return lambda: (starts_v, m)
 
         ix = self.ell(m)
-        mesh_mt = self._mesh_tables(m, ix)
-
         c0 = self._sparse_c0(len(d_all))
+        mesh = self._mesh_only()
+        if mesh is not None and delta is None and c0 is not None \
+                and flags.get("tpu_mesh_mode") == "sparse":
+            # the dense replicated-frontier tables are NOT built here —
+            # uploading both designs' tables would double per-chip HBM;
+            # the dense fallback builds them lazily on overflow only
+            launched = self._launch_mesh_sparse(
+                space_id, m, ix, d_all, q_all, nq, et_tuple, steps, c0,
+                mesh)
+            if launched is not None:
+                return launched
+            # start placement outgrew the per-device cap: dense fallback
+        mesh_mt = self._mesh_tables(m, ix) if mesh is not None else None
+
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is not None:
             return self._launch_sparse(space_id, m, ix, d_all, q_all, nq,
@@ -785,6 +811,69 @@ class TpuQueryRuntime:
             qids, vs_old = qids[order2], vs_old[order2]
             bounds = np.searchsorted(qids, np.arange(nq + 1))
             return [vs_old[bounds[q]:bounds[q + 1]]
+                    for q in range(nq)], m
+
+        return resolve
+
+    def _launch_mesh_sparse(self, space_id: int, m: CsrMirror,
+                            ix: EllIndex, d_all: np.ndarray,
+                            q_all: np.ndarray, nq: int,
+                            et_tuple: Tuple[int, ...], steps: int,
+                            c0: int, mesh):
+        """Frontier-sharded multi-chip GO: per-device pair lists +
+        all_to_all candidate exchange (ell.py design 2) — chips add
+        servable graph AND frontier capacity.  Returns None when the
+        start placement outgrows the per-device cap (caller falls back
+        to the replicated-frontier dense path); overflow inside the
+        kernel reruns dense."""
+        from .ell import (build_sharded_ell,
+                          make_frontier_sharded_sparse_go_kernel,
+                          sharded_device_args, sharded_sparse_pairs,
+                          split_start_pairs_by_owner, sparse_caps)
+        import jax.numpy as jnp
+        k = mesh.shape["parts"]
+        cached = getattr(m, "_sharded_ell_cache", None)
+        if cached is None or cached[0] != k:
+            sh = build_sharded_ell(ix, k)
+            m._sharded_ell_cache = (k, sh)
+        else:
+            sh = cached[1]
+        new = ix.perm[d_all].astype(np.int32)
+        placed = split_start_pairs_by_owner(sh, new,
+                                            q_all.astype(np.int32), c0)
+        if placed is None:
+            return None
+        d_max = max(ix.bucket_D) if ix.bucket_D else 1
+        cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
+        caps = sparse_caps(c0, d_max, steps, cap,
+                           growth=int(flags.get("tpu_sparse_growth") or 8))
+        cap_x = max(256, caps[-1] // max(k // 2, 1))
+        cap_e = max(64, c0)
+        kern = self._kernel(
+            ("mesh_sparse_go", ix.shape_sig(), et_tuple, steps, caps,
+             k, cap_x, cap_e),
+            lambda: make_frontier_sharded_sparse_go_kernel(
+                mesh, "parts", ix, sh, steps, et_tuple, caps,
+                cap_x=cap_x, cap_e=cap_e))
+        args = sharded_device_args(mesh, "parts", sh)
+        out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
+                       args[0], args[1], args[2], *args[3], *args[4])
+        self.stats["go_mesh_sparse"] = \
+            self.stats.get("go_mesh_sparse", 0) + 1
+
+        def resolve():
+            overflow, qids, vids_new = sharded_sparse_pairs(
+                np.asarray(out_dev))
+            if overflow:
+                self.stats["sparse_overflows"] += 1
+                return self._launch_dense(
+                    space_id, m, ix, d_all, q_all, nq, et_tuple, steps,
+                    None, self._mesh_tables(m, ix))()
+            vs_old = ix.inv[vids_new]
+            order2 = np.lexsort((vs_old, qids))
+            q2, v2 = qids[order2], vs_old[order2]
+            bounds = np.searchsorted(q2, np.arange(nq + 1))
+            return [v2[bounds[q]:bounds[q + 1]]
                     for q in range(nq)], m
 
         return resolve
@@ -1679,9 +1768,37 @@ class TpuQueryRuntime:
         the space version moves concurrently)."""
         ix = getattr(m, "_ell", None)
         if ix is None:
-            ix = EllIndex.build(m.edge_src, m.edge_dst, m.edge_etype, m.n)
+            ix = EllIndex.build(m.edge_src, m.edge_dst, m.edge_etype,
+                                m.n,
+                                cap=int(flags.get("tpu_ell_cap") or 512))
             m._ell = ix
         return ix
+
+    def _mesh_only(self):
+        """The configured 1-D Mesh (or None) WITHOUT building any
+        sharded tables — the sparse mesh path builds its own per-chunk
+        tables and must not pay for (or hold) the dense design's."""
+        k = int(flags.get("tpu_mesh_devices") or 0)
+        if k <= 1:
+            return None
+        cached = getattr(self, "_mesh_cache", None)
+        if cached is not None and cached[0] == k:
+            return cached[1]
+        import jax
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        if len(devs) < k:
+            if not getattr(self, "_mesh_warned", False):
+                self._mesh_warned = True
+                import sys
+                sys.stderr.write(
+                    f"tpu_mesh_devices={k} but only {len(devs)} devices "
+                    f"visible — running single-device\n")
+            self._mesh_cache = (k, None)
+            return None
+        mesh = Mesh(np.array(devs[:k]), ("parts",))
+        self._mesh_cache = (k, mesh)
+        return mesh
 
     def _mesh_tables(self, m: CsrMirror, ix: EllIndex):
         """(mesh, nbr_shards, et_shards, real_rows) when

@@ -69,11 +69,6 @@ def cluster():
     c.stop()
 
 
-def _ok(c, stmt):
-    r = c.client().execute(stmt) if not hasattr(c, "_cl") else None
-    return r
-
-
 class TestBulkMirrorParity:
     def test_rich_fixture(self, cluster):
         g = cluster.client()
@@ -107,7 +102,6 @@ class TestBulkMirrorParity:
         m = _diff(cluster, "bulk1")
         assert m.m > 0 and m.n >= 6
         # spot-check the multi-version winner landed
-        sid = cluster.graph_meta_client.get_space_id_by_name("bulk1").value()
         d1 = m.to_dense([1])[0]
         e = None
         for i in range(int(m.row_ptr[d1]), int(m.row_ptr[d1 + 1])):

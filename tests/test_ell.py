@@ -276,12 +276,17 @@ def test_runtime_mesh_sharded_parity():
     single = [sorted(map(tuple, g.execute(q).rows)) for q in queries]
     flags.set("tpu_mesh_devices", 8)
     try:
-        for q, exp in zip(queries, single):
-            r = g.execute(q)
-            assert r.ok(), f"{q}: {r.error_msg}"
-            assert sorted(map(tuple, r.rows)) == exp, q
+        for mode in ("sparse", "dense"):
+            flags.set("tpu_mesh_mode", mode)
+            for q, exp in zip(queries, single):
+                r = g.execute(q)
+                assert r.ok(), f"[{mode}] {q}: {r.error_msg}"
+                assert sorted(map(tuple, r.rows)) == exp, (mode, q)
+        # the frontier-sharded path must have actually served
+        assert c.tpu_runtime.stats.get("go_mesh_sparse", 0) > 0
     finally:
         flags.set("tpu_mesh_devices", 0)
+        flags.set("tpu_mesh_mode", "sparse")
     c.stop()
 
 
@@ -513,3 +518,62 @@ def test_sparse_hub_expansion_overflow_reported():
     out = np.asarray(kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                           *ix.kernel_args()[1:]))
     assert out[1] == 1, "hub expansion past the budget must overflow"
+
+
+def test_frontier_sharded_sparse_go_bitmatch():
+    """The frontier-sharded sparse kernel (per-device pair lists,
+    all_to_all candidate exchange, sharded hub metadata) must bit-match
+    the single-device dense pull on randomized hub-bearing graphs over
+    an 8-virtual-device mesh — and hold NO dense frontier anywhere."""
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:8]), ("parts",))
+    rng = np.random.default_rng(17)
+    verified = 0
+    for trial in range(6):
+        n = int(rng.integers(50, 500))
+        m = int(rng.integers(100, 3000))
+        es = rng.integers(0, n, m).astype(np.int32)
+        ed = rng.integers(0, n, m).astype(np.int32)
+        # a deliberate hub: vertex 0 receives/sends a burst
+        hub_m = int(rng.integers(0, 120))
+        es = np.concatenate([es, np.zeros(hub_m, np.int32)])
+        ed = np.concatenate([ed, rng.integers(0, n, hub_m).astype(np.int32)])
+        ee = rng.choice([1, 2], len(es)).astype(np.int32)
+        es2 = np.concatenate([es, ed])
+        ed2 = np.concatenate([ed, es])
+        ee2 = np.concatenate([ee, -ee])
+        steps = int(rng.integers(2, 5))
+        ix = E.EllIndex.build(es2, ed2, ee2, n, cap=16, min_d=4)
+        sh = E.build_sharded_ell(ix, 8)
+        nq = int(rng.integers(1, 6))
+        starts = [np.unique(rng.integers(0, n, int(rng.integers(1, 4))))
+                  for _ in range(nq)]
+        exp = ix.to_old(run_go(ix, steps, (1,),
+                               ix.start_frontier(starts,
+                                                 B=128)))[:, :nq] > 0
+        caps = tuple(min(1 << 12, 8 * (16 ** h) * 8)
+                     for h in range(steps))
+        kern = E.make_frontier_sharded_sparse_go_kernel(
+            mesh, "parts", ix, sh, steps, (1,), caps,
+            cap_x=1 << 11, cap_e=64)
+        new_ids, qids = [], []
+        for q, s in enumerate(starts):
+            new_ids.extend(ix.perm[s].tolist())
+            qids.extend([q] * len(s))
+        placed = E.split_start_pairs_by_owner(
+            sh, np.asarray(new_ids, np.int32),
+            np.asarray(qids, np.int32), caps[0])
+        assert placed is not None
+        args = E.sharded_device_args(mesh, "parts", sh)
+        out = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
+                   args[0], args[1], args[2], *args[3], *args[4])
+        overflow, oq, ou = E.sharded_sparse_pairs(np.asarray(out))
+        if overflow:
+            continue
+        got = np.zeros((n, nq), bool)
+        if len(oq):
+            got[ix.inv[ou], oq] = True
+        np.testing.assert_array_equal(got, exp, err_msg=f"trial {trial}")
+        verified += 1
+    assert verified >= 3, "too many overflows; caps too tight to test"
