@@ -234,9 +234,23 @@ def main():
                                  "cpu", flat=True)
         log(f"cpu flat fallback ({threads} workers): {cpu_flat_r}")
 
-        log("measuring served TPU path...")
-        tpu_r = serve_bench(c, "perf", queries, threads, "tpu")
-        log(f"tpu path ({threads} workers): {tpu_r}")
+        # N=3 serving runs; the HEADLINE is the median run (VERDICT r4
+        # weak #2: single-run numbers drifted 25% between the builder's
+        # and the driver's environments — the median with reported
+        # spread is reproducible)
+        log("measuring served TPU path (3 runs, median)...")
+        runs = []
+        for i in range(3):
+            r = serve_bench(c, "perf", queries, threads, "tpu")
+            log(f"tpu run {i + 1}: {r}")
+            runs.append(r)
+        runs.sort(key=lambda r: r["qps"])
+        tpu_r = runs[1]
+        tpu_spread = {
+            "qps_runs": [round(r["qps"], 1) for r in runs],
+            "p50_ms_runs": [round(r["p50_ms"], 2) for r in runs],
+            "p99_ms_runs": [round(r["p99_ms"], 2) for r in runs],
+        }
 
         # parity spot-check on a few queries
         g = c.client()
@@ -261,6 +275,8 @@ def main():
                              else rt.stats.get(k, 0)) for k in
                          ("go_sparse", "go_dense", "go_adaptive",
                           "sparse_overflows", "mirror_builds",
+                          "prewarm_compiled", "prewarm_hits",
+                          "prewarm_misses",
                           "t_launch_s", "t_fetch_s", "t_assemble_s")}
         runtime_stats.update({k: rt.dispatcher.stats.get(k, 0) for k in
                               ("batches", "batched_queries", "max_batch",
@@ -292,6 +308,7 @@ def main():
         "p50_speedup_vs_flat_cpu": round(
             cpu_flat_r["p50_ms"] / tpu_r["p50_ms"], 2),
         "edges_traversed_per_query": round(traversed_per_query, 1),
+        "tpu_run_spread": tpu_spread,
         "workers": threads,
         "graph": f"n=2^{n.bit_length() - 1}, m=2^{m.bit_length() - 1}",
         "config": {"tpu_queries": B, "cpu_queries": threads,

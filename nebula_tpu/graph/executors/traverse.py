@@ -7,7 +7,8 @@ prop wave :531-569, final eval :669-782), FetchVerticesExecutor,
 FetchEdgesExecutor, YieldExecutor, OrderByExecutor, SetExecutor,
 PipeExecutor, AssignmentExecutor. FIND/MATCH are principled stubs in the
 reference (FindExecutor.cpp:19-21); here FIND SHORTEST/ALL PATH is fully
-implemented (BASELINE.md config 3) and MATCH remains a stub.
+implemented (BASELINE.md config 3) and basic MATCH lowers onto the GO
+planner (MatchExecutor below).
 
 When ``ectx.tpu_runtime`` serves the current space, GO and FIND PATH
 delegate the whole multi-hop loop to the device (tpu/runtime.py): frontier
@@ -1085,10 +1086,171 @@ class FindExecutor(Executor):
 
 
 class MatchExecutor(Executor):
-    """Reference parity: MATCH is parsed but unsupported
-    (MatchExecutor.cpp:19-21)."""
+    """Basic MATCH, lowered onto the GO planner — strictly beyond the
+    reference, whose MatchExecutor rejects everything
+    (MatchExecutor.cpp:19-21).
+
+    Supported shape: ``MATCH (a[:tag])-[e:etype]->(b[:tag])
+    WHERE id(a) == <vid> [AND <preds>] RETURN <exprs>`` — pattern
+    variables rewrite into GO's property spaces (``id(a)``/``id(b)`` →
+    ``etype._src``/``etype._dst``, ``e.p`` → ``etype.p``, ``a.p`` →
+    ``$^.tag.p``, ``b.p`` → ``$$.tag.p``), the ``id(a)`` anchor
+    conjuncts become the FROM list, and the lowered GoSentence runs
+    through GoExecutor — batching, the device backend, and result
+    semantics all ride along.  Labels resolve property namespaces only
+    (tag-presence is not an implicit filter); everything outside the
+    shape errors E_UNSUPPORTED with the raw text preserved."""
 
     NAME = "MatchExecutor"
 
     def execute(self):
-        raise ExecError("MATCH is not supported yet", ErrorCode.E_UNSUPPORTED)
+        from ..parser.parser import _Parser, ParseError
+        from ..parser.lexer import LexError, tokenize
+
+        s = self.sentence
+        if s.a_var is None:
+            raise ExecError(
+                "MATCH supports the basic (a)-[e:etype]->(b) pattern "
+                "with an id(a) anchor; got: " + s.raw,
+                ErrorCode.E_UNSUPPORTED)
+        if not s.e_label:
+            raise ExecError(
+                "MATCH needs a typed edge pattern [e:etype]",
+                ErrorCode.E_UNSUPPORTED)
+        alias = s.e_label
+
+        pat_vars = {s.a_var, s.b_var, s.e_var}
+
+        def rewrite(text: str, what: str) -> str:
+            """Token-level pattern-variable substitution — operating on
+            TOKENS (not raw text) so string literals that happen to
+            spell a variable name are never touched."""
+            try:
+                toks = tokenize(text)
+            except LexError as e:
+                raise ExecError(f"MATCH {what}: {e}")
+            out: List[str] = []
+            i = 0
+
+            def lexeme(j: int) -> str:
+                end = toks[j + 1].pos if j + 1 < len(toks) else len(text)
+                return text[toks[j].pos:end]
+
+            def is_id(j: int, val: Optional[str] = None) -> bool:
+                t = toks[j]
+                return t.type == "ID" and (val is None or t.value == val)
+
+            def sym(j: int, v: str) -> bool:
+                t = toks[j]
+                return t.type == "SYM" and t.value == v
+
+            while toks[i].type != "EOF":
+                # id(<var>)
+                if is_id(i, "id") and sym(i + 1, "(") \
+                        and is_id(i + 2) and sym(i + 3, ")") \
+                        and toks[i + 2].value in pat_vars:
+                    v = toks[i + 2].value
+                    out.append(f"{alias}._src " if v == s.a_var
+                               else f"{alias}._dst ")
+                    i += 4
+                    continue
+                # <var>.<prop>
+                if is_id(i) and toks[i].value in pat_vars \
+                        and sym(i + 1, ".") and is_id(i + 2):
+                    v, prop = toks[i].value, toks[i + 2].value
+                    if v == s.e_var:
+                        out.append(f"{alias}.{prop} ")
+                    elif v == s.a_var:
+                        if not s.a_label:
+                            raise ExecError(
+                                f"({v}) needs a :tag label to read "
+                                f"{v}.{prop}")
+                        out.append(f"$^.{s.a_label}.{prop} ")
+                    else:
+                        if not s.b_label:
+                            raise ExecError(
+                                f"({v}) needs a :tag label to read "
+                                f"{v}.{prop}")
+                        out.append(f"$$.{s.b_label}.{prop} ")
+                    i += 3
+                    continue
+                # bare <var>
+                if is_id(i) and toks[i].value in pat_vars:
+                    v = toks[i].value
+                    if v == s.e_var:
+                        raise ExecError(
+                            f"bare edge variable {v} in {what}; return "
+                            f"its properties ({v}.<prop>) instead")
+                    out.append(f"{alias}._src " if v == s.a_var
+                               else f"{alias}._dst ")
+                    i += 1
+                    continue
+                out.append(lexeme(i))
+                i += 1
+            return "".join(out)
+
+        def parse_with(fn_name: str, text: str):
+            try:
+                p = _Parser(tokenize(text), text)
+                out = getattr(p, fn_name)()
+                if p.peek().type != "EOF":
+                    p.fail("unexpected trailing input in MATCH clause")
+                return out
+            except (ParseError, LexError) as e:
+                raise ExecError(f"MATCH clause: {e}")
+
+        # WHERE: split the anchor conjuncts (id(a) == vid) off the
+        # predicate tree; the rest travels as the GO filter
+        from ...filter.expressions import (EdgeSrcIdExpr, LogicalExpr,
+                                           PrimaryExpr, RelationalExpr)
+        vids: List[int] = []
+        remnant = None
+        if s.where_text:
+            tree = parse_with("p_expression",
+                              rewrite(s.where_text, "WHERE"))
+
+            def split(e):
+                nonlocal remnant
+                if isinstance(e, LogicalExpr) and e.op == "&&":
+                    split(e.left)
+                    split(e.right)
+                    return
+                if isinstance(e, RelationalExpr) and e.op == "==":
+                    l, r = e.left, e.right
+                    if isinstance(r, EdgeSrcIdExpr):
+                        l, r = r, l
+                    if isinstance(l, EdgeSrcIdExpr) \
+                            and isinstance(r, PrimaryExpr) \
+                            and isinstance(r.value, int) \
+                            and not isinstance(r.value, bool):
+                        vids.append(int(r.value))
+                        return
+                remnant = e if remnant is None else \
+                    LogicalExpr("&&", remnant, e)
+
+            split(tree)
+        if not vids:
+            raise ExecError(
+                "MATCH needs an id(<start var>) == <vid> anchor in "
+                "WHERE to choose start vertices",
+                ErrorCode.E_UNSUPPORTED)
+
+        yc = parse_with("p_yield_clause",
+                        "yield " + rewrite(s.return_text, "RETURN"))
+
+        if len(set(vids)) > 1:
+            # two DIFFERENT id(a) == … conjuncts can't both hold: the
+            # predicate is unsatisfiable, the result set is empty
+            cols = [c.alias or default_col_name(c.expr)
+                    for c in yc.columns]
+            return InterimResult(cols, [])
+        vids = vids[:1]
+
+        go = ast.GoSentence(
+            step=ast.StepClause(steps=1),
+            from_=ast.FromClause(vids=[PrimaryExpr(v) for v in vids]),
+            over=ast.OverClause(edges=[ast.OverEdge(edge=s.e_label)]),
+            where=(ast.WhereClause(filter=remnant)
+                   if remnant is not None else None),
+            yield_=yc)
+        return GoExecutor(go, self.ectx).execute()

@@ -133,8 +133,23 @@ class GoSentence(Sentence):
 
 @dataclass
 class MatchSentence(Sentence):
+    """MATCH — the basic single node-edge-node pattern
+    ``MATCH (a[:tag])-[e:etype]->(b[:tag]) WHERE ... RETURN ...``
+    parses structurally and LOWERS onto the GO planner
+    (executors/traverse.MatchExecutor); anything else keeps the raw
+    text and errors E_UNSUPPORTED — which is already beyond the
+    reference, whose MatchExecutor rejects everything
+    (MatchExecutor.cpp:19-21)."""
     kind = Kind.MATCH
-    raw: str = ""  # principled stub (reference MatchExecutor.cpp:19-21)
+    raw: str = ""
+    a_var: Optional[str] = None
+    a_label: Optional[str] = None
+    e_var: Optional[str] = None
+    e_label: Optional[str] = None
+    b_var: Optional[str] = None
+    b_label: Optional[str] = None
+    where_text: Optional[str] = None
+    return_text: Optional[str] = None
 
 
 @dataclass

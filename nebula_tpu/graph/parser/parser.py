@@ -310,6 +310,13 @@ class _Parser:
     def p_match(self) -> ast.MatchSentence:
         start = self.peek().pos
         self.expect_kw("match")
+        save = self.i
+        try:
+            s = self._p_match_basic()
+            s.raw = self.text[start:self.peek().pos]
+            return s
+        except ParseError:
+            self.i = save     # not the basic pattern: raw fallback
         depth = 0
         while not (self.peek().type == "EOF" or
                    (depth == 0 and self.at_sym(";", "|"))):
@@ -319,6 +326,63 @@ class _Parser:
                 depth -= 1
             self.next()
         return ast.MatchSentence(raw=self.text[start:self.peek().pos])
+
+    def _at_return(self) -> bool:
+        t = self.peek()
+        return t.type == "ID" and t.value.lower() == "return"
+
+    def _p_match_basic(self) -> ast.MatchSentence:
+        """(a[:label])-[e:etype]->(b[:label]) [WHERE ...] RETURN cols —
+        the MATCH shape the GO planner serves
+        (executors/traverse.MatchExecutor lowers it)."""
+        s = ast.MatchSentence()
+        self.expect_sym("(")
+        s.a_var = self.expect_id("pattern variable")
+        if self.accept_sym(":"):
+            s.a_label = self.expect_id("tag label")
+        self.expect_sym(")")
+        self.expect_sym("-")
+        self.expect_sym("[")
+        s.e_var = self.expect_id("edge variable")
+        if self.accept_sym(":"):
+            s.e_label = self.expect_id("edge type")
+        self.expect_sym("]")
+        self.expect_sym("->")
+        self.expect_sym("(")
+        s.b_var = self.expect_id("pattern variable")
+        if self.accept_sym(":"):
+            s.b_label = self.expect_id("tag label")
+        self.expect_sym(")")
+        if self.accept_kw("where"):
+            w0 = self.peek().pos
+            depth = 0
+            while not (self.peek().type == "EOF"
+                       or (depth == 0 and (self._at_return()
+                                           or self.at_sym(";", "|")))):
+                if self.at_sym("(", "["):
+                    depth += 1
+                elif self.at_sym(")", "]"):
+                    depth -= 1
+                self.next()
+            s.where_text = self.text[w0:self.peek().pos].strip()
+            if not s.where_text:
+                self.fail("empty WHERE in MATCH")
+        if not self._at_return():
+            self.fail("expected RETURN")
+        self.next()
+        r0 = self.peek().pos
+        depth = 0
+        while not (self.peek().type == "EOF"
+                   or (depth == 0 and self.at_sym(";", "|"))):
+            if self.at_sym("(", "["):
+                depth += 1
+            elif self.at_sym(")", "]"):
+                depth -= 1
+            self.next()
+        s.return_text = self.text[r0:self.peek().pos].strip()
+        if not s.return_text:
+            self.fail("empty RETURN in MATCH")
+        return s
 
     def p_find(self) -> ast.Sentence:
         self.expect_kw("find")

@@ -281,7 +281,7 @@ d_max = max(ix.bucket_D)
 c0 = 256                      # per device; total start capacity 8*c0
 caps = sparse_caps(c0, d_max, steps_s, 1 << 17)
 kern8 = make_frontier_sharded_sparse_go_kernel(
-    mesh, "parts", ix, sh, steps_s, (1,), caps, cap_x=1 << 15,
+    mesh, "parts", sh, steps_s, (1,), caps, cap_x=1 << 15,
     cap_e=c0)
 ni = np.asarray([int(ix.perm[s[0]]) for s in starts], np.int32)
 qi = np.arange(B, dtype=np.int32)

@@ -1,0 +1,159 @@
+"""Component micro-benchmarks — parser / row codec / key codec / WAL.
+
+The reference ships folly Benchmark harnesses for exactly these
+components (src/parser/test/ParserBenchmark.cpp,
+src/dataman/test/{RowReaderBenchmark,RowWriterBenchmark}.cpp,
+src/kvstore/test/MultiVersionBenchmark.cpp) but records no numbers; we
+run ours once per release and pin the results in BASELINE.md so
+regressions in the non-device substrate are visible without a full
+serving benchmark.
+
+Run: python -m nebula_tpu.tools.micro_bench [--quick]
+Prints one JSON object of {component: {metric: value}}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _rate(n, t):
+    return round(n / t, 1)
+
+
+def bench_parser(reps: int) -> dict:
+    from ..graph.parser.parser import GQLParser
+    stmts = [
+        "GO 3 STEPS FROM 100 OVER follow WHERE follow.degree > 30 && "
+        "$$.player.age < 40 YIELD follow._dst AS id, follow.degree",
+        'CREATE TAG player(name string, age int, score double)',
+        'INSERT EDGE follow(degree) VALUES 1 -> 2:(95), 3 -> 4:(80)',
+        "GO FROM 1 OVER e YIELD e._dst AS d | GO FROM $-.d OVER e "
+        "YIELD DISTINCT e._dst",
+        "FIND SHORTEST PATH FROM 1 TO 99 OVER * UPTO 5 STEPS",
+        "FETCH PROP ON player 1,2,3 YIELD player.name, player.age",
+        "SHOW TAGS; DESCRIBE TAG player",
+        "UPDATE VERTEX 1 SET player.age = $^.player.age + 1 "
+        "WHEN $^.player.age < 90 YIELD $^.player.age AS age",
+    ]
+    p = GQLParser()
+    for s in stmts:                     # warm + correctness gate
+        assert p.parse(s).ok(), s
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for s in stmts:
+            p.parse(s)
+    dt = time.perf_counter() - t0
+    return {"statements_per_s": _rate(reps * len(stmts), dt)}
+
+
+def bench_codec(rows: int) -> dict:
+    from ..codec.rows import RowReader, encode_row
+    from ..interface.common import ColumnDef, Schema, SupportedType
+    from ..native import batch as NB
+    schema = Schema(columns=[
+        ColumnDef("name", SupportedType.STRING),
+        ColumnDef("age", SupportedType.INT),
+        ColumnDef("score", SupportedType.DOUBLE),
+        ColumnDef("active", SupportedType.BOOL),
+    ])
+    vals = [{"name": f"p{i % 97}", "age": i % 120,
+             "score": i * 0.5, "active": (i & 1) == 0}
+            for i in range(rows)]
+    t0 = time.perf_counter()
+    blobs = [encode_row(schema, v) for v in vals]
+    t_enc = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    acc = 0
+    for b in blobs:
+        acc += RowReader(b, schema).get("age")
+    t_dec = time.perf_counter() - t0
+
+    out = {"encode_rows_per_s": _rate(rows, t_enc),
+           "decode_py_rows_per_s": _rate(rows, t_dec)}
+    blob, offs, lens = NB.concat_blobs(blobs)
+    t0 = time.perf_counter()
+    fc = NB.decode_field(blob, offs, lens, schema, 1)
+    t_nat = time.perf_counter() - t0
+    if fc is not None and int(fc.i64.sum()) == acc:
+        out["decode_native_rows_per_s"] = _rate(rows, t_nat)
+    return out
+
+
+def bench_keys(rows: int) -> dict:
+    from ..common.keys import KeyUtils
+    from ..native import batch as NB
+    rng = np.random.default_rng(3)
+    srcs = rng.integers(0, 1 << 40, rows)
+    t0 = time.perf_counter()
+    keys = [KeyUtils.edge_key(1, int(s), 7, 0, int(s) + 1, 12345)
+            for s in srcs]
+    t_enc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for k in keys:
+        KeyUtils.parse_edge(k)
+    t_dec = time.perf_counter() - t0
+    out = {"encode_keys_per_s": _rate(rows, t_enc),
+           "parse_py_keys_per_s": _rate(rows, t_dec)}
+    blob, offs, lens = NB.concat_blobs(keys)
+    t0 = time.perf_counter()
+    pk = NB.parse_keys(blob, offs, lens)
+    t_nat = time.perf_counter() - t0
+    if pk is not None and int(pk.a[0]) == int(srcs[0]):
+        out["parse_native_keys_per_s"] = _rate(rows, t_nat)
+    return out
+
+
+def bench_wal(entries: int) -> dict:
+    from ..kvstore.wal import FileBasedWal, LogEntry
+    msg = b"x" * 64
+    with tempfile.TemporaryDirectory() as d:
+        wal = FileBasedWal(d)
+        t0 = time.perf_counter()
+        batch = 64
+        for lo in range(1, entries + 1, batch):
+            wal.append_logs([LogEntry(i, 1, msg)
+                             for i in range(lo, min(lo + batch,
+                                                    entries + 1))])
+        wal.flush(sync=False)
+        t_app = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        seen = sum(1 for _ in wal.iterate(1, entries))
+        t_iter = time.perf_counter() - t0
+        assert seen == entries
+        wal.close()
+        t0 = time.perf_counter()
+        wal2 = FileBasedWal(d)       # cold replay (reference WAL load)
+        t_replay = time.perf_counter() - t0
+        assert wal2.last_log_id() == entries
+        wal2.close()
+    return {"append_entries_per_s": _rate(entries, t_app),
+            "iterate_entries_per_s": _rate(entries, t_iter),
+            "replay_s": round(t_replay, 3)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+    reps = 50 if args.quick else 400
+    rows = 20_000 if args.quick else 200_000
+    entries = 5_000 if args.quick else 50_000
+    out = {
+        "parser": bench_parser(reps),
+        "row_codec": bench_codec(rows),
+        "key_codec": bench_keys(rows),
+        "wal": bench_wal(entries),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

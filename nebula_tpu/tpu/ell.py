@@ -354,6 +354,43 @@ def _hop_body(jnp, jax, n: int, n_extras: int, etypes: Tuple[int, ...],
     return jnp.concatenate([nxt, pad], axis=0)
 
 
+def _segmented_hub_iota(jnp, cnt_raw, e0_vals, qid, EX: int,
+                        sentinel: int, BIG_Q):
+    """The hub-expansion core shared by the single-device and mesh
+    sparse kernels: per-pair extra-row counts + first-row ids ->
+    up to EX (row, qid) expansion pairs via a segmented iota over the
+    compacted runs, with a wrap-free budget check.
+
+    Per-pair counts clamp to c_lim (chosen so the int32 cumsum cannot
+    wrap past 2^31 and silently CLEAR the overflow flag); any clamped
+    entry flags overflow directly.  Dropped runs (rank >= EX) always
+    coincide with the overflow flag, so results are never silently
+    short."""
+    c_in = cnt_raw.shape[0]
+    c_lim = jnp.int32(max(1, (2**31 - 1) // max(c_in, 1)))
+    over_big = jnp.any(cnt_raw > c_lim)
+    cnt = jnp.minimum(cnt_raw, c_lim)
+    tot = jnp.cumsum(cnt)
+    total = tot[-1]
+    overflow = over_big | (total > EX)
+    s = (tot - cnt).astype(jnp.int32)
+    has = cnt > 0
+    rank = jnp.cumsum(has.astype(jnp.int32)) - 1
+    pos = jnp.where(has, rank, EX)
+    run_e0 = jnp.zeros((EX,), jnp.int32).at[pos].set(e0_vals,
+                                                     mode="drop")
+    run_q = jnp.full((EX,), BIG_Q).at[pos].set(qid, mode="drop")
+    run_s = jnp.full((EX,), jnp.int32(2**30)).at[pos].set(s, mode="drop")
+    j = jnp.arange(EX, dtype=jnp.int32)
+    seg = jnp.searchsorted(run_s, j, side="right").astype(jnp.int32) - 1
+    segc = jnp.clip(seg, 0, EX - 1)
+    live = (j < jnp.minimum(total, EX)) & (seg >= 0)
+    rows = jnp.where(live, run_e0[segc] + (j - run_s[segc]),
+                     jnp.int32(sentinel))
+    qs = jnp.where(live, run_q[segc], BIG_Q)
+    return rows, qs, overflow
+
+
 def pack_bits(jnp, x):
     """[R, B] truthy -> bit-packed uint8 [ceil(R/8), B] (row-major bits,
     little bit order — np.unpackbits(bitorder="little") inverts it).
@@ -520,43 +557,11 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
 
     def expand_hubs(ids, qid, ecnt, e0, EX):
         """Bounded hub expansion: (q, v) pairs -> up to EX extra-row
-        pairs (q, e) covering every frontier hub's spilled slot rows.
-        Segmented-iota over the compacted hub runs: run r of vertex v
-        starts at output offset s_r = cumsum-exclusive of per-pair
-        extra counts and emits rows e0[v] + 0..ecnt[v]-1.  Dropped
-        runs (rank >= EX) imply total > EX, so they always coincide
-        with the overflow flag."""
+        pairs (q, e) covering every frontier hub's spilled slot rows
+        (_segmented_hub_iota does the run decoding + budget check)."""
         raw = jnp.where(ids == sentinel, 0, ecnt[jnp.minimum(ids, n)])
-        # wrap-free budget check: int32 cumsum over unclamped counts
-        # could wrap past 2^31 on hub-heavy frontiers and silently
-        # CLEAR the overflow flag.  Clamp each count to c_lim (chosen
-        # so the clamped total cannot wrap) and flag any clamped entry
-        # directly — a single count > c_lim already exceeds any EX
-        # this kernel is built with, or is caught by the total check.
-        c_in_sz = raw.shape[0]
-        c_lim = jnp.int32(max(1, (2**31 - 1) // max(c_in_sz, 1)))
-        over_big = jnp.any(raw > c_lim)
-        cnt = jnp.minimum(raw, c_lim)
-        tot = jnp.cumsum(cnt)
-        total = tot[-1]
-        overflow = over_big | (total > EX)
-        s = (tot - cnt).astype(jnp.int32)
-        has = cnt > 0
-        rank = jnp.cumsum(has.astype(jnp.int32)) - 1
-        pos = jnp.where(has, rank, EX)
-        run_e0 = jnp.zeros((EX,), jnp.int32).at[pos].set(
-            e0[jnp.minimum(ids, n)], mode="drop")
-        run_q = jnp.full((EX,), BIG_Q).at[pos].set(qid, mode="drop")
-        run_s = jnp.full((EX,), jnp.int32(2**30)).at[pos].set(
-            s, mode="drop")
-        j = jnp.arange(EX, dtype=jnp.int32)
-        seg = jnp.searchsorted(run_s, j, side="right").astype(jnp.int32) - 1
-        segc = jnp.clip(seg, 0, EX - 1)
-        live = (j < jnp.minimum(total, EX)) & (seg >= 0)
-        rows = jnp.where(live, run_e0[segc] + (j - run_s[segc]),
-                         jnp.int32(sentinel))
-        qs = jnp.where(live, run_q[segc], BIG_Q)
-        return rows, qs, overflow
+        return _segmented_hub_iota(jnp, raw, e0[jnp.minimum(ids, n)],
+                                   qid, EX, sentinel, BIG_Q)
 
     # hub-expansion budget: each of the batch's <= qmax queries can
     # expand each of the graph's extra rows at most once, so
@@ -1103,7 +1108,6 @@ def split_start_pairs_by_owner(sh: ShardedEll, new_ids: np.ndarray,
 
 
 def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
-                                           ell: EllIndex,
                                            sh: ShardedEll, steps: int,
                                            etypes: Tuple[int, ...],
                                            caps: Tuple[int, ...],
@@ -1208,36 +1212,15 @@ def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
         return out_q, out_u, cnt > c_out, cnt
 
     def expand_local_hubs(q, u, ecnt_l, e0_l, base, EX):
-        """Local segmented-iota hub expansion (same trick as the
-        single-device kernel) over the device's OWN pairs; emitted
-        extra-row pairs may be remote and are routed by the caller."""
+        """Local hub expansion over the device's OWN pairs (chunk-local
+        ecnt/e0 lookups; _segmented_hub_iota does the run decoding +
+        budget check); emitted extra-row pairs may be remote and are
+        routed by the caller."""
         li = jnp.where(u == sentinel, 0, u - base)
         li = jnp.clip(li, 0, ecnt_l.shape[0] - 1)
         raw = jnp.where(u == sentinel, 0, ecnt_l[li])
-        c_lim = jnp.int32(max(1, (2**31 - 1) // max(u.shape[0], 1)))
-        over_big = jnp.any(raw > c_lim)
-        cnt = jnp.minimum(raw, c_lim)
-        tot = jnp.cumsum(cnt)
-        total = tot[-1]
-        overflow = over_big | (total > EX)
-        s = (tot - cnt).astype(jnp.int32)
-        has = cnt > 0
-        rank = jnp.cumsum(has.astype(jnp.int32)) - 1
-        pos = jnp.where(has, rank, EX)
-        run_e0 = jnp.zeros((EX,), jnp.int32).at[pos].set(
-            e0_l[li], mode="drop")
-        run_q = jnp.full((EX,), BIG_Q).at[pos].set(q, mode="drop")
-        run_s = jnp.full((EX,), jnp.int32(2**30)).at[pos].set(
-            s, mode="drop")
-        j = jnp.arange(EX, dtype=jnp.int32)
-        seg = jnp.searchsorted(run_s, j, side="right") \
-            .astype(jnp.int32) - 1
-        segc = jnp.clip(seg, 0, EX - 1)
-        live = (j < jnp.minimum(total, EX)) & (seg >= 0)
-        rows = jnp.where(live, run_e0[segc] + (j - run_s[segc]),
-                         jnp.int32(sentinel))
-        qs = jnp.where(live, run_q[segc], BIG_Q)
-        return rows, qs, overflow
+        return _segmented_hub_iota(jnp, raw, e0_l[li], q, EX, sentinel,
+                                   BIG_Q)
 
     def per_device(ids0, qid0, starts, ecnt_l, e0_l, *tables):
         # leading mesh dim of 1 from shard_map: squeeze

@@ -214,8 +214,16 @@ class TpuQueryRuntime:
         self.stats = {"go_device": 0, "path_device": 0, "mirror_builds": 0,
                       "mirror_deltas": 0, "go_sparse": 0, "go_dense": 0,
                       "go_adaptive": 0, "sparse_overflows": 0,
+                      "prewarm_compiled": 0, "prewarm_hits": 0,
+                      "prewarm_misses": 0,
                       "t_launch_s": 0.0, "t_fetch_s": 0.0,
                       "t_assemble_s": 0.0}
+        # shapes the AOT pre-warm compiled / shapes live dispatch used
+        # (prewarm_hits/misses make the pre-warm's p99 effect auditable:
+        # a miss = a live query paid a first compile the warm should
+        # have absorbed)
+        self._prewarmed_shapes: set = set()
+        self._live_shapes: set = set()
 
     def _tick(self, key: str, t0: float) -> float:
         """Accumulate wall time into a stats bucket; returns now."""
@@ -768,6 +776,21 @@ class TpuQueryRuntime:
                 return w
         return None
 
+    def _note_live_shape(self, shape_key: Tuple) -> None:
+        """First live dispatch of a pinned kernel shape: was it
+        pre-warmed?  (Called before the kernel invocation so the
+        hit/miss reflects what the live call will experience.)"""
+        if shape_key in self._live_shapes:
+            return
+        with self._lock:
+            if shape_key in self._live_shapes:
+                return
+            self._live_shapes.add(shape_key)
+            if shape_key in self._prewarmed_shapes:
+                self.stats["prewarm_hits"] += 1
+            else:
+                self.stats["prewarm_misses"] += 1
+
     def _launch_sparse(self, space_id: int, m: CsrMirror, ix: EllIndex,
                        d_all: np.ndarray, q_all: np.ndarray, nq: int,
                        et_tuple: Tuple[int, ...], steps: int, c0: int):
@@ -791,6 +814,8 @@ class TpuQueryRuntime:
         ids[:S] = new[order]
         qid[:S] = q_all[order]
         ecnt, e0 = self._hub_expansion_dev(m, ix)
+        self._note_live_shape(("sparse_go", ix.shape_sig(), et_tuple,
+                               steps, c0))
         out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                        *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
@@ -853,7 +878,7 @@ class TpuQueryRuntime:
             ("mesh_sparse_go", ix.shape_sig(), et_tuple, steps, caps,
              k, cap_x, cap_e),
             lambda: make_frontier_sharded_sparse_go_kernel(
-                mesh, "parts", ix, sh, steps, et_tuple, caps,
+                mesh, "parts", sh, steps, et_tuple, caps,
                 cap_x=cap_x, cap_e=cap_e))
         args = sharded_device_args(mesh, "parts", sh)
         out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
@@ -930,6 +955,8 @@ class TpuQueryRuntime:
                 ("ell_go", ix.shape_sig(), et_tuple, steps),
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
                                                pack=True))
+            self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
+                                   steps, B))
             out_dev = kern(f0_dev, *args)
             self._prewarm_family(m, ix, et_tuple, steps)
         self.stats["go_dense"] += 1
@@ -991,7 +1018,14 @@ class TpuQueryRuntime:
                           str(flags.get("tpu_sparse_c0s") or
                               "256,2048").split(",") if x.strip()]
                 for c0 in ladder:
-                    if c0 == skip_c0 or steps <= 1:
+                    if steps <= 1:
+                        continue
+                    shape_key = ("sparse_go", ix.shape_sig(), et_tuple,
+                                 steps, c0)
+                    if c0 == skip_c0:
+                        # the live first query compiled this rung
+                        with self._lock:
+                            self._prewarmed_shapes.add(shape_key)
                         continue
                     caps = sparse_caps(c0, d_max, steps, cap,
                                        growth=growth)
@@ -1002,6 +1036,9 @@ class TpuQueryRuntime:
                             ix, steps, et_tuple, caps, qmax=qmax))
                     kern.lower(i32((c0,), np.int32), i32((c0,), np.int32),
                                ecnt, e0, *args[1:]).compile()
+                    with self._lock:
+                        self._prewarmed_shapes.add(shape_key)
+                        self.stats["prewarm_compiled"] += 1
                 for B in sorted(int(w) for w in
                                 str(flags.get("go_batch_widths") or
                                     "128,1024").split(",") if w.strip()):
@@ -1013,6 +1050,11 @@ class TpuQueryRuntime:
                             ix, steps, et_tuple, pack=True))
                     kern.lower(i32((ix.n_rows + 1, B), np.int8),
                                *args).compile()
+                    with self._lock:
+                        self._prewarmed_shapes.add(
+                            ("ell_go", ix.shape_sig(), et_tuple, steps,
+                             B))
+                        self.stats["prewarm_compiled"] += 1
             except Exception:   # noqa: BLE001 — pre-warm must never
                 pass            # disturb serving
 

@@ -627,3 +627,19 @@ def test_graphd_per_statement_stats(tmp_path):
         assert (S.read_stats("graph.error.qps.count.3600") or 0) > e0 + 0
     finally:
         c.stop()
+
+
+def test_micro_bench_tool_runs():
+    """tools/micro_bench must produce sane rates for every component
+    (the reference's ParserBenchmark/RowReaderBenchmark/
+    MultiVersionBenchmark analogues, recorded in BASELINE.md)."""
+    from nebula_tpu.tools import micro_bench as MB
+    out = {
+        "parser": MB.bench_parser(5),
+        "row_codec": MB.bench_codec(2000),
+        "key_codec": MB.bench_keys(2000),
+        "wal": MB.bench_wal(500),
+    }
+    assert out["parser"]["statements_per_s"] > 0
+    assert out["row_codec"]["encode_rows_per_s"] > 0
+    assert out["wal"]["append_entries_per_s"] > 0

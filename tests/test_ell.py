@@ -555,7 +555,7 @@ def test_frontier_sharded_sparse_go_bitmatch():
         caps = tuple(min(1 << 12, 8 * (16 ** h) * 8)
                      for h in range(steps))
         kern = E.make_frontier_sharded_sparse_go_kernel(
-            mesh, "parts", ix, sh, steps, (1,), caps,
+            mesh, "parts", sh, steps, (1,), caps,
             cap_x=1 << 11, cap_e=64)
         new_ids, qids = [], []
         for q, s in enumerate(starts):
