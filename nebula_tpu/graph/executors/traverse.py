@@ -1145,11 +1145,16 @@ class MatchExecutor(Executor):
                 return t.type == "SYM" and t.value == v
 
             while toks[i].type != "EOF":
-                # id(<var>)
-                if is_id(i, "id") and sym(i + 1, "(") \
+                # id(<var>) — case-insensitive like every nGQL keyword
+                if is_id(i) and toks[i].value.lower() == "id" \
+                        and sym(i + 1, "(") \
                         and is_id(i + 2) and sym(i + 3, ")") \
                         and toks[i + 2].value in pat_vars:
                     v = toks[i + 2].value
+                    if v == s.e_var:
+                        raise ExecError(
+                            f"id({v}): {v} is the edge variable; edges "
+                            f"have no vertex id")
                     out.append(f"{alias}._src " if v == s.a_var
                                else f"{alias}._dst ")
                     i += 4
@@ -1202,9 +1207,21 @@ class MatchExecutor(Executor):
         # WHERE: split the anchor conjuncts (id(a) == vid) off the
         # predicate tree; the rest travels as the GO filter
         from ...filter.expressions import (EdgeSrcIdExpr, LogicalExpr,
-                                           PrimaryExpr, RelationalExpr)
+                                           PrimaryExpr, RelationalExpr,
+                                           UnaryExpr)
         vids: List[int] = []
         remnant = None
+
+        def int_literal(e) -> Optional[int]:
+            # vids are signed: -5 parses as UnaryExpr('-', Primary(5))
+            if isinstance(e, UnaryExpr) and e.op == "-":
+                inner = int_literal(e.operand)
+                return None if inner is None else -inner
+            if isinstance(e, PrimaryExpr) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                return int(e.value)
+            return None
+
         if s.where_text:
             tree = parse_with("p_expression",
                               rewrite(s.where_text, "WHERE"))
@@ -1219,12 +1236,11 @@ class MatchExecutor(Executor):
                     l, r = e.left, e.right
                     if isinstance(r, EdgeSrcIdExpr):
                         l, r = r, l
-                    if isinstance(l, EdgeSrcIdExpr) \
-                            and isinstance(r, PrimaryExpr) \
-                            and isinstance(r.value, int) \
-                            and not isinstance(r.value, bool):
-                        vids.append(int(r.value))
-                        return
+                    if isinstance(l, EdgeSrcIdExpr):
+                        lit = int_literal(r)
+                        if lit is not None:
+                            vids.append(lit)
+                            return
                 remnant = e if remnant is None else \
                     LogicalExpr("&&", remnant, e)
 

@@ -269,14 +269,31 @@ def build_mirror_bulk(space_id: int, stores, schema_man
     """Vectorized equivalent of csr.build_mirror, or None when the
     native codec is unavailable / the scan looks structurally wrong
     (caller then runs the per-row builder)."""
+    import sys
+    import time as _time
     from ..native import lib
     L = lib()
     if L is None or not hasattr(L, "neb_parse_keys"):
         return None
     sm = schema_man
+
+    t_last = [_time.perf_counter()]
+    trace = [False]      # stage timing for 10M+-row folds (the fold is
+                         # a recorded scale-bench stage; silent minutes
+                         # inside it are undiagnosable after the fact)
+
+    def tick(stage: str) -> None:
+        now = _time.perf_counter()
+        if trace[0]:
+            sys.stderr.write(
+                f"  mirror fold: {stage} {now - t_last[0]:.1f}s\n")
+        t_last[0] = now
+
     arena = _parse_arena(space_id, stores)
     if arena is None:
         return None
+    trace[0] = len(arena.kind) > 10_000_000
+    tick("scan+parse")
     if (arena.kind == 0).any():
         return None                  # unknown key shapes: slow path
 
@@ -293,23 +310,36 @@ def build_mirror_bulk(space_id: int, stores, schema_man
     if len(v_rows):
         keep_v = _dedup_first(arena.a[v_rows], arena.b[v_rows])
         v_rows = v_rows[keep_v]
+    tick("dedup")
 
     e_src = arena.a[e_rows]
     e_dst = arena.d[e_rows]
     mirror = CsrMirror(space_id)
 
     # ---- dense vertex space (slow-path parity: endpoints of even
-    # TTL-dropped edges participate — the filter runs after) ----------
-    mirror.vids = np.unique(np.concatenate(
-        [arena.a[v_rows], e_src, e_dst])) if (len(v_rows) or len(e_rows)) \
-        else np.zeros(0, dtype=np.int64)
+    # TTL-dropped edges participate — the filter runs after).  The
+    # dense ids come from unique's OWN inverse mapping — a separate
+    # searchsorted per endpoint array measured ~380 ns/lookup at
+    # 16M-vertex tables (cache-hostile binary search), dominating the
+    # fold at 10^8 rows ------------------------------------------------
+    if len(v_rows) or len(e_rows):
+        allv = np.concatenate([arena.a[v_rows], e_src, e_dst])
+        mirror.vids, inv = np.unique(allv, return_inverse=True)
+        nv = len(v_rows)
+        v_dense = inv[:nv].astype(np.int64)
+        src_d = inv[nv:nv + len(e_rows)].astype(np.int32)
+        dst_d = inv[nv + len(e_rows):].astype(np.int32)
+        del allv, inv
+    else:
+        mirror.vids = np.zeros(0, dtype=np.int64)
+        v_dense = np.zeros(0, dtype=np.int64)
+        src_d = dst_d = np.zeros(0, dtype=np.int32)
     mirror.n = n = len(mirror.vids)
+    tick("dense ids")
 
     m = len(e_rows)
     mirror.m = m
     if m:
-        src_d = np.searchsorted(mirror.vids, e_src).astype(np.int32)
-        dst_d = np.searchsorted(mirror.vids, e_dst).astype(np.int32)
         etype_a = arena.b[e_rows]
         rank_a = arena.c[e_rows]
         order = _edge_sort_order(src_d, etype_a, rank_a, dst_d)
@@ -318,6 +348,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
         mirror.edge_etype = etype_a[order].astype(np.int32)
         mirror.edge_rank = rank_a[order]
         e_rows_sorted = e_rows[order]
+        tick("edge sort")
 
         etypes_present = np.unique(mirror.edge_etype)
         cols: Dict[Tuple[int, str], Column] = {}
@@ -348,6 +379,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
                 return None
             if drop.any():
                 keep[grp[drop]] = False
+        tick("edge columns")
         if not keep.all():
             mirror.edge_src = mirror.edge_src[keep]
             mirror.edge_dst = mirror.edge_dst[keep]
@@ -388,7 +420,7 @@ def build_mirror_bulk(space_id: int, stores, schema_man
         if schema is None:
             continue
         grp = np.nonzero(v_tag == t)[0]
-        di = np.searchsorted(mirror.vids, v_vid[grp]).astype(np.int64)
+        di = v_dense[grp]
         t_cols = {name: c for (t2, name), c in vcols.items() if t2 == t}
         has_row = np.zeros(len(grp), dtype=bool)
 
@@ -404,4 +436,5 @@ def build_mirror_bulk(space_id: int, stores, schema_man
     for c in vcols.values():
         c.finalize()
     mirror.vertex_cols = vcols
+    tick("vertex columns")
     return mirror

@@ -776,16 +776,21 @@ class TpuQueryRuntime:
                 return w
         return None
 
-    def _note_live_shape(self, shape_key: Tuple) -> None:
+    def _note_live_shape(self, shape_key: Tuple,
+                         first_of_family: bool = False) -> None:
         """First live dispatch of a pinned kernel shape: was it
-        pre-warmed?  (Called before the kernel invocation so the
-        hit/miss reflects what the live call will experience.)"""
+        pre-warmed?  The FAMILY-TRIGGERING shape (the very first query
+        of an (OVER, steps) family — the one whose arrival STARTS the
+        background warm) is registered uncounted: nothing could have
+        warmed it, so neither hit nor miss is meaningful for it."""
         if shape_key in self._live_shapes:
             return
         with self._lock:
             if shape_key in self._live_shapes:
                 return
             self._live_shapes.add(shape_key)
+            if first_of_family:
+                return
             if shape_key in self._prewarmed_shapes:
                 self.stats["prewarm_hits"] += 1
             else:
@@ -805,6 +810,8 @@ class TpuQueryRuntime:
             ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax),
             lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
                                                   caps, qmax=qmax))
+        first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
+                                                 set())
         self._prewarm_family(m, ix, et_tuple, steps, skip_c0=c0)
         S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
@@ -815,7 +822,7 @@ class TpuQueryRuntime:
         qid[:S] = q_all[order]
         ecnt, e0 = self._hub_expansion_dev(m, ix)
         self._note_live_shape(("sparse_go", ix.shape_sig(), et_tuple,
-                               steps, c0))
+                               steps, c0), first_of_family=first)
         out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                        *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
@@ -955,8 +962,10 @@ class TpuQueryRuntime:
                 ("ell_go", ix.shape_sig(), et_tuple, steps),
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
                                                pack=True))
+            first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
+                                                     set())
             self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
-                                   steps, B))
+                                   steps, B), first_of_family=first)
             out_dev = kern(f0_dev, *args)
             self._prewarm_family(m, ix, et_tuple, steps)
         self.stats["go_dense"] += 1
@@ -1023,10 +1032,7 @@ class TpuQueryRuntime:
                     shape_key = ("sparse_go", ix.shape_sig(), et_tuple,
                                  steps, c0)
                     if c0 == skip_c0:
-                        # the live first query compiled this rung
-                        with self._lock:
-                            self._prewarmed_shapes.add(shape_key)
-                        continue
+                        continue   # the triggering live query compiled
                     caps = sparse_caps(c0, d_max, steps, cap,
                                        growth=growth)
                     kern = self._kernel(

@@ -61,3 +61,54 @@ def test_e2e_routing_preserves_results():
     finally:
         flags.set("go_backend_router", prev)
         c.stop()
+
+
+class TestRouterSoak:
+    """Regime-change convergence + probe overhead — the soak the
+    default-on decision rests on (etc/*.conf.default ships
+    go_backend_router=true)."""
+
+    def test_converges_after_regime_flip(self):
+        from nebula_tpu.graph.backend_router import BackendRouter
+        r = BackendRouter()
+        key = (1, (1,), 3)
+        # regime 1: device 2 ms, cpu 10 ms -> router must settle device
+        for _ in range(200):
+            pick = r.choose(key)
+            r.record(key, pick, 0.002 if pick == "device" else 0.010)
+        d0 = r.stats["routed_device"]
+        c0 = r.stats["routed_cpu"]
+        assert d0 > 4 * c0, (d0, c0)
+        # regime 2 (graph grew 100x: the dense dispatch now dominates):
+        # device 50 ms, cpu 5 ms -> must converge to cpu within a few
+        # probe periods
+        flip_at = None
+        for i in range(300):
+            pick = r.choose(key)
+            r.record(key, pick, 0.050 if pick == "device" else 0.005)
+            if flip_at is None and pick == "cpu" \
+                    and r._fams[key].device_s > r._fams[key].cpu_s:
+                flip_at = i
+        assert flip_at is not None and flip_at <= 100, flip_at
+        # after convergence the slower path only sees the probe stream
+        d1, c1 = r.stats["routed_device"], r.stats["routed_cpu"]
+        for _ in range(200):
+            pick = r.choose(key)
+            r.record(key, pick, 0.050 if pick == "device" else 0.005)
+        probes_to_device = r.stats["routed_device"] - d1
+        assert probes_to_device <= 200 // 20, probes_to_device
+
+    def test_probe_overhead_bounded(self):
+        from nebula_tpu.common.flags import flags
+        from nebula_tpu.graph.backend_router import BackendRouter
+        r = BackendRouter()
+        key = (2, (1,), 2)
+        n = 2000
+        probe_every = int(flags.get("go_router_probe_every") or 25)
+        for _ in range(n):
+            pick = r.choose(key)
+            r.record(key, pick, 0.001 if pick == "device" else 0.008)
+        # probe stream = 1/probe_every of steady-state traffic (+ the
+        # cold-start alternation)
+        assert r.stats["probes"] <= n // probe_every + 2
+        assert r.stats["routed_cpu"] <= n // probe_every + 10
