@@ -187,34 +187,27 @@ def main():
     # ---- served path: embedded cluster, bulk-loaded graph -----------
     log(f"loading {m:,} edges into the cluster...")
     from nebula_tpu.codec.rows import encode_row
-    from nebula_tpu.common.clock import inverted_version
-    from nebula_tpu.common.keys import KeyUtils, id_hash
+    from nebula_tpu.tools import bulk_load as BL
 
     c = LocalCluster(num_storage=1, tpu_backend=True)
     try:
         space_id, _tag, etype = ensure_perf_space(c.graph_meta_client)
         c.refresh_all()
-        # bulk load straight through the store (the statement/RPC write
+        # bulk load via the ingest path (sorted-run frames + hinted
+        # engine inserts, tools/bulk_load.py — the statement/RPC write
         # path would dominate setup; the write path has its own perf
-        # tool — tools/storage_perf.py)
+        # tool, tools/storage_perf.py)
         kv = c.storage_nodes[0].kv
-        parts = kv.part_ids(space_id)
-        nparts = len(parts)
+        nparts = len(kv.part_ids(space_id))
         schema = c.schema_man.get_edge_schema(space_id, etype)
-        ver = inverted_version()
-        by_part = {p: [] for p in parts}
-        for i in range(m):
-            s, d = int(edge_src[i]) + 1, int(edge_dst[i]) + 1
-            val = encode_row(schema, {"w": i % 97})
-            by_part[id_hash(s, nparts)].append(
-                (KeyUtils.edge_key(id_hash(s, nparts), s, etype, 0, d,
-                                   ver), val))
-            by_part[id_hash(d, nparts)].append(
-                (KeyUtils.edge_key(id_hash(d, nparts), d, -etype, 0, s,
-                                   ver), val))
-        for p, kvs in by_part.items():
-            for lo in range(0, len(kvs), 65536):
-                kv.multi_put(space_id, p, kvs[lo:lo + 65536])
+        blobs = [encode_row(schema, {"w": i}) for i in range(97)]
+        st = BL.bulk_load(
+            kv, space_id, "/tmp/bench_staging",
+            [BL.edge_frames(nparts, etype,
+                            edge_src.astype(np.int64) + 1,
+                            edge_dst.astype(np.int64) + 1, blobs,
+                            (np.arange(m) % 97).astype(np.int64))])
+        assert st.ok(), st
         log("loaded; measuring CPU executor path...")
 
         rng = np.random.default_rng(11)

@@ -71,7 +71,7 @@ def _frames_varlen(keys: np.ndarray, blobs: List[bytes],
     np.cumsum(8 + klen + vlen, out=off[1:])
     buf = np.empty(int(off[-1]), np.uint8)
     base = off[:-1]
-    kl_b = np.frombuffer(np.uint32(klen).byteswap().tobytes(), np.uint8)
+    kl_b = np.frombuffer(np.array(klen, ">u4").tobytes(), np.uint8)
     pos = base.copy()           # one running index array: per-byte
     for i in range(4):          # scatters reuse it instead of paying a
         buf[pos] = kl_b[i]      # fresh base+i allocation each pass
@@ -98,6 +98,19 @@ def _frames_varlen(keys: np.ndarray, blobs: List[bytes],
         for i in range(L):
             buf[rb + i] = rv[:, i]
     return buf, off
+
+
+def _split_by_part(parts: np.ndarray, nparts: int, buf: np.ndarray,
+                   off: np.ndarray) -> Dict[int, List[np.ndarray]]:
+    """Slice a part-major frame buffer into per-part byte views
+    (``parts`` must be sorted ascending — both frame builders sort
+    part-major)."""
+    out: Dict[int, List[np.ndarray]] = {}
+    bounds = np.searchsorted(parts, np.arange(nparts + 2))
+    for p in np.unique(parts).tolist():
+        lo, hi = int(off[bounds[p]]), int(off[bounds[p + 1]])
+        out[int(p)] = [buf[lo:hi]]
+    return out
 
 
 def edge_frames(nparts: int, etype: int, src: np.ndarray, dst: np.ndarray,
@@ -161,12 +174,7 @@ def edge_frames(nparts: int, etype: int, src: np.ndarray, dst: np.ndarray,
     keys["dst"] = _flip64(other)
     keys["ver"] = _flip64(np.full(n2, ver, np.int64))
     buf, off = _frames_varlen(keys, blobs, vidx2)
-    out: Dict[int, List[np.ndarray]] = {}
-    bounds = np.searchsorted(parts, np.arange(nparts + 2))
-    for p in np.unique(parts).tolist():
-        lo, hi = int(off[bounds[p]]), int(off[bounds[p + 1]])
-        out[int(p)] = [buf[lo:hi]]
-    return out
+    return _split_by_part(parts, nparts, buf, off)
 
 
 def vertex_frames(nparts: int, tag_id: int, vids: np.ndarray,
@@ -189,12 +197,7 @@ def vertex_frames(nparts: int, tag_id: int, vids: np.ndarray,
     keys["tag"] = _flip32(np.full(n, tag_id, np.int64))
     keys["ver"] = _flip64(np.full(n, ver, np.int64))
     buf, off = _frames_varlen(keys, blobs, val_idx)
-    out: Dict[int, List[np.ndarray]] = {}
-    bounds = np.searchsorted(parts, np.arange(nparts + 2))
-    for p in np.unique(parts).tolist():
-        lo, hi = int(off[bounds[p]]), int(off[bounds[p + 1]])
-        out[int(p)] = [buf[lo:hi]]
-    return out
+    return _split_by_part(parts, nparts, buf, off)
 
 
 def _assert_be(c: np.ndarray) -> np.ndarray:

@@ -241,9 +241,8 @@ def main():
             log(f"tpu path ({hops} hops): {out['tpu' + tag]}")
             flags.set("storage_backend", "cpu")
             flags.set("flat_bound_mode", True)
-            nc = args.cpu_queries if not tag else args.cpu_queries // 2
-            out["cpu_flat" + tag] = serve(c, "scale", queries[:nc],
-                                          args.workers)
+            out["cpu_flat" + tag] = serve(
+                c, "scale", queries[:args.cpu_queries], args.workers)
             log(f"cpu flat path ({hops} hops): {out['cpu_flat' + tag]}")
             out["p50_speedup_vs_flat_cpu" + tag] = round(
                 out["cpu_flat" + tag]["p50_ms"]
@@ -257,9 +256,11 @@ def main():
                                            "max_batch", "query_errors")}
 
         # ---- parity spot-check --------------------------------------
+        parity_qs = [f"GO {max(args.steps, 2)} STEPS FROM {v} OVER knows"
+                     for v in starts[:3]]
         gq = c.client()
         gq.execute("USE scale")
-        for q in queries[:3]:
+        for q in parity_qs:
             flags.set("storage_backend", "cpu")
             a = sorted(map(tuple, gq.execute(q).rows))
             flags.set("storage_backend", "tpu")

@@ -962,12 +962,15 @@ class TpuQueryRuntime:
                 ("ell_go", ix.shape_sig(), et_tuple, steps),
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
                                                pack=True))
+            # family registration BEFORE the first/_note check (like
+            # the sparse path): same-family queries racing the first
+            # compile must still be counted against the warm
             first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
                                                      set())
+            self._prewarm_family(m, ix, et_tuple, steps)
             self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
                                    steps, B), first_of_family=first)
             out_dev = kern(f0_dev, *args)
-            self._prewarm_family(m, ix, et_tuple, steps)
         self.stats["go_dense"] += 1
 
         def resolve():
