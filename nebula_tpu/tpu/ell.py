@@ -1107,47 +1107,19 @@ def split_start_pairs_by_owner(sh: ShardedEll, new_ids: np.ndarray,
     return ids, qid
 
 
-def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
-                                           sh: ShardedEll, steps: int,
-                                           etypes: Tuple[int, ...],
-                                           caps: Tuple[int, ...],
-                                           cap_x: int, cap_e: int):
-    """Frontier-sharded sparse batched GO over a 1-D mesh.
-
-    ``caps`` are PER-DEVICE pair capacities per hop (total frontier
-    capacity = k * caps[h]); ``cap_x`` bounds candidates shipped
-    between any (source, destination) device pair per hop; ``cap_e``
-    bounds hub extra-row pairs shipped per device pair.  Any exceeded
-    bound sets the overflow flag on every device — exactness falls
-    back, never correctness.
-
-    fn(ids0 [k, caps[0]], qid0 [k, caps[0]], starts, ecnt, e0,
-       *bucket tables) -> int32 [k, 2 + 2*caps[-1]] — per device
-    [count, overflow, qids..., global row ids...], pairs sorted by
-    (qid, row).
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-
-    # static metadata is COPIED out of ``sh`` here: the jitted kernel
-    # lives in the runtime's kernel cache keyed by table SHAPES, so
-    # closing over the ShardedEll itself would pin its cached device
-    # tables (gigabytes) long after the mirror it came from is replaced
-    k, chunk = sh.k, sh.chunk
-    n, n_rows = sh.n, sh.n_rows
-    bstarts = list(sh.bstarts)
-    Ds = list(sh.D)
+def _mesh_sparse_tools(jnp, jax, axis: str, k: int, chunk: int,
+                       n: int, n_rows: int, bstarts, Ds,
+                       etypes: Tuple[int, ...]):
+    """Per-device building blocks shared by the frontier-sharded GO and
+    BFS kernels: the local bucket-block gather, the all_to_all router,
+    the owner-side pair dedup, and the local hub expansion.  All static
+    metadata arrives as plain ints/lists so the returned closures never
+    pin a ShardedEll (whose device-table cache is gigabytes)."""
     sentinel = n_rows
     neg = tuple(-t for t in etypes)
     d_max = max(Ds) if Ds else 1
     nb_count = len(Ds)
-    has_hubs = sh.n_extras > 0
     BIG_Q = jnp.int32(2**30)
-    del sh
-
-    # static global [start, end) of each bucket's rows
     bucket_end = [bstarts[b + 1] if b + 1 < nb_count else n_rows
                   for b in range(nb_count)]
 
@@ -1193,6 +1165,13 @@ def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
         u_x = jnp.where(take, su[idxc], sentinel)
         return q_x, u_x, overflow
 
+    def exchange(q, u, slot_cap):
+        """route + all_to_all in one step -> flat received pairs."""
+        rq, ru, ovf = route(q, u, slot_cap)
+        q_r = jax.lax.all_to_all(rq, axis, 0, 0, tiled=False)
+        u_r = jax.lax.all_to_all(ru, axis, 0, 0, tiled=False)
+        return q_r.reshape(-1), u_r.reshape(-1), ovf
+
     def dedup_compact(q, u, c_out):
         """Sort + unique (q, u) pairs, compact to c_out."""
         valid = u != sentinel
@@ -1221,6 +1200,52 @@ def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
         raw = jnp.where(u == sentinel, 0, ecnt_l[li])
         return _segmented_hub_iota(jnp, raw, e0_l[li], q, EX, sentinel,
                                    BIG_Q)
+
+    return local_gather, route, exchange, dedup_compact, \
+        expand_local_hubs, BIG_Q
+
+
+def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
+                                           sh: ShardedEll, steps: int,
+                                           etypes: Tuple[int, ...],
+                                           caps: Tuple[int, ...],
+                                           cap_x: int, cap_e: int):
+    """Frontier-sharded sparse batched GO over a 1-D mesh.
+
+    ``caps`` are PER-DEVICE pair capacities per hop (total frontier
+    capacity = k * caps[h]); ``cap_x`` bounds candidates shipped
+    between any (source, destination) device pair per hop; ``cap_e``
+    bounds hub extra-row pairs shipped per device pair.  Any exceeded
+    bound sets the overflow flag on every device — exactness falls
+    back, never correctness.
+
+    fn(ids0 [k, caps[0]], qid0 [k, caps[0]], starts, ecnt, e0,
+       *bucket tables) -> int32 [k, 2 + 2*caps[-1]] — per device
+    [count, overflow, qids..., global row ids...], pairs sorted by
+    (qid, row).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    # static metadata is COPIED out of ``sh`` here: the jitted kernel
+    # lives in the runtime's kernel cache keyed by table SHAPES, so
+    # closing over the ShardedEll itself would pin its cached device
+    # tables (gigabytes) long after the mirror it came from is replaced
+    k, chunk = sh.k, sh.chunk
+    n, n_rows = sh.n, sh.n_rows
+    bstarts = list(sh.bstarts)
+    Ds = list(sh.D)
+    sentinel = n_rows
+    d_max = max(Ds) if Ds else 1
+    nb_count = len(Ds)
+    has_hubs = sh.n_extras > 0
+    del sh
+
+    (local_gather, route, _exchange, dedup_compact, expand_local_hubs,
+     BIG_Q) = _mesh_sparse_tools(jnp, jax, axis, k, chunk, n, n_rows,
+                                 bstarts, Ds, etypes)
 
     def per_device(ids0, qid0, starts, ecnt_l, e0_l, *tables):
         # leading mesh dim of 1 from shard_map: squeeze
@@ -1305,3 +1330,151 @@ def sharded_sparse_pairs(out: np.ndarray):
         qs.append(q[live])
         us.append(u[live])
     return overflow, np.concatenate(qs), np.concatenate(us)
+
+
+def make_frontier_sharded_sparse_bfs_kernel(mesh, axis: str,
+                                            sh: ShardedEll,
+                                            max_steps: int,
+                                            etypes: Tuple[int, ...],
+                                            cap: int, cap_x: int,
+                                            cap_e: int,
+                                            stop_when_found: bool = True):
+    """Frontier-sharded batched BFS — FIND PATH's multi-chip device
+    half with per-chip memory graph/k + depth/k (the replicated design
+    keeps every chip holding the whole [n_rows+1, B] state; this one
+    shards the depth matrix by the same vertex chunks the GO kernel
+    uses and exchanges frontier pairs via all_to_all per level).
+
+    Per level: local out-slot gather over the device's live pairs (+
+    hub extra rows) -> route candidates to their owner -> owner keeps
+    only rows whose depth is still unset, stamps them with the level,
+    and they become the next local frontier.  Early exit mirrors
+    make_batched_bfs_kernel: stop when every query stalled or (shortest
+    mode) covered its targets — both reductions ride a psum.
+
+    fn(ids0 [k, cap], qid0 [k, cap], tids [k, cap], tqid [k, cap],
+       starts, ecnt, e0, *bucket tables) ->
+    (depth [k, chunk, B] int16 (INT16_INF = unreached, rows in global
+    new-id order chunk-major), overflow [k] int32) — a frontier or
+    exchange outgrowing its cap flags overflow on every device and the
+    caller reruns on the replicated-frontier kernel.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    k, chunk = sh.k, sh.chunk
+    n, n_rows = sh.n, sh.n_rows
+    bstarts = list(sh.bstarts)
+    Ds = list(sh.D)
+    nb_count = len(Ds)
+    has_hubs = sh.n_extras > 0
+    sentinel = n_rows
+    del sh
+
+    (local_gather, _route, exchange, dedup_compact, expand_local_hubs,
+     BIG_Q) = _mesh_sparse_tools(jnp, jax, axis, k, chunk, n, n_rows,
+                                 bstarts, Ds, etypes)
+
+    def build(qmax: int):
+        # qmax bounds the depth matrix's query axis [chunk, qmax]
+        def per_device(ids0, qid0, tids, tqid, starts, ecnt_l, e0_l,
+                       *tables):
+            ids = ids0[0]
+            qid = jnp.where(ids == sentinel, BIG_Q, qid0[0])
+            t_i, t_q = tids[0], tqid[0]
+            starts_l = starts[0]
+            ecnt_l, e0_l = ecnt_l[0], e0_l[0]
+            nbrs = [t[0] for t in tables[:nb_count]]
+            ets = [t[0] for t in tables[nb_count:]]
+            d = jax.lax.axis_index(axis)
+            base = (d * chunk).astype(jnp.int32)
+
+            depth = jnp.full((chunk, qmax), INT16_INF, jnp.int16)
+            li0 = jnp.clip(ids - base, 0, chunk - 1)
+            q0 = jnp.clip(qid, 0, qmax - 1)
+            live0 = ids != sentinel
+            depth = depth.at[li0, q0].min(
+                jnp.where(live0, jnp.int16(0), INT16_INF))
+            # local target mask [chunk, qmax]
+            tgt = jnp.zeros((chunk, qmax), jnp.int8)
+            tli = jnp.clip(t_i - base, 0, chunk - 1)
+            tq = jnp.clip(t_q, 0, qmax - 1)
+            tgt = tgt.at[tli, tq].max(
+                jnp.where(t_i != sentinel, jnp.int8(1), jnp.int8(0)))
+
+            def unfound_any(dep):
+                u = jnp.any((tgt > 0) & (dep == INT16_INF))
+                return jax.lax.psum(u.astype(jnp.int32), axis) > 0
+
+            def frontier_any(i):
+                c = jnp.sum((i != sentinel).astype(jnp.int32))
+                return jax.lax.psum(c, axis) > 0
+
+            def hub_pairs(q, u):
+                if not has_hubs:
+                    return (jnp.full((1,), jnp.int32(sentinel)),
+                            jnp.full((1,), BIG_Q), jnp.bool_(False))
+                er, eq, ovf = expand_local_hubs(q, u, ecnt_l, e0_l,
+                                                base, EX=u.shape[0])
+                eq2, er2, ovf_r = exchange(eq, er, cap_e)
+                return er2, eq2, ovf | ovf_r
+
+            def body(state):
+                dep, ids, qid, step, _go, ovf = state
+                er, eq, ovf_h = hub_pairs(qid, ids)
+                g_rows = jnp.concatenate([ids, er])
+                g_q = jnp.concatenate([qid, eq])
+                cand = local_gather(g_rows, nbrs, ets, starts_l)
+                flat_u = cand.reshape(-1)
+                flat_q = jnp.repeat(g_q, cand.shape[1])
+                q_r, u_r, ovf_x = exchange(flat_q, flat_u, cap_x)
+                nq2, nu2, ovf_c, _cnt = dedup_compact(q_r, u_r, cap)
+                # newly discovered = depth still unset at the owner
+                li = jnp.clip(nu2 - base, 0, chunk - 1)
+                qi = jnp.clip(nq2, 0, qmax - 1)
+                fresh = (nu2 != sentinel) \
+                    & (dep[li, qi] == INT16_INF)
+                dep = dep.at[li, qi].min(
+                    jnp.where(fresh, (step + 1).astype(jnp.int16),
+                              INT16_INF))
+                ids2 = jnp.where(fresh, nu2, sentinel)
+                qid2 = jnp.where(fresh, nq2, BIG_Q)
+                # overflow must be GLOBALLY agreed before it feeds the
+                # loop condition: a device-local flag would make devices
+                # disagree on whether to run another level, and the next
+                # iteration's all_to_all deadlocks waiting for the
+                # devices that already exited
+                ovf_l = ovf_h | ovf_x | ovf_c
+                ovf = ovf | (jax.lax.psum(ovf_l.astype(jnp.int32),
+                                          axis) > 0)
+                step = step + 1
+                go_on = (step < max_steps) & frontier_any(ids2)
+                if stop_when_found:
+                    go_on = go_on & unfound_any(dep)
+                return dep, ids2, qid2, step, go_on, ovf
+
+            def cond(state):
+                return state[4] & jnp.logical_not(state[5])
+
+            pad_ids = jnp.full((cap,), jnp.int32(sentinel))
+            pad_q = jnp.full((cap,), BIG_Q)
+            ids_c = pad_ids.at[:ids.shape[0]].set(ids)
+            qid_c = pad_q.at[:qid.shape[0]].set(qid)
+            go0 = frontier_any(ids_c) & jnp.bool_(max_steps > 0)
+            if stop_when_found:
+                go0 = go0 & unfound_any(depth)
+            state = (depth, ids_c, qid_c, jnp.int32(0), go0,
+                     jnp.bool_(False))
+            dep, _i, _q, _s, _g, ovf = jax.lax.while_loop(
+                cond, body, state)
+            return dep[None], ovf.astype(jnp.int32)[None]
+
+        in_spec = (P(axis),) * (7 + 2 * nb_count)
+        return jax.jit(shard_map(per_device, mesh=mesh,
+                                 in_specs=in_spec,
+                                 out_specs=(P(axis), P(axis)),
+                                 check_vma=False))
+
+    return build

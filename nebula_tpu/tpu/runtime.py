@@ -1994,6 +1994,14 @@ class TpuQueryRuntime:
         ix = self.ell(m)
         nq = len(starts_per_query)
         B = self._batch_width(nq)
+        mesh = self._mesh_only()
+        if mesh is not None and flags.get("tpu_mesh_mode") == "sparse":
+            d = self._mesh_sparse_bfs(space_id, m, ix, starts_per_query,
+                                      targets_per_query, et_tuple,
+                                      max_steps, shortest, B, mesh)
+            if d is not None:
+                return d
+            # placement/overflow: replicated-frontier fallback below
         args = ix.kernel_args()
         mt = self._mesh_tables(m, ix)
         if mt is None:
@@ -2011,21 +2019,10 @@ class TpuQueryRuntime:
                     mesh, "parts", ix, max_steps, et_tuple, nbrs, ets,
                     reals, stop_when_found=shortest))
             table_args = (args[0], *nbrs, *ets)
-        def flat_coords(per_query):
-            lens = [len(s) for s in per_query]
-            flat: List[int] = []
-            for s in per_query:
-                flat.extend(int(v) for v in s)
-            d = m.to_dense(flat)
-            q = np.repeat(np.arange(nq, dtype=np.int32),
-                          np.asarray(lens, np.int64))
-            keep = d >= 0
-            return ix.perm[d[keep]], q[keep]
-
-        f0_dev = self._upload_frontier(ix, *flat_coords(starts_per_query),
-                                       B)
-        t0_dev = self._upload_frontier(ix, *flat_coords(targets_per_query),
-                                       B)
+        f0_dev = self._upload_frontier(
+            ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
+        t0_dev = self._upload_frontier(
+            ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
         self.stats["path_device"] += nq
         d_dev = kern(f0_dev, t0_dev, *table_args)
         nqp = min(B, max(8, -(-nq // 8) * 8))
@@ -2035,6 +2032,88 @@ class TpuQueryRuntime:
         else:
             d = host
         return ix.to_old(d).T
+
+    @staticmethod
+    def _flat_coords(m: CsrMirror, ix: EllIndex, per_query, nq: int):
+        """Per-query vid lists -> flat (new-id rows, query ids) with
+        unknown vids dropped — the ONE coordinate-flattening used by
+        both the replicated and frontier-sharded BFS paths (their
+        results are bit-matched fallbacks of each other, so start
+        placement must never diverge)."""
+        lens = [len(s) for s in per_query]
+        flat: List[int] = []
+        for s in per_query:
+            flat.extend(int(v) for v in s)
+        d = m.to_dense(flat)
+        q = np.repeat(np.arange(nq, dtype=np.int32),
+                      np.asarray(lens, np.int64))
+        keep = d >= 0
+        return ix.perm[d[keep]], q[keep]
+
+    def _mesh_sparse_bfs(self, space_id: int, m: CsrMirror,
+                         ix: EllIndex, starts_per_query,
+                         targets_per_query, et_tuple: Tuple[int, ...],
+                         max_steps: int, shortest: bool, B: int, mesh):
+        """Frontier-sharded BFS depths (per-chip memory graph/k +
+        depth/k — ell.make_frontier_sharded_sparse_bfs_kernel), or None
+        when pair placement outgrows the per-device cap / the kernel
+        overflows (caller runs the replicated-frontier design)."""
+        from .ell import (INT16_INF, build_sharded_ell,
+                          make_frontier_sharded_sparse_bfs_kernel,
+                          sharded_device_args,
+                          split_start_pairs_by_owner)
+        import jax.numpy as jnp
+        k = mesh.shape["parts"]
+        cached = getattr(m, "_sharded_ell_cache", None)
+        if cached is None or cached[0] != k:
+            sh = build_sharded_ell(ix, k)
+            m._sharded_ell_cache = (k, sh)
+        else:
+            sh = cached[1]
+        nq = len(starts_per_query)
+        cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
+        cap_x = max(256, cap // max(k // 2, 1))
+        cap_e = max(64, cap // 8)
+
+        def place(per_query):
+            rows, q = self._flat_coords(m, ix, per_query, nq)
+            return split_start_pairs_by_owner(
+                sh, rows.astype(np.int32), q, cap)
+
+        ps = place(starts_per_query)
+        pt = place(targets_per_query)
+        if ps is None or pt is None:
+            return None
+        builder = self._kernel(
+            ("mesh_sparse_bfs", ix.shape_sig(), et_tuple, max_steps,
+             shortest, k, cap, cap_x, cap_e),
+            lambda: make_frontier_sharded_sparse_bfs_kernel(
+                mesh, "parts", sh, max_steps, et_tuple,
+                cap, cap_x, cap_e, stop_when_found=shortest))
+        kern = self._kernel(
+            ("mesh_sparse_bfs_b", ix.shape_sig(), et_tuple, max_steps,
+             shortest, k, cap, cap_x, cap_e, B),
+            lambda: builder(B))
+        args = sharded_device_args(mesh, "parts", sh)
+        dep_dev, ovf_dev = kern(
+            jnp.asarray(ps[0]), jnp.asarray(ps[1]),
+            jnp.asarray(pt[0]), jnp.asarray(pt[1]),
+            args[0], args[1], args[2], *args[3], *args[4])
+        if np.asarray(ovf_dev).any():
+            self.stats["sparse_overflows"] += 1
+            return None
+        self.stats["bfs_mesh_sparse"] = \
+            self.stats.get("bfs_mesh_sparse", 0) + 1
+        # device-side column slice before the fetch, like the
+        # replicated path — B-nq padded columns are pure link waste
+        nqp = min(B, max(8, -(-nq // 8) * 8))
+        dep = np.asarray(dep_dev[:, :, :nqp]) \
+            .reshape(k * sh.chunk, nqp)[:, :nq]
+        d16 = np.vstack([dep[:ix.n_rows + 1],
+                         np.full((max(0, ix.n_rows + 1 - len(dep)), nq),
+                                 INT16_INF, np.int16)]) \
+            if len(dep) < ix.n_rows + 1 else dep[:ix.n_rows + 1]
+        return ix.to_old(d16.astype(np.int16)).T
 
     def bfs_batch(self, space_id: int, starts_per_query, targets_per_query,
                   etypes: List[int], max_steps: int,
