@@ -174,6 +174,21 @@ def main():
     from nebula_tpu.tools.perf_fixture import ensure_perf_space, edge
 
     platform = jax.devices()[0].platform
+    # link self-diagnosis: the serving path's per-batch floor is one
+    # execute + one fetch over the device link; record the measured
+    # round-trip so any qps/p50 drift between environments (local chip
+    # vs remote tunnel, quiet vs congested) is attributable from the
+    # JSON alone instead of looking like a regression
+    import jax.numpy as jnp
+    _f = jax.jit(lambda x: x + 1)
+    _x = jnp.zeros((8,), jnp.int32)
+    np.asarray(_f(_x))                       # warm the compile
+    _t0 = time.perf_counter()
+    _reps = 5
+    for _ in range(_reps):
+        np.asarray(_f(_x))
+    tunnel_rtt_ms = (time.perf_counter() - _t0) / _reps * 1000
+    log(f"device link roundtrip (execute+fetch): {tunnel_rtt_ms:.1f} ms")
     if platform == "cpu":   # CI/dev fallback — minutes-scale
         n, m, B, steps = 1 << 14, 1 << 17, 256, 4
         kn, km, kB = 1 << 14, 1 << 17, 128
@@ -302,6 +317,7 @@ def main():
             cpu_flat_r["p50_ms"] / tpu_r["p50_ms"], 2),
         "edges_traversed_per_query": round(traversed_per_query, 1),
         "tpu_run_spread": tpu_spread,
+        "tunnel_rtt_ms": round(tunnel_rtt_ms, 1),
         "workers": threads,
         "graph": f"n=2^{n.bit_length() - 1}, m=2^{m.bit_length() - 1}",
         "config": {"tpu_queries": B, "cpu_queries": threads,

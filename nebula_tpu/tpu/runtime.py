@@ -847,6 +847,20 @@ class TpuQueryRuntime:
 
         return resolve
 
+    @staticmethod
+    def _sharded_ell(m: CsrMirror, ix: EllIndex, k: int):
+        """Per-mirror cache of the k-way sharded ELL view — the ONE
+        cache both mesh entry points (GO and FIND PATH) read, so the
+        two paths can never serve from differently-built tables."""
+        from .ell import build_sharded_ell
+        cached = getattr(m, "_sharded_ell_cache", None)
+        if cached is None or cached[0] != k:
+            sh = build_sharded_ell(ix, k)
+            m._sharded_ell_cache = (k, sh)
+        else:
+            sh = cached[1]
+        return sh
+
     def _launch_mesh_sparse(self, space_id: int, m: CsrMirror,
                             ix: EllIndex, d_all: np.ndarray,
                             q_all: np.ndarray, nq: int,
@@ -858,18 +872,12 @@ class TpuQueryRuntime:
         start placement outgrows the per-device cap (caller falls back
         to the replicated-frontier dense path); overflow inside the
         kernel reruns dense."""
-        from .ell import (build_sharded_ell,
-                          make_frontier_sharded_sparse_go_kernel,
+        from .ell import (make_frontier_sharded_sparse_go_kernel,
                           sharded_device_args, sharded_sparse_pairs,
                           split_start_pairs_by_owner, sparse_caps)
         import jax.numpy as jnp
         k = mesh.shape["parts"]
-        cached = getattr(m, "_sharded_ell_cache", None)
-        if cached is None or cached[0] != k:
-            sh = build_sharded_ell(ix, k)
-            m._sharded_ell_cache = (k, sh)
-        else:
-            sh = cached[1]
+        sh = self._sharded_ell(m, ix, k)
         new = ix.perm[d_all].astype(np.int32)
         placed = split_start_pairs_by_owner(sh, new,
                                             q_all.astype(np.int32), c0)
@@ -2058,18 +2066,13 @@ class TpuQueryRuntime:
         depth/k — ell.make_frontier_sharded_sparse_bfs_kernel), or None
         when pair placement outgrows the per-device cap / the kernel
         overflows (caller runs the replicated-frontier design)."""
-        from .ell import (INT16_INF, build_sharded_ell,
+        from .ell import (INT16_INF,
                           make_frontier_sharded_sparse_bfs_kernel,
                           sharded_device_args,
                           split_start_pairs_by_owner)
         import jax.numpy as jnp
         k = mesh.shape["parts"]
-        cached = getattr(m, "_sharded_ell_cache", None)
-        if cached is None or cached[0] != k:
-            sh = build_sharded_ell(ix, k)
-            m._sharded_ell_cache = (k, sh)
-        else:
-            sh = cached[1]
+        sh = self._sharded_ell(m, ix, k)
         nq = len(starts_per_query)
         cap = int(flags.get("tpu_sparse_cap") or (1 << 17))
         cap_x = max(256, cap // max(k // 2, 1))
@@ -2102,6 +2105,7 @@ class TpuQueryRuntime:
         if np.asarray(ovf_dev).any():
             self.stats["sparse_overflows"] += 1
             return None
+        self.stats["path_device"] += nq
         self.stats["bfs_mesh_sparse"] = \
             self.stats.get("bfs_mesh_sparse", 0) + 1
         # device-side column slice before the fetch, like the

@@ -219,3 +219,105 @@ def test_leader_section_failure_resets_dispatching():
     # the key must still be serviceable
     r, m = d.submit_batched(("exec_batch", 1), 9)
     assert (r, m) == (9, "m")
+
+
+def test_adaptive_window_scales_with_roundtrip():
+    """go_batch_window_ms=-1 (default): the pooling window tracks
+    go_batch_window_frac of the key's EMA batch round-trip, capped at
+    go_batch_window_max_ms — so a ~100 ms-RTT device link pools wide
+    batches while a local chip's ~ms round-trips cost ~no wait.  A key
+    with no completed batch yet must never sleep on a guess."""
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher, _KeyState
+
+    d = GoBatchDispatcher(runtime=None)
+    st = _KeyState()
+    prev = flags.get("go_batch_window_ms")
+    try:
+        flags.set("go_batch_window_ms", -1)
+        assert d._window_s(st) == 0.0            # no sample yet
+        st.rt_ema_s = 0.2                        # 200 ms round trips
+        frac = float(flags.get("go_batch_window_frac"))
+        assert abs(d._window_s(st) - 0.2 * frac) < 1e-9
+        st.rt_ema_s = 30.0                       # compile outlier
+        cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
+        assert d._window_s(st) == cap            # capped
+        flags.set("go_batch_window_ms", 7)       # fixed override wins
+        assert abs(d._window_s(st) - 0.007) < 1e-9
+        flags.set("go_batch_window_ms", 0)       # immediate mode
+        assert d._window_s(st) == 0.0
+    finally:
+        flags.set("go_batch_window_ms", prev)
+
+
+def test_adaptive_window_ema_updates_from_batches():
+    """Completed batches feed the key's round-trip EMA (launch ->
+    results materialized), including two-phase _Pending results; a
+    regime change re-centers the EMA within a few batches."""
+    import time as _time
+
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher
+
+    class FakeRuntime:
+        def exec_batch(self, space_id, payloads):
+            _time.sleep(0.05)
+            return [p for p in payloads], "m"
+
+    d = GoBatchDispatcher(FakeRuntime())
+    key = ("exec_batch", 1)
+    prev = flags.get("go_batch_window_ms")
+    try:
+        flags.set("go_batch_window_ms", -1)
+        d.submit_batched(key, 1)
+        st = d._state(key)
+        first = st.rt_ema_s
+        assert first >= 0.05
+        for _ in range(3):
+            d.submit_batched(key, 2)
+        assert st.rt_ema_s >= 0.05              # stays in regime
+        # the observed window stays proportional and bounded
+        w = d._window_s(st)
+        frac = float(flags.get("go_batch_window_frac"))
+        cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
+        assert w <= cap and w <= st.rt_ema_s * frac + 1e-9
+    finally:
+        flags.set("go_batch_window_ms", prev)
+
+
+def test_adaptive_window_skips_lone_requests_and_honors_zero_caps():
+    """A lone request on an idle key must dispatch immediately even
+    with a warm high-RTT EMA (nothing to pool with), and an operator's
+    EXPLICIT go_batch_window_max_ms=0 / go_batch_window_frac=0 must not
+    be silently replaced by defaults."""
+    import time as _time
+
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher, _KeyState
+
+    class FakeRuntime:
+        def exec_batch(self, space_id, payloads):
+            return [p for p in payloads], "m"
+
+    d = GoBatchDispatcher(FakeRuntime())
+    key = ("exec_batch", 1)
+    prev = flags.get("go_batch_window_ms")
+    try:
+        flags.set("go_batch_window_ms", -1)
+        st = d._state(key)
+        st.rt_ema_s = 1.0                       # warm, high-RTT regime
+        t0 = _time.perf_counter()
+        r, _ = d.submit_batched(key, 5)         # lone request
+        solo_ms = (_time.perf_counter() - t0) * 1000
+        assert r == 5
+        assert solo_ms < 25, f"lone request paid the window: {solo_ms}ms"
+        # explicit zeros are respected, not defaulted away
+        st2 = _KeyState()
+        st2.rt_ema_s = 1.0
+        prev_cap = flags.get("go_batch_window_max_ms")
+        prev_frac = flags.get("go_batch_window_frac")
+        flags.set("go_batch_window_max_ms", 0)
+        assert d._window_s(st2) == 0.0
+        flags.set("go_batch_window_max_ms", prev_cap)
+        flags.set("go_batch_window_frac", 0)
+        assert d._window_s(st2) == 0.0
+        flags.set("go_batch_window_frac", prev_frac)
+    finally:
+        flags.set("go_batch_window_ms", prev)

@@ -282,8 +282,12 @@ def test_runtime_mesh_sharded_parity():
                 r = g.execute(q)
                 assert r.ok(), f"[{mode}] {q}: {r.error_msg}"
                 assert sorted(map(tuple, r.rows)) == exp, (mode, q)
-        # the frontier-sharded path must have actually served
+        # the frontier-sharded paths must have actually served, and
+        # mesh-served FIND PATH must count in path_device like every
+        # other device BFS (the serving accounting the benches report)
         assert c.tpu_runtime.stats.get("go_mesh_sparse", 0) > 0
+        assert c.tpu_runtime.stats.get("bfs_mesh_sparse", 0) > 0
+        assert c.tpu_runtime.stats.get("path_device", 0) > 0
     finally:
         flags.set("tpu_mesh_devices", 0)
         flags.set("tpu_mesh_mode", "sparse")
