@@ -46,24 +46,31 @@ flags.define("go_batch_window_ms", -1,
              "(remote tunnel: ~100 ms/launch) pools wide batches while "
              "a local chip pays ~nothing.  0: dispatch immediately; "
              ">0: fixed wait in ms")
-flags.define("go_batch_window_frac", 0.15,
+flags.define("go_batch_window_frac", 0.12,
              "adaptive window as a fraction of the EMA batch "
              "round-trip (launch -> results ready), capped at "
-             "go_batch_window_max_ms.  Measured on a ~110 ms-RTT "
-             "tunnel: 0.15 lifted served 4-hop qps ~12% and cut p50 "
-             "~17% vs dispatch-immediately by pooling ~35-query "
-             "batches instead of ~24")
-flags.define("go_batch_window_max_ms", 40,
-             "upper bound of the adaptive batch window")
+             "go_batch_window_max_ms.  The sparse kernel's result "
+             "transfer is FIXED-SIZE per batch (the final pair-list "
+             "cap), so fewer/fuller batches cut total link bytes "
+             "directly — interleaved A/B on a ~110 ms-RTT tunnel: "
+             "pooled batches beat dispatch-immediately ~12% qps / "
+             "~13% p50")
+flags.define("go_batch_window_max_ms", 25,
+             "upper bound of the adaptive batch window (interleaved "
+             "A/B swept 25/30/40 ms on the tunnel: 25 pooled best — "
+             "larger windows left pipeline slots idle past the "
+             "arrival burst they were pooling)")
 flags.define("go_batch_max", 1024,
              "max coalesced queries (GO or FIND PATH) per device dispatch")
-flags.define("go_batch_inflight", 4,
+flags.define("go_batch_inflight", 3,
              "max device batches in flight across the two-phase "
              "dispatch pipeline (launch overlaps the previous batch's "
-             "transfer + host assembly).  4 keeps the device fed over "
-             "high-RTT links (each batch spends ~2 link round-trips "
-             "in flight); the adaptive window stops the extra depth "
-             "from fragmenting batches")
+             "transfer + host assembly).  3 keeps a high-RTT link fed "
+             "(each batch spends ~2 link round-trips in flight) "
+             "without fragmenting the pooled batches — depth 4 "
+             "measured NET SLOWER on a fetch-bound link because the "
+             "result transfer is fixed-size per batch, so more, "
+             "smaller batches move more total bytes")
 
 
 class _Request:
@@ -98,7 +105,7 @@ class GoBatchDispatcher:
         self._lock = threading.Lock()
         self._keys: Dict[Tuple, _KeyState] = {}
         self._inflight = threading.Semaphore(
-            max(1, int(flags.get("go_batch_inflight") or 4)))
+            max(1, int(flags.get("go_batch_inflight") or 3)))
         self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0,
                       "query_errors": 0}
 
@@ -216,9 +223,9 @@ class GoBatchDispatcher:
         # explicit 0 must mean 0 (an operator disabling the wait), so
         # no falsy-`or` fallbacks here
         frac_raw = flags.get("go_batch_window_frac")
-        frac = 0.15 if frac_raw is None else float(frac_raw)
+        frac = 0.12 if frac_raw is None else float(frac_raw)
         cap_raw = flags.get("go_batch_window_max_ms")
-        cap_s = (40.0 if cap_raw is None else float(cap_raw)) / 1000.0
+        cap_s = (25.0 if cap_raw is None else float(cap_raw)) / 1000.0
         return min(st.rt_ema_s * frac, cap_s)
 
     # ------------------------------------------------------------------
