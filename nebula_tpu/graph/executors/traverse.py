@@ -1090,16 +1090,21 @@ class MatchExecutor(Executor):
     reference, whose MatchExecutor rejects everything
     (MatchExecutor.cpp:19-21).
 
-    Supported shape: ``MATCH (a[:tag])-[e:etype]->(b[:tag])
-    WHERE id(a) == <vid> [AND <preds>] RETURN <exprs>`` — pattern
-    variables rewrite into GO's property spaces (``id(a)``/``id(b)`` →
-    ``etype._src``/``etype._dst``, ``e.p`` → ``etype.p``, ``a.p`` →
-    ``$^.tag.p``, ``b.p`` → ``$$.tag.p``), the ``id(a)`` anchor
-    conjuncts become the FROM list, and the lowered GoSentence runs
-    through GoExecutor — batching, the device backend, and result
-    semantics all ride along.  Labels resolve property namespaces only
-    (tag-presence is not an implicit filter); everything outside the
-    shape errors E_UNSUPPORTED with the raw text preserved."""
+    Supported shapes: ``MATCH (a[:tag])-[e:etype]->(b[:tag])
+    WHERE id(a) == <vid> [AND <preds>] RETURN <exprs>`` plus the
+    reverse pattern ``(a)<-[e:etype]-(b)``, anchored on EITHER pattern
+    vertex — pattern variables rewrite into GO's property spaces
+    (``id(<start var>)``/``id(<other>)`` → ``etype._src``/
+    ``etype._dst``, ``e.p`` → ``etype.p``, ``<start>.p`` →
+    ``$^.tag.p``, ``<other>.p`` → ``$$.tag.p``), the ``id(...)``
+    anchor conjuncts become the FROM list, and the lowered GoSentence
+    runs through GoExecutor — batching, the device backend, and result
+    semantics all ride along.  Anchoring the edge's HEAD vertex lowers
+    onto ``OVER e REVERSELY`` (the engine's ``_src``/``$^`` are
+    traversal-relative, so one rewrite rule serves both directions).
+    Labels resolve property namespaces only (tag-presence is not an
+    implicit filter); everything outside the shape errors
+    E_UNSUPPORTED with the raw text preserved."""
 
     NAME = "MatchExecutor"
 
@@ -1110,8 +1115,9 @@ class MatchExecutor(Executor):
         s = self.sentence
         if s.a_var is None:
             raise ExecError(
-                "MATCH supports the basic (a)-[e:etype]->(b) pattern "
-                "with an id(a) anchor; got: " + s.raw,
+                "MATCH supports the basic (a)-[e:etype]->(b) / "
+                "(a)<-[e:etype]-(b) pattern with an id() anchor; "
+                "got: " + s.raw,
                 ErrorCode.E_UNSUPPORTED)
         if not s.e_label:
             raise ExecError(
@@ -1120,8 +1126,10 @@ class MatchExecutor(Executor):
         alias = s.e_label
 
         pat_vars = {s.a_var, s.b_var, s.e_var}
+        labels = {s.a_var: s.a_label, s.b_var: s.b_label}
 
-        def rewrite(text: str, what: str) -> str:
+        def rewrite(text: str, what: str, start_var: str,
+                    end_var: str) -> str:
             """Token-level pattern-variable substitution — operating on
             TOKENS (not raw text) so string literals that happen to
             spell a variable name are never touched."""
@@ -1155,7 +1163,7 @@ class MatchExecutor(Executor):
                         raise ExecError(
                             f"id({v}): {v} is the edge variable; edges "
                             f"have no vertex id")
-                    out.append(f"{alias}._src " if v == s.a_var
+                    out.append(f"{alias}._src " if v == start_var
                                else f"{alias}._dst ")
                     i += 4
                     continue
@@ -1165,18 +1173,13 @@ class MatchExecutor(Executor):
                     v, prop = toks[i].value, toks[i + 2].value
                     if v == s.e_var:
                         out.append(f"{alias}.{prop} ")
-                    elif v == s.a_var:
-                        if not s.a_label:
-                            raise ExecError(
-                                f"({v}) needs a :tag label to read "
-                                f"{v}.{prop}")
-                        out.append(f"$^.{s.a_label}.{prop} ")
                     else:
-                        if not s.b_label:
+                        if not labels.get(v):
                             raise ExecError(
                                 f"({v}) needs a :tag label to read "
                                 f"{v}.{prop}")
-                        out.append(f"$$.{s.b_label}.{prop} ")
+                        space = "$^" if v == start_var else "$$"
+                        out.append(f"{space}.{labels[v]}.{prop} ")
                     i += 3
                     continue
                 # bare <var>
@@ -1186,7 +1189,7 @@ class MatchExecutor(Executor):
                         raise ExecError(
                             f"bare edge variable {v} in {what}; return "
                             f"its properties ({v}.<prop>) instead")
-                    out.append(f"{alias}._src " if v == s.a_var
+                    out.append(f"{alias}._src " if v == start_var
                                else f"{alias}._dst ")
                     i += 1
                     continue
@@ -1204,13 +1207,16 @@ class MatchExecutor(Executor):
             except (ParseError, LexError) as e:
                 raise ExecError(f"MATCH clause: {e}")
 
-        # WHERE: split the anchor conjuncts (id(a) == vid) off the
-        # predicate tree; the rest travels as the GO filter
+        # WHERE: split the anchor conjuncts (id(<start>) == vid) off
+        # the predicate tree; the rest travels as the GO filter.  The
+        # traversal START is whichever pattern vertex the anchor
+        # names: the edge's tail lowers onto a forward GO, its head
+        # onto OVER ... REVERSELY (tried tail-first, so a query
+        # anchoring BOTH vertices runs forward with the head anchor
+        # kept as an equality filter)
         from ...filter.expressions import (EdgeSrcIdExpr, LogicalExpr,
                                            PrimaryExpr, RelationalExpr,
                                            UnaryExpr)
-        vids: List[int] = []
-        remnant = None
 
         def int_literal(e) -> Optional[int]:
             # vids are signed: -5 parses as UnaryExpr('-', Primary(5))
@@ -1222,12 +1228,13 @@ class MatchExecutor(Executor):
                 return int(e.value)
             return None
 
-        if s.where_text:
-            tree = parse_with("p_expression",
-                              rewrite(s.where_text, "WHERE"))
+        def split_anchors(tree):
+            """(vids, remnant): id(start) == <lit> conjuncts vs the
+            rest of the predicate."""
+            vids: List[int] = []
+            remnant = [None]
 
             def split(e):
-                nonlocal remnant
                 if isinstance(e, LogicalExpr) and e.op == "&&":
                     split(e.left)
                     split(e.right)
@@ -1241,22 +1248,54 @@ class MatchExecutor(Executor):
                         if lit is not None:
                             vids.append(lit)
                             return
-                remnant = e if remnant is None else \
-                    LogicalExpr("&&", remnant, e)
+                remnant[0] = e if remnant[0] is None else \
+                    LogicalExpr("&&", remnant[0], e)
 
             split(tree)
-        if not vids:
-            raise ExecError(
-                "MATCH needs an id(<start var>) == <vid> anchor in "
-                "WHERE to choose start vertices",
-                ErrorCode.E_UNSUPPORTED)
+            return vids, remnant[0]
 
-        yc = parse_with("p_yield_clause",
-                        "yield " + rewrite(s.return_text, "RETURN"))
+        # pattern normalization: the edge runs tail -> head
+        if s.reverse:
+            tail, head = s.b_var, s.a_var
+        else:
+            tail, head = s.a_var, s.b_var
+        chosen = None
+        rewrite_err = None
+        for start_var, end_var, reversely in ((tail, head, False),
+                                              (head, tail, True)):
+            if not s.where_text:
+                break
+            try:
+                tree = parse_with(
+                    "p_expression",
+                    rewrite(s.where_text, "WHERE", start_var, end_var))
+            except ExecError as e:
+                # a direction can fail to rewrite on its own (e.g. the
+                # would-be $^/$$ vertex reads a prop without a label);
+                # the other direction may still carry the anchor
+                rewrite_err = rewrite_err or e
+                continue
+            vids, remnant = split_anchors(tree)
+            if vids:
+                chosen = (start_var, end_var, reversely, vids, remnant)
+                break
+        if chosen is None:
+            if rewrite_err is not None:
+                raise rewrite_err
+            raise ExecError(
+                "MATCH needs an id(<pattern vertex>) == <vid> anchor "
+                "in WHERE to choose start vertices",
+                ErrorCode.E_UNSUPPORTED)
+        start_var, end_var, reversely, vids, remnant = chosen
+
+        yc = parse_with(
+            "p_yield_clause",
+            "yield " + rewrite(s.return_text, "RETURN", start_var,
+                               end_var))
 
         if len(set(vids)) > 1:
-            # two DIFFERENT id(a) == … conjuncts can't both hold: the
-            # predicate is unsatisfiable, the result set is empty
+            # two DIFFERENT id(start) == … conjuncts can't both hold:
+            # the predicate is unsatisfiable, the result set is empty
             cols = [c.alias or default_col_name(c.expr)
                     for c in yc.columns]
             return InterimResult(cols, [])
@@ -1265,7 +1304,8 @@ class MatchExecutor(Executor):
         go = ast.GoSentence(
             step=ast.StepClause(steps=1),
             from_=ast.FromClause(vids=[PrimaryExpr(v) for v in vids]),
-            over=ast.OverClause(edges=[ast.OverEdge(edge=s.e_label)]),
+            over=ast.OverClause(edges=[ast.OverEdge(edge=s.e_label)],
+                                reversely=reversely),
             where=(ast.WhereClause(filter=remnant)
                    if remnant is not None else None),
             yield_=yc)

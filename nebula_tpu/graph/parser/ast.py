@@ -135,7 +135,8 @@ class GoSentence(Sentence):
 class MatchSentence(Sentence):
     """MATCH — the basic single node-edge-node pattern
     ``MATCH (a[:tag])-[e:etype]->(b[:tag]) WHERE ... RETURN ...``
-    parses structurally and LOWERS onto the GO planner
+    (or the reverse-direction form ``(a)<-[e:etype]-(b)``) parses
+    structurally and LOWERS onto the GO planner
     (executors/traverse.MatchExecutor); anything else keeps the raw
     text and errors E_UNSUPPORTED — which is already beyond the
     reference, whose MatchExecutor rejects everything
@@ -148,6 +149,7 @@ class MatchSentence(Sentence):
     e_label: Optional[str] = None
     b_var: Optional[str] = None
     b_label: Optional[str] = None
+    reverse: bool = False          # (a)<-[e]-(b): the edge runs b -> a
     where_text: Optional[str] = None
     return_text: Optional[str] = None
 

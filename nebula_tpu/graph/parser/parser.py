@@ -333,21 +333,29 @@ class _Parser:
 
     def _p_match_basic(self) -> ast.MatchSentence:
         """(a[:label])-[e:etype]->(b[:label]) [WHERE ...] RETURN cols —
-        the MATCH shape the GO planner serves
-        (executors/traverse.MatchExecutor lowers it)."""
+        or the reverse form (a)<-[e:etype]-(b) — the MATCH shapes the
+        GO planner serves (executors/traverse.MatchExecutor lowers
+        them)."""
         s = ast.MatchSentence()
         self.expect_sym("(")
         s.a_var = self.expect_id("pattern variable")
         if self.accept_sym(":"):
             s.a_label = self.expect_id("tag label")
         self.expect_sym(")")
+        # "<-" lexes as two symbols; a leading "<" marks the reverse
+        # pattern (the edge runs b -> a) closed by "-" instead of "->"
+        if self.accept_sym("<"):
+            s.reverse = True
         self.expect_sym("-")
         self.expect_sym("[")
         s.e_var = self.expect_id("edge variable")
         if self.accept_sym(":"):
             s.e_label = self.expect_id("edge type")
         self.expect_sym("]")
-        self.expect_sym("->")
+        if s.reverse:
+            self.expect_sym("-")
+        else:
+            self.expect_sym("->")
         self.expect_sym("(")
         s.b_var = self.expect_id("pattern variable")
         if self.accept_sym(":"):

@@ -119,6 +119,12 @@ def main():
     ap.add_argument("--steps", type=int, default=2)
     ap.add_argument("--steps2", type=int, default=3,
                     help="second (deeper) measured hop count; 0 = skip")
+    ap.add_argument("--multi-starts", type=int, default=32,
+                    help="third measured leg: GO from this many start "
+                         "vids per query (the IS-style batched "
+                         "interactive read of BASELINE config 4 — the "
+                         "CPU path pays the fan-out per query, the "
+                         "device amortizes it); 0 = skip")
     ap.add_argument("--tpu-queries", type=int, default=4096)
     ap.add_argument("--cpu-queries", type=int, default=512,
                     help=">= 4x workers: the CPU number must be a "
@@ -157,6 +163,7 @@ def main():
         "max_deg": args.max_deg, "steps": args.steps,
         "parts": args.parts, "tpu_queries": args.tpu_queries,
         "cpu_queries": args.cpu_queries, "workers": args.workers,
+        "multi_starts": args.multi_starts,
     }}
     try:
         g = c.client()
@@ -228,22 +235,42 @@ def main():
         # ---- serving: TPU path vs flat CPU fallback -----------------
         rng = np.random.default_rng(7)
         starts = rng.integers(1, n + 1, args.tpu_queries)
-        for hops, tag in ((args.steps, ""),
-                          (args.steps2, f"_{args.steps2}hop")):
-            if not hops:
+        legs = [(args.steps, 1, ""),
+                (args.steps2, 1, f"_{args.steps2}hop"),
+                (args.steps, args.multi_starts,
+                 f"_{args.multi_starts}st")]
+        for hops, nst, tag in legs:
+            if not hops or not nst:
                 continue
-            queries = [f"GO {hops} STEPS FROM {v} OVER knows"
-                       for v in starts]
+            # the first leg runs the full pinned query count; the
+            # deeper and multi-start legs sample a quarter (their
+            # per-query work is several times larger)
+            nq = args.tpu_queries if not tag \
+                else max(args.tpu_queries // 4, 64)
+            if nst == 1:
+                queries = [f"GO {hops} STEPS FROM {v} OVER knows"
+                           for v in starts[:nq]]
+            else:
+                # IS-style batched short read: one query fans out of
+                # nst start vertices (BASELINE config 4's shape) — the
+                # per-query work the CPU path multiplies by nst rides
+                # the same single device batch
+                queries = [
+                    "GO {} STEPS FROM {} OVER knows".format(
+                        hops, ",".join(map(str, rng.integers(
+                            1, n + 1, nst))))
+                    for _ in range(nq)]
             flags.set("storage_backend", "tpu")
-            nq = args.tpu_queries if not tag else args.tpu_queries // 4
-            out["tpu" + tag] = serve(c, "scale", queries[:nq],
+            out["tpu" + tag] = serve(c, "scale", queries,
                                      args.workers)
-            log(f"tpu path ({hops} hops): {out['tpu' + tag]}")
+            log(f"tpu path ({hops} hops, {nst} starts): "
+                f"{out['tpu' + tag]}")
             flags.set("storage_backend", "cpu")
             flags.set("flat_bound_mode", True)
             out["cpu_flat" + tag] = serve(
                 c, "scale", queries[:args.cpu_queries], args.workers)
-            log(f"cpu flat path ({hops} hops): {out['cpu_flat' + tag]}")
+            log(f"cpu flat path ({hops} hops, {nst} starts): "
+                f"{out['cpu_flat' + tag]}")
             out["p50_speedup_vs_flat_cpu" + tag] = round(
                 out["cpu_flat" + tag]["p50_ms"]
                 / out["tpu" + tag]["p50_ms"], 2)

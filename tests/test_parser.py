@@ -142,6 +142,16 @@ class TestTraverseOthers:
         s = parse1("MATCH (v:player) RETURN v")
         assert isinstance(s, ast.MatchSentence)
 
+    def test_match_basic_directions(self):
+        s = parse1("MATCH (a:player)-[e:follow]->(b) "
+                   "WHERE id(a) == 1 RETURN id(b)")
+        assert s.a_var == "a" and s.e_label == "follow" \
+            and s.b_var == "b" and not s.reverse
+        s2 = parse1("MATCH (a)<-[e:follow]-(b:player) "
+                    "WHERE id(a) == 3 RETURN id(b)")
+        assert s2.reverse and s2.b_label == "player" \
+            and s2.where_text and s2.return_text
+
     def test_limit(self):
         s = parse1("GO FROM 1 OVER e | LIMIT 3, 10")
         assert s.right.offset == 3 and s.right.count == 10

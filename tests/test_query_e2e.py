@@ -440,6 +440,22 @@ class TestMatchLowering:
          'RETURN id(y)', []),
         ('MATCH (a)-[e:follow]->(b) WHERE id(a) == 2 '
          'RETURN id(a), id(b)', [(2, 3)]),
+        # anchor on the edge's HEAD -> lowers onto OVER ... REVERSELY
+        ('MATCH (a)-[e:follow]->(b) WHERE id(b) == 3 '
+         'RETURN id(a), e.degree', [(1, 50), (2, 80)]),
+        # reverse pattern (edge runs b -> a), anchored either side
+        ('MATCH (a)<-[e:follow]-(b) WHERE id(a) == 3 '
+         'RETURN id(b), e.degree', [(1, 50), (2, 80)]),
+        ('MATCH (a)<-[e:follow]-(b) WHERE id(b) == 1 '
+         'RETURN id(a), e.degree', [(2, 95), (3, 50)]),
+        # vertex props resolve to the right side under REVERSELY
+        ('MATCH (a:player)<-[e:follow]-(b:player) WHERE id(a) == 3 '
+         'AND b.age > 25 RETURN b.name, a.name',
+         [("a", "c"), ("b", "c")]),
+        # both vertices anchored: forward traversal, head anchor kept
+        # as an equality filter
+        ('MATCH (a)-[e:follow]->(b) WHERE id(a) == 1 AND id(b) == 2 '
+         'RETURN id(b), e.degree', [(2, 95)]),
     ])
     def test_match_rows(self, mcluster, q, exp):
         _, g = mcluster
@@ -450,15 +466,21 @@ class TestMatchLowering:
     def test_match_cpu_tpu_parity(self, mcluster):
         from nebula_tpu.common.flags import flags
         _, g = mcluster
-        q = ('MATCH (a:player)-[e:follow]->(b:player) WHERE id(a) == 1 '
-             'AND e.degree >= 50 RETURN id(b), b.age, e.degree')
-        flags.set("storage_backend", "cpu")
-        try:
-            a = sorted(map(tuple, g.execute(q).rows))
-        finally:
-            flags.set("storage_backend", "tpu")
-        b = sorted(map(tuple, g.execute(q).rows))
-        assert a == b and len(a) == 2
+        queries = [
+            ('MATCH (a:player)-[e:follow]->(b:player) WHERE id(a) == 1 '
+             'AND e.degree >= 50 RETURN id(b), b.age, e.degree', 2),
+            # REVERSELY lowering rides the same device seams
+            ('MATCH (a:player)<-[e:follow]-(b:player) WHERE id(a) == 3 '
+             'AND e.degree >= 50 RETURN id(b), b.age, e.degree', 2),
+        ]
+        for q, n in queries:
+            flags.set("storage_backend", "cpu")
+            try:
+                a = sorted(map(tuple, g.execute(q).rows))
+            finally:
+                flags.set("storage_backend", "tpu")
+            b = sorted(map(tuple, g.execute(q).rows))
+            assert a == b and len(a) == n, q
 
     @pytest.mark.parametrize("q,frag", [
         ("MATCH (a)-[e]->(b) WHERE id(a) == 1 RETURN id(b)",
