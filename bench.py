@@ -179,15 +179,8 @@ def main():
     # round-trip so any qps/p50 drift between environments (local chip
     # vs remote tunnel, quiet vs congested) is attributable from the
     # JSON alone instead of looking like a regression
-    import jax.numpy as jnp
-    _f = jax.jit(lambda x: x + 1)
-    _x = jnp.zeros((8,), jnp.int32)
-    np.asarray(_f(_x))                       # warm the compile
-    _t0 = time.perf_counter()
-    _reps = 5
-    for _ in range(_reps):
-        np.asarray(_f(_x))
-    tunnel_rtt_ms = (time.perf_counter() - _t0) / _reps * 1000
+    from nebula_tpu.tools.perf_fixture import probe_link_rtt_ms
+    tunnel_rtt_ms = probe_link_rtt_ms()
     log(f"device link roundtrip (execute+fetch): {tunnel_rtt_ms:.1f} ms")
     if platform == "cpu":   # CI/dev fallback — minutes-scale
         n, m, B, steps = 1 << 14, 1 << 17, 256, 4

@@ -285,7 +285,11 @@ class GoExecutor(Executor):
         rt = self.ectx.tpu_runtime
         router = self.ectx.router if flags.get("go_backend_router") \
             else None
-        route_key = (space, tuple(sorted(set(etypes))), steps)
+        # upto is part of the family: it always runs the CPU loop and
+        # costs differently than exact-depth GO, so sharing a key
+        # would pollute the EWMA that routes the exact queries
+        route_key = (space, tuple(sorted(set(etypes))), steps,
+                     bool(s.step.upto))
         prefer_device = True
         if rt is not None and router is not None:
             prefer_device = router.choose(route_key) == "device"
@@ -343,13 +347,33 @@ class GoExecutor(Executor):
                                            etypes)
 
         # ---- step loop (stepOut / onStepOutResponse) ----------------
+        # UPTO N STEPS: the final hop materializes edges out of the
+        # UNION of the frontiers at depths 0..N-1 — "every neighbor
+        # within N hops", each edge once.  (The reference parses UPTO
+        # but refuses it — GoExecutor.cpp:121-123 `UPTO not supported
+        # yet` — so this is defined capability beyond parity, not a
+        # ported semantic.)
+        upto = bool(s.step.upto and steps > 1)
+        union_ids: List[int] = []
+        union_bt: Dict[int, int] = {}
         cur = start_vids
         backtracker: Dict[int, int] = {v: v for v in cur}
         final_resp = None
         for step in range(steps):
+            if upto:
+                for v in cur:
+                    if v not in union_bt:
+                        union_bt[v] = backtracker.get(v, v)
+                        union_ids.append(v)
+            is_final = step == steps - 1
+            if upto and not is_final and not cur:
+                is_final = True      # frontier exhausted early: the
+                                     # union is complete, materialize
+            if is_final and upto:
+                cur = union_ids
+                backtracker = union_bt
             if not cur:
                 break
-            is_final = step == steps - 1
             resp = self.ectx.storage.get_neighbors(
                 space, cur, etypes,
                 filter_bytes=pushed if is_final else None,
@@ -362,6 +386,7 @@ class GoExecutor(Executor):
                 raise ExecError(f"storage error: {first.to_string()}")
             if is_final:
                 final_resp = resp
+                break        # may have been promoted early under UPTO
             else:
                 nxt: List[int] = []
                 seen: Set[int] = set()

@@ -226,6 +226,11 @@ class RemoteDeviceRuntime:
             return False
         if has_input:      # per-root $-/$var inputs never run on device
             return False
+        if getattr(sentence.step, "upto", False) \
+                and sentence.step.steps > 1:
+            return False   # UPTO unions every depth's frontier — the
+                           # CPU step loop serves it (runtime.py
+                           # can_run_go declines identically in-process)
         placement = self._device_host(space_id)
         if placement is None:
             return False

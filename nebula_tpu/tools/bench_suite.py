@@ -387,6 +387,21 @@ def main(argv=None) -> int:
     persons_mesh = args.persons or (2000 if args.quick else 50000)
 
     results: list = []
+    # link self-diagnosis first (same probe as bench.py): the device
+    # configs' absolute numbers track the link round trip, so record
+    # it in the JSON for cross-environment attribution
+    try:
+        import jax
+
+        from .perf_fixture import probe_link_rtt_ms
+        results.append({
+            "config": "device link probe", "backend": "-",
+            "qps": 0, "p50_ms": 0, "p99_ms": 0,
+            "tunnel_rtt_ms": round(probe_link_rtt_ms(), 1),
+            "platform": jax.devices()[0].platform})
+    except Exception as e:      # noqa: BLE001 — probe is diagnostics
+        results.append({"config": "device link probe", "backend": "-",
+                        "error": str(e)})
     bench_basketball(results)
     bench_ldbc_paths(results, persons_path)
     bench_ldbc_go(results, persons_go)

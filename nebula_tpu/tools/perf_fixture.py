@@ -45,3 +45,25 @@ def vertex(vid: int, tag_id: int, idx: int) -> dict:
 def edge(src: int, etype: int, dst: int, w: int) -> dict:
     return {"src": src, "etype": etype, "rank": 0, "dst": dst,
             "props": encode_row(REL, {"w": w})}
+
+
+def probe_link_rtt_ms(reps: int = 5) -> float:
+    """Measured device-link round trip (one jitted execute + fetch of
+    a tiny array, averaged over ``reps``).  The serving path's
+    per-batch floor is one execute + one fetch over this link, so
+    bench outputs record it for cross-environment attribution — the
+    ONE probe bench.py and bench_suite share, so their numbers stay
+    comparable."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    np.asarray(f(x))                     # warm the compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f(x))
+    return (time.perf_counter() - t0) / reps * 1000

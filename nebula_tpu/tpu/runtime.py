@@ -544,6 +544,13 @@ class TpuQueryRuntime:
             return False
         if has_input:
             return False
+        if getattr(sentence.step, "upto", False) \
+                and sentence.step.steps > 1:
+            # UPTO unions the frontiers of every depth (executor step
+            # loop); the batched kernels advance to one exact depth —
+            # the CPU loop serves these until a cumulative-frontier
+            # kernel variant exists
+            return False
         # alias map (same resolution GoExecutor did)
         alias_to_etype: Dict[str, int] = {}
         s = sentence

@@ -107,6 +107,31 @@ class TestGo:
         resp = client.ok(f"GO 3 STEPS FROM {TIM} OVER follow")
         assert rows_set(resp) == {(TONY,), (MANU,), (TIM,)}
 
+    def test_upto_steps_unions_depths(self, client):
+        # UPTO N = edges out of the union of frontiers at depths
+        # 0..N-1, each edge once (the reference PARSES UPTO but
+        # refuses to execute it — GoExecutor.cpp:121-123)
+        exact2 = client.ok(f"GO 2 STEPS FROM {TIM} OVER follow")
+        assert rows_set(exact2) == {(TIM,), (MANU,)}
+        resp = client.ok(f"GO UPTO 2 STEPS FROM {TIM} OVER follow")
+        # depth-1 edges (Tim->Tony, Tim->Manu) union depth-2 edges
+        assert rows_set(resp) == {(TONY,), (MANU,), (TIM,)}
+        # rows are per-EDGE: Manu reached from both Tim (d1) and
+        # Tony (d2) contributes both edges
+        assert len(resp.rows) == 5
+        # props/WHERE ride the same final-hop materialization
+        resp = client.ok(
+            f"GO UPTO 2 STEPS FROM {TIM} OVER follow "
+            f"WHERE follow.degree > 90 YIELD follow._dst, "
+            f"$$.player.name")
+        assert (TONY, "Tony Parker") in rows_set(resp)
+
+    def test_upto_frontier_exhausts_early(self, client):
+        # LeBron -> Cavs is a dead end over `serve`; UPTO 5 must
+        # still materialize the union instead of returning empty
+        resp = client.ok(f"GO UPTO 5 STEPS FROM {LEBRON} OVER serve")
+        assert rows_set(resp) == {(CAVS,)}
+
     def test_reversely(self, client):
         resp = client.ok(f"GO FROM {MANU} OVER follow REVERSELY")
         assert rows_set(resp) == {(TIM,), (TONY,)}
