@@ -1150,6 +1150,22 @@ class MatchExecutor(Executor):
                 ErrorCode.E_UNSUPPORTED)
         alias = s.e_label
 
+        # variable-length bounds: [e:t*N] = exact N hops, [e:t*1..N] =
+        # UPTO N (union of depths 1..N — GO UPTO semantics); other
+        # lower bounds have no GO lowering
+        hop_min, hop_max = s.hop_min, s.hop_max
+        if hop_min < 1 or hop_max < hop_min:
+            raise ExecError(
+                f"bad hop range *{hop_min}..{hop_max}",
+                ErrorCode.E_UNSUPPORTED)
+        if hop_min not in (1, hop_max):
+            raise ExecError(
+                f"*{hop_min}..{hop_max}: only *N (exact) and *1..N "
+                f"(up to) variable-length patterns lower onto the GO "
+                f"planner", ErrorCode.E_UNSUPPORTED)
+        steps = hop_max
+        upto = hop_min == 1 and hop_max > 1
+
         pat_vars = {s.a_var, s.b_var, s.e_var}
         labels = {s.a_var: s.a_label, s.b_var: s.b_label}
 
@@ -1197,12 +1213,34 @@ class MatchExecutor(Executor):
                         and sym(i + 1, ".") and is_id(i + 2):
                     v, prop = toks[i].value, toks[i + 2].value
                     if v == s.e_var:
+                        if steps > 1:
+                            # the lowered GO binds the alias to the
+                            # FINAL hop's edge; a Cypher-style reader
+                            # expects e to bind the whole edge list —
+                            # reject rather than silently serve one
+                            # edge's value
+                            raise ExecError(
+                                f"{v}.{prop}: edge properties across "
+                                f"a variable-length pattern are "
+                                f"unsupported (the lowered GO binds "
+                                f"{v} to the final hop's edge only)",
+                                ErrorCode.E_UNSUPPORTED)
                         out.append(f"{alias}.{prop} ")
                     else:
                         if not labels.get(v):
                             raise ExecError(
                                 f"({v}) needs a :tag label to read "
                                 f"{v}.{prop}")
+                        if v == start_var and steps > 1:
+                            # multi-hop GO's $^ is the FINAL hop's
+                            # source, not the anchor — serving the
+                            # anchor's props would be silently wrong
+                            raise ExecError(
+                                f"{v}.{prop}: anchor-vertex properties "
+                                f"across a variable-length pattern are "
+                                f"unsupported (the lowered GO reads "
+                                f"the final hop's source)",
+                                ErrorCode.E_UNSUPPORTED)
                         space = "$^" if v == start_var else "$$"
                         out.append(f"{space}.{labels[v]}.{prop} ")
                     i += 3
@@ -1318,6 +1356,23 @@ class MatchExecutor(Executor):
             "yield " + rewrite(s.return_text, "RETURN", start_var,
                                end_var))
 
+        if steps > 1:
+            # any id(<start>) that did NOT become the anchor (a
+            # non-== use in WHERE, or a RETURN column) would read the
+            # FINAL hop's source under the lowered multi-hop GO, not
+            # the pattern anchor — reject instead of serving the
+            # wrong vertex
+            for e in ([remnant] if remnant is not None else []) + \
+                    [c.expr for c in yc.columns]:
+                for node in walk_expr(e):
+                    if isinstance(node, EdgeSrcIdExpr):
+                        raise ExecError(
+                            f"id({start_var}) across a "
+                            f"variable-length pattern is only usable "
+                            f"as the == anchor (the lowered GO's _src "
+                            f"is the final hop's source)",
+                            ErrorCode.E_UNSUPPORTED)
+
         if len(set(vids)) > 1:
             # two DIFFERENT id(start) == … conjuncts can't both hold:
             # the predicate is unsatisfiable, the result set is empty
@@ -1327,7 +1382,7 @@ class MatchExecutor(Executor):
         vids = vids[:1]
 
         go = ast.GoSentence(
-            step=ast.StepClause(steps=1),
+            step=ast.StepClause(steps=steps, upto=upto),
             from_=ast.FromClause(vids=[PrimaryExpr(v) for v in vids]),
             over=ast.OverClause(edges=[ast.OverEdge(edge=s.e_label)],
                                 reversely=reversely),

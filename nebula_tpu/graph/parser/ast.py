@@ -150,6 +150,8 @@ class MatchSentence(Sentence):
     b_var: Optional[str] = None
     b_label: Optional[str] = None
     reverse: bool = False          # (a)<-[e]-(b): the edge runs b -> a
+    hop_min: int = 1               # [e:t*N] -> (N, N); [e:t*1..N] ->
+    hop_max: int = 1               # (1, N); plain [e:t] -> (1, 1)
     where_text: Optional[str] = None
     return_text: Optional[str] = None
 

@@ -49,6 +49,14 @@ class _Parser:
             self.i += 1
         return t
 
+    def _lexeme_at(self, j: int) -> str:
+        """Raw source slice of token j (through the next token's
+        start) — for the rare spot where a token's VALUE loses
+        information the grammar needs (FLOAT "2." in a hop range)."""
+        end = self.toks[j + 1].pos if j + 1 < len(self.toks) \
+            else len(self.text)
+        return self.text[self.toks[j].pos:end].strip()
+
     def at_kw(self, *kws: str) -> bool:
         t = self.peek()
         return t.type == "KW" and t.value in kws
@@ -351,6 +359,35 @@ class _Parser:
         s.e_var = self.expect_id("edge variable")
         if self.accept_sym(":"):
             s.e_label = self.expect_id("edge type")
+        if self.accept_sym("*"):
+            # variable length: *N (exact) or *m..N.  The lexer reads
+            # an unspaced "m..N" as two FLOATs ("m." and ".N"), so the
+            # bounds are reconstructed from the raw lexemes; a spaced
+            # "m .. N" arrives as INT SYM(.) SYM(.) INT.  Bounds are
+            # validated by the executor.
+            t = self.peek()
+            if t.type == "INT":
+                s.hop_min = s.hop_max = self.next().value
+                if self.accept_sym("."):
+                    self.expect_sym(".")
+                    if self.peek().type != "INT":
+                        self.fail("expected upper hop bound after ..")
+                    s.hop_max = self.next().value
+            elif t.type == "FLOAT":
+                lo_lex = self._lexeme_at(self.i)
+                self.next()
+                hi = self.peek()
+                hi_lex = self._lexeme_at(self.i)
+                if not (lo_lex.endswith(".") and hi.type == "FLOAT"
+                        and hi_lex.startswith(".")
+                        and lo_lex[:-1].isdigit()
+                        and hi_lex[1:].isdigit()):
+                    self.fail("expected hop range *m..N")
+                self.next()
+                s.hop_min = int(lo_lex[:-1])
+                s.hop_max = int(hi_lex[1:])
+            else:
+                self.fail("expected hop count after *")
         self.expect_sym("]")
         if s.reverse:
             self.expect_sym("-")

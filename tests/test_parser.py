@@ -152,6 +152,22 @@ class TestTraverseOthers:
         assert s2.reverse and s2.b_label == "player" \
             and s2.where_text and s2.return_text
 
+    def test_match_var_length_bounds(self):
+        s = parse1("MATCH (a)-[e:follow*3]->(b) "
+                   "WHERE id(a) == 1 RETURN id(b)")
+        assert (s.hop_min, s.hop_max) == (3, 3)
+        # unspaced range lexes as two FLOATs; spaced as INT . . INT —
+        # both must land the same bounds
+        s2 = parse1("MATCH (a)-[e:follow*1..4]->(b) "
+                    "WHERE id(a) == 1 RETURN id(b)")
+        assert (s2.hop_min, s2.hop_max) == (1, 4)
+        s3 = parse1("MATCH (a)-[e:follow*2 .. 5]->(b) "
+                    "WHERE id(a) == 1 RETURN id(b)")
+        assert (s3.hop_min, s3.hop_max) == (2, 5)
+        s4 = parse1("MATCH (a)-[e:follow]->(b) "
+                    "WHERE id(a) == 1 RETURN id(b)")
+        assert (s4.hop_min, s4.hop_max) == (1, 1)
+
     def test_limit(self):
         s = parse1("GO FROM 1 OVER e | LIMIT 3, 10")
         assert s.right.offset == 3 and s.right.count == 10

@@ -531,3 +531,94 @@ class TestMatchLowering:
         r = g.execute(q)
         assert r.ok(), r.error_msg
         assert sorted(map(tuple, r.rows)) == [(2, "b")]
+
+
+class TestMatchVarLength:
+    """Variable-length MATCH patterns lower onto GO N STEPS / GO UPTO:
+    [e:t*N] = exact depth N, [e:t*1..N] = every neighbor within N hops
+    (both beyond the reference, which rejects all MATCH)."""
+
+    @pytest.fixture(scope="class")
+    def vcluster(self):
+        from nebula_tpu.cluster import LocalCluster
+        c = LocalCluster(num_storage=1, tpu_backend=True)
+        g = c.client()
+        assert g.execute(
+            "CREATE SPACE vl(partition_num=3, replica_factor=1)").ok()
+        c.refresh_all()
+        g.execute("USE vl")
+        g.execute("CREATE TAG p(name string)")
+        g.execute("CREATE EDGE knows(w int)")
+        c.refresh_all()
+        g.execute('INSERT VERTEX p(name) VALUES '
+                  '1:("a"), 2:("b"), 3:("c"), 4:("d")')
+        # a chain 1 -> 2 -> 3 -> 4
+        g.execute("INSERT EDGE knows(w) VALUES "
+                  "1->2:(12), 2->3:(23), 3->4:(34)")
+        yield c, g
+        c.stop()
+
+    @pytest.mark.parametrize("q,exp", [
+        # *N = exact depth
+        ('MATCH (a)-[e:knows*2]->(b) WHERE id(a) == 1 RETURN id(b)',
+         [(3,)]),
+        ('MATCH (a)-[e:knows*3]->(b) WHERE id(a) == 1 '
+         'RETURN id(b)', [(4,)]),
+        # *1..N = union of depths (GO UPTO)
+        ('MATCH (a)-[e:knows*1..3]->(b) WHERE id(a) == 1 RETURN id(b)',
+         [(2,), (3,), (4,)]),
+        # end-vertex props and filters ride the final hop
+        ('MATCH (a)-[e:knows*1..3]->(b:p) WHERE id(a) == 1 '
+         'AND b.name != "b" RETURN id(b), b.name',
+         [(3, "c"), (4, "d")]),
+        # reverse pattern composes with var length (head anchor ->
+        # REVERSELY multi-hop)
+        ('MATCH (a)<-[e:knows*2]-(b) WHERE id(a) == 4 RETURN id(b)',
+         [(2,)]),
+        # plain single-hop unchanged
+        ('MATCH (a)-[e:knows*1]->(b) WHERE id(a) == 2 RETURN id(b)',
+         [(3,)]),
+    ])
+    def test_var_length_rows(self, vcluster, q, exp):
+        _, g = vcluster
+        r = g.execute(q)
+        assert r.ok(), f"{q}: {r.error_msg}"
+        assert sorted(map(tuple, r.rows)) == sorted(exp), q
+
+    @pytest.mark.parametrize("q,frag", [
+        # lower bounds other than 1/N have no GO lowering
+        ('MATCH (a)-[e:knows*2..3]->(b) WHERE id(a) == 1 RETURN id(b)',
+         "variable-length"),
+        # anchor props across multi-hop would read the final hop's src
+        ('MATCH (a:p)-[e:knows*2]->(b) WHERE id(a) == 1 '
+         'RETURN a.name', "anchor-vertex"),
+        # non-anchor id(a) use across multi-hop
+        ('MATCH (a)-[e:knows*1..2]->(b) WHERE id(a) == 1 '
+         'RETURN id(a), id(b)', "final hop"),
+        # edge props across multi-hop bind only the final edge —
+        # rejected rather than silently serving one edge's value
+        ('MATCH (a)-[e:knows*2]->(b) WHERE id(a) == 1 AND e.w == 12 '
+         'RETURN id(b)', "edge properties"),
+        ('MATCH (a)-[e:knows*1..3]->(b) WHERE id(a) == 1 '
+         'RETURN id(b), e.w', "edge properties"),
+    ])
+    def test_var_length_unsupported(self, vcluster, q, frag):
+        _, g = vcluster
+        r = g.execute(q)
+        assert not r.ok(), q
+        assert frag in r.error_msg, (q, r.error_msg)
+
+    def test_var_length_cpu_tpu_parity(self, vcluster):
+        from nebula_tpu.common.flags import flags
+        _, g = vcluster
+        for q in ('MATCH (a)-[e:knows*2]->(b) WHERE id(a) == 1 '
+                  'RETURN id(b)',
+                  'MATCH (a)-[e:knows*1..3]->(b) WHERE id(a) == 1 '
+                  'RETURN id(b)'):
+            flags.set("storage_backend", "cpu")
+            try:
+                a = sorted(map(tuple, g.execute(q).rows))
+            finally:
+                flags.set("storage_backend", "tpu")
+            b = sorted(map(tuple, g.execute(q).rows))
+            assert a == b and a, q
