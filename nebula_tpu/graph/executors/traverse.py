@@ -1152,7 +1152,11 @@ class MatchExecutor(Executor):
 
         # variable-length bounds: [e:t*N] = exact N hops, [e:t*1..N] =
         # UPTO N (union of depths 1..N — GO UPTO semantics); other
-        # lower bounds have no GO lowering
+        # lower bounds have no GO lowering.  Results use GO's WALK
+        # semantics (reachable by an N-edge walk; edges may repeat on
+        # cycles, frontier dedup collapses path multiplicity) — nGQL's
+        # established meaning, NOT Cypher's edge-distinct trails
+        # (docs/STATUS.md states this scope)
         hop_min, hop_max = s.hop_min, s.hop_max
         if hop_min < 1 or hop_max < hop_min:
             raise ExecError(

@@ -760,6 +760,20 @@ class TpuQueryRuntime:
             return self._launch_sparse(space_id, m, ix, d_all, q_all, nq,
                                        et_tuple, steps, c0)
 
+        if flags.get("tpu_sparse_go") and delta is None \
+                and mesh_mt is None and c0 is None and nq > 1:
+            # total starts outgrew the sparse ladder (a wide batch of
+            # multi-start queries): split at query boundaries into
+            # ladder-sized sparse sub-launches instead of the dense
+            # pull — at 10^8-edge scale a dense [n_rows+1, B] frontier
+            # upload costs MINUTES on a tunnel link (measured: one
+            # dense fallback put 75 s on the 32-start leg's p99)
+            launched = self._launch_sparse_split(
+                space_id, m, ix, d_all, q_all, nq, et_tuple, steps,
+                qbounds)
+            if launched is not None:
+                return launched
+
         if nq == 1 and delta is None and mesh_mt is None \
                 and flags.get("tpu_adaptive_single") \
                 and len(d_all) <= int(flags.get("tpu_adaptive_k") or 2048):
@@ -769,16 +783,73 @@ class TpuQueryRuntime:
         return self._launch_dense(space_id, m, ix, d_all, q_all, nq,
                                   et_tuple, steps, delta, mesh_mt)
 
+    def _launch_sparse_split(self, space_id: int, m: CsrMirror,
+                             ix: EllIndex, d_all: np.ndarray,
+                             q_all: np.ndarray, nq: int,
+                             et_tuple: Tuple[int, ...], steps: int,
+                             qbounds: np.ndarray):
+        """Greedy query-boundary split of an over-wide batch into
+        sparse sub-launches (each within the c0 ladder).  All sub
+        kernels dispatch async back-to-back, so the launches pipeline
+        on the device; the resolver stitches per-query results back in
+        submission order.  None when any SINGLE query outgrows the
+        ladder (only the dense pull can hold it)."""
+        cap_max = max(self._sparse_ladder())
+        groups: List[Tuple[int, int]] = []
+        lo = 0
+        while lo < nq:
+            hi = lo + 1
+            while hi < nq and \
+                    qbounds[hi + 1] - qbounds[lo] <= cap_max:
+                hi += 1
+            if qbounds[hi] - qbounds[lo] > cap_max:
+                return None          # one query alone outgrows the ladder
+            groups.append((lo, hi))
+            lo = hi
+        parts = []
+        for g_lo, g_hi in groups:
+            seg = slice(int(qbounds[g_lo]), int(qbounds[g_hi]))
+            d_seg = d_all[seg]
+            q_seg = q_all[seg] - g_lo
+            c0g = self._sparse_c0(len(d_seg))
+            if c0g is None:          # empty group (queries w/o starts)
+                parts.append((g_lo, g_hi, None))
+                continue
+            parts.append((g_lo, g_hi, self._launch_sparse(
+                space_id, m, ix, d_seg, q_seg, g_hi - g_lo, et_tuple,
+                steps, c0g)))
+        self.stats["go_sparse_split"] = \
+            self.stats.get("go_sparse_split", 0) + 1
+
+        def resolve():
+            out: List[np.ndarray] = [np.zeros(0, np.int64)] * nq
+            mm = m
+            for g_lo, g_hi, r in parts:
+                if r is None:
+                    continue
+                vs, mm = r()
+                out[g_lo:g_hi] = vs
+            return out, mm
+
+        return resolve
+
     @staticmethod
-    def _sparse_c0(total_starts: int) -> Optional[int]:
+    def _sparse_ladder() -> List[int]:
+        """The pinned sparse start-capacity ladder (ascending) — the
+        ONE parse of tpu_sparse_c0s, shared by the capacity lookup and
+        the batch splitter so their notions of 'fits' cannot drift."""
+        return sorted(int(x) for x in
+                      str(flags.get("tpu_sparse_c0s") or
+                          "256,2048").split(",") if x.strip())
+
+    @classmethod
+    def _sparse_c0(cls, total_starts: int) -> Optional[int]:
         """Smallest pinned sparse start-capacity holding the batch, or
         None when the batch is empty / outgrows the ladder (dense
         path)."""
         if total_starts <= 0:
             return None
-        for w in sorted(int(x) for x in
-                        str(flags.get("tpu_sparse_c0s") or
-                            "256,2048").split(",") if x.strip()):
+        for w in cls._sparse_ladder():
             if total_starts <= w:
                 return w
         return None
@@ -1041,10 +1112,7 @@ class TpuQueryRuntime:
                 ecnt, e0 = self._hub_expansion_dev(m, ix)
                 args = ix.kernel_args()
                 i32 = jax.ShapeDtypeStruct
-                ladder = [int(x) for x in
-                          str(flags.get("tpu_sparse_c0s") or
-                              "256,2048").split(",") if x.strip()]
-                for c0 in ladder:
+                for c0 in self._sparse_ladder():
                     if steps <= 1:
                         continue
                     shape_key = ("sparse_go", ix.shape_sig(), et_tuple,

@@ -622,3 +622,14 @@ class TestMatchVarLength:
                 flags.set("storage_backend", "tpu")
             b = sorted(map(tuple, g.execute(q).rows))
             assert a == b and a, q
+
+    def test_var_length_walk_semantics_documented(self, vcluster):
+        # deliberate scope: *N means reachable by an N-edge WALK (GO
+        # semantics) — on a 2-cycle, *3 revisits the edge and returns
+        # a row where Cypher's edge-distinct trails would return none
+        _, g = vcluster
+        g.execute("INSERT EDGE knows(w) VALUES 9->8:(98), 8->9:(89)")
+        r = g.execute('MATCH (a)-[e:knows*3]->(b) WHERE id(a) == 9 '
+                      'RETURN id(b)')
+        assert r.ok(), r.error_msg
+        assert sorted(map(tuple, r.rows)) == [(8,)]

@@ -718,3 +718,65 @@ class TestColumnarInterimSeams:
             assert sorted(u) == [(2,), (3,), (4,)]
         finally:
             c.stop()
+
+
+class TestSparseSplit:
+    """A batch whose TOTAL starts outgrow the sparse c0 ladder splits
+    into ladder-sized sparse sub-launches at query boundaries instead
+    of falling to the dense pull (whose [n_rows+1, B] frontier upload
+    costs minutes at 10^8-edge scale over a tunnel link)."""
+
+    def test_oversized_batch_splits_and_matches_cpu(self):
+        import threading
+
+        from nebula_tpu.common.flags import flags
+
+        c, g = _boot(tpu_backend=True)
+        try:
+            rng = np.random.default_rng(3)
+            extra = ", ".join(
+                f"{300 + int(a)} -> {300 + int(b)}:({int(i)})"
+                for i, (a, b) in enumerate(zip(rng.integers(0, 60, 240),
+                                               rng.integers(0, 60, 240))))
+            assert g.execute(
+                f"INSERT EDGE follow(degree) VALUES {extra}").ok()
+            starts = [",".join(str(300 + int(v)) for v in
+                               rng.integers(0, 60, 8))
+                      for _ in range(12)]
+            queries = [f"GO 2 STEPS FROM {s} OVER follow"
+                       for s in starts]
+            flags.set("storage_backend", "cpu")
+            cpu_rows = [sorted(map(tuple, g.execute(q).rows))
+                        for q in queries]
+            flags.set("storage_backend", "tpu")
+            flags.set("tpu_sparse_c0s", "16,32")   # force splitting
+            flags.set("go_batch_window_ms", 120)   # coalesce the burst
+            try:
+                rt = c.tpu_runtime
+                base_dense = rt.stats["go_dense"]
+                results = {}
+                lock = threading.Lock()
+
+                def worker(i):
+                    g2 = c.client()
+                    g2.execute("USE nba")
+                    r = g2.execute(queries[i])
+                    assert r.ok(), r.error_msg
+                    with lock:
+                        results[i] = sorted(map(tuple, r.rows))
+
+                g.execute(queries[0])       # warm kernels
+                ts = [threading.Thread(target=worker, args=(i,))
+                      for i in range(len(queries))]
+                [t.start() for t in ts]
+                [t.join() for t in ts]
+                for i, rows in results.items():
+                    assert rows == cpu_rows[i], queries[i]
+                assert rt.stats.get("go_sparse_split", 0) >= 1
+                assert rt.stats["go_dense"] == base_dense
+            finally:
+                flags.set("tpu_sparse_c0s", "256,2048")
+                flags.set("go_batch_window_ms", -1)
+        finally:
+            flags.set("storage_backend", "tpu")
+            c.stop()
