@@ -285,11 +285,12 @@ class GoExecutor(Executor):
         rt = self.ectx.tpu_runtime
         router = self.ectx.router if flags.get("go_backend_router") \
             else None
-        # upto is part of the family: it always runs the CPU loop and
-        # costs differently than exact-depth GO, so sharing a key
-        # would pollute the EWMA that routes the exact queries
-        route_key = (space, tuple(sorted(set(etypes))), steps,
-                     bool(s.step.upto))
+        # upto is part of the family key: it runs different kernels
+        # (cumulative-frontier) and costs differently than exact-depth
+        # GO, so sharing a key would pollute the EWMA that routes the
+        # exact queries
+        upto = bool(s.step.upto and steps > 1)
+        route_key = (space, tuple(sorted(set(etypes))), steps, upto)
         prefer_device = True
         if rt is not None and router is not None:
             prefer_device = router.choose(route_key) == "device"
@@ -301,7 +302,8 @@ class GoExecutor(Executor):
             try:
                 out = rt.run_go(self, space, start_vids, etypes, steps,
                                 etype_to_alias, yield_cols, distinct,
-                                where_expr, edge_props, vertex_props)
+                                where_expr, edge_props, vertex_props,
+                                upto=upto)
                 if router is not None:
                     router.record(route_key, "device",
                                   time.perf_counter() - t0)
@@ -352,8 +354,9 @@ class GoExecutor(Executor):
         # within N hops", each edge once.  (The reference parses UPTO
         # but refuses it — GoExecutor.cpp:121-123 `UPTO not supported
         # yet` — so this is defined capability beyond parity, not a
-        # ported semantic.)
-        upto = bool(s.step.upto and steps > 1)
+        # ported semantic.  `upto` was computed before the device fast
+        # path above, which serves the same union via the
+        # cumulative-frontier kernels.)
         union_ids: List[int] = []
         union_bt: Dict[int, int] = {}
         cur = start_vids

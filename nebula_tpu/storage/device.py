@@ -179,6 +179,10 @@ class RemoteDeviceRuntime:
         # id(sentence) -> (pushed_mode, (host, parts)) stashed by
         # can_run_go for the immediately following run_go
         self._stash: Dict[int, Tuple] = {}
+        # spaces whose storaged declined UPTO (mesh-sharded there, or
+        # an older build that can't serve it): remembered so repeat
+        # UPTO queries skip the ~RTT-costly decline round trip
+        self._upto_declined: set = set()
 
     # ------------------------------------------------------------ placement
     def _device_host(self, space_id: int
@@ -226,11 +230,14 @@ class RemoteDeviceRuntime:
             return False
         if has_input:      # per-root $-/$var inputs never run on device
             return False
+        # UPTO rides the cumulative-frontier kernels; the remote
+        # runtime declines if ITS mesh config or build can't serve it
+        # (this side can't see the storaged's flags) — cached so the
+        # decline round trip is paid once per space, not per query
         if getattr(sentence.step, "upto", False) \
-                and sentence.step.steps > 1:
-            return False   # UPTO unions every depth's frontier — the
-                           # CPU step loop serves it (runtime.py
-                           # can_run_go declines identically in-process)
+                and sentence.step.steps > 1 \
+                and space_id in self._upto_declined:
+            return False
         placement = self._device_host(space_id)
         if placement is None:
             return False
@@ -240,7 +247,8 @@ class RemoteDeviceRuntime:
     def run_go(self, executor, space_id: int, start_vids: List[int],
                etypes: List[int], steps: int,
                etype_to_alias: Dict[int, str], yield_cols, distinct: bool,
-               where_expr, edge_props, vertex_props) -> InterimResult:
+               where_expr, edge_props, vertex_props,
+               upto: bool = False) -> InterimResult:
         from ..graph.executors.base import ExecError
 
         pushed_mode, placement = self._stash.pop(
@@ -267,8 +275,23 @@ class RemoteDeviceRuntime:
             "distinct": bool(distinct),
             "where": wblob,
             "pushed_mode": pushed_mode,
+            "upto": bool(upto),
         }
-        resp = self._call(host, "deviceGo", req, ExecError)
+        try:
+            resp = self._call(host, "deviceGo", req, ExecError)
+        except TpuDecline:
+            if upto:
+                # mesh-sharded there / older build: don't re-pay this
+                # round trip for the space's next UPTO query
+                self._upto_declined.add(space_id)
+            raise
+        if upto and resp.get("upto") is not True:
+            # version skew: an older storaged ignores the upto field
+            # and serves EXACT depth — silently wrong rows.  The echo
+            # proves the server understood the request; absence means
+            # decline to the CPU loop (and stop asking)
+            self._upto_declined.add(space_id)
+            raise TpuDecline("storaged build predates UPTO serving")
         from ..graph.interim import rows_from_wire
         return InterimResult(list(resp["columns"]),
                              rows_from_wire(resp["rows"]))

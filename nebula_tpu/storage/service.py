@@ -403,7 +403,8 @@ class StorageService:
                 yield_specs=req["yield"],
                 distinct=bool(req["distinct"]),
                 where_blob=req.get("where"),
-                pushed_mode=bool(req["pushed_mode"]))
+                pushed_mode=bool(req["pushed_mode"]),
+                upto=bool(req.get("upto", False)))
         except TpuDecline as d:
             stats.add_value("storage.device_decline.qps")
             return {"ok": False, "reason": str(d)}
@@ -418,7 +419,13 @@ class StorageService:
             return {"ok": False,
                     "reason": f"device failure: {type(e).__name__}: {e}"}
         stats.add_value("storage.device_go.qps")
-        return {"ok": True, "columns": columns, "rows": rows}
+        resp = {"ok": True, "columns": columns, "rows": rows}
+        if req.get("upto"):
+            # capability echo: proves this build READ the upto field
+            # (an older build would silently serve exact depth; the
+            # client treats a missing echo as a decline)
+            resp["upto"] = True
+        return resp
 
     def rpc_deviceFindPath(self, req: dict) -> dict:
         from .device import DeviceExecError, TpuDecline

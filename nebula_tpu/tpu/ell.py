@@ -415,14 +415,18 @@ def unpack_bits(packed: np.ndarray, R1: int) -> np.ndarray:
 
 
 def make_batched_go_kernel(ell: EllIndex, steps: int,
-                           etypes: Tuple[int, ...], pack: bool = False):
+                           etypes: Tuple[int, ...], pack: bool = False,
+                           upto: bool = False):
     """fn(f0 [n_rows+1, B] int8, owner, *tables) -> frontier after
     ``steps-1`` advances (the final hop's edge set is frontier[src] &
     etype_ok, materialised by the caller — same split as
     kernels._go_body).  ``tables`` = (*bucket_nbr, *bucket_et) from
     EllIndex.kernel_args(); only static shapes are read off ``ell``, so
     the compiled fn serves any mirror with the same shape_sig.  With
-    ``pack`` the output is bit-packed uint8 (see pack_bits)."""
+    ``pack`` the output is bit-packed uint8 (see pack_bits).  With
+    ``upto`` the output is the OR of every depth's frontier (0..steps-1
+    — GO UPTO's pre-final-hop vertex set; one extra max per advance,
+    free against the gather cost)."""
     import jax
     import jax.numpy as jnp
     n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
@@ -434,8 +438,19 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
         def one(_, f):
             return _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
                              owner, f)
-        out = f0 if steps <= 1 else \
-            jax.lax.fori_loop(0, steps - 1, one, f0)
+
+        def one_acc(_, carry):
+            f, acc = carry
+            nxt = _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
+                            owner, f)
+            return nxt, jnp.maximum(acc, nxt)
+
+        if steps <= 1:
+            out = f0
+        elif upto:
+            _, out = jax.lax.fori_loop(0, steps - 1, one_acc, (f0, f0))
+        else:
+            out = jax.lax.fori_loop(0, steps - 1, one, f0)
         return pack_bits(jnp, out) if pack else out
 
     return go
@@ -496,7 +511,8 @@ def sparse_caps(c0: int, d_max: int, steps: int, cap: int,
 def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                                   etypes: Tuple[int, ...],
                                   caps: Tuple[int, ...],
-                                  qmax: int = 1024):
+                                  qmax: int = 1024,
+                                  upto: bool = False):
     """Sparse batched GO — B queries' frontiers ride ONE flat sorted
     (query, vertex) pair list instead of a dense [n_rows, B] bitmap.
 
@@ -601,6 +617,16 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             cand = jnp.where(inb[:, None], block, cand)
         flat_i = cand.reshape(-1)
         flat_q = jnp.repeat(gqs, d_max)
+        out_i, out_q, cnt = dedup_compact(flat_q, flat_i, c_out)
+        overflow = (cnt > c_out) | ovf_hub
+        return out_i, out_q, overflow, cnt
+
+    def dedup_compact(flat_q, flat_i, c_out):
+        """Sort + shift-compare dedup of (query, vertex) pairs,
+        compacted to ``c_out`` (sentinel/BIG_Q padded) — THE sparse
+        kernel's cost center, shared by the per-hop compaction and the
+        UPTO union merge so their dedup semantics cannot skew.  Pads
+        (sentinel ids) are dropped by construction."""
         valid = flat_i != sentinel
         if pack32:
             key = jnp.where(valid, flat_q * R1 + flat_i, I32_MAX)
@@ -630,8 +656,7 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             out_i = jnp.full((c_out,), jnp.int32(sentinel)) \
                 .at[pos].set(si, mode="drop")
             out_i = jnp.where(out_q == BIG_Q, sentinel, out_i)
-        overflow = (cnt > c_out) | ovf_hub
-        return out_i, out_q, overflow, cnt
+        return out_i, out_q, cnt
 
     @jax.jit
     def go(ids0, qid0, ecnt, e0, *tables):
@@ -639,11 +664,28 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
         ids, qid = ids0, jnp.where(ids0 == sentinel, BIG_Q, qid0)
         overflow = jnp.bool_(False)
         cnt = jnp.sum(ids != sentinel).astype(jnp.int32)
+        c_fin = caps[-1]
+        if upto:
+            # UPTO: the result is the UNION of the frontiers at depths
+            # 0..steps-1 (the final hop materializes edges out of
+            # every depth's vertices — GO UPTO semantics).  The
+            # accumulator rides at the final capacity; each hop's
+            # output merges in through the same dedup_compact
+            acc_i = jnp.pad(ids, (0, c_fin - ids.shape[0]),
+                            constant_values=sentinel)
+            acc_q = jnp.pad(qid, (0, c_fin - qid.shape[0]),
+                            constant_values=BIG_Q)
         for h in range(max(steps - 1, 0)):
             ids, qid, ovf_h, cnt = hop(ids, qid, ecnt, e0, nbrs, ets,
                                        caps[h + 1])
             overflow = overflow | ovf_h
-        c_fin = caps[-1]
+            if upto:
+                acc_i, acc_q, cnt = dedup_compact(
+                    jnp.concatenate([acc_q, qid]),
+                    jnp.concatenate([acc_i, ids]), c_fin)
+                overflow = overflow | (cnt > c_fin)
+        if upto:
+            ids, qid = acc_i, acc_q
         if ids.shape[0] < c_fin:                 # steps == 1: pad up
             padn = c_fin - ids.shape[0]
             ids = jnp.pad(ids, (0, padn), constant_values=sentinel)

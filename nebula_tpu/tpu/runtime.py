@@ -545,11 +545,11 @@ class TpuQueryRuntime:
         if has_input:
             return False
         if getattr(sentence.step, "upto", False) \
-                and sentence.step.steps > 1:
-            # UPTO unions the frontiers of every depth (executor step
-            # loop); the batched kernels advance to one exact depth —
-            # the CPU loop serves these until a cumulative-frontier
-            # kernel variant exists
+                and sentence.step.steps > 1 \
+                and int(flags.get("tpu_mesh_devices") or 0) > 1:
+            # UPTO runs on the cumulative-frontier kernel variants
+            # (single-device sparse + dense); the frontier-sharded
+            # mesh kernels have no union accumulator — CPU loop there
             return False
         # alias map (same resolution GoExecutor did)
         alias_to_etype: Dict[str, int] = {}
@@ -578,7 +578,8 @@ class TpuQueryRuntime:
     def run_go(self, executor, space_id: int, start_vids: List[int],
                etypes: List[int], steps: int, etype_to_alias: Dict[int, str],
                yield_cols, distinct: bool, where_expr,
-               edge_props, vertex_props) -> InterimResult:
+               edge_props, vertex_props,
+               upto: bool = False) -> InterimResult:
         from ..graph.executors.base import ExecError
 
         s = executor.sentence
@@ -587,14 +588,14 @@ class TpuQueryRuntime:
             raise ExecError("TPU plan missing (can_run_go not called)")
         columns, rows = self._go_via_dispatcher(
             space_id, plan, start_vids, etypes, steps, etype_to_alias,
-            yield_cols, distinct, where_expr, ExecError)
+            yield_cols, distinct, where_expr, ExecError, upto=upto)
         return InterimResult(columns, rows)
 
     def serve_go(self, space_id: int, start_vids: List[int],
                  etypes: List[int], steps: int,
                  etype_to_alias: Dict[int, str], yield_specs,
                  distinct: bool, where_blob: Optional[bytes],
-                 pushed_mode: bool):
+                 pushed_mode: bool, upto: bool = False):
         """storaged-side RPC half of the cross-process device path
         (storage/service.py rpc_deviceGo → here): decode the shipped
         WHERE/YIELD expression trees, plan against the local mirror and
@@ -614,26 +615,35 @@ class TpuQueryRuntime:
         except Exception as e:      # noqa: BLE001 — undecodable tree
             raise TpuDecline(f"undecodable expression: {e}")
         alias_to_etype = {a: et for et, a in etype_to_alias.items()}
+        if upto and int(flags.get("tpu_mesh_devices") or 0) > 1:
+            # the frontier-sharded mesh kernels have no UPTO union
+            # accumulator; the graphd side can't see this flag, so the
+            # decline happens here — BEFORE the plan build, and the
+            # client caches it per space so repeat UPTO queries don't
+            # re-pay the RPC round trip (storage/device.py)
+            raise TpuDecline("UPTO on a mesh-sharded space")
         plan = self._plan_go(space_id, alias_to_etype, where_expr,
                              pushed_mode)
         if plan is None:
             raise TpuDecline("device cannot reproduce this query")
         return self._go_via_dispatcher(
             space_id, plan, start_vids, etypes, steps, etype_to_alias,
-            yield_cols, distinct, where_expr, DeviceExecError)
+            yield_cols, distinct, where_expr, DeviceExecError, upto=upto)
 
     def _go_via_dispatcher(self, space_id: int, plan: _GoPlan,
                            start_vids: List[int], etypes: List[int],
                            steps: int, etype_to_alias: Dict[int, str],
                            yield_cols, distinct: bool, where_expr,
-                           ExcType):
+                           ExcType, upto: bool = False):
         """Submit one GO onto the coalescing dispatcher; the batch
         leader runs the whole device + host pipeline for every rider
         (go_batch_execute).  The fused device-filter mode bypasses the
-        dispatcher (its kernel bakes the query's filter)."""
+        dispatcher (its kernel bakes the query's filter; UPTO keeps
+        the dispatcher + host-filter path — the fused kernels have no
+        union accumulator)."""
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
-        if plan.filter_cval is not None \
+        if plan.filter_cval is not None and not upto \
                 and flags.get("tpu_filter_mode") == "device":
             return self._execute_fused(space_id, plan, start_vids,
                                        et_tuple, steps, etype_to_alias,
@@ -642,12 +652,13 @@ class TpuQueryRuntime:
         q = _GoQuery(start_vids, plan, yield_cols, distinct, where_expr,
                      etype_to_alias, ExcType)
         result, _m = self.dispatcher.submit_batched(
-            ("go_batch_execute", space_id, et_tuple, steps), q)
+            ("go_batch_execute", space_id, et_tuple, steps, upto), q)
         return result
 
     # ------------------------------------------------ batch entry point
     def go_batch_execute(self, space_id: int, queries: List[_GoQuery],
-                         et_tuple: Tuple[int, ...], steps: int):
+                         et_tuple: Tuple[int, ...], steps: int,
+                         upto: bool = False):
         """Dispatcher leader entry: run a whole batch of GO queries —
         one device launch for the frontier advance, then one vectorized
         host pass per (WHERE, YIELD) signature group.
@@ -660,7 +671,8 @@ class TpuQueryRuntime:
         import time
         t0 = time.perf_counter()
         starts = [q.start_vids for q in queries]
-        launch = self._launch_frontiers(space_id, starts, et_tuple, steps)
+        launch = self._launch_frontiers(space_id, starts, et_tuple, steps,
+                                        upto=upto)
         self._tick("t_launch_s", t0)
 
         def finish():
@@ -676,12 +688,15 @@ class TpuQueryRuntime:
 
     # ------------------------------------------------ frontier launch
     def _launch_frontiers(self, space_id: int, starts_per_query,
-                          et_tuple: Tuple[int, ...], steps: int):
+                          et_tuple: Tuple[int, ...], steps: int,
+                          upto: bool = False):
         """Start the device work for ``steps - 1`` frontier advances of
         B queries; returns a zero-arg resolver -> (per-query ascending
         dense-id frontier arrays, mirror).  Selection order: host-only
         (steps==1) → sparse pair-list → adaptive single → dense
-        bit-packed, with sparse overflow re-running dense.
+        bit-packed, with sparse overflow re-running dense.  ``upto``
+        selects the cumulative-frontier kernel variants (the returned
+        per-query arrays are the UNION of depths 0..steps-1).
 
         The start sets ride ONE flat (dense_id, query) pair vector,
         deduped with a single lexsort — per-query Python loops here ran
@@ -690,7 +705,7 @@ class TpuQueryRuntime:
         m = self.mirror(space_id)
         delta = self._live_delta(m)
         if delta is not None and steps > 1 \
-                and (delta.has_deletes or len(delta.extra_vids)):
+                and (upto or delta.has_deletes or len(delta.extra_vids)):
             # reachability changed (a base edge died) or the dense-id
             # space grew (new vertices): the base ELL can't answer a
             # multi-hop frontier advance exactly — pay the rebuild for
@@ -743,6 +758,7 @@ class TpuQueryRuntime:
         c0 = self._sparse_c0(len(d_all))
         mesh = self._mesh_only()
         if mesh is not None and delta is None and c0 is not None \
+                and not upto \
                 and flags.get("tpu_mesh_mode") == "sparse":
             # the dense replicated-frontier tables are NOT built here —
             # uploading both designs' tables would double per-chip HBM;
@@ -758,7 +774,7 @@ class TpuQueryRuntime:
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is not None:
             return self._launch_sparse(space_id, m, ix, d_all, q_all, nq,
-                                       et_tuple, steps, c0)
+                                       et_tuple, steps, c0, upto=upto)
 
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is None and nq > 1:
@@ -770,24 +786,25 @@ class TpuQueryRuntime:
             # dense fallback put 75 s on the 32-start leg's p99)
             launched = self._launch_sparse_split(
                 space_id, m, ix, d_all, q_all, nq, et_tuple, steps,
-                qbounds)
+                qbounds, upto=upto)
             if launched is not None:
                 return launched
 
-        if nq == 1 and delta is None and mesh_mt is None \
+        if nq == 1 and delta is None and mesh_mt is None and not upto \
                 and flags.get("tpu_adaptive_single") \
                 and len(d_all) <= int(flags.get("tpu_adaptive_k") or 2048):
             return self._launch_adaptive(space_id, m, ix, d_all,
                                          et_tuple, steps)
 
         return self._launch_dense(space_id, m, ix, d_all, q_all, nq,
-                                  et_tuple, steps, delta, mesh_mt)
+                                  et_tuple, steps, delta, mesh_mt,
+                                  upto=upto)
 
     def _launch_sparse_split(self, space_id: int, m: CsrMirror,
                              ix: EllIndex, d_all: np.ndarray,
                              q_all: np.ndarray, nq: int,
                              et_tuple: Tuple[int, ...], steps: int,
-                             qbounds: np.ndarray):
+                             qbounds: np.ndarray, upto: bool = False):
         """Greedy query-boundary split of an over-wide batch into
         sparse sub-launches (each within the c0 ladder).  All sub
         kernels dispatch async back-to-back, so the launches pipeline
@@ -817,7 +834,7 @@ class TpuQueryRuntime:
                 continue
             parts.append((g_lo, g_hi, self._launch_sparse(
                 space_id, m, ix, d_seg, q_seg, g_hi - g_lo, et_tuple,
-                steps, c0g)))
+                steps, c0g, upto=upto)))
         self.stats["go_sparse_split"] = \
             self.stats.get("go_sparse_split", 0) + 1
 
@@ -876,7 +893,8 @@ class TpuQueryRuntime:
 
     def _launch_sparse(self, space_id: int, m: CsrMirror, ix: EllIndex,
                        d_all: np.ndarray, q_all: np.ndarray, nq: int,
-                       et_tuple: Tuple[int, ...], steps: int, c0: int):
+                       et_tuple: Tuple[int, ...], steps: int, c0: int,
+                       upto: bool = False):
         from .ell import make_batched_sparse_go_kernel, sparse_caps
         import jax.numpy as jnp
         d_max = max(ix.bucket_D) if ix.bucket_D else 1
@@ -885,12 +903,17 @@ class TpuQueryRuntime:
                            growth=int(flags.get("tpu_sparse_growth") or 8))
         qmax = max(int(flags.get("go_batch_max") or 1024), nq)
         kern = self._kernel(
-            ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax),
+            ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax,
+             upto),
             lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
-                                                  caps, qmax=qmax))
+                                                  caps, qmax=qmax,
+                                                  upto=upto))
         first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
                                                  set())
-        self._prewarm_family(m, ix, et_tuple, steps, skip_c0=c0)
+        # an UPTO query compiled only the UPTO variant — every exact
+        # rung still needs the warm
+        self._prewarm_family(m, ix, et_tuple, steps,
+                             skip_c0=None if upto else c0)
         S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
         qid = np.zeros(c0, np.int32)
@@ -899,8 +922,12 @@ class TpuQueryRuntime:
         ids[:S] = new[order]
         qid[:S] = q_all[order]
         ecnt, e0 = self._hub_expansion_dev(m, ix)
+        # upto shapes are outside the warm's scope (it compiles the
+        # exact-depth variants only) — register uncounted, like the
+        # family-triggering shape
         self._note_live_shape(("sparse_go", ix.shape_sig(), et_tuple,
-                               steps, c0), first_of_family=first)
+                               steps, c0),
+                              first_of_family=first or upto)
         out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                        *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
@@ -913,7 +940,8 @@ class TpuQueryRuntime:
                 self.stats["sparse_overflows"] += 1
                 return self._launch_dense(space_id, m, ix, d_all, q_all,
                                           nq, et_tuple, steps, None,
-                                          self._mesh_tables(m, ix))()
+                                          self._mesh_tables(m, ix),
+                                          upto=upto)()
             vs_old = ix.inv[vids_new]
             # sorted by (query, old dense id): deterministic row order
             # identical to the dense path's ascending nonzero scan
@@ -1019,10 +1047,13 @@ class TpuQueryRuntime:
     def _launch_dense(self, space_id: int, m: CsrMirror, ix: EllIndex,
                       d_all: np.ndarray, q_all: np.ndarray, nq: int,
                       et_tuple: Tuple[int, ...], steps: int,
-                      delta, mesh_mt):
+                      delta, mesh_mt, upto: bool = False):
         from .ell import (make_batched_go_kernel,
                           make_batched_go_delta_kernel,
                           make_sharded_batched_go_kernel, unpack_bits)
+        # callers guarantee: upto never reaches the delta or sharded
+        # variants (delta forces mirror_full, the mesh gate declines)
+        assert not (upto and (delta is not None or mesh_mt is not None))
         B = self._batch_width(nq)
         f0_dev = self._upload_frontier(ix, ix.perm[d_all],
                                        q_all.astype(np.int32), B)
@@ -1045,9 +1076,9 @@ class TpuQueryRuntime:
             out_dev = kern(f0_dev, args[0], *nbrs, *ets)
         else:
             kern = self._kernel(
-                ("ell_go", ix.shape_sig(), et_tuple, steps),
+                ("ell_go", ix.shape_sig(), et_tuple, steps, upto),
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
-                                               pack=True))
+                                               pack=True, upto=upto))
             # family registration BEFORE the first/_note check (like
             # the sparse path): same-family queries racing the first
             # compile must still be counted against the warm
@@ -1055,7 +1086,8 @@ class TpuQueryRuntime:
                                                      set())
             self._prewarm_family(m, ix, et_tuple, steps)
             self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
-                                   steps, B), first_of_family=first)
+                                   steps, B),
+                                  first_of_family=first or upto)
             out_dev = kern(f0_dev, *args)
         self.stats["go_dense"] += 1
 
@@ -1121,9 +1153,12 @@ class TpuQueryRuntime:
                         continue   # the triggering live query compiled
                     caps = sparse_caps(c0, d_max, steps, cap,
                                        growth=growth)
+                    # upto=False in the key: prewarm covers the
+                    # exact-depth variants (the common shapes); UPTO
+                    # kernels compile on first use
                     kern = self._kernel(
                         ("sparse_go", ix.shape_sig(), et_tuple, steps,
-                         caps, qmax),
+                         caps, qmax, False),
                         lambda: make_batched_sparse_go_kernel(
                             ix, steps, et_tuple, caps, qmax=qmax))
                     kern.lower(i32((c0,), np.int32), i32((c0,), np.int32),
@@ -1137,7 +1172,8 @@ class TpuQueryRuntime:
                     if steps <= 1:
                         continue
                     kern = self._kernel(
-                        ("ell_go", ix.shape_sig(), et_tuple, steps),
+                        ("ell_go", ix.shape_sig(), et_tuple, steps,
+                         False),
                         lambda: make_batched_go_kernel(
                             ix, steps, et_tuple, pack=True))
                     kern.lower(i32((ix.n_rows + 1, B), np.int8),

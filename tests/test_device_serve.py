@@ -41,6 +41,7 @@ def _seed(c, cl):
 
 QUERIES = [
     "GO FROM 100 OVER follow",
+    "GO UPTO 2 STEPS FROM 100 OVER follow YIELD follow._dst",
     "GO 2 STEPS FROM 100 OVER follow YIELD follow._dst, follow.degree",
     "GO 3 STEPS FROM 100 OVER follow WHERE follow.degree > 85 "
     "YIELD follow._dst, $$.player.name",

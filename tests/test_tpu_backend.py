@@ -106,6 +106,12 @@ PARITY_QUERIES = [
     f"YIELD follow._dst",
     f"GO FROM {TIM} OVER follow YIELD follow.degree + 1 AS dd",
     f"GO FROM {TIM} OVER follow YIELD $^.player.age / 2 AS h",
+    # UPTO rides the cumulative-frontier kernel variants on device
+    f"GO UPTO 2 STEPS FROM {TIM} OVER follow",
+    f"GO UPTO 3 STEPS FROM {TIM} OVER follow YIELD follow._dst, "
+    f"follow.degree",
+    f"GO UPTO 2 STEPS FROM {TIM} OVER follow WHERE follow.degree > 90 "
+    f"YIELD follow._dst",
     f"FIND SHORTEST PATH FROM {TIM} TO {MANU} OVER follow",
     f"FIND SHORTEST PATH FROM {LEBRON} TO {CAVS} OVER * UPTO 3 STEPS",
     f"FIND SHORTEST PATH FROM {TIM} TO {CAVS} OVER follow",
@@ -780,3 +786,116 @@ class TestSparseSplit:
         finally:
             flags.set("storage_backend", "tpu")
             c.stop()
+
+
+class TestUptoDevice:
+    """GO UPTO serves on the device via the cumulative-frontier kernel
+    variants (sparse union merge / dense OR accumulator) — not a CPU
+    fallback."""
+
+    def test_upto_runs_on_device_and_matches_cpu(self):
+        from nebula_tpu.common.flags import flags
+
+        c, g = _boot(tpu_backend=True)
+        try:
+            q = (f"GO UPTO 3 STEPS FROM {TIM} OVER follow "
+                 f"YIELD follow._dst, follow.degree")
+            flags.set("storage_backend", "cpu")
+            cpu_rows = sorted(map(tuple, g.execute(q).rows))
+            flags.set("storage_backend", "tpu")
+            rt = c.tpu_runtime
+            before = rt.stats["go_device"]
+            before_sparse = rt.stats["go_sparse"]
+            r = g.execute(q)
+            assert r.ok(), r.error_msg
+            assert sorted(map(tuple, r.rows)) == cpu_rows
+            assert rt.stats["go_device"] == before + 1
+            assert rt.stats["go_sparse"] == before_sparse + 1
+        finally:
+            flags.set("storage_backend", "tpu")
+            c.stop()
+
+    def test_upto_dense_kernel_union(self):
+        """Dense UPTO variant ORs every depth's frontier."""
+        import jax.numpy as jnp
+
+        from nebula_tpu.tpu import ell as E
+
+        rng = np.random.default_rng(5)
+        n, m = 200, 900
+        es = rng.integers(0, n, m).astype(np.int32)
+        ed = rng.integers(0, n, m).astype(np.int32)
+        ee = np.ones(m, np.int32)
+        both_s = np.concatenate([es, ed])
+        both_d = np.concatenate([ed, es])
+        both_e = np.concatenate([ee, -ee])
+        ix = E.EllIndex.build(both_s, both_d, both_e, n, cap=64, min_d=8)
+        f0 = ix.start_frontier([np.asarray([3]), np.asarray([7, 11])],
+                               B=8)
+        steps = 3
+        kern = E.make_batched_go_kernel(ix, steps, (1,), upto=True)
+        out = np.asarray(kern(jnp.asarray(f0), *ix.kernel_args()))
+        # numpy oracle: OR of frontiers at depths 0..steps-1
+        adj = {}
+        for s_, d_ in zip(es.tolist(), ed.tolist()):
+            adj.setdefault(s_, set()).add(d_)
+        for q, starts in enumerate(([3], [7, 11])):
+            acc = set(starts)
+            cur = set(starts)
+            for _ in range(steps - 1):
+                cur = set().union(*(adj.get(v, set()) for v in cur)) \
+                    if cur else set()
+                acc |= cur
+            got = set(np.nonzero(ix.to_old(out[:ix.n_rows + 1])
+                                 [:n, q])[0].tolist())
+            assert got == acc, (q, got, acc)
+
+    def test_upto_sparse_kernel_union(self):
+        """Sparse UPTO variant returns the deduped union pair list."""
+        import jax.numpy as jnp
+
+        from nebula_tpu.tpu import ell as E
+
+        rng = np.random.default_rng(11)
+        n, m = 300, 1500
+        es = rng.integers(0, n, m).astype(np.int32)
+        ed = rng.integers(0, n, m).astype(np.int32)
+        ee = np.ones(m, np.int32)
+        both_s = np.concatenate([es, ed])
+        both_d = np.concatenate([ed, es])
+        both_e = np.concatenate([ee, -ee])
+        ix = E.EllIndex.build(both_s, both_d, both_e, n, cap=64, min_d=8)
+        steps = 3
+        caps = E.sparse_caps(8, max(ix.bucket_D), steps, 1 << 14)
+        kern = E.make_batched_sparse_go_kernel(ix, steps, (1,), caps,
+                                               qmax=16, upto=True)
+        starts = [[3], [7, 11], [42]]
+        ids = np.full(caps[0], ix.n_rows, np.int32)
+        qid = np.zeros(caps[0], np.int32)
+        k = 0
+        for q, ss in enumerate(starts):
+            for v in ss:
+                ids[k] = ix.perm[v]
+                qid[k] = q
+                k += 1
+        ecnt, e0 = ix.hub_expansion()
+        out = kern(jnp.asarray(ids), jnp.asarray(qid),
+                   jnp.asarray(ecnt), jnp.asarray(e0),
+                   *ix.kernel_args()[1:])
+        cnt, overflow, qids, vids_new = E.sparse_go_pairs(
+            kern, np.asarray(out))
+        assert not overflow
+        adj = {}
+        for s_, d_ in zip(es.tolist(), ed.tolist()):
+            adj.setdefault(s_, set()).add(d_)
+        got = {}
+        for qv, iv in zip(qids.tolist(), ix.inv[vids_new].tolist()):
+            got.setdefault(qv, set()).add(iv)
+        for q, ss in enumerate(starts):
+            acc = set(ss)
+            cur = set(ss)
+            for _ in range(steps - 1):
+                cur = set().union(*(adj.get(v, set()) for v in cur)) \
+                    if cur else set()
+                acc |= cur
+            assert got.get(q, set()) == acc, (q, got.get(q), acc)
