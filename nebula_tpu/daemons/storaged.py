@@ -14,6 +14,7 @@ from __future__ import annotations
 import sys
 
 from ..cluster import CompositeHandler, StorageNode
+from ..common.flags import flags
 from ..interface.rpc import ClientManager, RpcServer
 from ..webservice import WebService
 from .common import (apply_flag_overrides, base_parser, load_flagfile,
@@ -27,9 +28,31 @@ def main(argv=None) -> int:
     p.add_argument("--wal_path", default=None)
     p.add_argument("--no_raft", action="store_true",
                    help="single-replica mode (no consensus)")
+    p.add_argument("--store_type", default="nebula",
+                   help='storage service type: "nebula" (the built-in '
+                        'KV engines — C++ in-memory, durable disk, or '
+                        'pure-python fallback, chosen by --data_path). '
+                        '"hbase" is recognized for reference-flag '
+                        'parity and refused the same way the '
+                        'reference refuses it (StorageServer.cpp:52)')
     args = p.parse_args(argv)
     load_flagfile(args.flagfile)
     apply_flag_overrides(args.flag)
+    # reference parity: StorageServer.cpp:44-55 instantiates only
+    # kStore and errors "Unknown store type" for everything else (its
+    # HBase plugin is dormant); same contract here.  The gate runs
+    # AFTER the flagfile/--flag overrides so a conf-file
+    # `store_type=hbase` (the reference's idiom) is refused too — an
+    # explicit CLI value wins over the conf like every other flag
+    store_type = args.store_type
+    if store_type == "nebula" \
+            and flags.get("store_type") not in (None, ""):
+        store_type = str(flags.get("store_type"))
+    if store_type != "nebula":
+        print(f"nebula-storaged: unknown store type "
+              f"'{store_type}' (only 'nebula' is served)",
+              file=sys.stderr)
+        return 1
     write_pidfile(args.pid_file)
 
     from ..native import ensure_built

@@ -643,3 +643,30 @@ def test_micro_bench_tool_runs():
     assert out["parser"]["statements_per_s"] > 0
     assert out["row_codec"]["encode_rows_per_s"] > 0
     assert out["wal"]["append_entries_per_s"] > 0
+
+
+class TestStoreTypeGate:
+    def test_unknown_store_type_refused(self, tmp_path):
+        """--store_type parity: only 'nebula' is served; anything else
+        (incl. 'hbase', whose plugin the reference keeps dormant and
+        refuses at startup, StorageServer.cpp:44-55) exits with an
+        error instead of booting — whether it arrives on the CLI or
+        via --flagfile (the reference's conf idiom)."""
+        import subprocess
+        import sys as _sys
+        r = subprocess.run(
+            [_sys.executable, "-m", "nebula_tpu.daemons.storaged",
+             "--store_type", "hbase", "--port", "45993",
+             "--meta_server_addrs", "127.0.0.1:45994"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 1
+        assert "unknown store type 'hbase'" in r.stderr
+        conf = tmp_path / "storaged.conf"
+        conf.write_text("store_type=hbase\n")
+        r2 = subprocess.run(
+            [_sys.executable, "-m", "nebula_tpu.daemons.storaged",
+             "--flagfile", str(conf), "--port", "45993",
+             "--meta_server_addrs", "127.0.0.1:45994"],
+            capture_output=True, text=True, timeout=60)
+        assert r2.returncode == 1
+        assert "unknown store type 'hbase'" in r2.stderr

@@ -261,3 +261,69 @@ class TestTornScanGuard:
         cm = self._FakeCM(bump_at_chunk=2)
         with pytest.raises(RpcError):
             list(self._view(cm).prefix(1, 1, b"k"))
+
+
+class TestUptoRpcSkew:
+    """The deviceGo response must ECHO the upto field: an older
+    storaged that ignores it would silently serve exact depth, so a
+    missing echo is a decline (cached per space — the round trip is
+    not re-paid per query)."""
+
+    def _runtime(self, responses):
+        from types import SimpleNamespace
+
+        from nebula_tpu.storage.device import RemoteDeviceRuntime
+
+        rt = RemoteDeviceRuntime(meta_client=None, schema_man=None,
+                                 client_manager=None)
+        calls = []
+
+        def fake_call(host, method, req, ExcType):
+            calls.append(req)
+            return responses.pop(0)
+
+        rt._call = fake_call
+        rt._device_host = lambda sid: (("h", 1), [1])
+        rt.calls = calls
+        return rt
+
+    def _go(self, rt, upto):
+        from types import SimpleNamespace
+
+        from nebula_tpu.filter.expressions import PrimaryExpr
+        sentence = SimpleNamespace(step=SimpleNamespace(steps=3,
+                                                        upto=upto))
+        executor = SimpleNamespace(sentence=sentence)
+        return rt.run_go(executor, 7, [1], [1], 3, {1: "e"},
+                         [SimpleNamespace(expr=PrimaryExpr(1),
+                                          alias="c")],
+                         False, None, {}, [], upto=upto)
+
+    def test_missing_echo_declines_and_caches(self):
+        from nebula_tpu.storage.device import TpuDecline
+
+        import pytest as _pytest
+        # old build: ok response WITHOUT the upto echo
+        rt = self._runtime([{"ok": True, "columns": ["c"], "rows": []}])
+        with _pytest.raises(TpuDecline):
+            self._go(rt, upto=True)
+        assert 7 in rt._upto_declined
+        # next UPTO query on the space declines BEFORE any RPC
+        sentence = type("S", (), {})()
+        sentence.step = type("T", (), {"steps": 3, "upto": True})()
+        assert rt.can_run_go(7, [1], sentence, None, None, [], [],
+                             False) is False
+        assert len(rt.calls) == 1          # no second round trip
+
+    def test_echo_accepted(self):
+        from nebula_tpu.graph.interim import InterimResult
+        rt = self._runtime([{"ok": True, "columns": ["c"], "rows": [],
+                             "upto": True}])
+        out = self._go(rt, upto=True)
+        assert isinstance(out, InterimResult)
+        assert 7 not in rt._upto_declined
+
+    def test_exact_depth_needs_no_echo(self):
+        rt = self._runtime([{"ok": True, "columns": ["c"], "rows": []}])
+        out = self._go(rt, upto=False)
+        assert out is not None
