@@ -50,6 +50,18 @@ class ExecutionResponse:
     def space_name(self) -> str:
         return self.raw.get("space_name", "")
 
+    @property
+    def completeness(self) -> int:
+        """% of storage parts that answered (100 = full result; < 100
+        = graphd served a correct subset and said so — see
+        StorageRpcResponse.completeness)."""
+        return self.raw.get("completeness", 100)
+
+    @property
+    def warnings(self) -> list:
+        """Degradation notes attached by graphd (partial results)."""
+        return self.raw.get("warnings", [])
+
     def ok(self) -> bool:
         return self.error_code == ErrorCode.SUCCEEDED
 

@@ -130,3 +130,30 @@ flags.define("storage_engine", "auto",
 flags.define("raft_heartbeat_interval_ms", 500, "raft leader heartbeat")
 flags.define("raft_election_timeout_ms", 1500, "raft election timeout base")
 flags.define("wal_buffer_size_bytes", 256 * 1024, "wal flush buffer")
+
+# ---- robustness / fault injection (interface/faults.py) -------------
+flags.define("fault_injection_rules", "",
+             "JSON list of wire-fault rules (docs/fault_injection.md); "
+             "empty disables injection")
+flags.define("fault_injection_seed", 0,
+             "seed for the fault injector's probability draws")
+# storage client retry policy (storage/client.py collect)
+flags.define("storage_client_retry_backoff_ms", 20,
+             "base backoff between scatter-gather retry passes")
+flags.define("storage_client_retry_backoff_max_ms", 1000,
+             "cap on one storage-client backoff sleep")
+flags.define("storage_client_request_deadline_ms", 15000,
+             "overall per-request budget for one scatter-gather collect "
+             "(passes + backoff); 0 disables the deadline")
+# meta client retry policy (meta/client.py _call)
+flags.define("meta_client_retry_backoff_ms", 100,
+             "base backoff between whole-peer-set retry passes")
+flags.define("meta_client_retry_backoff_max_ms", 2000,
+             "cap on one meta-client backoff sleep")
+flags.define("meta_client_max_hint_chase", 3,
+             "max not-a-leader hints chased inside one peer pass "
+             "(bounds adversarial/looping hint chains)")
+# UPTO negative-cache policy (storage/device.py RemoteDeviceRuntime)
+flags.define("upto_decline_ttl_s", 300.0,
+             "seconds an UPTO decline is remembered per space before "
+             "the device host is probed again (restart/upgrade recovery)")

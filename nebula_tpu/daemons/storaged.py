@@ -21,6 +21,18 @@ from .common import (apply_flag_overrides, base_parser, load_flagfile,
                      parse_meta_addrs, serve_forever, write_pidfile)
 
 
+def resolve_store_type(cli_value):
+    """CLI-vs-conf precedence for --store_type (reference gflags
+    semantics): an EXPLICIT CLI value always beats the conf-file value
+    (so `--store_type nebula` overrides a conf `hbase`), an unset CLI
+    (None — the argparse default) falls through to the conf, and an
+    unset conf falls through to "nebula"."""
+    if cli_value is not None:
+        return str(cli_value)
+    conf_value = flags.get("store_type")
+    return str(conf_value) if conf_value not in (None, "") else "nebula"
+
+
 def main(argv=None) -> int:
     p = base_parser("nebula-storaged", 44500)
     p.add_argument("--data_path", default=None,
@@ -28,7 +40,7 @@ def main(argv=None) -> int:
     p.add_argument("--wal_path", default=None)
     p.add_argument("--no_raft", action="store_true",
                    help="single-replica mode (no consensus)")
-    p.add_argument("--store_type", default="nebula",
+    p.add_argument("--store_type", default=None,
                    help='storage service type: "nebula" (the built-in '
                         'KV engines — C++ in-memory, durable disk, or '
                         'pure-python fallback, chosen by --data_path). '
@@ -42,12 +54,10 @@ def main(argv=None) -> int:
     # kStore and errors "Unknown store type" for everything else (its
     # HBase plugin is dormant); same contract here.  The gate runs
     # AFTER the flagfile/--flag overrides so a conf-file
-    # `store_type=hbase` (the reference's idiom) is refused too — an
-    # explicit CLI value wins over the conf like every other flag
-    store_type = args.store_type
-    if store_type == "nebula" \
-            and flags.get("store_type") not in (None, ""):
-        store_type = str(flags.get("store_type"))
+    # `store_type=hbase` (the reference's idiom) is refused too, while
+    # default=None above keeps an explicit CLI value distinguishable
+    # from "unset" (resolve_store_type)
+    store_type = resolve_store_type(args.store_type)
     if store_type != "nebula":
         print(f"nebula-storaged: unknown store type "
               f"'{store_type}' (only 'nebula' is served)",

@@ -40,12 +40,29 @@ class ExecutionContext:
         self.variables = VariableHolder()
         # set by Pipe: the left-hand result available as $- to the right
         self.input: Optional[InterimResult] = None
+        # partial-result accounting: executors that accept a degraded
+        # scatter-gather response (some parts failed, completeness
+        # 0 < % < 100) record it here instead of silently returning a
+        # subset — ExecutionEngine surfaces it on the client response
+        self.completeness: int = 100
+        self.warnings: list = []
         # TPU query runtime (tpu/runtime.py) — executors prefer it when the
         # current space has a device CSR mirror and the flag allows
         self.tpu_runtime = tpu_runtime
         # adaptive device-vs-CPU router (graph/backend_router.py),
         # engine-scoped so estimates persist across queries
         self.router = router
+
+    def note_partial(self, resp) -> None:
+        """Record a degraded StorageRpcResponse (reference
+        GoExecutor.cpp:356-366 tolerates completeness < 100; we also
+        report it instead of silently dropping the failed parts)."""
+        pct = resp.completeness()
+        self.completeness = min(self.completeness, pct)
+        first = next(iter(resp.failed_parts.values()))
+        self.warnings.append(
+            f"partial result: {len(resp.failed_parts)}/{resp.total_parts} "
+            f"storage parts failed ({first.to_string()})")
 
     def space_id(self) -> int:
         return self.session.space_id

@@ -35,6 +35,19 @@ class Executor:
         raise NotImplementedError
 
     # ---- helpers shared by executors --------------------------------
+    def check_storage_resp(self, resp) -> None:
+        """Shared read-path contract for scatter-gather responses:
+        every part failed → typed error; SOME parts failed → keep the
+        surviving rows but record completeness % + a warning on the
+        execution context so the client response reports the
+        degradation instead of silently serving a subset."""
+        if resp.succeeded():
+            return
+        if resp.completeness() == 0:
+            first = next(iter(resp.failed_parts.values()))
+            raise ExecError(f"storage error: {first.to_string()}")
+        self.ectx.note_partial(resp)
+
     def check_space_chosen(self) -> None:
         if not self.ectx.space_chosen():
             raise ExecError("please choose a graph space with `USE spaceName' first")

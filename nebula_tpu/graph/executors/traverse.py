@@ -384,9 +384,7 @@ class GoExecutor(Executor):
                 edge_props=edge_props if is_final else {},
                 dst_only=not is_final,
                 flat=is_final and flat_specs is not None)
-            if not resp.succeeded() and resp.completeness() == 0:
-                first = next(iter(resp.failed_parts.values()))
-                raise ExecError(f"storage error: {first.to_string()}")
+            self.check_storage_resp(resp)
             if is_final:
                 final_resp = resp
                 break        # may have been promoted early under UPTO
@@ -597,9 +595,7 @@ class FetchVerticesExecutor(Executor):
                 vertex_props = [[tag_id, p] for p in schema.names()]
 
         resp = self.ectx.storage.get_props(space, vids, vertex_props)
-        if not resp.succeeded() and resp.completeness() == 0:
-            first = next(iter(resp.failed_parts.values()))
-            raise ExecError(f"storage error: {first.to_string()}")
+        self.check_storage_resp(resp)
 
         if s.yield_ is not None:
             yield_cols = s.yield_.columns
@@ -696,9 +692,7 @@ class FetchEdgesExecutor(Executor):
                 [c.expr for c in s.yield_.columns])
             props = sorted({p for _a, p in edge_refs})
         resp = self.ectx.storage.get_edge_props(space, keys, props)
-        if not resp.succeeded() and resp.completeness() == 0:
-            first = next(iter(resp.failed_parts.values()))
-            raise ExecError(f"storage error: {first.to_string()}")
+        self.check_storage_resp(resp)
 
         if s.yield_ is not None:
             yield_cols = s.yield_.columns
@@ -1023,9 +1017,7 @@ class FindPathExecutor(Executor):
             if not unfound and s.shortest:
                 break  # every target reached at its shortest depth
             resp = self.ectx.storage.get_neighbors(space, frontier, etypes)
-            if not resp.succeeded() and resp.completeness() == 0:
-                first = next(iter(resp.failed_parts.values()))
-                raise ExecError(f"storage error: {first.to_string()}")
+            self.check_storage_resp(resp)
             from ...native.batch import decode_rowset_column
             nxt: List[int] = []
             for r in resp.responses:
@@ -1176,8 +1168,7 @@ class MatchExecutor(Executor):
         pat_vars = {s.a_var, s.b_var, s.e_var}
         labels = {s.a_var: s.a_label, s.b_var: s.b_label}
 
-        def rewrite(text: str, what: str, start_var: str,
-                    end_var: str) -> str:
+        def rewrite(text: str, what: str, start_var: str) -> str:
             """Token-level pattern-variable substitution — operating on
             TOKENS (not raw text) so string literals that happen to
             spell a variable name are never touched."""
@@ -1331,37 +1322,41 @@ class MatchExecutor(Executor):
             tail, head = s.a_var, s.b_var
         chosen = None
         rewrite_err = None
-        for start_var, end_var, reversely in ((tail, head, False),
-                                              (head, tail, True)):
+        rewrote_clean = False
+        for start_var, reversely in ((tail, False), (head, True)):
             if not s.where_text:
                 break
             try:
                 tree = parse_with(
                     "p_expression",
-                    rewrite(s.where_text, "WHERE", start_var, end_var))
+                    rewrite(s.where_text, "WHERE", start_var))
             except ExecError as e:
                 # a direction can fail to rewrite on its own (e.g. the
                 # would-be $^/$$ vertex reads a prop without a label);
                 # the other direction may still carry the anchor
                 rewrite_err = rewrite_err or e
                 continue
+            rewrote_clean = True
             vids, remnant = split_anchors(tree)
             if vids:
-                chosen = (start_var, end_var, reversely, vids, remnant)
+                chosen = (start_var, reversely, vids, remnant)
                 break
         if chosen is None:
-            if rewrite_err is not None:
+            # when a direction rewrote cleanly but carried no anchor,
+            # the real problem is the missing id() anchor — the OTHER
+            # direction's rewrite error is incidental (its $^/$$ shape
+            # would never have been used) and would only mislead
+            if rewrite_err is not None and not rewrote_clean:
                 raise rewrite_err
             raise ExecError(
                 "MATCH needs an id(<pattern vertex>) == <vid> anchor "
                 "in WHERE to choose start vertices",
                 ErrorCode.E_UNSUPPORTED)
-        start_var, end_var, reversely, vids, remnant = chosen
+        start_var, reversely, vids, remnant = chosen
 
         yc = parse_with(
             "p_yield_clause",
-            "yield " + rewrite(s.return_text, "RETURN", start_var,
-                               end_var))
+            "yield " + rewrite(s.return_text, "RETURN", start_var))
 
         if steps > 1:
             # any id(<start>) that did NOT become the anchor (a

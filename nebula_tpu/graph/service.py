@@ -172,6 +172,15 @@ class ExecutionEngine:
         if result is not None and resp["error_code"] == int(ErrorCode.SUCCEEDED):
             resp["column_names"] = result.columns
             resp["rows"] = result.rows
+        if ectx.completeness < 100 \
+                and resp["error_code"] == int(ErrorCode.SUCCEEDED):
+            # degraded scatter-gather: the rows are a correct SUBSET —
+            # report completeness % + per-op warnings instead of the
+            # old silent degradation (attached only when < 100, so the
+            # wire shape for healthy responses is unchanged)
+            resp["completeness"] = ectx.completeness
+            resp["warnings"] = list(ectx.warnings)
+            stats.add_value("graph.partial_result.qps")
         resp["space_name"] = session.space_name
         resp["latency_in_us"] = dur.elapsed_in_usec()
         stats.add_value("graph.latency_us", resp["latency_in_us"])
@@ -196,6 +205,7 @@ class GraphService:
         stats.register_stats("graph.qps")
         stats.register_stats("graph.latency_us")
         stats.register_stats("graph.error.qps")
+        stats.register_stats("graph.partial_result.qps")
 
     def rpc_authenticate(self, req: dict) -> dict:
         user = req.get("username", "")

@@ -1,0 +1,207 @@
+"""Deterministic wire-level fault injection (docs/fault_injection.md).
+
+The chaos seam the reference exercises with real cluster churn
+(StorageClient.inl:120-133 leader chases, MetaClient failover) is here a
+first-class, seeded layer: ``FaultInjector.intercept(host, method)``
+sits in ``ClientManager.call`` / ``RpcChannel.call`` (interface/rpc.py)
+— the single chokepoint every in-tree client (StorageClient, MetaClient,
+raftex replication, GraphClient, RemoteDeviceRuntime) dials through —
+and decides per rule whether the call proceeds, is delayed, or dies with
+a typed RpcError before/after reaching the wire.
+
+Rules are plain dicts (JSON on the wire), matched in order; the first
+rule that matches AND fires wins:
+
+  {"kind": "refuse_connect",      # E_FAIL_TO_CONNECT before send
+          | "blackhole"           # same code; semantically "packets
+                                  #   dropped" — pair with delay_s to
+                                  #   model the connect-timeout wait
+          | "rpc_failure"         # E_RPC_FAILURE, op NOT executed
+                                  #   (request lost mid-call)
+          | "rpc_failure_after"   # op EXECUTED, reply lost — the
+                                  #   non-idempotent-duplication trap
+          | "leader_changed"      # E_LEADER_CHANGED, msg = "leader"
+          | "delay",              # sleep delay_s then proceed
+   "host": "127.0.0.1:44500",     # fnmatch pattern, default "*"
+   "method": "getBound",          # fnmatch pattern, default "*"
+   "p": 1.0,                      # fire probability (seeded RNG)
+   "times": 2,                    # stop firing after N fires (None=∞)
+   "skip": 0,                     # let the first N matches through
+   "delay_s": 0.0,                # added latency (any kind)
+   "leader": "127.0.0.1:44501"}   # hint for leader_changed ("" = none)
+
+Determinism: the injector owns one ``random.Random(seed)`` consulted
+only for ``p`` draws, in call order under a lock — the same seed, rules
+and call sequence always produce the same fault schedule.  Config comes
+from three equivalent surfaces: this API, the ``fault_injection_rules``
+/ ``fault_injection_seed`` flags (common/flags.py, conf-file loadable),
+and the ``/faults`` webservice endpoint (GET/PUT, next to ``/flags``).
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.flags import flags
+from ..common.stats import stats
+from ..common.status import ErrorCode
+
+KINDS = ("refuse_connect", "blackhole", "rpc_failure", "rpc_failure_after",
+         "leader_changed", "delay")
+
+# intercept() phases: fail before the call is dispatched (the op never
+# ran) vs after (the op ran, the reply was dropped)
+BEFORE, AFTER = "before", "after"
+
+stats.register_stats("rpc.fault.injected")
+
+
+class FaultRule:
+    __slots__ = ("kind", "host", "method", "p", "times", "skip", "delay_s",
+                 "leader", "hits", "fired")
+
+    def __init__(self, kind: str, host: str = "*", method: str = "*",
+                 p: float = 1.0, times: Optional[int] = None, skip: int = 0,
+                 delay_s: float = 0.0, leader: str = ""):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {', '.join(KINDS)})")
+        self.kind = kind
+        self.host = str(host)
+        self.method = str(method)
+        self.p = float(p)
+        self.times = None if times is None else int(times)
+        self.skip = int(skip)
+        self.delay_s = float(delay_s)
+        self.leader = str(leader)
+        self.hits = 0      # calls that matched (host, method)
+        self.fired = 0     # matches that actually injected the fault
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultRule":
+        unknown = set(d) - {"kind", "host", "method", "p", "times", "skip",
+                            "delay_s", "leader"}
+        if unknown:
+            raise ValueError(f"unknown fault rule fields {sorted(unknown)}")
+        if "kind" not in d:
+            raise ValueError("fault rule needs a 'kind'")
+        return cls(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "host": self.host, "method": self.method,
+                "p": self.p, "times": self.times, "skip": self.skip,
+                "delay_s": self.delay_s, "leader": self.leader,
+                "hits": self.hits, "fired": self.fired}
+
+    def matches(self, host: str, method: str) -> bool:
+        return fnmatch.fnmatchcase(host, self.host) and \
+            fnmatch.fnmatchcase(method, self.method)
+
+
+class FaultInjector:
+    """Rule table + seeded RNG. One module-global instance
+    (``default_injector``) serves the process, mirroring flags/stats."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+
+    # ------------------------------------------------------ configure
+    def configure(self, rules: List[Any],
+                  seed: Optional[int] = None) -> None:
+        """Replace the rule table atomically; the RNG restarts from the
+        (possibly updated) seed so re-applying the same config replays
+        the same fault schedule."""
+        parsed = [r if isinstance(r, FaultRule) else FaultRule.from_dict(r)
+                  for r in (rules or [])]
+        with self._lock:
+            if seed is not None:
+                self.seed = int(seed)
+            self._rng = random.Random(self.seed)
+            self._rules = parsed
+
+    def clear(self) -> None:
+        self.configure([])
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"seed": self.seed,
+                    "rules": [r.to_dict() for r in self._rules]}
+
+    # ------------------------------------------------------ hot path
+    def active(self) -> bool:
+        return bool(self._rules)       # racy read is fine: empty ≡ off
+
+    def intercept(self, host: str, method: str
+                  ) -> Optional[Tuple[str, ErrorCode, str]]:
+        """Consult the rules for one outbound call.  Returns None
+        (proceed normally, possibly after an injected delay) or
+        ``(phase, code, msg)`` for the transport to convert into an
+        RpcError — phase ``BEFORE`` means the op never ran, ``AFTER``
+        means run it first, then drop the reply."""
+        rule = None
+        with self._lock:
+            for r in self._rules:
+                if not r.matches(host, method):
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                r.hits += 1
+                if r.hits <= r.skip:
+                    continue
+                if r.p < 1.0 and self._rng.random() >= r.p:
+                    continue
+                r.fired += 1
+                rule = r
+                break
+        if rule is None:
+            return None
+        stats.add_value("rpc.fault.injected")
+        if rule.delay_s > 0:
+            time.sleep(rule.delay_s)      # outside the lock
+        kind = rule.kind
+        where = f"{method}@{host}"
+        if kind == "delay":
+            return None
+        if kind in ("refuse_connect", "blackhole"):
+            return (BEFORE, ErrorCode.E_FAIL_TO_CONNECT,
+                    f"injected {kind}: {where}")
+        if kind == "rpc_failure":
+            return (BEFORE, ErrorCode.E_RPC_FAILURE,
+                    f"injected rpc failure (request lost): {where}")
+        if kind == "rpc_failure_after":
+            return (AFTER, ErrorCode.E_RPC_FAILURE,
+                    f"injected rpc failure (reply lost): {where}")
+        # leader_changed: msg carries the hint, exactly like a real
+        # storaged's whole-request redirect (storage/service.py)
+        return (BEFORE, ErrorCode.E_LEADER_CHANGED, rule.leader)
+
+
+default_injector = FaultInjector(seed=flags.get("fault_injection_seed", 0))
+
+
+def _apply_rules_flag(_value=None) -> None:
+    raw = flags.get("fault_injection_rules", "")
+    try:
+        rules = json.loads(raw) if raw else []
+    except (json.JSONDecodeError, TypeError):
+        return                # a bad conf line must not kill the daemon
+    try:
+        default_injector.configure(
+            rules, seed=flags.get("fault_injection_seed", 0))
+    except (ValueError, TypeError):
+        pass
+
+
+flags.watch("fault_injection_rules", _apply_rules_flag)
+# the seed alone must also reconfigure (flagfiles apply line at a time,
+# in file order — a seed listed after the rules would otherwise be
+# silently ignored and the schedule would replay under seed 0)
+flags.watch("fault_injection_seed", _apply_rules_flag)
+_apply_rules_flag()

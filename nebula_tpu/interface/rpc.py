@@ -27,6 +27,7 @@ import msgpack
 
 from ..common.status import ErrorCode, Status
 from .common import HostAddr
+from .faults import AFTER, default_injector
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
@@ -139,6 +140,30 @@ class RpcServer:
         self._server.server_close()
 
 
+def _inject_fault(injector, addr, method: str):
+    """Wire-fault seam shared by RpcChannel.call and ClientManager.call
+    (interface/faults.py).  Returns None (proceed) or a callable that
+    the caller invokes AROUND the real dispatch: the callable runs the
+    op when the injected failure is reply-loss (the server executed),
+    then raises the injected RpcError."""
+    if injector is None or not injector.active():
+        return None
+    verdict = injector.intercept(str(addr), method)
+    if verdict is None:
+        return None
+    phase, code, msg = verdict
+
+    def fail(do_call=None):
+        if phase == AFTER and do_call is not None:
+            try:
+                do_call()   # op executes server-side; the reply is lost
+            except RpcError:
+                pass        # the injected failure wins either way
+        raise RpcError(Status(code, msg))
+
+    return fail
+
+
 # ---------------------------------------------------------------- client
 class RpcChannel:
     """Connection pool to one host; concurrent call()s each use their own
@@ -152,10 +177,13 @@ class RpcChannel:
     """
 
     def __init__(self, addr: HostAddr, timeout: float = 30.0,
-                 pool_size: int = 8):
+                 pool_size: int = 8, fault_injector=None):
         self.addr = addr
         self.timeout = timeout
         self.pool_size = pool_size
+        # standalone channels (not owned by a ClientManager, which
+        # injects at its own call()) opt into fault injection here
+        self.fault_injector = fault_injector
         self._lock = threading.Lock()
         self._idle: list = []
 
@@ -168,6 +196,13 @@ class RpcChannel:
 
     def call(self, method: str, payload: Any,
              timeout: Optional[float] = None) -> Any:
+        fail = _inject_fault(self.fault_injector, self.addr, method)
+        if fail is not None:
+            fail(lambda: self._call_wire(method, payload, timeout))
+        return self._call_wire(method, payload, timeout)
+
+    def _call_wire(self, method: str, payload: Any,
+                   timeout: Optional[float] = None) -> Any:
         frame_out = _pack([method, payload])
         for attempt in (0, 1):
             pooled = False
@@ -275,11 +310,17 @@ class ClientManager:
     """Per-host channel cache (reference ThriftClientManager). Register
     loopback handlers for in-process daemons; everything else dials TCP."""
 
-    def __init__(self):
+    def __init__(self, fault_injector=None):
         self._channels: Dict[HostAddr, Any] = {}
         self._loopbacks: Dict[HostAddr, Any] = {}
         self._dead: set = set()          # crash-simulated addrs
         self._lock = threading.Lock()
+        # wire-fault seam (interface/faults.py): every in-tree client
+        # dials through here, so one hook covers loopback AND TCP.
+        # Defaults to the process-global injector (configured via the
+        # fault_injection_rules flag or the /faults web endpoint).
+        self.fault_injector = (default_injector if fault_injector is None
+                               else fault_injector)
 
     def register_loopback(self, addr: HostAddr, handler: Any) -> None:
         with self._lock:
@@ -314,6 +355,10 @@ class ClientManager:
 
     def call(self, addr: HostAddr, method: str, payload: Any,
              timeout: Optional[float] = None) -> Any:
+        fail = _inject_fault(self.fault_injector, addr, method)
+        if fail is not None:
+            fail(lambda: self.channel(addr).call(method, payload,
+                                                 timeout=timeout))
         return self.channel(addr).call(method, payload, timeout=timeout)
 
     def close(self) -> None:

@@ -1,0 +1,105 @@
+"""SortedDict with a dependency gate.
+
+The engines (engine.py MemEngine, disk_engine.py memtable) want
+``sortedcontainers.SortedDict`` for ordered scans, but the package is an
+optional third-party dependency — a bare interpreter must still boot
+the cluster (the chaos suite and the single-process deployment both
+depend on it).  When the import fails we fall back to a minimal
+pure-python stand-in covering exactly the surface the engines use:
+plain dict mutation, ordered ``items()``, and ``irange(minimum,
+maximum, inclusive)``.
+
+The fallback keeps a lazily-rebuilt sorted key list (invalidated on any
+key-set mutation), so reads are O(n log n) after a write burst and
+O(log n + k) when the table is quiescent — fine for the memtable sizes
+the engines bound (disk_engine flushes at memtable_limit), slower than
+the real package's B-tree for huge single tables, which is why the
+import is still preferred.
+"""
+from __future__ import annotations
+
+import bisect
+
+try:                                      # pragma: no cover - env specific
+    from sortedcontainers import SortedDict  # type: ignore  # noqa: F401
+except ImportError:
+
+    class SortedDict(dict):               # type: ignore[no-redef]
+        """Minimal ordered-dict fallback (see module docstring)."""
+
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._sorted_keys = None
+
+        # ---- mutation: every key-set change drops the key cache ----
+        def __setitem__(self, key, value):
+            if key not in self:
+                self._sorted_keys = None
+            super().__setitem__(key, value)
+
+        def __delitem__(self, key):
+            super().__delitem__(key)
+            self._sorted_keys = None
+
+        def pop(self, key, *default):
+            had = key in self
+            out = super().pop(key, *default)
+            if had:
+                self._sorted_keys = None
+            return out
+
+        def popitem(self):
+            out = super().popitem()
+            self._sorted_keys = None
+            return out
+
+        def setdefault(self, key, default=None):
+            if key not in self:
+                self._sorted_keys = None
+            return super().setdefault(key, default)
+
+        def update(self, *args, **kwargs):
+            super().update(*args, **kwargs)
+            self._sorted_keys = None
+
+        def clear(self):
+            super().clear()
+            self._sorted_keys = None
+
+        # ---- ordered reads -----------------------------------------
+        def _keys(self):
+            if self._sorted_keys is None:
+                self._sorted_keys = sorted(super().keys())
+            return self._sorted_keys
+
+        def keys(self):
+            return list(self._keys())
+
+        def __iter__(self):
+            return iter(self._keys())
+
+        def values(self):
+            return [dict.__getitem__(self, k) for k in self._keys()]
+
+        def items(self):
+            return [(k, dict.__getitem__(self, k)) for k in self._keys()]
+
+        def irange(self, minimum=None, maximum=None,
+                   inclusive=(True, True)):
+            """Iterate keys in [minimum, maximum] honoring per-bound
+            inclusivity — over a slice snapshot, so callers may mutate
+            while iterating (strictly safer than the real package)."""
+            ks = self._keys()
+            if minimum is None:
+                lo = 0
+            elif inclusive[0]:
+                lo = bisect.bisect_left(ks, minimum)
+            else:
+                lo = bisect.bisect_right(ks, minimum)
+            if maximum is None:
+                hi = len(ks)
+            elif inclusive[1]:
+                hi = bisect.bisect_right(ks, maximum)
+            else:
+                hi = bisect.bisect_left(ks, maximum)
+            return iter(ks[lo:hi])

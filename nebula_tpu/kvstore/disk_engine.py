@@ -42,7 +42,7 @@ import os
 import struct
 from typing import Callable, Iterator, List, Optional, Tuple
 
-from sortedcontainers import SortedDict
+from ._sorted import SortedDict
 
 from ..common.status import ErrorCode, Status
 from .engine import KVEngine

@@ -10,13 +10,21 @@ meta addresses on leader change / RPC failure.
 """
 from __future__ import annotations
 
+import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..common.flags import flags
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
 from ..interface.common import (HostAddr, Schema, schema_from_wire)
 from ..interface.rpc import ClientManager, RpcError, default_client_manager
+
+# retry observability (acceptance: visible via /get_stats)
+stats.register_stats("meta.client.retry_attempts")
+stats.register_stats("meta.client.backoff_ms")
+stats.register_stats("meta.client.retry_exhausted")
+stats.register_stats("meta.client.hint_chases")
 
 
 class SpaceInfoCache:
@@ -71,17 +79,26 @@ class MetaClient:
     # ---------------- rpc plumbing ----------------
     # election-window retry: when EVERY peer answers not-a-leader /
     # unreachable (catalog leader just died), a survivor usually wins
-    # within a couple of seconds — retry the whole peer pass with a
-    # short sleep instead of surfacing a user-visible DDL error
-    # (reference MetaClient retries leader changes the same way)
+    # within a couple of seconds — retry the whole peer pass with
+    # exponential backoff + jitter (meta_client_retry_backoff_ms,
+    # doubling per pass, capped) instead of surfacing a user-visible
+    # DDL error (reference MetaClient retries leader changes the same
+    # way; the backoff keeps a dead metad set from being hammered)
     _CALL_PASSES = 4
-    _CALL_RETRY_SLEEP_S = 0.5
 
     def _call(self, method: str, payload: dict):
         last_exc: Optional[RpcError] = None
+        backoff_s = flags.get("meta_client_retry_backoff_ms", 100) / 1000.0
+        backoff_cap_s = flags.get("meta_client_retry_backoff_max_ms",
+                                  2000) / 1000.0
+        max_chase = flags.get("meta_client_max_hint_chase", 3)
         for attempt in range(self._CALL_PASSES):
             if attempt:
-                self._stop.wait(self._CALL_RETRY_SLEEP_S)
+                span = min(backoff_cap_s, backoff_s * (1 << (attempt - 1)))
+                sleep_s = span * (0.5 + 0.5 * random.random())  # jitter
+                stats.add_value("meta.client.retry_attempts")
+                stats.add_value("meta.client.backoff_ms", sleep_s * 1000.0)
+                self._stop.wait(sleep_s)
                 if self._stop.is_set():
                     break
             # last known-good metad (the catalog leader) first; a
@@ -93,6 +110,7 @@ class MetaClient:
                 queue.remove(good)
                 queue.insert(0, good)
             tried = set()
+            chased = 0
             while queue:
                 addr = queue.pop(0)
                 if addr in tried:
@@ -118,10 +136,20 @@ class MetaClient:
                                 hint = HostAddr.parse(e.status.msg)
                             except Exception:  # noqa: BLE001 — bad hint
                                 hint = None
-                            if hint is not None and hint not in tried:
+                            # bounded hint chase: peers bouncing hints at
+                            # each other (split-brain, stale views) must
+                            # not extend one pass unboundedly — after
+                            # max_chase hints the pass falls back to the
+                            # configured peer set and the next pass's
+                            # backoff gives the election time to settle
+                            if hint is not None and hint not in tried \
+                                    and chased < max_chase:
+                                chased += 1
+                                stats.add_value("meta.client.hint_chases")
                                 queue.insert(0, hint)
                         continue
                     raise
+        stats.add_value("meta.client.retry_exhausted")
         raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))
 
     def _call_status(self, method: str, payload: dict) -> StatusOr:

@@ -37,12 +37,25 @@ def ensure_built() -> bool:
     CLI tools) — never from a serving thread: the compile can take tens
     of seconds and lib() itself deliberately never builds."""
     global _TRIED
-    if not os.path.exists(_SO_PATH):
+    stale = False
+    if os.path.exists(_SO_PATH):
+        try:
+            ctypes.CDLL(_SO_PATH)
+        except OSError:
+            # the artifact exists but won't load here — typically a
+            # checked-in build from a newer toolchain (glibc symbol
+            # versions); force a local rebuild instead of silently
+            # dropping every native-served path to the Python fallback
+            stale = True
+    if stale or not os.path.exists(_SO_PATH):
         makefile = os.path.join(_REPO_ROOT, "native", "Makefile")
         if os.path.exists(makefile):
+            cmd = ["make", "-C", os.path.dirname(makefile)]
+            if stale:
+                cmd.insert(1, "-B")      # mtime says up-to-date; it isn't
             try:
-                subprocess.run(["make", "-C", os.path.dirname(makefile)],
-                               capture_output=True, timeout=120, check=True)
+                subprocess.run(cmd, capture_output=True, timeout=120,
+                               check=True)
             except Exception:            # noqa: BLE001 — fall back to Python
                 return False
         _TRIED = False                   # allow lib() to retry the load

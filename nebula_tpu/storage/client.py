@@ -12,14 +12,24 @@ Capability parity with /root/reference/src/storage/client/StorageClient.h:
 from __future__ import annotations
 
 import concurrent.futures
+import random
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common.flags import flags
 from ..common.keys import id_hash
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..interface.common import HostAddr
 from ..interface.rpc import ClientManager, RpcError, default_client_manager
 from ..meta.client import MetaClient
+
+# retry observability (acceptance: visible via /get_stats)
+stats.register_stats("storage.client.retry_attempts")
+stats.register_stats("storage.client.backoff_ms")
+stats.register_stats("storage.client.retry_exhausted")
+stats.register_stats("storage.client.deadline_exceeded")
 
 
 class StorageRpcResponse:
@@ -104,15 +114,54 @@ class StorageClient:
     # ---- generic scatter-gather -------------------------------------
     def collect(self, space_id: int, part_items: Dict[int, list],
                 make_req: Callable[[Dict[int, list]], Tuple[str, dict]],
-                retries: int = 3) -> StorageRpcResponse:
-        """Fan a per-part payload out to leader hosts; retry leader-changed
-        parts once against the hinted leader (reference collectResponse)."""
+                retries: int = 3,
+                deadline_s: Optional[float] = None) -> StorageRpcResponse:
+        """Fan a per-part payload out to leader hosts; retry failed parts
+        against hinted/re-routed leaders (reference collectResponse).
+
+        Retry passes are spaced by exponential backoff with jitter
+        (storage_client_retry_backoff_ms, doubling per pass up to
+        storage_client_retry_backoff_max_ms) and the WHOLE collect —
+        passes, backoff sleeps, and per-host RPCs — runs under one
+        deadline budget (storage_client_request_deadline_ms, or the
+        ``deadline_s`` override), so a flapping leader can never pin a
+        query in a tight re-dial loop or stall it indefinitely."""
         resp = StorageRpcResponse(total_parts=len(part_items))
         pending = dict(part_items)
         last_status: Dict[int, Status] = {}
+        if deadline_s is None:
+            budget_ms = flags.get("storage_client_request_deadline_ms",
+                                  15000)
+            deadline_s = budget_ms / 1000.0 if budget_ms else None
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        backoff_s = flags.get("storage_client_retry_backoff_ms", 20) / 1000.0
+        backoff_cap_s = flags.get("storage_client_retry_backoff_max_ms",
+                                  1000) / 1000.0
         for _attempt in range(retries + 1):
             if not pending:
                 break
+            if _attempt:
+                stats.add_value("storage.client.retry_attempts")
+                span = min(backoff_cap_s, backoff_s * (1 << (_attempt - 1)))
+                sleep_s = span * (0.5 + 0.5 * random.random())  # jitter
+                if deadline is not None \
+                        and deadline - time.monotonic() <= sleep_s:
+                    # no room for a useful pass after the sleep — fail
+                    # now instead of spending the budget's tail asleep
+                    stats.add_value("storage.client.deadline_exceeded")
+                    break
+                if sleep_s > 0:
+                    stats.add_value("storage.client.backoff_ms",
+                                    sleep_s * 1000.0)
+                    time.sleep(sleep_s)
+            # per-pass RPC timeout bounded by what's left of the budget
+            pass_timeout = None
+            if deadline is not None:
+                pass_timeout = deadline - time.monotonic()
+                if pass_timeout <= 0:
+                    stats.add_value("storage.client.deadline_exceeded")
+                    break
             by_host = {}
             routing_failed = {}
             for part, items in pending.items():
@@ -125,12 +174,20 @@ class StorageClient:
             for host, parts in by_host.items():
                 method, payload = make_req(parts)
                 futures[self.pool.submit(self._call_host, host, method,
-                                         payload)] = (host, parts)
+                                         payload, pass_timeout)] = (host,
+                                                                    parts)
             next_pending: Dict[int, list] = {}
             for fut, (host, parts) in futures.items():
                 status, result = fut.result()
                 if status.ok():
-                    resp.responses.append(result)
+                    failed_now = {int(p) for p in
+                                  (result.get("failed_parts") or {})}
+                    if any(p not in failed_now for p in parts):
+                        resp.responses.append(result)
+                    # else: the host led NONE of the addressed parts
+                    # (service.py _bulk short-circuit) — the reply is
+                    # only per-part hints, no data section, so merging
+                    # it would feed executors a schema-less response
                     resp.max_latency_us = max(resp.max_latency_us,
                                               result.get("latency_us", 0))
                     # per-part failures (reference ResultCode list): the
@@ -180,14 +237,18 @@ class StorageClient:
             for part, st in routing_failed.items():
                 resp.failed_parts[part] = st
             pending = next_pending
-        for part in pending:  # retries exhausted: report what we saw
+        if pending:
+            stats.add_value("storage.client.retry_exhausted")
+        for part in pending:  # retries/budget exhausted: report what we saw
             resp.failed_parts[part] = last_status.get(
                 part, Status.LeaderChanged())
         return resp
 
-    def _call_host(self, host: str, method: str, payload: dict):
+    def _call_host(self, host: str, method: str, payload: dict,
+                   timeout: Optional[float] = None):
         try:
-            return Status.OK(), self.cm.call(HostAddr.parse(host), method, payload)
+            return Status.OK(), self.cm.call(HostAddr.parse(host), method,
+                                             payload, timeout=timeout)
         except RpcError as e:
             return e.status, None
 

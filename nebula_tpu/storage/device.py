@@ -22,6 +22,7 @@ daemon, which never touches the device.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..common.flags import flags
@@ -181,8 +182,12 @@ class RemoteDeviceRuntime:
         self._stash: Dict[int, Tuple] = {}
         # spaces whose storaged declined UPTO (mesh-sharded there, or
         # an older build that can't serve it): remembered so repeat
-        # UPTO queries skip the ~RTT-costly decline round trip
-        self._upto_declined: set = set()
+        # UPTO queries skip the ~RTT-costly decline round trip.
+        # Negative-cache entries carry (expiry, device host): they lapse
+        # after upto_decline_ttl_s (a restarted/upgraded storaged gets
+        # UPTO traffic again without a graphd restart) and drop
+        # immediately when a placement refresh moves the device host
+        self._upto_declined: Dict[int, Tuple[float, str]] = {}
 
     # ------------------------------------------------------------ placement
     def _device_host(self, space_id: int
@@ -204,6 +209,25 @@ class RemoteDeviceRuntime:
             return None
         best = max(sorted(counts), key=lambda h: counts[h])
         return HostAddr.parse(best), sorted(alloc.keys())
+
+    # ------------------------------------------------- UPTO negative cache
+    def _upto_decline_active(self, space_id: int, host) -> bool:
+        """True while a remembered UPTO decline still binds: unexpired
+        AND the device host is unchanged.  TTL lapse or a placement
+        refresh that moved the space's device host drops the entry, so
+        the next UPTO query probes again."""
+        ent = self._upto_declined.get(space_id)
+        if ent is None:
+            return False
+        expiry, decline_host = ent
+        if time.monotonic() >= expiry or decline_host != str(host):
+            self._upto_declined.pop(space_id, None)
+            return False
+        return True
+
+    def _note_upto_declined(self, space_id: int, host) -> None:
+        ttl = float(flags.get("upto_decline_ttl_s", 300))
+        self._upto_declined[space_id] = (time.monotonic() + ttl, str(host))
 
     # ------------------------------------------------------------ rpc
     def _call(self, host: HostAddr, method: str, req: dict,
@@ -230,16 +254,18 @@ class RemoteDeviceRuntime:
             return False
         if has_input:      # per-root $-/$var inputs never run on device
             return False
-        # UPTO rides the cumulative-frontier kernels; the remote
-        # runtime declines if ITS mesh config or build can't serve it
-        # (this side can't see the storaged's flags) — cached so the
-        # decline round trip is paid once per space, not per query
-        if getattr(sentence.step, "upto", False) \
-                and sentence.step.steps > 1 \
-                and space_id in self._upto_declined:
-            return False
         placement = self._device_host(space_id)
         if placement is None:
+            return False
+        # UPTO rides the cumulative-frontier kernels; the remote
+        # runtime declines if ITS mesh config or build can't serve it
+        # (this side can't see the storaged's flags) — cached with a
+        # TTL + the declining host, so the decline round trip is paid
+        # once per space, not per query, without pinning a restarted
+        # or re-placed storaged out of UPTO traffic forever
+        if getattr(sentence.step, "upto", False) \
+                and sentence.step.steps > 1 \
+                and self._upto_decline_active(space_id, placement[0]):
             return False
         self._stash[id(sentence)] = (pushed is not None, placement)
         return True
@@ -283,14 +309,14 @@ class RemoteDeviceRuntime:
             if upto:
                 # mesh-sharded there / older build: don't re-pay this
                 # round trip for the space's next UPTO query
-                self._upto_declined.add(space_id)
+                self._note_upto_declined(space_id, host)
             raise
         if upto and resp.get("upto") is not True:
             # version skew: an older storaged ignores the upto field
             # and serves EXACT depth — silently wrong rows.  The echo
             # proves the server understood the request; absence means
             # decline to the CPU loop (and stop asking)
-            self._upto_declined.add(space_id)
+            self._note_upto_declined(space_id, host)
             raise TpuDecline("storaged build predates UPTO serving")
         from ..graph.interim import rows_from_wire
         return InterimResult(list(resp["columns"]),

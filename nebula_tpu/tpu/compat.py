@@ -1,0 +1,38 @@
+"""JAX version-compat shims for the TPU kernels.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace (jax >= 0.4.x late series); the kernels
+must run on both generations — the accelerator image pins whatever jax
+the toolchain ships, not what this repo prefers.  Import from here
+(function-locally, like every other jax import in tpu/) instead of
+hard-coding either location.
+
+``check_vma`` is the newer spelling of the older ``check_rep`` kwarg;
+the wrapper accepts either and forwards whichever the resident jax
+understands.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                                      # newer jax: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:                       # older jax: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = None
+
+
+def shard_map(*args, **kwargs):
+    global _PARAMS
+    if _PARAMS is None:
+        try:
+            _PARAMS = set(inspect.signature(_shard_map).parameters)
+        except (TypeError, ValueError):   # C-level/uninspectable: trust
+            _PARAMS = set(kwargs)
+    for new, old in (("check_vma", "check_rep"),):
+        if new in kwargs and new not in _PARAMS and old in _PARAMS:
+            kwargs[old] = kwargs.pop(new)
+        elif old in kwargs and old not in _PARAMS and new in _PARAMS:
+            kwargs[new] = kwargs.pop(old)
+    return _shard_map(*args, **kwargs)

@@ -964,7 +964,7 @@ def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     n_buckets = len(nbr_shards)
     n_extras = len(ell.extra_owner)
@@ -1269,7 +1269,7 @@ def make_frontier_sharded_sparse_go_kernel(mesh, axis: str,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     # static metadata is COPIED out of ``sh`` here: the jitted kernel
     # lives in the runtime's kernel cache keyed by table SHAPES, so
@@ -1404,7 +1404,7 @@ def make_frontier_sharded_sparse_bfs_kernel(mesh, axis: str,
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     k, chunk = sh.k, sh.chunk
     n, n_rows = sh.n, sh.n_rows

@@ -36,6 +36,7 @@ class WebService:
         self._handlers: Dict[str, Callable] = {}
         self.register_handler("/status", self._status)
         self.register_handler("/flags", self._flags)
+        self.register_handler("/faults", self._faults)
         self.register_handler("/get_stats", self._get_stats)
         outer = self
 
@@ -122,6 +123,29 @@ class WebService:
             return 200, {n: flags.get(n) for n in names.split(",")}
         return 200, flags.dump() if hasattr(flags, "dump") else \
             {n: flags.get(n) for n in flags.names()}
+
+    def _faults(self, q: dict, body: bytes):
+        """Runtime fault-injection control (docs/fault_injection.md):
+        GET returns {seed, rules:[... with hits/fired]}; PUT with a JSON
+        body {"seed": N, "rules": [...]} (or a bare rule list) replaces
+        the table atomically — {"rules": []} turns injection off."""
+        from ..interface.faults import default_injector
+        if q.get("__method__") in ("PUT", "POST"):
+            try:
+                spec = json.loads(body) if body else {"rules": []}
+            except json.JSONDecodeError as e:
+                return 400, {"error": f"bad JSON body: {e}"}
+            if isinstance(spec, list):
+                spec = {"rules": spec}
+            if not isinstance(spec, dict):
+                return 400, {"error": "body must be a rule list or "
+                                      "{seed, rules}"}
+            try:
+                default_injector.configure(spec.get("rules", []),
+                                           seed=spec.get("seed"))
+            except (TypeError, ValueError) as e:
+                return 400, {"error": str(e)}
+        return 200, default_injector.dump()
 
     def _get_stats(self, q: dict, body: bytes):
         exprs = q.get("stats")

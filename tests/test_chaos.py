@@ -1,0 +1,599 @@
+"""Chaos suite — deterministic wire-level fault injection over real
+multi-daemon clusters (docs/fault_injection.md).
+
+The retry seams this exercises exist for exactly these failures
+(reference StorageClient.inl:120-133 leader chases, MetaClient
+failover, raftex elections); the FaultInjector (interface/faults.py)
+finally injects them on demand: every scenario asserts queries either
+return correct (possibly reported-partial) results or a clean typed
+error — never a hang, never a duplicated non-idempotent write.
+
+Scenarios use p=1 rules with times/skip bounds (deterministic by
+construction) or the seeded RNG (reproducible per seed); backoff and
+deadline flags are shrunk in fixtures so nothing sleeps longer than
+the configured caps.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.flags import flags
+from nebula_tpu.common.stats import stats
+from nebula_tpu.common.status import ErrorCode, Status
+from nebula_tpu.interface.common import HostAddr
+from nebula_tpu.interface.faults import FaultInjector, default_injector
+from nebula_tpu.interface.rpc import ClientManager, RpcError
+
+pytestmark = pytest.mark.chaos
+
+
+def _stat(name: str) -> float:
+    return stats.read_stats(f"{name}.sum.60") or 0.0
+
+
+# ---------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module", autouse=True)
+def fast_retries():
+    names = ("storage_client_retry_backoff_ms",
+             "storage_client_retry_backoff_max_ms",
+             "storage_client_request_deadline_ms",
+             "meta_client_retry_backoff_ms",
+             "meta_client_retry_backoff_max_ms")
+    saved = {n: flags.get(n) for n in names}
+    flags.set("storage_client_retry_backoff_ms", 5)
+    flags.set("storage_client_retry_backoff_max_ms", 50)
+    flags.set("storage_client_request_deadline_ms", 5000)
+    flags.set("meta_client_retry_backoff_ms", 5)
+    flags.set("meta_client_retry_backoff_max_ms", 50)
+    yield
+    for k, v in saved.items():
+        flags.set(k, v)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    default_injector.clear()
+    yield
+    default_injector.clear()
+
+
+@pytest.fixture(scope="module")
+def duo():
+    """2 storaged (no raft, loopback) + a seeded space: edges
+    i -> i+100 for i in 1..8 over partition_num=4 spread across both
+    hosts."""
+    c = LocalCluster(num_storage=2)
+    cl = c.client()
+
+    def ok(stmt):
+        r = cl.execute(stmt)
+        assert r.ok(), f"{stmt}: {r.error_msg}"
+        return r
+
+    ok("CREATE SPACE chaos(partition_num=4, replica_factor=1)")
+    c.refresh_all()
+    ok("USE chaos")
+    ok("CREATE TAG person(name string)")
+    ok("CREATE EDGE knows(w int)")
+    c.refresh_all()
+    ok("INSERT EDGE knows(w) VALUES " +
+       ", ".join(f"{i}->{i + 100}:({i})" for i in range(1, 9)))
+    cl.ok = ok
+    yield c, cl
+    cl.disconnect()
+    c.stop()
+
+
+ALL_SRC = "GO FROM 1,2,3,4,5,6,7,8 OVER knows YIELD knows._dst"
+ALL_DST = sorted(range(101, 109))
+
+
+# ============================================================ unit layer
+class TestInjectorUnit:
+    def test_seeded_probability_is_reproducible(self):
+        rules = [{"kind": "rpc_failure", "method": "m", "p": 0.5}]
+        fi = FaultInjector(seed=123)
+        fi.configure(rules, seed=123)
+        first = [fi.intercept("h:1", "m") is not None for _ in range(30)]
+        # same seed + rules -> identical fault schedule
+        fi.configure(rules, seed=123)
+        again = [fi.intercept("h:1", "m") is not None for _ in range(30)]
+        assert first == again
+        assert any(first) and not all(first)   # p=0.5 actually sampled
+        # a different seed produces a different schedule
+        fi.configure(rules, seed=124)
+        other = [fi.intercept("h:1", "m") is not None for _ in range(30)]
+        assert other != first
+
+    def test_times_and_skip_bounds(self):
+        fi = FaultInjector()
+        fi.configure([{"kind": "rpc_failure", "method": "m",
+                       "skip": 1, "times": 1}])
+        assert fi.intercept("h:1", "m") is None          # skipped
+        assert fi.intercept("h:1", "m") is not None      # fired
+        assert fi.intercept("h:1", "m") is None          # times spent
+        dump = fi.dump()["rules"][0]
+        assert dump["hits"] == 2 and dump["fired"] == 1
+
+    def test_kind_taxonomy(self):
+        fi = FaultInjector()
+        fi.configure([{"kind": "refuse_connect", "method": "a"},
+                      {"kind": "rpc_failure", "method": "b"},
+                      {"kind": "rpc_failure_after", "method": "c"},
+                      {"kind": "leader_changed", "method": "d",
+                       "leader": "x:1"}])
+        assert fi.intercept("h:1", "a")[:2] == \
+            ("before", ErrorCode.E_FAIL_TO_CONNECT)
+        assert fi.intercept("h:1", "b")[:2] == \
+            ("before", ErrorCode.E_RPC_FAILURE)
+        assert fi.intercept("h:1", "c")[:2] == \
+            ("after", ErrorCode.E_RPC_FAILURE)
+        assert fi.intercept("h:1", "d") == \
+            ("before", ErrorCode.E_LEADER_CHANGED, "x:1")
+        assert fi.intercept("h:1", "nomatch") is None
+
+    def test_delay_injects_latency_then_proceeds(self):
+        fi = FaultInjector()
+        fi.configure([{"kind": "delay", "method": "m", "delay_s": 0.05}])
+        t0 = time.monotonic()
+        assert fi.intercept("h:1", "m") is None
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_bad_rules_rejected(self):
+        fi = FaultInjector()
+        with pytest.raises(ValueError):
+            fi.configure([{"kind": "meteor_strike"}])
+        with pytest.raises(ValueError):
+            fi.configure([{"kind": "delay", "surprise": 1}])
+        with pytest.raises(ValueError):
+            fi.configure([{"method": "m"}])
+
+    def test_flag_watcher_configures_default_injector(self):
+        flags.set("fault_injection_rules",
+                  '[{"kind": "delay", "method": "zz"}]')
+        try:
+            assert [r["method"] for r in
+                    default_injector.dump()["rules"]] == ["zz"]
+            # the seed flag alone reconfigures too: flagfiles apply
+            # line by line, so a seed listed AFTER the rules must not
+            # be silently ignored (determinism promise)
+            flags.set("fault_injection_seed", 777)
+            assert default_injector.dump()["seed"] == 777
+        finally:
+            flags.set("fault_injection_seed", 0)
+            flags.set("fault_injection_rules", "")
+        assert default_injector.dump()["rules"] == []
+
+
+# ====================================================== storage hardening
+class TestStorageRetries:
+    def test_transient_connect_refusal_retried_to_success(self, duo):
+        c, cl = duo
+        before = _stat("storage.client.retry_attempts")
+        injected = _stat("rpc.fault.injected")
+        default_injector.configure(
+            [{"kind": "refuse_connect", "method": "getBound", "times": 1}])
+        r = cl.ok(ALL_SRC)
+        assert sorted(x[0] for x in r.rows) == ALL_DST
+        assert r.completeness == 100
+        assert _stat("storage.client.retry_attempts") > before
+        assert _stat("rpc.fault.injected") > injected
+
+    def test_injected_leader_flap_with_bogus_hint_heals(self, duo):
+        """E_LEADER_CHANGED hinting at the WRONG host: the client must
+        chase the hint, get per-part E_PART_NOT_FOUND there, re-route
+        from meta placement, and still deliver the full result."""
+        c, cl = duo
+        hosts = [n.host for n in c.storage_nodes]
+        default_injector.configure(
+            [{"kind": "leader_changed", "method": "getBound",
+              "host": hosts[0], "times": 1, "leader": hosts[1]},
+             {"kind": "leader_changed", "method": "getBound",
+              "host": hosts[1], "times": 1, "leader": hosts[0]}])
+        r = cl.ok(ALL_SRC)
+        assert sorted(x[0] for x in r.rows) == ALL_DST
+        assert r.completeness == 100
+
+    def test_retry_exhaustion_respects_deadline_no_tight_loop(self, duo):
+        """An endless leader flap must neither hang nor spin: the
+        collect deadline budget bounds the whole request and the
+        exhaustion is counted."""
+        c, cl = duo
+        saved = flags.get("storage_client_request_deadline_ms")
+        flags.set("storage_client_request_deadline_ms", 400)
+        try:
+            default_injector.configure(
+                [{"kind": "leader_changed", "method": "getBound"}])
+            sid = c.graph_meta_client.get_space_id_by_name("chaos").value()
+            before_exh = _stat("storage.client.retry_exhausted")
+            t0 = time.monotonic()
+            resp = c.storage_client.get_neighbors(sid, list(range(1, 9)),
+                                                  [1], retries=1000)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0                    # deadline, not retries
+            assert not resp.succeeded()
+            assert resp.completeness() == 0
+            assert _stat("storage.client.retry_exhausted") > before_exh
+            assert _stat("storage.client.backoff_ms") > 0
+        finally:
+            flags.set("storage_client_request_deadline_ms", saved)
+
+    def test_reply_loss_on_write_is_not_resent(self):
+        """rpc_failure_after = the storaged EXECUTED the write and the
+        reply was lost.  The client must surface a typed error, NOT
+        resend (non-idempotent duplication risk) — the op lands exactly
+        once."""
+        c = LocalCluster(num_storage=1)
+        cl = c.client()
+        try:
+            for stmt in ("CREATE SPACE once(partition_num=2, "
+                         "replica_factor=1)",):
+                assert cl.execute(stmt).ok()
+            c.refresh_all()
+            assert cl.execute("USE once").ok()
+            assert cl.execute("CREATE EDGE e(w int)").ok()
+            c.refresh_all()
+            node = c.storage_nodes[0]
+            calls = []
+            real = node.service.rpc_addEdges
+
+            def counting(req):
+                calls.append(req)
+                return real(req)
+
+            node.service.rpc_addEdges = counting
+            default_injector.configure(
+                [{"kind": "rpc_failure_after", "method": "addEdges",
+                  "times": 1}])
+            r = cl.execute("INSERT EDGE e(w) VALUES 1->2:(7)")
+            assert not r.ok()
+            assert "E_RPC_FAILURE" in r.error_msg
+            assert len(calls) == 1          # executed once, never resent
+            default_injector.clear()
+            # the write really landed (reply was lost, op was not)
+            rows = cl.execute("GO FROM 1 OVER e YIELD e._dst").rows
+            assert [x[0] for x in rows] == [2]
+        finally:
+            cl.disconnect()
+            c.stop()
+
+    def test_partial_results_report_completeness(self, duo):
+        """Fan-out where one host is blackholed: the response keeps the
+        surviving parts' rows AND reports completeness < 100 + a
+        warning instead of silently degrading."""
+        c, cl = duo
+        sid = c.graph_meta_client.get_space_id_by_name("chaos").value()
+        alloc = c.graph_meta_client.parts_alloc(sid)
+        dead_host = c.storage_nodes[1].host
+        surviving = sorted(
+            i + 100 for i in range(1, 9)
+            if alloc[c.storage_client.part_id(sid, i)][0] != dead_host)
+        assert surviving and len(surviving) < 8     # both hosts hold parts
+        before_partial = _stat("graph.partial_result.qps")
+        default_injector.configure(
+            [{"kind": "blackhole", "method": "getBound",
+              "host": dead_host}])
+        r = cl.execute(ALL_SRC)
+        assert r.ok()
+        assert sorted(x[0] for x in r.rows) == surviving
+        assert 0 < r.completeness < 100
+        assert r.warnings and "parts failed" in r.warnings[0]
+        assert _stat("graph.partial_result.qps") > before_partial
+        # recovery: faults off -> full results, no completeness field
+        default_injector.clear()
+        r = cl.ok(ALL_SRC)
+        assert sorted(x[0] for x in r.rows) == ALL_DST
+        assert r.completeness == 100 and not r.warnings
+
+
+# ========================================================= meta hardening
+class TestMetaResilience:
+    def test_metad_blackhole_degrades_to_cached_metadata(self, duo):
+        """metad down mid-flight: reads on cached metadata keep working,
+        heartbeats fail with a clean Status, DDL errors cleanly (typed,
+        no hang), cache misses error cleanly — and everything recovers
+        when the fault lifts."""
+        c, cl = duo
+        default_injector.configure(
+            [{"kind": "blackhole", "host": str(c.meta_addr)}])
+        # cached read path unaffected
+        r = cl.ok(ALL_SRC)
+        assert sorted(x[0] for x in r.rows) == ALL_DST
+        # heartbeat: clean Status error, not an exception
+        hb = c.storage_nodes[0].meta_client.heartbeat()
+        assert not hb.ok()
+        # DDL: clean typed error
+        before_exh = _stat("meta.client.retry_exhausted")
+        r = cl.execute("CREATE SPACE nope(partition_num=1)")
+        assert not r.ok()
+        assert r.error_code != ErrorCode.SUCCEEDED
+        assert _stat("meta.client.retry_exhausted") > before_exh
+        # cache miss: clean error (space was never cached)
+        r = cl.execute("USE never_created")
+        assert not r.ok()
+        # recovery
+        default_injector.clear()
+        assert cl.execute("CREATE SPACE nope(partition_num=1)").ok()
+        assert c.storage_nodes[0].meta_client.heartbeat().ok()
+
+    def test_hint_chase_is_bounded(self):
+        """A chain of metads bouncing not-a-leader hints at each other
+        must terminate within meta_client_max_hint_chase per pass
+        instead of chasing forever."""
+        cm = ClientManager()
+        called = []
+
+        class Bouncer:
+            def __init__(self, me, nxt):
+                self.me, self.nxt = me, nxt
+
+            def rpc_listSpaces(self, payload):
+                called.append(self.me)
+                raise RpcError(Status(ErrorCode.E_NOT_A_LEADER, self.nxt))
+
+        n = 10
+        for i in range(n):
+            cm.register_loopback(
+                HostAddr(f"m{i}", 1),
+                Bouncer(f"m{i}:1", f"m{i + 1}:1" if i + 1 < n else ""))
+        from nebula_tpu.meta.client import MetaClient
+        mc = MetaClient([HostAddr("m0", 1)], client_manager=cm)
+        max_chase = flags.get("meta_client_max_hint_chase", 3)
+        with pytest.raises(RpcError) as ei:
+            mc._call("listSpaces", {})
+        assert ei.value.status.code == ErrorCode.E_NOT_A_LEADER
+        per_pass = 1 + max_chase
+        assert len(called) <= mc._CALL_PASSES * per_pass
+        assert len(set(called)) <= per_pass   # never walked the chain
+
+
+# ==================================================== device fallback
+class TestTpuFallback:
+    def test_storaged_blackhole_falls_back_to_cpu(self):
+        """deviceGo blackholed: the remote device runtime declines and
+        the per-hop CPU scatter-gather path serves the same rows."""
+        c = LocalCluster(num_storage=1, tpu_backend="remote")
+        cl = c.client()
+        try:
+            def ok(stmt):
+                r = cl.execute(stmt)
+                assert r.ok(), f"{stmt}: {r.error_msg}"
+                return r
+
+            ok("CREATE SPACE dev(partition_num=2, replica_factor=1)")
+            c.refresh_all()
+            ok("USE dev")
+            ok("CREATE EDGE follow(d int)")
+            c.refresh_all()
+            ok("INSERT EDGE follow(d) VALUES 1->2:(5), 2->3:(6), 1->3:(7)")
+            q = "GO 2 STEPS FROM 1 OVER follow YIELD follow._dst"
+            expect = sorted(x[0] for x in ok(q).rows)
+            injected = _stat("rpc.fault.injected")
+            default_injector.configure(
+                [{"kind": "blackhole", "method": "deviceGo"},
+                 {"kind": "blackhole", "method": "deviceFindPath"}])
+            r = ok(q)
+            assert sorted(x[0] for x in r.rows) == expect
+            assert r.completeness == 100
+            assert _stat("rpc.fault.injected") > injected
+        finally:
+            cl.disconnect()
+            c.stop()
+
+
+# ===================================================== replicated chaos
+@pytest.fixture()
+def fast_raft():
+    saved = {n: flags.get(n) for n in
+             ("raft_heartbeat_interval_s", "raft_election_timeout_s")}
+    flags.set("raft_heartbeat_interval_s", 0.1)
+    flags.set("raft_election_timeout_s", 0.8)
+    yield
+    for k, v in saved.items():
+        flags.set(k, v)
+
+
+def _wait_leaders(cluster, space_parts, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        elected = sum(1 for node in cluster.storage_nodes
+                      if node.raft_service is not None
+                      for st in node.raft_service.status()
+                      if st["role"] == "LEADER")
+        if elected >= space_parts:
+            return
+        time.sleep(0.05)
+    raise AssertionError("raft groups failed to elect")
+
+
+class TestReplicatedChaos:
+    def test_leader_kill_mid_go_returns_complete_results(self, fast_raft):
+        """Kill the storaged leading the queried part between two GOs:
+        every response during failover is ok (possibly partial) or a
+        typed error — and once raft re-elects, the SAME query returns
+        complete (completeness == 100) correct results."""
+        c = LocalCluster(num_storage=3, use_raft=True)
+        cl = c.client()
+        try:
+            def ok(stmt, tries=40):
+                last = None
+                for _ in range(tries):
+                    last = cl.execute(stmt)
+                    if last.ok():
+                        return last
+                    time.sleep(0.25)
+                raise AssertionError(f"{stmt}: {last.error_msg}")
+
+            ok("CREATE SPACE rk(partition_num=2, replica_factor=3)")
+            c.refresh_all()
+            _wait_leaders(c, space_parts=2)
+            ok("USE rk")
+            ok("CREATE EDGE e(w int)")
+            c.refresh_all()
+            ok("INSERT EDGE e(w) VALUES 1->2:(7), 2->3:(8)")
+            q = "GO FROM 1,2 OVER e YIELD e._dst"
+            r = ok(q)
+            assert sorted(x[0] for x in r.rows) == [2, 3]
+
+            # find and hard-kill the node leading vid 1's part
+            sid = c.graph_meta_client.get_space_id_by_name("rk").value()
+            part = c.storage_client.part_id(sid, 1)
+            victim = next(
+                node for node in c.storage_nodes
+                for st in node.raft_service.status()
+                if st["space"] == sid and st["part"] == part
+                and st["role"] == "LEADER")
+            c.cm.unregister_loopback(HostAddr.parse(victim.host))
+            victim.stop()
+
+            # failover window: responses are clean (ok-or-typed-error,
+            # never a hang — the deadline budget bounds each attempt);
+            # eventually the result is COMPLETE and correct again
+            deadline = time.monotonic() + 25
+            final = None
+            while time.monotonic() < deadline:
+                r = cl.execute(q)
+                if r.ok() and r.completeness == 100 \
+                        and sorted(x[0] for x in r.rows) == [2, 3]:
+                    final = r
+                    break
+                assert isinstance(r.error_msg, str)
+                time.sleep(0.2)
+            assert final is not None, "failover never converged"
+            # writes keep working through the surviving quorum
+            ok("INSERT EDGE e(w) VALUES 3->4:(9)")
+            r = ok("GO FROM 3 OVER e YIELD e._dst")
+            assert sorted(x[0] for x in r.rows) == [4]
+        finally:
+            cl.disconnect()
+            c.stop()
+
+    @pytest.mark.slow
+    def test_slow_peer_triggers_election_queries_survive(self, fast_raft):
+        """Delay every raft RPC to one follower past the election
+        timeout: terms churn, and queries still answer correctly once
+        the fault lifts (wall-clock-heavy: real election waits)."""
+        c = LocalCluster(num_storage=3, use_raft=True)
+        cl = c.client()
+        try:
+            def ok(stmt, tries=40):
+                last = None
+                for _ in range(tries):
+                    last = cl.execute(stmt)
+                    if last.ok():
+                        return last
+                    time.sleep(0.25)
+                raise AssertionError(f"{stmt}: {last.error_msg}")
+
+            ok("CREATE SPACE sp(partition_num=1, replica_factor=3)")
+            c.refresh_all()
+            _wait_leaders(c, space_parts=1)
+            ok("USE sp")
+            ok("CREATE EDGE e(w int)")
+            c.refresh_all()
+            ok("INSERT EDGE e(w) VALUES 1->2:(7)")
+            leader_node = next(
+                node for node in c.storage_nodes
+                for st in node.raft_service.status()
+                if st["role"] == "LEADER")
+            term0 = max(st["term"]
+                        for st in leader_node.raft_service.status())
+            # stall the LEADER's outbound heartbeats: followers time
+            # out.  The per-call delay must clear the WORST-case
+            # randomized election timeout (base * 2, raft_part.py
+            # _reset_election_timeout) or the scenario is a coin flip
+            # on the follower's draw
+            stall_s = 2 * flags.get("raft_election_timeout_s") + 0.5
+            default_injector.configure(
+                [{"kind": "delay", "method": "raftAppendLog",
+                  "delay_s": stall_s, "times": 10}])
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                terms = [st["term"] for node in c.storage_nodes
+                         if node.raft_service
+                         for st in node.raft_service.status()]
+                if terms and max(terms) > term0:
+                    break
+                time.sleep(0.1)
+            assert max(
+                st["term"] for node in c.storage_nodes
+                if node.raft_service
+                for st in node.raft_service.status()) > term0
+            default_injector.clear()
+            # the new leader commits/applies the entry on its first
+            # heartbeat round — an ok-but-empty response in that window
+            # is legal, so poll for the converged result
+            deadline = time.monotonic() + 15
+            rows = None
+            while time.monotonic() < deadline:
+                r = cl.execute("GO FROM 1 OVER e YIELD e._dst")
+                if r.ok() and r.completeness == 100:
+                    rows = sorted(x[0] for x in r.rows)
+                    if rows == [2]:
+                        break
+                time.sleep(0.2)
+            assert rows == [2]
+        finally:
+            cl.disconnect()
+            c.stop()
+
+
+# ======================================================== ops surface
+class TestFaultsEndpoint:
+    def test_faults_roundtrip_over_http(self):
+        from nebula_tpu.webservice import WebService
+        ws = WebService("test").start()
+        base = f"http://127.0.0.1:{ws.port}"
+        try:
+            got = json.load(urllib.request.urlopen(f"{base}/faults"))
+            assert got["rules"] == []
+            body = json.dumps({"seed": 99, "rules": [
+                {"kind": "delay", "method": "getBound",
+                 "delay_s": 0.01}]}).encode()
+            req = urllib.request.Request(f"{base}/faults", data=body,
+                                         method="PUT")
+            got = json.load(urllib.request.urlopen(req))
+            assert got["seed"] == 99
+            assert got["rules"][0]["kind"] == "delay"
+            # the process-global injector picked it up
+            assert default_injector.dump()["seed"] == 99
+            default_injector.intercept("h:1", "getBound")
+            got = json.load(urllib.request.urlopen(f"{base}/faults"))
+            assert got["rules"][0]["hits"] == 1
+            assert got["rules"][0]["fired"] == 1
+            # bad kinds are refused with a 400
+            bad = urllib.request.Request(
+                f"{base}/faults",
+                data=json.dumps([{"kind": "nope"}]).encode(),
+                method="PUT")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad)
+            assert ei.value.code == 400
+            # empty rule list turns injection off
+            off = urllib.request.Request(
+                f"{base}/faults", data=b'{"rules": []}', method="PUT")
+            assert json.load(urllib.request.urlopen(off))["rules"] == []
+            assert not default_injector.active()
+        finally:
+            ws.stop()
+
+    def test_retry_counters_visible_on_get_stats(self, duo):
+        c, cl = duo
+        default_injector.configure(
+            [{"kind": "refuse_connect", "method": "getBound",
+              "times": 1}])
+        cl.ok(ALL_SRC)
+        default_injector.clear()
+        from nebula_tpu.webservice import WebService
+        ws = WebService("test").start()
+        try:
+            got = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{ws.port}/get_stats"))
+            assert got["storage.client.retry_attempts"]["sum.60"] > 0
+            assert "meta.client.retry_attempts" in got
+            assert got["rpc.fault.injected"]["sum.60"] > 0
+        finally:
+            ws.stop()

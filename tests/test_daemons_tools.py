@@ -670,3 +670,22 @@ class TestStoreTypeGate:
             capture_output=True, text=True, timeout=60)
         assert r2.returncode == 1
         assert "unknown store type 'hbase'" in r2.stderr
+
+    def test_explicit_cli_beats_conf(self):
+        """ADVICE round 5: default=None in add_argument keeps an
+        explicit CLI --store_type distinguishable from "unset", so CLI
+        `nebula` beats a conf-file `hbase` (gflags semantics) instead
+        of the conf silently overriding it."""
+        from nebula_tpu.common.flags import flags
+        from nebula_tpu.daemons.storaged import resolve_store_type
+        flags.define("store_type", "")      # what a flagfile load does
+        saved = flags.get("store_type")
+        try:
+            flags.set("store_type", "hbase", force=True)
+            assert resolve_store_type("nebula") == "nebula"  # CLI wins
+            assert resolve_store_type(None) == "hbase"       # conf fills
+            flags.set("store_type", "", force=True)
+            assert resolve_store_type(None) == "nebula"      # default
+            assert resolve_store_type("hbase") == "hbase"
+        finally:
+            flags.set("store_type", saved, force=True)

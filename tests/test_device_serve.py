@@ -11,6 +11,8 @@ in-process-only attachment.  Tests cover:
   * graceful decline → CPU fallback (multi-host placement, $-input);
   * hard errors surface as query errors, not CPU fallbacks.
 """
+import time
+
 import numpy as np
 import pytest
 
@@ -327,3 +329,47 @@ class TestUptoRpcSkew:
         rt = self._runtime([{"ok": True, "columns": ["c"], "rows": []}])
         out = self._go(rt, upto=False)
         assert out is not None
+
+
+class TestUptoDeclineCacheHealing:
+    """The UPTO negative cache must HEAL: entries lapse after
+    upto_decline_ttl_s (a restarted/upgraded storaged gets UPTO traffic
+    again without a graphd restart) and drop immediately when a
+    placement refresh moves the space's device host."""
+
+    def _declined_runtime(self):
+        from nebula_tpu.storage.device import TpuDecline
+        helper = TestUptoRpcSkew()
+        # old build: ok response WITHOUT the upto echo -> decline cached
+        rt = helper._runtime([{"ok": True, "columns": ["c"], "rows": []}])
+        with pytest.raises(TpuDecline):
+            helper._go(rt, upto=True)
+        assert 7 in rt._upto_declined
+        return rt
+
+    def _can_run(self, rt):
+        sentence = type("S", (), {})()
+        sentence.step = type("T", (), {"steps": 3, "upto": True})()
+        return rt.can_run_go(7, [1], sentence, None, None, [], [], False)
+
+    def test_decline_lapses_after_ttl(self):
+        saved = flags.get("upto_decline_ttl_s")
+        flags.set("upto_decline_ttl_s", 0.05)
+        try:
+            rt = self._declined_runtime()
+            assert self._can_run(rt) is False     # cached decline binds
+            time.sleep(0.06)
+            # TTL lapsed: the space is probed again (entry dropped)
+            assert self._can_run(rt) is True
+            assert 7 not in rt._upto_declined
+        finally:
+            flags.set("upto_decline_ttl_s", saved)
+
+    def test_decline_dropped_on_placement_change(self):
+        rt = self._declined_runtime()
+        assert self._can_run(rt) is False
+        # placement refresh moved the space's device host: the old
+        # host's decline no longer describes the serving storaged
+        rt._device_host = lambda sid: (("h2", 1), [1])
+        assert self._can_run(rt) is True
+        assert 7 not in rt._upto_declined
