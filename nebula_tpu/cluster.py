@@ -191,7 +191,9 @@ class LocalCluster:
     def client(self):
         from .clients.graph_client import GraphClient
         c = GraphClient(self.graph_addr, client_manager=self.cm)
-        c.connect()
+        st = c.connect()
+        if not st.ok():
+            raise RuntimeError(f"graphd connect failed: {st}")
         return c
 
     def refresh_all(self) -> None:

@@ -9,10 +9,10 @@ refresh; (3) runtime get/set over the web service (/flags).
 from __future__ import annotations
 
 import json
-import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..interface.common import ConfigMode, ConfigModule
+from .ordered_lock import OrderedLock
 
 
 class FlagInfo:
@@ -32,7 +32,7 @@ class FlagInfo:
 class FlagsRegistry:
     def __init__(self):
         self._flags: Dict[str, FlagInfo] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("flags.registry")
 
     def define(self, name: str, default: Any, help_: str = "",
                mode: ConfigMode = ConfigMode.MUTABLE,
@@ -42,36 +42,49 @@ class FlagsRegistry:
                 self._flags[name] = FlagInfo(name, default, help_, mode, module)
 
     def get(self, name: str, default: Any = None) -> Any:
+        # lock-free read path: hot loops (raft tick, storage collect)
+        # read flags per call; a torn value is impossible (one attribute
+        # load) and staleness across one read is fine
         f = self._flags.get(name)
         return f.value if f is not None else default
 
     def set(self, name: str, value: Any, force: bool = False) -> bool:
-        f = self._flags.get(name)
-        if f is None:
-            return False
-        if f.mode == ConfigMode.IMMUTABLE and not force:
-            return False
-        # coerce to the default's type when possible
-        if f.default is not None and not isinstance(value, type(f.default)):
-            try:
-                if isinstance(f.default, bool):
-                    value = str(value).lower() in ("1", "true", "yes")
-                else:
-                    value = type(f.default)(value)
-            except (TypeError, ValueError):
+        with self._lock:
+            f = self._flags.get(name)
+            if f is None:
                 return False
-        f.value = value
-        for w in f.watchers:
+            if f.mode == ConfigMode.IMMUTABLE and not force:
+                return False
+            # coerce to the default's type when possible
+            if f.default is not None \
+                    and not isinstance(value, type(f.default)):
+                try:
+                    if isinstance(f.default, bool):
+                        value = str(value).lower() in ("1", "true", "yes")
+                    else:
+                        value = type(f.default)(value)
+                except (TypeError, ValueError):
+                    return False
+            f.value = value
+            watchers = list(f.watchers)
+        # watchers run OUTSIDE the registry lock: a callback that reads
+        # or sets another flag must not deadlock the registry
+        for w in watchers:
             w(value)
         return True
 
     def watch(self, name: str, fn: Callable[[Any], None]) -> None:
-        f = self._flags.get(name)
-        if f is not None:
-            f.watchers.append(fn)
+        with self._lock:
+            f = self._flags.get(name)
+            if f is not None:
+                f.watchers.append(fn)
 
     def names(self, module: Optional[ConfigModule] = None) -> List[str]:
-        return sorted(n for n, f in self._flags.items()
+        # snapshot under the lock: lazy subsystem imports define() flags
+        # while an operator polls /flags (dict-changed-size otherwise)
+        with self._lock:
+            items = list(self._flags.items())
+        return sorted(n for n, f in items
                       if module in (None, ConfigModule.ALL) or
                       f.module in (module, ConfigModule.ALL))
 
@@ -79,7 +92,9 @@ class FlagsRegistry:
         return self._flags.get(name)
 
     def dump(self) -> Dict[str, Any]:
-        return {n: f.value for n, f in sorted(self._flags.items())}
+        with self._lock:
+            items = sorted(self._flags.items())
+        return {n: f.value for n, f in items}
 
     def load_file(self, path: str) -> None:
         """Conf file: json object or ``--name=value`` lines."""
@@ -120,15 +135,21 @@ flags.define("session_idle_timeout_secs", 600, "session reclaim timeout")
 flags.define("session_reclaim_interval_secs", 10, "reclaim cadence")
 flags.define("heartbeat_interval_secs", 10, "storaged->metad heartbeat")
 flags.define("load_data_interval_secs", 120, "meta cache refresh cadence")
-flags.define("expired_hosts_check_interval_sec", 20, "active host sweep")
 flags.define("expired_threshold_sec", 10 * 60, "host liveness TTL")
 flags.define("max_handlers_per_req", 10, "per-request bucket fan-out")
 flags.define("min_vertices_per_bucket", 3, "min vertices per bucket")
 flags.define("storage_backend", "auto", "storage traversal backend: cpu|tpu|auto")
 flags.define("storage_engine", "auto",
              "kv engine: native (C++ kv_engine.cc) | mem | auto")
-flags.define("raft_heartbeat_interval_ms", 500, "raft leader heartbeat")
-flags.define("raft_election_timeout_ms", 1500, "raft election timeout base")
+flags.define("store_type", None,
+             "storage service type (reference StorageServer.cpp:44-55 "
+             "parity; only 'nebula' is served) — set from conf files, "
+             "overridden by the storaged --store_type CLI flag")
+# NOTE: the raft timing knobs live where raftex defines them
+# (raft_heartbeat_interval_s / raft_election_timeout_s in
+# raftex/raft_part.py) — the old *_ms duplicates here were dead
+# (flag-registry check) and are gone; wal_buffer_size_bytes is now read
+# by kvstore/wal.py instead of a hardcoded default
 flags.define("wal_buffer_size_bytes", 256 * 1024, "wal flush buffer")
 
 # ---- robustness / fault injection (interface/faults.py) -------------

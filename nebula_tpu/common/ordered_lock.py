@@ -1,0 +1,248 @@
+"""OrderedLock + LockWatchdog — a runtime lock-order sanitizer (mini-TSan).
+
+The static half of the project's lock-discipline story lives in
+``nebula_tpu/tools/lint`` (the ``lock-order`` check builds the ACQUISITION
+graph from the AST); this module is the dynamic half: named locks record
+their REAL acquisition order per thread while the chaos / replicated
+suites run, and any observed inversion — lock rank B acquired while A is
+held on one thread, when some other thread has already acquired A while
+holding B — is recorded as a violation (optionally raised).
+
+Design notes
+  * Ranks, not instances: every ``OrderedLock`` carries a short rank name
+    ("raft.part", "meta.cache", ...).  All instances of a class share a
+    rank, so an inversion between two RaftParts is reported the same as
+    an inversion between a RaftPart and a MetaClient.  Same-rank nesting
+    (part A's lock inside part B's) is deliberately NOT an edge — per
+    instance locks of one class legitimately nest in balancer/admin
+    paths and instance-level tracking would drown the graph.
+  * Near-zero cost when disabled: acquire/release delegate straight to
+    the underlying ``threading.Lock``/``RLock`` behind a single enabled
+    check, so production paths (stats counters, the raft hot path) pay
+    one attribute load.
+  * Condition-compatible: ``_is_owned`` / ``_release_save`` /
+    ``_acquire_restore`` are implemented so ``threading.Condition(lock)``
+    works on a reentrant OrderedLock (raftex wraps its part lock in a
+    Condition); a Condition wait fully releases the lock, and the
+    watchdog's held-stack mirrors that.
+
+Enable via ``watchdog.enable()`` (tests/conftest.py turns it on for the
+chaos/replicated suites) or the ``NEBULA_LOCK_WATCHDOG=1`` environment
+variable.  See docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderError(RuntimeError):
+    """Raised on an observed lock-order inversion when strict mode is on."""
+
+
+class LockWatchdog:
+    """Records the cross-thread lock acquisition graph and flags cycles.
+
+    An edge A->B means "some thread acquired rank B while holding rank
+    A".  A violation is recorded the moment an acquisition would close a
+    cycle in that graph — the classic potential-deadlock signature, even
+    when the run itself got lucky with timing (that is the point: the
+    chaos suites only have to EXERCISE both orders once each, not lose
+    the race)."""
+
+    def __init__(self):
+        self._enabled = False
+        self.strict = False
+        self._graph_lock = threading.Lock()
+        # rank -> {successor rank -> (thread name, location-ish note)}
+        self._edges: Dict[str, Dict[str, str]] = {}
+        self.violations: List[str] = []
+        self._tls = threading.local()
+        # bumped on enable(): a lock held across a disable would leave
+        # a stale rank on its thread's stack (on_release is skipped
+        # while disabled) and poison later enabled windows with
+        # phantom edges — _held() drops stacks from older generations
+        self._gen = 0
+
+    # -- lifecycle ----------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, strict: bool = False) -> None:
+        with self._graph_lock:
+            self._edges = {}
+            self.violations = []
+            self.strict = strict
+            self._gen += 1
+            self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        with self._graph_lock:
+            self._edges = {}
+            self.violations = []
+
+    def drain(self) -> List[str]:
+        with self._graph_lock:
+            out = self.violations
+            self.violations = []
+            return out
+
+    # -- per-thread held stack ----------------------------------------
+    def _held(self) -> List[str]:
+        st = getattr(self._tls, "held", None)
+        if st is None or getattr(self._tls, "gen", -1) != self._gen:
+            st = self._tls.held = []
+            self._tls.gen = self._gen
+        return st
+
+    # -- hooks ---------------------------------------------------------
+    def on_acquire(self, rank: str) -> None:
+        held = self._held()
+        if rank not in held:
+            # distinct ranks currently held on this thread become edges.
+            # Steady state stays off the graph lock: a GIL-safe read
+            # filters edges already recorded, so only a genuinely new
+            # edge pays for the lock + cycle search (the raft append
+            # path acquires nested ranks thousands of times per second)
+            edges = self._edges
+            missing = [h for h in set(held)
+                       if h != rank and rank not in edges.get(h, ())]
+            if missing:
+                with self._graph_lock:
+                    for h in missing:
+                        succ = self._edges.setdefault(h, {})
+                        if rank not in succ:
+                            succ[rank] = threading.current_thread().name
+                            cycle = self._find_path(rank, h)
+                            if cycle is not None:
+                                self._record(h, rank, cycle)
+        held.append(rank)
+
+    def on_release(self, rank: str) -> None:
+        held = self._held()
+        if held:
+            # remove the LAST occurrence (reentrant ranks stack)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == rank:
+                    del held[i]
+                    break
+
+    # -- cycle detection (caller holds _graph_lock) --------------------
+    def _find_path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS: path src ~> dst through the edge graph, else None."""
+        stack: List[Tuple[str, List[str]]] = [(src, [src])]
+        seen: Set[str] = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, {}):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _record(self, held: str, acquired: str, cycle: List[str]) -> None:
+        msg = (f"lock-order inversion: thread "
+               f"{threading.current_thread().name!r} acquired "
+               f"{acquired!r} while holding {held!r}, but the observed "
+               f"order graph already has {' -> '.join(cycle)} -> "
+               f"{cycle[0]}")
+        self.violations.append(msg)
+        if self.strict:
+            raise LockOrderError(msg)
+
+
+watchdog = LockWatchdog()
+if os.environ.get("NEBULA_LOCK_WATCHDOG", "") not in ("", "0"):
+    watchdog.enable()
+
+
+class OrderedLock:
+    """A named (ranked) lock that reports acquisitions to the watchdog.
+
+    Drop-in for ``threading.Lock()`` / ``threading.RLock()`` (pass
+    ``reentrant=True`` for RLock semantics).  When the watchdog is
+    disabled this is a thin pass-through."""
+
+    __slots__ = ("rank", "_lock", "_reentrant")
+
+    def __init__(self, rank: str, reentrant: bool = False):
+        self.rank = rank
+        self._reentrant = reentrant
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    # -- lock protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got and watchdog._enabled:
+            try:
+                watchdog.on_acquire(self.rank)
+            except BaseException:
+                # strict mode raises LockOrderError from on_acquire;
+                # the underlying lock is already held and __exit__ will
+                # never run — release it or every later acquirer hangs
+                self._lock.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        if watchdog._enabled:
+            watchdog.on_release(self.rank)
+        self._lock.release()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") \
+            else self._is_owned()
+
+    # -- threading.Condition integration -------------------------------
+    # Condition(lock) probes for these; the RLock versions release ALL
+    # recursion levels at wait() and restore them after, so the
+    # watchdog's held-stack must mirror the full unwind.
+    def _is_owned(self) -> bool:
+        if self._reentrant:
+            return self._lock._is_owned()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def _release_save(self):
+        n = 1
+        if self._reentrant:
+            state = self._lock._release_save()
+            # RLock._release_save returns (count, owner)
+            n = state[0] if isinstance(state, tuple) else 1
+        else:
+            state = None
+            self._lock.release()
+        if watchdog._enabled:
+            for _ in range(n):
+                watchdog.on_release(self.rank)
+        return (state, n)
+
+    def _acquire_restore(self, saved) -> None:
+        state, n = saved
+        if self._reentrant:
+            self._lock._acquire_restore(state)
+        else:
+            self._lock.acquire()
+        if watchdog._enabled:
+            for _ in range(n):
+                watchdog.on_acquire(self.rank)
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.rank!r}, reentrant={self._reentrant})"

@@ -12,9 +12,10 @@ no global locks on the read path, one small lock per stat on write.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+from .ordered_lock import OrderedLock
 
 _WINDOWS = (5, 60, 600, 3600)
 _RING = 3600
@@ -25,7 +26,7 @@ class _Stat:
     __slots__ = ("lock", "sums", "counts", "samples", "stamps")
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = OrderedLock("stats.stat")
         self.sums = [0.0] * _RING
         self.counts = [0] * _RING
         self.samples: List[List[float]] = [[] for _ in range(_RING)]
@@ -64,7 +65,7 @@ class StatsManager:
 
     def __init__(self):
         self._stats: Dict[str, _Stat] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("stats.manager")
 
     def register_stats(self, name: str) -> str:
         with self._lock:
@@ -73,9 +74,13 @@ class StatsManager:
         return name
 
     def add_value(self, name: str, value: float = 1.0) -> None:
+        # lock-free fast path for registered stats; the auto-register
+        # slow path mutates the dict and must hold the registry lock
+        # (counters are bumped from every daemon/RPC thread)
         stat = self._stats.get(name)
         if stat is None:
-            stat = self._stats.setdefault(name, _Stat())
+            with self._lock:
+                stat = self._stats.setdefault(name, _Stat())
         stat.add(value)
 
     def read_stats(self, expr: str, now: Optional[float] = None) -> Optional[float]:
@@ -116,8 +121,10 @@ class StatsManager:
     def dump(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
         """All stats over the 60 s window — feeds /get_stats (webservice)."""
         out: Dict[str, Dict[str, float]] = {}
-        for name in list(self._stats):
-            total, count, _ = self._stats[name].window(60, now)
+        with self._lock:
+            snapshot = dict(self._stats)
+        for name, stat in snapshot.items():
+            total, count, _ = stat.window(60, now)
             out[name] = {
                 "sum.60": total,
                 "count.60": float(count),
@@ -127,7 +134,8 @@ class StatsManager:
         return out
 
     def names(self) -> List[str]:
-        return sorted(self._stats)
+        with self._lock:
+            return sorted(self._stats)
 
 
 stats = StatsManager()

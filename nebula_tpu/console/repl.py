@@ -68,7 +68,9 @@ class Console:
     def __init__(self, addr: HostAddr, username: str = "user",
                  password: str = "password", client_manager=None):
         self.client = GraphClient(addr, client_manager=client_manager)
-        self.client.connect(username, password)
+        st = self.client.connect(username, password)
+        if not st.ok():
+            raise RuntimeError(f"connect to {addr} failed: {st}")
         self.space = ""
 
     # ------------------------------------------------------- commands

@@ -92,7 +92,11 @@ def main(argv=None) -> int:
     register_web_handlers(ws, node)
     # advertise the web port to metad so /ingest-dispatch can reach us
     node.meta_client.hb_info["ws_port"] = ws.port
-    node.meta_client.heartbeat()
+    st = node.meta_client.heartbeat()
+    if not st.ok():
+        # not fatal — the heartbeat loop keeps beating — but an operator
+        # watching startup needs to know metad did not hear us yet
+        sys.stderr.write(f"storaged: initial heartbeat failed: {st}\n")
     sys.stderr.write(f"storaged serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

@@ -164,12 +164,11 @@ class MemEngine(KVEngine):
                 return Status.Error(f"corrupt snapshot {path}")
             batch.append((data[pos:pos + klen], data[pos + klen:pos + klen + vlen]))
             pos += klen + vlen
-        self.multi_put(batch)
-        return Status.OK()
+        return self.multi_put(batch)
 
     def compact(self) -> Status:
         if self.compaction_filter is not None:
             doomed = [k for k, v in self._table.items()
                       if self.compaction_filter(k, v)]
-            self.multi_remove(doomed)
+            return self.multi_remove(doomed)
         return Status.OK()

@@ -173,5 +173,5 @@ class NativeEngine(KVEngine):
         if self.compaction_filter is not None:
             doomed = [k for k, v in self.prefix(b"")
                       if self.compaction_filter(k, v)]
-            self.multi_remove(doomed)
+            return self.multi_remove(doomed)
         return Status.OK()

@@ -163,13 +163,21 @@ class Part:
         decoded = []
         batch_put: List[KV] = []
         batch_del: List[bytes] = []
+        failed: List[Status] = []
+        merged = 0       # OP_MERGEs applied so far (non-idempotent)
+
+        def check(st: Status) -> None:
+            # an engine failure mid-batch means this replica diverges
+            # from the quorum — propagate it instead of dropping it
+            if not st.ok():
+                failed.append(st)
 
         def flush():
             if batch_del:
-                self.engine.multi_remove(batch_del)
+                check(self.engine.multi_remove(batch_del))
                 batch_del.clear()
             if batch_put:
-                self.engine.multi_put(batch_put)
+                check(self.engine.multi_put(batch_put))
                 batch_put.clear()
 
         with self._batch_ctx():
@@ -202,19 +210,35 @@ class Part:
                             "OP_MERGE in log but no merge operator "
                             "configured — refusing to corrupt state")
                     k, operand = payload
-                    self.engine.put(k, self.merge_op(self.engine.get(k),
-                                                     operand))
+                    st = self.engine.put(
+                        k, self.merge_op(self.engine.get(k), operand))
+                    check(st)
+                    if st.ok():
+                        merged += 1
                 elif op == LogOp.OP_REMOVE_PREFIX:
                     flush()
-                    self.engine.remove_prefix(payload)
+                    check(self.engine.remove_prefix(payload))
                 elif op == LogOp.OP_REMOVE_RANGE:
                     flush()
-                    self.engine.remove_range(*payload)
+                    check(self.engine.remove_range(*payload))
                 # membership ops are handled in pre_process_log
-            if log_id > 0:
+            # the watermark only advances when every op applied: a
+            # durable commit marker above lost mutations would make
+            # crash replay skip them forever (silent divergence)
+            if log_id > 0 and not failed:
                 batch_put.append((_commit_key(self.part_id),
                                   _COMMIT.pack(log_id, term)))
             flush()
+        if failed:
+            if merged:
+                # puts/removes re-apply idempotently on the commit
+                # retry, but an already-applied OP_MERGE would run
+                # twice — refuse to continue rather than diverge
+                raise RuntimeError(
+                    f"part {self.space_id}/{self.part_id}: engine "
+                    f"failure after {merged} applied merge op(s) — "
+                    f"retry would double-merge: {failed[0]}")
+            return failed[0]
         for listener in self.listeners:
             listener(self, decoded)
         return Status.OK()
@@ -234,14 +258,22 @@ class Part:
         """Replace this part's state with a leader snapshot (follower
         side); completes the reference's reserved snapshot path
         (raftex.thrift:109, SURVEY.md §5.4)."""
+        def must(st: Status) -> None:
+            # a half-installed snapshot is silent divergence; fail
+            # loudly so raft re-requests the transfer
+            if not st.ok():
+                raise RuntimeError(
+                    f"part {self.space_id}/{self.part_id}: snapshot "
+                    f"install failed: {st}")
+
         with self._batch_ctx():
             stale = [k for k, _v in self.snapshot_rows()]
             if stale:
-                self.engine.multi_remove(stale)
+                must(self.engine.multi_remove(stale))
             if rows:
-                self.engine.multi_put(rows)
-            self.engine.put(_commit_key(self.part_id),
-                            _COMMIT.pack(log_id, term))
+                must(self.engine.multi_put(rows))
+            must(self.engine.put(_commit_key(self.part_id),
+                                 _COMMIT.pack(log_id, term)))
         for listener in self.listeners:
             listener(self, None)   # None = wholesale state replacement
 

@@ -371,12 +371,16 @@ class NebulaStore:
         sd = self.spaces.get(space_id)
         if sd is None:
             return Status.SpaceNotFound(f"space {space_id}")
+        failed: Optional[Status] = None
         for e in sd.engines:
-            e.compact()
+            st = e.compact()
+            if not st.ok() and failed is None:
+                failed = st
         # compaction filters drop TTL-expired/orphaned rows directly on
         # the engines, bypassing Part — invalidate mirrors explicitly
+        # (even on partial failure: some engines may have compacted)
         self._bump(space_id)
-        return Status.OK()
+        return failed if failed is not None else Status.OK()
 
     def flush(self, space_id: GraphSpaceID, path_prefix: str) -> Status:
         sd = self.spaces.get(space_id)

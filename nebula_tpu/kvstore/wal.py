@@ -53,11 +53,14 @@ class FileBasedWal:
     transient parts) — one implementation, optional persistence."""
 
     def __init__(self, wal_dir: Optional[str] = None,
-                 buffer_size: int = 256 * 1024):
+                 buffer_size: Optional[int] = None):
         self.dir = wal_dir
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
-        self.buffer_size = buffer_size
+        # buffer size comes from the registry so operators can tune the
+        # flush granularity without code changes (wal_buffer_size_bytes)
+        self.buffer_size = buffer_size if buffer_size is not None \
+            else int(flags.get("wal_buffer_size_bytes", 256 * 1024))
         self._buf = bytearray()
         self._fh = None
         self._cur_seg_path: Optional[str] = None

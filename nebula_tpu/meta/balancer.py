@@ -136,9 +136,14 @@ class Balancer:
 
     def _save_plan(self, plan_id: int, tasks: List[BalanceTask],
                    status: str) -> None:
-        self.meta.kv.put(META_SPACE, META_PART, self._plan_key(plan_id),
-                         _pk({"status": status,
-                              "tasks": [t.to_wire() for t in tasks]}))
+        st = self.meta.kv.put(META_SPACE, META_PART, self._plan_key(plan_id),
+                              _pk({"status": status,
+                                   "tasks": [t.to_wire() for t in tasks]}))
+        if not st.ok():
+            # a plan that is not durable cannot be crash-recovered —
+            # abort loudly instead of running it untracked
+            raise RuntimeError(f"persisting balance plan {plan_id} "
+                               f"failed: {st}")
 
     def _load_plan(self, plan_id: int):
         raw, _ = self.meta.kv.get(META_SPACE, META_PART,
@@ -293,25 +298,31 @@ class Balancer:
 
     # ---------------------------------------------------- execution
     def _run_plan(self, plan_id: int, tasks: List[BalanceTask]) -> None:
-        ok = True
-        for t in tasks:
-            if self._stop_requested:
-                t.status = "STOPPED"
-                ok = False
-                self._save_plan(plan_id, tasks, "STOPPED")
-                continue
-            try:
-                self._run_task(t)
-                t.status = "SUCCEEDED"
-            except Exception as e:       # noqa: BLE001 — record and go on
-                t.status = f"FAILED: {e}"
-                ok = False
-            self._save_plan(plan_id, tasks, "IN_PROGRESS")
-        with self._lock:
-            self._running_plan = None
-        self._save_plan(plan_id, tasks,
-                        "SUCCEEDED" if ok else
-                        ("STOPPED" if self._stop_requested else "FAILED"))
+        # _running_plan MUST clear however this thread dies (a raising
+        # _save_plan would otherwise wedge the balancer: every future
+        # BALANCE gets E_BALANCER_RUNNING with no thread left to stop)
+        try:
+            ok = True
+            for t in tasks:
+                if self._stop_requested:
+                    t.status = "STOPPED"
+                    ok = False
+                    self._save_plan(plan_id, tasks, "STOPPED")
+                    continue
+                try:
+                    self._run_task(t)
+                    t.status = "SUCCEEDED"
+                except Exception as e:   # noqa: BLE001 — record and go on
+                    t.status = f"FAILED: {e}"
+                    ok = False
+                self._save_plan(plan_id, tasks, "IN_PROGRESS")
+            self._save_plan(plan_id, tasks,
+                            "SUCCEEDED" if ok else
+                            ("STOPPED" if self._stop_requested else
+                             "FAILED"))
+        finally:
+            with self._lock:
+                self._running_plan = None
 
     def _leader_of(self, space_id: int, part_id: int,
                    peers: List[str]) -> str:
@@ -395,8 +406,14 @@ class Balancer:
         # 4. commit the new placement to meta
         t.status = "UPDATE_META"
         new_peers = [h for h in peers if h != t.src] + [t.dst]
-        self.meta.kv.put(META_SPACE, META_PART,
-                         mk.part_key(t.space_id, t.part_id), _pk(new_peers))
+        st = self.meta.kv.put(META_SPACE, META_PART,
+                              mk.part_key(t.space_id, t.part_id),
+                              _pk(new_peers))
+        if not st.ok():
+            # placement not committed — stop before removing the old
+            # replica or clients would chase a part meta never moved
+            raise RuntimeError(f"committing placement for part "
+                               f"{t.space_id}/{t.part_id} failed: {st}")
         self.meta._bump_last_update()
         # 5. drop the replica from src
         t.status = "REMOVE_OLD"

@@ -15,6 +15,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..common.flags import flags
+from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
 from ..interface.common import (HostAddr, Schema, schema_from_wire)
@@ -25,6 +26,7 @@ stats.register_stats("meta.client.retry_attempts")
 stats.register_stats("meta.client.backoff_ms")
 stats.register_stats("meta.client.retry_exhausted")
 stats.register_stats("meta.client.hint_chases")
+stats.register_stats("meta.client.heartbeat_failed")
 
 
 class SpaceInfoCache:
@@ -65,11 +67,12 @@ class MetaClient:
         self.cluster_id = 0
         self.hb_info: dict = {}   # advertised in heartbeats (ws_port...)
         self.last_update_time = -1
+        self._good_addr: Optional[str] = None  # last known catalog leader
 
-        self._cache_lock = threading.RLock()
+        self._cache_lock = OrderedLock("meta.cache", reentrant=True)
         # serializes whole load_data passes (refresh + heartbeat threads)
         # so a stale snapshot can never overwrite a newer one
-        self._load_lock = threading.Lock()
+        self._load_lock = OrderedLock("meta.load")
         self.spaces: Dict[int, SpaceInfoCache] = {}
         self.space_name_to_id: Dict[str, int] = {}
 
@@ -105,7 +108,8 @@ class MetaClient:
             # follower's E_NOT_A_LEADER carries the leader hint in its
             # message, which jumps the queue
             queue = list(self.addrs)
-            good = getattr(self, "_good_addr", None)
+            with self._cache_lock:
+                good = self._good_addr
             if good in queue:
                 queue.remove(good)
                 queue.insert(0, good)
@@ -118,7 +122,8 @@ class MetaClient:
                 tried.add(addr)
                 try:
                     resp = self.cm.call(addr, method, payload)
-                    self._good_addr = addr
+                    with self._cache_lock:
+                        self._good_addr = addr
                     return resp
                 except RpcError as e:
                     # Fail over to another metad only when the request
@@ -196,7 +201,11 @@ class MetaClient:
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():
-            self.heartbeat()
+            st = self.heartbeat()
+            if not st.ok():
+                # metad churn is survivable (the next beat retries) but
+                # must be observable, not silently dropped
+                stats.add_value("meta.client.heartbeat_failed")
             self._stop.wait(flags.get("heartbeat_interval_secs", 10))
 
     def heartbeat(self) -> Status:
@@ -208,12 +217,15 @@ class MetaClient:
             payload["info"] = dict(self.hb_info)
         r = self._call_status("heartBeat", payload)
         if r.ok():
-            self.cluster_id = r.value().get("cluster_id", self.cluster_id)
             # cheap change detection (reference uses last_update_time the
             # same way to skip full reloads)
-            lut = r.value().get("last_update_time_in_us", 0)
-            if lut != self.last_update_time:
+            with self._cache_lock:
+                self.cluster_id = r.value().get("cluster_id",
+                                                self.cluster_id)
+                lut = r.value().get("last_update_time_in_us", 0)
+                changed = lut != self.last_update_time
                 self.last_update_time = lut
+            if changed:
                 try:
                     self.load_data()
                 except RpcError:

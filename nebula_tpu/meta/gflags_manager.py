@@ -16,14 +16,13 @@ _MANAGED = {
     ConfigModule.GRAPH: ["session_idle_timeout_secs",
                          "session_reclaim_interval_secs",
                          "storage_backend"],
-    ConfigModule.META: ["expired_threshold_sec",
-                        "expired_hosts_check_interval_sec"],
+    ConfigModule.META: ["expired_threshold_sec"],
     ConfigModule.STORAGE: ["heartbeat_interval_secs",
                            "load_data_interval_secs",
                            "max_handlers_per_req",
                            "min_vertices_per_bucket",
-                           "raft_heartbeat_interval_ms",
-                           "raft_election_timeout_ms",
+                           "raft_heartbeat_interval_s",
+                           "raft_election_timeout_s",
                            "wal_buffer_size_bytes"],
 }
 

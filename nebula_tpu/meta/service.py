@@ -33,6 +33,16 @@ def _err(code: ErrorCode, msg: str = "") -> RpcError:
     return RpcError(Status(code, msg))
 
 
+def _ck(st: Status) -> None:
+    """Every catalog write goes through this (MUST_USE_RESULT): on a
+    raft-replicated metad, leadership can move between the serving gate
+    (_check_catalog_leader) and the put, and the refused append would
+    otherwise drop the DDL silently — surface it so MetaClient fails
+    over and retries against the new leader."""
+    if not st.ok():
+        raise RpcError(st)
+
+
 class ActiveHostsMan:
     """Host liveness from heartbeats with TTL expiry
     (reference ActiveHostsMan.h:46-54)."""
@@ -44,7 +54,7 @@ class ActiveHostsMan:
         rec = {"last_hb_ms": int(time.time() * 1000)}
         if info:
             rec.update(info)
-        self.kv.put(META_SPACE, META_PART, mk.host_key(host), _pk(rec))
+        _ck(self.kv.put(META_SPACE, META_PART, mk.host_key(host), _pk(rec)))
 
     def hosts(self) -> Dict[str, dict]:
         out = {}
@@ -148,12 +158,12 @@ class MetaService:
 
     # ================= helpers =================
     def _bump_last_update(self) -> None:
-        self.kv.put(META_SPACE, META_PART, mk.LAST_UPDATE_KEY, _pk(now_micros()))
+        _ck(self.kv.put(META_SPACE, META_PART, mk.LAST_UPDATE_KEY, _pk(now_micros())))
 
     def _next_id(self) -> int:
         raw, _ = self.kv.get(META_SPACE, META_PART, mk.ID_KEY)
         nxt = (_unpk(raw) if raw is not None else 0) + 1
-        self.kv.put(META_SPACE, META_PART, mk.ID_KEY, _pk(nxt))
+        _ck(self.kv.put(META_SPACE, META_PART, mk.ID_KEY, _pk(nxt)))
         return nxt
 
     def _space_id(self, name: str) -> Optional[int]:
@@ -191,7 +201,7 @@ class MetaService:
         for part in range(1, parts + 1):
             peers = [hosts[(offset + part + r) % len(hosts)] for r in range(replica)]
             batch.append((mk.part_key(space_id, part), _pk(peers)))
-        self.kv.multi_put(META_SPACE, META_PART, batch)
+        _ck(self.kv.multi_put(META_SPACE, META_PART, batch))
         self._bump_last_update()
         return {"id": space_id}
 
@@ -200,15 +210,19 @@ class MetaService:
         space_id = self._space_id(name)
         if space_id is None:
             raise _err(ErrorCode.E_NOT_FOUND, f"space {name}")
-        self.kv.remove(META_SPACE, META_PART, mk.space_index_key(name))
-        self.kv.remove(META_SPACE, META_PART, mk.space_key(space_id))
-        self.kv.remove_prefix(META_SPACE, META_PART, mk.part_prefix(space_id))
-        self.kv.remove_prefix(META_SPACE, META_PART, mk.tag_prefix(space_id))
-        self.kv.remove_prefix(META_SPACE, META_PART, mk.edge_prefix(space_id))
-        self.kv.remove_prefix(META_SPACE, META_PART,
-                              mk.tag_index_key(space_id, ""))
-        self.kv.remove_prefix(META_SPACE, META_PART,
-                              mk.edge_index_key(space_id, ""))
+        # name-index key LAST: while it exists a retried DROP SPACE
+        # still resolves the space id, so a failure partway (leadership
+        # moved mid-drop) leaves the drop retryable instead of
+        # orphaning the space's rows behind an E_NOT_FOUND
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART, mk.part_prefix(space_id)))
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART, mk.tag_prefix(space_id)))
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART, mk.edge_prefix(space_id)))
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART,
+                              mk.tag_index_key(space_id, "")))
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART,
+                              mk.edge_index_key(space_id, "")))
+        _ck(self.kv.remove(META_SPACE, META_PART, mk.space_key(space_id)))
+        _ck(self.kv.remove(META_SPACE, META_PART, mk.space_index_key(name)))
         self._bump_last_update()
         return {}
 
@@ -238,8 +252,8 @@ class MetaService:
     def rpc_updatePartAlloc(self, req: dict) -> dict:
         """Balancer support: move a part's peer list."""
         space_id, part_id = int(req["space_id"]), int(req["part_id"])
-        self.kv.put(META_SPACE, META_PART, mk.part_key(space_id, part_id),
-                    _pk(list(req["peers"])))
+        _ck(self.kv.put(META_SPACE, META_PART, mk.part_key(space_id, part_id),
+                    _pk(list(req["peers"]))))
         self._bump_last_update()
         return {}
 
@@ -251,7 +265,7 @@ class MetaService:
 
     def rpc_removeHosts(self, req: dict) -> dict:
         for h in req["hosts"]:
-            self.kv.remove(META_SPACE, META_PART, mk.host_key(h))
+            _ck(self.kv.remove(META_SPACE, META_PART, mk.host_key(h)))
         return {}
 
     def rpc_listHosts(self, req: dict) -> dict:
@@ -285,11 +299,11 @@ class MetaService:
         sid = self._next_id()
         schema = schema_from_wire(req["schema"])
         schema.version = 0
-        self.kv.multi_put(META_SPACE, META_PART, [
+        _ck(self.kv.multi_put(META_SPACE, META_PART, [
             (index_key_fn(space_id, name), _pk(sid)),
             (key_fn(space_id, sid, 0), _pk({"name": name,
                                             "schema": schema_to_wire(schema)})),
-        ])
+        ]))
         self._bump_last_update()
         return {"id": sid}
 
@@ -337,8 +351,8 @@ class MetaService:
             from ..interface.common import SchemaProp
             new_schema.schema_prop = SchemaProp(ttl.get("ttl_duration"),
                                                 ttl.get("ttl_col"))
-        self.kv.put(META_SPACE, META_PART, key_fn(space_id, sid, new_ver),
-                    _pk({"name": name, "schema": schema_to_wire(new_schema)}))
+        _ck(self.kv.put(META_SPACE, META_PART, key_fn(space_id, sid, new_ver),
+                    _pk({"name": name, "schema": schema_to_wire(new_schema)})))
         self._bump_last_update()
         return {"id": sid, "version": new_ver}
 
@@ -349,8 +363,8 @@ class MetaService:
         if raw is None:
             raise _err(ErrorCode.E_SCHEMA_NOT_FOUND, name)
         sid = _unpk(raw)
-        self.kv.remove(META_SPACE, META_PART, index_key_fn(space_id, name))
-        self.kv.remove_prefix(META_SPACE, META_PART, prefix_fn(space_id, sid))
+        _ck(self.kv.remove(META_SPACE, META_PART, index_key_fn(space_id, name)))
+        _ck(self.kv.remove_prefix(META_SPACE, META_PART, prefix_fn(space_id, sid)))
         self._bump_last_update()
         return {}
 
@@ -452,9 +466,9 @@ class MetaService:
     # ================= customKV =================
     def rpc_multiPut(self, req: dict) -> dict:
         seg = req["segment"]
-        self.kv.multi_put(META_SPACE, META_PART,
+        _ck(self.kv.multi_put(META_SPACE, META_PART,
                           [(mk.kv_key(seg, k), _pk(v))
-                           for k, v in req["pairs"]])
+                           for k, v in req["pairs"]]))
         return {}
 
     def rpc_get(self, req: dict) -> dict:
@@ -483,14 +497,14 @@ class MetaService:
         return {"values": out}
 
     def rpc_remove(self, req: dict) -> dict:
-        self.kv.remove(META_SPACE, META_PART, mk.kv_key(req["segment"], req["key"]))
+        _ck(self.kv.remove(META_SPACE, META_PART, mk.kv_key(req["segment"], req["key"])))
         return {}
 
     def rpc_removeRange(self, req: dict) -> dict:
         prefix = mk.kv_prefix(req["segment"])
-        self.kv.remove_range(META_SPACE, META_PART,
+        _ck(self.kv.remove_range(META_SPACE, META_PART,
                              prefix + req["start"].encode(),
-                             prefix + req["end"].encode())
+                             prefix + req["end"].encode()))
         return {}
 
     # ================= usersMan =================
@@ -502,8 +516,8 @@ class MetaService:
             if req.get("if_not_exists"):
                 return {}
             raise _err(ErrorCode.E_EXISTED, name)
-        self.kv.put(META_SPACE, META_PART, key,
-                    _pk({"password": req.get("password", ""), "roles": {}}))
+        _ck(self.kv.put(META_SPACE, META_PART, key,
+                    _pk({"password": req.get("password", ""), "roles": {}})))
         return {}
 
     def rpc_dropUser(self, req: dict) -> dict:
@@ -511,7 +525,7 @@ class MetaService:
         raw, _ = self.kv.get(META_SPACE, META_PART, key)
         if raw is None and not req.get("if_exists"):
             raise _err(ErrorCode.E_NOT_FOUND, req["account"])
-        self.kv.remove(META_SPACE, META_PART, key)
+        _ck(self.kv.remove(META_SPACE, META_PART, key))
         return {}
 
     def rpc_getUser(self, req: dict) -> dict:
@@ -551,7 +565,7 @@ class MetaService:
                 rec["password"] != req["old_password"]:
             raise _err(ErrorCode.E_BAD_USERNAME_PASSWORD, "wrong password")
         rec["password"] = req["new_password"]
-        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        _ck(self.kv.put(META_SPACE, META_PART, key, _pk(rec)))
         return {}
 
     def rpc_checkPassword(self, req: dict) -> dict:
@@ -568,7 +582,7 @@ class MetaService:
             raise _err(ErrorCode.E_NOT_FOUND, req["account"])
         rec = _unpk(raw)
         rec.setdefault("roles", {})[str(req["space_id"])] = int(req["role"])
-        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        _ck(self.kv.put(META_SPACE, META_PART, key, _pk(rec)))
         return {}
 
     def rpc_revokeRole(self, req: dict) -> dict:
@@ -578,7 +592,7 @@ class MetaService:
             raise _err(ErrorCode.E_NOT_FOUND, req["account"])
         rec = _unpk(raw)
         rec.get("roles", {}).pop(str(req["space_id"]), None)
-        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        _ck(self.kv.put(META_SPACE, META_PART, key, _pk(rec)))
         return {}
 
     def rpc_listUsers(self, req: dict) -> dict:
@@ -595,10 +609,10 @@ class MetaService:
             key = mk.config_key(int(item["module"]), item["name"])
             raw, _ = self.kv.get(META_SPACE, META_PART, key)
             if raw is None:  # first registration wins; value is the default
-                self.kv.put(META_SPACE, META_PART, key, _pk({
+                _ck(self.kv.put(META_SPACE, META_PART, key, _pk({
                     "mode": int(item.get("mode", ConfigMode.MUTABLE)),
                     "value": item.get("value"),
-                }))
+                })))
         return {}
 
     def rpc_getConfig(self, req: dict) -> dict:
@@ -618,7 +632,7 @@ class MetaService:
         if ConfigMode(rec["mode"]) == ConfigMode.IMMUTABLE:
             raise _err(ErrorCode.E_UNSUPPORTED, f"{req['name']} is immutable")
         rec["value"] = req["value"]
-        self.kv.put(META_SPACE, META_PART, key, _pk(rec))
+        _ck(self.kv.put(META_SPACE, META_PART, key, _pk(rec)))
         self._bump_last_update()
         return {}
 

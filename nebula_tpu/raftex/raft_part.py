@@ -35,6 +35,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..common.flags import flags
+from ..common.ordered_lock import OrderedLock
 from ..common.status import ErrorCode, Status
 from ..interface.common import HostAddr
 from ..kvstore.log_encoder import LogOp, decode as decode_log, encode_single
@@ -107,7 +108,7 @@ class Peer:
         self.addr = addr          # "host:port"
         self.is_learner = is_learner
         self.match_id = 0
-        self.lock = threading.Lock()
+        self.lock = OrderedLock("raft.peer")
         self.inflight_hb = False
 
 
@@ -120,7 +121,7 @@ class RaftPart:
         self.addr = local_addr                     # "host:port"
         self.cm = client_manager
         self.executor = executor
-        self._lock = threading.RLock()
+        self._lock = OrderedLock("raft.part", reentrant=True)
         # signaled whenever the WAL tail advances — pipelined appends
         # arriving out of order wait here for the gap to fill
         self._wal_advanced = threading.Condition(self._lock)
@@ -194,6 +195,8 @@ class RaftPart:
         os.replace(tmp, self._state_path)
 
     def _reset_election_timeout(self) -> None:
+        """Caller holds the lock (self._lock) — or is __init__, before
+        any worker thread exists."""
         base = float(flags.get("raft_election_timeout_s"))
         self._election_timeout = base * (1.0 + random.random())
 
@@ -612,7 +615,18 @@ class RaftPart:
         entries = [(e.log_id, e.term, e.msg)
                    for e in self.wal.iterate(self.committed_id + 1, to_id)]
         if self.commit_handler is not None and entries:
-            self.commit_handler(entries)
+            st = self.commit_handler(entries)
+            if st is not None and not st.ok():
+                # the state machine could not apply the batch (engine
+                # failure): advancing committed_id anyway would skip
+                # these logs forever and silently diverge this replica.
+                # Leave the watermark so the next commit pass retries.
+                import sys
+                sys.stderr.write(
+                    f"[raft {self.space_id}/{self.part_id}] commit of "
+                    f"logs {self.committed_id + 1}..{to_id} failed: "
+                    f"{st} — not advancing committed_id\n")
+                return
         self.committed_id = to_id
         self._wal_advanced.notify_all()   # CAS batches wait for drain
 

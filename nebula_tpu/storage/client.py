@@ -13,12 +13,12 @@ from __future__ import annotations
 
 import concurrent.futures
 import random
-import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..common.flags import flags
 from ..common.keys import id_hash
+from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..interface.common import HostAddr
@@ -59,7 +59,7 @@ class StorageClient:
         self.cm = client_manager or default_client_manager
         self.pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=fanout_workers, thread_name_prefix="storage-client")
-        self._leader_lock = threading.Lock()
+        self._leader_lock = OrderedLock("storage.leader_cache")
         self._leaders: Dict[Tuple[int, int], str] = {}  # (space, part) -> host
         # round-robin cursor for leaderless fallback routing
         self._fallback_rr: Dict[Tuple[int, int], int] = {}

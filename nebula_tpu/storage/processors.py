@@ -801,8 +801,13 @@ class DeleteProcessor(QueryBaseProcessor):
         space_id = int(req["space_id"])
         part = int(req["part"])
         vid = int(req["vid"])
-        self.kv.remove_prefix(space_id, part, KeyUtils.vertex_prefix(part, vid))
-        self.kv.remove_prefix(space_id, part, KeyUtils.edge_prefix(part, vid))
+        for prefix in (KeyUtils.vertex_prefix(part, vid),
+                       KeyUtils.edge_prefix(part, vid)):
+            st = self.kv.remove_prefix(space_id, part, prefix)
+            if not st.ok():
+                # a half-deleted vertex (props gone, edges alive) is
+                # worse than a failed RPC the client can retry
+                raise RpcError(st)
         return {}
 
     def delete_edges(self, req: dict) -> dict:
@@ -812,5 +817,7 @@ class DeleteProcessor(QueryBaseProcessor):
             for src, etype, rank, dst in keys:
                 prefix = KeyUtils.edge_prefix(part, int(src), int(etype),
                                               int(rank), int(dst))
-                self.kv.remove_prefix(space_id, part, prefix)
+                st = self.kv.remove_prefix(space_id, part, prefix)
+                if not st.ok():
+                    raise RpcError(st)
         return {}

@@ -18,6 +18,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ..common.flags import flags
+from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..interface.rpc import RpcError
@@ -58,8 +59,10 @@ class StorageService:
         self.backend = None  # TpuStorageBackend when attached
         self._device_rt = None      # lazy TpuQueryRuntime (device serving)
         self._backend_rt = None     # local-only runtime for the backend
-        self._device_rt_lock = threading.Lock()
+        self._backend_broken = False
+        self._device_rt_lock = OrderedLock("storage.device_rt")
         self._remote_views: Dict = {}   # (space_id, host_str) -> view
+        self._device_fail_log: Dict = {}  # (method, exc type) -> last log
         stats.register_stats("storage.get_bound.latency_us")
         stats.register_stats("storage.add.latency_us")
         stats.register_stats("storage.qps")
@@ -162,8 +165,7 @@ class StorageService:
         """Lazily attach the mirror-backed bulk-read backend
         (tpu/backend.py).  Stays None on CPU-only deployments or when
         jax is unavailable — the processors answer everything then."""
-        if self.backend is None and not getattr(self, "_backend_broken",
-                                                False):
+        if self.backend is None and not self._backend_broken:
             if flags.get("storage_backend") == "cpu":
                 return None
             try:
@@ -196,7 +198,8 @@ class StorageService:
                     "[storage] mirror read backend unavailable — bulk "
                     f"reads stay on the CPU processors: "
                     f"{type(e).__name__}: {e}\n")
-                self._backend_broken = True
+                with self._device_rt_lock:
+                    self._backend_broken = True
         return self.backend
 
     # reference-IDL spellings (storage.thrift:207-228): direction is a
@@ -329,11 +332,11 @@ class StorageService:
         import time as _time
         key = (method, type(exc).__name__)
         now = _time.time()
-        seen = getattr(self, "_device_fail_log", None)
-        if seen is None:
-            seen = self._device_fail_log = {}
-        if now - seen.get(key, 0) >= 60:
-            seen[key] = now
+        with self._device_rt_lock:
+            should_log = now - self._device_fail_log.get(key, 0) >= 60
+            if should_log:
+                self._device_fail_log[key] = now
+        if should_log:
             sys.stderr.write(
                 f"[storage] {method} device failure — queries fall back "
                 f"to the CPU path: {type(exc).__name__}: {exc}\n")
@@ -482,6 +485,13 @@ class StorageService:
     def rpc_transLeader(self, req: dict) -> dict:
         part = self._raft(req)
         if part.raft is not None:
+            # Deliberately fire-and-forget (the reference's (void) cast
+            # case): the OP_TRANS_LEADER batch is often aborted by the
+            # very election it triggers — the target's higher-term vote
+            # deposes the sender mid-append — so a non-OK append status
+            # does NOT mean the transfer failed. Callers poll the
+            # leadership instead (balancer catch-up loop).
+            # nebulint: disable=status-discard
             part.raft.transfer_leadership(req["new_leader"])
         return {}
 

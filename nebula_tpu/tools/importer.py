@@ -133,7 +133,10 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     client = GraphClient(HostAddr.parse(args.addr))
-    client.connect()
+    st = client.connect()
+    if not st.ok():
+        print(f"importer: connect failed: {st}", file=sys.stderr)
+        return 1
     imp = Importer(client, args.space, args.batch)
     props = args.props.split(",")
     t0 = time.perf_counter()

@@ -1,0 +1,30 @@
+"""nebulint — project-invariant static analysis for nebula_tpu.
+
+The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
+on Status/StatusOr, clang-tidy, sanitizer builds) that a Python
+reproduction loses.  nebulint restores the project-specific part as five
+AST checks run over the whole package and gated as a tier-1 test
+(tests/test_lint.py):
+
+  lock-discipline   attributes mutated from thread entry points without
+                    the owning class's declared lock; blocking calls
+                    (RPC, sleep, fsync) made while a lock is held
+  lock-order        cycles in the static lock acquisition graph
+                    (runtime counterpart: common/ordered_lock.py)
+  status-discard    a call whose callee returns Status/StatusOr with the
+                    result discarded — the MUST_USE_RESULT analogue
+  jax-hotpath       host syncs and jit-cache busters inside the TPU
+                    frontier loops (tpu/runtime.py, tpu/kernels.py,
+                    graph/executors/)
+  flag-registry     flags.get("x") without a define(), and dead defines
+
+Suppression: ``# nebulint: disable=<check>[,<check>]`` on the flagged
+line (or the line above), ``# nebulint: disable-file=<check>`` anywhere
+in a file, or an entry in baseline.json (every baseline entry must carry
+a one-line justification).  See docs/static_analysis.md.
+"""
+from .core import (ALL_CHECKS, Baseline, LintError, Violation, lint_paths,
+                   run_lint)
+
+__all__ = ["ALL_CHECKS", "Baseline", "LintError", "Violation",
+           "lint_paths", "run_lint"]

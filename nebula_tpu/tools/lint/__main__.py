@@ -1,0 +1,66 @@
+"""CLI: ``python -m nebula_tpu.tools.lint [options] [root]``.
+
+Exit status 0 when no unsuppressed violations remain, 1 otherwise,
+2 for configuration errors (bad baseline, unknown check)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import (ALL_CHECKS, DEFAULT_BASELINE, LintError, run_lint)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="nebulint",
+        description="project-invariant static analysis for nebula_tpu")
+    p.add_argument("root", nargs="?", default=None,
+                   help="package root to lint (default: the installed "
+                        "nebula_tpu package)")
+    p.add_argument("--check", action="append", dest="checks",
+                   metavar="NAME", help=f"run only this check (repeat; "
+                                        f"one of: {', '.join(ALL_CHECKS)})")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: the checked-in one)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined violations too")
+    p.add_argument("--list-baseline", action="store_true",
+                   help="print baseline entries with their reasons")
+    args = p.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        import nebula_tpu
+        root = os.path.dirname(os.path.abspath(nebula_tpu.__file__))
+    baseline = None if args.no_baseline else args.baseline
+
+    try:
+        vs, bl = run_lint(root, baseline_path=baseline, checks=args.checks)
+    except LintError as e:
+        print(f"nebulint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.list_baseline and bl is not None:
+        for e in bl.entries:
+            print(f"baseline: {e['file']} {e['symbol']} [{e['check']}] "
+                  f"— {e['reason']}")
+
+    for v in vs:
+        print(f"{v.path}:{v.line}: [{v.check}] ({v.symbol}) {v.message}")
+    if bl is not None:
+        stale = bl.unused()
+        for e in stale:
+            print(f"stale baseline entry (no longer fires): "
+                  f"{e['file']} {e['symbol']} [{e['check']}]",
+                  file=sys.stderr)
+    n = len(vs)
+    if n:
+        print(f"nebulint: {n} unsuppressed violation(s)", file=sys.stderr)
+        return 1
+    print("nebulint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""nebulint driver: file walking, suppression, baseline, check registry.
+
+Checks are pure functions ``check(ctx) -> List[Violation]`` over a
+``PackageContext`` holding every parsed module (several checks are
+whole-package analyses: the Status return-type fixpoint, the flag
+registry, the lock acquisition graph)."""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class LintError(RuntimeError):
+    """Configuration problems (unparseable baseline, reason-less entry)."""
+
+
+class Violation:
+    __slots__ = ("check", "path", "line", "symbol", "message")
+
+    def __init__(self, check: str, path: str, line: int, symbol: str,
+                 message: str):
+        self.check = check
+        self.path = path          # posix path relative to the repo root
+        self.line = line
+        self.symbol = symbol      # "Class.method", "func", or "<module>"
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.check, self.path, self.symbol)
+
+
+class Module:
+    """One parsed source file plus its suppression tables."""
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        # line -> set of checks disabled on that line
+        self.line_disable: Dict[int, set] = {}
+        self.file_disable: set = set()
+        self._parse_suppressions()
+
+    _SUPPRESS = re.compile(
+        r"#\s*nebulint:\s*(disable(?:-file)?)\s*=\s*([\w\-, ]+)")
+
+    def _parse_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = self._SUPPRESS.search(line)
+            if not m:
+                continue
+            checks = {c.strip() for c in m.group(2).split(",") if c.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disable |= checks
+            else:
+                self.line_disable.setdefault(i, set()).update(checks)
+
+    def suppressed(self, check: str, line: int) -> bool:
+        if check in self.file_disable or "all" in self.file_disable:
+            return True
+        for ln in (line, line - 1):
+            marks = self.line_disable.get(ln)
+            if marks and (check in marks or "all" in marks):
+                return True
+        return False
+
+
+class PackageContext:
+    def __init__(self, root: str, modules: List[Module],
+                 extra_text: Optional[Dict[str, str]] = None):
+        self.root = root
+        self.modules = modules
+        # non-Python reference text (etc/*.conf): flag names appearing
+        # there count as "referenced" for the dead-define analysis
+        self.extra_text = extra_text or {}
+
+
+# ---------------------------------------------------------------- baseline
+class Baseline:
+    """Checked-in list of accepted violations, each with a one-line
+    justification.  Matching is by (check, file, symbol) — line numbers
+    churn too much to key on."""
+
+    def __init__(self, entries: List[dict], path: str = "<inline>"):
+        self.entries = entries
+        self.by_key: Dict[Tuple[str, str, str], dict] = {}
+        for e in entries:
+            for field in ("check", "file", "symbol", "reason"):
+                if not str(e.get(field, "")).strip():
+                    raise LintError(
+                        f"{path}: baseline entry {e!r} missing a "
+                        f"non-empty {field!r} (every accepted violation "
+                        f"must carry a justification)")
+            self.by_key[(e["check"], e["file"], e["symbol"])] = e
+        self.hits: set = set()
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise LintError(f"cannot load baseline {path}: {e}")
+        return cls(data.get("entries", []), path=path)
+
+    def match(self, v: Violation) -> bool:
+        k = v.key()
+        if k in self.by_key:
+            self.hits.add(k)
+            return True
+        return False
+
+    def unused(self) -> List[dict]:
+        return [e for k, e in self.by_key.items() if k not in self.hits]
+
+
+# ---------------------------------------------------------------- walking
+_SKIP_DIRS = {"__pycache__", ".git", "lint"}  # lint never lints itself
+
+
+def _iter_py(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d not in _SKIP_DIRS]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_package(root: str, repo_root: Optional[str] = None
+                 ) -> PackageContext:
+    repo_root = repo_root or os.path.dirname(os.path.abspath(root))
+    modules: List[Module] = []
+    for path in _iter_py(root):
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            raise LintError(f"{rel}: syntax error: {e}")
+        modules.append(Module(path, rel, src, tree))
+    extra: Dict[str, str] = {}
+    etc = os.path.join(repo_root, "etc")
+    if os.path.isdir(etc):
+        for fn in sorted(os.listdir(etc)):
+            p = os.path.join(etc, fn)
+            if os.path.isfile(p):
+                try:
+                    with open(p, encoding="utf-8", errors="replace") as fh:
+                        extra["etc/" + fn] = fh.read()
+                except OSError:
+                    pass
+    return PackageContext(root, modules, extra)
+
+
+# ---------------------------------------------------------------- registry
+def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
+    from . import flagsreg, hotpath, locks, status
+    return {
+        "lock-discipline": locks.check_lock_discipline,
+        "lock-order": locks.check_lock_order,
+        "status-discard": status.check_status_discard,
+        "jax-hotpath": hotpath.check_jax_hotpath,
+        "flag-registry": flagsreg.check_flag_registry,
+    }
+
+
+ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
+              "jax-hotpath", "flag-registry")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def lint_paths(root: str, checks: Optional[Iterable[str]] = None,
+               repo_root: Optional[str] = None) -> List[Violation]:
+    """Run the selected checks; returns violations AFTER inline
+    suppression but BEFORE baseline filtering."""
+    ctx = load_package(root, repo_root)
+    registry = _checks()
+    names = list(checks) if checks else list(ALL_CHECKS)
+    by_rel = {m.rel: m for m in ctx.modules}
+    out: List[Violation] = []
+    for name in names:
+        if name not in registry:
+            raise LintError(f"unknown check {name!r} "
+                            f"(have: {', '.join(ALL_CHECKS)})")
+        for v in registry[name](ctx):
+            mod = by_rel.get(v.path)
+            if mod is not None and mod.suppressed(v.check, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.check))
+    return out
+
+
+def run_lint(root: str, baseline_path: Optional[str] = DEFAULT_BASELINE,
+             checks: Optional[Iterable[str]] = None,
+             repo_root: Optional[str] = None
+             ) -> Tuple[List[Violation], Optional[Baseline]]:
+    """Full run: (unsuppressed-and-unbaselined violations, baseline)."""
+    vs = lint_paths(root, checks, repo_root)
+    baseline = None
+    if baseline_path:
+        if os.path.exists(baseline_path):
+            baseline = Baseline.load(baseline_path)
+            vs = [v for v in vs if not baseline.match(v)]
+        elif baseline_path != DEFAULT_BASELINE:
+            # an explicitly requested baseline that is missing is a
+            # configuration error (typo'd CI path), not "no baseline"
+            raise LintError(f"baseline {baseline_path} does not exist")
+    return vs, baseline
+
+
+# ---------------------------------------------------------------- helpers
+def qualname_map(tree: ast.AST) -> Dict[ast.AST, str]:
+    """Map every function/class node to its dotted qualname."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[child] = q
+                walk(child, q)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def enclosing_symbol(qmap: Dict[ast.AST, str], stack: List[ast.AST]) -> str:
+    for node in reversed(stack):
+        if node in qmap:
+            return qmap[node]
+    return "<module>"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,88 @@
+"""flag-registry — every ``flags.get("x")`` resolves to a ``define()``
+somewhere in the package, and no dead defines remain.
+
+Defines are distributed (common/flags.py holds the framework set;
+tpu/runtime.py, raftex/raft_part.py etc. define their subsystem flags at
+import), so resolution is package-wide.  A define is DEAD when its name
+string appears nowhere else: not in a ``flags.get``/``set``/``watch``,
+not in any other string literal (meta/gflags_manager.py's _MANAGED
+lists, docs references embedded in code), and not in the etc/ conf
+files.  Dynamic gets (``flags.get(name_var)``) can't be checked and are
+ignored — the literal-name rule is the contract this check enforces.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import PackageContext, Violation, dotted, enclosing_symbol, \
+    qualname_map
+
+
+def _literal(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def check_flag_registry(ctx: PackageContext) -> List[Violation]:
+    defines: Dict[str, Tuple[str, int, str]] = {}   # name -> site
+    gets: List[Tuple[str, str, int, str, str]] = []  # (+ accessor kind)
+
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call):
+                    d = dotted(child.func) or ""
+                    leaf = d.rsplit(".", 1)[-1]
+                    recv_ok = d.split(".")[0] in ("flags", "self") \
+                        or "flags" in d
+                    name = _literal(child.args[0]) if child.args else None
+                    if leaf == "define" and recv_ok and name:
+                        defines.setdefault(
+                            name, (mod.rel, child.lineno,
+                                   enclosing_symbol(qmap, stack)))
+                        walk(child, stack + [child])
+                        continue
+                    if leaf in ("get", "set", "watch", "info") and recv_ok \
+                            and d.split(".")[0] == "flags" and name:
+                        gets.append((name, mod.rel, child.lineno,
+                                     enclosing_symbol(qmap, stack), leaf))
+                        walk(child, stack + [child])
+                        continue
+                new_stack = stack + [child] if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) else stack
+                walk(child, new_stack)
+
+        walk(mod.tree, [])
+
+    # conf files reference flags by --name=value / json keys
+    conf_text = "\n".join(ctx.extra_text.values())
+
+    out: List[Violation] = []
+    for name, rel, line, sym, kind in gets:
+        if kind == "get" and name not in defines:
+            out.append(Violation(
+                "flag-registry", rel, line, sym,
+                f"flags.get({name!r}) has no flags.define() anywhere in "
+                f"the package — typo or missing registration"))
+
+    # a flag is READ only via a literal flags.get/watch/info — being
+    # listed in a remote-management table or set from a conf file does
+    # not make an unread flag alive (that is exactly the config-theater
+    # case this check exists to catch)
+    read_names = {g[0] for g in gets if g[4] in ("get", "watch", "info")}
+    set_only = {g[0] for g in gets} - read_names
+    for name, (rel, line, sym) in sorted(defines.items()):
+        if name in read_names:
+            continue
+        hint = " (it IS written via flags.set — write-only config)" \
+            if name in set_only or name in conf_text else ""
+        out.append(Violation(
+            "flag-registry", rel, line, sym,
+            f"flag {name!r} is defined but never read via a literal "
+            f"flags.get/watch{hint} — delete it or wire it up"))
+    return out

@@ -1,0 +1,171 @@
+"""jax-hotpath — host-sync and jit-cache-buster detection in the TPU
+frontier loops.
+
+Graph-accelerator work (IntersectX, arxiv 2012.10848; on-chip graph
+comms, arxiv 2108.11521) shows accelerator-side traversal wins evaporate
+when host round-trips sneak into the frontier loop, so this is a perf
+gate, not style.  Scoped to the device hot path — ``tpu/runtime.py``,
+``tpu/kernels.py``, ``tpu/ell.py`` and ``graph/executors/`` — and only
+INSIDE ``for``/``while`` loop bodies (module-level and straight-line
+uses are setup cost, not per-hop cost):
+
+  * ``jax.jit`` / ``partial(jax.jit, ...)`` construction inside a loop:
+    every iteration makes a fresh callable, so XLA's trace cache keys
+    never hit — the classic silent-retrace bug.
+  * ``make_*_kernel`` factory calls inside a loop that don't go through
+    the runtime's ``self._kernel`` memo: same buster, project-specific
+    spelling.
+  * host syncs on device values inside a loop: ``np.asarray``/
+    ``np.array``/``float``/``int``/``.tolist()``/``.item()`` applied to
+    a ``*_dev``-suffixed name (the project convention for device
+    arrays), or ``.block_until_ready()`` anywhere in a loop.
+  * ``jit(..., static_argnums/static_argnames=...)`` whose function is
+    built in a loop — flagged by the first rule; listed here because
+    unhashable static args force a retrace per call even outside loops,
+    so any ``static_arg*`` usage with a mutable default (list/dict
+    literal in the same call) is flagged wherever it appears.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import PackageContext, Violation, dotted, qualname_map
+
+_HOT_FILES = ("tpu/runtime.py", "tpu/kernels.py", "tpu/ell.py")
+_HOT_DIRS = ("graph/executors/",)
+_HOST_SYNC_FNS = {"float", "int", "bool"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEV_SUFFIXES = ("_dev", "_device")
+
+
+def _is_hot(rel: str) -> bool:
+    return rel.endswith(_HOT_FILES) or any(d in rel for d in _HOT_DIRS)
+
+
+def _devish(node: ast.AST) -> Optional[str]:
+    """Name of a device-valued expression per project convention."""
+    d = dotted(node)
+    if d is None:
+        return None
+    if d.split(".")[-1].endswith(_DEV_SUFFIXES):
+        return d
+    return None
+
+
+class _LoopScan(ast.NodeVisitor):
+    def __init__(self, mod, qmap):
+        self.mod = mod
+        self.qmap = qmap
+        self.sym_stack: List[str] = []
+        self.loop_depth = 0
+        self.kernel_memo_depth = 0   # inside self._kernel(...) args
+        self.out: List[Violation] = []
+
+    # -- symbol tracking ----------------------------------------------
+    def visit_FunctionDef(self, node):
+        self.sym_stack.append(self.qmap.get(node, node.name))
+        # a nested def's body does not execute in the enclosing loop
+        saved, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = saved
+        self.sym_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.sym_stack.append(self.qmap.get(node, node.name))
+        self.generic_visit(node)
+        self.sym_stack.pop()
+
+    def _sym(self) -> str:
+        return self.sym_stack[-1] if self.sym_stack else "<module>"
+
+    # -- loops ---------------------------------------------------------
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    # -- calls -----------------------------------------------------------
+    def _flag(self, line: int, msg: str) -> None:
+        self.out.append(Violation("jax-hotpath", self.mod.rel, line,
+                                  self._sym(), msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        d = dotted(node.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+
+        # static_arg* with a mutable literal — retraces on every call.
+        # Only the static_arg* keyword's own value is inspected (a
+        # list in donate_argnums/in_shardings is hashed by jit itself
+        # and must not false-flag); one report per call.
+        if "jit" in d or "jit" in leaf:
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                if any(isinstance(sub, (ast.List, ast.Dict, ast.Set))
+                       for sub in ast.walk(kw.value)):
+                    self._flag(node.lineno,
+                               "jit static args built from a mutable "
+                               "literal — unhashable statics force a "
+                               "retrace per call; use a tuple")
+                    break
+
+        if self.loop_depth > 0:
+            if d in ("jax.jit", "jit") or (leaf == "jit"):
+                self._flag(node.lineno,
+                           "jax.jit constructed inside a loop — a fresh "
+                           "callable per iteration never hits the trace "
+                           "cache (jit-cache buster)")
+            elif leaf.startswith("make_") and leaf.endswith("_kernel") \
+                    and self.kernel_memo_depth == 0:
+                self._flag(node.lineno,
+                           f"kernel factory {leaf}() called inside a "
+                           f"loop without the self._kernel memo — "
+                           f"compiles a new XLA program per iteration")
+            elif leaf == "block_until_ready":
+                self._flag(node.lineno,
+                           "block_until_ready() inside a loop — host "
+                           "sync per iteration serializes the device "
+                           "pipeline")
+            elif d in _NP_SYNC or leaf in _HOST_SYNC_FNS:
+                for arg in node.args[:1]:
+                    dev = _devish(arg)
+                    if dev:
+                        self._flag(node.lineno,
+                                   f"host materialization of device "
+                                   f"value {dev!r} inside a loop — "
+                                   f"forces a device->host sync per "
+                                   f"iteration")
+            elif leaf in ("tolist", "item"):
+                base = node.func.value if isinstance(node.func,
+                                                     ast.Attribute) else None
+                dev = _devish(base) if base is not None else None
+                if dev:
+                    self._flag(node.lineno,
+                               f"host materialization of device value "
+                               f"{dev!r} inside a loop (.{leaf}())")
+
+        # track self._kernel(...) memo scope: factories inside its
+        # lambda argument are the CORRECT pattern
+        if d.endswith("._kernel") or leaf == "_kernel":
+            self.kernel_memo_depth += 1
+            self.generic_visit(node)
+            self.kernel_memo_depth -= 1
+        else:
+            self.generic_visit(node)
+
+
+def check_jax_hotpath(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        if not _is_hot(mod.rel):
+            continue
+        scan = _LoopScan(mod, qualname_map(mod.tree))
+        scan.visit(mod.tree)
+        out += scan.out
+    return out

@@ -1,0 +1,451 @@
+"""lock-discipline and lock-order checks.
+
+Model: a class "declares" a lock when any of its methods assigns
+``self.<attr> = threading.Lock()/RLock()/Condition(...)`` or
+``OrderedLock(...)``.  Within such classes:
+
+  * lock-discipline (a): methods that run on their own threads — Thread
+    targets, executor-submit targets, raft/RPC handlers (``process_*``,
+    ``rpc_*``) — must mutate ``self.*`` state only inside a
+    ``with self.<lock>`` block.  Attributes assigned ONLY in
+    ``__init__``/``start`` (configuration wired before threads exist)
+    are exempt.  A method whose docstring states the project's
+    "caller holds the lock" contract is treated as lock-held — the
+    check enforces that the convention is WRITTEN DOWN, which is what
+    a reviewer needs.
+  * lock-discipline (b): no blocking call (``time.sleep``, an RPC via a
+    client-manager ``.call(...)``, ``os.fsync``) lexically inside a
+    ``with <lock>`` block.  Condition/Event ``.wait()`` is NOT flagged —
+    a Condition wait releases the lock.
+  * lock-order: nested ``with`` acquisitions (plus one level of
+    same-class call propagation) build a rank graph; cycles are
+    reported.  Ranks are ``Class.attr``; a cross-class receiver like
+    ``peer.lock`` resolves via the unique-attribute-name heuristic
+    (only one class declares an attr named ``lock``).
+
+The runtime counterpart of lock-order is common/ordered_lock.py.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import PackageContext, Violation, dotted
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "OrderedLock"}
+_BLOCKING_CALLS = {"time.sleep", "os.fsync"}
+# receivers whose .call(...) is an RPC round trip
+_RPC_RECEIVERS = {"cm", "client_manager"}
+_MUTATORS = {"append", "extend", "add", "update", "pop", "clear",
+             "insert", "setdefault", "discard"}
+# docstring contract: "caller holds the lock" (raft_part._commit_to,
+# runtime._publish, ...) — the method runs under its class lock by
+# convention, and the convention being written down is the requirement
+_CALLER_HOLDS = re.compile(r"caller[s]?\s+hold[s]?\s+(the\s+)?\S*lock",
+                           re.IGNORECASE)
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    d = dotted(call.func)
+    if d is None:
+        return False
+    return d.rsplit(".", 1)[-1] in _LOCK_CTORS
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef, rel: str):
+        self.name = node.name
+        self.node = node
+        self.rel = rel
+        self.locks: Set[str] = set()          # declared lock attr names
+        self.lock_getters: Set[str] = set()   # methods returning a lock
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+def _collect_classes(ctx: PackageContext) -> List[_ClassInfo]:
+    out: List[_ClassInfo] = []
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(node, mod.rel)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.methods[item.name] = item
+                    if "lock" in item.name.lower():
+                        info.lock_getters.add(item.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            info.locks.add(tgt.attr)
+            out.append(info)
+    return out
+
+
+def _attr_owner_map(classes: List[_ClassInfo]) -> Dict[str, str]:
+    """lock attr name -> 'Class.attr' when exactly one class declares
+    it (resolves cross-class receivers like ``peer.lock``)."""
+    owners: Dict[str, List[str]] = {}
+    for info in classes:
+        for lk in info.locks:
+            owners.setdefault(lk, []).append(f"{info.name}.{lk}")
+    return {attr: lst[0] for attr, lst in owners.items() if len(lst) == 1}
+
+
+def _with_lock_ranks(stmt: ast.With, info: Optional[_ClassInfo],
+                     attr_owner: Dict[str, str]) -> List[str]:
+    """Ranks acquired by a ``with`` statement ('Class.attr'), [] when it
+    is not a lock acquisition."""
+    ranks: List[str] = []
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            # with self._build_lock(space): — lock-getter method
+            cd = dotted(expr.func)
+            if cd and cd.startswith("self.") and info is not None:
+                m = cd.split(".", 1)[1]
+                if m in info.lock_getters:
+                    ranks.append(f"{info.name}.{m}")
+            continue
+        d = dotted(expr)
+        if d is None:
+            continue
+        parts = d.split(".")
+        if len(parts) < 2:
+            continue
+        recv, attr = parts[0], parts[-1]
+        if recv == "self" and info is not None and attr in info.locks:
+            ranks.append(f"{info.name}.{attr}")
+        elif recv != "self" and attr in attr_owner:
+            ranks.append(attr_owner[attr])
+    return ranks
+
+
+# ------------------------------------------------------------ entry points
+def _thread_entry_names(ctx: PackageContext) -> Set[str]:
+    """Names handed to Thread(target=...) or executor .submit(...)."""
+    names: Set[str] = set()
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            leaf = d.rsplit(".", 1)[-1] if d else ""
+            cands: List[ast.AST] = []
+            if leaf == "Thread":
+                cands += [kw.value for kw in node.keywords
+                          if kw.arg == "target"]
+            elif leaf in ("submit", "run_in_executor", "start_new_thread"):
+                cands += node.args[:1]
+            for c in cands:
+                cd = dotted(c)
+                if cd:
+                    names.add(cd.rsplit(".", 1)[-1])
+    return names
+
+
+def _is_blocking(call: ast.Call) -> Optional[str]:
+    d = dotted(call.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    if d in _BLOCKING_CALLS or leaf == "sleep":
+        return d or leaf
+    if leaf == "call":
+        parts = d.split(".")
+        if len(parts) >= 2 and parts[-2] in _RPC_RECEIVERS:
+            return d
+    return None
+
+
+def _self_mut_attr(node: ast.AST) -> Optional[Tuple[str, int]]:
+    """(attr, line) when node mutates ``self.<attr>`` state."""
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) and t.value.id == "self":
+                return t.attr, node.lineno
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Attribute) \
+                    and isinstance(t.value.value, ast.Name) \
+                    and t.value.value.id == "self":
+                return t.value.attr, node.lineno
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS \
+                and isinstance(f.value, ast.Attribute) \
+                and isinstance(f.value.value, ast.Name) \
+                and f.value.value.id == "self":
+            return f.value.attr, node.lineno
+    return None
+
+
+def _init_only_attrs(info: _ClassInfo) -> Set[str]:
+    """Attrs assigned ONLY in __init__/start — configuration wired
+    before any worker thread exists, not shared mutable state."""
+    per_method: Dict[str, Set[str]] = {}
+    for mname, mnode in info.methods.items():
+        attrs: Set[str] = set()
+        for sub in ast.walk(mnode):
+            hit = _self_mut_attr(sub)
+            if hit:
+                attrs.add(hit[0])
+        per_method[mname] = attrs
+    ctor = per_method.get("__init__", set()) | per_method.get("start", set())
+    elsewhere: Set[str] = set()
+    for mname, attrs in per_method.items():
+        if mname not in ("__init__", "start"):
+            elsewhere |= attrs
+    return ctor - elsewhere
+
+
+# ================================================================ check 1
+class _DisciplineScan(ast.NodeVisitor):
+    """One method: track lexical lock scope; flag unguarded self.*
+    mutations (entry points only) and blocking calls under a lock."""
+
+    def __init__(self, mod, info: _ClassInfo, mname: str, attr_owner,
+                 check_mutations: bool, config_attrs: Set[str]):
+        self.mod = mod
+        self.info = info
+        self.mname = mname
+        self.attr_owner = attr_owner
+        self.check_mutations = check_mutations
+        self.config_attrs = config_attrs
+        self.held: List[str] = []
+        self.out: List[Violation] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        ranks = _with_lock_ranks(node, self.info, self.attr_owner)
+        self.held += ranks
+        for stmt in node.body:
+            self.visit(stmt)
+        if ranks:
+            del self.held[-len(ranks):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested def body runs later, on its own stack, NOT under the
+        # current with — but mutations inside it still belong to this
+        # entry point's thread family, so keep mutation checking on
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.held = self.held, []
+        self.visit(node.body)
+        self.held = saved
+
+    def _flag_mutation(self, attr: str, line: int) -> None:
+        if not self.check_mutations or self.held:
+            return
+        if attr in self.info.locks or attr in self.config_attrs:
+            return
+        self.out.append(Violation(
+            "lock-discipline", self.mod.rel, line,
+            f"{self.info.name}.{self.mname}",
+            f"self.{attr} mutated from thread entry point "
+            f"{self.mname!r} without holding a declared lock "
+            f"({', '.join(sorted(self.info.locks))})"))
+
+    def _generic(self, node: ast.AST) -> None:
+        hit = _self_mut_attr(node)
+        if hit:
+            self._flag_mutation(*hit)
+        self.generic_visit(node)
+
+    visit_Assign = _generic
+    visit_AugAssign = _generic
+
+    def visit_Call(self, node: ast.Call) -> None:
+        hit = _self_mut_attr(node)
+        if hit:
+            self._flag_mutation(*hit)
+        if self.held:
+            b = _is_blocking(node)
+            if b:
+                self.out.append(Violation(
+                    "lock-discipline", self.mod.rel, node.lineno,
+                    f"{self.info.name}.{self.mname}",
+                    f"blocking call {b} while holding "
+                    f"{'/'.join(self.held)} — RPC/sleep/disk I/O must "
+                    f"not run under a lock"))
+        self.generic_visit(node)
+
+
+def _entry_closure(ctx: PackageContext, classes: List[_ClassInfo],
+                   thread_targets: Set[str]) -> Dict[int, Set[str]]:
+    """Per class (keyed by id(info)): methods reachable from a thread
+    entry point.  Seeds are Thread/submit targets and RPC/raft handlers
+    (``process_*``/``rpc_*``); the closure follows ``self.m()`` calls
+    within a class and, across classes, ``x.m()`` calls where the
+    method name uniquely belongs to ONE lock-declaring class (the
+    singleton pattern: ``stats.add_value`` resolves to StatsManager)."""
+    locked = [c for c in classes if c.locks]
+    method_owner: Dict[str, List[_ClassInfo]] = {}
+    for info in locked:
+        for m in info.methods:
+            method_owner.setdefault(m, []).append(info)
+
+    entries: Dict[int, Set[str]] = {id(c): set() for c in classes}
+    work: List[Tuple[Optional[_ClassInfo], ast.AST]] = []
+    for info in classes:
+        for m, node in info.methods.items():
+            if m in thread_targets or m.startswith(("process_", "rpc_")):
+                if m not in entries[id(info)]:
+                    entries[id(info)].add(m)
+                    work.append((info, node))
+    # module-level thread targets (free functions)
+    for mod in ctx.modules:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in thread_targets:
+                work.append((None, node))
+
+    while work:
+        info, fn = work.pop()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if not d or "." not in d:
+                continue
+            root, leaf = d.split(".")[0], d.rsplit(".", 1)[-1]
+            targets: List[_ClassInfo] = []
+            if root == "self" and info is not None and leaf in info.methods:
+                targets.append(info)
+            elif root != "self":
+                owners = method_owner.get(leaf, [])
+                if len(owners) == 1:
+                    targets.append(owners[0])
+            for t in targets:
+                if leaf not in entries[id(t)]:
+                    entries[id(t)].add(leaf)
+                    work.append((t, t.methods[leaf]))
+    return entries
+
+
+def check_lock_discipline(ctx: PackageContext) -> List[Violation]:
+    classes = _collect_classes(ctx)
+    attr_owner = _attr_owner_map(classes)
+    thread_targets = _thread_entry_names(ctx)
+    entries = _entry_closure(ctx, classes, thread_targets)
+    by_rel: Dict[str, List[_ClassInfo]] = {}
+    for info in classes:
+        by_rel.setdefault(info.rel, []).append(info)
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        for info in by_rel.get(mod.rel, []):
+            if not info.locks:
+                continue
+            config_attrs = _init_only_attrs(info)
+            for mname, mnode in sorted(info.methods.items()):
+                doc = ast.get_docstring(mnode) or ""
+                caller_holds = bool(_CALLER_HOLDS.search(doc))
+                scan = _DisciplineScan(
+                    mod, info, mname, attr_owner,
+                    check_mutations=(mname in entries[id(info)]
+                                     and not caller_holds),
+                    config_attrs=config_attrs)
+                for stmt in mnode.body:
+                    scan.visit(stmt)
+                out += scan.out
+    return out
+
+
+# ================================================================ check 2
+def check_lock_order(ctx: PackageContext) -> List[Violation]:
+    classes = _collect_classes(ctx)
+    attr_owner = _attr_owner_map(classes)
+    # which ranks does each (class, method) acquire anywhere in its body?
+    method_acquires: Dict[Tuple[str, str], Set[str]] = {}
+    for info in classes:
+        for mname, mnode in info.methods.items():
+            acq: Set[str] = set()
+            for sub in ast.walk(mnode):
+                if isinstance(sub, ast.With):
+                    acq |= set(_with_lock_ranks(sub, info, attr_owner))
+            method_acquires[(info.name, mname)] = acq
+
+    edges: Dict[str, Dict[str, Tuple[str, int, str]]] = {}
+
+    def add_edge(a: str, b: str, rel: str, line: int, sym: str) -> None:
+        if a == b:
+            return               # same-rank nesting: see ordered_lock.py
+        edges.setdefault(a, {}).setdefault(b, (rel, line, sym))
+
+    class OrderScan(ast.NodeVisitor):
+        def __init__(self, mod, info, sym):
+            self.mod = mod
+            self.info = info
+            self.sym = sym
+            self.held: List[str] = []
+
+        def visit_With(self, node: ast.With) -> None:
+            ranks = _with_lock_ranks(node, self.info, attr_owner)
+            for r in ranks:
+                for h in self.held:
+                    add_edge(h, r, self.mod.rel, node.lineno, self.sym)
+            self.held += ranks
+            for stmt in node.body:
+                self.visit(stmt)
+            if ranks:
+                del self.held[-len(ranks):]
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            saved, self.held = self.held, []
+            self.generic_visit(node)
+            self.held = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call) -> None:
+            # one level of same-class call propagation
+            if self.held:
+                d = dotted(node.func) or ""
+                if d.startswith("self.") and d.count(".") == 1:
+                    callee = d.split(".", 1)[1]
+                    for r in method_acquires.get(
+                            (self.info.name, callee), ()):
+                        for h in self.held:
+                            add_edge(h, r, self.mod.rel, node.lineno,
+                                     self.sym)
+            self.generic_visit(node)
+
+    by_rel: Dict[str, List[_ClassInfo]] = {}
+    for info in classes:
+        by_rel.setdefault(info.rel, []).append(info)
+    for mod in ctx.modules:
+        for info in by_rel.get(mod.rel, []):
+            for mname, mnode in info.methods.items():
+                OrderScan(mod, info, f"{info.name}.{mname}").visit(mnode)
+
+    out: List[Violation] = []
+    reported: Set[frozenset] = set()
+    for start in sorted(edges):
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start:
+                    cyc = frozenset(path)
+                    if cyc in reported:
+                        continue
+                    reported.add(cyc)
+                    rel, line, sym = edges[node][start]
+                    out.append(Violation(
+                        "lock-order", rel, line, sym,
+                        "static lock-order cycle: "
+                        + " -> ".join(path + [start])))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return out

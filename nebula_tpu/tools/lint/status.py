@@ -1,0 +1,201 @@
+"""status-discard — the MUST_USE_RESULT analogue for Status/StatusOr.
+
+Two passes:
+
+  1. Whole-package return-type inference: a function "returns status"
+     when its return annotation names Status/StatusOr, or any ``return``
+     value is a ``Status``/``StatusOr`` construction/classmethod, or a
+     call to another status-returning callable (fixpoint over call-by-
+     name, a few iterations).
+  2. Flag every expression-statement call (``ast.Expr(Call)`` — the
+     result is discarded) whose callee resolves to a status-returning
+     function.  Attribute calls resolve by METHOD NAME and are flagged
+     only when EVERY definition of that name in the package returns
+     status — a name shared with a non-status function (``dict.get``
+     style ambiguity) is skipped rather than guessed.
+
+False-positive control for the name-based resolution:
+
+  * calls through an imported MODULE (``os.remove``) are never package
+    methods — each file's plain ``import m`` / ``import m as a`` roots
+    are excluded;
+  * method names that collide with builtin container/str methods
+    (``remove``, ``get``, ``update``, ``error``...) are flagged only on
+    ``self.*`` receivers, where the package-type assumption is sound; a
+    plain local variable (``queue.remove(x)``) is almost always a list.
+
+This intentionally has no notion of "handled": assigning to ``_`` still
+counts as using the result; to deliberately drop a Status use an inline
+``# nebulint: disable=status-discard`` with a justification, exactly
+like the reference's rare ``(void)`` casts under MUST_USE_RESULT.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import PackageContext, Violation, dotted, qualname_map
+
+_STATUS_TYPES = {"Status", "StatusOr"}
+# names shared with builtin containers / stdlib objects: only trust a
+# self.* receiver for these
+_AMBIGUOUS = {"remove", "get", "set", "add", "pop", "clear", "update",
+              "insert", "discard", "append", "extend", "error", "count",
+              "index", "copy", "close", "flush", "write", "open", "send"}
+
+
+def _ann_is_status(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name) and node.id in _STATUS_TYPES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _STATUS_TYPES:
+            return True
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and any(t in node.value for t in _STATUS_TYPES):
+            return True
+    return False
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    """Leaf name of the callee: 'm' for both m(...) and a.b.m(...)."""
+    d = dotted(call.func)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _direct_status_value(node: ast.AST) -> bool:
+    """Is this return value literally a Status/StatusOr?"""
+    if isinstance(node, ast.Call):
+        d = dotted(node.func) or ""
+        parts = d.split(".")
+        # Status(...), Status.OK(), StatusOr.of(...), x.Error(...) etc.
+        if parts[0] in _STATUS_TYPES:
+            return True
+    return False
+
+
+class _FnInfo:
+    __slots__ = ("qual", "name", "rel", "returns_status", "ret_calls")
+
+    def __init__(self, qual: str, name: str, rel: str):
+        self.qual = qual
+        self.name = name
+        self.rel = rel
+        self.returns_status = False
+        self.ret_calls: Set[str] = set()   # leaf names of returned calls
+
+
+def _collect_functions(ctx: PackageContext) -> Dict[str, List[_FnInfo]]:
+    """leaf function name -> all definitions in the package."""
+    by_name: Dict[str, List[_FnInfo]] = {}
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+        for node, qual in qmap.items():
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = _FnInfo(f"{mod.rel}:{qual}", node.name, mod.rel)
+            if _ann_is_status(node.returns):
+                info.returns_status = True
+            for ret in _own_returns(node):
+                if ret.value is None:
+                    continue
+                if _direct_status_value(ret.value):
+                    info.returns_status = True
+                elif isinstance(ret.value, ast.Call):
+                    leaf = _call_name(ret.value)
+                    if leaf:
+                        info.ret_calls.add(leaf)
+            by_name.setdefault(node.name, []).append(info)
+    return by_name
+
+
+def _own_returns(fn: ast.AST) -> List[ast.Return]:
+    """Return statements belonging to ``fn`` itself (not nested defs)."""
+    out: List[ast.Return] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Return):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _status_names(by_name: Dict[str, List[_FnInfo]]) -> Set[str]:
+    """Fixpoint: leaf names where EVERY definition returns status."""
+    for _ in range(4):
+        changed = False
+        for defs in by_name.values():
+            for fn in defs:
+                if fn.returns_status:
+                    continue
+                for callee in fn.ret_calls:
+                    cdefs = by_name.get(callee)
+                    if cdefs and all(c.returns_status for c in cdefs):
+                        fn.returns_status = True
+                        changed = True
+                        break
+        if not changed:
+            break
+    return {name for name, defs in by_name.items()
+            if defs and all(d.returns_status for d in defs)}
+
+
+def _module_roots(tree: ast.AST) -> Set[str]:
+    """Names bound to modules in this file (``import os`` -> 'os',
+    ``import jax.numpy as jnp`` -> 'jnp')."""
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                roots.add(alias.asname or alias.name.split(".")[0])
+    return roots
+
+
+def _flaggable(call: ast.Call, mod_roots: Set[str]) -> Optional[str]:
+    """Leaf name when this discarded call should be checked."""
+    leaf = _call_name(call)
+    if leaf is None:
+        return None
+    d = dotted(call.func) or leaf
+    parts = d.split(".")
+    if len(parts) == 1:
+        return leaf                       # plain function call
+    root = parts[0]
+    if root in mod_roots:
+        return None                       # stdlib/third-party module call
+    if root != "self" and leaf in _AMBIGUOUS:
+        return None                       # local var: probably a builtin
+    return leaf
+
+
+def check_status_discard(ctx: PackageContext) -> List[Violation]:
+    by_name = _collect_functions(ctx)
+    status_names = _status_names(by_name)
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+        mod_roots = _module_roots(mod.tree)
+
+        # walk with a symbol stack so violations carry Class.method
+        def walk(node: ast.AST, sym: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_sym = qmap.get(child, sym)
+                if isinstance(child, ast.Expr) \
+                        and isinstance(child.value, ast.Call):
+                    leaf = _flaggable(child.value, mod_roots)
+                    if leaf in status_names:
+                        out.append(Violation(
+                            "status-discard", mod.rel, child.lineno,
+                            child_sym,
+                            f"result of {leaf}() (returns "
+                            f"Status/StatusOr) is discarded — check "
+                            f".ok() or propagate it (MUST_USE_RESULT)"))
+                walk(child, child_sym)
+
+        walk(mod.tree, "<module>")
+    return out

@@ -20,8 +20,13 @@ def ensure_perf_space(meta_client):
     r = meta_client.create_space("perf", partition_num=6)
     if r.ok():
         sid = r.value()
-        meta_client.create_tag_schema(sid, "item", schema_to_wire(ITEM))
-        meta_client.create_edge_schema(sid, "rel", schema_to_wire(REL))
+        for s in (meta_client.create_tag_schema(sid, "item",
+                                                schema_to_wire(ITEM)),
+                  meta_client.create_edge_schema(sid, "rel",
+                                                 schema_to_wire(REL))):
+            if not s.ok():
+                raise RuntimeError(f"perf fixture schema DDL failed: "
+                                   f"{s.status}")
     else:
         sid = meta_client.get_space_id_by_name("perf").value()
     meta_client.load_data()

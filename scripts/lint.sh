@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Static-analysis + sanitizer gate (docs/static_analysis.md):
+#   1. nebulint — the five project-invariant AST checks over nebula_tpu
+#      (lock discipline, lock-order cycles, Status discipline, JAX
+#      hot-path hygiene, flag registry consistency);
+#   2. asan_driver — the native C ABI driven under the ASan+UBSan build,
+#      when `make -C native asan` has produced the instrumented .so and
+#      libasan is present (skipped, loudly, otherwise).
+# Exit status is non-zero when either gate fails.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== nebulint (static analysis) =="
+python -m nebula_tpu.tools.lint
+
+if [ -f native/libnebula_native_asan.so ]; then
+  libasan="$(gcc -print-file-name=libasan.so 2>/dev/null || true)"
+  if [ -n "${libasan}" ] && [ -f "${libasan}" ]; then
+    echo "== asan_driver (native ABI under ASan+UBSan) =="
+    tmp="$(mktemp -d)"
+    trap 'rm -rf "${tmp}"' EXIT
+    LD_PRELOAD="${libasan}" \
+      NEBULA_NATIVE_SO="${PWD}/native/libnebula_native_asan.so" \
+      JAX_PLATFORMS=cpu \
+      ASAN_OPTIONS="strict_init_order=true:detect_stack_use_after_return=true:detect_container_overflow=true:detect_leaks=0" \
+      UBSAN_OPTIONS="halt_on_error=1:print_stacktrace=1" \
+      python tests/asan_driver.py "${tmp}"
+  else
+    echo "== asan_driver skipped (no libasan on this toolchain) =="
+  fi
+else
+  echo "== asan_driver skipped (run 'make -C native asan' first) =="
+fi
+
+echo "lint.sh: all gates green"

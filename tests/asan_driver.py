@@ -45,9 +45,28 @@ def main(tmp_dir: str) -> None:
         elif roll < 0.8:
             e.remove_prefix(k[:2])
             m.remove_prefix(k[:2])
+        elif roll < 0.85:
+            # bulk ABI: neb_multi_put / neb_multi_remove
+            kvs = [(rng.choice(keys),
+                    bytes(rng.getrandbits(8)
+                          for _ in range(rng.randrange(0, 32))))
+                   for _ in range(rng.randrange(1, 8))]
+            e.multi_put(kvs)
+            m.multi_put(kvs)
+        elif roll < 0.9:
+            doomed = [rng.choice(keys) for _ in range(rng.randrange(1, 5))]
+            e.multi_remove(doomed)
+            m.multi_remove(doomed)
+        elif roll < 0.95:
+            a, b = sorted((rng.choice(keys), rng.choice(keys)))
+            e.remove_range(a, b)
+            m.remove_range(a, b)
         else:
             assert e.get(k) == m.get(k)
     assert list(e.prefix(b"")) == list(m.prefix(b""))
+    # range scan + key count over the ABI (neb_scan_range/neb_total_keys)
+    assert list(e.range(b"k10", b"k30")) == list(m.range(b"k10", b"k30"))
+    assert e.total_keys() == sum(1 for _ in m.prefix(b""))
     snap = os.path.join(tmp_dir, "snap")
     e.flush(snap)
     e2 = NativeEngine()

@@ -44,3 +44,35 @@ try:
     ensure_built()
 except Exception:    # noqa: BLE001 — tests fall back to the Python paths
     pass
+
+import pytest  # noqa: E402
+
+# The multi-daemon suites exercise the real 19-thread mesh — run them
+# under the lock-order watchdog (common/ordered_lock.py, the runtime
+# half of nebulint's static lock-order check) and fail the test if the
+# observed acquisition graph ever contains a cycle.
+# (test_raftex.py is excluded: its adaptive-pipelining tests assert
+# sub-millisecond replication RTTs that per-acquire bookkeeping skews)
+_WATCHDOG_FILES = ("test_chaos.py", "test_cluster_replicated.py",
+                   "test_metad_replicated.py")
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_watchdog(request):
+    fspath = getattr(request.node, "fspath", None)
+    if fspath is None or os.path.basename(str(fspath)) not in _WATCHDOG_FILES:
+        yield
+        return
+    from nebula_tpu.common.ordered_lock import watchdog
+    was_enabled = watchdog.enabled   # NEBULA_LOCK_WATCHDOG=1 session?
+    watchdog.enable()
+    try:
+        yield
+        violations = watchdog.drain()
+        assert not violations, (
+            "lock-order inversions observed:\n" + "\n".join(violations))
+    finally:
+        # restore rather than unconditionally disable: an env-var
+        # session-wide enable must survive past the first fixture use
+        if not was_enabled:
+            watchdog.disable()

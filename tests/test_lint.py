@@ -1,0 +1,431 @@
+"""nebulint self-tests: each of the five checks must fire on a minimal
+fixture snippet, honor inline suppression, and the whole-package run is
+the tier-1 gate (zero unsuppressed violations).  Also the runtime half:
+the OrderedLock watchdog must detect a deliberately seeded inversion.
+
+Run just these: ``pytest -m lint``.
+"""
+import os
+import textwrap
+import threading
+
+import pytest
+
+from nebula_tpu.tools.lint import (ALL_CHECKS, Baseline, LintError,
+                                   lint_paths, run_lint)
+from nebula_tpu.tools.lint.core import DEFAULT_BASELINE
+
+pytestmark = pytest.mark.lint
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "nebula_tpu")
+
+
+def run_fixture(tmp_path, files, checks=None):
+    """Write {relpath: source} under a fake package root and lint it."""
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint_paths(str(root), checks=checks, repo_root=str(tmp_path))
+
+
+def names(violations):
+    return [v.check for v in violations]
+
+
+# ================================================== 1 · lock-discipline
+_UNGUARDED = """
+    import threading
+
+    class Daemon:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def process_put(self, req):
+            self.count = self.count + 1
+"""
+
+
+def test_lock_discipline_unguarded_mutation(tmp_path):
+    vs = run_fixture(tmp_path, {"daemon.py": _UNGUARDED},
+                     checks=["lock-discipline"])
+    assert names(vs) == ["lock-discipline"]
+    assert "self.count" in vs[0].message
+
+
+def test_lock_discipline_guarded_is_clean(tmp_path):
+    ok = _UNGUARDED.replace(
+        "            self.count = self.count + 1",
+        "            with self._lock:\n"
+        "                self.count = self.count + 1")
+    assert run_fixture(tmp_path, {"daemon.py": ok},
+                       checks=["lock-discipline"]) == []
+
+
+def test_lock_discipline_caller_holds_contract(tmp_path):
+    ok = _UNGUARDED.replace(
+        "        def process_put(self, req):",
+        "        def process_put(self, req):\n"
+        '            """Caller holds the lock."""')
+    assert run_fixture(tmp_path, {"daemon.py": ok},
+                       checks=["lock-discipline"]) == []
+
+
+def test_lock_discipline_blocking_call_under_lock(tmp_path):
+    vs = run_fixture(tmp_path, {"daemon.py": """
+        import threading
+        import time
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1)
+    """}, checks=["lock-discipline"])
+    assert names(vs) == ["lock-discipline"]
+    assert "blocking call" in vs[0].message
+
+
+def test_lock_discipline_inline_suppression(tmp_path):
+    sup = _UNGUARDED.replace(
+        "            self.count = self.count + 1",
+        "            self.count = self.count + 1  "
+        "# nebulint: disable=lock-discipline")
+    assert run_fixture(tmp_path, {"daemon.py": sup},
+                       checks=["lock-discipline"]) == []
+
+
+# ===================================================== 2 · lock-order
+_CYCLE = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self.la = threading.Lock()
+            self.lb = threading.Lock()
+
+        def one(self):
+            with self.la:
+                with self.lb:
+                    pass
+
+        def two(self):
+            with self.lb:
+                with self.la:
+                    pass
+"""
+
+
+def test_lock_order_cycle(tmp_path):
+    vs = run_fixture(tmp_path, {"pair.py": _CYCLE}, checks=["lock-order"])
+    assert names(vs) == ["lock-order"]
+    assert "Pair.la" in vs[0].message and "Pair.lb" in vs[0].message
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    ok = _CYCLE.replace(
+        "            with self.lb:\n                with self.la:",
+        "            with self.la:\n                with self.lb:")
+    assert run_fixture(tmp_path, {"pair.py": ok},
+                       checks=["lock-order"]) == []
+
+
+def test_lock_order_file_suppression(tmp_path):
+    sup = "# nebulint: disable-file=lock-order\n" + textwrap.dedent(_CYCLE)
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "pair.py").write_text(sup)
+    assert lint_paths(str(root), checks=["lock-order"],
+                      repo_root=str(tmp_path)) == []
+
+
+# ================================================== 3 · status-discard
+_DISCARD = """
+    from common.status import Status
+
+    def save() -> Status:
+        return Status.OK()
+
+    def caller():
+        save()
+"""
+
+
+def test_status_discard(tmp_path):
+    vs = run_fixture(tmp_path, {"mod.py": _DISCARD},
+                     checks=["status-discard"])
+    assert names(vs) == ["status-discard"]
+    assert "save" in vs[0].message
+
+
+def test_status_used_is_clean(tmp_path):
+    ok = _DISCARD.replace("    save()", "    st = save()\n    return st")
+    assert run_fixture(tmp_path, {"mod.py": ok},
+                       checks=["status-discard"]) == []
+
+
+def test_status_discard_suppression(tmp_path):
+    sup = _DISCARD.replace(
+        "    save()", "    save()  # nebulint: disable=status-discard")
+    assert run_fixture(tmp_path, {"mod.py": sup},
+                       checks=["status-discard"]) == []
+
+
+def test_status_fixpoint_through_wrappers(tmp_path):
+    """A function returning another status-returning function's result
+    is itself status-returning (the MUST_USE_RESULT fixpoint)."""
+    vs = run_fixture(tmp_path, {"mod.py": """
+        def inner():
+            return Status.OK()
+
+        def outer():
+            return inner()
+
+        def caller():
+            outer()
+    """}, checks=["status-discard"])
+    assert [v.symbol for v in vs] == ["caller"]
+
+
+# ==================================================== 4 · jax-hotpath
+def test_hotpath_jit_in_loop(tmp_path):
+    vs = run_fixture(tmp_path, {"tpu/runtime.py": """
+        import jax
+
+        def traverse(frontiers):
+            for f in frontiers:
+                step = jax.jit(lambda x: x)
+                f = step(f)
+    """}, checks=["jax-hotpath"])
+    assert names(vs) == ["jax-hotpath"]
+    assert "loop" in vs[0].message
+
+
+def test_hotpath_host_sync_on_device_value(tmp_path):
+    vs = run_fixture(tmp_path, {"tpu/kernels.py": """
+        def drain(frontier_dev):
+            total = 0
+            while total < 10:
+                total += int(frontier_dev)
+            return total
+    """}, checks=["jax-hotpath"])
+    assert names(vs) == ["jax-hotpath"]
+    assert "frontier_dev" in vs[0].message
+
+
+def test_hotpath_outside_hot_files_ignored(tmp_path):
+    assert run_fixture(tmp_path, {"graph/parser/x.py": """
+        import jax
+
+        def setup(items):
+            for i in items:
+                f = jax.jit(lambda x: x)
+    """}, checks=["jax-hotpath"]) == []
+
+
+def test_hotpath_jit_outside_loop_is_clean(tmp_path):
+    assert run_fixture(tmp_path, {"tpu/runtime.py": """
+        import jax
+
+        step = jax.jit(lambda x: x)
+
+        def traverse(frontiers):
+            for f in frontiers:
+                f = step(f)
+    """}, checks=["jax-hotpath"]) == []
+
+
+# ================================================== 5 · flag-registry
+def test_flag_registry_missing_define(tmp_path):
+    vs = run_fixture(tmp_path, {"mod.py": """
+        from common.flags import flags
+
+        def f():
+            return flags.get("never_defined_anywhere")
+    """}, checks=["flag-registry"])
+    assert names(vs) == ["flag-registry"]
+    assert "never_defined_anywhere" in vs[0].message
+
+
+def test_flag_registry_dead_define(tmp_path):
+    vs = run_fixture(tmp_path, {"flagdefs.py": """
+        from common.flags import flags
+
+        flags.define("dead_knob", 1, "never read")
+    """}, checks=["flag-registry"])
+    assert names(vs) == ["flag-registry"]
+    assert "dead_knob" in vs[0].message
+
+
+def test_flag_registry_defined_and_read_is_clean(tmp_path):
+    assert run_fixture(tmp_path, {"flagdefs.py": """
+        from common.flags import flags
+
+        flags.define("live_knob", 1, "read below")
+
+        def f():
+            return flags.get("live_knob")
+    """}, checks=["flag-registry"]) == []
+
+
+# ====================================================== baseline rules
+def test_baseline_entry_requires_reason():
+    with pytest.raises(LintError):
+        Baseline([{"check": "status-discard", "file": "x.py",
+                   "symbol": "f", "reason": "  "}])
+
+
+def test_baseline_matches_and_reports_stale(tmp_path):
+    vs = run_fixture(tmp_path, {"mod.py": _DISCARD},
+                     checks=["status-discard"])
+    bl = Baseline([
+        {"check": "status-discard", "file": "pkg/mod.py",
+         "symbol": "caller", "reason": "fixture"},
+        {"check": "status-discard", "file": "pkg/gone.py",
+         "symbol": "f", "reason": "stale entry"},
+    ])
+    assert [v for v in vs if not bl.match(v)] == []
+    assert [e["file"] for e in bl.unused()] == ["pkg/gone.py"]
+
+
+# ============================================== whole-package tier-1 gate
+def test_package_is_clean():
+    """THE gate: nebulint over nebula_tpu reports zero unsuppressed
+    violations (suppressions and baseline entries each carry a reason)."""
+    vs, _bl = run_lint(PKG_ROOT, baseline_path=DEFAULT_BASELINE)
+    assert vs == [], "unsuppressed nebulint violations:\n" + "\n".join(
+        repr(v) for v in vs)
+
+
+def test_package_has_no_stale_baseline_entries():
+    vs, bl = run_lint(PKG_ROOT, baseline_path=DEFAULT_BASELINE)
+    if bl is not None:
+        stale = bl.unused()
+        assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_all_checks_registered():
+    assert set(ALL_CHECKS) == {"lock-discipline", "lock-order",
+                               "status-discard", "jax-hotpath",
+                               "flag-registry"}
+
+
+# ========================================== OrderedLock runtime watchdog
+def test_watchdog_detects_seeded_inversion():
+    """The mini-TSan self-test demanded by the acceptance criteria: two
+    threads acquiring two ranks in opposite orders — even without losing
+    the race — must produce a recorded inversion."""
+    from nebula_tpu.common.ordered_lock import OrderedLock, watchdog
+    a = OrderedLock("selftest.A")
+    b = OrderedLock("selftest.B")
+    watchdog.enable()
+    try:
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        for fn in (ab, ba):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        violations = watchdog.drain()
+    finally:
+        watchdog.disable()
+    assert violations, "seeded inversion went undetected"
+    assert "selftest.A" in violations[0] and "selftest.B" in violations[0]
+
+
+def test_watchdog_consistent_order_is_clean():
+    from nebula_tpu.common.ordered_lock import OrderedLock, watchdog
+    a = OrderedLock("clean.A")
+    b = OrderedLock("clean.B")
+    watchdog.enable()
+    try:
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        violations = watchdog.drain()
+    finally:
+        watchdog.disable()
+    assert violations == []
+
+
+def test_watchdog_strict_raises():
+    from nebula_tpu.common.ordered_lock import (LockOrderError, OrderedLock,
+                                                watchdog)
+    a = OrderedLock("strict.A")
+    b = OrderedLock("strict.B")
+    watchdog.enable(strict=True)
+    try:
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderError):
+            with b:
+                with a:
+                    pass
+    finally:
+        watchdog.drain()
+        watchdog.disable()
+
+
+def test_ordered_lock_works_with_condition():
+    """raftex wraps its part lock in a Condition — the OrderedLock must
+    support wait/notify (full reentrant unwind mirrored in the
+    watchdog's held stack)."""
+    from nebula_tpu.common.ordered_lock import OrderedLock, watchdog
+    lk = OrderedLock("cond.part", reentrant=True)
+    cond = threading.Condition(lk)
+    state = {"ready": False}
+    watchdog.enable()
+    try:
+        def producer():
+            with cond:
+                state["ready"] = True
+                cond.notify_all()
+
+        t = threading.Thread(target=producer)
+        with cond:
+            with lk:   # reentrant: wait() must unwind BOTH levels
+                t.start()
+                assert cond.wait_for(lambda: state["ready"], timeout=5)
+        t.join()
+        assert watchdog.drain() == []
+    finally:
+        watchdog.disable()
+
+
+def test_hotpath_mutable_static_args_flagged(tmp_path):
+    vs = run_fixture(tmp_path, {"tpu/runtime.py": """
+        import jax
+
+        f = jax.jit(lambda x: x, static_argnums=[0])
+    """}, checks=["jax-hotpath"])
+    assert names(vs) == ["jax-hotpath"]
+
+
+def test_hotpath_mutable_literal_in_other_kwarg_not_flagged(tmp_path):
+    """Only the static_arg* value itself may trip the mutable-literal
+    rule — a list in donate_argnums/in_shardings must not."""
+    assert run_fixture(tmp_path, {"tpu/runtime.py": """
+        import jax
+
+        f = jax.jit(lambda x: x, static_argnums=(0,), donate_argnums=[1])
+    """}, checks=["jax-hotpath"]) == []
+
+
+def test_missing_explicit_baseline_is_config_error(tmp_path):
+    with pytest.raises(LintError):
+        run_lint(PKG_ROOT, baseline_path=str(tmp_path / "typo.json"))

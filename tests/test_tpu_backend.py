@@ -899,3 +899,41 @@ class TestUptoDevice:
                     if cur else set()
                 acc |= cur
             assert got.get(q, set()) == acc, (q, got.get(q), acc)
+
+
+class TestRetraceBudget:
+    """Runtime half of nebulint's jax-hotpath check: a repeated
+    multi-hop traversal over the same space must not grow the jit
+    trace cache (or the runtime's kernel memo) after warmup.  Growth
+    here is the cache-buster class — jit construction per call,
+    unhashable static args, closures over mutables — that silently
+    turns every hop into a fresh XLA trace."""
+
+    QUERY = f"GO 3 STEPS FROM {TIM} OVER follow YIELD follow._dst"
+
+    def _snapshot(self, rt):
+        with rt._lock:
+            kernels = dict(rt._kernels)
+        sizes = {}
+        for key, kern in kernels.items():
+            cs = getattr(kern, "_cache_size", None)
+            sizes[key] = cs() if callable(cs) else -1
+        return sizes
+
+    def test_jit_cache_stable_after_warmup(self, clusters):
+        _cpu_c, _cpu, tpu_c, tpu = clusters
+        rt = tpu_c.tpu_runtime
+        for _ in range(2):       # warmup: mirror + kernel builds + traces
+            assert tpu.execute(self.QUERY).ok()
+        before = self._snapshot(rt)
+        builds_before = rt.stats["mirror_builds"]
+        for _ in range(5):
+            assert tpu.execute(self.QUERY).ok()
+        after = self._snapshot(rt)
+        assert set(after) == set(before), (
+            f"kernel memo grew after warmup: {set(after) ^ set(before)}")
+        grown = {k: (before[k], after[k]) for k in before
+                 if after[k] != before[k]}
+        assert not grown, f"jit trace cache grew after warmup: {grown}"
+        assert rt.stats["mirror_builds"] == builds_before, \
+            "repeat traversal rebuilt the mirror"
