@@ -62,6 +62,13 @@ class ExecutionResponse:
         """Degradation notes attached by graphd (partial results)."""
         return self.raw.get("warnings", [])
 
+    @property
+    def profile(self) -> Optional[dict]:
+        """Span tree attached by a PROFILE-prefixed statement:
+        {"trace_id": hex, "roots": [{name, duration_us, tags,
+        children}, ...]} — see docs/observability.md."""
+        return self.raw.get("profile")
+
     def ok(self) -> bool:
         return self.error_code == ErrorCode.SUCCEEDED
 

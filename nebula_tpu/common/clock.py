@@ -36,6 +36,12 @@ def now_micros() -> int:
     return time.time_ns() // 1000 + int(_test_offset_s * 1_000_000)
 
 
+def test_offset_micros() -> int:
+    """Current fake-clock skew in microseconds — tracing spans fold it
+    into their durations so `advance_for_tests` ages them too."""
+    return int(_test_offset_s * 1_000_000)
+
+
 def inverted_version(micros: int | None = None) -> int:
     """int64max - now_us — latest version sorts first (AddVerticesProcessor.cpp:30)."""
     return INT64_MAX - (now_micros() if micros is None else micros)

@@ -22,6 +22,17 @@ _RING = 3600
 _MAX_SAMPLES_PER_BUCKET = 256
 
 
+def _percentile_sorted(vals: List[float], q: float) -> float:
+    """Linear-interpolated quantile over an already-sorted sample list
+    (shared by read_stats pNN and dump's p95/p99 columns)."""
+    pos = q * (len(vals) - 1)
+    i = int(pos)
+    frac = pos - i
+    if i + 1 < len(vals):
+        return vals[i] * (1 - frac) + vals[i + 1] * frac
+    return vals[i]
+
+
 class _Stat:
     __slots__ = ("lock", "sums", "counts", "samples", "stamps")
 
@@ -109,13 +120,8 @@ class StatsManager:
             if not vals:
                 return 0.0
             vals.sort()
-            q = min(int(method[1:]), 100) / 100.0
-            pos = q * (len(vals) - 1)
-            i = int(pos)
-            frac = pos - i
-            if i + 1 < len(vals):
-                return vals[i] * (1 - frac) + vals[i + 1] * frac
-            return vals[i]
+            return _percentile_sorted(vals,
+                                      min(int(method[1:]), 100) / 100.0)
         return None
 
     def dump(self, now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
@@ -124,12 +130,17 @@ class StatsManager:
         with self._lock:
             snapshot = dict(self._stats)
         for name, stat in snapshot.items():
-            total, count, _ = stat.window(60, now)
+            total, count, vals = stat.window(60, now)
+            vals.sort()
             out[name] = {
                 "sum.60": total,
                 "count.60": float(count),
                 "avg.60": total / count if count else 0.0,
                 "rate.60": total / 60.0,
+                # tail latency from the per-bucket sample reservoirs —
+                # the avg alone hid p99 regressions on /get_stats
+                "p95.60": _percentile_sorted(vals, 0.95) if vals else 0.0,
+                "p99.60": _percentile_sorted(vals, 0.99) if vals else 0.0,
             }
         return out
 

@@ -1,0 +1,407 @@
+"""nebulatrace — process-wide span tracer with cross-RPC propagation.
+
+The reference has aggregate StatsManager counters but nothing that
+attributes ONE slow query to parse vs RPC fan-out vs device kernels
+(SURVEY.md §5.5 scaffolds the counters and stops there).  This module
+is the Dapper-shaped half: a query (or any root operation) opens a
+trace; every instrumented seam underneath — RPC client/server hops
+(interface/rpc.py frame envelope), executor runs (graph/service.py),
+storage/meta retry passes, TPU runtime phases (tpu/runtime.py) — adds
+child spans that share the trace id across thread and process
+boundaries.
+
+Design constraints, in order:
+
+1. **Disabled must be free.**  With ``trace_sample_rate=0`` and no
+   PROFILE in flight the hot path is one thread-local read returning
+   ``None`` — no allocation, no branch into this module's classes
+   (tests/test_tracing.py pins this with tracemalloc on
+   ``RpcChannel.call``).
+2. **Propagation is explicit.**  Context rides a thread-local; crossing
+   a thread pool uses ``capture()``/``attach_captured()`` and crossing
+   a process uses the RPC frame envelope ``[method, payload,
+   [trace_id, span_id]]`` with finished spans returned piggybacked on
+   the response — the client absorbs them, so graphd assembles the
+   whole tree without a second collection RPC.
+3. **Names are a closed set.**  Every span name is a literal dotted
+   string from ``SPAN_NAMES`` below; ``nebula_tpu/tools/lint``'s
+   span-registry check enforces it (same contract as the flag
+   registry), so dashboards and tests can rely on exact names.
+
+Timing: spans use clock.Duration (monotonic) plus the fake-clock test
+offset (clock.advance_for_tests), so tracing tests are deterministic
+without sleeping.
+"""
+from __future__ import annotations
+
+import random
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from .clock import Duration, now_micros, test_offset_micros
+from .flags import flags
+from .ordered_lock import OrderedLock
+
+flags.define("trace_sample_rate", 0.0,
+             "fraction of root operations (queries) traced when not "
+             "explicitly PROFILEd; 0 disables background sampling")
+flags.define("trace_buffer_size", 256,
+             "recent traces kept in the in-process ring buffer served "
+             "by the /traces web endpoint")
+flags.define("slow_query_threshold_ms", 0,
+             "statements slower than this land in the slow-query log "
+             "(/traces?slow=1) with their trace id when sampled; "
+             "0 disables")
+
+# The single span-name registry (lint: span-registry).  Add here FIRST,
+# then use the literal at the call site.
+SPAN_NAMES = (
+    "graph.query",            # root: one statement through the engine
+    "graph.parse",            # GQLParser.parse
+    "graph.executor",         # one executor run (tags: executor, rows)
+    "rpc.client",             # outbound RPC (tags: method, peer)
+    "rpc.server",             # inbound RPC dispatch (tags: method)
+    "storage.collect.pass",   # one scatter-gather retry pass
+    "meta.call.pass",         # one meta whole-peer-set retry pass
+    "tpu.mirror.build",       # full CSR/ELL mirror rebuild
+    "tpu.mirror.delta",       # incremental overlay absorb
+    "tpu.transfer",           # host→device mirror upload
+    "tpu.jit.compile",        # kernel cache miss → XLA build/compile
+    "tpu.kernel",             # device kernel dispatch (async launch)
+    "tpu.launch",             # batch leader: frontier launch half
+    "tpu.fetch",              # device→host result gather
+    "tpu.assemble",           # host row materialization
+    "rpc.fault",              # zero-duration marker: injected fault
+)
+
+_tls = threading.local()          # .ctx = (trace_id, span_id, True)
+_rng = random.Random()            # ids; independent of seeded test RNGs
+
+
+class _Noop:
+    """Shared disabled-path context manager: ``with span(...) as s``
+    yields None and allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+def current_context() -> Optional[Tuple[int, int, bool]]:
+    """(trace_id, span_id, sampled) of the calling thread, or None.
+    Presence implies sampled — unsampled operations never set context."""
+    return getattr(_tls, "ctx", None)
+
+
+class Span:
+    """One timed operation.  Context-manager protocol; while entered it
+    becomes the thread's current context so nested spans parent to it."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "tags",
+                 "start_us", "duration_us", "_dur", "_off0", "_prev")
+
+    def __init__(self, name: str, trace_id: int, parent_id: Optional[int],
+                 tags: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.span_id = _rng.getrandbits(63)
+        self.tags = tags
+        self.start_us = 0
+        self.duration_us = 0
+
+    def tag(self, **tags) -> "Span":
+        self.tags.update(tags)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = (self.trace_id, self.span_id, True)
+        if self.parent_id is None:
+            trace_store.pin(self.trace_id)
+        self._off0 = test_offset_micros()
+        self.start_us = now_micros()
+        self._dur = Duration()
+        return self
+
+    def __exit__(self, et, ev, tb) -> bool:
+        # fake-clock aware: advance_for_tests() moves the duration too,
+        # so tracing tests assert exact-ish timings without sleeping
+        self.duration_us = self._dur.elapsed_in_usec() + \
+            (test_offset_micros() - self._off0)
+        _tls.ctx = self._prev
+        if et is not None:
+            self.tags["error"] = f"{et.__name__}: {ev}"
+        _record(self.to_wire())
+        if self.parent_id is None:
+            # root closed: the trace is complete and becomes evictable
+            trace_store.unpin(self.trace_id)
+        return False
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start_us": self.start_us,
+                "duration_us": self.duration_us, "tags": self.tags}
+
+
+def span(name: str, **tags):
+    """Child span under the current context, or the shared no-op when
+    the thread isn't tracing.  ``name`` must be a SPAN_NAMES literal."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return _NOOP
+    return Span(name, ctx[0], ctx[1], tags)
+
+
+def start_trace(name: str, forced: bool = False, **tags):
+    """Root span: samples per trace_sample_rate unless ``forced``
+    (PROFILE).  Returns the root Span or the no-op."""
+    if not forced:
+        rate = flags.get("trace_sample_rate", 0.0)
+        if not rate or _rng.random() >= float(rate):
+            return _NOOP
+    return Span(name, _rng.getrandbits(63), None, tags)
+
+
+class _Attach:
+    """Install a (context, sink) pair on the calling thread for a
+    with-block — the cross-thread / server-side adoption primitive."""
+
+    __slots__ = ("_ctx", "_sink", "_prev")
+
+    def __init__(self, ctx, sink=None):
+        self._ctx = ctx
+        self._sink = sink
+
+    def __enter__(self):
+        self._prev = (getattr(_tls, "ctx", None),
+                      getattr(_tls, "sink", None))
+        _tls.ctx = self._ctx
+        _tls.sink = self._sink
+        return self
+
+    def __exit__(self, *exc):
+        _tls.ctx, _tls.sink = self._prev
+        return False
+
+
+def attach(ctx, sink=None):
+    """Adopt a propagated context (server dispatch, pool worker)."""
+    return _Attach(ctx, sink)
+
+
+def capture():
+    """Snapshot the calling thread's trace state for handoff into a
+    worker thread; None when not tracing (then attach_captured is the
+    free no-op)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return None
+    return (ctx, getattr(_tls, "sink", None))
+
+
+def attach_captured(cap):
+    if cap is None:
+        return _NOOP
+    return _Attach(cap[0], cap[1])
+
+
+# ------------------------------------------------------------ storage
+class TraceStore:
+    """Ring buffer of recent traces (trace_buffer_size), each a flat
+    span list deduped by span id; /traces serves it as JSON."""
+
+    def __init__(self):
+        self._lock = OrderedLock("tracing.store")
+        self._traces: "OrderedDict[int, List[dict]]" = OrderedDict()
+        self._seen: Dict[int, set] = {}
+        self._pinned: set = set()   # in-flight rooted traces: no evict
+
+    def pin(self, trace_id: int) -> None:
+        """Shield an in-flight trace from ring eviction (the root Span
+        pins on enter, unpins on exit): a slow PROFILE under ring
+        pressure must not come back gutted of its early spans."""
+        with self._lock:
+            self._pinned.add(trace_id)
+
+    def unpin(self, trace_id: int) -> None:
+        with self._lock:
+            self._pinned.discard(trace_id)
+
+    def record(self, wire: Dict[str, Any]) -> None:
+        cap = int(flags.get("trace_buffer_size", 256) or 256)
+        tid = wire["trace_id"]
+        with self._lock:
+            spans = self._traces.get(tid)
+            if spans is None:
+                spans = self._traces[tid] = []
+                self._seen[tid] = set()
+                while len(self._traces) > cap:
+                    # oldest UNPINNED trace goes — never the entry just
+                    # created for THIS span (evicting it would KeyError
+                    # below); pinned (in-flight) traces may transiently
+                    # push the ring over cap, bounded by the number of
+                    # concurrent roots
+                    victim = next((t for t in self._traces
+                                   if t not in self._pinned
+                                   and t != tid), None)
+                    if victim is None:
+                        break
+                    del self._traces[victim]
+                    self._seen.pop(victim, None)
+            if wire["span_id"] in self._seen[tid]:
+                return           # envelope echo of a span already local
+            self._seen[tid].add(wire["span_id"])
+            spans.append(wire)
+
+    def absorb(self, spans: List[dict]) -> None:
+        """Fold spans returned in an RPC response envelope into the
+        local store (they carry their own trace/span ids)."""
+        for s in spans:
+            if isinstance(s, dict) and "trace_id" in s \
+                    and "span_id" in s:
+                self.record(s)
+
+    def spans(self, trace_id: int) -> List[dict]:
+        with self._lock:
+            return list(self._traces.get(trace_id, ()))
+
+    def summaries(self) -> List[dict]:
+        """Newest-first trace summaries for the /traces listing."""
+        with self._lock:
+            items = list(self._traces.items())
+        out = []
+        for tid, spans in reversed(items):
+            if not spans:
+                continue
+            roots = [s for s in spans if s.get("parent_id") is None]
+            head = roots[0] if roots else \
+                min(spans, key=lambda s: s.get("start_us", 0))
+            out.append({"id": f"{tid:016x}", "name": head["name"],
+                        "start_us": head.get("start_us", 0),
+                        "duration_us": head.get("duration_us", 0),
+                        "spans": len(spans)})
+        return out
+
+    def tree(self, trace_id: int) -> Optional[dict]:
+        """Nested span tree {id, name, duration_us, tags, children}.
+        Spans whose parent is missing (other process, evicted) hang off
+        the synthetic root list."""
+        spans = self.spans(trace_id)
+        if not spans:
+            return None
+        nodes = {}
+        for s in spans:
+            nodes[s["span_id"]] = {
+                "span_id": f"{s['span_id']:016x}", "name": s["name"],
+                "start_us": s.get("start_us", 0),
+                "duration_us": s.get("duration_us", 0),
+                "tags": s.get("tags") or {}, "children": []}
+        orphans = []
+        for s in spans:
+            node = nodes[s["span_id"]]
+            parent = s.get("parent_id")
+            if parent is not None and parent in nodes:
+                nodes[parent]["children"].append(node)
+            else:
+                orphans.append(node)
+        for n in nodes.values():
+            n["children"].sort(key=lambda c: c["start_us"])
+        orphans.sort(key=lambda c: c["start_us"])
+        return {"trace_id": f"{trace_id:016x}", "roots": orphans}
+
+    def discard(self, trace_id: int) -> None:
+        """Drop one trace (a force-started trace whose statement turned
+        out not to be a PROFILE — it would only evict real traces)."""
+        with self._lock:
+            self._traces.pop(trace_id, None)
+            self._seen.pop(trace_id, None)
+            self._pinned.discard(trace_id)
+
+    def clear_for_tests(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._seen.clear()
+            self._pinned.clear()
+
+
+class SlowQueryLog:
+    """Bounded ring of statements over slow_query_threshold_ms."""
+
+    _CAP = 128
+    # credential-bearing statements (CREATE USER ... WITH PASSWORD "x",
+    # CHANGE PASSWORD u FROM "old" TO "new") must not leak plaintext to
+    # the unauthenticated /traces?slow=1 endpoint — any statement
+    # mentioning PASSWORD gets EVERY string literal masked (the
+    # literals sit after WITH/FROM/TO, so masking only the one adjacent
+    # to the keyword would miss them; reference DBs mask slow logs the
+    # same way)
+    _PASSWORD_KW = re.compile(r"(?i)\bpassword\b")
+    _STRING_RE = re.compile(r"\"(?:\\.|[^\"\\])*\"|'(?:\\.|[^'\\])*'")
+
+    def __init__(self):
+        self._lock = OrderedLock("tracing.slowlog")
+        self._entries: List[dict] = []
+
+    _MAX_STMT = 4096
+
+    def record(self, stmt: str, latency_us: int,
+               trace_id: Optional[int]) -> None:
+        if self._PASSWORD_KW.search(stmt):
+            stmt = self._STRING_RE.sub('"***"', stmt)
+        if len(stmt) > self._MAX_STMT:
+            # slow statements are often huge INSERT bodies — the ring
+            # bounds entry COUNT; this bounds entry SIZE (reference DBs
+            # truncate slow-log statements the same way)
+            stmt = stmt[:self._MAX_STMT] + f"... [{len(stmt)} chars]"
+        entry = {"stmt": stmt, "latency_us": int(latency_us),
+                 "time_us": now_micros(),
+                 "trace_id": (f"{trace_id:016x}"
+                              if trace_id is not None else None)}
+        with self._lock:
+            self._entries.append(entry)
+            if len(self._entries) > self._CAP:
+                del self._entries[:len(self._entries) - self._CAP]
+
+    def dump(self) -> List[dict]:
+        with self._lock:
+            return list(reversed(self._entries))
+
+    def clear_for_tests(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+trace_store = TraceStore()
+slow_log = SlowQueryLog()
+
+
+def _record(wire: Dict[str, Any]) -> None:
+    trace_store.record(wire)
+    sink = getattr(_tls, "sink", None)
+    if sink is not None:
+        sink.append(wire)
+
+
+def annotate(name: str, **tags) -> None:
+    """Best-effort tag drop on the thread's ACTIVE span context — used
+    by layers that don't own a span object (fault injection).  The tags
+    land on a zero-duration marker child so the enclosing span's tree
+    shows them without mutating a span owned by another frame.
+    ``name`` must be a SPAN_NAMES literal (lint: span-registry)."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        return
+    s = Span(name, ctx[0], ctx[1], tags)
+    s.start_us = now_micros()
+    _record(s.to_wire())

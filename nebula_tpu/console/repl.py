@@ -27,7 +27,7 @@ KEYWORDS = [
     "ALL", "MATCH", "SET", "ADD", "REMOVE", "BALANCE", "DATA", "LEADER",
     "CONFIGS", "GET", "USER", "USERS", "GRANT", "REVOKE", "ROLE", "TO",
     "CHANGE", "PASSWORD", "WITH", "TTL_COL", "TTL_DURATION", "INGEST",
-    "DOWNLOAD", "HDFS", "PIPE", "VARIABLES",
+    "DOWNLOAD", "HDFS", "PIPE", "VARIABLES", "PROFILE", "EXPLAIN",
 ]
 
 
@@ -62,6 +62,25 @@ def _fmt(v) -> str:
     if isinstance(v, float):
         return f"{v:.6g}"
     return str(v)
+
+
+def render_profile(tree: dict) -> str:
+    """Indented span tree for a PROFILE statement (CmdProcessor-style
+    plain text): one line per span — name, duration, selected tags."""
+    lines = [f"PROFILE (trace {tree.get('trace_id', '?')})"]
+
+    def walk(node: dict, depth: int) -> None:
+        tags = node.get("tags") or {}
+        tag_str = " ".join(f"{k}={_fmt(v)}" for k, v in sorted(tags.items()))
+        lines.append(f"{'  ' * depth}+ {node['name']} "
+                     f"{node.get('duration_us', 0)}us"
+                     + (f"  [{tag_str}]" if tag_str else ""))
+        for child in node.get("children", ()):
+            walk(child, depth + 1)
+
+    for root in tree.get("roots", ()):
+        walk(root, 1)
+    return "\n".join(lines)
 
 
 class Console:
@@ -102,6 +121,8 @@ class Console:
             if stmt.upper().startswith("USE "):
                 self.space = stmt.split(None, 1)[1].rstrip(";")
             print(render_table(resp), file=out)
+            if resp.profile:
+                print(render_profile(resp.profile), file=out)
         else:
             print(f"[ERROR ({int(resp.error_code)})]: {resp.error_msg}",
                   file=out)

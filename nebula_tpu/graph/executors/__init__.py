@@ -2,6 +2,7 @@
 Executor::makeExecutor, Executor.cpp:48-150)."""
 from __future__ import annotations
 
+from ...common import tracing
 from ..parser import ast
 from .base import Executor, ExecError
 from . import admin, mutate, traverse
@@ -59,3 +60,22 @@ def make_executor(sentence: ast.Sentence, ectx) -> Executor:
     if cls is None:
         raise ExecError(f"statement {sentence.kind.value} not supported")
     return cls(sentence, ectx)
+
+
+def traced_execute(executor: Executor, ectx):
+    """Run one executor under a graph.executor span tagged with the
+    rows flowing in (the piped/variable input it consumes) and out —
+    shared by the engine's sentence loop and the executors that run
+    sub-executors (PipeExecutor, AssignmentExecutor), so pipe halves
+    show up as their own spans with truthful row counts.  Free when the
+    thread isn't tracing."""
+    if tracing.current_context() is None:
+        return executor.execute()
+    rows_in = len(ectx.input) if ectx.input is not None else 0
+    with tracing.span("graph.executor",
+                      executor=type(executor).__name__) as es:
+        out = executor.execute()
+        es.tag(rows_in=rows_in,
+               rows_out=(len(out.rows) if out is not None
+                         and out.rows is not None else 0))
+    return out

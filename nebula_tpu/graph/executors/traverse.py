@@ -913,10 +913,12 @@ class SetExecutor(Executor):
     NAME = "SetExecutor"
 
     def execute(self) -> InterimResult:
-        from . import make_executor
+        from . import make_executor, traced_execute
         s: ast.SetSentence = self.sentence
-        left = make_executor(s.left, self.ectx).execute()
-        right = make_executor(s.right, self.ectx).execute()
+        left = traced_execute(make_executor(s.left, self.ectx),
+                              self.ectx)
+        right = traced_execute(make_executor(s.right, self.ectx),
+                               self.ectx)
         left = left or InterimResult([])
         right = right or InterimResult([])
         if left.columns and right.columns and \
@@ -943,13 +945,19 @@ class PipeExecutor(Executor):
     NAME = "PipeExecutor"
 
     def execute(self) -> Optional[InterimResult]:
-        from . import make_executor
+        # both halves run via traced_execute so a PROFILE of a piped
+        # statement shows each side as its own span with the real
+        # rows_in it consumed (the left half may itself be fed by an
+        # enclosing pipe's input)
+        from . import make_executor, traced_execute
         s: ast.PipedSentence = self.sentence
-        left = make_executor(s.left, self.ectx).execute()
+        left = traced_execute(make_executor(s.left, self.ectx),
+                              self.ectx)
         saved = self.ectx.input
         self.ectx.input = left if left is not None else InterimResult([])
         try:
-            return make_executor(s.right, self.ectx).execute()
+            return traced_execute(make_executor(s.right, self.ectx),
+                                  self.ectx)
         finally:
             self.ectx.input = saved
 
@@ -958,9 +966,10 @@ class AssignmentExecutor(Executor):
     NAME = "AssignmentExecutor"
 
     def execute(self) -> None:
-        from . import make_executor
+        from . import make_executor, traced_execute
         s: ast.AssignmentSentence = self.sentence
-        result = make_executor(s.sentence, self.ectx).execute()
+        result = traced_execute(make_executor(s.sentence, self.ectx),
+                                self.ectx)
         self.ectx.variables.add(s.var, result or InterimResult([]))
         return None
 

@@ -557,3 +557,8 @@ class RevokeSentence(Sentence):
 @dataclass
 class SequentialSentences:
     sentences: List[Sentence] = field(default_factory=list)
+    # leading PROFILE / EXPLAIN prefix (reference parser.yy explain
+    # parity): PROFILE executes and attaches the span tree to the
+    # response; EXPLAIN returns the executor plan without executing
+    profile: bool = False
+    explain: bool = False

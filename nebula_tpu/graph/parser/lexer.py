@@ -43,10 +43,19 @@ KEYWORDS = {
     "graph", "meta", "storage", "uuid", "or", "and", "xor", "no",
     "overwrite", "vertices", "in", "out", "both",
 }
+# NOTE: PROFILE/EXPLAIN are deliberately NOT keywords — reserving them
+# broke bare identifiers named profile/explain in expression position
+# (ORDER BY profile).  The parser special-cases the two words only as
+# the very first token of a statement list (parser.py parse_sentences),
+# where no valid statement can start with a bare identifier.
+
+# comment alternation, shared with the engine's PROFILE-prefix sniff
+# (graph/service.py) so the two grammars cannot drift
+COMMENT_RE = r"--[^\n]*|\#[^\n]*|//[^\n]*|/\*(?:[^*]|\*(?!/))*\*/"
 
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
-  | (?P<comment>--[^\n]*|\#[^\n]*|//[^\n]*|/\*(?:[^*]|\*(?!/))*\*/)
+  | (?P<comment>""" + COMMENT_RE + r""")
   | (?P<badcomment>/\*)
   | (?P<ipv4>\d+\.\d+\.\d+\.\d+)
   | (?P<float>\d+\.\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?|\d+[eE][-+]?\d+)

@@ -115,6 +115,25 @@ class _Parser:
     # ---- entry ------------------------------------------------------
     def parse_sentences(self) -> ast.SequentialSentences:
         out = ast.SequentialSentences()
+        # optional leading PROFILE/EXPLAIN prefix applies to the whole
+        # statement list (PROFILE only makes sense at position 0: the
+        # trace covers the full engine pass).  The two words are NOT
+        # lexer keywords (that reserved them out of expression position
+        # — `ORDER BY profile` must keep parsing); they lex as plain
+        # IDs and are special-cased here only as the very first token,
+        # where no valid statement can start with a bare identifier.
+        # Any following token starts the wrapped statement — keywords,
+        # `$var =` assignments, `(` groups; a lone `PROFILE` falls
+        # through to the normal error path.
+        t = self.peek()
+        if t.type == "ID" and isinstance(t.value, str) \
+                and t.value.lower() in ("profile", "explain") \
+                and self.peek(1).type != "EOF":
+            self.next()
+            if t.value.lower() == "profile":
+                out.profile = True
+            else:
+                out.explain = True
         while True:
             while self.accept_sym(";"):
                 pass

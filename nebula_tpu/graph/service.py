@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import itertools
 import random
+import re
 import threading
 from typing import Dict, Optional
 
+from ..common import tracing
 from ..common.clock import Duration
 from ..common.flags import flags
 from ..common.stats import stats
@@ -23,10 +25,11 @@ from ..meta.client import MetaClient
 from ..meta.schema_manager import SchemaManager
 from ..storage.client import StorageClient
 from .context import ClientSession, ExecutionContext
-from .executors import make_executor
+from .executors import make_executor, traced_execute
 from .executors.base import ExecError
 from .interim import ColumnarRows, InterimResult
 from .parser import GQLParser
+from .parser.lexer import COMMENT_RE as LEX_COMMENT_RE
 from .parser.parser import ParseError
 
 
@@ -138,28 +141,96 @@ class ExecutionEngine:
             cls._KIND_STATS_REGISTERED.add(kind)
         return name
 
+    # one whitespace run OR one comment (the lexer's grammar); each
+    # match() is COMMITTED before the next, so the prefix scan below is
+    # strictly linear and can never backtrack into a comment body the
+    # way a single (?:ws|comment)*PROFILE regex does (which would both
+    # blow up on indented statements and false-match the word PROFILE
+    # INSIDE a leading comment)
+    _WS_OR_COMMENT_RE = re.compile(r"\s+|" + LEX_COMMENT_RE)
+
+    @classmethod
+    def _sniff_profile(cls, text: str) -> bool:
+        """Is the first real token the PROFILE keyword?  4 KB window:
+        a PROFILE buried past 4 KB of comments is not a real workload,
+        and an unmatched sniff just skips the tree, never errors."""
+        text = text[:4096]
+        pos, n = 0, len(text)
+        while pos < n:
+            m = cls._WS_OR_COMMENT_RE.match(text, pos)
+            if m is None or m.end() == pos:
+                break
+            pos = m.end()
+        if text[pos:pos + 7].upper() != "PROFILE":
+            return False
+        nxt = text[pos + 7:pos + 8]
+        return not (nxt.isalnum() or nxt == "_")
+
     def execute(self, session: ClientSession, text: str) -> dict:
         """-> ExecutionResponse dict (graph.thrift:89-96)."""
+        # PROFILE must trace from before the parse (the parse span
+        # belongs to the tree), so the prefix is sniffed textually
+        # here; the parser's SequentialSentences flag stays
+        # authoritative for the response shape, and a sniff false
+        # positive discards its trace below
+        forced = self._sniff_profile(text)
+        root = tracing.start_trace("graph.query", forced=forced)
+        trace_id = None
+        profiled = False
+        try:
+            with root as rs:
+                if rs is not None:
+                    trace_id = rs.trace_id
+                resp, profiled = self._execute_traced(session, text, rs)
+        finally:
+            if forced and not profiled and trace_id is not None:
+                # sniffed PROFILE but no tree will be read (parser
+                # disagreed, or an unexpected executor exception is
+                # propagating): a force-started trace nobody can fetch
+                # must not evict genuine traces from the ring buffer —
+                # and nothing below (slow log) may reference it either
+                tracing.trace_store.discard(trace_id)
+                trace_id = None
+        if profiled and trace_id is not None:
+            # root span just closed — the tree is complete now
+            resp["profile"] = tracing.trace_store.tree(trace_id)
+        threshold = flags.get("slow_query_threshold_ms", 0)
+        if threshold and resp.get("latency_in_us", 0) >= threshold * 1000:
+            stats.add_value("graph.slow_query.qps")
+            tracing.slow_log.record(text, resp["latency_in_us"], trace_id)
+        return resp
+
+    def _execute_traced(self, session: ClientSession, text: str,
+                        rs) -> tuple:
+        """Engine pass under the (possibly no-op) root span ``rs``.
+        Returns (response dict, profile-requested flag)."""
         dur = Duration()
         stats.add_value("graph.qps")
         resp = {"error_code": int(ErrorCode.SUCCEEDED)}
-        parsed = self.parser.parse(text)
+        with tracing.span("graph.parse"):
+            parsed = self.parser.parse(text)
         if not parsed.ok():
             stats.add_value("graph.error.qps")
             resp["error_code"] = int(ErrorCode.E_SYNTAX_ERROR)
             resp["error_msg"] = parsed.status.msg
             resp["latency_in_us"] = dur.elapsed_in_usec()
-            return resp
+            return resp, False
 
+        seq = parsed.value()
         ectx = ExecutionContext(session, self.meta, self.schema_man,
                                 self.storage, tpu_runtime=self.tpu_runtime,
                                 router=self.router)
+        if seq.explain:
+            resp["column_names"], resp["rows"] = \
+                self._explain_plan(seq, ectx)
+            resp["space_name"] = session.space_name
+            resp["latency_in_us"] = dur.elapsed_in_usec()
+            return resp, False
         result: Optional[InterimResult] = None
         try:
             # SequentialExecutor semantics: run each; last rowset wins
-            for sentence in parsed.value().sentences:
-                executor = make_executor(sentence, ectx)
-                out = executor.execute()
+            for sentence in seq.sentences:
+                out = traced_execute(make_executor(sentence, ectx), ectx)
                 ectx.input = None  # pipes manage their own input scoping
                 if out is not None:
                     result = out
@@ -186,12 +257,27 @@ class ExecutionEngine:
         stats.add_value("graph.latency_us", resp["latency_in_us"])
         # per-statement-kind histogram + error counter (first sentence
         # names a multi-statement input)
-        sentences = parsed.value().sentences
-        kind = type(sentences[0]).__name__ if sentences else "Empty"
+        kind = type(seq.sentences[0]).__name__ if seq.sentences else "Empty"
         stats.add_value(self._stmt_stat(kind), resp["latency_in_us"])
+        if rs is not None:
+            rs.tag(stmt_kind=kind)
         if resp["error_code"] != int(ErrorCode.SUCCEEDED):
             stats.add_value("graph.error.qps")
-        return resp
+        return resp, seq.profile
+
+    @staticmethod
+    def _explain_plan(seq, ectx) -> tuple:
+        """EXPLAIN: the executor plan without executing (the reference
+        gained EXPLAIN/PROFILE statements in later releases; the plan
+        here is the sequential executor chain)."""
+        rows = []
+        for i, sentence in enumerate(seq.sentences):
+            try:
+                name = type(make_executor(sentence, ectx)).__name__
+            except ExecError as e:
+                name = f"<unsupported: {e}>"
+            rows.append([i, type(sentence).__name__, name])
+        return ["step", "sentence", "executor"], rows
 
 
 class GraphService:
@@ -206,6 +292,7 @@ class GraphService:
         stats.register_stats("graph.latency_us")
         stats.register_stats("graph.error.qps")
         stats.register_stats("graph.partial_result.qps")
+        stats.register_stats("graph.slow_query.qps")
 
     def rpc_authenticate(self, req: dict) -> dict:
         user = req.get("username", "")

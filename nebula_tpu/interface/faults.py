@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import tracing
 from ..common.flags import flags
 from ..common.stats import stats
 from ..common.status import ErrorCode
@@ -163,6 +164,12 @@ class FaultInjector:
         if rule is None:
             return None
         stats.add_value("rpc.fault.injected")
+        # chaos-run visibility (tests/test_chaos.py): WHICH faults a
+        # query absorbed, per method, plus a marker on the active trace
+        # span so a PROFILE of a degraded query shows the injection
+        stats.add_value(f"rpc.fault_injected.{method}")
+        tracing.annotate("rpc.fault", fault=rule.kind, method=method,
+                         host=host)
         if rule.delay_s > 0:
             time.sleep(rule.delay_s)      # outside the lock
         kind = rule.kind

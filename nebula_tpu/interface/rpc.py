@@ -25,12 +25,23 @@ from typing import Any, Callable, Dict, Optional
 
 import msgpack
 
+from ..common import tracing
 from ..common.status import ErrorCode, Status
 from .common import HostAddr
 from .faults import AFTER, default_injector
 
 _LEN = struct.Struct(">I")
 _MAX_FRAME = 1 << 30
+
+# Trace propagation (common/tracing.py): a traced caller sends
+# [method, payload, [trace_id, span_id]] instead of [method, payload];
+# the server adopts the context, runs the dispatch under an rpc.server
+# span, and returns {_TRACED: finished-spans, _RESP: response} so the
+# client can fold the server's spans into its own trace tree without a
+# second collection RPC.  Untraced calls keep the original 2-element
+# frame and bare response — zero overhead, wire-compatible.
+_TRACED = "__spans__"
+_RESP = "__resp__"
 
 
 class RpcError(Exception):
@@ -103,9 +114,16 @@ class RpcServer:
                     frame = _read_frame(sock)
                     if frame is None:
                         return
+                    wctx = None
                     try:
-                        method, payload = _unpack(frame)
-                        resp = outer.dispatch(method, payload)
+                        parts = _unpack(frame)
+                        method, payload = parts[0], parts[1]
+                        wctx = parts[2] if len(parts) > 2 else None
+                        if wctx is not None:
+                            resp = _dispatch_traced(outer.dispatch, method,
+                                                    payload, wctx)
+                        else:
+                            resp = outer.dispatch(method, payload)
                     except RpcError as e:
                         resp = {"__error__": int(e.status.code),
                                 "msg": e.status.msg}
@@ -138,6 +156,25 @@ class RpcServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+def _dispatch_traced(dispatch, method: str, payload: Any, wctx) -> Any:
+    """Server half of trace propagation: adopt the caller's context,
+    run the dispatch under an rpc.server span collecting every span the
+    handler produces on this thread (and pool threads that re-attach),
+    and wrap the response with the collected spans.  Errors are wrapped
+    too — the caller's trace must show the failing hop."""
+    sink: list = []
+    try:
+        with tracing.attach((int(wctx[0]), int(wctx[1]), True), sink):
+            with tracing.span("rpc.server", method=method):
+                resp = dispatch(method, payload)
+    except RpcError as e:
+        resp = {"__error__": int(e.status.code), "msg": e.status.msg}
+    except Exception as e:  # noqa: BLE001 — mirror the untraced handler
+        resp = {"__error__": int(ErrorCode.E_INTERNAL_ERROR),
+                "msg": f"{type(e).__name__}: {e}"}
+    return {_TRACED: sink, _RESP: resp}
 
 
 def _inject_fault(injector, addr, method: str):
@@ -203,7 +240,18 @@ class RpcChannel:
 
     def _call_wire(self, method: str, payload: Any,
                    timeout: Optional[float] = None) -> Any:
-        frame_out = _pack([method, payload])
+        ctx = tracing.current_context()
+        if ctx is None:
+            # tracing-disabled hot path: 2-element frame, no span, no
+            # allocation in the tracing module (overhead-guard test)
+            return self._wire_exchange(_pack([method, payload]), timeout)
+        with tracing.span("rpc.client", method=method,
+                          peer=str(self.addr)) as sp:
+            frame = _pack([method, payload, [sp.trace_id, sp.span_id]])
+            return self._wire_exchange(frame, timeout)
+
+    def _wire_exchange(self, frame_out: bytes,
+                       timeout: Optional[float] = None) -> Any:
         for attempt in (0, 1):
             pooled = False
             sock = None
@@ -265,6 +313,10 @@ class RpcChannel:
                         else ErrorCode.E_FAIL_TO_CONNECT)
                 raise RpcError(Status.Error(
                     f"rpc to {self.addr} failed: {e}", code)) from e
+        if isinstance(resp, dict) and _TRACED in resp:
+            # traced envelope: fold the server's spans into our trace
+            tracing.trace_store.absorb(resp.get(_TRACED) or [])
+            resp = resp.get(_RESP)
         if isinstance(resp, dict) and "__error__" in resp:
             raise RpcError(Status(ErrorCode(resp["__error__"]),
                                   resp.get("msg", "")))
@@ -295,6 +347,17 @@ class LoopbackChannel:
         if fn is None:
             raise RpcError(Status.Error(f"no method {method}",
                                         ErrorCode.E_UNSUPPORTED))
+        if tracing.current_context() is None:
+            return self._invoke(fn, payload)
+        # same client/server span pair the TCP path produces; spans land
+        # directly in the process-shared store (no envelope needed) and
+        # nest naturally because each span becomes the thread context
+        with tracing.span("rpc.client", method=method, peer="loopback"):
+            with tracing.span("rpc.server", method=method):
+                return self._invoke(fn, payload)
+
+    @staticmethod
+    def _invoke(fn, payload: Any) -> Any:
         try:
             return _unpack(_pack(fn(payload)))
         except RpcError:

@@ -14,6 +14,7 @@ import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..common import tracing
 from ..common.flags import flags
 from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
@@ -27,6 +28,16 @@ stats.register_stats("meta.client.backoff_ms")
 stats.register_stats("meta.client.retry_exhausted")
 stats.register_stats("meta.client.hint_chases")
 stats.register_stats("meta.client.heartbeat_failed")
+
+
+class _PassDeferred(Exception):
+    """One whole-peer retry pass ended with every metad answering a
+    failover-class error; carries the last such error for the final
+    retry-exhausted report."""
+
+    def __init__(self, cause: Optional["RpcError"]):
+        super().__init__(str(cause) if cause else "all peers deferred")
+        self.cause = cause
 
 
 class SpaceInfoCache:
@@ -96,6 +107,7 @@ class MetaClient:
                                   2000) / 1000.0
         max_chase = flags.get("meta_client_max_hint_chase", 3)
         for attempt in range(self._CALL_PASSES):
+            sleep_s = 0.0
             if attempt:
                 span = min(backoff_cap_s, backoff_s * (1 << (attempt - 1)))
                 sleep_s = span * (0.5 + 0.5 * random.random())  # jitter
@@ -104,58 +116,73 @@ class MetaClient:
                 self._stop.wait(sleep_s)
                 if self._stop.is_set():
                     break
-            # last known-good metad (the catalog leader) first; a
-            # follower's E_NOT_A_LEADER carries the leader hint in its
-            # message, which jumps the queue
-            queue = list(self.addrs)
-            with self._cache_lock:
-                good = self._good_addr
-            if good in queue:
-                queue.remove(good)
-                queue.insert(0, good)
-            tried = set()
-            chased = 0
-            while queue:
-                addr = queue.pop(0)
-                if addr in tried:
-                    continue
-                tried.add(addr)
-                try:
-                    resp = self.cm.call(addr, method, payload)
-                    with self._cache_lock:
-                        self._good_addr = addr
-                    return resp
-                except RpcError as e:
-                    # Fail over to another metad only when the request
-                    # provably never executed (connect failure) or this
-                    # peer isn't the leader. E_RPC_FAILURE means "may
-                    # have executed" — a resend could duplicate
-                    # non-idempotent DDL, so propagate.
-                    if e.status.code in (ErrorCode.E_FAIL_TO_CONNECT,
-                                         ErrorCode.E_LEADER_CHANGED,
-                                         ErrorCode.E_NOT_A_LEADER):
-                        last_exc = e
-                        if e.status.code == ErrorCode.E_NOT_A_LEADER \
-                                and e.status.msg:
-                            try:
-                                hint = HostAddr.parse(e.status.msg)
-                            except Exception:  # noqa: BLE001 — bad hint
-                                hint = None
-                            # bounded hint chase: peers bouncing hints at
-                            # each other (split-brain, stale views) must
-                            # not extend one pass unboundedly — after
-                            # max_chase hints the pass falls back to the
-                            # configured peer set and the next pass's
-                            # backoff gives the election time to settle
-                            if hint is not None and hint not in tried \
-                                    and chased < max_chase:
-                                chased += 1
-                                stats.add_value("meta.client.hint_chases")
-                                queue.insert(0, hint)
-                        continue
-                    raise
+            try:
+                with tracing.span("meta.call.pass", method=method,
+                                  attempt=attempt,
+                                  backoff_ms=round(sleep_s * 1000.0, 3)):
+                    return self._one_pass(method, payload, max_chase)
+            except _PassDeferred as d:
+                last_exc = d.cause      # failover-class only: next pass
         stats.add_value("meta.client.retry_exhausted")
         raise last_exc if last_exc else RpcError(Status.Error("no meta addrs"))
+
+    def _one_pass(self, method: str, payload: dict, max_chase: int):
+        """One whole-peer-set attempt.  Returns the response on
+        success; raises _PassDeferred when every peer answered with a
+        failover-class error (caller backs off and retries); any other
+        RpcError propagates immediately."""
+        # last known-good metad (the catalog leader) first; a
+        # follower's E_NOT_A_LEADER carries the leader hint in its
+        # message, which jumps the queue
+        queue = list(self.addrs)
+        with self._cache_lock:
+            good = self._good_addr
+        if good in queue:
+            queue.remove(good)
+            queue.insert(0, good)
+        tried = set()
+        chased = 0
+        deferred: Optional[RpcError] = None
+        while queue:
+            addr = queue.pop(0)
+            if addr in tried:
+                continue
+            tried.add(addr)
+            try:
+                resp = self.cm.call(addr, method, payload)
+                with self._cache_lock:
+                    self._good_addr = addr
+                return resp
+            except RpcError as e:
+                # Fail over to another metad only when the request
+                # provably never executed (connect failure) or this
+                # peer isn't the leader. E_RPC_FAILURE means "may
+                # have executed" — a resend could duplicate
+                # non-idempotent DDL, so propagate.
+                if e.status.code in (ErrorCode.E_FAIL_TO_CONNECT,
+                                     ErrorCode.E_LEADER_CHANGED,
+                                     ErrorCode.E_NOT_A_LEADER):
+                    deferred = e
+                    if e.status.code == ErrorCode.E_NOT_A_LEADER \
+                            and e.status.msg:
+                        try:
+                            hint = HostAddr.parse(e.status.msg)
+                        except Exception:  # noqa: BLE001 — bad hint
+                            hint = None
+                        # bounded hint chase: peers bouncing hints at
+                        # each other (split-brain, stale views) must
+                        # not extend one pass unboundedly — after
+                        # max_chase hints the pass falls back to the
+                        # configured peer set and the next pass's
+                        # backoff gives the election time to settle
+                        if hint is not None and hint not in tried \
+                                and chased < max_chase:
+                            chased += 1
+                            stats.add_value("meta.client.hint_chases")
+                            queue.insert(0, hint)
+                    continue
+                raise
+        raise _PassDeferred(deferred)
 
     def _call_status(self, method: str, payload: dict) -> StatusOr:
         try:

@@ -16,6 +16,7 @@ import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common import tracing
 from ..common.flags import flags
 from ..common.keys import id_hash
 from ..common.ordered_lock import OrderedLock
@@ -141,6 +142,7 @@ class StorageClient:
         for _attempt in range(retries + 1):
             if not pending:
                 break
+            sleep_s = 0.0
             if _attempt:
                 stats.add_value("storage.client.retry_attempts")
                 span = min(backoff_cap_s, backoff_s * (1 << (_attempt - 1)))
@@ -162,81 +164,92 @@ class StorageClient:
                 if pass_timeout <= 0:
                     stats.add_value("storage.client.deadline_exceeded")
                     break
-            by_host = {}
-            routing_failed = {}
-            for part, items in pending.items():
-                try:
-                    host = self._leader_for(space_id, part)
-                    by_host.setdefault(host, {})[part] = items
-                except RpcError as e:
-                    routing_failed[part] = e.status
-            futures = {}
-            for host, parts in by_host.items():
-                method, payload = make_req(parts)
-                futures[self.pool.submit(self._call_host, host, method,
-                                         payload, pass_timeout)] = (host,
-                                                                    parts)
-            next_pending: Dict[int, list] = {}
-            for fut, (host, parts) in futures.items():
-                status, result = fut.result()
-                if status.ok():
-                    failed_now = {int(p) for p in
-                                  (result.get("failed_parts") or {})}
-                    if any(p not in failed_now for p in parts):
-                        resp.responses.append(result)
-                    # else: the host led NONE of the addressed parts
-                    # (service.py _bulk short-circuit) — the reply is
-                    # only per-part hints, no data section, so merging
-                    # it would feed executors a schema-less response
-                    resp.max_latency_us = max(resp.max_latency_us,
-                                              result.get("latency_us", 0))
-                    # per-part failures (reference ResultCode list): the
-                    # host served the parts it leads and hinted the rest
-                    # — retry ONLY those, each with its own hint, so the
-                    # good parts' cache entries stay intact
-                    for part_s, info in (result.get("failed_parts")
-                                         or {}).items():
-                        part = int(part_s)
-                        if part not in parts:
-                            continue
-                        code = ErrorCode(int(info.get("code", 0)))
-                        if code == ErrorCode.E_LEADER_CHANGED \
-                                and info.get("leader"):
-                            self.update_leader(space_id, part,
-                                               info["leader"])
-                        else:
+            with tracing.span("storage.collect.pass", attempt=_attempt,
+                              backoff_ms=round(sleep_s * 1000.0, 3),
+                              parts=len(pending)):
+                # fan-out workers run on pool threads: hand them the
+                # trace context so their rpc.client spans parent here
+                tctx = tracing.capture()
+                by_host = {}
+                routing_failed = {}
+                for part, items in pending.items():
+                    try:
+                        host = self._leader_for(space_id, part)
+                        by_host.setdefault(host, {})[part] = items
+                    except RpcError as e:
+                        routing_failed[part] = e.status
+                futures = {}
+                for host, parts in by_host.items():
+                    method, payload = make_req(parts)
+                    futures[self.pool.submit(self._call_host, host, method,
+                                             payload, pass_timeout,
+                                             tctx)] = (host, parts)
+                next_pending: Dict[int, list] = {}
+                for fut, (host, parts) in futures.items():
+                    status, result = fut.result()
+                    if status.ok():
+                        failed_now = {int(p) for p in
+                                      (result.get("failed_parts") or {})}
+                        if any(p not in failed_now for p in parts):
+                            resp.responses.append(result)
+                        # else: the host led NONE of the addressed parts
+                        # (service.py _bulk short-circuit) — the reply is
+                        # only per-part hints, no data section, so merging
+                        # it would feed executors a schema-less response
+                        resp.max_latency_us = max(resp.max_latency_us,
+                                                  result.get("latency_us",
+                                                             0))
+                        # per-part failures (reference ResultCode list):
+                        # the host served the parts it leads and hinted
+                        # the rest — retry ONLY those, each with its own
+                        # hint, so the good parts' cache entries stay
+                        # intact
+                        for part_s, info in (result.get("failed_parts")
+                                             or {}).items():
+                            part = int(part_s)
+                            if part not in parts:
+                                continue
+                            code = ErrorCode(int(info.get("code", 0)))
+                            if code == ErrorCode.E_LEADER_CHANGED \
+                                    and info.get("leader"):
+                                self.update_leader(space_id, part,
+                                                   info["leader"])
+                            else:
+                                self.invalidate_leader(space_id, part)
+                            next_pending[part] = parts[part]
+                            last_status[part] = Status(code,
+                                                       info.get("leader",
+                                                                ""))
+                    elif status.code == ErrorCode.E_LEADER_CHANGED:
+                        for part in parts:
+                            if status.msg:  # leader hint
+                                self.update_leader(space_id, part,
+                                                   status.msg)
+                            else:
+                                self.invalidate_leader(space_id, part)
+                            next_pending[part] = parts[part]
+                            last_status[part] = status
+                    elif status.code in (ErrorCode.E_PART_NOT_FOUND,
+                                         ErrorCode.E_FAIL_TO_CONNECT):
+                        # stale leader cache (part moved by the balancer,
+                        # or host down before the request was sent — both
+                        # cases the op never executed, so resending is
+                        # safe): re-route from meta's current placement.
+                        # E_RPC_FAILURE is NOT retried: the server may
+                        # have executed the op (non-idempotent duplication
+                        # risk, same stance as the reference's
+                        # collectResponse).
+                        for part in parts:
                             self.invalidate_leader(space_id, part)
-                        next_pending[part] = parts[part]
-                        last_status[part] = Status(code,
-                                                   info.get("leader", ""))
-                elif status.code == ErrorCode.E_LEADER_CHANGED:
-                    for part in parts:
-                        if status.msg:  # leader hint
-                            self.update_leader(space_id, part, status.msg)
-                        else:
+                            next_pending[part] = parts[part]
+                            last_status[part] = status
+                    else:
+                        for part in parts:
                             self.invalidate_leader(space_id, part)
-                        next_pending[part] = parts[part]
-                        last_status[part] = status
-                elif status.code in (ErrorCode.E_PART_NOT_FOUND,
-                                     ErrorCode.E_FAIL_TO_CONNECT):
-                    # stale leader cache (part moved by the balancer, or
-                    # host down before the request was sent — both cases
-                    # the op never executed, so resending is safe):
-                    # re-route from meta's current placement.
-                    # E_RPC_FAILURE is NOT retried: the server may have
-                    # executed the op (non-idempotent duplication risk,
-                    # same stance as the reference's collectResponse).
-                    for part in parts:
-                        self.invalidate_leader(space_id, part)
-                        next_pending[part] = parts[part]
-                        last_status[part] = status
-                else:
-                    for part in parts:
-                        self.invalidate_leader(space_id, part)
-                        resp.failed_parts[part] = status
-            for part, st in routing_failed.items():
-                resp.failed_parts[part] = st
-            pending = next_pending
+                            resp.failed_parts[part] = status
+                for part, st in routing_failed.items():
+                    resp.failed_parts[part] = st
+                pending = next_pending
         if pending:
             stats.add_value("storage.client.retry_exhausted")
         for part in pending:  # retries/budget exhausted: report what we saw
@@ -245,12 +258,14 @@ class StorageClient:
         return resp
 
     def _call_host(self, host: str, method: str, payload: dict,
-                   timeout: Optional[float] = None):
-        try:
-            return Status.OK(), self.cm.call(HostAddr.parse(host), method,
-                                             payload, timeout=timeout)
-        except RpcError as e:
-            return e.status, None
+                   timeout: Optional[float] = None, tctx=None):
+        with tracing.attach_captured(tctx):
+            try:
+                return Status.OK(), self.cm.call(HostAddr.parse(host),
+                                                 method, payload,
+                                                 timeout=timeout)
+            except RpcError as e:
+                return e.status, None
 
     # ---- typed APIs (the reference's public surface) ----------------
     def get_neighbors(self, space_id: int, vids: List[int],

@@ -2,7 +2,7 @@
 
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
 on Status/StatusOr, clang-tidy, sanitizer builds) that a Python
-reproduction loses.  nebulint restores the project-specific part as five
+reproduction loses.  nebulint restores the project-specific part as six
 AST checks run over the whole package and gated as a tier-1 test
 (tests/test_lint.py):
 
@@ -17,6 +17,9 @@ AST checks run over the whole package and gated as a tier-1 test
                     frontier loops (tpu/runtime.py, tpu/kernels.py,
                     graph/executors/)
   flag-registry     flags.get("x") without a define(), and dead defines
+  span-registry     tracing.span()/start_trace() names must be literal
+                    dotted strings from the single SPAN_NAMES registry
+                    (common/tracing.py), with dead entries flagged
 
 Suppression: ``# nebulint: disable=<check>[,<check>]`` on the flagged
 line (or the line above), ``# nebulint: disable-file=<check>`` anywhere

@@ -163,18 +163,19 @@ def load_package(root: str, repo_root: Optional[str] = None
 
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
-    from . import flagsreg, hotpath, locks, status
+    from . import flagsreg, hotpath, locks, spans, status
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
         "status-discard": status.check_status_discard,
         "jax-hotpath": hotpath.check_jax_hotpath,
         "flag-registry": flagsreg.check_flag_registry,
+        "span-registry": spans.check_span_registry,
     }
 
 
 ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
-              "jax-hotpath", "flag-registry")
+              "jax-hotpath", "flag-registry", "span-registry")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 

@@ -138,6 +138,41 @@ def bench_wal(entries: int) -> dict:
             "replay_s": round(t_replay, 3)}
 
 
+def bench_query(reps: int) -> dict:
+    """End-to-end query path: client → graphd engine → storage
+    scatter-gather over an in-process cluster.  This is the number the
+    tracing-disabled overhead budget is pinned against
+    (docs/observability.md): with trace_sample_rate=0 the per-query
+    cost of the nebulatrace seams must stay within noise."""
+    from ..cluster import LocalCluster
+    cluster = LocalCluster(num_storage=1)
+    try:
+        client = cluster.client()
+
+        def ok(stmt):
+            # setup must survive ``python -O`` — execute, then check
+            # (a bare assert around the call would be stripped)
+            r = client.execute(stmt)
+            if not r.ok():
+                raise RuntimeError(f"{stmt}: {r.error_msg}")
+
+        ok("CREATE SPACE mb(partition_num=3, replica_factor=1)")
+        cluster.refresh_all()
+        ok("USE mb; CREATE EDGE e(w int)")
+        cluster.refresh_all()
+        edges = ", ".join(f"{i} -> {i + 1}:({i})" for i in range(64))
+        ok(f"INSERT EDGE e(w) VALUES {edges}")
+        go = "GO FROM 1 OVER e YIELD e._dst AS d, e.w AS w"
+        ok(go)                                   # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            client.execute(go)
+        t_go = time.perf_counter() - t0
+        return {"go_queries_per_s": _rate(reps, t_go)}
+    finally:
+        cluster.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -145,11 +180,13 @@ def main(argv=None) -> int:
     reps = 50 if args.quick else 400
     rows = 20_000 if args.quick else 200_000
     entries = 5_000 if args.quick else 50_000
+    qreps = 300 if args.quick else 2_000
     out = {
         "parser": bench_parser(reps),
         "row_codec": bench_codec(rows),
         "key_codec": bench_keys(rows),
         "wal": bench_wal(entries),
+        "query_path": bench_query(qreps),
     }
     print(json.dumps(out))
     return 0

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import tracing
 from ..common.flags import flags
 from ..common.status import ErrorCode
 from ..filter.expressions import ExprContext, ExprError, Expression
@@ -281,7 +282,11 @@ class TpuQueryRuntime:
                     and not m.expired_now():
                 return m
             if m is not None and not m.expired_now():
-                d = self._try_delta(space_id, m, ver, stores, vers)
+                with tracing.span("tpu.mirror.delta",
+                                  space=space_id) as ds:
+                    d = self._try_delta(space_id, m, ver, stores, vers)
+                    if ds is not None:
+                        ds.tag(absorbed=d is not None)
                 if d is not None:
                     return d
             if m is not None and flags.get("mirror_refresh_mode") == "async":
@@ -319,7 +324,10 @@ class TpuQueryRuntime:
                                     m.build_version) == ver \
                         and not m.expired_now():
                     return m     # another thread built while we waited
-            built = build_mirror(space_id, stores, self.sm)
+            with tracing.span("tpu.mirror.build", space=space_id) as bs:
+                built = build_mirror(space_id, stores, self.sm)
+                if bs is not None:
+                    bs.tag(edges=built.m, vertices=built.n)
             built._device = self._to_device(built)
             with self._lock:
                 return self._publish(space_id, built, ver, stores, vers)
@@ -465,7 +473,8 @@ class TpuQueryRuntime:
                         and getattr(cur, "_fresh_version",
                                     cur.build_version) == ver:
                     return cur       # someone rebuilt while we waited
-            m2 = build_mirror(space_id, stores, self.sm)
+            with tracing.span("tpu.mirror.build", space=space_id):
+                m2 = build_mirror(space_id, stores, self.sm)
             m2._device = self._to_device(m2)
             with self._lock:
                 return self._publish(space_id, m2, ver, stores, vers)
@@ -492,18 +501,19 @@ class TpuQueryRuntime:
     @staticmethod
     def _to_device(m: CsrMirror) -> Dict[str, object]:
         import jax.numpy as jnp
-        dev = {
-            "edge_src": jnp.asarray(m.edge_src),
-            "edge_dst": jnp.asarray(m.edge_dst),
-            "edge_etype": jnp.asarray(m.edge_etype),
-        }
-        # rank device copy when int32-representable
-        if m.m == 0 or (m.edge_rank.min() > -2**31 and
-                        m.edge_rank.max() < 2**31):
-            dev["rank"] = jnp.asarray(m.edge_rank.astype(np.int32))
-        else:
-            dev["rank"] = None
-        return dev
+        with tracing.span("tpu.transfer", edges=int(m.m)):
+            dev = {
+                "edge_src": jnp.asarray(m.edge_src),
+                "edge_dst": jnp.asarray(m.edge_dst),
+                "edge_etype": jnp.asarray(m.edge_etype),
+            }
+            # rank device copy when int32-representable
+            if m.m == 0 or (m.edge_rank.min() > -2**31 and
+                            m.edge_rank.max() < 2**31):
+                dev["rank"] = jnp.asarray(m.edge_rank.astype(np.int32))
+            else:
+                dev["rank"] = None
+            return dev
 
     # ================================================== GO planning
     def _plan_go(self, space_id: int, alias_to_etype: Dict[str, int],
@@ -671,16 +681,25 @@ class TpuQueryRuntime:
         import time
         t0 = time.perf_counter()
         starts = [q.start_vids for q in queries]
-        launch = self._launch_frontiers(space_id, starts, et_tuple, steps,
-                                        upto=upto)
+        with tracing.span("tpu.launch", queries=len(queries),
+                          steps=steps):
+            launch = self._launch_frontiers(space_id, starts, et_tuple,
+                                            steps, upto=upto)
         self._tick("t_launch_s", t0)
+        # finish() may run on a different thread (the dispatcher
+        # pipelines batches) — carry the leader's trace context across
+        tctx = tracing.capture()
 
         def finish():
             t1 = time.perf_counter()
-            vs_lists, m = launch()
-            t1 = self._tick("t_fetch_s", t1)
-            results = self._assemble_results(space_id, m, queries,
-                                             vs_lists, et_tuple)
+            with tracing.attach_captured(tctx):
+                with tracing.span("tpu.fetch"):
+                    vs_lists, m = launch()
+                t1 = self._tick("t_fetch_s", t1)
+                with tracing.span("tpu.assemble",
+                                  queries=len(queries)):
+                    results = self._assemble_results(space_id, m, queries,
+                                                     vs_lists, et_tuple)
             self._tick("t_assemble_s", t1)
             return results, m
 
@@ -928,8 +947,9 @@ class TpuQueryRuntime:
         self._note_live_shape(("sparse_go", ix.shape_sig(), et_tuple,
                                steps, c0),
                               first_of_family=first or upto)
-        out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
-                       *ix.kernel_args()[1:])
+        with tracing.span("tpu.kernel", kind="sparse_go", starts=S):
+            out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
+                           *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
 
         def resolve():
@@ -1002,8 +1022,9 @@ class TpuQueryRuntime:
                 mesh, "parts", sh, steps, et_tuple, caps,
                 cap_x=cap_x, cap_e=cap_e))
         args = sharded_device_args(mesh, "parts", sh)
-        out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
-                       args[0], args[1], args[2], *args[3], *args[4])
+        with tracing.span("tpu.kernel", kind="mesh_sparse_go"):
+            out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
+                           args[0], args[1], args[2], *args[3], *args[4])
         self.stats["go_mesh_sparse"] = \
             self.stats.get("go_mesh_sparse", 0) + 1
 
@@ -1033,7 +1054,8 @@ class TpuQueryRuntime:
             ("adaptive_go", ix.shape_sig(), et_tuple, steps, K),
             lambda: make_adaptive_go_kernel(ix, steps, et_tuple, K=K))
         hub = self._hub_dev(m, ix)
-        out_dev = kern(ix.perm[d_all], hub, *ix.kernel_args())
+        with tracing.span("tpu.kernel", kind="adaptive_go"):
+            out_dev = kern(ix.perm[d_all], hub, *ix.kernel_args())
         self.stats["go_adaptive"] += 1
 
         def resolve():
@@ -1064,7 +1086,8 @@ class TpuQueryRuntime:
                 ("ell_go_delta", ix.shape_sig(), et_tuple, steps),
                 lambda: make_batched_go_delta_kernel(ix, steps, et_tuple,
                                                      cap, pack=True))
-            out_dev = kern(f0_dev, dsrc, ddst, det, *args)
+            with tracing.span("tpu.kernel", kind="ell_go_delta"):
+                out_dev = kern(f0_dev, dsrc, ddst, det, *args)
         elif mesh_mt is not None:
             mesh, nbrs, ets, reals = mesh_mt
             kern = self._kernel(
@@ -1073,7 +1096,8 @@ class TpuQueryRuntime:
                 lambda: make_sharded_batched_go_kernel(
                     mesh, "parts", ix, steps, et_tuple, nbrs, ets, reals,
                     pack=True))
-            out_dev = kern(f0_dev, args[0], *nbrs, *ets)
+            with tracing.span("tpu.kernel", kind="ell_go_sharded"):
+                out_dev = kern(f0_dev, args[0], *nbrs, *ets)
         else:
             kern = self._kernel(
                 ("ell_go", ix.shape_sig(), et_tuple, steps, upto),
@@ -1088,7 +1112,8 @@ class TpuQueryRuntime:
             self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
                                    steps, B),
                                   first_of_family=first or upto)
-            out_dev = kern(f0_dev, *args)
+            with tracing.span("tpu.kernel", kind="ell_go", width=B):
+                out_dev = kern(f0_dev, *args)
         self.stats["go_dense"] += 1
 
         def resolve():
@@ -2019,7 +2044,10 @@ class TpuQueryRuntime:
         with self._lock:
             kern = self._kernels.get(key)
             if kern is None:
-                kern = self._kernels[key] = builder()
+                # a cache miss is a jit (re)trace event — the p99 spike
+                # source PROFILE must be able to name
+                with tracing.span("tpu.jit.compile", kernel=str(key[0])):
+                    kern = self._kernels[key] = builder()
         return kern
 
     def _delta_device(self, m: CsrMirror, ix: EllIndex):

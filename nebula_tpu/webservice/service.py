@@ -12,6 +12,10 @@ HTTP server exposing
                                     "counter.{sum|count|avg|rate|pXX}.
                                     {5|60|600|3600}" (StatsManager.h:24-40)
   GET /get_stats?format=text        plain-text k=v dump
+  GET /traces[?id=<hex>|slow=1]     nebulatrace ring buffer: recent
+                                    trace summaries, one span tree, or
+                                    the slow-query log
+                                    (docs/observability.md)
 
 plus ``register_handler(path, fn)`` for daemon-specific paths (storage's
 /download /ingest /admin, meta's /*-dispatch — SURVEY.md §2.10).
@@ -38,6 +42,7 @@ class WebService:
         self.register_handler("/flags", self._flags)
         self.register_handler("/faults", self._faults)
         self.register_handler("/get_stats", self._get_stats)
+        self.register_handler("/traces", self._traces)
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -146,6 +151,28 @@ class WebService:
             except (TypeError, ValueError) as e:
                 return 400, {"error": str(e)}
         return 200, default_injector.dump()
+
+    def _traces(self, q: dict, body: bytes):
+        """nebulatrace ring buffer (docs/observability.md):
+        GET /traces             recent trace summaries (newest first)
+        GET /traces?id=<hex>    one trace as a nested span tree
+        GET /traces?slow=1      the slow-query log
+        (common/tracing.py; traces appear when trace_sample_rate > 0 or
+        a statement ran under PROFILE)."""
+        from ..common.tracing import slow_log, trace_store
+        tid = q.get("id")
+        if tid:
+            try:
+                tree = trace_store.tree(int(tid, 16))
+            except ValueError:
+                return 400, {"error": f"bad trace id {tid!r}"}
+            if tree is None:
+                return 404, {"error": f"trace {tid} not found "
+                                      "(evicted or never sampled)"}
+            return 200, tree
+        if q.get("slow"):
+            return 200, {"slow_queries": slow_log.dump()}
+        return 200, {"traces": trace_store.summaries()}
 
     def _get_stats(self, q: dict, body: bytes):
         exprs = q.get("stats")

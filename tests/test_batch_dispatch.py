@@ -118,10 +118,16 @@ def test_concurrent_find_path_coalesce(nba):
     results = {}
     errors = []
 
+    # session setup (connect + USE) staggers threads by whole RPC round
+    # trips on a loaded box — the barrier makes the four FIND PATH
+    # statements actually CONCURRENT, which is the property under test
+    gate = threading.Barrier(4)
+
     def worker(src, dst):
         try:
             g2 = c.client()
             g2.execute("USE s")
+            gate.wait(timeout=10)
             r = g2.execute(f"FIND SHORTEST PATH FROM {src} TO {dst} "
                            f"OVER follow")
             assert r.ok(), r.error_msg

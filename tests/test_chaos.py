@@ -580,6 +580,29 @@ class TestFaultsEndpoint:
         finally:
             ws.stop()
 
+    def test_injected_fault_kinds_visible_per_method(self, duo):
+        """Which faults did a query actually absorb?  The injector
+        bumps a per-method counter and drops a marker span on the
+        active trace, so chaos runs can assert the schedule landed."""
+        from nebula_tpu.common.tracing import trace_store
+        c, cl = duo
+        m0 = stats.read_stats(
+            "rpc.fault_injected.getBound.count.3600") or 0
+        default_injector.configure(
+            [{"kind": "refuse_connect", "method": "getBound",
+              "times": 1}])
+        r = cl.execute("PROFILE " + ALL_SRC)
+        default_injector.clear()
+        assert r.ok()
+        assert sorted(v for (v,) in map(tuple, r.rows)) == ALL_DST
+        assert (stats.read_stats("rpc.fault_injected.getBound"
+                                 ".count.3600") or 0) > m0
+        # the PROFILE tree carries the fault marker with its kind
+        spans = trace_store.spans(int(r.profile["trace_id"], 16))
+        marks = [s for s in spans if s["name"] == "rpc.fault"]
+        assert marks and marks[0]["tags"]["fault"] == "refuse_connect"
+        assert marks[0]["tags"]["method"] == "getBound"
+
     def test_retry_counters_visible_on_get_stats(self, duo):
         c, cl = duo
         default_injector.configure(

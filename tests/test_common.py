@@ -104,6 +104,42 @@ class TestStats:
         assert m.read_stats("x.sum.61") is None
         assert m.read_stats("x.wat.60") is None
 
+    def test_dump_exposes_tail_percentiles(self):
+        m = StatsManager()
+        m.register_stats("lat")
+        now = time.time()
+        for v in range(1, 101):
+            m._stats["lat"].add(v, now)
+        d = m.dump(now)["lat"]
+        assert d["count.60"] == 100.0
+        assert 90 <= d["p95.60"] <= 96
+        assert d["p99.60"] >= d["p95.60"]
+        # empty reservoir: percentile columns present but zero
+        m.register_stats("idle")
+        assert m.dump(now)["idle"]["p95.60"] == 0.0
+
+    def test_ring_wrap_stale_bucket_not_leaked(self):
+        """A bucket whose stamp is exactly _RING (3600) seconds stale
+        lands on the SAME ring index as `now` — window() must see the
+        stamp mismatch and skip it, and add() must reset it."""
+        m = StatsManager()
+        m.register_stats("w")
+        st = m._stats["w"]
+        now = 1_700_000_000.0
+        st.add(7, now)
+        assert m.read_stats("w.sum.60", now) == 7
+        # one full ring later: same index, stale stamp — no leak in any
+        # window, including the full 3600 s one
+        later = now + 3600
+        assert m.read_stats("w.sum.60", later) == 0
+        assert m.read_stats("w.count.3600", later) == 0.0
+        assert m.read_stats("w.p99.60", later) == 0.0
+        # writing at the wrapped second resets the bucket rather than
+        # accumulating onto the stale sums
+        st.add(3, later)
+        total, count, vals = st.window(60, later)
+        assert (total, count, vals) == (3.0, 1, [3])
+
 
 class TestClock:
     def test_duration(self):

@@ -152,6 +152,9 @@ class TestWebService:
             stats.add_value("web.test.counter", 5)
             got = json.load(urllib.request.urlopen(f"{base}/get_stats"))
             assert any("web.test.counter" in k for k in got)
+            # tail-latency columns from the sample reservoirs
+            assert got["web.test.counter"]["p95.60"] == 5.0
+            assert got["web.test.counter"]["p99.60"] == 5.0
             txt = urllib.request.urlopen(
                 f"{base}/get_stats?format=text").read().decode()
             assert "web.test.counter" in txt
@@ -617,6 +620,12 @@ def test_graphd_per_statement_stats(tmp_path):
                              ".count.3600") or 0) >= 1
         assert (S.read_stats("graph.stmt.InsertEdgeSentence.latency_us"
                              ".count.3600") or 0) >= 1
+        # /get_stats (StatsManager.dump) exposes tail latency now —
+        # the per-statement histograms must carry real p95/p99 columns
+        dump = S.dump()
+        go_hist = dump["graph.stmt.GoSentence.latency_us"]
+        assert go_hist["p95.60"] > 0 and go_hist["p99.60"] > 0
+        assert go_hist["p99.60"] >= go_hist["p95.60"]
         e0 = S.read_stats("graph.error.qps.count.3600") or 0
         r = g.execute("GO FROM 1 OVER nosuch")
         assert not r.ok()
@@ -639,10 +648,12 @@ def test_micro_bench_tool_runs():
         "row_codec": MB.bench_codec(2000),
         "key_codec": MB.bench_keys(2000),
         "wal": MB.bench_wal(500),
+        "query_path": MB.bench_query(5),
     }
     assert out["parser"]["statements_per_s"] > 0
     assert out["row_codec"]["encode_rows_per_s"] > 0
     assert out["wal"]["append_entries_per_s"] > 0
+    assert out["query_path"]["go_queries_per_s"] > 0
 
 
 class TestStoreTypeGate:

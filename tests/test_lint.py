@@ -1,4 +1,4 @@
-"""nebulint self-tests: each of the five checks must fire on a minimal
+"""nebulint self-tests: each of the six checks must fire on a minimal
 fixture snippet, honor inline suppression, and the whole-package run is
 the tier-1 gate (zero unsuppressed violations).  Also the runtime half:
 the OrderedLock watchdog must detect a deliberately seeded inversion.
@@ -273,6 +273,77 @@ def test_flag_registry_defined_and_read_is_clean(tmp_path):
     """}, checks=["flag-registry"]) == []
 
 
+# ================================================== 6 · span-registry
+_SPAN_REG = """
+    from common import tracing
+
+    SPAN_NAMES = ("graph.query", "rpc.client")
+
+    def f():
+        with tracing.span("rpc.client"):
+            pass
+
+    def g():
+        with tracing.start_trace("graph.query", forced=True):
+            pass
+"""
+
+
+def test_span_registry_clean(tmp_path):
+    assert run_fixture(tmp_path, {"tracing.py": _SPAN_REG},
+                       checks=["span-registry"]) == []
+
+
+def test_span_registry_unknown_name(tmp_path):
+    bad = _SPAN_REG.replace('tracing.span("rpc.client")',
+                            'tracing.span("rpc.mystery")')
+    vs = run_fixture(tmp_path, {"tracing.py": bad},
+                     checks=["span-registry"])
+    msgs = [v.message for v in vs]
+    assert any("rpc.mystery" in m and "not in the SPAN_NAMES" in m
+               for m in msgs)
+    # the now-unused registry entry is flagged dead too
+    assert any("'rpc.client'" in m and "never used" in m for m in msgs)
+
+
+def test_span_registry_dynamic_name_rejected(tmp_path):
+    bad = _SPAN_REG.replace('tracing.span("rpc.client")',
+                            'tracing.span(name)')
+    vs = run_fixture(tmp_path, {"tracing.py": bad},
+                     checks=["span-registry"])
+    assert any("literal" in v.message for v in vs)
+
+
+def test_span_registry_requires_single_registry(tmp_path):
+    files = {"tracing.py": _SPAN_REG,
+             "other.py": 'SPAN_NAMES = ("dup.reg",)\n'}
+    vs = run_fixture(tmp_path, files, checks=["span-registry"])
+    assert any("ONE registry" in v.message for v in vs)
+
+
+def test_span_registry_missing_registry(tmp_path):
+    vs = run_fixture(tmp_path, {"mod.py": """
+        from common import tracing
+
+        def f():
+            with tracing.span("orphan.name"):
+                pass
+    """}, checks=["span-registry"])
+    assert any("no SPAN_NAMES registry" in v.message for v in vs)
+
+
+def test_span_registry_ignores_unrelated_span_calls(tmp_path):
+    """A local helper also called span() (numpy span, etc.) must not
+    trip the check — only tracing.* receivers count."""
+    assert run_fixture(tmp_path, {"mod.py": """
+        def span(x):
+            return x
+
+        def f():
+            return span("whatever")
+    """}, checks=["span-registry"]) == []
+
+
 # ====================================================== baseline rules
 def test_baseline_entry_requires_reason():
     with pytest.raises(LintError):
@@ -312,7 +383,7 @@ def test_package_has_no_stale_baseline_entries():
 def test_all_checks_registered():
     assert set(ALL_CHECKS) == {"lock-discipline", "lock-order",
                                "status-discard", "jax-hotpath",
-                               "flag-registry"}
+                               "flag-registry", "span-registry"}
 
 
 # ========================================== OrderedLock runtime watchdog

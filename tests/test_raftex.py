@@ -632,9 +632,16 @@ class TestAdaptivePipelining:
         measured -25%); a slow measured RTT must restore the
         configured depth."""
         lead = cluster3.leader()
-        for i in range(20):
-            assert lead.part.put(b"a%02d" % i, b"v").ok()
         raft = lead.part.raft
+        # scheduler noise on a loaded box can pin the RTT EMA just over
+        # the 1 ms gate — re-measure a few rounds; the link itself is
+        # loopback, so a quiet round lands far under it
+        for round_ in range(5):
+            for i in range(20):
+                assert lead.part.put(b"a%02d%02d" % (round_, i),
+                                     b"v").ok()
+            if raft._rep_rtt is not None and raft._rep_rtt < 0.001:
+                break
         assert raft._rep_rtt is not None and raft._rep_rtt < 0.001
         with raft._lock:
             assert raft._effective_depth() == 1
