@@ -567,18 +567,26 @@ class DiskEngine(KVEngine):
         return Status.OK()
 
     def _bg_compact(self) -> None:
-        try:
-            while True:
+        while True:
+            try:
                 self._compact_offline()
-                with self._lock:
-                    # runs flushed DURING the merge can push the count
-                    # back over the threshold; nothing else re-triggers
-                    # until the next flush, so re-check here
-                    if len(self._runs) < self.compact_after_runs:
-                        return
-        finally:
+            except BaseException:   # incl. interpreter-shutdown exits —
+                with self._lock:    # the flag must clear on EVERY path
+                    self._compacting = False
+                raise
             with self._lock:
-                self._compacting = False
+                # runs flushed DURING the merge can push the count
+                # back over the threshold; nothing else re-triggers
+                # until the next flush, so re-check here.  The stop
+                # decision and the flag clear are ONE locked section:
+                # clearing the flag after returning left a window
+                # where a flush saw _compacting still True, skipped
+                # the trigger, and the run count stuck at the
+                # threshold until the next flush (observed as a
+                # full-suite flake in test_auto_compaction_bounds_run_count)
+                if len(self._runs) < self.compact_after_runs:
+                    self._compacting = False
+                    return
 
     def _compact_offline(self) -> None:
         """Merge the run set captured at entry into one run without

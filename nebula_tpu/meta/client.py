@@ -86,6 +86,12 @@ class MetaClient:
         self._load_lock = OrderedLock("meta.load")
         self.spaces: Dict[int, SpaceInfoCache] = {}
         self.space_name_to_id: Dict[str, int] = {}
+        # bumped on every completed load_data: consumers holding
+        # placement-derived negative caches (storage/device.py's UPTO
+        # decline cache) drop their entries when this moves, so a
+        # restarted/upgraded storaged resumes serving without waiting
+        # out a TTL or restarting this process
+        self.data_generation = 0
 
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -280,6 +286,7 @@ class MetaClient:
                 old_spaces = self.spaces
                 self.spaces = new_spaces
                 self.space_name_to_id = new_name_to_id
+                self.data_generation += 1
             self._diff(old_spaces, new_spaces)
 
     def _load_space(self, sid: int, name: str) -> SpaceInfoCache:

@@ -116,7 +116,21 @@ class MetaService:
             if name.startswith("rpc_"):
                 setattr(self, name, self._locked(getattr(self, name)))
 
+    # catalog-leader-gated but NOT serialized under the write lock:
+    # the bulk-load dispatch fans HTTP out to every storaged with a
+    # 120 s per-host timeout — holding the catalog lock across that
+    # would stall heartbeats (and thus liveness) behind one blackholed
+    # host.  These handlers only READ active_hosts (its own locking).
+    _UNLOCKED_RPCS = ("rpc_download", "rpc_ingest")
+
     def _locked(self, fn):
+        if fn.__name__ in self._UNLOCKED_RPCS:
+            def leader_only(req: dict):
+                self._check_catalog_leader()
+                return fn(req)
+            leader_only.__name__ = fn.__name__
+            return leader_only
+
         def wrapper(req: dict):
             self._check_catalog_leader()
             with self._write_lock:
@@ -646,6 +660,41 @@ class MetaService:
             out.append({"module": mod,
                         "name": k[len(mk.CONFIG_PREFIX) + 4:].decode(), **rec})
         return {"items": out}
+
+    # ================= bulk-load dispatch =================
+    # the DOWNLOAD/INGEST nGQL statements arrive as meta RPCs
+    # (graph/executors/admin.py Download/IngestExecutor); the
+    # /download-dispatch and /ingest-dispatch web endpoints share the
+    # same per-host fan-out (http_dispatch._fan_out).  A partial
+    # fan-out raises, so the statement errors instead of silently
+    # half-loading the space
+    def rpc_download(self, req: dict) -> dict:
+        from urllib.parse import quote
+        from .http_dispatch import _fan_out
+        space = int(req["space_id"])
+        url = str(req.get("url") or "")
+        if not url:
+            raise _err(ErrorCode.E_INVALID_HOST,
+                       "DOWNLOAD needs a source url")
+        out = _fan_out(self, lambda ip, p: (
+            f"http://{ip}:{p}/download?space={space}"
+            f"&url={quote(url, safe='')}"))
+        if not out.get("ok"):
+            raise _err(ErrorCode.E_NO_VALID_HOST,
+                       f"download dispatch failed: "
+                       f"{out.get('error') or out.get('hosts')}")
+        return out
+
+    def rpc_ingest(self, req: dict) -> dict:
+        from .http_dispatch import _fan_out
+        space = int(req["space_id"])
+        out = _fan_out(self, lambda ip, p:
+                       f"http://{ip}:{p}/ingest?space={space}")
+        if not out.get("ok"):
+            raise _err(ErrorCode.E_NO_VALID_HOST,
+                       f"ingest dispatch failed: "
+                       f"{out.get('error') or out.get('hosts')}")
+        return out
 
     # ================= balance =================
     def rpc_balance(self, req: dict) -> dict:

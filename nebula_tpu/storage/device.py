@@ -183,11 +183,16 @@ class RemoteDeviceRuntime:
         # spaces whose storaged declined UPTO (mesh-sharded there, or
         # an older build that can't serve it): remembered so repeat
         # UPTO queries skip the ~RTT-costly decline round trip.
-        # Negative-cache entries carry (expiry, device host): they lapse
-        # after upto_decline_ttl_s (a restarted/upgraded storaged gets
-        # UPTO traffic again without a graphd restart) and drop
-        # immediately when a placement refresh moves the device host
-        self._upto_declined: Dict[int, Tuple[float, str]] = {}
+        # Negative-cache entries carry (expiry, device host, meta
+        # generation): they lapse after upto_decline_ttl_s, drop
+        # immediately when a placement refresh moves the device host,
+        # AND drop whenever the meta cache refreshes at all
+        # (meta/client.py data_generation) — a storaged restarting
+        # WITHOUT mesh sharding re-heartbeats, metad's catalog clock
+        # moves, graphd's next load_data bumps the generation, and the
+        # space probes UPTO again without waiting out the TTL or
+        # restarting graphd (ADVICE.md round 5)
+        self._upto_declined: Dict[int, Tuple[float, str, int]] = {}
 
     # ------------------------------------------------------------ placement
     def _device_host(self, space_id: int
@@ -212,22 +217,26 @@ class RemoteDeviceRuntime:
 
     # ------------------------------------------------- UPTO negative cache
     def _upto_decline_active(self, space_id: int, host) -> bool:
-        """True while a remembered UPTO decline still binds: unexpired
-        AND the device host is unchanged.  TTL lapse or a placement
-        refresh that moved the space's device host drops the entry, so
-        the next UPTO query probes again."""
+        """True while a remembered UPTO decline still binds: unexpired,
+        the device host unchanged, AND the meta cache not refreshed
+        since the decline.  TTL lapse, a placement refresh that moved
+        the device host, or ANY completed meta refresh drops the
+        entry, so the next UPTO query probes again."""
         ent = self._upto_declined.get(space_id)
         if ent is None:
             return False
-        expiry, decline_host = ent
-        if time.monotonic() >= expiry or decline_host != str(host):
+        expiry, decline_host, gen = ent
+        if time.monotonic() >= expiry or decline_host != str(host) \
+                or gen != getattr(self.meta, "data_generation", gen):
             self._upto_declined.pop(space_id, None)
             return False
         return True
 
     def _note_upto_declined(self, space_id: int, host) -> None:
         ttl = float(flags.get("upto_decline_ttl_s", 300))
-        self._upto_declined[space_id] = (time.monotonic() + ttl, str(host))
+        self._upto_declined[space_id] = (
+            time.monotonic() + ttl, str(host),
+            getattr(self.meta, "data_generation", 0))
 
     # ------------------------------------------------------------ rpc
     def _call(self, host: HostAddr, method: str, req: dict,

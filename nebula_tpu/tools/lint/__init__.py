@@ -1,10 +1,10 @@
 """nebulint — project-invariant static analysis for nebula_tpu.
 
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
-on Status/StatusOr, clang-tidy, sanitizer builds) that a Python
-reproduction loses.  nebulint restores the project-specific part as six
-AST checks run over the whole package and gated as a tier-1 test
-(tests/test_lint.py):
+on Status/StatusOr, clang-tidy, sanitizer builds) plus a Thrift IDL
+that makes wire drift a compile error — both lost in a Python
+reproduction.  nebulint restores the project-specific part as eight
+whole-package checks gated as a tier-1 test (tests/test_lint.py):
 
   lock-discipline   attributes mutated from thread entry points without
                     the owning class's declared lock; blocking calls
@@ -20,6 +20,19 @@ AST checks run over the whole package and gated as a tier-1 test
   span-registry     tracing.span()/start_trace() names must be literal
                     dotted strings from the single SPAN_NAMES registry
                     (common/tracing.py), with dead entries flagged
+  jaxpr-audit       SEMANTIC: traces every registered kernel factory
+                    (tpu/kernels.py KERNEL_REGISTRY) across the
+                    runtime's real shape buckets and proves, on the
+                    jaxpr: no host callbacks in loop bodies, no 64-bit
+                    promotion of persistent buffers, donation where
+                    claimed, a bounded recompile-key space, transfer
+                    counts matching runtime.DEVICE_PHASES
+  wire-contract     SEMANTIC: cross-checks every RPC client call site
+                    against the rpc_* handlers (orphan methods and
+                    handlers, request-key drift, response-envelope
+                    drift, the transport frame contract, the
+                    /get_stats//traces//faults endpoint payloads) —
+                    the Thrift-IDL guarantee, restored mechanically
 
 Suppression: ``# nebulint: disable=<check>[,<check>]`` on the flagged
 line (or the line above), ``# nebulint: disable-file=<check>`` anywhere

@@ -163,7 +163,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
-    from . import flagsreg, hotpath, locks, spans, status
+    from . import flagsreg, hotpath, jaxaudit, locks, spans, status, \
+        wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -171,11 +172,14 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "jax-hotpath": hotpath.check_jax_hotpath,
         "flag-registry": flagsreg.check_flag_registry,
         "span-registry": spans.check_span_registry,
+        "jaxpr-audit": jaxaudit.check_jaxpr_audit,
+        "wire-contract": wirecheck.check_wire_contract,
     }
 
 
 ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
-              "jax-hotpath", "flag-registry", "span-registry")
+              "jax-hotpath", "flag-registry", "span-registry",
+              "jaxpr-audit", "wire-contract")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 

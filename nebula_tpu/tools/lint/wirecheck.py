@@ -1,0 +1,711 @@
+"""wire-contract — mechanical client/server RPC contract checking.
+
+The reference's Thrift IDL makes wire drift a compile error: a client
+cannot call a method the service doesn't declare, or read a response
+field that isn't there.  This package's RPC seam is msgpack dicts over
+``rpc_*`` handlers (interface/rpc.py), so the same guarantees must come
+from analysis.  This pass extracts, from the ASTs of every module:
+
+  * CLIENT SIDE — every ``*.call(...)`` / ``*._call(...)`` /
+    ``*._call_status(...)`` invocation whose method is a string
+    literal (storage/meta/graph clients, the balancer, device proxy,
+    raft peers, DDL executors), plus the ``("method", {...})`` tuples
+    the scatter-gather ``make_req`` closures return; for each site:
+    the payload keys (when a dict literal) and the response-envelope
+    keys the caller reads off the result.
+  * SERVER SIDE — every ``rpc_<method>`` handler: the request keys it
+    requires (``req["k"]``) or accepts (``req.get("k")``), and the
+    response keys it writes, resolved through one level of
+    ``self.rpc_*`` delegation and same-class helpers (``_bulk``,
+    ``_raft``, ...).  Handlers that hand the request (or build the
+    response) through non-self code (the storage processors) are
+    marked OPEN and exempt from exact-key checks.
+
+Checks (each suppressible with ``# nebulint: disable=wire-contract``
+or a justified baseline entry):
+
+  * a called method with no ``rpc_`` handler anywhere (orphan method);
+  * a handler no in-tree client ever names (orphan handler — the
+    reference-IDL parity spellings carry baseline justifications);
+  * argument drift: a required request key the caller never sends, or
+    a sent key a CLOSED handler never reads;
+  * envelope drift: a response field read but never written by any
+    CLOSED handler of the method, or written but read by no caller
+    (flagged only when the method has analyzed read sites);
+  * the transport frame contract (interface/rpc.py): the untraced
+    2-element ``[method, payload]`` frame must survive, the traced
+    3-element frame must cover every ``parts[i]`` index the server
+    touches, and the ``__spans__``/``__resp__`` envelope constants
+    must be both written and read;
+  * the ``/get_stats`` / ``/traces`` / ``/faults`` web endpoints:
+    registered, and their literal payload keys matching the declared
+    contract below.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import PackageContext, Violation, dotted
+
+CHECK = "wire-contract"
+
+_CALL_LEAVES = {"call", "_call", "_call_status"}
+_SKIP_CALL_PREFIXES = ("subprocess.", "os.", "shutil.")
+
+# transport-level envelope keys every response may carry
+# (interface/rpc.py error + trace piggyback envelopes)
+_TRANSPORT_KEYS = {"__error__", "msg", "__spans__", "__resp__"}
+
+# web-endpoint payload contract: declared keys per endpoint; "dynamic"
+# endpoints also return non-literal payloads (stats dumps, span trees)
+# whose keys the declaration cannot enumerate
+ENDPOINT_CONTRACT = {
+    "/get_stats": {"keys": {"error"}, "dynamic": True},
+    "/traces": {"keys": {"error", "traces", "slow_queries"},
+                "dynamic": True},
+    "/faults": {"keys": {"error", "seed", "rules"}, "dynamic": True},
+}
+
+
+# ------------------------------------------------------------ handlers
+class Handler:
+    __slots__ = ("method", "rel", "line", "symbol", "required",
+                 "optional", "resp_keys", "open_reads", "open_resp",
+                 "delegates")
+
+    def __init__(self, method, rel, line, symbol):
+        self.method = method
+        self.rel = rel
+        self.line = line
+        self.symbol = symbol
+        self.required: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.resp_keys: Set[str] = set()
+        self.open_reads = False
+        self.open_resp = False
+        self.delegates: Set[str] = set()   # rpc_ methods it forwards to
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _dict_keys(node) -> Optional[Set[str]]:
+    """Keys of an all-literal dict display, else None (dynamic)."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in node.keys:
+        s = _const_str(k) if k is not None else None
+        if s is None:
+            return None
+        keys.add(s)
+    return keys
+
+
+class _FnScan(ast.NodeVisitor):
+    """Request/response key extraction over one function body, given
+    the set of names aliasing the request dict."""
+
+    def __init__(self, req_names: Set[str]):
+        self.req = set(req_names)
+        self.required: Set[str] = set()
+        self.optional: Set[str] = set()
+        self.helper_calls: List[Tuple[str, int]] = []  # (self-method,
+        self.open_reads = False                        #  req-arg pos)
+        self.delegates: Set[str] = set()
+        self.returns: List[ast.AST] = []
+        self.assigns: Dict[str, List[ast.AST]] = {}
+        self.subscript_writes: Dict[str, Set[str]] = {}
+
+    def visit_Assign(self, node):
+        # alias tracking: x = req / x = dict(req)
+        val = node.value
+        aliased = False
+        if isinstance(val, ast.Name) and val.id in self.req:
+            aliased = True
+        elif isinstance(val, ast.Call) and isinstance(val.func, ast.Name) \
+                and val.func.id == "dict" and len(val.args) == 1 \
+                and isinstance(val.args[0], ast.Name) \
+                and val.args[0].id in self.req:
+            aliased = True
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                if aliased:
+                    self.req.add(t.id)
+                self.assigns.setdefault(t.id, []).append(val)
+            elif isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name):
+                k = _const_str(t.slice)
+                if k is not None:
+                    self.subscript_writes.setdefault(
+                        t.value.id, set()).add(k)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if isinstance(node.value, ast.Name) and node.value.id in self.req \
+                and isinstance(node.ctx, ast.Load):
+            k = _const_str(node.slice)
+            if k is not None:
+                self.required.add(k)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            # req.get("k")
+            if f.attr == "get" and isinstance(f.value, ast.Name) \
+                    and f.value.id in self.req and node.args:
+                k = _const_str(node.args[0])
+                if k is not None:
+                    self.optional.add(k)
+            # self.something(...) with a req alias among the args
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                for pos, a in enumerate(node.args):
+                    if isinstance(a, ast.Name) and a.id in self.req:
+                        if f.attr.startswith("rpc_"):
+                            self.delegates.add(f.attr[4:])
+                        else:
+                            self.helper_calls.append((f.attr, pos))
+                        break
+            elif any(isinstance(a, ast.Name) and a.id in self.req
+                     for a in node.args):
+                fn_name = dotted(f) or f.attr
+                if fn_name != "dict":
+                    self.open_reads = True   # req escapes to non-self code
+        elif isinstance(f, ast.Name):
+            if f.id not in ("dict", "int", "str", "len", "bool", "list"):
+                if any(isinstance(a, ast.Name) and a.id in self.req
+                       for a in node.args):
+                    self.open_reads = True
+        self.generic_visit(node)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.returns.append(node.value)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs (the _bulk(run) closures): scan them too — they
+        # receive the request through the outer scope
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_handler_fn(fn: ast.FunctionDef, req_name: Optional[str]
+                     ) -> _FnScan:
+    scan = _FnScan({req_name} if req_name else set())
+    for stmt in fn.body:
+        scan.visit(stmt)
+    return scan
+
+
+def _resolve_resp(scan: _FnScan, fn_by_name, depth: int,
+                  h: Handler) -> None:
+    """Fold a scan's return expressions into handler resp keys."""
+    for ret in scan.returns:
+        keys = _dict_keys(ret)
+        if keys is not None:
+            h.resp_keys |= keys
+            continue
+        if isinstance(ret, ast.Name):
+            resolved = False
+            for val in scan.assigns.get(ret.id, []):
+                k2 = _dict_keys(val)
+                if k2 is not None:
+                    h.resp_keys |= k2
+                    resolved = True
+                else:
+                    h.open_resp = True
+            h.resp_keys |= scan.subscript_writes.get(ret.id, set())
+            if not resolved and ret.id not in scan.subscript_writes:
+                h.open_resp = True
+            continue
+        if isinstance(ret, ast.Call) \
+                and isinstance(ret.func, ast.Attribute) \
+                and isinstance(ret.func.value, ast.Name) \
+                and ret.func.value.id == "self":
+            attr = ret.func.attr
+            if attr.startswith("rpc_"):
+                h.delegates.add(attr[4:])
+                continue
+            target = fn_by_name.get(attr)
+            if target is not None and depth > 0:
+                # same-class helper (_bulk, _get_schema, ...): fold its
+                # literal return keys; req flows through its params
+                req2 = None
+                for pos, a in enumerate(ret.args):
+                    if isinstance(a, ast.Name) and a.id in scan.req:
+                        params = [p.arg for p in target.args.args
+                                  if p.arg != "self"]
+                        if pos < len(params):
+                            req2 = params[pos]
+                        break
+                sub = _scan_handler_fn(target, req2)
+                h.required |= sub.required
+                h.optional |= sub.optional
+                h.open_reads |= sub.open_reads
+                h.delegates |= sub.delegates
+                _resolve_resp(sub, fn_by_name, depth - 1, h)
+                continue
+        h.open_resp = True
+
+
+def _collect_handlers(ctx: PackageContext) -> Dict[str, List[Handler]]:
+    out: Dict[str, List[Handler]] = {}
+    for mod in ctx.modules:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            fn_by_name = {f.name: f for f in cls.body
+                          if isinstance(f, ast.FunctionDef)}
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef) \
+                        or not fn.name.startswith("rpc_"):
+                    continue
+                method = fn.name[4:]
+                req_name = (fn.args.args[1].arg
+                            if len(fn.args.args) > 1 else None)
+                h = Handler(method, mod.rel, fn.lineno,
+                            f"{cls.name}.{fn.name}")
+                scan = _scan_handler_fn(fn, req_name)
+                h.required |= scan.required
+                h.optional |= scan.optional
+                h.open_reads |= scan.open_reads
+                h.delegates |= scan.delegates
+                # helper calls taking the request (self._bulk(req, ..),
+                # self._raft(req), self._check_parts(req[..]...))
+                for attr, pos in scan.helper_calls:
+                    target = fn_by_name.get(attr)
+                    if target is None:
+                        h.open_reads = True
+                        continue
+                    params = [p.arg for p in target.args.args
+                              if p.arg != "self"]
+                    req2 = params[pos] if pos < len(params) else None
+                    sub = _scan_handler_fn(target, req2)
+                    # fold the helper's REQUEST reads only — its
+                    # returns are NOT this handler's response (a
+                    # handler that RETURNS a helper call is resolved
+                    # through _resolve_resp below instead)
+                    h.required |= sub.required
+                    h.optional |= sub.optional
+                    h.open_reads |= sub.open_reads
+                    h.delegates |= sub.delegates
+                _resolve_resp(scan, fn_by_name, 2, h)
+                out.setdefault(method, []).append(h)
+    # second pass: delegation closure (one level is enough in-tree:
+    # the alias handlers forward straight to their targets)
+    for _ in range(2):
+        for hs in out.values():
+            for h in hs:
+                for d in h.delegates:
+                    for t in out.get(d, []):
+                        h.required |= t.required
+                        h.optional |= t.optional
+                        h.resp_keys |= t.resp_keys
+                        h.open_reads |= t.open_reads
+                        h.open_resp |= t.open_resp
+    for hs in out.values():
+        for h in hs:
+            # a key read BOTH ways (req["k"] under a req.get("k")
+            # guard — rpc_changePassword's old_password) is optional
+            h.required -= h.optional
+    return out
+
+
+# ------------------------------------------------------------ clients
+class CallSite:
+    __slots__ = ("method", "rel", "line", "symbol", "payload_keys",
+                 "resp_reads")
+
+    def __init__(self, method, rel, line, symbol, payload_keys,
+                 resp_reads):
+        self.method = method
+        self.rel = rel
+        self.line = line
+        self.symbol = symbol
+        self.payload_keys = payload_keys     # set or None (dynamic)
+        self.resp_reads: Set[str] = resp_reads
+
+
+def _call_leaf(node: ast.Call) -> Optional[str]:
+    """The call-family leaf name of an invocation, else None.  Covers
+    ``x.call`` / ``x._call`` / ``x._call_status`` plus module-level
+    wrappers spelled ``*_call`` (graph/executors/admin._meta_call)."""
+    f = node.func
+    leaf = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if leaf is None:
+        return None
+    if leaf not in _CALL_LEAVES and not leaf.endswith("_call"):
+        return None
+    d = dotted(f) or leaf
+    if d.startswith(_SKIP_CALL_PREFIXES):
+        return None
+    return leaf
+
+
+def _method_of_call(node: ast.Call) -> Optional[Tuple[str, Optional[ast.AST]]]:
+    """(method, payload node) for a call-family invocation with a
+    literal method name, else None."""
+    if _call_leaf(node) is None:
+        return None
+    for i, a in enumerate(node.args):
+        s = _const_str(a)
+        if s is not None:
+            payload = node.args[i + 1] if i + 1 < len(node.args) else None
+            return s, payload
+    return None
+
+
+def _dynamic_method_param(node: ast.Call, params: Set[str]) -> bool:
+    """True when a call-family invocation routes a METHOD VARIABLE that
+    is one of the enclosing function's parameters — the generic
+    transport wrappers (RemoteDeviceRuntime._call, MetaClient._one_pass
+    ...).  Envelope keys such wrappers read apply to every method
+    routed through them."""
+    if _call_leaf(node) is None:
+        return False
+    return any(isinstance(a, ast.Name) and a.id in params
+               for a in node.args)
+
+
+class _ClientScan(ast.NodeVisitor):
+    """Call sites + response reads within one function scope."""
+
+    def __init__(self, mod, symbol: str, params: Set[str] = frozenset()):
+        self.mod = mod
+        self.symbol = symbol
+        self.params = set(params)
+        self.sites: List[CallSite] = []
+        # var name -> site (direct `resp = X.call(...)` binding)
+        self._bound: Dict[str, CallSite] = {}
+        # var name -> site for StatusOr (`r = self._call_status(...)`)
+        self._bound_statusor: Dict[str, CallSite] = {}
+        # vars bound to calls whose method is a PARAMETER — their
+        # envelope reads apply to every routed method
+        self._generic_vars: Set[str] = set()
+        self.generic_reads: Set[str] = set()
+
+    def _mk_site(self, node: ast.Call, mp) -> CallSite:
+        method, payload = mp
+        site = CallSite(method, self.mod.rel, node.lineno, self.symbol,
+                        _dict_keys(payload) if payload is not None
+                        else set(), set())
+        if payload is not None and _dict_keys(payload) is None:
+            site.payload_keys = None
+        self.sites.append(site)
+        return site
+
+    def visit_Assign(self, node):
+        if isinstance(node.value, ast.Call):
+            mp = _method_of_call(node.value)
+            if mp is not None and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                site = self._mk_site(node.value, mp)
+                if _call_leaf(node.value) == "_call_status":
+                    self._bound_statusor[node.targets[0].id] = site
+                else:
+                    self._bound[node.targets[0].id] = site
+                return self.generic_visit(node.value)
+            if mp is None and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _dynamic_method_param(node.value, self.params):
+                self._generic_vars.add(node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        mp = _method_of_call(node)
+        if mp is not None:
+            # not an Assign target (handled above) — still a site
+            if not any(s.line == node.lineno and s.method == mp[0]
+                       for s in self.sites):
+                self._mk_site(node, mp)
+        # resp.get("k") / r.value().get("k")
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "get" and node.args:
+            k = _const_str(node.args[0])
+            if k is not None:
+                base = f.value
+                site = self._site_of(base)
+                if site is not None:
+                    site.resp_reads.add(k)
+                elif isinstance(base, ast.Name) \
+                        and base.id in self._generic_vars:
+                    self.generic_reads.add(k)
+        self.generic_visit(node)
+
+    def _site_of(self, base) -> Optional[CallSite]:
+        """The call site a read expression refers to: a bound var, a
+        direct call chain, or a StatusOr .value() chain."""
+        if isinstance(base, ast.Name):
+            return self._bound.get(base.id)
+        if isinstance(base, ast.Call):
+            mp = _method_of_call(base)
+            if mp is not None:
+                for s in self.sites:
+                    if s.line == base.lineno and s.method == mp[0]:
+                        return s
+            f = base.func
+            if isinstance(f, ast.Attribute) and f.attr == "value" \
+                    and isinstance(f.value, ast.Name):
+                return self._bound_statusor.get(f.value.id)
+        return None
+
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, ast.Load):
+            k = _const_str(node.slice)
+            if k is not None:
+                site = self._site_of(node.value)
+                if site is not None:
+                    site.resp_reads.add(k)
+                elif isinstance(node.value, ast.Name) \
+                        and node.value.id in self._generic_vars:
+                    self.generic_reads.add(k)
+        self.generic_visit(node)
+
+    def visit_Return(self, node):
+        # the scatter-gather make_req contract: return "method", {...}
+        v = node.value
+        if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+            m = _const_str(v.elts[0])
+            if m is not None and isinstance(v.elts[1], ast.Dict):
+                self.sites.append(CallSite(
+                    m, self.mod.rel, node.lineno, self.symbol,
+                    _dict_keys(v.elts[1]), set()))
+        self.generic_visit(node)
+
+
+def _collect_call_sites(ctx: PackageContext
+                        ) -> Tuple[List[CallSite], Set[str]]:
+    from .core import qualname_map
+    out: List[CallSite] = []
+    generic_reads: Set[str] = set()
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = {a.arg for a in node.args.args}
+                scan = _ClientScan(mod, qmap.get(node, node.name),
+                                   params)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                out.extend(scan.sites)
+                generic_reads |= scan.generic_reads
+    # nested functions are revisited by ast.walk — dedupe on identity
+    seen = set()
+    uniq = []
+    for s in out:
+        key = (s.rel, s.line, s.method)
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append(s)
+    return uniq, generic_reads
+
+
+# ------------------------------------------------------------ rpc frame
+def _check_frame_contract(ctx: PackageContext) -> List[Violation]:
+    mod = next((m for m in ctx.modules
+                if m.rel.endswith("interface/rpc.py")), None)
+    if mod is None:
+        return []
+    out: List[Violation] = []
+    frame_lens: Set[int] = set()
+    max_part_idx = -1
+    env_consts: Set[str] = set()
+    env_written: Set[str] = set()
+    env_read: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = _const_str(node.value)
+            if v is not None and v.startswith("__") and v.endswith("__"):
+                env_consts.add(name)
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            if d.endswith("_pack") and node.args \
+                    and isinstance(node.args[0], ast.List):
+                frame_lens.add(len(node.args[0].elts))
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Name):
+                    env_read.add(a.id)
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "parts" \
+                    and isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, int):
+                max_part_idx = max(max_part_idx, node.slice.value)
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Name):
+                    env_written.add(k.id)
+
+    def v(line, msg):
+        out.append(Violation(CHECK, mod.rel, line, "interface.rpc", msg))
+
+    if 2 not in frame_lens:
+        v(1, "the untraced 2-element [method, payload] frame is gone — "
+             "untraced calls would pay the trace envelope")
+    if frame_lens and max_part_idx >= 0 \
+            and max_part_idx + 1 > max(frame_lens):
+        v(1, f"server indexes frame part {max_part_idx} but clients "
+             f"send at most {max(frame_lens)} elements")
+    for name in sorted(env_consts):
+        if name in env_written and name not in env_read:
+            v(1, f"envelope field {name} is written but never read — "
+                 f"dead piggyback payload")
+        if name in env_read and name not in env_written:
+            v(1, f"envelope field {name} is read but never written — "
+                 f"the client would always miss it")
+    return out
+
+
+# ------------------------------------------------------------ endpoints
+def _check_endpoints(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    registered: Dict[str, Tuple] = {}   # path -> (mod, handler attr)
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "register_handler" \
+                    and len(node.args) >= 2:
+                path = _const_str(node.args[0])
+                if path is None:
+                    continue
+                target = node.args[1]
+                attr = target.attr if isinstance(target, ast.Attribute) \
+                    else None
+                registered[path] = (mod, attr, node.lineno)
+    for path, contract in ENDPOINT_CONTRACT.items():
+        if path not in registered:
+            ws = next((m for m in ctx.modules
+                       if m.rel.endswith("webservice/service.py")), None)
+            if ws is not None:
+                out.append(Violation(
+                    CHECK, ws.rel, 1, "WebService",
+                    f"contract endpoint {path} is never registered"))
+            continue
+        mod, attr, line = registered[path]
+        if attr is None:
+            continue
+        produced: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == attr:
+                for ret in ast.walk(node):
+                    if isinstance(ret, ast.Return) \
+                            and isinstance(ret.value, ast.Tuple) \
+                            and len(ret.value.elts) == 2:
+                        keys = _dict_keys(ret.value.elts[1])
+                        if keys is not None:
+                            produced |= keys
+                fn_line = node.lineno
+                break
+        else:
+            continue
+        extra = produced - contract["keys"]
+        if extra:
+            out.append(Violation(
+                CHECK, mod.rel, fn_line, attr,
+                f"endpoint {path} returns undeclared payload key(s) "
+                f"{sorted(extra)} — update ENDPOINT_CONTRACT "
+                f"(tools/lint/wirecheck.py) with the new fields"))
+        if not contract.get("dynamic"):
+            missing = contract["keys"] - produced
+            if missing:
+                out.append(Violation(
+                    CHECK, mod.rel, fn_line, attr,
+                    f"endpoint {path} never produces declared key(s) "
+                    f"{sorted(missing)} — stale declaration"))
+    return out
+
+
+# ------------------------------------------------------------ top level
+def check_wire_contract(ctx: PackageContext) -> List[Violation]:
+    handlers = _collect_handlers(ctx)
+    sites, generic_reads = _collect_call_sites(ctx)
+    out: List[Violation] = []
+
+    called = {s.method for s in sites}
+    delegated = set()
+    for hs in handlers.values():
+        for h in hs:
+            delegated |= h.delegates
+
+    # W1: orphan client methods
+    for s in sites:
+        if s.method not in handlers:
+            out.append(Violation(
+                CHECK, s.rel, s.line, s.symbol,
+                f"RPC method '{s.method}' has no rpc_{s.method} "
+                f"handler anywhere — the call can only fail"))
+
+    # W2: orphan handlers
+    for method, hs in sorted(handlers.items()):
+        if method in called or method in delegated:
+            continue
+        for h in hs:
+            out.append(Violation(
+                CHECK, h.rel, h.line, h.symbol,
+                f"handler rpc_{method} has no in-tree caller"))
+
+    # W3/W4: request-key drift; W5: envelope reads
+    for s in sites:
+        hs = handlers.get(s.method)
+        if not hs:
+            continue
+        if s.payload_keys is not None:
+            required = set.union(*[h.required for h in hs]) \
+                if hs else set()
+            for k in sorted(required - s.payload_keys):
+                out.append(Violation(
+                    CHECK, s.rel, s.line, s.symbol,
+                    f"call to '{s.method}' never sends key '{k}' "
+                    f"required (req[...]) by the handler"))
+            if all(not h.open_reads for h in hs):
+                accepted = set.union(*[h.required | h.optional
+                                       for h in hs])
+                for k in sorted(s.payload_keys - accepted):
+                    out.append(Violation(
+                        CHECK, s.rel, s.line, s.symbol,
+                        f"call to '{s.method}' sends key '{k}' the "
+                        f"handler never reads — dead payload"))
+        if s.resp_reads and all(not h.open_resp for h in hs):
+            written = set.union(*[h.resp_keys for h in hs])
+            for k in sorted(s.resp_reads - written - _TRANSPORT_KEYS):
+                out.append(Violation(
+                    CHECK, s.rel, s.line, s.symbol,
+                    f"reads response field '{k}' of '{s.method}' "
+                    f"which no handler ever writes"))
+
+    # W6: dead envelope fields (methods with analyzed read sites only)
+    reads_by_method: Dict[str, Set[str]] = {}
+    for s in sites:
+        if s.resp_reads:
+            reads_by_method.setdefault(s.method, set()).update(
+                s.resp_reads)
+    for method, hs in sorted(handlers.items()):
+        reads = reads_by_method.get(method)
+        if not reads:
+            continue
+        for h in hs:
+            if h.open_resp or not h.resp_keys:
+                continue
+            for k in sorted(h.resp_keys - reads - generic_reads):
+                out.append(Violation(
+                    CHECK, h.rel, h.line, h.symbol,
+                    f"response field '{k}' of rpc_{method} is written "
+                    f"but no caller reads it"))
+
+    out += _check_frame_contract(ctx)
+    out += _check_endpoints(ctx)
+    return out

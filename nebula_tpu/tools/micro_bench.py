@@ -173,9 +173,33 @@ def bench_query(reps: int) -> dict:
         cluster.stop()
 
 
+def bench_lint(budget_s: float) -> dict:
+    """Wall time of the whole-package nebulint run (all eight checks —
+    the jaxpr tracing of every registered kernel bucket included).
+    The analysis gates tier-1, so it must stay interactive: exceeding
+    ``budget_s`` is reported as a guard failure in the result (and
+    main() exits non-zero on it)."""
+    from .lint import run_lint
+    from .lint.core import DEFAULT_BASELINE
+    import nebula_tpu
+    import os
+    root = os.path.dirname(os.path.abspath(nebula_tpu.__file__))
+    t0 = time.perf_counter()
+    vs, _bl = run_lint(root, baseline_path=DEFAULT_BASELINE)
+    elapsed = time.perf_counter() - t0
+    return {"wall_s": round(elapsed, 2),
+            "budget_s": budget_s,
+            "violations": len(vs),
+            "within_budget": elapsed <= budget_s}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--lint-budget-s", type=float, default=20.0,
+                    help="fail when the whole-package nebulint run "
+                         "exceeds this wall time (the static analysis "
+                         "must stay a few seconds to gate tier-1)")
     args = ap.parse_args(argv)
     reps = 50 if args.quick else 400
     rows = 20_000 if args.quick else 200_000
@@ -187,9 +211,10 @@ def main(argv=None) -> int:
         "key_codec": bench_keys(rows),
         "wal": bench_wal(entries),
         "query_path": bench_query(qreps),
+        "lint": bench_lint(args.lint_budget_s),
     }
     print(json.dumps(out))
-    return 0
+    return 0 if out["lint"]["within_budget"] else 1
 
 
 if __name__ == "__main__":

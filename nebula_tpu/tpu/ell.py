@@ -416,7 +416,7 @@ def unpack_bits(packed: np.ndarray, R1: int) -> np.ndarray:
 
 def make_batched_go_kernel(ell: EllIndex, steps: int,
                            etypes: Tuple[int, ...], pack: bool = False,
-                           upto: bool = False):
+                           upto: bool = False, donate: bool = False):
     """fn(f0 [n_rows+1, B] int8, owner, *tables) -> frontier after
     ``steps-1`` advances (the final hop's edge set is frontier[src] &
     etype_ok, materialised by the caller — same split as
@@ -431,7 +431,6 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
     import jax.numpy as jnp
     n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
 
-    @jax.jit
     def go(f0, owner, *tables):
         nbrs, ets = tables[:nb], tables[nb:]
 
@@ -453,12 +452,21 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
             out = jax.lax.fori_loop(0, steps - 1, one, f0)
         return pack_bits(jnp, out) if pack else out
 
-    return go
+    # ``donate`` (the RUNTIME's dispatch configuration —
+    # _launch_dense builds f0 fresh per dispatch, so handing the
+    # [n_rows+1, B] buffer to XLA lets the hop loop reuse its HBM
+    # instead of holding both live; jaxaudit verifies the claim on the
+    # traced pjit).  OPT-IN because a donated frontier is CONSUMED:
+    # callers that re-dispatch one frontier (bench drivers, parity
+    # tests) — or that pass a numpy array jax may zero-copy alias on
+    # CPU — must keep the default
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
 def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
                                  etypes: Tuple[int, ...], cap: int,
-                                 pack: bool = False):
+                                 pack: bool = False,
+                                 donate: bool = False):
     """Batched GO over the base ELL plus up to ``cap`` overlay edges
     (incremental CSR maintenance: freshly committed edge inserts ride
     as (src, dst, etype) triples in the ell's NEW-id space instead of
@@ -468,7 +476,6 @@ def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
     import jax.numpy as jnp
     n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
 
-    @jax.jit
     def go(f0, dsrc, ddst, det, owner, *tables):
         nbrs, ets = tables[:nb], tables[nb:]
         ok = _etype_ok(jnp, det, etypes).astype(jnp.int8)
@@ -482,7 +489,11 @@ def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
             jax.lax.fori_loop(0, steps - 1, one, f0)
         return pack_bits(jnp, out) if pack else out
 
-    return go
+    # f0 only (opt-in, see make_batched_go_kernel): dsrc/ddst/det are
+    # CACHED per delta generation (runtime._delta_device) and
+    # re-dispatched, so donating them would invalidate a live cache
+    # entry
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
 def sparse_caps(c0: int, d_max: int, steps: int, cap: int,
@@ -843,12 +854,14 @@ def make_adaptive_go_kernel(ell: EllIndex, steps: int,
         import jax.numpy as jnp2
         return go(jnp2.asarray(fr0), hub, owner, *tables)
 
+    entry._jitted = go          # jaxaudit traces the device half
     return entry
 
 
 def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
                             etypes: Tuple[int, ...],
-                            stop_when_found: bool = True):
+                            stop_when_found: bool = True,
+                            donate: bool = False):
     """fn(f0, targets, owner, *tables) -> depth [n_rows+1, B]:
     int8 with -1 = unreachable when max_steps fits (the transfer is 2x
     smaller and depths are tiny), else int16 with INT16_INF.  Batched
@@ -859,7 +872,6 @@ def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
     n, n_extras, nb_count = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
     small = max_steps <= 120
 
-    @jax.jit
     def bfs(f0, targets, owner, *tables):
         nbrs, ets = tables[:nb_count], tables[nb_count:]
         d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
@@ -886,7 +898,10 @@ def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
             return jnp.where(d == INT16_INF, -1, d).astype(jnp.int8)
         return d
 
-    return bfs
+    # both frontier matrices are built fresh per dispatch by
+    # runtime._bfs_depths — single-use there, so the runtime opts in
+    # (see make_batched_go_kernel for why the default stays off)
+    return jax.jit(bfs, donate_argnums=(0, 1) if donate else ())
 
 
 # ====================================================================
@@ -1520,3 +1535,162 @@ def make_frontier_sharded_sparse_bfs_kernel(mesh, axis: str,
                                  check_vma=False))
 
     return build
+
+
+# ====================================================================
+# Kernel-registry entries (tpu/kernels.py KernelSpec) — the abstract
+# signatures jaxaudit traces for the ELL kernel families, bucketed by
+# the SAME pinned flag ladders the runtime dispatches on.
+# ====================================================================
+from .kernels import KernelSpec, register_kernel  # noqa: E402
+
+
+def _ell_go_buckets(fx):
+    R1 = fx.ell.n_rows + 1
+    out = []
+    for upto in (False, True):
+        # audit-time instantiation: traced by jaxaudit, never
+        # dispatched — not the serving hot path
+        kern = make_batched_go_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes,
+            pack=True, upto=upto, donate=True)
+        for B in fx.widths:
+            out.append((("ell_go", fx.ell.shape_sig(), fx.etypes,
+                         fx.steps, upto), kern,
+                        (fx.aval((R1, B), np.int8),) + fx.table_avals()))
+    return out
+
+
+def _sparse_go_buckets(fx):
+    d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
+    n1 = fx.ell.n + 1
+    out = []
+    for upto in (False, True):
+        for c0 in fx.c0s:
+            caps = sparse_caps(c0, d_max, fx.steps, fx.sparse_cap,
+                               growth=fx.sparse_growth)
+            kern = make_batched_sparse_go_kernel(  # nebulint: disable=jax-hotpath
+                fx.ell, fx.steps, fx.etypes, caps, qmax=fx.qmax,
+                upto=upto)
+            out.append((("sparse_go", fx.ell.shape_sig(), fx.etypes,
+                         fx.steps, caps, fx.qmax, upto), kern,
+                        (fx.aval((c0,), np.int32),
+                         fx.aval((c0,), np.int32),
+                         fx.aval((n1,), np.int32),
+                         fx.aval((n1,), np.int32))
+                        + fx.table_avals()[1:]))    # no owner arg
+    return out
+
+
+def _adaptive_go_buckets(fx):
+    entry = make_adaptive_go_kernel(fx.ell, fx.steps, fx.etypes,
+                                    K=fx.adaptive_k)
+    return [(("adaptive_go", fx.ell.shape_sig(), fx.etypes, fx.steps,
+              fx.adaptive_k), entry._jitted,
+             (fx.aval((fx.adaptive_k,), np.int32),
+              fx.aval((fx.ell.n + 1,), np.bool_)) + fx.table_avals())]
+
+
+def _ell_bfs_buckets(fx):
+    R1 = fx.ell.n_rows + 1
+    out = []
+    for shortest in (True, False):
+        kern = make_batched_bfs_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes,
+            stop_when_found=shortest, donate=True)
+        for B in fx.widths:
+            out.append((("ell_bfs", fx.ell.shape_sig(), fx.etypes,
+                         fx.steps, shortest), kern,
+                        (fx.aval((R1, B), np.int8),
+                         fx.aval((R1, B), np.int8)) + fx.table_avals()))
+    return out
+
+
+def _ell_go_delta_buckets(fx):
+    R1 = fx.ell.n_rows + 1
+    out = []
+    for cap in (8, 4096):           # the pow-2 overlay ladder's ends
+        kern = make_batched_go_delta_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes, cap, pack=True, donate=True)
+        out.append((("ell_go_delta", fx.ell.shape_sig(), fx.etypes,
+                     fx.steps, cap), kern,
+                    (fx.aval((R1, fx.widths[0]), np.int8),
+                     fx.aval((cap,), np.int32),
+                     fx.aval((cap,), np.int32),
+                     fx.aval((cap,), np.int32)) + fx.table_avals()))
+    return out
+
+
+register_kernel(KernelSpec(
+    "ell_go", make_batched_go_kernel, phase_kind="ell_go",
+    # per steps value: one retrace per pinned batch width per
+    # exact/upto variant (the runtime's prewarm compiles exactly these)
+    budget=4, instantiate=_ell_go_buckets, donate=(0,), dispatch=(0,),
+    frontier=(0,)))
+register_kernel(KernelSpec(
+    "sparse_go", make_batched_sparse_go_kernel, phase_kind="sparse_go",
+    # per steps value: one retrace per sparse c0 rung per variant
+    budget=4, instantiate=_sparse_go_buckets, dispatch=(0, 1)))
+register_kernel(KernelSpec(
+    "adaptive_go", make_adaptive_go_kernel, phase_kind="adaptive_go",
+    budget=1, instantiate=_adaptive_go_buckets, dispatch=(0,)))
+register_kernel(KernelSpec(
+    "ell_bfs", make_batched_bfs_kernel, phase_kind="ell_bfs",
+    budget=4, instantiate=_ell_bfs_buckets, donate=(0, 1),
+    dispatch=(0, 1), frontier=(0, 1)))
+register_kernel(KernelSpec(
+    "ell_go_delta", make_batched_go_delta_kernel,
+    phase_kind="ell_go_delta",
+    # per steps value: one retrace per pow-2 overlay-capacity rung
+    # (log2(mirror_delta_max) rungs bound the ladder)
+    budget=12, instantiate=_ell_go_delta_buckets, donate=(0,),
+    dispatch=(0,), frontier=(0,)))
+
+
+def _ell_go_sharded_buckets(fx):
+    mesh = fx.mesh()
+    nbrs, ets, reals = shard_ell(mesh, "parts", fx.ell)
+    kern = make_sharded_batched_go_kernel(  # nebulint: disable=jax-hotpath
+        mesh, "parts", fx.ell, fx.steps, fx.etypes, nbrs, ets, reals,
+        pack=True)
+    R1 = fx.ell.n_rows + 1
+    owner = fx.aval((len(fx.ell.extra_owner),), np.int32)
+    tables = tuple(fx.aval(a.shape, np.int32) for a in nbrs) \
+        + tuple(fx.aval(a.shape, np.int32) for a in ets)
+    return [(("ell_go_sharded", fx.ell.shape_sig(), fx.etypes,
+              fx.steps, 1), kern,
+             (fx.aval((R1, B), np.int8), owner) + tables)
+            for B in fx.widths]
+
+
+def _ell_bfs_sharded_buckets(fx):
+    mesh = fx.mesh()
+    nbrs, ets, reals = shard_ell(mesh, "parts", fx.ell)
+    R1 = fx.ell.n_rows + 1
+    B = fx.widths[0]
+    owner = fx.aval((len(fx.ell.extra_owner),), np.int32)
+    tables = tuple(fx.aval(a.shape, np.int32) for a in nbrs) \
+        + tuple(fx.aval(a.shape, np.int32) for a in ets)
+    out = []
+    for shortest in (True, False):
+        kern = make_sharded_batched_bfs_kernel(  # nebulint: disable=jax-hotpath
+            mesh, "parts", fx.ell, fx.steps, fx.etypes, nbrs, ets,
+            reals, stop_when_found=shortest)
+        out.append((("ell_bfs_sharded", fx.ell.shape_sig(), fx.etypes,
+                     fx.steps, shortest, 1), kern,
+                    (fx.aval((R1, B), np.int8),
+                     fx.aval((R1, B), np.int8), owner) + tables))
+    return out
+
+
+register_kernel(KernelSpec(
+    "ell_go_sharded", make_sharded_batched_go_kernel,
+    phase_kind="ell_go_sharded",
+    # per steps value: one retrace per pinned batch width
+    budget=2, instantiate=_ell_go_sharded_buckets, dispatch=(0,),
+    frontier=(0,)))
+register_kernel(KernelSpec(
+    "ell_bfs_sharded", make_sharded_batched_bfs_kernel,
+    phase_kind="ell_bfs_sharded",
+    budget=2, instantiate=_ell_bfs_sharded_buckets, dispatch=(0, 1),
+    frontier=(0, 1)))

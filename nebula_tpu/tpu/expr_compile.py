@@ -455,3 +455,62 @@ def _cmp_fn(a: CVal, b: CVal, op: str):
 def _py_cmp(a, b, op: str) -> bool:
     return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
             "==": a == b, "!=": a != b}[op]
+
+
+# ====================================================================
+# Kernel-registry entry (tpu/kernels.py KernelSpec): the CVal/Env
+# device-filter machinery as jaxaudit traces it.  A representative
+# compiled WHERE — integer modulo compare AND a division with a LIVE
+# div guard over a non-constant denominator — built by the REAL
+# ExprCompiler (EdgeRankExpr needs no mirror), then evaluated the way
+# runtime._run_go_kernel's fused filter closures evaluate cvals.
+# ====================================================================
+def audit_filter_entry():
+    """(jitted fn(env_cols) -> bool mask, env aval builder) for the
+    registry; the traced graph covers _arith's guarded idiv/imod
+    lowering, _cmp_fn, _to_bool and a div-guard mask merge."""
+    import jax
+    import jax.numpy as jnp
+    from ..filter.expressions import (ArithmeticExpr, EdgeRankExpr,
+                                      LogicalExpr, PrimaryExpr,
+                                      RelationalExpr)
+
+    comp = ExprCompiler(None, 0, None, {"e": 1})
+    tree = LogicalExpr(
+        "&&",
+        RelationalExpr("!=",
+                       ArithmeticExpr("%", EdgeRankExpr("e"),
+                                      PrimaryExpr(7)),
+                       PrimaryExpr(0)),
+        RelationalExpr(">=",
+                       ArithmeticExpr("/", PrimaryExpr(10),
+                                      EdgeRankExpr("e")),
+                       PrimaryExpr(0)))
+    cval = comp.compile(tree)
+    guards = list(comp.div_guards)
+
+    def filt(env_cols):
+        env = Env(jnp, env_cols)
+        mask = jnp.asarray(cval.fn(env))
+        if mask.dtype != jnp.bool_:
+            mask = mask != 0
+        for g in guards:
+            mask = mask & jnp.logical_not(g(env))
+        return mask
+
+    return jax.jit(filt)
+
+
+def _expr_filter_buckets(fx):
+    kern = audit_filter_entry()
+    return [(("expr_filter",), kern,
+             ({"rank": fx.aval((fx.m,), np.int32)},))]
+
+
+from .kernels import KernelSpec, register_kernel  # noqa: E402
+
+register_kernel(KernelSpec(
+    "expr_filter", audit_filter_entry, phase_kind="expr_filter",
+    # one compiled program per (space, build, expr) by design; the
+    # audit proves the machinery's IR, not a shape ladder
+    budget=1, instantiate=_expr_filter_buckets, dispatch=(0,)))

@@ -192,6 +192,44 @@ flags.define(
     "load_data_interval_secs=120s, MetaClient.cpp:13-14)")
 
 
+# ====================================================================
+# Declared device-dispatch phase structure — the runtime's side of the
+# contract tools/lint/jaxaudit.py audits every registered kernel
+# against (tpu/kernels.py KERNEL_REGISTRY).  Per kernel kind:
+#   phases  the nebulatrace spans (SPAN_NAMES literals) a dispatch of
+#           this kind passes through (PR 3 phase attribution)
+#   h2d     host->device argument-leaf uploads paid PER DISPATCH
+#           (mirror-resident tables excluded — they upload per build)
+#   d2h     device->host fetches the resolver performs per dispatch
+# Drift in either direction fails tier-1: a kernel growing an output
+# (an extra fetch) or a new per-dispatch upload must update this table
+# — the declaration is the review surface, exactly like the
+# reference's Thrift IDL.
+# ====================================================================
+DEVICE_PHASES = {
+    "ell_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
+                          "tpu.assemble"), "h2d": 1, "d2h": 1},
+    "sparse_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
+                             "tpu.assemble"), "h2d": 2, "d2h": 1},
+    "adaptive_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
+                               "tpu.assemble"), "h2d": 1, "d2h": 1},
+    "ell_go_delta": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
+                                "tpu.assemble"), "h2d": 1, "d2h": 1},
+    "ell_bfs": {"phases": ("tpu.kernel", "tpu.fetch"), "h2d": 2,
+                "d2h": 1},
+    "ell_go_sharded": {"phases": ("tpu.launch", "tpu.kernel",
+                                  "tpu.fetch", "tpu.assemble"),
+                       "h2d": 1, "d2h": 1},
+    "ell_bfs_sharded": {"phases": ("tpu.kernel", "tpu.fetch"),
+                        "h2d": 2, "d2h": 1},
+    "go_fused": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 2},
+    "go_filtered": {"phases": ("tpu.kernel",), "h2d": 3, "d2h": 2},
+    "bfs_fused": {"phases": ("tpu.kernel",), "h2d": 2, "d2h": 1},
+    "go_sharded": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 2},
+    "expr_filter": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 1},
+}
+
+
 class TpuQueryRuntime:
     def __init__(self, storage_nodes, schema_man, remote_provider=None):
         # storage_nodes: objects with .kv (NebulaStore); the runtime is the
@@ -1085,7 +1123,8 @@ class TpuQueryRuntime:
             kern = self._kernel(
                 ("ell_go_delta", ix.shape_sig(), et_tuple, steps),
                 lambda: make_batched_go_delta_kernel(ix, steps, et_tuple,
-                                                     cap, pack=True))
+                                                     cap, pack=True,
+                                                     donate=True))
             with tracing.span("tpu.kernel", kind="ell_go_delta"):
                 out_dev = kern(f0_dev, dsrc, ddst, det, *args)
         elif mesh_mt is not None:
@@ -1101,8 +1140,11 @@ class TpuQueryRuntime:
         else:
             kern = self._kernel(
                 ("ell_go", ix.shape_sig(), et_tuple, steps, upto),
+                # donate=True: f0 is built fresh per dispatch right
+                # above (_upload_frontier) — single-use by construction
                 lambda: make_batched_go_kernel(ix, steps, et_tuple,
-                                               pack=True, upto=upto))
+                                               pack=True, upto=upto,
+                                               donate=True))
             # family registration BEFORE the first/_note check (like
             # the sparse path): same-family queries racing the first
             # compile must still be counted against the warm
@@ -1200,7 +1242,8 @@ class TpuQueryRuntime:
                         ("ell_go", ix.shape_sig(), et_tuple, steps,
                          False),
                         lambda: make_batched_go_kernel(
-                            ix, steps, et_tuple, pack=True))
+                            ix, steps, et_tuple, pack=True,
+                            donate=True))   # must match live dispatch
                     kern.lower(i32((ix.n_rows + 1, B), np.int8),
                                *args).compile()
                     with self._lock:
@@ -1417,8 +1460,13 @@ class TpuQueryRuntime:
             plan = self._replan_or_raise(space_id, plan, where_expr, m,
                                          ExcType)
         start_idx = _pad_pow2(m.to_dense(start_vids))
-        final_mask, frontier = self._run_go_kernel(
-            m, space_id, steps, et_tuple, plan, start_idx)
+        # the fused dispatch must be phase-attributable like every
+        # other kernel kind (DEVICE_PHASES) — PROFILE otherwise showed
+        # device-filter queries as unattributed wall time
+        with tracing.span("tpu.kernel", kind="go_fused",
+                          starts=len(start_vids)):
+            final_mask, frontier = self._run_go_kernel(
+                m, space_id, steps, et_tuple, plan, start_idx)
         final_mask = np.asarray(final_mask)
         frontier = np.asarray(frontier)
         vs = np.nonzero(frontier[:m.n])[0]
@@ -2154,8 +2202,10 @@ class TpuQueryRuntime:
         if mt is None:
             kern = self._kernel(
                 ("ell_bfs", ix.shape_sig(), et_tuple, max_steps, shortest),
+                # donate=True: f0/t0 are built fresh per dispatch below
                 lambda: make_batched_bfs_kernel(
-                    ix, max_steps, et_tuple, stop_when_found=shortest))
+                    ix, max_steps, et_tuple, stop_when_found=shortest,
+                    donate=True))
             table_args = args
         else:
             mesh, nbrs, ets, reals = mt
@@ -2171,9 +2221,13 @@ class TpuQueryRuntime:
         t0_dev = self._upload_frontier(
             ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
         self.stats["path_device"] += nq
-        d_dev = kern(f0_dev, t0_dev, *table_args)
+        with tracing.span("tpu.kernel",
+                          kind="ell_bfs" if mt is None
+                          else "ell_bfs_sharded", queries=nq):
+            d_dev = kern(f0_dev, t0_dev, *table_args)
         nqp = min(B, max(8, -(-nq // 8) * 8))
-        host = np.asarray(d_dev[:, :nqp])[:, :nq]   # device-side slice
+        with tracing.span("tpu.fetch"):
+            host = np.asarray(d_dev[:, :nqp])[:, :nq]   # device slice
         if host.dtype == np.int8:        # in-kernel compression (-1=INF)
             d = np.where(host < 0, INT16_INF, host).astype(np.int16)
         else:

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Static-analysis + sanitizer gate (docs/static_analysis.md):
-#   1. nebulint — the five project-invariant AST checks over nebula_tpu
-#      (lock discipline, lock-order cycles, Status discipline, JAX
-#      hot-path hygiene, flag registry consistency);
+#   1. nebulint — the eight whole-package checks over nebula_tpu: the
+#      five AST checks (lock discipline, lock-order cycles, Status
+#      discipline, JAX hot-path hygiene, flag registry), the span
+#      registry, and the two SEMANTIC passes — the jaxpr device-path
+#      auditor (traces every registered kernel across its shape
+#      buckets; needs jax but no accelerator, hence JAX_PLATFORMS=cpu)
+#      and the RPC wire-contract checker;
 #   2. asan_driver — the native C ABI driven under the ASan+UBSan build,
 #      when `make -C native asan` has produced the instrumented .so and
 #      libasan is present (skipped, loudly, otherwise).
@@ -10,8 +14,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== nebulint (static analysis) =="
-python -m nebula_tpu.tools.lint
+echo "== nebulint (static + semantic analysis) =="
+JAX_PLATFORMS=cpu python -m nebula_tpu.tools.lint
 
 if [ -f native/libnebula_native_asan.so ]; then
   libasan="$(gcc -print-file-name=libasan.so 2>/dev/null || true)"

@@ -524,6 +524,71 @@ def test_meta_dispatched_bulk_load(tmp_path):
         c.stop()
 
 
+def test_download_ingest_statements(tmp_path):
+    """The nGQL ``DOWNLOAD HDFS "..."`` / ``INGEST`` statements reach
+    metad as the ``download``/``ingest`` RPCs (regression: wirecheck's
+    first run found the executors calling methods NO handler served —
+    the statements could only fail while the web-dispatch path worked)."""
+    import struct
+    from nebula_tpu.common.clock import inverted_version
+    from nebula_tpu.common.keys import KeyUtils, id_hash
+    from nebula_tpu.codec.rows import encode_row
+    from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+    from nebula_tpu.storage.web import register_web_handlers
+
+    c = LocalCluster(num_storage=1, use_tcp=True,
+                     data_paths=[str(tmp_path / "data")])
+    web_services = []
+    try:
+        client = c.client()
+        assert client.execute("CREATE SPACE bulks(partition_num=4, "
+                              "replica_factor=1)").ok()
+        c.refresh_all()
+        assert client.execute("USE bulks; CREATE EDGE e(w int)").ok()
+        c.refresh_all()
+        space_id = c.graph_meta_client.get_space_id_by_name(
+            "bulks").value()
+        etype = c.graph_meta_client.get_edge_type(space_id, "e").value()
+
+        for node in c.storage_nodes:
+            ws = WebService("storaged-test", host="127.0.0.1").start()
+            register_web_handlers(ws, node)
+            web_services.append(ws)
+            node.meta_client.hb_info["ws_port"] = ws.port
+            node.meta_client.heartbeat()
+
+        schema = Schema(columns=[ColumnDef("w", SupportedType.INT)])
+        frame = struct.Struct(">II")
+        src_dir = tmp_path / "stmt_src"
+        src_dir.mkdir()
+        kvs = []
+        for i in range(12):
+            part = id_hash(1, 4)
+            key = KeyUtils.edge_key(part, 1, etype, 0, 200 + i,
+                                    inverted_version())
+            kvs.append((key, encode_row(schema, {"w": i})))
+        kvs.sort()
+        with open(src_dir / "edges.snap", "wb") as f:
+            for k, v in kvs:
+                f.write(frame.pack(len(k), len(v)))
+                f.write(k)
+                f.write(v)
+
+        r = client.execute(f'USE bulks; DOWNLOAD HDFS "file://{src_dir}"')
+        assert r.ok(), r.error_msg
+        r = client.execute("USE bulks; INGEST")
+        assert r.ok(), r.error_msg
+
+        resp = client.execute("USE bulks; GO FROM 1 OVER e YIELD e._dst")
+        assert resp.ok(), resp.error_msg
+        assert sorted(x[0] for x in resp.rows) == [200 + i
+                                                   for i in range(12)]
+    finally:
+        for ws in web_services:
+            ws.stop()
+        c.stop()
+
+
 def test_hdfs_download_shells_out(tmp_path, monkeypatch):
     """hdfs:// download urls shell out to `hdfs dfs -get` exactly like
     the reference (HdfsCommandHelper.h) — driven here through a fake

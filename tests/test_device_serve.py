@@ -373,3 +373,39 @@ class TestUptoDeclineCacheHealing:
         rt._device_host = lambda sid: (("h2", 1), [1])
         assert self._can_run(rt) is True
         assert 7 not in rt._upto_declined
+
+    def test_decline_dropped_on_meta_refresh(self):
+        """ADVICE.md round 5: a storaged restarted WITHOUT mesh
+        sharding (same host, same placement) must resume UPTO traffic
+        as soon as graphd's meta cache refreshes — not only after the
+        TTL or a graphd restart.  load_data bumps
+        MetaClient.data_generation; any bump drops the entry."""
+        from types import SimpleNamespace
+        meta = SimpleNamespace(data_generation=41)
+        rt = self._declined_runtime()
+        rt.meta = meta
+        # re-note against the live meta so the entry carries its gen
+        rt._note_upto_declined(7, ("h", 1))
+        assert self._can_run(rt) is False      # same generation: binds
+        meta.data_generation += 1              # a load_data completed
+        assert self._can_run(rt) is True
+        assert 7 not in rt._upto_declined
+
+    def test_meta_client_load_data_bumps_generation(self):
+        """The generation the decline cache keys on really moves on
+        every completed load_data."""
+        from nebula_tpu.interface.common import HostAddr
+        from nebula_tpu.interface.rpc import ClientManager
+        from nebula_tpu.meta.client import MetaClient
+        from nebula_tpu.meta.service import MetaService
+
+        cm = ClientManager()
+        svc = MetaService()
+        addr = HostAddr("127.0.0.1", 45990)
+        cm.register_loopback(addr, svc)
+        mc = MetaClient([addr], client_manager=cm)
+        g0 = mc.data_generation
+        mc.load_data()
+        assert mc.data_generation == g0 + 1
+        mc.load_data()
+        assert mc.data_generation == g0 + 2

@@ -155,8 +155,10 @@ def test_sharded_batched_go_parity():
     ix = E.EllIndex.build(es, ed, ee, n, cap=8, min_d=2)
     steps = 3
     starts = [rng.integers(0, n, 3) for _ in range(4)]
-    f0 = jnp.asarray(ix.start_frontier([np.asarray(s) for s in starts],
-                                       B=128))
+    # f0 stays a HOST array and each kernel call converts its own
+    # device copy — the runtime's dispatch paths build theirs with
+    # donate=True (single-use), which a shared device f0 would break
+    f0 = ix.start_frontier([np.asarray(s) for s in starts], B=128)
     ref = run_go(ix, steps, (1,), f0)
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("parts",))
@@ -164,7 +166,7 @@ def test_sharded_batched_go_parity():
     go = E.make_sharded_batched_go_kernel(mesh, "parts", ix, steps, (1,),
                                           nbrs, ets, reals)
     owner = jnp.asarray(ix.extra_owner)
-    got = np.asarray(go(f0, owner, *nbrs, *ets))
+    got = np.asarray(go(jnp.asarray(f0), owner, *nbrs, *ets))
     np.testing.assert_array_equal(got, ref)
 
 

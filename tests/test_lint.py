@@ -383,7 +383,8 @@ def test_package_has_no_stale_baseline_entries():
 def test_all_checks_registered():
     assert set(ALL_CHECKS) == {"lock-discipline", "lock-order",
                                "status-discard", "jax-hotpath",
-                               "flag-registry", "span-registry"}
+                               "flag-registry", "span-registry",
+                               "jaxpr-audit", "wire-contract"}
 
 
 # ========================================== OrderedLock runtime watchdog
@@ -500,3 +501,383 @@ def test_hotpath_mutable_literal_in_other_kwarg_not_flagged(tmp_path):
 def test_missing_explicit_baseline_is_config_error(tmp_path):
     with pytest.raises(LintError):
         run_lint(PKG_ROOT, baseline_path=str(tmp_path / "typo.json"))
+
+
+# ================================================== 7 · jaxpr-audit
+def _audit(specs, phases, span_names=("tpu.kernel",)):
+    from nebula_tpu.tools.lint.jaxaudit import audit_specs
+    vs, _kinds = audit_specs(specs, None, phases,
+                             span_names, lambda s: ("pkg/fake.py", 1))
+    return vs
+
+
+def _spec(fn, avals, *, name="k", budget=4, donate=(), dispatch=(),
+          frontier=(), buckets=None):
+    from nebula_tpu.tpu.kernels import KernelSpec
+    return KernelSpec(
+        name, fn, phase_kind="k", budget=budget,
+        instantiate=(buckets or (lambda fx: [(("k",), fn, avals)])),
+        donate=donate, dispatch=dispatch, frontier=frontier)
+
+
+_PHASES_1IN_1OUT = {"k": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 1}}
+
+
+def test_jaxaudit_flags_loop_callback():
+    """Seeded violation: a pure_callback inside the hop loop — the
+    exact host-round-trip-per-hop class the audit exists to block."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def bad(x):
+        def body(i, acc):
+            return acc + jax.pure_callback(
+                lambda v: v, jax.ShapeDtypeStruct((8,), np.int32), x)
+        return jax.lax.fori_loop(0, 4, body, x)
+
+    vs = _audit([_spec(bad, (jax.ShapeDtypeStruct((8,), np.int32),),
+                       dispatch=(0,))], _PHASES_1IN_1OUT)
+    assert any("host callback" in v.message for v in vs), vs
+
+
+def test_jaxaudit_flags_64bit_promotion():
+    """Seeded violation: an int64 loop-carried buffer (visible because
+    the audit traces under enable_x64)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def bad(x):
+        def body(i, acc):
+            return acc + x.astype(jnp.int64)
+        acc0 = jnp.zeros(x.shape, jnp.int64)
+        return jax.lax.fori_loop(0, 3, body, acc0).astype(jnp.int32)
+
+    vs = _audit([_spec(bad, (jax.ShapeDtypeStruct((8,), np.int32),),
+                       dispatch=(0,))], _PHASES_1IN_1OUT)
+    assert any("int64" in v.message and "carry" in v.message
+               for v in vs), vs
+
+
+def test_jaxaudit_flags_unbounded_bucket_space():
+    """Seeded violation: more distinct (cache key, signature) pairs
+    than the declared retrace budget."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def k(x):
+        return x + 1
+
+    def buckets(fx):
+        return [((("k", s)), k, (jax.ShapeDtypeStruct((s,), np.int32),))
+                for s in (8, 16, 32, 64)]
+
+    vs = _audit([_spec(k, None, budget=2, dispatch=(0,),
+                       buckets=buckets)], _PHASES_1IN_1OUT)
+    assert any("retrace budget" in v.message for v in vs), vs
+
+
+def test_jaxaudit_flags_donation_drift():
+    """Seeded violations, both directions: claiming donation the jit
+    doesn't perform, and donating what the spec says is cached."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def undonated(x):
+        return x + 1
+
+    donated = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    av = (jax.ShapeDtypeStruct((8,), np.int8),)
+    vs = _audit([_spec(undonated, av, donate=(0,), dispatch=(0,))],
+                _PHASES_1IN_1OUT)
+    assert any("donation drift" in v.message for v in vs), vs
+    vs = _audit([_spec(donated, av, donate=(), dispatch=(0,))],
+                _PHASES_1IN_1OUT)
+    assert any("donation drift" in v.message for v in vs), vs
+
+
+def test_jaxaudit_flags_transfer_drift():
+    """Seeded violation: a kernel growing a second output (an extra
+    device->host fetch) without updating DEVICE_PHASES."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def two_out(x):
+        return x + 1, x * 2
+
+    vs = _audit([_spec(two_out, (jax.ShapeDtypeStruct((8,), np.int32),),
+                       dispatch=(0,))], _PHASES_1IN_1OUT)
+    assert any("output fetches" in v.message for v in vs), vs
+
+
+def test_jaxaudit_flags_wide_frontier():
+    """Seeded violation: a declared frontier bitmap that is int32."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def k(f):
+        return f
+
+    vs = _audit([_spec(k, (jax.ShapeDtypeStruct((8,), np.int32),),
+                       dispatch=(0,), frontier=(0,))], _PHASES_1IN_1OUT)
+    assert any("frontier argument" in v.message for v in vs), vs
+
+
+def test_jaxaudit_package_registry_is_clean_within_budgets():
+    """Acceptance: the auditor runs over EVERY registered kernel
+    factory across all shape buckets and the per-kernel retrace-budget
+    table holds — zero violations on the real registry."""
+    from nebula_tpu.common.tracing import SPAN_NAMES
+    from nebula_tpu.tools.lint.jaxaudit import audit_specs
+    from nebula_tpu.tpu import runtime as rt
+    from nebula_tpu.tpu.kernels import AuditFixture, kernel_registry
+
+    registry = kernel_registry()
+    assert {"go", "go_filtered", "bfs", "sharded_go", "ell_go",
+            "sparse_go", "adaptive_go", "ell_bfs", "ell_go_delta",
+            "expr_filter"} <= set(registry)
+    fx = AuditFixture()
+    vs, kinds = audit_specs(registry.values(), fx, rt.DEVICE_PHASES,
+                            SPAN_NAMES, lambda s: ("x", 1))
+    assert vs == [], "\n".join(repr(v) for v in vs)
+    # every spec declares a positive budget (the table is the proof
+    # surface TestRetraceBudget's runtime smoke test now leans on)
+    assert all(s.budget >= 1 for s in registry.values())
+
+
+def test_jaxaudit_skips_fixture_roots(tmp_path):
+    """Fixture packages have no device path: the package check is a
+    no-op there (the self-tests above drive audit_specs directly)."""
+    assert run_fixture(tmp_path, {"mod.py": "x = 1"},
+                       checks=["jaxpr-audit"]) == []
+
+
+# ================================================== 8 · wire-contract
+_WIRE_ORPHANS = """
+    class Client:
+        def fetch(self, addr):
+            resp = self.cm.call(addr, "fetchThing", {"space_id": 1})
+            return resp
+
+    class Service:
+        def rpc_storeThing(self, req):
+            return {"ok": True}
+"""
+
+
+def test_wirecheck_orphan_method_and_handler(tmp_path):
+    vs = run_fixture(tmp_path, {"svc.py": _WIRE_ORPHANS},
+                     checks=["wire-contract"])
+    msgs = [v.message for v in vs]
+    assert any("no rpc_fetchThing handler" in m for m in msgs), msgs
+    assert any("rpc_storeThing has no in-tree caller" in m
+               for m in msgs), msgs
+
+
+_WIRE_DRIFT = """
+    class Client:
+        def put(self, addr):
+            resp = self.cm.call(addr, "putThing",
+                                {"space_id": 1, "stale_key": 2})
+            return resp.get("phantom_field")
+
+    class Service:
+        def rpc_putThing(self, req):
+            part = req["part_id"]
+            return {"ok": True, "latency_us": 1}
+"""
+
+
+def test_wirecheck_argument_and_envelope_drift(tmp_path):
+    vs = run_fixture(tmp_path, {"svc.py": _WIRE_DRIFT},
+                     checks=["wire-contract"])
+    msgs = [v.message for v in vs]
+    # arity drift: required key never sent
+    assert any("never sends key 'part_id'" in m for m in msgs), msgs
+    # dead payload: sent key never read
+    assert any("sends key 'stale_key'" in m for m in msgs), msgs
+    # phantom envelope field: read but never written
+    assert any("reads response field 'phantom_field'" in m
+               for m in msgs), msgs
+    # dead envelope field: written but no caller reads it
+    assert any("'latency_us'" in m and "no caller reads" in m
+               for m in msgs), msgs
+
+
+def test_wirecheck_matched_contract_is_clean(tmp_path):
+    ok = """
+    class Client:
+        def put(self, addr):
+            resp = self.cm.call(addr, "putThing",
+                                {"space_id": 1, "part_id": 2})
+            return resp.get("ok")
+
+    class Service:
+        def rpc_putThing(self, req):
+            part = req["part_id"]
+            space = req.get("space_id")
+            return {"ok": True}
+    """
+    assert run_fixture(tmp_path, {"svc.py": ok},
+                       checks=["wire-contract"]) == []
+
+
+def test_wirecheck_open_handlers_exempt_from_key_checks(tmp_path):
+    """A handler that hands the request to non-self code (the storage
+    processors) cannot be key-checked exactly — no false positives."""
+    open_h = """
+    class Client:
+        def put(self, addr):
+            return self.cm.call(addr, "putThing", {"anything": 1})
+
+    class Service:
+        def rpc_putThing(self, req):
+            return process(req)
+    """
+    assert run_fixture(tmp_path, {"svc.py": open_h},
+                       checks=["wire-contract"]) == []
+
+
+def test_wirecheck_suppression_roundtrip(tmp_path):
+    """Inline suppression silences a wire-contract finding like any
+    other check."""
+    suppressed = _WIRE_ORPHANS.replace(
+        'resp = self.cm.call(addr, "fetchThing", {"space_id": 1})',
+        'resp = self.cm.call(  # nebulint: disable=wire-contract\n'
+        '                addr, "fetchThing", {"space_id": 1})').replace(
+        "def rpc_storeThing(self, req):",
+        "def rpc_storeThing(self, req):"
+        "  # nebulint: disable=wire-contract")
+    assert run_fixture(tmp_path, {"svc.py": suppressed},
+                       checks=["wire-contract"]) == []
+
+
+def test_wirecheck_delegation_resolves_alias_handlers(tmp_path):
+    """rpc_X bodies that forward to rpc_Y inherit Y's request/response
+    contract (the meta.thrift spelling aliases)."""
+    alias = """
+    class Client:
+        def put(self, addr):
+            resp = self.cm.call(addr, "createTag", {"name": "t"})
+            return resp.get("id")
+
+    class Service:
+        def rpc_createTagSchema(self, req):
+            name = req["name"]
+            return {"id": 7}
+
+        def rpc_createTag(self, req):
+            return self.rpc_createTagSchema(req)
+    """
+    vs = run_fixture(tmp_path, {"svc.py": alias},
+                     checks=["wire-contract"])
+    # rpc_createTagSchema has no DIRECT caller but IS a delegation
+    # target; the alias's contract resolves through it
+    assert vs == [], vs
+
+
+def test_wirecheck_scatter_gather_make_req_tuples(tmp_path):
+    """The ``return "method", {...}`` make_req closures count as call
+    sites (the StorageClient collect contract)."""
+    sg = """
+    class Client:
+        def get_props(self):
+            def make(parts):
+                return "bulkFetch", {"space_id": 1}
+            return self.collect(make)
+    """
+    vs = run_fixture(tmp_path, {"svc.py": sg}, checks=["wire-contract"])
+    assert any("no rpc_bulkFetch handler" in v.message for v in vs), vs
+
+
+# ================================================ lint wall-time guard
+def test_lint_wall_time_budget():
+    """The whole-package analysis (all eight checks, jaxpr tracing
+    included) must stay fast enough to gate tier-1 — micro_bench's
+    lint component enforces the tighter interactive budget."""
+    import time
+    t0 = time.perf_counter()
+    run_lint(PKG_ROOT, baseline_path=DEFAULT_BASELINE)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 60.0, f"nebulint took {elapsed:.1f}s"
+
+
+def test_wirecheck_frame_contract_drops_untraced_frame(tmp_path):
+    """Seeded violation: interface/rpc.py losing the 2-element untraced
+    frame (every call would pay the trace envelope)."""
+    rpc = """
+    _TRACED = "__spans__"
+    _RESP = "__resp__"
+
+    def client_call(method, payload, sp):
+        return _pack([method, payload, [sp.trace_id, sp.span_id]])
+
+    def server(frame):
+        parts = _unpack(frame)
+        method, payload = parts[0], parts[1]
+        wctx = parts[2] if len(parts) > 2 else None
+        return {_TRACED: [], _RESP: payload}
+
+    def absorb(resp):
+        return resp.get(_TRACED), resp.get(_RESP)
+    """
+    vs = run_fixture(tmp_path, {"interface/rpc.py": rpc},
+                     checks=["wire-contract"])
+    assert any("2-element" in v.message for v in vs), vs
+
+
+def test_wirecheck_frame_contract_envelope_constant_drift(tmp_path):
+    """Seeded violation: an envelope constant written server-side but
+    never read by the client (dead piggyback payload)."""
+    rpc = """
+    _TRACED = "__spans__"
+    _RESP = "__resp__"
+
+    def client_call(method, payload):
+        return _pack([method, payload])
+
+    def client_traced(method, payload, sp):
+        return _pack([method, payload, [sp.trace_id, sp.span_id]])
+
+    def server(frame):
+        parts = _unpack(frame)
+        return {_TRACED: [], _RESP: parts[1]}
+
+    def absorb(resp):
+        return resp.get(_RESP)      # __spans__ never read
+    """
+    vs = run_fixture(tmp_path, {"interface/rpc.py": rpc},
+                     checks=["wire-contract"])
+    assert any("_TRACED" in v.message and "never read" in v.message
+               for v in vs), vs
+
+
+def test_wirecheck_endpoint_contract_drift(tmp_path):
+    """Seeded violation: a contract endpoint returning a payload key
+    the ENDPOINT_CONTRACT declaration doesn't name."""
+    ws = """
+    class WebService:
+        def __init__(self):
+            self.register_handler("/faults", self._faults)
+            self.register_handler("/get_stats", self._get_stats)
+            self.register_handler("/traces", self._traces)
+
+        def _faults(self, q, body):
+            return 200, {"seed": 1, "rules": [], "bogus_field": 2}
+
+        def _get_stats(self, q, body):
+            return 200, dump()
+
+        def _traces(self, q, body):
+            return 200, {"traces": []}
+    """
+    vs = run_fixture(tmp_path, {"webservice/service.py": ws},
+                     checks=["wire-contract"])
+    assert any("bogus_field" in v.message and "/faults" in v.message
+               for v in vs), vs

@@ -522,6 +522,18 @@ class TestMatchLowering:
         assert not r.ok(), q
         assert frag in r.error_msg, (q, r.error_msg)
 
+    def test_match_prefers_missing_anchor_error(self, mcluster):
+        """ADVICE round 5: when one direction's rewrite fails (here:
+        anchor-vertex props across a variable-length pattern) but the
+        OTHER direction rewrites cleanly without finding an id()
+        anchor, the surfaced error must be the clearer missing-anchor
+        message, not the losing direction's incidental rewrite error."""
+        _, g = mcluster
+        r = g.execute("MATCH (a:player)-[e:follow*2]->(b:player) "
+                      "WHERE a.age > 0 RETURN id(b)")
+        assert not r.ok()
+        assert "anchor" in r.error_msg, r.error_msg
+
     def test_match_string_literal_collides_with_var_name(self, mcluster):
         # a literal spelling a pattern-variable name must NOT be
         # rewritten (the substitution is token-level)
