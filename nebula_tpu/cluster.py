@@ -76,6 +76,9 @@ class StorageNode:
                                       local_host=host,
                                       meta_client=self.meta_client,
                                       client_manager=cm)
+        # heartbeats carry the per-part replication brief so metad's
+        # SHOW PARTS can show term/commit/log lag without scraping us
+        self.meta_client.hb_parts_provider = self.service.part_status_brief
         self.handler = CompositeHandler(self.service, self.raft_service) \
             if self.raft_service else self.service
 
@@ -198,9 +201,14 @@ class LocalCluster:
 
     def refresh_all(self) -> None:
         """Propagate meta changes now (tests shrink the refresh interval;
-        we just push — reference TestEnv sleeps on load_data_interval_secs)."""
+        we just push — reference TestEnv sleeps on load_data_interval_secs).
+        Heartbeats ride along so metad's host table picks up the parts
+        replication brief + journal events without waiting a beat."""
         for node in self.storage_nodes:
             node.meta_client.load_data()
+            # the next beat retries; refresh_all is a test convenience,
+            # not a liveness path
+            node.meta_client.heartbeat()  # nebulint: disable=status-discard
         self.graph_meta_client.load_data()
 
     def stop(self) -> None:

@@ -1,0 +1,167 @@
+"""Event journal — clock-stamped ring buffer of notable cluster events.
+
+The metrics plane's third leg (beside counters/gauges and traces): a
+bounded, process-wide journal of the DISCRETE things an operator asks
+"what just happened?" about — leader elections and step-downs,
+membership and balancer moves, meta catalog writes, injected faults,
+and slow queries.  Served raw at every daemon's ``/events`` endpoint,
+piggybacked on storaged heartbeats to metad (meta/client.py),
+aggregated cluster-wide there (meta/service.py rpc_listEvents), and
+surfaced in nGQL as ``SHOW EVENTS`` (docs/observability.md).
+
+Shape: the journal mirrors TraceStore — an OrderedLock-guarded ring
+(``event_journal_size``), entries stamped with clock.now_micros() so
+``clock.advance_for_tests`` ages them deterministically.  Each entry
+carries a process-unique 63-bit ``id``: the cluster aggregation dedups
+on it, so an event that reaches metad twice (heartbeat piggyback AND
+the shared in-process journal of a LocalCluster) lands once.
+
+Kinds are a closed set (``EVENT_KINDS``) so dashboards and tests can
+match exactly — ``record`` refuses unknown kinds at runtime, the cheap
+analogue of the span/metric registry lint contracts.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .clock import now_micros
+from .flags import flags
+from .ordered_lock import OrderedLock
+from .stats import stats
+
+flags.define("event_journal_size", 512,
+             "events kept in the in-process ring buffer served by the "
+             "/events web endpoint and heartbeat-forwarded to metad")
+
+EVENT_KINDS = (
+    "raft.leader_elected",   # a part won an election (space/part/term)
+    "raft.step_down",        # a LEADER reverted to follower
+    "raft.membership",       # learner/peer add/remove took effect
+    "balancer.move",         # one BalanceTask moved a part replica
+    "meta.catalog_write",    # a DDL/config write landed in the catalog
+    "fault.injected",        # the wire-level fault injector fired
+    "query.slow",            # a statement crossed slow_query_threshold_ms
+)
+
+_rng = random.Random()       # event ids; independent of seeded test RNGs
+
+stats.register_stats("events.recorded")
+
+
+class EventJournal:
+    """Bounded ring of event dicts, oldest evicted first."""
+
+    def __init__(self):
+        self._lock = OrderedLock("events.journal")
+        self._entries: List[dict] = []
+        self._seq = 0
+
+    def record(self, kind: str, detail: str = "", **fields) -> dict:
+        """Append one event.  ``fields`` are structured extras (space,
+        part, term, host, ...) merged into the entry.  Cheap enough to
+        call from consensus paths — one lock, one dict, no I/O."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {kind!r} "
+                             f"(register it in EVENT_KINDS first)")
+        entry = {"id": _rng.getrandbits(63), "kind": kind,
+                 "time_us": now_micros(), "detail": str(detail)}
+        for k, v in fields.items():
+            if v is not None:
+                entry[k] = v
+        cap = int(flags.get("event_journal_size") or 512)
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._entries.append(entry)
+            if len(self._entries) > cap:
+                del self._entries[:len(self._entries) - cap]
+        stats.add_value("events.recorded")
+        return entry
+
+    def since(self, seq: int, limit: int = 64) -> Tuple[List[dict], int]:
+        """Events with seq > ``seq``, OLDEST first and capped at
+        ``limit``, plus the seq of the last event RETURNED — the
+        heartbeat piggyback cursor.  Capping keeps the oldest and the
+        cursor tracks what was actually handed out, so a burst larger
+        than one beat's budget drains over several beats instead of
+        silently dropping its head."""
+        with self._lock:
+            out = [e for e in self._entries if e["seq"] > seq]
+        if len(out) > limit:
+            out = out[:limit]
+        last = out[-1]["seq"] if out else seq
+        return [dict(e) for e in out], last
+
+    def dump(self, limit: int = 100) -> List[dict]:
+        """Newest-first snapshot for /events and SHOW EVENTS."""
+        with self._lock:
+            out = list(reversed(self._entries[-max(int(limit), 0):]))
+        return [dict(e) for e in out]
+
+    def clear_for_tests(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+journal = EventJournal()
+
+
+def merge_events(*sources: List[dict], limit: int = 200) -> List[dict]:
+    """Dedup-by-id merge of event lists, newest first, capped — THE
+    ordering every surface shares (metad rpc_listEvents, graphd's
+    SHOW EVENTS executor).  Earlier sources win on id collisions."""
+    out: Dict[int, dict] = {}
+    for events in sources:
+        for e in events:
+            if isinstance(e, dict) and "id" in e:
+                out.setdefault(e["id"], e)
+    rows = sorted(out.values(),
+                  key=lambda e: (e.get("time_us", 0), e.get("id", 0)),
+                  reverse=True)
+    return rows[:max(int(limit), 0)]
+
+
+class ClusterEventStore:
+    """Metad-side aggregation of events reported over heartbeats,
+    deduped by event id and bounded like the local journal.  Kept
+    separate from EventJournal because absorbed entries arrive with
+    their own ids/stamps and a reporting ``host``."""
+
+    def __init__(self):
+        self._lock = OrderedLock("events.cluster")
+        self._by_id: "Dict[int, dict]" = {}
+        self._order: List[int] = []
+
+    def absorb(self, host: Optional[str], events) -> None:
+        if not events:
+            return
+        cap = int(flags.get("event_journal_size") or 512)
+        with self._lock:
+            for e in events:
+                if not isinstance(e, dict) or "id" not in e \
+                        or e.get("kind") not in EVENT_KINDS:
+                    continue
+                eid = e["id"]
+                if eid in self._by_id:
+                    continue
+                e = dict(e)
+                if host and "host" not in e:
+                    e["host"] = host
+                self._by_id[eid] = e
+                self._order.append(eid)
+            while len(self._order) > cap:
+                self._by_id.pop(self._order.pop(0), None)
+
+    def merged(self, local: List[dict], limit: int = 200) -> List[dict]:
+        """Cluster view: absorbed events + the caller's local snapshot,
+        deduped by id, newest first (merge_events ordering)."""
+        with self._lock:
+            absorbed = list(self._by_id.values())
+        return merge_events(absorbed, local, limit=limit)
+
+    def clear_for_tests(self) -> None:
+        with self._lock:
+            self._by_id.clear()
+            self._order.clear()

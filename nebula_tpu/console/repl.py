@@ -28,6 +28,7 @@ KEYWORDS = [
     "CONFIGS", "GET", "USER", "USERS", "GRANT", "REVOKE", "ROLE", "TO",
     "CHANGE", "PASSWORD", "WITH", "TTL_COL", "TTL_DURATION", "INGEST",
     "DOWNLOAD", "HDFS", "PIPE", "VARIABLES", "PROFILE", "EXPLAIN",
+    "STATS", "EVENTS",
 ]
 
 

@@ -61,6 +61,12 @@ def main(argv=None) -> int:
     rpc = RpcServer(service, host=args.local_ip, port=args.port).start()
     ws = WebService("nebula-graphd", host=args.local_ip,
                     port=args.ws_http_port).start()
+
+    def _meta_reachable():
+        r = meta_client.call("listSpaces", {})
+        return r.ok(), "meta ok" if r.ok() else r.status.to_string()
+
+    ws.register_health_check("meta", _meta_reachable)
     sys.stderr.write(f"graphd serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

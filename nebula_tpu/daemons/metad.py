@@ -81,6 +81,23 @@ def main(argv=None) -> int:
     ws.register_handler(
         "/balance", lambda q, b: (200, service.rpc_balance(
             {k: v for k, v in q.items() if not k.startswith("__")})))
+    # metad's /events serves the CLUSTER aggregation (heartbeat-absorbed
+    # events merged with its own journal) instead of the local-only
+    # builtin every other daemon keeps
+    ws.register_handler(
+        "/events", lambda q, b: (200, service.rpc_listEvents(
+            {"limit": q.get("limit", 200)})))
+
+    def _catalog_serving():
+        from ..meta.service import META_PART, META_SPACE
+        p = service.kv.part(META_SPACE, META_PART)
+        if p is None:
+            return False, "catalog part missing"
+        if p.raft is not None and p.leader() is None:
+            return False, "catalog raft group has no leader yet"
+        return True, "catalog serving"
+
+    ws.register_health_check("catalog", _catalog_serving)
     from ..meta.http_dispatch import register_dispatch_handlers
     register_dispatch_handlers(ws, service)
     sys.stderr.write(f"metad serving on {rpc.addr} (ws :{ws.port})\n")

@@ -264,10 +264,27 @@ class ShowExecutor(Executor):
             self.check_space_chosen()
             resp = _meta_call(self, "getPartsAlloc",
                               {"space_id": self.ectx.space_id()})
-            rows = [[int(p), ", ".join(hosts)]
-                    for p, hosts in sorted(resp["parts"].items(),
-                                           key=lambda kv: int(kv[0]))]
-            return InterimResult(["Partition ID", "Peers"], rows)
+            status = resp.get("status") or {}
+            rows = []
+            for p, hosts in sorted(resp["parts"].items(),
+                                   key=lambda kv: int(kv[0])):
+                # replication brief from storaged heartbeats: the
+                # highest-term leader report wins (meta/service.py
+                # _parts_status) — "-" until the first beat lands
+                st = status.get(str(int(p))) or {}
+                leader = st.get("host", "-") \
+                    if st.get("role") == "LEADER" else "-"
+                rows.append([int(p), leader, st.get("term", "-"),
+                             st.get("committed", "-"),
+                             st.get("last_log_id", "-"),
+                             ", ".join(hosts)])
+            return InterimResult(
+                ["Partition ID", "Leader", "Term", "Committed",
+                 "Last Log", "Peers"], rows)
+        if t == ast.ShowTarget.STATS:
+            return self._show_stats()
+        if t == ast.ShowTarget.EVENTS:
+            return self._show_events()
         if t == ast.ShowTarget.USERS:
             resp = _meta_call(self, "listUsers", {})
             return InterimResult(["Account"],
@@ -294,6 +311,56 @@ class ShowExecutor(Executor):
                  ast.ShowTarget.CREATE_EDGE):
             return self._show_create(t, s.name)
         raise ExecError(f"SHOW {t.value} not supported")
+
+    def _show_stats(self) -> InterimResult:
+        """SHOW STATS: per-daemon 60 s snapshots through metad's
+        ``showStats`` fan-out (metad itself + every active storaged),
+        then a ``<cluster>`` rollup — sums/counts add across daemons,
+        percentiles take the worst daemon (they don't compose)."""
+        resp = _meta_call(self, "showStats", {})
+        rows: List[list] = []
+        agg: dict = {}
+        for hrec in resp.get("hosts", []):
+            host = hrec.get("host", "?")
+            for name, d in sorted((hrec.get("stats") or {}).items()):
+                vals = [d.get("sum.60", 0.0), d.get("count.60", 0.0),
+                        d.get("avg.60", 0.0), d.get("rate.60", 0.0),
+                        d.get("p95.60", 0.0), d.get("p99.60", 0.0)]
+                rows.append([host, name] + vals)
+                a = agg.setdefault(name, [0.0] * 6)
+                a[0] += vals[0]
+                a[1] += vals[1]
+                a[4] = max(a[4], vals[4])
+                a[5] = max(a[5], vals[5])
+        for name in sorted(agg):
+            a = agg[name]
+            a[2] = a[0] / a[1] if a[1] else 0.0
+            a[3] = a[0] / 60.0
+            rows.append(["<cluster>", name] + a)
+        return InterimResult(
+            ["Host", "Stat", "Sum(60s)", "Count(60s)", "Avg(60s)",
+             "Rate(60s)", "p95(60s)", "p99(60s)"], rows)
+
+    def _show_events(self) -> InterimResult:
+        """SHOW EVENTS: metad's cluster-wide aggregation (heartbeat
+        piggyback, meta/service.py rpc_listEvents) merged with this
+        graphd's own journal (slow queries never ride a heartbeat —
+        graphd doesn't beat), deduped by event id, newest first."""
+        from ...common.events import journal, merge_events
+        resp = _meta_call(self, "listEvents", {})
+        ordered = merge_events(resp.get("events", []),
+                               journal.dump(limit=200), limit=200)
+        rows = []
+        for e in ordered:
+            extras = " ".join(
+                f"{k}={e[k]}" for k in ("space", "part", "term")
+                if k in e)
+            detail = e.get("detail", "")
+            if extras:
+                detail = f"{detail} [{extras}]" if detail else extras
+            rows.append([e.get("time_us", 0), e.get("host", "-"),
+                         e.get("kind", "?"), detail])
+        return InterimResult(["Time(us)", "Host", "Kind", "Detail"], rows)
 
     def _show_create(self, t: "ast.ShowTarget", name: str) -> InterimResult:
         """Render the statement that would recreate the object — the

@@ -456,6 +456,8 @@ class ShowTarget(enum.Enum):
     CREATE_TAG = "create tag"
     CREATE_EDGE = "create edge"
     CONFIGS = "configs"
+    STATS = "stats"                # SHOW STATS: daemon + cluster rollup
+    EVENTS = "events"              # SHOW EVENTS: cluster event journal
 
 
 @dataclass

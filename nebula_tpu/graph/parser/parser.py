@@ -914,7 +914,9 @@ class _Parser:
                                     name=self.expect_id("space name"))
         mapping = {"spaces": ast.ShowTarget.SPACES, "tags": ast.ShowTarget.TAGS,
                    "edges": ast.ShowTarget.EDGES, "hosts": ast.ShowTarget.HOSTS,
-                   "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS}
+                   "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS,
+                   "stats": ast.ShowTarget.STATS,
+                   "events": ast.ShowTarget.EVENTS}
         kw = self.next()
         if kw.type != "KW" or kw.value not in mapping:
             self.fail("expected SHOW target")

@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 from ..common import tracing
 from ..common.clock import Duration
+from ..common.events import journal
 from ..common.flags import flags
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status
@@ -129,17 +130,16 @@ class ExecutionEngine:
     _KIND_STATS_REGISTERED: set = set()
 
     @classmethod
-    def _stmt_stat(cls, kind: str) -> str:
-        """Lazily-registered per-statement-kind latency histogram name
+    def _note_stmt_kind(cls, kind: str) -> None:
+        """Lazily register the per-statement-kind latency histogram
         (reference scaffolding: StatsManager counters per RPC,
         SURVEY.md §5.5 / StorageServer.cpp:93-94 — here filled in for
         graphd: `graph.stmt.<Kind>.latency_us.{avg|p95|...}.<window>`
-        over /get_stats)."""
-        name = f"graph.stmt.{kind}.latency_us"
+        over /get_stats; the literal f-strings keep the name visible to
+        nebulint's metric-registry wildcard `graph.stmt.*`)."""
         if kind not in cls._KIND_STATS_REGISTERED:
-            stats.register_stats(name)
+            stats.register_stats(f"graph.stmt.{kind}.latency_us")
             cls._KIND_STATS_REGISTERED.add(kind)
-        return name
 
     # one whitespace run OR one comment (the lexer's grammar); each
     # match() is COMMITTED before the next, so the prefix scan below is
@@ -198,6 +198,12 @@ class ExecutionEngine:
         if threshold and resp.get("latency_in_us", 0) >= threshold * 1000:
             stats.add_value("graph.slow_query.qps")
             tracing.slow_log.record(text, resp["latency_in_us"], trace_id)
+            # the event journal carries the masked/truncated statement
+            # only via the slow log; SHOW EVENTS shows the occurrence
+            journal.record("query.slow",
+                           detail=f"{resp['latency_in_us']} us",
+                           latency_us=resp["latency_in_us"],
+                           host="graphd")
         return resp
 
     def _execute_traced(self, session: ClientSession, text: str,
@@ -258,7 +264,9 @@ class ExecutionEngine:
         # per-statement-kind histogram + error counter (first sentence
         # names a multi-statement input)
         kind = type(seq.sentences[0]).__name__ if seq.sentences else "Empty"
-        stats.add_value(self._stmt_stat(kind), resp["latency_in_us"])
+        self._note_stmt_kind(kind)
+        stats.add_value(f"graph.stmt.{kind}.latency_us",
+                        resp["latency_in_us"])
         if rs is not None:
             rs.tag(stmt_kind=kind)
         if resp["error_code"] != int(ErrorCode.SUCCEEDED):
@@ -289,7 +297,7 @@ class GraphService:
         self.sessions = SessionManager()
         self.authenticator = authenticator or SimpleAuthenticator(engine.meta)
         stats.register_stats("graph.qps")
-        stats.register_stats("graph.latency_us")
+        stats.register_histogram("graph.latency_us")
         stats.register_stats("graph.error.qps")
         stats.register_stats("graph.partial_result.qps")
         stats.register_stats("graph.slow_query.qps")

@@ -170,6 +170,11 @@ class FaultInjector:
         stats.add_value(f"rpc.fault_injected.{method}")
         tracing.annotate("rpc.fault", fault=rule.kind, method=method,
                          host=host)
+        # event journal (SHOW EVENTS / /events): injections only fire
+        # in chaos runs, so the allocation cost is off the clean path
+        from ..common.events import journal
+        journal.record("fault.injected",
+                       detail=f"{rule.kind} {method}@{host}")
         if rule.delay_s > 0:
             time.sleep(rule.delay_s)      # outside the lock
         kind = rule.kind

@@ -414,3 +414,38 @@ class NebulaStore:
                     return st
         self._bump(space_id)   # ingest loads keys engine-side, not via Part
         return Status.OK()
+
+
+def collect_raft_gauges(kv: "NebulaStore", host: str) -> None:
+    """Scrape-time collector body: set one gauge series per hosted raft
+    part (labels space/part/host) from ``RaftPart.status()`` — role,
+    term, commit lag vs last_log_id, WAL catch-up depth, election count
+    and snapshot transfer state.  Registered (via a bound method that
+    closes over a store) by StorageService and MetaService with
+    ``stats.register_collector``; runs only when /metrics or SHOW STATS
+    scrapes, so the idle path costs nothing.
+    """
+    from ..common.stats import stats
+    for space_id in list(kv.spaces):
+        for part_id in kv.part_ids(space_id):
+            part = kv.part(space_id, part_id)
+            if part is None or part.raft is None:
+                continue
+            st = part.raft.status()
+            labels = {"space": space_id, "part": part_id, "host": host}
+            stats.set_gauge("raft.is_leader",
+                            1.0 if st["role"] == "LEADER" else 0.0,
+                            role=st["role"], **labels)
+            stats.set_gauge("raft.term", st["term"], **labels)
+            stats.set_gauge("raft.commit_lag",
+                            st["last_log_id"] - st["committed"], **labels)
+            wal_first = st.get("wal_first") or 0
+            depth = (st["last_log_id"] - wal_first + 1) if wal_first else 0
+            stats.set_gauge("raft.wal_depth", depth, **labels)
+            stats.set_gauge("raft.elections", st.get("elections", 0),
+                            **labels)
+            stats.set_gauge("raft.snapshot_sending",
+                            st.get("snapshot_sending", 0), **labels)
+            stats.set_gauge("raft.snapshot_receiving",
+                            1.0 if st.get("snapshot_receiving") else 0.0,
+                            **labels)

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import msgpack
 
+from ..common.events import journal
 from ..common.flags import flags
 from ..common.status import ErrorCode, Status
 from ..interface.common import HostAddr
@@ -415,6 +416,12 @@ class Balancer:
             raise RuntimeError(f"committing placement for part "
                                f"{t.space_id}/{t.part_id} failed: {st}")
         self.meta._bump_last_update()
+        # journaled only once the placement COMMITTED (same rule as
+        # meta.catalog_write: a refused put records nothing)
+        journal.record("balancer.move",
+                       detail=f"part {t.space_id}/{t.part_id} "
+                              f"{t.src} -> {t.dst}",
+                       space=t.space_id, part=t.part_id)
         # 5. drop the replica from src
         t.status = "REMOVE_OLD"
         try:

@@ -15,6 +15,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..common import tracing
+from ..common.events import journal
 from ..common.flags import flags
 from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
@@ -77,6 +78,13 @@ class MetaClient:
         self.listener: Optional[MetaChangedListener] = None
         self.cluster_id = 0
         self.hb_info: dict = {}   # advertised in heartbeats (ws_port...)
+        # optional callable -> {"sid/pid": {...}}: per-part replication
+        # brief piggybacked on each heartbeat (storage/service.py
+        # part_status_brief) so metad can answer SHOW PARTS lag columns
+        self.hb_parts_provider = None
+        # event-journal piggyback cursor: entries with seq beyond this
+        # already reached metad on an acked heartbeat
+        self._event_seq = 0
         self.last_update_time = -1
         self._good_addr: Optional[str] = None  # last known catalog leader
 
@@ -248,11 +256,26 @@ class MetaClient:
         if self.hb_info:
             # daemon-advertised metadata (ws_port for bulk-load dispatch)
             payload["info"] = dict(self.hb_info)
+        provider = self.hb_parts_provider
+        if provider is not None:
+            try:
+                ps = provider()
+            except Exception:       # noqa: BLE001 — a sick status probe
+                ps = None           # must not stop liveness beats
+            if ps:
+                payload["parts_status"] = ps
+        # journal piggyback: events metad hasn't acked yet ride along;
+        # the cursor only advances on an acked beat, and metad dedups
+        # by event id, so a lost reply just re-sends
+        events, last_seq = journal.since(self._event_seq)
+        if events:
+            payload["events"] = events
         r = self._call_status("heartBeat", payload)
         if r.ok():
             # cheap change detection (reference uses last_update_time the
             # same way to skip full reloads)
             with self._cache_lock:
+                self._event_seq = last_seq
                 self.cluster_id = r.value().get("cluster_id",
                                                 self.cluster_id)
                 lut = r.value().get("last_update_time_in_us", 0)

@@ -17,7 +17,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..common.clock import now_micros
+from ..common.clock import Duration, now_micros
+from ..common.events import ClusterEventStore, journal
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..interface.common import (AlterSchemaOp, ConfigMode, HostAddr, RoleType,
                                 Schema, schema_from_wire, schema_to_wire)
@@ -108,6 +110,14 @@ class MetaService:
         # the LEADER resolves it on first use (reference: MetaDaemon
         # waits for election, then the leader persists the id)
         self.balancer = None  # wired by meta/balancer.py when admin client exists
+        # cluster-wide event aggregation: storaged/graphd piggyback
+        # recent journal entries on heartbeats; SHOW EVENTS reads the
+        # merged view (common/events.py)
+        self.cluster_events = ClusterEventStore()
+        stats.register_histogram("meta.heartbeat.latency_us")
+        # replicated-catalog raft gauges (space 0 / part 0); weak bound
+        # method — dropped with the service
+        stats.register_collector(self._collect_metrics)
         # RpcServer is threaded: one lock serializes catalog access
         # (id allocation + check-then-put DDL are read-modify-write).
         # Meta QPS is trivially low; correctness over concurrency here.
@@ -116,12 +126,30 @@ class MetaService:
             if name.startswith("rpc_"):
                 setattr(self, name, self._locked(getattr(self, name)))
 
+    def _collect_metrics(self) -> None:
+        from ..kvstore.store import collect_raft_gauges
+        collect_raft_gauges(self.kv, "metad")
+
+    # catalog mutations worth an operator-visible journal entry
+    # (SHOW EVENTS / /events): the _locked wrapper records one
+    # meta.catalog_write per successful call of these
+    _CATALOG_WRITE_RPCS = frozenset((
+        "rpc_createSpace", "rpc_dropSpace", "rpc_createTagSchema",
+        "rpc_createEdgeSchema", "rpc_alterTagSchema", "rpc_alterEdgeSchema",
+        "rpc_dropTagSchema", "rpc_dropEdgeSchema", "rpc_addHosts",
+        "rpc_removeHosts", "rpc_updatePartAlloc", "rpc_createUser",
+        "rpc_dropUser", "rpc_grantRole", "rpc_revokeRole", "rpc_setConfig",
+    ))
+
     # catalog-leader-gated but NOT serialized under the write lock:
     # the bulk-load dispatch fans HTTP out to every storaged with a
     # 120 s per-host timeout — holding the catalog lock across that
     # would stall heartbeats (and thus liveness) behind one blackholed
     # host.  These handlers only READ active_hosts (its own locking).
-    _UNLOCKED_RPCS = ("rpc_download", "rpc_ingest")
+    # showStats fans RPCs to every storaged and listEvents reads the
+    # event stores (their own locks) — same reasoning.
+    _UNLOCKED_RPCS = ("rpc_download", "rpc_ingest", "rpc_showStats",
+                      "rpc_listEvents")
 
     def _locked(self, fn):
         if fn.__name__ in self._UNLOCKED_RPCS:
@@ -131,10 +159,21 @@ class MetaService:
             leader_only.__name__ = fn.__name__
             return leader_only
 
-        def wrapper(req: dict):
-            self._check_catalog_leader()
-            with self._write_lock:
-                return fn(req)
+        if fn.__name__ in self._CATALOG_WRITE_RPCS:
+            def wrapper(req: dict, _kind=fn.__name__[4:]):
+                self._check_catalog_leader()
+                with self._write_lock:
+                    resp = fn(req)
+                # journaled AFTER the write landed (a refused raft
+                # append raises out of fn and records nothing)
+                journal.record("meta.catalog_write", detail=_kind,
+                               host="metad")
+                return resp
+        else:
+            def wrapper(req: dict):
+                self._check_catalog_leader()
+                with self._write_lock:
+                    return fn(req)
         wrapper.__name__ = fn.__name__
         return wrapper
 
@@ -261,7 +300,62 @@ class MetaService:
         parts = {}
         for k, v in self.kv.prefix(META_SPACE, META_PART, mk.part_prefix(space_id)):
             parts[mk.part_id_from_key(k)] = _unpk(v)
-        return {"parts": parts}
+        return {"parts": parts,
+                "status": self._parts_status(space_id)}
+
+    def _parts_status(self, space_id: int) -> Dict[str, dict]:
+        """Fold the per-host replication briefs (heartbeat
+        ``parts_status``) into one view per part: the highest-term
+        LEADER report wins (SHOW PARTS term/commit/log columns)."""
+        out: Dict[str, dict] = {}
+        for host, rec in self.active_hosts.hosts().items():
+            for key, st in (rec.get("parts_status") or {}).items():
+                try:
+                    sid_s, pid_s = key.split("/", 1)
+                    if int(sid_s) != space_id:
+                        continue
+                    pid = str(int(pid_s))
+                except ValueError:
+                    continue
+                cand = dict(st)
+                cand["host"] = host
+                cur = out.get(pid)
+                better = cur is None or (
+                    (cand.get("term", 0), cand.get("role") == "LEADER")
+                    > (cur.get("term", 0), cur.get("role") == "LEADER"))
+                if better:
+                    out[pid] = cand
+        return out
+
+    def rpc_showStats(self, req: dict) -> dict:
+        """SHOW STATS fan-out: this metad's own 60 s stats snapshot
+        plus one ``daemonStats`` RPC per active storage host (the
+        AdminClient channel the balancer already uses).  Unreachable
+        hosts are skipped — a rollup that blocks on a dead storaged
+        would make the health statement itself unhealthy."""
+        hosts = [{"host": "metad", "stats": stats.dump()}]
+        admin = getattr(self.balancer, "admin", None)
+        if admin is not None:
+            for h in self.active_hosts.active_hosts():
+                try:
+                    r = admin.cm.call(HostAddr.parse(h), "daemonStats", {})
+                except Exception:     # noqa: BLE001 — host churn mid-scan
+                    continue
+                if isinstance(r, dict) and "stats" in r:
+                    hosts.append({"host": r.get("host", h),
+                                  "stats": r["stats"]})
+        return {"hosts": hosts}
+
+    def rpc_listEvents(self, req: dict) -> dict:
+        """Cluster-wide event view: heartbeat-absorbed events merged
+        with this process's own journal, newest first."""
+        try:
+            limit = int(req.get("limit", 200))
+        except (TypeError, ValueError):
+            raise _err(ErrorCode.E_INVALID_HOST,
+                       f"bad limit {req.get('limit')!r}")
+        local = journal.dump(limit=limit)
+        return {"events": self.cluster_events.merged(local, limit=limit)}
 
     def rpc_updatePartAlloc(self, req: dict) -> dict:
         """Balancer support: move a part's peer list."""
@@ -290,12 +384,25 @@ class MetaService:
 
     # ================= heartbeat (admin/HBProcessor) =================
     def rpc_heartBeat(self, req: dict) -> dict:
+        dur = Duration()
         cid = req.get("cluster_id", 0)
         if cid and cid != self.cluster_id:
             raise _err(ErrorCode.E_WRONGCLUSTER, "cluster id mismatch")
-        self.active_hosts.update_host(req["host"], req.get("info"))
-        return {"cluster_id": self.cluster_id,
+        info = dict(req.get("info") or {})
+        # per-part replication brief (term/committed/last_log per
+        # hosted raft part) — SHOW PARTS reads it back out of the host
+        # table instead of scraping every storaged
+        if "parts_status" in req:
+            info["parts_status"] = req["parts_status"]
+        self.active_hosts.update_host(req["host"], info or None)
+        # recent journal entries ride the heartbeat; the cluster store
+        # dedups on event id, so re-sends after a failed beat are safe
+        if req.get("events"):
+            self.cluster_events.absorb(req["host"], req["events"])
+        resp = {"cluster_id": self.cluster_id,
                 "last_update_time_in_us": self.last_update_time()}
+        stats.add_value("meta.heartbeat.latency_us", dur.elapsed_in_usec())
+        return resp
 
     def last_update_time(self) -> int:
         raw, _ = self.kv.get(META_SPACE, META_PART, mk.LAST_UPDATE_KEY)

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common.events import journal
 from ..common.flags import flags
 from ..common.ordered_lock import OrderedLock
 from ..common.status import ErrorCode, Status
@@ -156,6 +157,12 @@ class RaftPart:
         self._electing = False
         self._stopped = False
         self._snap_rows: List[Tuple[bytes, bytes]] = []
+        # replication observability (status() -> /metrics raft gauges +
+        # SHOW PARTS; guarded by self._lock like the rest of the state)
+        self.election_count = 0        # elections this replica STARTED
+        self.snapshot_sending = 0      # leader->peer streams in flight
+        self.snapshot_receiving = False
+        self._snap_last_chunk = 0.0    # monotonic stamp of last chunk
 
         now = time.monotonic()
         self._last_heard = now + random.random() * 0.2   # stagger first wave
@@ -233,6 +240,15 @@ class RaftPart:
                 "role": self.role, "term": self.term, "leader": self.leader,
                 "committed": self.committed_id,
                 "last_log_id": self.wal.last_log_id(),
+                "wal_first": self.wal.first_log_id(),
+                "elections": self.election_count,
+                "snapshot_sending": self.snapshot_sending,
+                # an aborted stream never sends done=True — age the
+                # receiving flag out so the gauge can't stick at 1
+                "snapshot_receiving": bool(
+                    self.snapshot_receiving
+                    and time.monotonic() - self._snap_last_chunk
+                    < 2 * float(flags.get("raft_rpc_timeout_s") or 3.0)),
                 "peers": {a: {"learner": p.is_learner,
                               "match": p.match_id}
                           for a, p in self.peers.items()},
@@ -574,37 +590,52 @@ class RaftPart:
             rows = list(self.snapshot_source())
             snap_committed = self.committed_id
             snap_term = self.wal.get_term(snap_committed) or self.term
-        chunk = int(flags.get("raft_snapshot_rows_per_chunk"))
-        total = len(rows)
-        for off in range(0, max(total, 1), chunk):
-            part_rows = rows[off:off + chunk]
-            payload = {
-                "space": self.space_id, "part": self.part_id,
-                "term": term, "leader": self.addr,
-                "rows": [[k, v] for k, v in part_rows],
-                "committed_id": snap_committed,
-                "committed_term": snap_term,
-                "first": off == 0,
-                "done": off + chunk >= total,
-            }
-            try:
-                resp = self.cm.call(HostAddr.parse(peer.addr),
-                                    "raftSendSnapshot", payload)
-            except Exception:        # noqa: BLE001
-                return False
-            if resp.get("code", 1) != 0:
-                self._maybe_step_down(resp.get("term", 0))
-                return False
-        return True
+            self.snapshot_sending += 1
+        try:
+            chunk = int(flags.get("raft_snapshot_rows_per_chunk"))
+            total = len(rows)
+            for off in range(0, max(total, 1), chunk):
+                part_rows = rows[off:off + chunk]
+                payload = {
+                    "space": self.space_id, "part": self.part_id,
+                    "term": term, "leader": self.addr,
+                    "rows": [[k, v] for k, v in part_rows],
+                    "committed_id": snap_committed,
+                    "committed_term": snap_term,
+                    "first": off == 0,
+                    "done": off + chunk >= total,
+                }
+                try:
+                    resp = self.cm.call(HostAddr.parse(peer.addr),
+                                        "raftSendSnapshot", payload)
+                except Exception:        # noqa: BLE001
+                    return False
+                if resp.get("code", 1) != 0:
+                    self._maybe_step_down(resp.get("term", 0))
+                    return False
+            return True
+        finally:
+            with self._lock:
+                self.snapshot_sending -= 1
 
     def _maybe_step_down(self, peer_term: int) -> None:
+        was_leader = False
         with self._lock:
             if peer_term > self.term:
                 self.term = peer_term
                 if self.role in (Role.LEADER, Role.CANDIDATE):
+                    was_leader = self.role == Role.LEADER
                     self.role = Role.FOLLOWER
                 self.leader = None
                 self._persist_hard_state()
+                new_term = self.term
+        if was_leader:
+            # journaled OUTSIDE the part lock (events takes its own
+            # leaf lock; no reason to extend this one's hold time)
+            journal.record("raft.step_down",
+                           detail=f"saw higher term {new_term}",
+                           space=self.space_id, part=self.part_id,
+                           term=new_term, host=self.addr)
 
     # ==================================================== commit
     def _commit_to(self, to_id: int) -> None:
@@ -642,6 +673,13 @@ class RaftPart:
             if req["term"] > self.term:
                 self.term = req["term"]
                 if self.role in (Role.LEADER, Role.CANDIDATE):
+                    if self.role == Role.LEADER:
+                        journal.record(
+                            "raft.step_down",
+                            detail=f"vote request from {req['cand']} at "
+                                   f"term {req['term']}",
+                            space=self.space_id, part=self.part_id,
+                            term=self.term, host=self.addr)
                     self.role = Role.FOLLOWER
                 self.leader = None
                 self._persist_hard_state()
@@ -669,10 +707,23 @@ class RaftPart:
                     self.term = req["term"]
                     self._persist_hard_state()
                 if self.role != Role.LEARNER:
+                    if self.role == Role.LEADER:
+                        # journal under the lock: record() only takes
+                        # the events leaf lock, no I/O
+                        journal.record(
+                            "raft.step_down",
+                            detail=f"append from {req['leader']} at "
+                                   f"term {req['term']}",
+                            space=self.space_id, part=self.part_id,
+                            term=self.term, host=self.addr)
                     self.role = Role.FOLLOWER
             elif self.role == Role.LEADER:
                 # same term, two leaders — impossible with correct quorum;
                 # highest log wins deterministically: step down
+                journal.record("raft.step_down",
+                               detail=f"same-term leader {req['leader']}",
+                               space=self.space_id, part=self.part_id,
+                               term=self.term, host=self.addr)
                 self.role = Role.FOLLOWER
             self.leader = req["leader"]
             self._last_heard = time.monotonic()
@@ -758,11 +809,14 @@ class RaftPart:
             self._last_heard = time.monotonic()
             if req.get("first", True):
                 self._snap_rows = []
+                self.snapshot_receiving = True
+            self._snap_last_chunk = time.monotonic()
             self._snap_rows.extend((bytes(k), bytes(v))
                                    for k, v in req["rows"])
             if req.get("done", True):
                 rows = self._snap_rows
                 self._snap_rows = []
+                self.snapshot_receiving = False
                 if self.install_handler is not None:
                     self.install_handler(rows, req["committed_id"],
                                          req["committed_term"])
@@ -864,6 +918,7 @@ class RaftPart:
                     return
                 self.role = Role.CANDIDATE
                 self.term += 1
+                self.election_count += 1
                 term = self.term
                 self._voted_term = term
                 self._voted_for = self.addr
@@ -905,6 +960,10 @@ class RaftPart:
                 won.set()
             won.wait(float(flags.get("raft_rpc_timeout_s")))
 
+            # NB: a distinct name — ``won`` is the Event still captured
+            # by in-flight ask() closures; rebinding it would make a
+            # straggler vote response call .set() on a bool
+            elected = False
             with self._lock:
                 if self.term != term or self.role != Role.CANDIDATE:
                     return
@@ -912,8 +971,15 @@ class RaftPart:
                     self.role = Role.LEADER
                     self.leader = self.addr
                     self._last_hb = 0.0
+                    elected = True
                 else:
                     self.role = Role.FOLLOWER
+            if elected:
+                journal.record("raft.leader_elected",
+                               detail=f"won with {votes['n']}/"
+                                      f"{1 + len(voters)} votes",
+                               space=self.space_id, part=self.part_id,
+                               term=term, host=self.addr)
         finally:
             with self._lock:
                 self._electing = False
@@ -936,6 +1002,13 @@ class RaftPart:
                 self.peers[addr] = Peer(addr, is_learner=True)
             else:
                 p.is_learner = True
+            is_leader = self.role == Role.LEADER
+        if is_leader:
+            # one event per change, journaled by the leader only —
+            # every replica pre-processes the same COMMAND log
+            journal.record("raft.membership", detail=f"add learner {addr}",
+                           space=self.space_id, part=self.part_id,
+                           host=self.addr)
 
     def add_peer(self, payload: bytes) -> None:
         addr = payload.decode() if isinstance(payload, bytes) else payload
@@ -950,6 +1023,11 @@ class RaftPart:
                 self.peers[addr] = Peer(addr)
             else:
                 p.is_learner = False
+            is_leader = self.role == Role.LEADER
+        if is_leader:
+            journal.record("raft.membership", detail=f"add peer {addr}",
+                           space=self.space_id, part=self.part_id,
+                           host=self.addr)
 
     def remove_peer(self, payload: bytes) -> None:
         addr = payload.decode() if isinstance(payload, bytes) else payload
@@ -958,6 +1036,11 @@ class RaftPart:
                 self.role = Role.LEARNER           # no longer votes
                 return
             self.peers.pop(addr, None)
+            is_leader = self.role == Role.LEADER
+        if is_leader:
+            journal.record("raft.membership", detail=f"remove peer {addr}",
+                           space=self.space_id, part=self.part_id,
+                           host=self.addr)
 
     def prepare_leader_transfer(self, payload: bytes) -> None:
         """COMMAND OP_TRANS_LEADER hits every replica at append; the

@@ -17,6 +17,7 @@ import concurrent.futures
 import threading
 from typing import Dict, List, Optional
 
+from ..common.clock import Duration
 from ..common.flags import flags
 from ..common.ordered_lock import OrderedLock
 from ..common.stats import stats
@@ -63,14 +64,22 @@ class StorageService:
         self._device_rt_lock = OrderedLock("storage.device_rt")
         self._remote_views: Dict = {}   # (space_id, host_str) -> view
         self._device_fail_log: Dict = {}  # (method, exc type) -> last log
-        stats.register_stats("storage.get_bound.latency_us")
-        stats.register_stats("storage.add.latency_us")
+        stats.register_histogram("storage.get_bound.latency_us")
+        stats.register_histogram("storage.add.latency_us")
         stats.register_stats("storage.qps")
         stats.register_stats("storage.device_go.qps")
         stats.register_stats("storage.device_path.qps")
         stats.register_stats("storage.device_decline.qps")
         stats.register_stats("storage.backend_bound.qps")
         stats.register_stats("storage.backend_stats.qps")
+        # raft replication gauges for every part this node hosts —
+        # refreshed only when /metrics or SHOW STATS scrapes (the
+        # collector is a weak bound method: dropped with the service)
+        stats.register_collector(self._collect_metrics)
+
+    def _collect_metrics(self) -> None:
+        from ..kvstore.store import collect_raft_gauges
+        collect_raft_gauges(self.kv, self.local_host or "local")
 
     # ---- ownership / leadership gate --------------------------------
     def _check_parts(self, space_id: int, part_ids) -> None:
@@ -459,13 +468,19 @@ class StorageService:
     # ---- writes -----------------------------------------------------
     def rpc_addVertices(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        return self._bulk(req, AddVerticesProcessor(
+        dur = Duration()
+        resp = self._bulk(req, AddVerticesProcessor(
             self.kv, self.schema_man).process)
+        stats.add_value("storage.add.latency_us", dur.elapsed_in_usec())
+        return resp
 
     def rpc_addEdges(self, req: dict) -> dict:
         stats.add_value("storage.qps")
-        return self._bulk(req, AddEdgesProcessor(
+        dur = Duration()
+        resp = self._bulk(req, AddEdgesProcessor(
             self.kv, self.schema_man).process)
+        stats.add_value("storage.add.latency_us", dur.elapsed_in_usec())
+        return resp
 
     def rpc_deleteVertex(self, req: dict) -> dict:
         self._check_parts(req["space_id"], [req["part"]])
@@ -519,6 +534,44 @@ class StorageService:
                                 "peers": {}})
         return {"parts": out}
 
+    def rpc_daemonStats(self, req: dict) -> dict:
+        """One daemon's 60 s stats snapshot for metad's SHOW STATS
+        fan-out (the nGQL analogue of scraping /get_stats)."""
+        return {"host": self.local_host or "storaged",
+                "stats": stats.dump()}
+
+    def part_status_brief(self) -> Dict[str, dict]:
+        """Per-part replication brief piggybacked on heartbeats
+        (meta/client.py hb_parts_provider): metad folds it into the
+        host table so SHOW PARTS can show term/commit/log positions
+        without scraping every storaged."""
+        out: Dict[str, dict] = {}
+        for sid in list(self.kv.spaces):
+            for pid in self.kv.part_ids(sid):
+                part = self.kv.part(sid, pid)
+                if part is None or part.raft is None:
+                    continue
+                st = part.raft.status()
+                out[f"{sid}/{pid}"] = {
+                    "role": st["role"], "term": st["term"],
+                    "committed": st["committed"],
+                    "last_log_id": st["last_log_id"]}
+        return out
+
+    def device_ready(self) -> bool:
+        """Healthz probe: the device runtime either isn't wanted
+        (storage_backend=cpu) or its jax substrate imports/configures."""
+        if flags.get("storage_backend") == "cpu":
+            return True
+        if self._device_rt is not None or self._backend_rt is not None:
+            return True
+        try:
+            from ..tpu.jax_setup import ensure_jax_configured
+            ensure_jax_configured()
+            return True
+        except Exception:       # noqa: BLE001
+            return False
+
     def rpc_addLearner(self, req: dict) -> dict:
         part = self._raft(req)
         if part.raft is not None:
@@ -551,4 +604,5 @@ class StorageService:
         return {}
 
     def shutdown(self) -> None:
+        stats.unregister_collector(self._collect_metrics)
         self.pool.shutdown(wait=False)

@@ -104,6 +104,28 @@ def _ingest(node, space_id: int, path: Optional[str]) -> dict:
             **({} if st.ok() else {"error": st.msg})}
 
 
+def _meta_reachable(node):
+    """Healthz: one live heartbeat round-trip — metad down, partitioned
+    (or fault-injected away) flips this red within one probe."""
+    st = node.meta_client.heartbeat()
+    return st.ok(), "heartbeat ok" if st.ok() else st.to_string()
+
+
+def _parts_serving(node):
+    """Healthz: every hosted partition exists and (when replicated)
+    knows a raft leader — a part mid-election or mid-snapshot can't
+    serve reads/writes yet."""
+    total = unserved = 0
+    for sid in list(node.kv.spaces):
+        for pid in node.kv.part_ids(sid):
+            total += 1
+            part = node.kv.part(sid, pid)
+            if part is None or (part.raft is not None
+                                and part.leader() is None):
+                unserved += 1
+    return unserved == 0, f"{total - unserved}/{total} parts serving"
+
+
 def register_web_handlers(ws, node) -> None:
     """Wire the storaged handlers onto a WebService (shared by
     daemons/storaged.py and the in-process test clusters)."""
@@ -115,3 +137,10 @@ def register_web_handlers(ws, node) -> None:
     ws.register_handler(
         "/ingest", lambda q, b: (200, _ingest(
             node, int(q.get("space", 0)), q.get("path"))))
+    # readiness (/healthz): meta reachable, partitions serving, device
+    # runtime importable (docs/observability.md "Metrics & events")
+    ws.register_health_check("meta", lambda: _meta_reachable(node))
+    ws.register_health_check("parts", lambda: _parts_serving(node))
+    ws.register_health_check(
+        "device", lambda: (node.service.device_ready(),
+                           "device runtime ready"))

@@ -3,7 +3,7 @@
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
 on Status/StatusOr, clang-tidy, sanitizer builds) plus a Thrift IDL
 that makes wire drift a compile error — both lost in a Python
-reproduction.  nebulint restores the project-specific part as eight
+reproduction.  nebulint restores the project-specific part as nine
 whole-package checks gated as a tier-1 test (tests/test_lint.py):
 
   lock-discipline   attributes mutated from thread entry points without
@@ -20,6 +20,11 @@ whole-package checks gated as a tier-1 test (tests/test_lint.py):
   span-registry     tracing.span()/start_trace() names must be literal
                     dotted strings from the single SPAN_NAMES registry
                     (common/tracing.py), with dead entries flagged
+  metric-registry   StatsManager names (add_value/observe/set_gauge/
+                    register_*) must be literals from the single
+                    METRIC_NAMES registry (common/stats.py); entries
+                    ending `.*` license f-string families; dead
+                    entries flagged
   jaxpr-audit       SEMANTIC: traces every registered kernel factory
                     (tpu/kernels.py KERNEL_REGISTRY) across the
                     runtime's real shape buckets and proves, on the

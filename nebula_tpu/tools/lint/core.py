@@ -163,8 +163,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
-    from . import flagsreg, hotpath, jaxaudit, locks, spans, status, \
-        wirecheck
+    from . import flagsreg, hotpath, jaxaudit, locks, metrics, spans, \
+        status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -172,6 +172,7 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "jax-hotpath": hotpath.check_jax_hotpath,
         "flag-registry": flagsreg.check_flag_registry,
         "span-registry": spans.check_span_registry,
+        "metric-registry": metrics.check_metric_registry,
         "jaxpr-audit": jaxaudit.check_jaxpr_audit,
         "wire-contract": wirecheck.check_wire_contract,
     }
@@ -179,7 +180,7 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
 
 ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "jax-hotpath", "flag-registry", "span-registry",
-              "jaxpr-audit", "wire-contract")
+              "metric-registry", "jaxpr-audit", "wire-contract")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 

@@ -1,0 +1,164 @@
+"""metric-registry — every StatsManager name (``stats.add_value``,
+``observe``, ``set_gauge``, ``register_stats``, ``register_histogram``)
+is a LITERAL dotted string from the single ``METRIC_NAMES`` registry
+(common/stats.py), and no dead registry entries remain.
+
+Mirrors the span-registry contract (spans.py): dynamic metric names
+would make /metrics un-greppable and dashboards unstable.  One
+extension the tracing check doesn't need: a registry entry ending in
+``.*`` licenses a bounded dynamic FAMILY — an f-string whose leading
+literal matches the prefix (``f"graph.stmt.{kind}.latency_us"`` under
+``graph.stmt.*``).  Anything else non-literal is flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import PackageContext, Violation, dotted, enclosing_symbol, \
+    qualname_map
+
+_CALLS = ("add_value", "observe", "set_gauge", "register_stats",
+          "register_histogram")
+
+
+def _literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _name_forms(node: ast.AST) -> Optional[List[Tuple[str, bool]]]:
+    """Resolve a metric-name argument into [(text, is_prefix)] forms:
+    a literal -> [(name, False)]; an IfExp over two literals -> both;
+    an f-string with a leading literal -> [(head, True)].  None means
+    irreducibly dynamic."""
+    lit = _literal(node)
+    if lit is not None:
+        return [(lit, False)]
+    if isinstance(node, ast.IfExp):
+        body = _name_forms(node.body)
+        orelse = _name_forms(node.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = _literal(node.values[0])
+        if head:
+            return [(head, True)]
+    return None
+
+
+def _registry_names(node: ast.AST) -> Optional[List[str]]:
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out = []
+    for el in node.elts:
+        name = _literal(el)
+        if name is None:
+            return None
+        out.append(name)
+    return out
+
+
+def _matches(form: Tuple[str, bool], exact: set, wildcards: List[str]
+             ) -> Optional[str]:
+    """Registry entry this use satisfies, or None.  An f-string head
+    must carry the FULL wildcard prefix — a shorter head (``graph.``
+    under ``graph.stmt.*``) could name any family and would defeat the
+    closed set."""
+    text, is_prefix = form
+    if not is_prefix and text in exact:
+        return text
+    for w in wildcards:
+        if text.startswith(w[:-1]):   # "graph.stmt.*" -> "graph.stmt."
+            return w
+    return None
+
+
+def check_metric_registry(ctx: PackageContext) -> List[Violation]:
+    registries: List[Tuple[str, int, List[str]]] = []
+    # (forms-or-None, rel, line, symbol)
+    uses: List[Tuple[Optional[List[Tuple[str, bool]]], str, int, str]] = []
+    out: List[Violation] = []
+
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name) \
+                                and tgt.id == "METRIC_NAMES":
+                            names = _registry_names(child.value)
+                            if names is not None:
+                                registries.append((mod.rel, child.lineno,
+                                                   names))
+                if isinstance(child, ast.Call):
+                    d = dotted(child.func) or ""
+                    parts = d.split(".")
+                    if parts[-1] in _CALLS and any(
+                            p == "stats" or p.endswith("stats")
+                            for p in parts[:-1]):
+                        forms = _name_forms(child.args[0]) \
+                            if child.args else None
+                        uses.append((forms, mod.rel, child.lineno,
+                                     enclosing_symbol(qmap, stack)))
+                new_stack = stack + [child] if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) else stack
+                walk(child, new_stack)
+
+        walk(mod.tree, [])
+
+    if not uses and not registries:
+        return out
+    if len(registries) > 1:
+        for rel, line, _ in registries[1:]:
+            out.append(Violation(
+                "metric-registry", rel, line, "<module>",
+                "second METRIC_NAMES registry — metric names must come "
+                f"from ONE registry (first at {registries[0][0]}:"
+                f"{registries[0][1]})"))
+    known = registries[0][2] if registries else []
+    exact = {n for n in known if not n.endswith("*")}
+    wildcards = [n for n in known if n.endswith("*")]
+
+    hit: set = set()
+    for forms, rel, line, sym in uses:
+        if forms is None:
+            out.append(Violation(
+                "metric-registry", rel, line, sym,
+                "metric name must be a literal dotted string from the "
+                "METRIC_NAMES registry (or an f-string under a "
+                "registered `family.*` prefix) — dynamic names break "
+                "/metrics dashboards and grep"))
+            continue
+        if not registries:
+            out.append(Violation(
+                "metric-registry", rel, line, sym,
+                f"metric {forms[0][0]!r} used but no METRIC_NAMES "
+                "registry exists in the package"))
+            continue
+        for form in forms:
+            entry = _matches(form, exact, wildcards)
+            if entry is None:
+                kind = "f-string family" if form[1] else "name"
+                out.append(Violation(
+                    "metric-registry", rel, line, sym,
+                    f"metric {kind} {form[0]!r} is not in the "
+                    f"METRIC_NAMES registry ({registries[0][0]}:"
+                    f"{registries[0][1]}) — add it there first"))
+            else:
+                hit.add(entry)
+
+    if registries:
+        rel, line, _names = registries[0]
+        for name in known:
+            if name not in hit:
+                out.append(Violation(
+                    "metric-registry", rel, line, "<module>",
+                    f"metric name {name!r} is registered but never used "
+                    "by a stats add_value/observe/set_gauge/register "
+                    "call — delete it or instrument the seam"))
+    return out

@@ -65,6 +65,9 @@ ENDPOINT_CONTRACT = {
     "/traces": {"keys": {"error", "traces", "slow_queries"},
                 "dynamic": True},
     "/faults": {"keys": {"error", "seed", "rules"}, "dynamic": True},
+    "/metrics": {"keys": set(), "dynamic": True},   # text exposition
+    "/healthz": {"keys": {"healthy", "checks"}, "dynamic": True},
+    "/events": {"keys": {"error", "events"}, "dynamic": True},
 }
 
 

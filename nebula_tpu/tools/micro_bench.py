@@ -173,8 +173,47 @@ def bench_query(reps: int) -> dict:
         cluster.stop()
 
 
+def bench_metrics(reps: int, op_budget_ns: float = 50_000.0,
+                  render_budget_s: float = 2.0) -> dict:
+    """Metrics-plane hot-path cost: per-op latency of the counter /
+    histogram write paths (the only thing the GO hot path ever pays —
+    gauges and exposition run at scrape time only) plus one
+    prometheus_text render of the LIVE registry.  Deterministic budget
+    guard, like bench_lint: per-op cost over ``op_budget_ns`` or a
+    render over ``render_budget_s`` fails the run.  The end-to-end
+    confirmation lives in query_path: its GO/s number is measured with
+    every metric above enabled, so comparing it release-over-release
+    (BASELINE.md) is the "within noise" check."""
+    from ..common.stats import StatsManager, stats
+    m = StatsManager()
+    m.register_stats("bench.counter")
+    m.register_histogram("bench.hist")
+    n = max(1000, reps * 100)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m.add_value("bench.counter")
+    t_ctr = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n):
+        m.observe("bench.hist", float(i & 1023), width=128)
+    t_obs = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    text = stats.prometheus_text()      # the process-global registry
+    t_render = time.perf_counter() - t0
+    ctr_ns = t_ctr / n * 1e9
+    obs_ns = t_obs / n * 1e9
+    return {"counter_ns_per_op": round(ctr_ns, 1),
+            "observe_ns_per_op": round(obs_ns, 1),
+            "render_s": round(t_render, 4),
+            "render_bytes": len(text),
+            "op_budget_ns": op_budget_ns,
+            "within_budget": (ctr_ns <= op_budget_ns
+                              and obs_ns <= op_budget_ns
+                              and t_render <= render_budget_s)}
+
+
 def bench_lint(budget_s: float) -> dict:
-    """Wall time of the whole-package nebulint run (all eight checks —
+    """Wall time of the whole-package nebulint run (all nine checks —
     the jaxpr tracing of every registered kernel bucket included).
     The analysis gates tier-1, so it must stay interactive: exceeding
     ``budget_s`` is reported as a guard failure in the result (and
@@ -211,10 +250,13 @@ def main(argv=None) -> int:
         "key_codec": bench_keys(rows),
         "wal": bench_wal(entries),
         "query_path": bench_query(qreps),
+        "metrics_path": bench_metrics(reps),
         "lint": bench_lint(args.lint_budget_s),
     }
     print(json.dumps(out))
-    return 0 if out["lint"]["within_budget"] else 1
+    ok = out["lint"]["within_budget"] \
+        and out["metrics_path"]["within_budget"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

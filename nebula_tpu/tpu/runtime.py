@@ -45,6 +45,7 @@ import numpy as np
 
 from ..common import tracing
 from ..common.flags import flags
+from ..common.stats import stats as _stats
 from ..common.status import ErrorCode
 from ..filter.expressions import ExprContext, ExprError, Expression
 from ..graph.interim import InterimResult
@@ -263,6 +264,48 @@ class TpuQueryRuntime:
         # have absorbed)
         self._prewarmed_shapes: set = set()
         self._live_shapes: set = set()
+        # device telemetry for the cluster metrics plane: the counters
+        # above export as gauges at scrape time (weak bound method — a
+        # discarded runtime unregisters itself), and every batched GO
+        # dispatch lands one latency observation keyed by its dense
+        # batch-width rung
+        _stats.register_histogram("tpu.dispatch.latency_us")
+        _stats.register_collector(self._collect_metrics)
+
+    @staticmethod
+    def _mirror_nbytes(m: CsrMirror) -> int:
+        """Approximate HBM residency of one space's mirror: the core
+        CSR arrays plus every finalized column/tag bitmap (the device
+        copies mirror these host arrays 1:1, modulo int64->int32/f32
+        narrowing — good enough for capacity dashboards)."""
+        total = (m.vids.nbytes + m.edge_src.nbytes + m.edge_dst.nbytes
+                 + m.edge_etype.nbytes + m.edge_rank.nbytes
+                 + m.row_ptr.nbytes)
+        for col in list(m.edge_cols.values()) \
+                + list(m.vertex_cols.values()):
+            vals = getattr(col, "values", None)
+            if vals is not None and hasattr(vals, "nbytes"):
+                total += vals.nbytes
+        for bm in m.has_tag.values():
+            total += bm.nbytes
+        return int(total)
+
+    def _collect_metrics(self) -> None:
+        """Scrape-time gauge refresh (stats.register_collector)."""
+        with self._lock:
+            mirrors = dict(self.mirrors)
+            n_kernels = len(self._kernels)
+            snap = dict(self.stats)
+        for space_id, m in mirrors.items():
+            _stats.set_gauge("tpu.mirror.hbm_bytes",
+                             self._mirror_nbytes(m), space=space_id)
+        _stats.set_gauge("tpu.jit_cache.size", n_kernels)
+        _stats.set_gauge("tpu.compile.count",
+                         snap.get("kernel_compiles", 0))
+        _stats.set_gauge("tpu.mirror.builds", snap.get("mirror_builds", 0))
+        _stats.set_gauge("tpu.prewarm.hits", snap.get("prewarm_hits", 0))
+        _stats.set_gauge("tpu.prewarm.misses",
+                         snap.get("prewarm_misses", 0))
 
     def _tick(self, key: str, t0: float) -> float:
         """Accumulate wall time into a stats bucket; returns now."""
@@ -739,6 +782,12 @@ class TpuQueryRuntime:
                     results = self._assemble_results(space_id, m, queries,
                                                      vs_lists, et_tuple)
             self._tick("t_assemble_s", t1)
+            # whole-dispatch latency (launch -> fetch -> assemble),
+            # bucketed by the dense batch-width rung this query count
+            # rides — one histogram update per BATCH, not per query
+            _stats.observe("tpu.dispatch.latency_us",
+                           (time.perf_counter() - t0) * 1e6,
+                           width=self._batch_width(len(queries)))
             return results, m
 
         return _Pending(finish)
@@ -2094,6 +2143,8 @@ class TpuQueryRuntime:
             if kern is None:
                 # a cache miss is a jit (re)trace event — the p99 spike
                 # source PROFILE must be able to name
+                self.stats["kernel_compiles"] = \
+                    self.stats.get("kernel_compiles", 0) + 1
                 with tracing.span("tpu.jit.compile", kernel=str(key[0])):
                     kern = self._kernels[key] = builder()
         return kern

@@ -16,9 +16,18 @@ HTTP server exposing
                                     trace summaries, one span tree, or
                                     the slow-query log
                                     (docs/observability.md)
+  GET /metrics                      Prometheus text exposition of the
+                                    whole StatsManager registry
+                                    (counters, gauges, histograms)
+  GET /healthz                      readiness: 200 when every registered
+                                    health check passes, else 503
+  GET /events[?limit=N]             event journal, newest first
+                                    (common/events.py)
 
 plus ``register_handler(path, fn)`` for daemon-specific paths (storage's
-/download /ingest /admin, meta's /*-dispatch — SURVEY.md §2.10).
+/download /ingest /admin, meta's /*-dispatch — SURVEY.md §2.10) and
+``register_health_check(name, fn)`` for daemon-specific readiness
+probes (meta reachable, partitions serving, device runtime up).
 """
 from __future__ import annotations
 
@@ -38,11 +47,16 @@ class WebService:
         self.daemon_name = daemon_name
         # path -> fn(query_dict, body: bytes) -> (code, obj-or-str)
         self._handlers: Dict[str, Callable] = {}
+        # name -> fn() -> (ok: bool, detail: str); all must pass for 200
+        self._health_checks: Dict[str, Callable] = {}
         self.register_handler("/status", self._status)
         self.register_handler("/flags", self._flags)
         self.register_handler("/faults", self._faults)
         self.register_handler("/get_stats", self._get_stats)
         self.register_handler("/traces", self._traces)
+        self.register_handler("/metrics", self._metrics)
+        self.register_handler("/healthz", self._healthz)
+        self.register_handler("/events", self._events)
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -103,6 +117,12 @@ class WebService:
 
     def register_handler(self, path: str, fn: Callable) -> None:
         self._handlers[path] = fn
+
+    def register_health_check(self, name: str, fn: Callable) -> None:
+        """``fn() -> (ok, detail)``; /healthz is 200 only when every
+        registered check passes.  A check that raises counts as
+        failed (its exception becomes the detail)."""
+        self._health_checks[name] = fn
 
     # ------------------------------------------------------- built-ins
     def _status(self, q: dict, body: bytes):
@@ -173,6 +193,40 @@ class WebService:
         if q.get("slow"):
             return 200, {"slow_queries": slow_log.dump()}
         return 200, {"traces": trace_store.summaries()}
+
+    def _metrics(self, q: dict, body: bytes):
+        """Prometheus text exposition (docs/observability.md): the
+        whole StatsManager registry — cumulative counters, native
+        explicit-bucket histograms, and collector-refreshed gauges
+        (raft replication per (space, part), TPU device telemetry)."""
+        return 200, stats.prometheus_text()
+
+    def _healthz(self, q: dict, body: bytes):
+        """Readiness probe: every check registered via
+        register_health_check must pass.  A daemon with no checks is
+        trivially ready (bare liveness, like /status)."""
+        checks = {}
+        healthy = True
+        for name, fn in sorted(self._health_checks.items()):
+            try:
+                ok, detail = fn()
+            except Exception as e:         # noqa: BLE001
+                ok, detail = False, f"{type(e).__name__}: {e}"
+            checks[name] = {"ok": bool(ok), "detail": str(detail)}
+            healthy = healthy and bool(ok)
+        return (200 if healthy else 503), {"healthy": healthy,
+                                           "checks": checks}
+
+    def _events(self, q: dict, body: bytes):
+        """Local event journal, newest first (common/events.py).  On
+        metad the daemon overrides this path with the cluster-wide
+        aggregation (daemons/metad.py)."""
+        from ..common.events import journal
+        try:
+            limit = int(q.get("limit", 100))
+        except ValueError:
+            return 400, {"error": f"bad limit {q.get('limit')!r}"}
+        return 200, {"events": journal.dump(limit=limit)}
 
     def _get_stats(self, q: dict, body: bytes):
         exprs = q.get("stats")

@@ -214,3 +214,57 @@ def test_node_crash_failover():
         assert sorted(x[0] for x in r.rows) == [4]
     finally:
         c.stop()
+
+
+def test_step_down_records_election_event_and_show_parts(cluster, client):
+    """Observability acceptance: a forced step-down (leader transfer)
+    must surface as a raft.leader_elected journal event, visible
+    through SHOW EVENTS, and SHOW PARTS must carry the replication
+    columns (term/committed/last log) sourced from heartbeat briefs."""
+    from nebula_tpu.common.events import journal
+
+    moved = None
+    # an earlier test may have drained node 0's leaderships — take the
+    # first led part on ANY node (module-scoped cluster)
+    for node in cluster.storage_nodes:
+        for st in node.raft_service.status():
+            if st["role"] == "LEADER" and st["peers"]:
+                part = node.kv.part(st["space"], st["part"])
+                target = next(iter(part.raft.peers))
+                part.raft.transfer_leadership(target)
+                moved = st
+                break
+        if moved is not None:
+            break
+    assert moved is not None, "no node leads anything to transfer"
+
+    # the target's election (a term beyond the pre-transfer one) must
+    # land in the process journal
+    deadline = time.monotonic() + 20
+    elected = []
+    while time.monotonic() < deadline and not elected:
+        elected = [e for e in journal.dump(limit=500)
+                   if e["kind"] == "raft.leader_elected"
+                   and e.get("space") == moved["space"]
+                   and e.get("part") == moved["part"]
+                   and e.get("term", 0) > moved["term"]]
+        time.sleep(0.05)
+    assert elected, "no raft.leader_elected event after forced step-down"
+    # the deposed leader journals its step-down too (same-term append
+    # or higher-term vote — either way the role change is recorded)
+    kinds = {e["kind"] for e in journal.dump(limit=500)}
+    assert "raft.step_down" in kinds
+
+    resp = client.ok("SHOW EVENTS")
+    assert "raft.leader_elected" in {r[2] for r in resp.rows}
+
+    # replication columns ride the heartbeat brief into metad
+    cluster.refresh_all()
+    resp = client.ok("SHOW PARTS")
+    assert resp.column_names == ["Partition ID", "Leader", "Term",
+                                 "Committed", "Last Log", "Peers"]
+    with_leader = [r for r in resp.rows if r[1] != "-"]
+    assert with_leader, "no part reported a leader over heartbeats"
+    for r in with_leader:
+        assert isinstance(r[2], int) and r[2] >= 1      # elected terms
+        assert isinstance(r[3], int) and isinstance(r[4], int)

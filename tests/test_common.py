@@ -140,6 +140,47 @@ class TestStats:
         total, count, vals = st.window(60, later)
         assert (total, count, vals) == (3.0, 1, [3])
 
+    def test_ring_wrap_resets_window_minmax(self):
+        """Companion to the ring-wrap test for the new per-bucket
+        min/max columns: a wrapped bucket's extremes must not leak
+        into the fresh second, and dump()'s min.60/max.60 must track
+        the reset values (exact, not reservoir-sampled)."""
+        m = StatsManager()
+        m.register_stats("w")
+        st = m._stats["w"]
+        now = 1_700_000_000.0
+        st.add(7, now)
+        st.add(999, now)
+        d = m.dump(now)["w"]
+        assert (d["min.60"], d["max.60"]) == (7.0, 999.0)
+        later = now + 3600
+        d = m.dump(later)["w"]
+        assert (d["min.60"], d["max.60"]) == (0.0, 0.0)   # empty window
+        st.add(3, later)
+        d = m.dump(later)["w"]
+        assert (d["min.60"], d["max.60"]) == (3.0, 3.0)
+        assert (d["count.60"], d["sum.60"]) == (1.0, 3.0)
+
+    def test_dump_histogram_count_sum_min_max(self):
+        """Satellite regression: dump() carries count/sum/min/max per
+        stat (histograms included), and the cumulative Prometheus cells
+        survive window expiry — buckets are since-start, windows slide."""
+        m = StatsManager()
+        m.register_histogram("h", buckets=(10, 100))
+        now = time.time()
+        for v in (5, 50, 500):
+            m._stats["h"].add(v, now)
+        d = m.dump(now)["h"]
+        assert d["count.60"] == 3.0 and d["sum.60"] == 555.0
+        assert d["min.60"] == 5.0 and d["max.60"] == 500.0
+        # an hour later the window is empty but the cumulative cell
+        # (what /metrics exposes) still counts everything
+        d2 = m.dump(now + 3600)["h"]
+        assert d2["count.60"] == 0.0
+        cell = m._stats["h"].cells[()]
+        assert cell.count == 3 and cell.sum == 555.0
+        assert cell.min == 5 and cell.max == 500
+
 
 class TestClock:
     def test_duration(self):

@@ -344,6 +344,118 @@ def test_span_registry_ignores_unrelated_span_calls(tmp_path):
     """}, checks=["span-registry"]) == []
 
 
+# ================================================ 7 · metric-registry
+_METRIC_REG = """
+    from common.stats import stats
+
+    METRIC_NAMES = ("graph.qps", "graph.stmt.*", "raft.term")
+
+    def f(kind):
+        stats.add_value("graph.qps")
+        stats.observe(f"graph.stmt.{kind}.latency_us", 1.0)
+        stats.set_gauge("raft.term", 3, space=1)
+"""
+
+
+def test_metric_registry_clean(tmp_path):
+    assert run_fixture(tmp_path, {"stats.py": _METRIC_REG},
+                       checks=["metric-registry"]) == []
+
+
+def test_metric_registry_unknown_name(tmp_path):
+    bad = _METRIC_REG.replace('stats.add_value("graph.qps")',
+                              'stats.add_value("graph.mystery")')
+    vs = run_fixture(tmp_path, {"stats.py": bad},
+                     checks=["metric-registry"])
+    msgs = [v.message for v in vs]
+    assert any("graph.mystery" in m and "not in the METRIC_NAMES" in m
+               for m in msgs)
+    # the now-unused registry entry is flagged dead too
+    assert any("'graph.qps'" in m and "never used" in m for m in msgs)
+
+
+def test_metric_registry_fstring_needs_wildcard(tmp_path):
+    bad = _METRIC_REG.replace(
+        'stats.observe(f"graph.stmt.{kind}.latency_us", 1.0)',
+        'stats.observe(f"rogue.family.{kind}", 1.0)')
+    vs = run_fixture(tmp_path, {"stats.py": bad},
+                     checks=["metric-registry"])
+    msgs = [v.message for v in vs]
+    assert any("rogue.family." in m and "not in the METRIC_NAMES" in m
+               for m in msgs)
+    assert any("'graph.stmt.*'" in m and "never used" in m for m in msgs)
+
+
+def test_metric_registry_short_fstring_head_rejected(tmp_path):
+    """An f-string whose literal head is a PREFIX of a wildcard entry
+    ("graph." under "graph.stmt.*") could name any family — it must
+    NOT satisfy the registry."""
+    bad = _METRIC_REG.replace(
+        'stats.observe(f"graph.stmt.{kind}.latency_us", 1.0)',
+        'stats.observe(f"graph.{kind}", 1.0)')
+    vs = run_fixture(tmp_path, {"stats.py": bad},
+                     checks=["metric-registry"])
+    assert any("'graph.'" in v.message and "not in the METRIC_NAMES"
+               in v.message for v in vs)
+
+
+def test_metric_registry_dynamic_name_rejected(tmp_path):
+    bad = _METRIC_REG.replace('stats.add_value("graph.qps")',
+                              'stats.add_value(kind)')
+    vs = run_fixture(tmp_path, {"stats.py": bad},
+                     checks=["metric-registry"])
+    assert any("literal" in v.message for v in vs)
+
+
+def test_metric_registry_ifexp_literals_resolved(tmp_path):
+    ok = _METRIC_REG.replace(
+        'stats.add_value("graph.qps")',
+        'stats.add_value("graph.qps" if kind else "raft.term")')
+    # both arms resolve; raft.term now has a second use — still clean
+    assert run_fixture(tmp_path, {"stats.py": ok},
+                       checks=["metric-registry"]) == []
+
+
+def test_metric_registry_requires_single_registry(tmp_path):
+    files = {"stats.py": _METRIC_REG,
+             "other.py": 'METRIC_NAMES = ("dup.reg",)\n'}
+    vs = run_fixture(tmp_path, files, checks=["metric-registry"])
+    assert any("ONE registry" in v.message for v in vs)
+
+
+def test_metric_registry_missing_registry(tmp_path):
+    vs = run_fixture(tmp_path, {"mod.py": """
+        from common.stats import stats
+
+        def f():
+            stats.add_value("orphan.metric")
+    """}, checks=["metric-registry"])
+    assert any("no METRIC_NAMES registry" in v.message for v in vs)
+
+
+def test_metric_registry_ignores_unrelated_receivers(tmp_path):
+    """Only stats-ish receivers count — a runtime's own `self.stats`
+    dict ops or random add_value helpers must not trip the check."""
+    assert run_fixture(tmp_path, {"mod.py": """
+        def add_value(x):
+            return x
+
+        class R:
+            def f(self):
+                return add_value("whatever")
+    """}, checks=["metric-registry"]) == []
+
+
+def test_metric_registry_suppression_round_trip(tmp_path):
+    bad = _METRIC_REG.replace(
+        'stats.add_value("graph.qps")',
+        'stats.add_value("graph.qps")\n'
+        '        stats.add_value(kind)  '
+        '# nebulint: disable=metric-registry')
+    assert run_fixture(tmp_path, {"stats.py": bad},
+                       checks=["metric-registry"]) == []
+
+
 # ====================================================== baseline rules
 def test_baseline_entry_requires_reason():
     with pytest.raises(LintError):
@@ -384,7 +496,8 @@ def test_all_checks_registered():
     assert set(ALL_CHECKS) == {"lock-discipline", "lock-order",
                                "status-discard", "jax-hotpath",
                                "flag-registry", "span-registry",
-                               "jaxpr-audit", "wire-contract"}
+                               "metric-registry", "jaxpr-audit",
+                               "wire-contract"}
 
 
 # ========================================== OrderedLock runtime watchdog

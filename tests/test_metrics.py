@@ -1,0 +1,448 @@
+"""Cluster metrics plane (docs/observability.md "Metrics & events"):
+
+* StatsManager v2 — explicit-bucket histograms (labeled), cumulative
+  totals, gauges + scrape-time collectors, dump() min/max columns.
+* /metrics Prometheus text exposition on graphd/storaged/metad
+  webservices, validated by a small in-repo parser (no new dependency):
+  at least one raft gauge, one TPU device gauge and one latency
+  histogram with monotone buckets.
+* /healthz readiness — flips unhealthy when the wire-level fault
+  injector blackholes the meta heartbeat.
+* /events + SHOW STATS / SHOW EVENTS end-to-end through a loopback
+  cluster (cluster rollup via metad fan-out, catalog-write events).
+"""
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nebula_tpu.cluster import LocalCluster
+from nebula_tpu.common.events import EVENT_KINDS, EventJournal, journal
+from nebula_tpu.common.stats import StatsManager, stats
+from nebula_tpu.webservice import WebService
+
+
+# ---------------------------------------------------------------------
+# A minimal Prometheus text-format (0.0.4) parser: enough rigor to
+# catch malformed lines, bad label escaping and non-monotone buckets.
+# ---------------------------------------------------------------------
+_COMMENT_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(-?[0-9.eE+-]+|NaN|[+-]Inf)$")
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def parse_prom(text):
+    """-> (types {family: type}, samples {(metric, labelstr): value})."""
+    types, samples = {}, {}
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("#"):
+            m = _COMMENT_RE.match(ln)
+            assert m, f"malformed comment line: {ln!r}"
+            types[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"malformed sample line: {ln!r}"
+        labelstr = m.group(2) or ""
+        if labelstr:
+            for kv in labelstr[1:-1].split(","):
+                assert _LABEL_RE.match(kv), \
+                    f"malformed label {kv!r} in {ln!r}"
+        key = (m.group(1), labelstr)
+        assert key not in samples, f"duplicate series {key}"
+        samples[key] = float(m.group(3))
+    return types, samples
+
+
+def _bucket_series(samples, fam):
+    """[(le, value)] of one histogram family's unlabeled-extra buckets,
+    grouped by their non-le labels."""
+    groups = {}
+    for (name, labelstr), v in samples.items():
+        if name != f"{fam}_bucket":
+            continue
+        le = None
+        rest = []
+        for kv in labelstr[1:-1].split(","):
+            k, val = kv.split("=", 1)
+            if k == "le":
+                le = val.strip('"')
+            else:
+                rest.append(kv)
+        groups.setdefault(tuple(rest), []).append(
+            (float("inf") if le == "+Inf" else float(le), v))
+    return {k: sorted(vs) for k, vs in groups.items()}
+
+
+# ---------------------------------------------------------------------
+# StatsManager v2 units
+# ---------------------------------------------------------------------
+class TestStatsHistograms:
+    def test_histogram_buckets_and_totals(self):
+        m = StatsManager()
+        m.register_histogram("lat", buckets=(10, 100, 1000))
+        for v in (5, 50, 500, 5000):
+            m.add_value("lat", v)
+        st = m._stats["lat"]
+        cell = st.cells[()]
+        assert cell.counts == [1, 1, 1]       # per-bound, 5000 overflows
+        assert cell.count == 4 and cell.sum == 5555
+        assert cell.min == 5 and cell.max == 5000
+        assert st.cum_count == 4 and st.cum_sum == 5555
+
+    def test_labeled_observe_children(self):
+        m = StatsManager()
+        m.register_histogram("disp", buckets=(10, 100))
+        m.observe("disp", 7, width=128)
+        m.observe("disp", 70, width=128)
+        m.observe("disp", 7, width=1024)
+        st = m._stats["disp"]
+        assert st.cells[(("width", 128),)].count == 2
+        assert st.cells[(("width", 1024),)].count == 1
+        # the windowed reservoir aggregates across labels (feeds the
+        # p95/p99 /get_stats columns)
+        total, count, vals = st.window(60)
+        assert count == 3 and sorted(vals) == [7, 7, 70]
+
+    def test_prometheus_text_histogram_shape(self):
+        m = StatsManager()
+        m.register_histogram("lat", buckets=(10, 100))
+        m.register_stats("qps")
+        for v in (5, 50, 500):
+            m.add_value("lat", v)
+        m.add_value("qps")
+        m.add_value("qps")
+        types, samples = parse_prom(m.prometheus_text())
+        assert types["nebula_lat"] == "histogram"
+        assert types["nebula_qps"] == "counter"
+        assert samples[("nebula_qps_total", "")] == 2.0
+        assert samples[("nebula_lat_count", "")] == 3.0
+        assert samples[("nebula_lat_sum", "")] == 555.0
+        for _labels, series in _bucket_series(samples, "nebula_lat").items():
+            vals = [v for _le, v in series]
+            assert vals == sorted(vals), "buckets must be cumulative"
+            assert series[-1][1] == 3.0       # +Inf == count
+
+    def test_gauges_and_collectors(self):
+        m = StatsManager()
+        calls = []
+
+        def collector():
+            calls.append(1)
+            m.set_gauge("raft.term", 7, space=1, part=2, host="h")
+
+        m.register_collector(collector)
+        rows = m.gauges()
+        assert calls and rows == [
+            ("raft.term", (("host", "h"), ("part", 2), ("space", 1)), 7.0)]
+        # stale series vanish: the table is re-set every scrape
+        m.unregister_collector(collector)
+        assert m.gauges() == []
+
+    def test_concurrent_scrapes_never_lose_series(self):
+        """Scrapes serialize: an overlapping scrape's table clear must
+        not wipe series another scrape's collectors just set (the
+        webservice is threaded; stats is process-global)."""
+        import threading
+        m = StatsManager()
+
+        def collector():
+            m.set_gauge("raft.term", 1)
+            time.sleep(0.005)       # widen the clear->snapshot window
+
+        m.register_collector(collector)
+        outs = []
+
+        def scrape():
+            outs.append(m.gauges())
+
+        threads = [threading.Thread(target=scrape) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(len(o) == 1 for o in outs), outs
+
+    def test_collector_weakref_drops_with_owner(self):
+        m = StatsManager()
+
+        class Owner:
+            def collect(self):
+                m.set_gauge("raft.term", 1)
+
+        o = Owner()
+        m.register_collector(o.collect)
+        assert len(m.gauges()) == 1
+        del o
+        import gc
+        gc.collect()
+        assert m.gauges() == []
+
+    def test_dump_min_max_columns(self):
+        m = StatsManager()
+        m.register_stats("lat")
+        now = time.time()
+        for v in (3, 900, 12):
+            m._stats["lat"].add(v, now)
+        d = m.dump(now)["lat"]
+        assert d["min.60"] == 3.0 and d["max.60"] == 900.0
+        assert d["count.60"] == 3.0 and d["sum.60"] == 915.0
+        # empty window: min/max present but zero (like p95/p99)
+        m.register_stats("idle")
+        assert m.dump(now)["idle"]["min.60"] == 0.0
+        assert m.dump(now)["idle"]["max.60"] == 0.0
+
+    def test_dump_min_max_survive_reservoir_cap(self):
+        """min/max come from per-bucket columns, not the (256-sample
+        capped) reservoir — an outlier past the cap must still show."""
+        m = StatsManager()
+        m.register_stats("lat")
+        now = time.time()
+        st = m._stats["lat"]
+        for _ in range(300):
+            st.add(10, now)
+        st.add(99999, now)          # beyond the sample cap
+        d = m.dump(now)["lat"]
+        assert d["max.60"] == 99999.0
+        assert d["min.60"] == 10.0
+
+
+# ---------------------------------------------------------------------
+# Event journal units
+# ---------------------------------------------------------------------
+class TestEventJournal:
+    def test_record_and_ring(self):
+        j = EventJournal()
+        for i in range(5):
+            j.record("query.slow", detail=str(i))
+        out = j.dump(limit=3)
+        assert [e["detail"] for e in out] == ["4", "3", "2"]
+        assert all(e["kind"] == "query.slow" for e in out)
+
+    def test_unknown_kind_refused(self):
+        j = EventJournal()
+        with pytest.raises(ValueError):
+            j.record("not.a.kind")
+
+    def test_since_cursor(self):
+        j = EventJournal()
+        j.record("query.slow", detail="a")
+        evs, last = j.since(0)
+        assert [e["detail"] for e in evs] == ["a"]
+        evs2, last2 = j.since(last)
+        assert evs2 == [] and last2 == last
+        j.record("query.slow", detail="b")
+        evs3, _ = j.since(last)
+        assert [e["detail"] for e in evs3] == ["b"]
+
+    def test_since_burst_drains_without_loss(self):
+        """A burst larger than one beat's budget must drain OLDEST
+        first over several cursor advances — the cap must never skip
+        the head of the backlog (the cursor tracks what was actually
+        returned, not the ring tail)."""
+        j = EventJournal()
+        for i in range(100):
+            j.record("query.slow", detail=str(i))
+        seen, cursor = [], 0
+        for _ in range(5):
+            evs, cursor = j.since(cursor, limit=64)
+            if not evs:
+                break
+            seen.extend(e["detail"] for e in evs)
+        assert seen == [str(i) for i in range(100)]
+
+
+# ---------------------------------------------------------------------
+# Endpoints + nGQL, end to end over a loopback cluster
+# ---------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(num_storage=1, use_raft=True, tpu_backend=True)
+    client = c.client()
+
+    def ok(stmt, tries=40):
+        last = None
+        for _ in range(tries):
+            last = client.execute(stmt)
+            if last.ok():
+                return last
+            time.sleep(0.1)
+        raise AssertionError(f"{stmt}: {last.error_msg}")
+
+    ok("CREATE SPACE mp(partition_num=2, replica_factor=1)")
+    c.refresh_all()
+    ok("USE mp; CREATE EDGE e(w int)")
+    c.refresh_all()
+    edges = ", ".join(f"{i} -> {i + 1}:({i})" for i in range(32))
+    ok(f"INSERT EDGE e(w) VALUES {edges}")
+    ok("GO FROM 1 OVER e YIELD e._dst")
+    c.refresh_all()           # heartbeat: parts brief + events to metad
+    c.ok = ok
+    yield c
+    client.disconnect()
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def webservices(cluster):
+    """graphd/storaged/metad-shaped WebServices, wired like the daemons
+    (storage/web.py register_web_handlers; metad /events override)."""
+    from nebula_tpu.storage.web import register_web_handlers
+    out = {}
+    s_ws = WebService("nebula-storaged", host="127.0.0.1").start()
+    register_web_handlers(s_ws, cluster.storage_nodes[0])
+    out["storaged"] = s_ws
+    m_ws = WebService("nebula-metad", host="127.0.0.1").start()
+    m_ws.register_handler(
+        "/events", lambda q, b: (200, cluster.meta_service.rpc_listEvents(
+            {"limit": q.get("limit", 200)})))
+    out["metad"] = m_ws
+    g_ws = WebService("nebula-graphd", host="127.0.0.1").start()
+    out["graphd"] = g_ws
+    yield out
+    for ws in out.values():
+        ws.stop()
+
+
+def _get(ws, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{ws.port}{path}", timeout=30)
+
+
+class TestMetricsEndpoint:
+    def test_all_daemons_serve_valid_exposition(self, webservices):
+        for name, ws in webservices.items():
+            types, samples = parse_prom(_get(ws, "/metrics").read().decode())
+            assert types, f"{name}: empty exposition"
+
+    def test_storaged_raft_gauges(self, webservices):
+        _types, samples = parse_prom(
+            _get(webservices["storaged"], "/metrics").read().decode())
+        terms = {k: v for k, v in samples.items()
+                 if k[0] == "nebula_raft_term"}
+        assert terms, "no raft term gauge exported"
+        assert any('space="' in k[1] and 'part="' in k[1] for k in terms)
+        lags = [v for k, v in samples.items()
+                if k[0] == "nebula_raft_commit_lag"]
+        assert lags and all(v >= 0 for v in lags)
+        assert any(k[0] == "nebula_raft_is_leader" and v == 1.0
+                   for k, v in samples.items())
+
+    def test_tpu_device_gauges(self, webservices):
+        _types, samples = parse_prom(
+            _get(webservices["storaged"], "/metrics").read().decode())
+        assert ("nebula_tpu_jit_cache_size", "") in samples
+        assert ("nebula_tpu_compile_count", "") in samples
+
+    def test_latency_histogram_shape(self, webservices):
+        types, samples = parse_prom(
+            _get(webservices["graphd"], "/metrics").read().decode())
+        assert types["nebula_graph_latency_us"] == "histogram"
+        series = _bucket_series(samples, "nebula_graph_latency_us")
+        assert series
+        for labels, buckets in series.items():
+            vals = [v for _le, v in buckets]
+            assert vals == sorted(vals), "buckets must be cumulative"
+        count = samples[("nebula_graph_latency_us_count", "")]
+        assert count >= 1
+        assert samples[("nebula_graph_latency_us_sum", "")] > 0
+
+    def test_fault_counters_present(self, webservices):
+        _types, samples = parse_prom(
+            _get(webservices["storaged"], "/metrics").read().decode())
+        assert ("nebula_rpc_fault_injected_total", "") in samples
+
+
+class TestHealthz:
+    def test_healthy_cluster_is_ready(self, cluster, webservices):
+        resp = _get(webservices["storaged"], "/healthz")
+        body = json.load(resp)
+        assert resp.status == 200 and body["healthy"] is True
+        assert set(body["checks"]) == {"meta", "parts", "device"}
+
+    def test_no_checks_means_bare_liveness(self, webservices):
+        resp = _get(webservices["graphd"], "/healthz")
+        assert resp.status == 200 and json.load(resp)["healthy"] is True
+
+    def test_flips_unhealthy_under_fault_injection(self, cluster,
+                                                   webservices):
+        from nebula_tpu.interface.faults import default_injector
+        default_injector.configure(
+            [{"kind": "blackhole", "method": "heartBeat"}], seed=7)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(webservices["storaged"], "/healthz")
+            assert ei.value.code == 503
+            body = json.load(ei.value)
+            assert body["healthy"] is False
+            assert body["checks"]["meta"]["ok"] is False
+        finally:
+            default_injector.clear()
+        # the injection itself is journaled
+        kinds = {e["kind"] for e in journal.dump(limit=200)}
+        assert "fault.injected" in kinds
+        # and recovery is observable
+        resp = _get(webservices["storaged"], "/healthz")
+        assert resp.status == 200
+
+
+class TestEventsEndpoint:
+    def test_events_listing(self, cluster, webservices):
+        body = json.load(_get(webservices["storaged"], "/events?limit=50"))
+        assert isinstance(body["events"], list) and body["events"]
+        for e in body["events"]:
+            assert e["kind"] in EVENT_KINDS
+            assert "time_us" in e and "id" in e
+        times = [e["time_us"] for e in body["events"]]
+        assert times == sorted(times, reverse=True)
+
+    def test_metad_serves_cluster_aggregation(self, cluster, webservices):
+        body = json.load(_get(webservices["metad"], "/events?limit=200"))
+        kinds = {e["kind"] for e in body["events"]}
+        assert "meta.catalog_write" in kinds
+
+
+class TestShowStatsEvents:
+    def test_show_stats_cluster_rollup(self, cluster):
+        r = cluster.ok("SHOW STATS")
+        assert r.column_names[:2] == ["Host", "Stat"]
+        hosts = {row[0] for row in r.rows}
+        assert "<cluster>" in hosts and "metad" in hosts
+        qps = [row for row in r.rows
+               if row[0] == "<cluster>" and row[1] == "graph.qps"]
+        assert qps and qps[0][2] >= 1       # Sum(60s)
+
+    def test_show_events_catalog_writes(self, cluster):
+        r = cluster.ok("SHOW EVENTS")
+        assert r.column_names == ["Time(us)", "Host", "Kind", "Detail"]
+        kinds = {row[2] for row in r.rows}
+        assert "meta.catalog_write" in kinds
+        details = {row[3] for row in r.rows if row[2] == "meta.catalog_write"}
+        assert any("createSpace" in d for d in details)
+
+    def test_show_parts_replication_columns(self, cluster):
+        r = cluster.ok("SHOW PARTS")
+        assert r.column_names == ["Partition ID", "Leader", "Term",
+                                  "Committed", "Last Log", "Peers"]
+        assert len(r.rows) == 2
+        # single-replica raft parts: this node leads, positions are ints
+        # (the heartbeat in the fixture's refresh_all delivered them)
+        leaders = {row[1] for row in r.rows}
+        assert leaders == {cluster.storage_nodes[0].host}
+        for row in r.rows:
+            assert isinstance(row[3], int) and isinstance(row[4], int)
+            assert row[3] <= row[4]         # committed <= last log
+
+
+class TestMicroBenchMetricsPath:
+    def test_metrics_path_within_budget(self):
+        from nebula_tpu.tools.micro_bench import bench_metrics
+        out = bench_metrics(20)
+        assert out["within_budget"], out
+        assert out["render_bytes"] > 0
