@@ -104,18 +104,25 @@ class GraphClient:
         self.session_id = resp["session_id"]
         return Status.OK()
 
-    def execute(self, stmt: str) -> ExecutionResponse:
+    def execute(self, stmt: str,
+                timeout_ms: Optional[int] = None) -> ExecutionResponse:
+        """``timeout_ms``: per-call whole-request deadline the server
+        enforces end-to-end (docs/admission.md) — the client option
+        rung of the deadline ladder (statement TIMEOUT prefix wins,
+        the query_deadline_ms flag is the fallback)."""
         if self.session_id is None:
             return ExecutionResponse(
                 {"error_code": int(ErrorCode.E_DISCONNECTED),
                  "error_msg": "not connected"})
+        req = {"session_id": self.session_id, "stmt": stmt,
+               "columnar": True}
+        if timeout_ms is not None:
+            req["timeout_ms"] = int(timeout_ms)
         try:
             # columnar=True: this client understands the typed-buffer
             # row payload (rows_from_wire) — plain protocol users that
             # don't send it get row lists (graph/service.py rpc_execute)
-            raw = self.cm.call(self.addr, "execute",
-                               {"session_id": self.session_id,
-                                "stmt": stmt, "columnar": True},
+            raw = self.cm.call(self.addr, "execute", req,
                                timeout=self.execute_timeout_s)
         except RpcError as e:
             raw = {"error_code": int(e.status.code),

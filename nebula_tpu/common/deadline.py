@@ -1,0 +1,113 @@
+"""Whole-request deadlines — the serving path's time budget.
+
+Every query gets a deadline at graphd ingress (``query_deadline_ms``
+flag, per-statement ``TIMEOUT n`` prefix, or the client's
+``timeout_ms`` execute option) and the budget travels WITH the request:
+the RPC envelope carries the remaining milliseconds (interface/rpc.py,
+re-anchored server-side so clock skew never matters), retry loops
+consume only what is left (storage/client.py collect, meta/client.py
+_call — a retry can never extend the budget), and the batch dispatcher
+drops entries whose budget is gone before they reach the device
+(graph/batch_dispatch.py, docs/admission.md).
+
+Deadlines are absolute points on ``time.monotonic()`` — immutable once
+minted, so capturing one for a pool thread is just passing the object.
+The thread-local binding mirrors tracing's context: ``bind`` installs
+a deadline for the current thread, ``current`` reads it (no allocation
+on the miss path — the untraced/undeadlined RPC fast path stays
+zero-overhead), and crossing a thread pool is ``current()`` on the
+submitting side + ``bind`` on the worker.
+
+The reference's StorageClient carries exactly this semantic as an
+evictable per-request timeout; here it is process-wide plumbing shared
+by every client.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .status import ErrorCode, Status
+
+_tls = threading.local()          # .deadline = Deadline | None
+
+
+class DeadlineExceeded(Exception):
+    """The whole-request budget ran out (or admission proved it will —
+    see graph/batch_dispatch.py).  Carries a Status so RPC seams and
+    graphd's response path surface ``E_DEADLINE_EXCEEDED`` instead of
+    a generic internal error."""
+
+    def __init__(self, msg: str = "deadline exceeded"):
+        super().__init__(msg)
+        self.status = Status(ErrorCode.E_DEADLINE_EXCEEDED, msg)
+
+
+class Deadline:
+    """Absolute monotonic deadline.  Immutable; share freely."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = float(at)
+
+    @classmethod
+    def after_s(cls, seconds: float) -> "Deadline":
+        return cls(time.monotonic() + float(seconds))
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(time.monotonic() + float(ms) / 1000.0)
+
+    def remaining_s(self) -> float:
+        return self.at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.at
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining_s():.3f}s)"
+
+
+def current() -> Optional[Deadline]:
+    """The calling thread's deadline, or None (unbounded)."""
+    return getattr(_tls, "deadline", None)
+
+
+class bind:
+    """``with bind(deadline):`` — install ``deadline`` (a Deadline or
+    None) as the thread's budget; restores the previous binding on
+    exit.  Passing None clears the budget for the scope (background
+    loops borrowed onto a request thread must not inherit it)."""
+
+    __slots__ = ("deadline", "_prev")
+
+    def __init__(self, deadline: Optional[Deadline]):
+        self.deadline = deadline
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "deadline", None)
+        _tls.deadline = self.deadline
+        return self.deadline
+
+    def __exit__(self, *exc):
+        _tls.deadline = self._prev
+        return False
+
+
+def remaining_or(cap_s: Optional[float]) -> Optional[float]:
+    """Clamp a caller-chosen timeout to the thread's remaining budget:
+    min(cap_s, remaining).  None cap means "just the budget"; returns
+    None when neither bounds the call.  Raises DeadlineExceeded when
+    the budget is already spent — callers must fail fast, not dial."""
+    d = current()
+    if d is None:
+        return cap_s
+    rem = d.remaining_s()
+    if rem <= 0:
+        raise DeadlineExceeded()
+    return rem if cap_s is None else min(cap_s, rem)

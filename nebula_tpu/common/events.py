@@ -43,6 +43,9 @@ EVENT_KINDS = (
     "meta.catalog_write",    # a DDL/config write landed in the catalog
     "fault.injected",        # the wire-level fault injector fired
     "query.slow",            # a statement crossed slow_query_threshold_ms
+    "query.shed",            # admission control rejected a query
+                             # (queue full / budget provably unmeetable
+                             # — graph/batch_dispatch.py)
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

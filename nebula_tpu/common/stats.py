@@ -37,6 +37,7 @@ counter path.
 """
 from __future__ import annotations
 
+import random
 import re
 import time
 import weakref
@@ -63,6 +64,11 @@ METRIC_NAMES = (
     "graph.stmt.*",                  # per-statement-kind latency family
     "graph.router.device.qps",
     "graph.router.cpu.qps",
+    # admission control / load shedding (graph/batch_dispatch.py,
+    # docs/admission.md): queue depth observations + gauges, shed and
+    # deadline-exceeded counters, admission wait histogram, the
+    # closed-loop batch-window gauge
+    "graph.admission.*",
     # rpc / fault injection
     "rpc.fault.injected",
     "rpc.fault_injected.*",          # per-method fault counters
@@ -72,6 +78,7 @@ METRIC_NAMES = (
     "meta.client.retry_exhausted",
     "meta.client.hint_chases",
     "meta.client.heartbeat_failed",
+    "meta.client.deadline_exceeded",
     "meta.heartbeat.latency_us",
     # storage client/server
     "storage.client.retry_attempts",
@@ -487,3 +494,12 @@ class StatsManager:
 
 
 stats = StatsManager()
+
+# Process identity for cluster-wide stats aggregation (SHOW STATS):
+# daemons sharing one process (LocalCluster) share this registry, so
+# their sections carry the same token and the rollup counts them once
+# (graph/executors/admin.py _show_stats) instead of double-summing.
+# Private Random: independent of seeded test RNGs (same stance as the
+# event-id RNG in common/events.py) — two daemons whose GLOBAL RNG
+# state matches at import must still mint distinct tokens.
+PROC_TOKEN = random.Random().getrandbits(63)

@@ -35,6 +35,10 @@ class ErrorCode(enum.IntEnum):
     E_EXECUTION_ERROR = -8
     E_STATEMENT_EMPTY = -9
     E_INTERNAL_ERROR = -10
+    # whole-request budget exhausted (or admission proved it will be —
+    # docs/admission.md); retrying without a fresh budget is pointless,
+    # which is why this is distinct from E_RPC_FAILURE
+    E_DEADLINE_EXCEEDED = -11
 
     # Storage
     E_KEY_NOT_FOUND = -100
@@ -119,6 +123,10 @@ class Status:
     @classmethod
     def LeaderChanged(cls, msg: str = "leader changed") -> "Status":
         return cls(ErrorCode.E_LEADER_CHANGED, msg)
+
+    @classmethod
+    def DeadlineExceeded(cls, msg: str = "deadline exceeded") -> "Status":
+        return cls(ErrorCode.E_DEADLINE_EXCEEDED, msg)
 
     # -- predicates ---------------------------------------------------
     def ok(self) -> bool:

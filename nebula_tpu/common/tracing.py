@@ -74,6 +74,8 @@ SPAN_NAMES = (
     "tpu.fetch",              # device→host result gather
     "tpu.assemble",           # host row materialization
     "rpc.fault",              # zero-duration marker: injected fault
+    "graph.admission",        # zero-duration marker: admission decision
+                              # (shed / deadline drop — batch_dispatch)
 )
 
 _tls = threading.local()          # .ctx = (trace_id, span_id, True)

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import sys
 
-from ..graph.service import ExecutionEngine, GraphService
+from ..graph.service import ExecutionEngine, GraphService, admission_health
 from ..interface.common import ConfigModule
 from ..interface.rpc import ClientManager, RpcServer
 from ..meta.client import MetaClient
@@ -67,6 +67,9 @@ def main(argv=None) -> int:
         return r.ok(), "meta ok" if r.ok() else r.status.to_string()
 
     ws.register_health_check("meta", _meta_reachable)
+    # degradation signal: 503 while actively shedding (admission
+    # control, docs/admission.md) so load balancers drain this graphd
+    ws.register_health_check("admission", admission_health)
     sys.stderr.write(f"graphd serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

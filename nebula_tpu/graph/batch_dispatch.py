@@ -1,5 +1,6 @@
 """GoBatchDispatcher — coalesce concurrent device queries into one
-dispatch (GO executions and FIND PATH BFS depths share the seam).
+dispatch (GO executions and FIND PATH BFS depths share the seam), with
+deadline-aware admission control in front (docs/admission.md).
 
 The batched ELL engine (tpu/ell.py) amortises the TPU's per-row-access
 floor across the whole batch, so the serving layer must feed it
@@ -16,7 +17,9 @@ the device work (async under JAX), then immediately releases
 leadership so the next batch's leader can launch while this batch's
 transfer + host assembly (`finish`) complete — device compute and
 host post-processing overlap instead of serializing.  In-flight
-batches are bounded by ``go_batch_inflight``.
+batches are bounded by ``go_batch_inflight``; under admission control
+the slots hand out in PRIORITY order (cheap 1-hop GO ahead of deep
+FIND PATH BFS — the per-query-class ladder).
 
 Failure isolation (round 3): the runtime returns per-query results in
 which individual entries may be Exception instances; only their own
@@ -25,6 +28,21 @@ wakes everyone with the error — but a poisoned query no longer fails
 its 1023 innocent neighbours (the reference's semantics are per-request
 partial failure, StorageClient.h:22-72).
 
+Admission control (round 6): the old dispatcher admitted everything —
+at 64 workers FIND PATH p50 tripled because every thread piled onto
+the queue behind a static 25 ms window.  Now each key's queue is
+BOUNDED (``admission_queue_max``), a query whose remaining deadline
+budget (common/deadline.py) provably cannot cover the queue ahead of
+it is REJECTED at admission (fast failure — an AdmissionShed surfaces
+as DEADLINE_EXCEEDED with the partial-result completeness/warning
+machinery, never a hang), entries whose budget ran out while queued
+are dropped from the batch BEFORE launch and their waiters woken with
+DEADLINE_EXCEEDED through the per-query-exception machinery, and the
+static window cap is replaced by a closed-loop controller
+(_WindowController) that tracks queue depth and dispatch latency:
+deep queues already pool, so the artificial wait collapses to zero
+exactly when it would only add latency.
+
 The reference has no cross-query batching (each GO is its own RPC
 fan-out); this is TPU-native serving the same way the reference's
 per-request vertex bucketing (QueryBaseProcessor.inl:433-460) is
@@ -32,11 +50,17 @@ CPU-native parallelism.
 """
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from typing import Dict, List, Tuple
 
+from ..common import deadline as deadlines
+from ..common import tracing
+from ..common.deadline import DeadlineExceeded
+from ..common.events import journal
 from ..common.flags import flags
+from ..common.stats import stats
 
 flags.define("go_batch_window_ms", -1,
              "batch-leader wait before dispatching coalesced device "
@@ -48,18 +72,22 @@ flags.define("go_batch_window_ms", -1,
              ">0: fixed wait in ms")
 flags.define("go_batch_window_frac", 0.12,
              "adaptive window as a fraction of the EMA batch "
-             "round-trip (launch -> results ready), capped at "
-             "go_batch_window_max_ms.  The sparse kernel's result "
+             "round-trip (launch -> results ready), capped by the "
+             "closed-loop controller (go_batch_window_max_ms scaled "
+             "down as queue depth grows).  The sparse kernel's result "
              "transfer is FIXED-SIZE per batch (the final pair-list "
              "cap), so fewer/fuller batches cut total link bytes "
              "directly — interleaved A/B on a ~110 ms-RTT tunnel: "
              "pooled batches beat dispatch-immediately ~12% qps / "
              "~13% p50")
 flags.define("go_batch_window_max_ms", 25,
-             "upper bound of the adaptive batch window (interleaved "
-             "A/B swept 25/30/40 ms on the tunnel: 25 pooled best — "
-             "larger windows left pipeline slots idle past the "
-             "arrival burst they were pooling)")
+             "upper bound of the adaptive batch window when the "
+             "dispatcher is otherwise idle (interleaved A/B swept "
+             "25/30/40 ms on the tunnel: 25 pooled best).  Under load "
+             "the effective cap is this value scaled DOWN by the "
+             "closed-loop controller: queue depth already pools "
+             "arrivals, so sleeping on top of it only adds latency "
+             "(admission_window_depth_ref)")
 flags.define("go_batch_max", 1024,
              "max coalesced queries (GO or FIND PATH) per device dispatch")
 flags.define("go_batch_inflight", 3,
@@ -72,17 +100,60 @@ flags.define("go_batch_inflight", 3,
              "result transfer is fixed-size per batch, so more, "
              "smaller batches move more total bytes")
 
+# ---- admission control (docs/admission.md) --------------------------
+flags.define("admission_control", True,
+             "deadline-aware admission in the batch dispatcher: "
+             "bounded per-(space, shape) queues, load shedding when a "
+             "query provably cannot meet its remaining deadline "
+             "budget, pre-launch expiry drops, and priority-ordered "
+             "pipeline slots.  Off restores the round-3 admit-"
+             "everything behavior (the window controller and stats "
+             "stay live either way)")
+flags.define("admission_queue_max", 256,
+             "per-(space, shape-key) queue bound: a submit finding "
+             "this many requests already queued on its key is shed "
+             "immediately (fast DEADLINE_EXCEEDED failure) instead of "
+             "joining a queue that only grows the tail")
+flags.define("admission_window_depth_ref", 8,
+             "closed-loop window controller reference depth: the "
+             "effective pooling-window cap is go_batch_window_max_ms "
+             "/ (1 + depth_ema / ref) — at the reference depth the "
+             "cap halves, and a saturated queue drives it toward 0 "
+             "because arrivals already pool behind in-flight batches")
+
+
+# registered at import (not per-dispatcher) so SHOW STATS always has
+# the admission rows, zero until the first shed (docs/admission.md)
+stats.register_stats("graph.admission.shed")
+stats.register_stats("graph.admission.deadline_exceeded")
+stats.register_histogram("graph.admission.wait_us")
+
+
+class AdmissionShed(DeadlineExceeded):
+    """Rejected at admission — the queue is full or the remaining
+    deadline budget provably cannot cover the work ahead.  A shed is a
+    DEADLINE_EXCEEDED to every upper layer (fast typed failure with
+    completeness < 100, docs/admission.md), with the shed reason kept
+    for stats/journal."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
+
 
 class _Request:
-    __slots__ = ("payload", "done", "result", "mirror", "error")
+    __slots__ = ("payload", "done", "result", "mirror", "error",
+                 "deadline", "enq_t")
 
-    def __init__(self, payload):
+    def __init__(self, payload, deadline=None):
         self.payload = payload   # per-query input, method-defined (GO:
         self.done = False        # _GoQuery; BFS: (srcs, dsts)); the
                                  # leader maps ids against ONE mirror
         self.result = None               # per-query result of the batch
         self.mirror = None
         self.error = None
+        self.deadline = deadline         # common/deadline.py Deadline|None
+        self.enq_t = time.perf_counter()
 
 
 class _KeyState:
@@ -93,10 +164,90 @@ class _KeyState:
         self.queue: List[_Request] = []
         self.dispatching = False
         # EMA of this key's batch round-trip (leader entering _run ->
-        # results materialized); feeds the adaptive batch window.  0.0
-        # until the first batch completes, so a fresh key never sleeps
-        # on a guess.
+        # results materialized); feeds the adaptive batch window AND
+        # the admission estimate of whether a deadline is meetable.
+        # 0.0 until the first batch completes, so a fresh key never
+        # sleeps (or sheds) on a guess.
         self.rt_ema_s = 0.0
+
+
+class _PrioritySlots:
+    """Counted pipeline slots whose waiters are served in priority
+    order (lower value first; FIFO within a class): when a slot frees
+    under contention, a cheap interactive GO leader takes it ahead of
+    a deep FIND PATH BFS leader — the per-query-class ladder.  With no
+    contention this degenerates to the plain semaphore it replaced."""
+
+    def __init__(self, n: int):
+        self._cond = threading.Condition()
+        self._free = max(1, int(n))
+        self._seq = 0
+        self._waiters: List[Tuple[int, int]] = []   # heap (prio, seq)
+
+    def acquire(self, priority: int = 1) -> None:
+        with self._cond:
+            self._seq += 1
+            me = (int(priority), self._seq)
+            heapq.heappush(self._waiters, me)
+            try:
+                while self._free <= 0 or self._waiters[0] != me:
+                    self._cond.wait()
+            except BaseException:
+                # interrupted waiter must not wedge the queue head
+                self._waiters = [w for w in self._waiters if w != me]
+                heapq.heapify(self._waiters)
+                self._cond.notify_all()
+                raise
+            heapq.heappop(self._waiters)
+            self._free -= 1
+            if self._free > 0 and self._waiters:
+                # two release()s can land while the old head is inside
+                # one wait(): popping ourselves makes a NEW head that
+                # nobody will notify again — hand the spare slot on, or
+                # it idles a full batch round-trip under contention
+                self._cond.notify_all()
+
+    def release(self) -> None:
+        with self._cond:
+            self._free += 1
+            self._cond.notify_all()
+
+
+class _WindowController:
+    """Closed-loop cap on the pooling window: tracks the queue depth
+    leaders observe (the PR 5 queue-depth gauge's signal) and the
+    dispatch latency (the tpu.dispatch.latency_us histogram's signal)
+    and scales ``go_batch_window_max_ms`` down as depth grows —
+    cap = max_ms / (1 + depth_ema / depth_ref).  Idle dispatchers keep
+    the full pooling window (wide batches on high-RTT links); a
+    saturated queue drives the artificial wait toward zero because
+    arrivals already pool behind the in-flight batches (self-clocking),
+    so sleeping on top of the backlog is pure added latency."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth_ema = 0.0
+        self.lat_ema_s = 0.0
+
+    def observe_depth(self, depth: int) -> None:
+        with self._lock:
+            self.depth_ema = 0.8 * self.depth_ema + 0.2 * float(depth)
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.lat_ema_s = (seconds if self.lat_ema_s == 0.0
+                              else 0.7 * self.lat_ema_s + 0.3 * seconds)
+
+    def cap_s(self) -> float:
+        cap_raw = flags.get("go_batch_window_max_ms")
+        cap_s = (25.0 if cap_raw is None else float(cap_raw)) / 1000.0
+        ref_raw = flags.get("admission_window_depth_ref")
+        ref = 8.0 if ref_raw is None else float(ref_raw)
+        if ref <= 0:
+            return cap_s
+        with self._lock:
+            depth = self.depth_ema
+        return cap_s / (1.0 + depth / ref)
 
 
 class GoBatchDispatcher:
@@ -104,10 +255,15 @@ class GoBatchDispatcher:
         self.runtime = runtime
         self._lock = threading.Lock()
         self._keys: Dict[Tuple, _KeyState] = {}
-        self._inflight = threading.Semaphore(
+        self._inflight = _PrioritySlots(
             max(1, int(flags.get("go_batch_inflight") or 3)))
+        self.window = _WindowController()
         self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0,
-                      "query_errors": 0}
+                      "query_errors": 0, "sheds": 0, "deadline_drops": 0}
+        # scrape-time gauges: live per-key queue depths + the current
+        # closed-loop window cap (weak bound method — a discarded
+        # dispatcher unregisters itself)
+        stats.register_collector(self._collect_gauges)
 
     def _state(self, key: Tuple) -> _KeyState:
         with self._lock:
@@ -116,6 +272,117 @@ class GoBatchDispatcher:
                 st = self._keys[key] = _KeyState()
             return st
 
+    # ------------------------------------------------------ admission
+    @staticmethod
+    def _priority_for_key(key: Tuple) -> int:
+        """Per-query-class priority (lower = sooner): cheap 1-hop GO
+        ahead of multi-hop GO ahead of FIND PATH BFS — interactive
+        short reads keep their latency while deep traversals absorb
+        the queueing (docs/admission.md)."""
+        method = key[0]
+        if method == "go_batch_execute":
+            steps = key[3] if len(key) > 3 else 1
+            try:
+                return 0 if int(steps) <= 1 else 1
+            except (TypeError, ValueError):
+                return 1
+        if method == "bfs_batch_dispatch":
+            return 2
+        return 1
+
+    def _admit(self, key: Tuple, st: _KeyState, dl) -> None:
+        """Admission decision for one submit (st.cond held): bounded
+        queue + deadline-feasibility check.  Raises AdmissionShed —
+        the fast typed failure — instead of letting a query join a
+        queue it cannot survive."""
+        if not flags.get("admission_control", True):
+            return
+        depth = len(st.queue)
+        qraw = flags.get("admission_queue_max")
+        # explicit 0 means "shed everything" (an operator draining a
+        # graphd) — no falsy-`or` default here
+        qmax = 256 if qraw is None else int(qraw)
+        if depth >= qmax:
+            self._shed(key, "queue_full", depth)
+        if dl is not None:
+            rem = dl.remaining_s()
+            if rem <= 0:
+                # already expired on arrival: the CLIENT's budget
+                # failed, not this daemon — typed fast failure without
+                # the shed/overload counters (a tight TIMEOUT on an
+                # idle graphd must never flip /healthz)
+                self._deadline_reject(key, "expired", depth)
+            elif st.rt_ema_s > 0.0:
+                # batches ahead of us (the backlog dispatches in
+                # ceil(depth/max_b) batches) plus our own — each costs
+                # ~one measured round trip.  A conservative LOWER
+                # bound: if even that exceeds the remaining budget,
+                # the query cannot finish in time and queuing it only
+                # steals batch width from queries that can
+                max_b = max(1, int(flags.get("go_batch_max") or 1024))
+                est_s = st.rt_ema_s * (depth // max_b + 1)
+                if rem < est_s:
+                    if depth > 0:
+                        # a BACKLOG makes the budget unmeetable —
+                        # that is overload: shed
+                        self._shed(key, "deadline_unmeetable", depth)
+                    # empty queue: the budget is simply smaller than
+                    # one batch round trip — client-chosen, not load
+                    self._deadline_reject(key, "budget_below_round_trip",
+                                          depth)
+
+    def _shed(self, key: Tuple, reason: str, depth: int) -> None:
+        stats.add_value("graph.admission.shed")
+        if reason != "queue_full":
+            stats.add_value("graph.admission.deadline_exceeded")
+        with self._lock:
+            self.stats["sheds"] += 1
+        journal.record("query.shed",
+                       detail=f"{reason} {key[0]} depth={depth}",
+                       space=key[1])
+        tracing.annotate("graph.admission", decision="shed",
+                         reason=reason, depth=depth, method=key[0])
+        raise AdmissionShed(
+            f"query shed at admission ({reason}): {key[0]} queue depth "
+            f"{depth}", reason)
+
+    def _deadline_reject(self, key: Tuple, reason: str,
+                         depth: int) -> None:
+        """Client-budget fast failure at admission: typed
+        DEADLINE_EXCEEDED, deadline counters, trace marker — but NOT a
+        shed (no overload counters, no query.shed journal entry, no
+        /healthz degradation: the budget was the caller's choice)."""
+        self._note_deadline_drop(key)
+        tracing.annotate("graph.admission", decision=reason,
+                         depth=depth, method=key[0])
+        raise DeadlineExceeded(
+            f"{key[0]}: remaining budget cannot cover one dispatch "
+            f"({reason})")
+
+    def _note_deadline_drop(self, key: Tuple) -> None:
+        stats.add_value("graph.admission.deadline_exceeded")
+        with self._lock:
+            self.stats["deadline_drops"] += 1
+
+    def queue_depths(self) -> Dict[Tuple, int]:
+        """Live queue depth per key — the shared source for the
+        scrape-time gauges and SHOW STATS' live admission row."""
+        with self._lock:
+            keys = list(self._keys.items())
+        out: Dict[Tuple, int] = {}
+        for key, st in keys:
+            with st.cond:
+                out[key] = len(st.queue)
+        return out
+
+    def _collect_gauges(self) -> None:
+        for key, depth in self.queue_depths().items():
+            stats.set_gauge("graph.admission.queue_depth", depth,
+                            method=str(key[0]), space=str(key[1]))
+        stats.set_gauge("graph.admission.window_ms",
+                        round(self.window.cap_s() * 1000.0, 3))
+
+    # ---------------------------------------------------------- submit
     def submit_batched(self, key: Tuple, payload):
         """Coalesce any batched runtime entry point: ``key[0]`` names a
         runtime method with signature ``fn(space_id, payloads, *key[2:])
@@ -123,15 +390,41 @@ class GoBatchDispatcher:
         (an object with ``.finish()``) whose launch half has already
         run.  Requests sharing the key ride one device dispatch.  A
         per-query result that is an Exception instance is raised only
-        for its own submitter."""
+        for its own submitter.
+
+        The calling thread's deadline budget (common/deadline.py) is
+        captured at admission: an unmeetable budget sheds here, an
+        expired one wakes the waiter with DEADLINE_EXCEEDED even while
+        its batch is still in flight — no waiter ever blocks past its
+        deadline."""
         st = self._state(key)
-        req = _Request(payload)
+        dl = deadlines.current()
+        req = _Request(payload, dl)
         st.cond.acquire()
         try:
+            self._admit(key, st, dl)         # may raise AdmissionShed
             st.queue.append(req)
             while not req.done:
+                if dl is not None and dl.expired():
+                    # budget gone while waiting: leave the queue (or
+                    # abandon the in-flight batch's result) and fail
+                    # fast — the leader setting fields on an abandoned
+                    # request is harmless
+                    try:
+                        st.queue.remove(req)
+                    except ValueError:
+                        pass                 # already snapshotted
+                    req.error = DeadlineExceeded(
+                        f"{key[0]}: deadline expired after "
+                        f"{(time.perf_counter() - req.enq_t) * 1e3:.0f} ms "
+                        f"in the admission queue")
+                    self._note_deadline_drop(key)
+                    break
                 if st.dispatching or not st.queue:
-                    st.cond.wait()
+                    if dl is None:
+                        st.cond.wait()
+                    else:
+                        st.cond.wait(max(0.0, dl.remaining_s()))
                     continue
                 # become the leader for the next batch.  ANY failure
                 # between taking leadership and entering _run (whose
@@ -147,6 +440,7 @@ class GoBatchDispatcher:
                 # go_batch_max skips it too: the batch is full, the
                 # wait could pool nothing
                 qlen = len(st.queue)
+                self.window.observe_depth(qlen)
                 no_wait = qlen <= 1 or \
                     qlen >= int(flags.get("go_batch_max") or 1024)
                 try:
@@ -169,7 +463,7 @@ class GoBatchDispatcher:
                             window = 0.0
                         if window > 0:
                             time.sleep(window)
-                        self._inflight.acquire()
+                        self._inflight.acquire(self._priority_for_key(key))
                         sem_held = True
                     finally:
                         st.cond.acquire()
@@ -204,6 +498,14 @@ class GoBatchDispatcher:
         finally:
             st.cond.release()
         if req.error is not None:
+            if isinstance(req.error, DeadlineExceeded) \
+                    and not isinstance(req.error, AdmissionShed):
+                # the admission decision lands on the WAITER's own
+                # trace (the leader thread can't reach it): a PROFILE
+                # of the failed query shows why it never launched
+                tracing.annotate("graph.admission",
+                                 decision="deadline_drop",
+                                 method=key[0])
             raise req.error
         return req.result, req.mirror
 
@@ -215,7 +517,9 @@ class GoBatchDispatcher:
         the wait pools arrivals into markedly wider batches (the
         per-batch link cost is flat in batch width), while on a local
         chip with ~ms round-trips the wait collapses to ~nothing —
-        the same no-tuning philosophy as the backend router."""
+        the same no-tuning philosophy as the backend router.  The cap
+        is the CLOSED-LOOP controller's (queue depth scales the
+        go_batch_window_max_ms flag down), replacing the static cap."""
         raw = flags.get("go_batch_window_ms")
         window_ms = float(raw if raw is not None else -1)
         if window_ms >= 0:
@@ -224,9 +528,7 @@ class GoBatchDispatcher:
         # no falsy-`or` fallbacks here
         frac_raw = flags.get("go_batch_window_frac")
         frac = 0.12 if frac_raw is None else float(frac_raw)
-        cap_raw = flags.get("go_batch_window_max_ms")
-        cap_s = (25.0 if cap_raw is None else float(cap_raw)) / 1000.0
-        return min(st.rt_ema_s * frac, cap_s)
+        return min(st.rt_ema_s * frac, self.window.cap_s())
 
     # ------------------------------------------------------------------
     def _run(self, key: Tuple, batch: List[_Request],
@@ -235,29 +537,56 @@ class GoBatchDispatcher:
         st_key = self._state(key)
         t_run0 = time.perf_counter()
         n_errors = 0
+        live = batch
         try:
+            if flags.get("admission_control", True):
+                # pre-launch expiry drop: entries whose budget ran out
+                # while queued never reach the device — their waiters
+                # wake with DEADLINE_EXCEEDED via the same per-query
+                # exception machinery a poisoned query uses
+                live = []
+                for r in batch:
+                    if r.deadline is not None and r.deadline.expired():
+                        r.error = DeadlineExceeded(
+                            f"{method}: budget exhausted in the "
+                            f"admission queue (dropped pre-launch)")
+                        self._note_deadline_drop(key)
+                    else:
+                        live.append(r)
+            if live:
+                # admission wait of the OLDEST rider — one histogram
+                # observation per batch, the tail-relevant sample
+                stats.observe(
+                    "graph.admission.wait_us",
+                    (time.perf_counter()
+                     - min(r.enq_t for r in live)) * 1e6)
             # the leader already holds an in-flight slot (acquired
             # before the batch snapshot in submit_batched)
             try:
-                fn = getattr(self.runtime, method)
-                res = fn(space_id, [r.payload for r in batch], *key[2:])
-                if hasattr(res, "finish"):       # two-phase _Pending
-                    release_leadership()
-                    results, mirror = res.finish()
+                if live:
+                    fn = getattr(self.runtime, method)
+                    res = fn(space_id, [r.payload for r in live],
+                             *key[2:])
+                    if hasattr(res, "finish"):   # two-phase _Pending
+                        release_leadership()
+                        results, mirror = res.finish()
+                    else:
+                        results, mirror = res
+                    # round-trip sample for the adaptive window
+                    # (results are materialized here; waiters wake just
+                    # after).  EMA weight 0.3: a regime change (link
+                    # congestion, kernel shape shift) re-centers within
+                    # a few batches without single-outlier jitter
+                    dur = time.perf_counter() - t_run0
+                    with st_key.cond:
+                        st_key.rt_ema_s = dur if st_key.rt_ema_s == 0.0 \
+                            else 0.7 * st_key.rt_ema_s + 0.3 * dur
+                    self.window.observe_latency(dur)
                 else:
-                    results, mirror = res
-                # round-trip sample for the adaptive window (results
-                # are materialized here; waiters wake just after).
-                # EMA weight 0.3: a regime change (link congestion,
-                # kernel shape shift) re-centers within a few batches
-                # without single-outlier jitter
-                dur = time.perf_counter() - t_run0
-                with st_key.cond:
-                    st_key.rt_ema_s = dur if st_key.rt_ema_s == 0.0 \
-                        else 0.7 * st_key.rt_ema_s + 0.3 * dur
+                    results, mirror = [], None
             finally:
                 self._inflight.release()
-            for i, r in enumerate(batch):
+            for i, r in enumerate(live):
                 out = results[i]
                 if isinstance(out, Exception):
                     r.error = out                # only this waiter fails

@@ -315,12 +315,26 @@ class ShowExecutor(Executor):
     def _show_stats(self) -> InterimResult:
         """SHOW STATS: per-daemon 60 s snapshots through metad's
         ``showStats`` fan-out (metad itself + every active storaged),
-        then a ``<cluster>`` rollup — sums/counts add across daemons,
-        percentiles take the worst daemon (they don't compose)."""
+        plus this graphd's OWN registry when it lives in a different
+        process (standalone graphd — sections dedup by the
+        stats.PROC_TOKEN process identity so LocalCluster's shared
+        registry is never double-counted), then a ``<cluster>`` rollup
+        — sums/counts add across daemons, percentiles take the worst
+        daemon (they don't compose).  Admission control contributes
+        its rows here: graph.admission.shed / .deadline_exceeded /
+        .rejected.qps from the registries, and a live
+        graph.admission.queue_depth row read straight off the local
+        batch dispatcher (docs/admission.md)."""
+        from ...common.stats import PROC_TOKEN
+        from ...common.stats import stats as _stats
         resp = _meta_call(self, "showStats", {})
+        hosts = list(resp.get("hosts", []))
+        if not any(h.get("proc") == PROC_TOKEN for h in hosts):
+            hosts.append({"host": "graphd", "stats": _stats.dump(),
+                          "proc": PROC_TOKEN})
         rows: List[list] = []
         agg: dict = {}
-        for hrec in resp.get("hosts", []):
+        for hrec in hosts:
             host = hrec.get("host", "?")
             for name, d in sorted((hrec.get("stats") or {}).items()):
                 vals = [d.get("sum.60", 0.0), d.get("count.60", 0.0),
@@ -337,6 +351,15 @@ class ShowExecutor(Executor):
             a[2] = a[0] / a[1] if a[1] else 0.0
             a[3] = a[0] / 60.0
             rows.append(["<cluster>", name] + a)
+        # live admission queue depth off the local dispatcher (the
+        # registry rows above are 60 s windows; this is "now")
+        rt = self.ectx.tpu_runtime
+        disp = getattr(rt, "_dispatcher", None) if rt is not None else None
+        if disp is not None:
+            depths = disp.queue_depths()
+            rows.append(["graphd", "graph.admission.queue_depth.live",
+                         float(sum(depths.values())), float(len(depths)),
+                         0.0, 0.0, 0.0, 0.0])
         return InterimResult(
             ["Host", "Stat", "Sum(60s)", "Count(60s)", "Avg(60s)",
              "Rate(60s)", "p95(60s)", "p99(60s)"], rows)

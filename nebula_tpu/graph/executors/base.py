@@ -45,7 +45,13 @@ class Executor:
             return
         if resp.completeness() == 0:
             first = next(iter(resp.failed_parts.values()))
-            raise ExecError(f"storage error: {first.to_string()}")
+            # a budget-exhausted fan-out keeps its typed code so the
+            # client sees DEADLINE_EXCEEDED, not a generic exec error
+            # (and graphd attaches completeness/warnings to it)
+            code = (ErrorCode.E_DEADLINE_EXCEEDED
+                    if first.code == ErrorCode.E_DEADLINE_EXCEEDED
+                    else ErrorCode.E_EXECUTION_ERROR)
+            raise ExecError(f"storage error: {first.to_string()}", code)
         self.ectx.note_partial(resp)
 
     def check_space_chosen(self) -> None:

@@ -564,3 +564,7 @@ class SequentialSentences:
     # response; EXPLAIN returns the executor plan without executing
     profile: bool = False
     explain: bool = False
+    # leading TIMEOUT <n> prefix: per-statement whole-request deadline
+    # override in milliseconds (docs/admission.md); None = the
+    # query_deadline_ms flag / client option applies
+    timeout_ms: Optional[int] = None

@@ -134,6 +134,22 @@ class _Parser:
                 out.profile = True
             else:
                 out.explain = True
+        # optional TIMEOUT <ms> prefix (after PROFILE/EXPLAIN when both
+        # are present): per-statement deadline override.  Like
+        # PROFILE/EXPLAIN, `timeout` is NOT a lexer keyword — it lexes
+        # as a plain ID and is special-cased only here, where no valid
+        # statement can start with a bare identifier, so expressions
+        # naming a `timeout` property keep parsing.
+        t = self.peek()
+        if t.type == "ID" and isinstance(t.value, str) \
+                and t.value.lower() == "timeout" \
+                and self.peek(1).type == "INT":
+            self.next()
+            ms = self.next().value
+            if ms <= 0:
+                raise ParseError("TIMEOUT must be a positive "
+                                 "millisecond count")
+            out.timeout_ms = int(ms)
         while True:
             while self.accept_sym(";"):
                 pass

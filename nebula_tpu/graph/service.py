@@ -15,13 +15,16 @@ import re
 import threading
 from typing import Dict, Optional
 
+from ..common import deadline as deadlines
 from ..common import tracing
 from ..common.clock import Duration
+from ..common.deadline import Deadline, DeadlineExceeded
 from ..common.events import journal
 from ..common.flags import flags
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..interface.rpc import RpcError
+from .batch_dispatch import AdmissionShed
 from ..meta.client import MetaClient
 from ..meta.schema_manager import SchemaManager
 from ..storage.client import StorageClient
@@ -32,6 +35,15 @@ from .interim import ColumnarRows, InterimResult
 from .parser import GQLParser
 from .parser.lexer import COMMENT_RE as LEX_COMMENT_RE
 from .parser.parser import ParseError
+
+flags.define("query_deadline_ms", 300000,
+             "whole-request deadline every statement receives at "
+             "graphd ingress (docs/admission.md): the budget rides the "
+             "RPC envelope into storage/meta retry loops and the batch "
+             "dispatcher, which drops expired entries before device "
+             "launch.  Per-statement `TIMEOUT n` prefix or the "
+             "client's timeout_ms execute option override it; 0 "
+             "disables the default deadline")
 
 
 class Authenticator:
@@ -166,8 +178,12 @@ class ExecutionEngine:
         nxt = text[pos + 7:pos + 8]
         return not (nxt.isalnum() or nxt == "_")
 
-    def execute(self, session: ClientSession, text: str) -> dict:
-        """-> ExecutionResponse dict (graph.thrift:89-96)."""
+    def execute(self, session: ClientSession, text: str,
+                timeout_ms: Optional[int] = None) -> dict:
+        """-> ExecutionResponse dict (graph.thrift:89-96).
+        ``timeout_ms`` is the client execute option — the middle rung
+        of the deadline ladder (statement TIMEOUT prefix > client
+        option > query_deadline_ms flag, docs/admission.md)."""
         # PROFILE must trace from before the parse (the parse span
         # belongs to the tree), so the prefix is sniffed textually
         # here; the parser's SequentialSentences flag stays
@@ -181,7 +197,8 @@ class ExecutionEngine:
             with root as rs:
                 if rs is not None:
                     trace_id = rs.trace_id
-                resp, profiled = self._execute_traced(session, text, rs)
+                resp, profiled = self._execute_traced(session, text, rs,
+                                                      timeout_ms)
         finally:
             if forced and not profiled and trace_id is not None:
                 # sniffed PROFILE but no tree will be read (parser
@@ -207,7 +224,7 @@ class ExecutionEngine:
         return resp
 
     def _execute_traced(self, session: ClientSession, text: str,
-                        rs) -> tuple:
+                        rs, timeout_ms: Optional[int] = None) -> tuple:
         """Engine pass under the (possibly no-op) root span ``rs``.
         Returns (response dict, profile-requested flag)."""
         dur = Duration()
@@ -232,29 +249,77 @@ class ExecutionEngine:
             resp["space_name"] = session.space_name
             resp["latency_in_us"] = dur.elapsed_in_usec()
             return resp, False
+        # whole-request deadline at ingress (docs/admission.md):
+        # statement TIMEOUT prefix > client timeout_ms option >
+        # query_deadline_ms flag (0 = unbounded).  The budget binds
+        # around the whole executor chain, so every storage/meta RPC,
+        # retry pass, and batch-dispatcher admission downstream
+        # consumes the same allowance.
+        budget_ms = seq.timeout_ms
+        if budget_ms is None:
+            budget_ms = timeout_ms
+        if budget_ms is None:
+            budget_ms = flags.get("query_deadline_ms", 0)
+        dl = Deadline.after_ms(budget_ms) if budget_ms else None
+        if rs is not None and dl is not None:
+            rs.tag(deadline_ms=int(budget_ms))
         result: Optional[InterimResult] = None
-        try:
-            # SequentialExecutor semantics: run each; last rowset wins
-            for sentence in seq.sentences:
-                out = traced_execute(make_executor(sentence, ectx), ectx)
-                ectx.input = None  # pipes manage their own input scoping
-                if out is not None:
-                    result = out
-        except ExecError as e:
-            resp["error_code"] = int(e.code)
-            resp["error_msg"] = str(e)
-        except RpcError as e:
-            resp["error_code"] = int(e.status.code)
-            resp["error_msg"] = e.status.to_string()
+        shed = False
+        with deadlines.bind(dl):
+            try:
+                # SequentialExecutor semantics: run each; last rowset
+                # wins
+                for sentence in seq.sentences:
+                    out = traced_execute(make_executor(sentence, ectx),
+                                         ectx)
+                    ectx.input = None  # pipes scope their own input
+                    if out is not None:
+                        result = out
+            except AdmissionShed as e:
+                resp["error_code"] = int(ErrorCode.E_DEADLINE_EXCEEDED)
+                resp["error_msg"] = str(e)
+                shed = True
+                ectx.completeness = 0
+                ectx.warnings.append(
+                    f"query shed at admission ({e.reason})")
+            except DeadlineExceeded as e:
+                resp["error_code"] = int(ErrorCode.E_DEADLINE_EXCEEDED)
+                resp["error_msg"] = str(e)
+                ectx.completeness = 0
+                ectx.warnings.append("whole-request deadline exceeded")
+            except ExecError as e:
+                resp["error_code"] = int(e.code)
+                resp["error_msg"] = str(e)
+            except RpcError as e:
+                resp["error_code"] = int(e.status.code)
+                resp["error_msg"] = e.status.to_string()
+        if resp["error_code"] == int(ErrorCode.E_DEADLINE_EXCEEDED):
+            # shed/expired responses keep the partial-result surface:
+            # completeness < 100 + warnings say WHY the rows are
+            # missing.  Only a SHED (an admission decision — local or
+            # surfaced from storaged) feeds the /healthz degradation
+            # counter: a client's own tight TIMEOUT expiring on an idle
+            # daemon is not overload and must not drain the instance
+            if shed:
+                stats.add_value("graph.admission.rejected.qps")
+            ectx.completeness = min(ectx.completeness, 0)
+            if not ectx.warnings:
+                ectx.warnings.append("whole-request deadline exceeded")
+            if rs is not None:
+                rs.tag(admission="rejected")
         if result is not None and resp["error_code"] == int(ErrorCode.SUCCEEDED):
             resp["column_names"] = result.columns
             resp["rows"] = result.rows
         if ectx.completeness < 100 \
-                and resp["error_code"] == int(ErrorCode.SUCCEEDED):
+                and resp["error_code"] in (
+                    int(ErrorCode.SUCCEEDED),
+                    int(ErrorCode.E_DEADLINE_EXCEEDED)):
             # degraded scatter-gather: the rows are a correct SUBSET —
             # report completeness % + per-op warnings instead of the
             # old silent degradation (attached only when < 100, so the
-            # wire shape for healthy responses is unchanged)
+            # wire shape for healthy responses is unchanged).  A
+            # deadline-exceeded/shed response carries the same surface
+            # so clients see a typed fast failure, not a mystery
             resp["completeness"] = ectx.completeness
             resp["warnings"] = list(ectx.warnings)
             stats.add_value("graph.partial_result.qps")
@@ -301,6 +366,7 @@ class GraphService:
         stats.register_stats("graph.error.qps")
         stats.register_stats("graph.partial_result.qps")
         stats.register_stats("graph.slow_query.qps")
+        stats.register_stats("graph.admission.rejected.qps")
 
     def rpc_authenticate(self, req: dict) -> dict:
         user = req.get("username", "")
@@ -320,7 +386,13 @@ class GraphService:
         if session is None:
             return {"error_code": int(ErrorCode.E_SESSION_INVALID),
                     "error_msg": "invalid session"}
-        resp = self.engine.execute(session, req.get("stmt", ""))
+        timeout_ms = req.get("timeout_ms")
+        try:
+            timeout_ms = int(timeout_ms) if timeout_ms else None
+        except (TypeError, ValueError):
+            timeout_ms = None
+        resp = self.engine.execute(session, req.get("stmt", ""),
+                                   timeout_ms=timeout_ms)
         if not req.get("columnar"):
             # wire compatibility: only clients that opted in receive
             # the typed-buffer columnar row payload (graph/interim.py
@@ -331,3 +403,22 @@ class GraphService:
                 resp = dict(resp)
                 resp["rows"] = rows._mat()
         return resp
+
+
+def admission_health():
+    """/healthz degradation signal (docs/admission.md): graphd reports
+    DEGRADED (503) while it is actively SHEDDING — admission decisions
+    in the last 5 s window, from the local dispatcher
+    (graph.admission.shed) or surfaced from a storaged
+    (graph.admission.rejected.qps counts only sheds, never a client's
+    own TIMEOUT expiring on an idle daemon — that would hand clients a
+    lever to drain healthy instances).  Load balancers drain a
+    shedding graphd instead of feeding the overload; the signal
+    self-clears once sheds stop.  Registered beside the meta
+    round-trip check in daemons/graphd.py."""
+    shed = max(stats.read_stats("graph.admission.shed.count.5") or 0.0,
+               stats.read_stats("graph.admission.rejected.qps.count.5")
+               or 0.0)
+    if shed > 0:
+        return False, f"actively shedding ({int(shed)} sheds in 5s)"
+    return True, "not shedding"

@@ -25,7 +25,9 @@ from typing import Any, Callable, Dict, Optional
 
 import msgpack
 
+from ..common import deadline as deadlines
 from ..common import tracing
+from ..common.deadline import Deadline, DeadlineExceeded
 from ..common.status import ErrorCode, Status
 from .common import HostAddr
 from .faults import AFTER, default_injector
@@ -40,6 +42,14 @@ _MAX_FRAME = 1 << 30
 # client can fold the server's spans into its own trace tree without a
 # second collection RPC.  Untraced calls keep the original 2-element
 # frame and bare response — zero overhead, wire-compatible.
+#
+# Deadline propagation (common/deadline.py): a caller with a bound
+# budget sends a 4th element — the REMAINING milliseconds at send time
+# — as [method, payload, wctx-or-None, remaining_ms]; the server
+# re-anchors it on its own monotonic clock (absolute stamps don't
+# cross hosts) and binds it around the dispatch, so every nested RPC
+# and retry loop server-side consumes the same budget.  Calls with
+# neither trace nor deadline keep the 2-element frame.
 _TRACED = "__spans__"
 _RESP = "__resp__"
 
@@ -119,7 +129,19 @@ class RpcServer:
                         parts = _unpack(frame)
                         method, payload = parts[0], parts[1]
                         wctx = parts[2] if len(parts) > 2 else None
-                        if wctx is not None:
+                        dl_ms = parts[3] if len(parts) > 3 else None
+                        if dl_ms is not None:
+                            # re-anchor the remaining budget on this
+                            # host's clock and bind it around the whole
+                            # dispatch (nested RPCs consume it too)
+                            with deadlines.bind(Deadline.after_ms(dl_ms)):
+                                if wctx is not None:
+                                    resp = _dispatch_traced(
+                                        outer.dispatch, method, payload,
+                                        wctx)
+                                else:
+                                    resp = outer.dispatch(method, payload)
+                        elif wctx is not None:
                             resp = _dispatch_traced(outer.dispatch, method,
                                                     payload, wctx)
                         else:
@@ -127,6 +149,9 @@ class RpcServer:
                     except RpcError as e:
                         resp = {"__error__": int(e.status.code),
                                 "msg": e.status.msg}
+                    except DeadlineExceeded as e:
+                        resp = {"__error__": int(e.status.code),
+                                "msg": str(e)}
                     except Exception as e:  # noqa: BLE001 — server must not die
                         resp = {"__error__": int(ErrorCode.E_INTERNAL_ERROR),
                                 "msg": f"{type(e).__name__}: {e}"}
@@ -171,6 +196,8 @@ def _dispatch_traced(dispatch, method: str, payload: Any, wctx) -> Any:
                 resp = dispatch(method, payload)
     except RpcError as e:
         resp = {"__error__": int(e.status.code), "msg": e.status.msg}
+    except DeadlineExceeded as e:
+        resp = {"__error__": int(e.status.code), "msg": str(e)}
     except Exception as e:  # noqa: BLE001 — mirror the untraced handler
         resp = {"__error__": int(ErrorCode.E_INTERNAL_ERROR),
                 "msg": f"{type(e).__name__}: {e}"}
@@ -241,13 +268,34 @@ class RpcChannel:
     def _call_wire(self, method: str, payload: Any,
                    timeout: Optional[float] = None) -> Any:
         ctx = tracing.current_context()
+        dl = deadlines.current()
+        rem_ms = None
+        if dl is not None:
+            rem_ms = dl.remaining_ms()
+            if rem_ms <= 0:
+                # budget already spent: fail fast without dialing —
+                # the wire exchange could only waste a peer's time
+                raise RpcError(Status.DeadlineExceeded(
+                    f"{method} to {self.addr}: budget exhausted"))
+            # the socket wait may never outlive the budget
+            cap = timeout if timeout is not None else self.timeout
+            timeout = min(cap, rem_ms / 1000.0)
         if ctx is None:
-            # tracing-disabled hot path: 2-element frame, no span, no
-            # allocation in the tracing module (overhead-guard test)
-            return self._wire_exchange(_pack([method, payload]), timeout)
+            if rem_ms is None:
+                # tracing-disabled hot path: 2-element frame, no span,
+                # no allocation in the tracing module (overhead-guard
+                # test) and none in the deadline module either
+                return self._wire_exchange(_pack([method, payload]),
+                                           timeout)
+            return self._wire_exchange(
+                _pack([method, payload, None, int(rem_ms)]), timeout)
         with tracing.span("rpc.client", method=method,
                           peer=str(self.addr)) as sp:
-            frame = _pack([method, payload, [sp.trace_id, sp.span_id]])
+            wctx = [sp.trace_id, sp.span_id]
+            if rem_ms is None:
+                frame = _pack([method, payload, wctx])
+            else:
+                frame = _pack([method, payload, wctx, int(rem_ms)])
             return self._wire_exchange(frame, timeout)
 
     def _wire_exchange(self, frame_out: bytes,
@@ -342,6 +390,12 @@ class LoopbackChannel:
 
     def call(self, method: str, payload: Any,
              timeout: Optional[float] = None) -> Any:
+        dl = deadlines.current()
+        if dl is not None and dl.expired():
+            # same fast-fail the TCP channel performs; the handler runs
+            # on this thread so the budget itself propagates natively
+            raise RpcError(Status.DeadlineExceeded(
+                f"{method} (loopback): budget exhausted"))
         payload = _unpack(_pack(payload))
         fn = getattr(self.handler, "rpc_" + method, None)
         if fn is None:
@@ -362,6 +416,8 @@ class LoopbackChannel:
             return _unpack(_pack(fn(payload)))
         except RpcError:
             raise
+        except DeadlineExceeded as e:
+            raise RpcError(e.status) from e
         except Exception as e:  # noqa: BLE001
             raise RpcError(Status.Error(f"{type(e).__name__}: {e}")) from e
 

@@ -14,6 +14,7 @@ import random
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..common import deadline as deadlines
 from ..common import tracing
 from ..common.events import journal
 from ..common.flags import flags
@@ -29,6 +30,7 @@ stats.register_stats("meta.client.backoff_ms")
 stats.register_stats("meta.client.retry_exhausted")
 stats.register_stats("meta.client.hint_chases")
 stats.register_stats("meta.client.heartbeat_failed")
+stats.register_stats("meta.client.deadline_exceeded")
 
 
 class _PassDeferred(Exception):
@@ -120,11 +122,22 @@ class MetaClient:
         backoff_cap_s = flags.get("meta_client_retry_backoff_max_ms",
                                   2000) / 1000.0
         max_chase = flags.get("meta_client_max_hint_chase", 3)
+        qdl = deadlines.current()   # whole-request budget, if bound
         for attempt in range(self._CALL_PASSES):
             sleep_s = 0.0
             if attempt:
                 span = min(backoff_cap_s, backoff_s * (1 << (attempt - 1)))
                 sleep_s = span * (0.5 + 0.5 * random.random())  # jitter
+                if qdl is not None and qdl.remaining_s() <= sleep_s:
+                    # the backoff alone would outlive the budget — fail
+                    # now with the typed code instead of sleeping the
+                    # budget's tail away (retries must fit the
+                    # REMAINING budget, never extend it)
+                    stats.add_value("meta.client.deadline_exceeded")
+                    raise RpcError(Status.DeadlineExceeded(
+                        f"{method}: retry budget exhausted"
+                        + (f" (last: {last_exc.status.msg})"
+                           if last_exc else "")))
                 stats.add_value("meta.client.retry_attempts")
                 stats.add_value("meta.client.backoff_ms", sleep_s * 1000.0)
                 self._stop.wait(sleep_s)

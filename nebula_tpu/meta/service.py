@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 
 from ..common.clock import Duration, now_micros
 from ..common.events import ClusterEventStore, journal
-from ..common.stats import stats
+from ..common.stats import PROC_TOKEN, stats
 from ..common.status import ErrorCode, Status
 from ..interface.common import (AlterSchemaOp, ConfigMode, HostAddr, RoleType,
                                 Schema, schema_from_wire, schema_to_wire)
@@ -333,17 +333,27 @@ class MetaService:
         AdminClient channel the balancer already uses).  Unreachable
         hosts are skipped — a rollup that blocks on a dead storaged
         would make the health statement itself unhealthy."""
-        hosts = [{"host": "metad", "stats": stats.dump()}]
+        hosts = [{"host": "metad", "stats": stats.dump(),
+                  "proc": PROC_TOKEN}]
         admin = getattr(self.balancer, "admin", None)
         if admin is not None:
+            seen = {PROC_TOKEN}
             for h in self.active_hosts.active_hosts():
                 try:
                     r = admin.cm.call(HostAddr.parse(h), "daemonStats", {})
                 except Exception:     # noqa: BLE001 — host churn mid-scan
                     continue
                 if isinstance(r, dict) and "stats" in r:
+                    proc = r.get("proc")
+                    if proc is not None and proc in seen:
+                        # same process registry (LocalCluster daemons
+                        # share it) — a second section would double
+                        # every <cluster> rollup sum
+                        continue
+                    if proc is not None:
+                        seen.add(proc)
                     hosts.append({"host": r.get("host", h),
-                                  "stats": r["stats"]})
+                                  "stats": r["stats"], "proc": proc})
         return {"hosts": hosts}
 
     def rpc_listEvents(self, req: dict) -> dict:

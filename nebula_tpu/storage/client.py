@@ -16,6 +16,7 @@ import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common import deadline as deadlines
 from ..common import tracing
 from ..common.flags import flags
 from ..common.keys import id_hash
@@ -134,8 +135,21 @@ class StorageClient:
             budget_ms = flags.get("storage_client_request_deadline_ms",
                                   15000)
             deadline_s = budget_ms / 1000.0 if budget_ms else None
+        # the whole-query budget (common/deadline.py, bound at graphd
+        # ingress) caps the collect's own deadline: retry passes and
+        # backoff sleeps fit the REMAINING budget, never extend it
+        qdl = deadlines.current()
+        if qdl is not None:
+            rem = qdl.remaining_s()
+            if rem <= 0:
+                stats.add_value("storage.client.deadline_exceeded")
+                for part in part_items:
+                    resp.failed_parts[part] = Status.DeadlineExceeded()
+                return resp
+            deadline_s = rem if deadline_s is None else min(deadline_s, rem)
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
+        deadline_hit = False   # budget (not retry count) ended the loop
         backoff_s = flags.get("storage_client_retry_backoff_ms", 20) / 1000.0
         backoff_cap_s = flags.get("storage_client_retry_backoff_max_ms",
                                   1000) / 1000.0
@@ -152,6 +166,7 @@ class StorageClient:
                     # no room for a useful pass after the sleep — fail
                     # now instead of spending the budget's tail asleep
                     stats.add_value("storage.client.deadline_exceeded")
+                    deadline_hit = True
                     break
                 if sleep_s > 0:
                     stats.add_value("storage.client.backoff_ms",
@@ -163,12 +178,15 @@ class StorageClient:
                 pass_timeout = deadline - time.monotonic()
                 if pass_timeout <= 0:
                     stats.add_value("storage.client.deadline_exceeded")
+                    deadline_hit = True
                     break
             with tracing.span("storage.collect.pass", attempt=_attempt,
                               backoff_ms=round(sleep_s * 1000.0, 3),
                               parts=len(pending)):
                 # fan-out workers run on pool threads: hand them the
-                # trace context so their rpc.client spans parent here
+                # trace context so their rpc.client spans parent here,
+                # and the caller's deadline so the per-host RPCs (and
+                # their sockets) enforce the same budget
                 tctx = tracing.capture()
                 by_host = {}
                 routing_failed = {}
@@ -183,7 +201,7 @@ class StorageClient:
                     method, payload = make_req(parts)
                     futures[self.pool.submit(self._call_host, host, method,
                                              payload, pass_timeout,
-                                             tctx)] = (host, parts)
+                                             tctx, qdl)] = (host, parts)
                 next_pending: Dict[int, list] = {}
                 for fut, (host, parts) in futures.items():
                     status, result = fut.result()
@@ -253,19 +271,30 @@ class StorageClient:
         if pending:
             stats.add_value("storage.client.retry_exhausted")
         for part in pending:  # retries/budget exhausted: report what we saw
-            resp.failed_parts[part] = last_status.get(
-                part, Status.LeaderChanged())
+            if deadline_hit:
+                # the BUDGET ended the retries — keep the typed code so
+                # clients see DEADLINE_EXCEEDED (non-retryable without a
+                # fresh budget), with the last transient status kept for
+                # diagnosis (docs/admission.md)
+                last = last_status.get(part)
+                resp.failed_parts[part] = Status.DeadlineExceeded(
+                    "collect budget exhausted"
+                    + (f" (last: {last.to_string()})" if last else ""))
+            else:
+                resp.failed_parts[part] = last_status.get(
+                    part, Status.LeaderChanged())
         return resp
 
     def _call_host(self, host: str, method: str, payload: dict,
-                   timeout: Optional[float] = None, tctx=None):
+                   timeout: Optional[float] = None, tctx=None, qdl=None):
         with tracing.attach_captured(tctx):
-            try:
-                return Status.OK(), self.cm.call(HostAddr.parse(host),
-                                                 method, payload,
-                                                 timeout=timeout)
-            except RpcError as e:
-                return e.status, None
+            with deadlines.bind(qdl):
+                try:
+                    return Status.OK(), self.cm.call(HostAddr.parse(host),
+                                                     method, payload,
+                                                     timeout=timeout)
+                except RpcError as e:
+                    return e.status, None
 
     # ---- typed APIs (the reference's public surface) ----------------
     def get_neighbors(self, space_id: int, vids: List[int],

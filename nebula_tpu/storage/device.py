@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
 from ..common.status import ErrorCode, Status
 from ..filter.expressions import encode_expr
@@ -248,9 +249,24 @@ class RemoteDeviceRuntime:
         try:
             resp = self.cm.call(host, method, req)
         except RpcError as e:
+            if e.status.code == ErrorCode.E_DEADLINE_EXCEEDED:
+                # the budget is gone — falling back to the CPU loop
+                # would spend MORE time the query no longer has
+                raise DeadlineExceeded(e.status.msg) from e
             # storaged down / old build without the method — CPU path
             raise TpuDecline(f"{method} rpc failed: {e.status.msg}")
         if not resp.get("ok"):
+            if resp.get("code") == int(ErrorCode.E_DEADLINE_EXCEEDED):
+                # storaged-side admission shed / expiry: typed fast
+                # failure, never a decline (docs/admission.md).  A
+                # marked SHED keeps its class across the wire so graphd
+                # counts it as overload, not as a client timeout
+                if resp.get("shed"):
+                    from ..graph.batch_dispatch import AdmissionShed
+                    raise AdmissionShed(
+                        resp.get("error", "query shed"), "remote_shed")
+                raise DeadlineExceeded(resp.get("error",
+                                                "deadline exceeded"))
             if resp.get("error"):
                 raise ExecError(resp["error"])
             raise TpuDecline(resp.get("reason", "declined"))

@@ -18,9 +18,10 @@ import threading
 from typing import Dict, List, Optional
 
 from ..common.clock import Duration
+from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
 from ..common.ordered_lock import OrderedLock
-from ..common.stats import stats
+from ..common.stats import PROC_TOKEN, stats
 from ..common.status import ErrorCode, Status
 from ..interface.rpc import RpcError
 from ..kvstore.store import NebulaStore
@@ -422,6 +423,18 @@ class StorageService:
             return {"ok": False, "reason": str(d)}
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
+        except DeadlineExceeded as e:
+            # admission shed / budget exhausted: a TYPED fast failure —
+            # NOT a decline, or graphd's CPU fallback would re-run the
+            # very work the overload protection just rejected.  A true
+            # SHED (admission decision, not mere expiry) is marked so
+            # graphd's overload signals count it (docs/admission.md)
+            from ..graph.batch_dispatch import AdmissionShed
+            resp = {"ok": False, "error": str(e),
+                    "code": int(ErrorCode.E_DEADLINE_EXCEEDED)}
+            if isinstance(e, AdmissionShed):
+                resp["shed"] = True
+            return resp
         except Exception as e:      # noqa: BLE001 — device-infra failure
             # (jax missing/broken, HBM OOM, ...): decline so graphd's
             # CPU per-hop loop still answers the query — but loudly, or
@@ -457,6 +470,14 @@ class StorageService:
             return {"ok": False, "reason": str(d)}
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
+        except DeadlineExceeded as e:
+            # typed fast failure (see rpc_deviceGo): never a decline
+            from ..graph.batch_dispatch import AdmissionShed
+            resp = {"ok": False, "error": str(e),
+                    "code": int(ErrorCode.E_DEADLINE_EXCEEDED)}
+            if isinstance(e, AdmissionShed):
+                resp["shed"] = True
+            return resp
         except Exception as e:      # noqa: BLE001 — device-infra failure
             self._log_device_failure("deviceFindPath", e)
             stats.add_value("storage.device_decline.qps")
@@ -538,7 +559,7 @@ class StorageService:
         """One daemon's 60 s stats snapshot for metad's SHOW STATS
         fan-out (the nGQL analogue of scraping /get_stats)."""
         return {"host": self.local_host or "storaged",
-                "stats": stats.dump()}
+                "stats": stats.dump(), "proc": PROC_TOKEN}
 
     def part_status_brief(self) -> Dict[str, dict]:
         """Per-part replication brief piggybacked on heartbeats

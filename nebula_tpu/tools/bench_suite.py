@@ -325,6 +325,148 @@ print(json.dumps(out))
 """
 
 
+def _soak_pass(c, space: str, go_qs: List[str], path_qs: List[str],
+               threads: int, duration_s: float) -> dict:
+    """One closed-loop soak rung: ``threads`` workers hammer a 2:1
+    GO : FIND PATH mix for ``duration_s``.  Shed/deadline-exceeded
+    responses are counted separately (they are the overload valve
+    working, not errors); latencies are recorded per statement class
+    so the FIND PATH saturation curve is its own column."""
+    import time as _time
+
+    from ..common.status import ErrorCode
+    lock = threading.Lock()
+    lat = {"go": [], "path": []}
+    sheds = [0]
+    errors: List[str] = []
+    stop_at = [0.0]
+
+    def worker(wid: int):
+        g = c.client()
+        g.execute(f"USE {space}")
+        i = wid
+        while _time.perf_counter() < stop_at[0]:
+            kind = "path" if i % 3 == 2 else "go"
+            qs = path_qs if kind == "path" else go_qs
+            q = qs[i % len(qs)]
+            t0 = _time.perf_counter()
+            r = g.execute(q)
+            dt_us = (_time.perf_counter() - t0) * 1e6
+            with lock:
+                if r.ok():
+                    lat[kind].append(dt_us)
+                elif r.error_code == ErrorCode.E_DEADLINE_EXCEEDED:
+                    sheds[0] += 1
+                else:
+                    errors.append(r.error_msg)
+            i += threads
+
+    # warm concurrently at the rung's thread count (batch shapes are a
+    # function of concurrency — see _timed_queries)
+    stop_at[0] = _time.perf_counter() + min(3.0, duration_s / 4)
+    ts = [threading.Thread(target=worker, args=(w,))
+          for w in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    with lock:
+        lat["go"].clear()
+        lat["path"].clear()
+        sheds[0] = 0
+        errors.clear()
+    start = _time.perf_counter()
+    stop_at[0] = start + duration_s
+    ts = [threading.Thread(target=worker, args=(w,))
+          for w in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = _time.perf_counter() - start
+    n_ok = len(lat["go"]) + len(lat["path"])
+    out = {
+        "workers": threads, "wall_s": round(wall, 1),
+        "requests": n_ok, "sheds": sheds[0],
+        "errors": len(errors),
+        "qps": round(n_ok / wall, 1),
+        "go_p50_ms": round(percentile(lat["go"], 50) / 1000, 3)
+        if lat["go"] else None,
+        "go_p99_ms": round(percentile(lat["go"], 99) / 1000, 3)
+        if lat["go"] else None,
+        "path_p50_ms": round(percentile(lat["path"], 50) / 1000, 3)
+        if lat["path"] else None,
+        "path_p99_ms": round(percentile(lat["path"], 99) / 1000, 3)
+        if lat["path"] else None,
+    }
+    if errors:
+        out["first_errors"] = errors[:3]
+    return out
+
+
+def bench_soak(results: list, persons: int, duration_s: float = 600.0,
+               workers=(8, 16, 32, 64), deadline_ms: int = 2000) -> None:
+    """Sustained mixed-workload saturation curve (docs/admission.md):
+    GO 3 STEPS + FIND SHORTEST PATH at a 2:1 mix, swept across worker
+    counts with admission control ON (2 s whole-request deadlines —
+    the overload valve the curve is recording), plus one
+    admission-OFF control at the top rung.  The acceptance bar: the
+    64-worker FIND PATH p50 stays within ~2x of the 16-worker p50 at
+    equal-or-better qps, instead of the 3x collapse the round-5 suite
+    recorded (BENCH_SUITE_r05: 1,653 ms vs 549 ms)."""
+    from ..cluster import LocalCluster
+    from ..common.flags import flags
+    from .ldbc_gen import generate, load_cluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    saved = {n: flags.get(n) for n in ("admission_control",
+                                       "query_deadline_ms",
+                                       "storage_backend")}
+    try:
+        src, dst, props = generate(persons)
+        load_cluster(c, "ldbc", src, dst, props)
+        rng = np.random.default_rng(11)
+        vids = rng.integers(1, persons + 1, 512)
+        pairs = rng.integers(1, persons + 1, (256, 2))
+        go_qs = [f"GO 3 STEPS FROM {v} OVER knows" for v in vids]
+        path_qs = [f"FIND SHORTEST PATH FROM {a} TO {b} OVER knows "
+                   f"UPTO 4 STEPS" for a, b in pairs]
+        flags.set("storage_backend", "tpu")
+        # global warm with the valve open and no deadline: first-query
+        # XLA compiles take longer than any sane per-query budget, and
+        # a sweep that sheds its own warmup records nothing
+        flags.set("admission_control", False)
+        flags.set("query_deadline_ms", 0)
+        g = c.client()
+        g.execute("USE ldbc")
+        for q in go_qs[:4] + path_qs[:4]:
+            r = g.execute(q)
+            assert r.ok(), r.error_msg
+        per_rung = duration_s / (len(workers) + 1)
+        flags.set("admission_control", True)
+        flags.set("query_deadline_ms", int(deadline_ms))
+        for t in workers:
+            r = _soak_pass(c, "ldbc", go_qs, path_qs, t, per_rung)
+            r["config"] = f"soak mixed GO+PATH ({t} workers, admission on)"
+            r["backend"] = "tpu"
+            r["admission"] = "on"
+            results.append(r)
+            print(r, file=sys.stderr)
+        # control: the top rung with the valve open (round-5 behavior)
+        flags.set("admission_control", False)
+        flags.set("query_deadline_ms", 0)
+        r = _soak_pass(c, "ldbc", go_qs, path_qs, workers[-1], per_rung)
+        r["config"] = (f"soak mixed GO+PATH ({workers[-1]} workers, "
+                       f"admission off)")
+        r["backend"] = "tpu"
+        r["admission"] = "off"
+        results.append(r)
+        print(r, file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            flags.set(k, v)
+        c.stop()
+
+
 def bench_mesh_virtual(results: list, persons: int) -> None:
     """Config 5: cross-partition multi-hop GO sharded over an 8-device
     mesh.  Real multi-chip hardware is not available, so this runs the
@@ -381,12 +523,28 @@ def main(argv=None) -> int:
     p.add_argument("--quick", action="store_true",
                    help="small sizes (CI smoke)")
     p.add_argument("--persons", type=int, default=None)
+    p.add_argument("--soak", action="store_true",
+                   help="run ONLY the sustained mixed-workload "
+                        "saturation sweep (admission control on, "
+                        "8->64 workers + an admission-off control)")
+    p.add_argument("--soak-secs", type=float, default=600.0,
+                   help="total soak wall budget, split evenly across "
+                        "the worker rungs (default: the 10-minute leg)")
+    p.add_argument("--out", default=None,
+                   help="also write the results JSON to this path")
     args = p.parse_args(argv)
     persons_path = args.persons or (2000 if args.quick else 10000)
     persons_go = args.persons or (2000 if args.quick else 100000)
     persons_mesh = args.persons or (2000 if args.quick else 50000)
 
     results: list = []
+    if args.soak:
+        bench_soak(results, persons_path, duration_s=args.soak_secs)
+        print(json.dumps(results))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(results, fh, indent=1)
+        return 0
     # link self-diagnosis first (same probe as bench.py): the device
     # configs' absolute numbers track the link round trip, so record
     # it in the JSON for cross-environment attribution
@@ -418,6 +576,9 @@ def main(argv=None) -> int:
               f"| {r['p50_ms']} ms | {r['p99_ms']} ms |")
     print()
     print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=1)
     return 0
 
 

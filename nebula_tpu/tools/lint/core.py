@@ -163,8 +163,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
-    from . import flagsreg, hotpath, jaxaudit, locks, metrics, spans, \
-        status, wirecheck
+    from . import events, flagsreg, hotpath, jaxaudit, locks, metrics, \
+        spans, status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -173,6 +173,7 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "flag-registry": flagsreg.check_flag_registry,
         "span-registry": spans.check_span_registry,
         "metric-registry": metrics.check_metric_registry,
+        "event-registry": events.check_event_registry,
         "jaxpr-audit": jaxaudit.check_jaxpr_audit,
         "wire-contract": wirecheck.check_wire_contract,
     }
@@ -180,7 +181,8 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
 
 ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "jax-hotpath", "flag-registry", "span-registry",
-              "metric-registry", "jaxpr-audit", "wire-contract")
+              "metric-registry", "event-registry", "jaxpr-audit",
+              "wire-contract")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 

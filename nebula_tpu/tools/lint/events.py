@@ -1,0 +1,126 @@
+"""event-registry — every ``journal.record("...")`` kind is a LITERAL
+dotted string from the single ``EVENT_KINDS`` registry
+(common/events.py), and no dead registry entries remain.
+
+Mirrors the span-/metric-registry contracts: the journal's runtime
+guard (EventJournal.record raises on unknown kinds) catches a typo'd
+kind only when that code path actually RUNS — a chaos-only event would
+ship broken.  This check proves the whole vocabulary statically, and
+flags registry entries no producer ever records (dead dashboard rows).
+
+The registry itself must exist exactly once; ``record`` calls are
+matched on a receiver whose dotted path ends in ``journal`` (the
+module singleton and any alias of it) so unrelated ``.record``
+methods (slow-query log, backend router) stay out of scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import PackageContext, Violation, dotted, enclosing_symbol, \
+    qualname_map
+
+CHECK = "event-registry"
+
+
+def _literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _registry_names(node: ast.AST) -> Optional[List[str]]:
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out = []
+    for el in node.elts:
+        name = _literal(el)
+        if name is None:
+            return None
+        out.append(name)
+    return out
+
+
+def check_event_registry(ctx: PackageContext) -> List[Violation]:
+    registries: List[Tuple[str, int, List[str]]] = []
+    # (kind-literal-or-None, rel, line, symbol)
+    uses: List[Tuple[Optional[str], str, int, str]] = []
+    out: List[Violation] = []
+
+    for mod in ctx.modules:
+        qmap = qualname_map(mod.tree)
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name) \
+                                and tgt.id == "EVENT_KINDS":
+                            names = _registry_names(child.value)
+                            if names is not None:
+                                registries.append((mod.rel, child.lineno,
+                                                   names))
+                if isinstance(child, ast.Call):
+                    d = dotted(child.func) or ""
+                    parts = d.split(".")
+                    if len(parts) >= 2 and parts[-1] == "record" \
+                            and parts[-2].endswith("journal"):
+                        kind = _literal(child.args[0]) \
+                            if child.args else None
+                        uses.append((kind, mod.rel, child.lineno,
+                                     enclosing_symbol(qmap, stack)))
+                new_stack = stack + [child] if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)) else stack
+                walk(child, new_stack)
+
+        walk(mod.tree, [])
+
+    if not uses and not registries:
+        return out
+    if len(registries) > 1:
+        for rel, line, _ in registries[1:]:
+            out.append(Violation(
+                CHECK, rel, line, "<module>",
+                "second EVENT_KINDS registry — event kinds must come "
+                f"from ONE registry (first at {registries[0][0]}:"
+                f"{registries[0][1]})"))
+    known = set(registries[0][2]) if registries else set()
+
+    hit: set = set()
+    for kind, rel, line, sym in uses:
+        if kind is None:
+            out.append(Violation(
+                CHECK, rel, line, sym,
+                "event kind must be a literal dotted string from the "
+                "EVENT_KINDS registry (common/events.py) — a dynamic "
+                "kind defeats the closed set SHOW EVENTS and the "
+                "cluster aggregation filter on"))
+            continue
+        if not registries:
+            out.append(Violation(
+                CHECK, rel, line, sym,
+                f"event kind {kind!r} recorded but no EVENT_KINDS "
+                "registry exists in the package"))
+            continue
+        if kind not in known:
+            out.append(Violation(
+                CHECK, rel, line, sym,
+                f"event kind {kind!r} is not in the EVENT_KINDS "
+                f"registry ({registries[0][0]}:{registries[0][1]}) — "
+                "add it there first (the runtime guard would only "
+                "catch this when the path runs)"))
+        else:
+            hit.add(kind)
+
+    if registries:
+        rel, line, _names = registries[0]
+        for name in registries[0][2]:
+            if name not in hit:
+                out.append(Violation(
+                    CHECK, rel, line, "<module>",
+                    f"event kind {name!r} is registered but never "
+                    "recorded by any journal.record call — delete it "
+                    "or instrument the seam"))
+    return out

@@ -212,6 +212,53 @@ def bench_metrics(reps: int, op_budget_ns: float = 50_000.0,
                               and t_render <= render_budget_s)}
 
 
+def bench_admission(reps: int, op_budget_us: float = 200.0) -> dict:
+    """Admission-path hot cost: per-query overhead of the dispatcher's
+    admission layer (deadline capture, bounded-queue check, priority
+    slot, window controller) on the DISABLED/idle path — no deadline
+    bound, shallow queue, nothing sheds.  This is the only new cost
+    the PR 6 overload protection adds to every device query, so it is
+    budget-guarded like lint/metrics: a submit over ``op_budget_us``
+    fails the run.  The end-to-end confirmation is query_path's GO/s
+    pinned in BASELINE.md (its serving path crosses this seam when the
+    device is attached)."""
+    from ..graph.batch_dispatch import GoBatchDispatcher
+
+    class _Runtime:
+        def exec_batch(self, space_id, payloads):
+            return [p for p in payloads], "m"
+
+    d = GoBatchDispatcher(_Runtime())
+    key = ("exec_batch", 1)
+    n = max(500, reps * 20)
+    d.submit_batched(key, 0)                 # warm the key state
+    t0 = time.perf_counter()
+    for i in range(n):
+        d.submit_batched(key, i)
+    dt = time.perf_counter() - t0
+    per_us = dt / n * 1e6
+    # and the shed fast path (overloaded): rejects must stay cheap —
+    # failing fast is the whole point
+    from ..common.flags import flags
+    from ..graph.batch_dispatch import AdmissionShed, _KeyState
+    st = _KeyState()
+    st.queue = [None] * (int(flags.get("admission_queue_max") or 256))
+    t0 = time.perf_counter()
+    sheds = 0
+    m = max(200, reps * 5)
+    for _ in range(m):
+        try:
+            d._admit(key, st, None)
+        except AdmissionShed:
+            sheds += 1
+    dt_shed = time.perf_counter() - t0
+    return {"submit_us_per_op": round(per_us, 2),
+            "shed_us_per_op": round(dt_shed / m * 1e6, 2),
+            "sheds": sheds,
+            "op_budget_us": op_budget_us,
+            "within_budget": per_us <= op_budget_us}
+
+
 def bench_lint(budget_s: float) -> dict:
     """Wall time of the whole-package nebulint run (all nine checks —
     the jaxpr tracing of every registered kernel bucket included).
@@ -251,11 +298,13 @@ def main(argv=None) -> int:
         "wal": bench_wal(entries),
         "query_path": bench_query(qreps),
         "metrics_path": bench_metrics(reps),
+        "admission_path": bench_admission(reps),
         "lint": bench_lint(args.lint_budget_s),
     }
     print(json.dumps(out))
     ok = out["lint"]["within_budget"] \
-        and out["metrics_path"]["within_budget"]
+        and out["metrics_path"]["within_budget"] \
+        and out["admission_path"]["within_budget"]
     return 0 if ok else 1
 
 

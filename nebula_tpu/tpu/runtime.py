@@ -43,7 +43,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import deadline as deadlines
 from ..common import tracing
+from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
 from ..common.stats import stats as _stats
 from ..common.status import ErrorCode
@@ -78,10 +80,10 @@ class _GoQuery:
     """One query riding a go_batch_execute dispatch."""
 
     __slots__ = ("start_vids", "plan", "yield_cols", "distinct",
-                 "where_expr", "etype_to_alias", "exc_type")
+                 "where_expr", "etype_to_alias", "exc_type", "deadline")
 
     def __init__(self, start_vids, plan, yield_cols, distinct, where_expr,
-                 etype_to_alias, exc_type):
+                 etype_to_alias, exc_type, deadline=None):
         self.start_vids = start_vids
         self.plan = plan
         self.yield_cols = yield_cols
@@ -89,6 +91,10 @@ class _GoQuery:
         self.where_expr = where_expr
         self.etype_to_alias = etype_to_alias
         self.exc_type = exc_type
+        # whole-request budget (common/deadline.py): checked again
+        # right before the device launch — the dispatcher's snapshot
+        # check can predate a slow mirror build
+        self.deadline = deadline
 
 
 class _Pending:
@@ -741,7 +747,7 @@ class TpuQueryRuntime:
                                        yield_cols, distinct, where_expr,
                                        ExcType)
         q = _GoQuery(start_vids, plan, yield_cols, distinct, where_expr,
-                     etype_to_alias, ExcType)
+                     etype_to_alias, ExcType, deadline=deadlines.current())
         result, _m = self.dispatcher.submit_batched(
             ("go_batch_execute", space_id, et_tuple, steps, upto), q)
         return result
@@ -761,8 +767,27 @@ class TpuQueryRuntime:
         a poisoned query must not fail its batch)."""
         import time
         t0 = time.perf_counter()
-        starts = [q.start_vids for q in queries]
-        with tracing.span("tpu.launch", queries=len(queries),
+        # final pre-launch deadline gate (docs/admission.md): the
+        # dispatcher filtered at snapshot time, but a slow mirror
+        # build / leadership handoff can age a batch — an entry whose
+        # budget ran out here is dropped from the launch and its
+        # waiter woken with DEADLINE_EXCEEDED via the per-query
+        # exception slots, exactly like a poisoned query
+        expired: Dict[int, Exception] = {}
+        live = queries
+        if any(q.deadline is not None and q.deadline.expired()
+               for q in queries):
+            live = []
+            for i, q in enumerate(queries):
+                if q.deadline is not None and q.deadline.expired():
+                    expired[i] = DeadlineExceeded(
+                        "go: budget exhausted before device launch")
+                else:
+                    live.append(q)
+        if not live:
+            return [expired[i] for i in range(len(queries))], None
+        starts = [q.start_vids for q in live]
+        with tracing.span("tpu.launch", queries=len(live),
                           steps=steps):
             launch = self._launch_frontiers(space_id, starts, et_tuple,
                                             steps, upto=upto)
@@ -778,8 +803,8 @@ class TpuQueryRuntime:
                     vs_lists, m = launch()
                 t1 = self._tick("t_fetch_s", t1)
                 with tracing.span("tpu.assemble",
-                                  queries=len(queries)):
-                    results = self._assemble_results(space_id, m, queries,
+                                  queries=len(live)):
+                    results = self._assemble_results(space_id, m, live,
                                                      vs_lists, et_tuple)
             self._tick("t_assemble_s", t1)
             # whole-dispatch latency (launch -> fetch -> assemble),
@@ -787,8 +812,12 @@ class TpuQueryRuntime:
             # rides — one histogram update per BATCH, not per query
             _stats.observe("tpu.dispatch.latency_us",
                            (time.perf_counter() - t0) * 1e6,
-                           width=self._batch_width(len(queries)))
-            return results, m
+                           width=self._batch_width(len(live)))
+            if not expired:
+                return results, m
+            it = iter(results)
+            return [expired[i] if i in expired else next(it)
+                    for i in range(len(queries))], m
 
         return _Pending(finish)
 

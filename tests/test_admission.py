@@ -1,0 +1,538 @@
+"""Deadline-aware admission control, backpressure, and load shedding
+(docs/admission.md): the serving path must degrade GRACEFULLY under
+overload — bounded queues, typed fast failures (DEADLINE_EXCEEDED with
+the partial-result completeness/warning surface), priority-ordered
+pipeline slots, a closed-loop batch window, and whole-request deadline
+budgets that propagate graphd -> RPC envelope -> storage/meta retries
+-> device dispatch.  No waiter ever blocks past its deadline."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.common import deadline as deadlines
+from nebula_tpu.common.deadline import Deadline, DeadlineExceeded
+from nebula_tpu.common.events import journal
+from nebula_tpu.common.flags import flags
+from nebula_tpu.common.stats import stats
+from nebula_tpu.common.status import ErrorCode, Status
+from nebula_tpu.graph.batch_dispatch import (AdmissionShed,
+                                             GoBatchDispatcher, _KeyState,
+                                             _PrioritySlots, _Request,
+                                             _WindowController)
+
+
+@pytest.fixture(autouse=True)
+def _restore_admission_flags():
+    names = ("admission_control", "admission_queue_max",
+             "admission_window_depth_ref", "go_batch_window_ms",
+             "go_batch_inflight", "query_deadline_ms")
+    saved = {n: flags.get(n) for n in names}
+    yield
+    for k, v in saved.items():
+        flags.set(k, v)
+
+
+# ---------------------------------------------------------- deadline core
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        d = Deadline.after_ms(50)
+        assert 0 < d.remaining_s() <= 0.05
+        assert not d.expired()
+        e = Deadline.after_ms(-1)
+        assert e.expired() and e.remaining_s() <= 0
+
+    def test_bind_restores_previous(self):
+        assert deadlines.current() is None
+        outer = Deadline.after_s(10)
+        with deadlines.bind(outer):
+            assert deadlines.current() is outer
+            with deadlines.bind(None):       # scoped clear
+                assert deadlines.current() is None
+            inner = Deadline.after_s(1)
+            with deadlines.bind(inner):
+                assert deadlines.current() is inner
+            assert deadlines.current() is outer
+        assert deadlines.current() is None
+
+    def test_remaining_or_clamps_and_raises(self):
+        with deadlines.bind(Deadline.after_s(0.5)):
+            assert deadlines.remaining_or(10.0) <= 0.5
+            assert deadlines.remaining_or(None) <= 0.5
+        with deadlines.bind(Deadline.after_ms(-5)):
+            with pytest.raises(DeadlineExceeded):
+                deadlines.remaining_or(1.0)
+        assert deadlines.remaining_or(7.0) == 7.0    # unbound
+
+
+# ------------------------------------------------------- priority slots
+class TestPrioritySlots:
+    def test_priority_order_under_contention(self):
+        """With the single slot held, a priority-0 waiter that arrived
+        AFTER a priority-2 waiter still gets the slot first — the
+        per-query-class ladder."""
+        slots = _PrioritySlots(1)
+        slots.acquire(1)                  # occupy
+        order = []
+        ready = threading.Barrier(3)
+
+        def waiter(prio):
+            ready.wait(timeout=5)
+            if prio == 0:
+                time.sleep(0.05)          # provably arrives second
+            slots.acquire(prio)
+            order.append(prio)
+            slots.release()
+
+        ts = [threading.Thread(target=waiter, args=(2,)),
+              threading.Thread(target=waiter, args=(0,))]
+        for t in ts:
+            t.start()
+        ready.wait(timeout=5)
+        time.sleep(0.2)                   # both parked on the slot
+        slots.release()
+        for t in ts:
+            t.join(timeout=5)
+        assert order == [0, 2]
+
+    def test_back_to_back_releases_wake_successive_heads(self):
+        """Missed-wakeup regression: two release()s landing while the
+        head waiter is inside one wait() leave a SECOND free slot that
+        nobody re-notifies for — the new head must be woken by the
+        departing head, not sleep on a free slot for a full batch
+        round-trip."""
+        for _ in range(20):               # the race is probabilistic
+            slots = _PrioritySlots(2)
+            slots.acquire(0)
+            slots.acquire(1)              # drain both slots
+            got = []
+
+            def w(p, slots=slots, got=got):
+                slots.acquire(p)
+                got.append(p)
+
+            ts = [threading.Thread(target=w, args=(p,)) for p in (0, 1)]
+            for t in ts:
+                t.start()
+            time.sleep(0.02)              # both parked on the heap
+            slots.release()
+            slots.release()               # back-to-back frees
+            for t in ts:
+                t.join(timeout=2.0)
+            assert not any(t.is_alive() for t in ts), \
+                "a waiter slept on a free slot"
+            assert sorted(got) == [0, 1]
+
+    def test_release_wakes_fifo_within_class(self):
+        slots = _PrioritySlots(2)
+        slots.acquire(1)
+        slots.acquire(1)
+        done = []
+
+        def w():
+            slots.acquire(1)
+            done.append(1)
+            slots.release()
+
+        t = threading.Thread(target=w)
+        t.start()
+        time.sleep(0.05)
+        assert not done
+        slots.release()
+        t.join(timeout=5)
+        assert done == [1]
+
+
+# ---------------------------------------------------- window controller
+class TestWindowController:
+    def test_cap_full_when_idle_shrinks_with_depth(self):
+        flags.set("go_batch_window_max_ms", 25)
+        flags.set("admission_window_depth_ref", 8)
+        w = _WindowController()
+        full = w.cap_s()
+        assert abs(full - 0.025) < 1e-9
+        for _ in range(50):
+            w.observe_depth(64)           # saturated queue
+        assert w.cap_s() < full / 4
+        for _ in range(200):
+            w.observe_depth(0)            # drains -> cap recovers
+        assert w.cap_s() > full * 0.9
+
+    def test_dispatcher_window_obeys_controller_cap(self):
+        d = GoBatchDispatcher(runtime=None)
+        st = _KeyState()
+        st.rt_ema_s = 30.0                # frac * ema would be huge
+        flags.set("go_batch_window_ms", -1)
+        cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
+        assert d._window_s(st) == cap     # idle: the full flag cap
+        for _ in range(50):
+            d.window.observe_depth(100)
+        assert d._window_s(st) < cap / 4  # loaded: controller shrinks it
+
+
+# ------------------------------------------------------------- shedding
+class _EchoRuntime:
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+        self.calls = []
+
+    def exec_batch(self, space_id, payloads):
+        self.calls.append(list(payloads))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [p for p in payloads], "m"
+
+
+class TestShedding:
+    def test_queue_full_sheds_fast(self):
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        flags.set("admission_queue_max", 0)   # explicit 0: shed all
+        before = d.stats["sheds"]
+        journal.clear_for_tests()
+        t0 = time.perf_counter()
+        with pytest.raises(AdmissionShed) as ei:
+            d.submit_batched(("exec_batch", 1), "x")
+        assert (time.perf_counter() - t0) < 0.1, "shed must fail FAST"
+        assert ei.value.reason == "queue_full"
+        assert isinstance(ei.value, DeadlineExceeded)   # typed surface
+        assert ei.value.status.code == ErrorCode.E_DEADLINE_EXCEEDED
+        assert d.stats["sheds"] == before + 1
+        assert rt.calls == []                 # never reached the device
+        kinds = [e["kind"] for e in journal.dump(10)]
+        assert "query.shed" in kinds
+
+    def test_unmeetable_deadline_sheds_at_admission(self):
+        """A BACKLOG that makes the budget unmeetable is overload —
+        an AdmissionShed that feeds the /healthz counters."""
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        st = d._state(("exec_batch", 1))
+        st.rt_ema_s = 5.0                     # measured: ~5 s a batch
+        st.queue.append(_Request("backlog"))  # depth 1 ahead of us
+        with deadlines.bind(Deadline.after_ms(100)):
+            with pytest.raises(AdmissionShed) as ei:
+                d.submit_batched(("exec_batch", 1), "x")
+        assert ei.value.reason == "deadline_unmeetable"
+        assert rt.calls == []
+
+    def test_client_budget_failure_is_not_a_shed(self):
+        """The SAME unmeetable budget on an EMPTY queue is the
+        client's own choice, not overload: typed DEADLINE_EXCEEDED but
+        no shed counter and no query.shed journal entry — a tight
+        TIMEOUT on an idle daemon must never degrade /healthz."""
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        st = d._state(("exec_batch", 1))
+        st.rt_ema_s = 5.0
+        journal.clear_for_tests()
+        sheds_before = d.stats["sheds"]
+        for budget_ms in (100, -1):           # unmeetable and expired
+            with deadlines.bind(Deadline.after_ms(budget_ms)):
+                with pytest.raises(DeadlineExceeded) as ei:
+                    d.submit_batched(("exec_batch", 1), "x")
+            assert not isinstance(ei.value, AdmissionShed)
+        assert d.stats["sheds"] == sheds_before
+        assert d.stats["deadline_drops"] >= 2
+        assert all(e["kind"] != "query.shed" for e in journal.dump(10))
+        assert rt.calls == []
+
+    def test_admission_off_restores_admit_everything(self):
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        flags.set("admission_control", False)
+        flags.set("admission_queue_max", 0)
+        st = d._state(("exec_batch", 1))
+        st.rt_ema_s = 5.0
+        with deadlines.bind(Deadline.after_s(30)):
+            r, m = d.submit_batched(("exec_batch", 1), "x")
+        assert (r, m) == ("x", "m")
+
+    def test_waiter_never_blocks_past_deadline(self):
+        """A request queued behind a slow batch wakes itself with
+        DEADLINE_EXCEEDED at its deadline — it does NOT wait for the
+        leader, and the runtime never sees its payload."""
+        flags.set("go_batch_inflight", 1)
+        rt = _EchoRuntime(delay_s=0.6)
+        d = GoBatchDispatcher(rt)
+        key = ("exec_batch", 1)
+        errs = {}
+
+        def occupant():
+            d.submit_batched(key, "slow")
+
+        t = threading.Thread(target=occupant)
+        t.start()
+        time.sleep(0.1)                   # occupant is dispatching
+
+        def victim():
+            try:
+                with deadlines.bind(Deadline.after_ms(120)):
+                    d.submit_batched(key, "victim")
+            except DeadlineExceeded as e:
+                errs["victim"] = e
+
+        t0 = time.perf_counter()
+        v = threading.Thread(target=victim)
+        v.start()
+        v.join(timeout=5)
+        waited = time.perf_counter() - t0
+        t.join(timeout=5)
+        assert "victim" in errs, "victim hung instead of failing fast"
+        assert not isinstance(errs["victim"], AdmissionShed)
+        assert waited < 0.45, f"blocked {waited:.2f}s past its deadline"
+        assert d.stats["deadline_drops"] >= 1
+        assert all("victim" not in call for call in rt.calls)
+
+    def test_run_drops_expired_pre_launch(self):
+        """The leader's pre-launch gate: an entry whose budget ran out
+        while queued is dropped from the batch (per-query exception
+        machinery) while its batch-mates launch normally."""
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        key = ("exec_batch", 1)
+        live = _Request("live", Deadline.after_s(30))
+        dead = _Request("dead", Deadline.after_ms(-1))   # already expired
+        d._run(key, [live, dead], lambda: None)
+        # _run releases one inflight slot it never acquired in this
+        # direct-call harness — re-acquire to keep the fixture honest
+        d._inflight.acquire(1)
+        assert rt.calls == [["live"]]
+        assert live.result == "live" and live.error is None
+        assert isinstance(dead.error, DeadlineExceeded)
+        assert dead.done and live.done
+        assert d.stats["deadline_drops"] >= 1
+
+
+# ------------------------------------------------- wire-level deadlines
+class TestWireDeadline:
+    def test_deadline_rides_the_rpc_envelope(self):
+        """A bound budget crosses the TCP frame as remaining ms and is
+        re-anchored server-side; without a binding the server sees no
+        deadline (2-element frame contract)."""
+        from nebula_tpu.interface.rpc import RpcChannel, RpcServer
+
+        seen = {}
+
+        class H:
+            def rpc_probe(self, req):
+                dl = deadlines.current()
+                seen["rem"] = dl.remaining_ms() if dl else None
+                return {"ok": True}
+
+        srv = RpcServer(H()).start()
+        try:
+            ch = RpcChannel(srv.addr)
+            ch.call("probe", {})
+            assert seen["rem"] is None
+            with deadlines.bind(Deadline.after_ms(500)):
+                ch.call("probe", {})
+            assert seen["rem"] is not None and 0 < seen["rem"] <= 500
+            ch.close()
+        finally:
+            srv.stop()
+
+    def test_expired_budget_fails_before_dialing(self):
+        from nebula_tpu.interface.rpc import RpcChannel, RpcError
+        from nebula_tpu.interface.common import HostAddr
+        # unroutable port: a dial attempt would error differently/slowly
+        ch = RpcChannel(HostAddr("127.0.0.1", 1))
+        with deadlines.bind(Deadline.after_ms(-1)):
+            with pytest.raises(RpcError) as ei:
+                ch.call("probe", {})
+        assert ei.value.status.code == ErrorCode.E_DEADLINE_EXCEEDED
+
+    def test_storage_collect_respects_remaining_budget(self):
+        """collect() clamps its own retry budget to the thread's
+        remaining deadline: an exhausted budget fails every part with
+        the typed status instead of dialing."""
+        from nebula_tpu.storage.client import StorageClient
+
+        class _Meta:
+            def part_num(self, s):
+                return 1
+
+            def parts_alloc(self, s):
+                return {0: ["127.0.0.1:1"]}
+
+        sc = StorageClient(_Meta())
+        with deadlines.bind(Deadline.after_ms(-1)):
+            resp = sc.collect(1, {0: [1]},
+                              lambda parts: ("getBound", {}))
+        assert not resp.succeeded()
+        assert all(s.code == ErrorCode.E_DEADLINE_EXCEEDED
+                   for s in resp.failed_parts.values())
+
+
+# ----------------------------------------------------------- TIMEOUT nGQL
+class TestTimeoutClause:
+    def test_parse_timeout_prefix(self):
+        from nebula_tpu.graph.parser import GQLParser
+        p = GQLParser()
+        r = p.parse("TIMEOUT 1500 GO FROM 1 OVER e")
+        assert r.ok() and r.value().timeout_ms == 1500
+        r = p.parse("PROFILE TIMEOUT 20 GO FROM 1 OVER e")
+        assert r.ok()
+        assert r.value().profile and r.value().timeout_ms == 20
+        r = p.parse("GO FROM 1 OVER e")
+        assert r.ok() and r.value().timeout_ms is None
+
+    def test_timeout_zero_rejected(self):
+        from nebula_tpu.graph.parser import GQLParser
+        r = GQLParser().parse("TIMEOUT 0 GO FROM 1 OVER e")
+        assert not r.ok()
+
+    def test_timeout_stays_usable_as_identifier(self):
+        from nebula_tpu.graph.parser import GQLParser
+        r = GQLParser().parse("GO FROM 1 OVER timeout")
+        assert r.ok()
+
+
+# ---------------------------------------------------------- observability
+class TestObservability:
+    def test_admission_metrics_registered_and_exported(self):
+        rt = _EchoRuntime()
+        d = GoBatchDispatcher(rt)
+        flags.set("admission_queue_max", 0)
+        with pytest.raises(AdmissionShed):
+            d.submit_batched(("exec_batch", 7), "x")
+        flags.set("admission_queue_max", 256)
+        d.submit_batched(("exec_batch", 7), "y")
+        text = stats.prometheus_text()
+        assert "nebula_graph_admission_shed_total" in text
+        assert "nebula_graph_admission_deadline_exceeded_total" in text
+        assert "nebula_graph_admission_wait_us" in text
+        # scrape-time gauges: live queue depth per (method, space) +
+        # the closed-loop window cap
+        assert 'nebula_graph_admission_queue_depth{method="exec_batch"' \
+            in text
+        assert "nebula_graph_admission_window_ms" in text
+
+    def test_healthz_degrades_while_shedding(self):
+        from nebula_tpu.graph.service import admission_health
+        ok, _detail = admission_health()     # may be degraded from
+        # neighbors in this module — force a fresh reject and check the
+        # flip is observable either way
+        stats.add_value("graph.admission.rejected.qps")
+        ok, detail = admission_health()
+        assert ok is False and "shedding" in detail
+
+
+# --------------------------------------------------------------- e2e
+@pytest.fixture
+def nba():
+    from nebula_tpu.cluster import LocalCluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    g = c.client()
+
+    def ok(stmt):
+        r = g.execute(stmt)
+        assert r.ok(), f"{stmt}: {r.error_msg}"
+        return r
+
+    ok("CREATE SPACE s(partition_num=3, replica_factor=1)")
+    c.refresh_all()
+    ok("USE s")
+    ok("CREATE EDGE follow(w int)")
+    c.refresh_all()
+    ok("INSERT EDGE follow(w) VALUES 1->2:(1), 2->3:(1), 3->4:(1), "
+       "4->5:(1), 1->6:(1), 6->7:(1), 2->7:(1)")
+    yield c, g, ok
+    c.stop()
+
+
+class TestEndToEnd:
+    def test_shed_query_fails_fast_with_completeness(self, nba):
+        c, g, ok = nba
+        ok("GO 2 STEPS FROM 1 OVER follow")       # warm mirror/kernels
+        rt = c.tpu_runtime
+        orig = rt.go_batch_execute
+
+        def slow(*a, **kw):
+            time.sleep(0.4)
+            return orig(*a, **kw)
+
+        rt.go_batch_execute = slow
+        try:
+            t0 = time.perf_counter()
+            r = g.execute("TIMEOUT 90 GO 2 STEPS FROM 1 OVER follow")
+            wall = time.perf_counter() - t0
+        finally:
+            rt.go_batch_execute = orig
+        assert r.error_code == ErrorCode.E_DEADLINE_EXCEEDED, r.error_msg
+        assert wall < 2.0, f"deadline failure took {wall:.2f}s"
+        assert r.completeness < 100
+        assert r.warnings, "shed/deadline response must carry warnings"
+
+    def test_profile_of_rejected_query_carries_admission_tag(self, nba):
+        c, g, ok = nba
+        ok("GO 2 STEPS FROM 1 OVER follow")
+        # make the budget provably unmeetable: a warm key with a huge
+        # measured round trip
+        d = c.tpu_runtime.dispatcher
+        key = next(k for k in d._keys if k[0] == "go_batch_execute")
+        d._state(key).rt_ema_s = 30.0
+        try:
+            r = g.execute("PROFILE TIMEOUT 50 GO 2 STEPS FROM 1 "
+                          "OVER follow")
+        finally:
+            d._state(key).rt_ema_s = 0.0
+        assert r.error_code == ErrorCode.E_DEADLINE_EXCEEDED
+
+        prof = r.raw.get("profile")
+        assert prof, "PROFILE must return the trace even on rejection"
+
+        def walk(n):
+            yield n
+            for ch in n.get("children", []):
+                yield from walk(ch)
+
+        spans = [s for root in prof["roots"] for s in walk(root)]
+        admission = [s for s in spans if s["name"] == "graph.admission"]
+        assert admission, [s["name"] for s in spans]
+        # empty queue + huge measured round trip: a client-budget
+        # rejection (not an overload shed) — the marker says which
+        assert admission[0]["tags"].get("decision") == \
+            "budget_below_round_trip"
+        roots = [s for s in spans if s["name"] == "graph.query"]
+        assert roots and roots[0]["tags"].get("admission") == "rejected"
+        assert roots[0]["tags"].get("deadline_ms") == 50
+
+    def test_show_stats_has_admission_rows(self, nba):
+        c, g, ok = nba
+        ok("GO FROM 1 OVER follow")              # dispatcher exists
+        r = ok("SHOW STATS")
+        rows = r.rows if not hasattr(r.rows, "_mat") else r.rows._mat()
+        names = {row[1] for row in rows}
+        assert "graph.admission.shed" in names
+        assert "graph.admission.deadline_exceeded" in names
+        assert "graph.admission.queue_depth.live" in names
+        # no double counting: each (host, stat) pair appears once
+        pairs = [(row[0], row[1]) for row in rows]
+        assert len(pairs) == len(set(pairs))
+
+    def test_deadline_statement_succeeds_within_budget(self, nba):
+        c, g, ok = nba
+        ok("GO 2 STEPS FROM 1 OVER follow")
+        r = ok("TIMEOUT 60000 GO 2 STEPS FROM 1 OVER follow "
+               "YIELD follow._dst")
+        assert sorted(x[0] for x in r.rows) == [3, 7, 7]
+
+
+@pytest.mark.slow
+def test_soak_leg_records_saturation_curve():
+    """The bench-suite soak leg (tools/bench_suite.py bench_soak) runs
+    end to end on a tiny graph/short budget: every rung reports qps +
+    per-class percentiles, the admission-on rungs carry the shed
+    counter, and the control rung has the valve off.  The real
+    10-minute recording is BENCH_SUITE_r06.json (marked slow so tier-1
+    stays fast)."""
+    from nebula_tpu.tools.bench_suite import bench_soak
+    results = []
+    bench_soak(results, persons=400, duration_s=12.0, workers=(4, 8))
+    assert len(results) == 3                  # 2 rungs on + 1 control
+    for r in results:
+        assert r["requests"] > 0 and r["qps"] > 0
+        assert r["errors"] == 0, r
+        assert r["path_p50_ms"] is None or r["path_p50_ms"] > 0
+    assert results[-1]["admission"] == "off"

@@ -620,3 +620,96 @@ class TestFaultsEndpoint:
             assert got["rpc.fault.injected"]["sum.60"] > 0
         finally:
             ws.stop()
+
+
+# ===================================================== deadline budgets
+class TestWholeRequestDeadline:
+    """Retry-storm guard (docs/admission.md): injected latency must
+    never let retries outlive the whole-request deadline — shed/expired
+    queries return DEADLINE_EXCEEDED with completeness < 100 instead of
+    hanging, and the backoff ladders consume only the remaining
+    budget."""
+
+    def test_injected_latency_cannot_outlive_query_deadline(self, duo):
+        c, cl = duo
+        default_injector.configure(
+            [{"kind": "delay", "method": "getBound", "delay_s": 0.5}])
+        t0 = time.monotonic()
+        r = cl.execute("TIMEOUT 300 GO FROM 1,2,3,4,5,6,7,8 OVER knows "
+                       "YIELD knows._dst")
+        elapsed = time.monotonic() - t0
+        # the injected 0.5 s/call latency x 4 parts x retry passes
+        # would run for many seconds unbounded — the 300 ms budget
+        # caps the whole statement (one absorbed delay + fast failure)
+        assert elapsed < 3.0, f"retries outlived the deadline: {elapsed}s"
+        assert r.error_code == ErrorCode.E_DEADLINE_EXCEEDED, (
+            r.error_code, r.error_msg)
+        assert r.completeness < 100
+        assert r.warnings, "deadline failure must carry warnings"
+
+    def test_storage_retry_passes_consume_remaining_budget_only(self, duo):
+        """A flapping leader under a bound budget: the collect loop's
+        backoff + passes fit the remaining deadline (never extend it)
+        and the exhaustion surfaces as the typed deadline status."""
+        from nebula_tpu.common import deadline as deadlines
+        from nebula_tpu.common.deadline import Deadline
+        c, cl = duo
+        default_injector.configure(
+            [{"kind": "leader_changed", "method": "getBound"}])
+        sid = c.graph_meta_client.get_space_id_by_name("chaos").value()
+        saved = flags.get("storage_client_request_deadline_ms")
+        flags.set("storage_client_request_deadline_ms", 60000)
+        try:
+            t0 = time.monotonic()
+            with deadlines.bind(Deadline.after_ms(350)):
+                resp = c.storage_client.get_neighbors(
+                    sid, list(range(1, 9)), [1], retries=1000)
+            elapsed = time.monotonic() - t0
+        finally:
+            flags.set("storage_client_request_deadline_ms", saved)
+        # the 60 s collect flag did NOT win: the narrower thread budget
+        # clamped the whole retry ladder
+        assert elapsed < 3.0, f"budget not honored: {elapsed}s"
+        assert not resp.succeeded() and resp.completeness() == 0
+
+    def test_meta_retry_backoff_fits_remaining_budget(self, duo):
+        from nebula_tpu.common import deadline as deadlines
+        from nebula_tpu.common.deadline import Deadline
+        c, cl = duo
+        saved = {n: flags.get(n) for n in
+                 ("meta_client_retry_backoff_ms",
+                  "meta_client_retry_backoff_max_ms")}
+        flags.set("meta_client_retry_backoff_ms", 800)
+        flags.set("meta_client_retry_backoff_max_ms", 800)
+        default_injector.configure(
+            [{"kind": "blackhole", "method": "listSpaces"}])
+        before = _stat("meta.client.deadline_exceeded")
+        try:
+            t0 = time.monotonic()
+            with deadlines.bind(Deadline.after_ms(250)):
+                r = c.graph_meta_client.call("listSpaces", {})
+            elapsed = time.monotonic() - t0
+        finally:
+            for k, v in saved.items():
+                flags.set(k, v)
+        assert not r.ok()
+        # without the budget, 4 whole-peer passes at ~0.8 s backoff
+        # would run ~2.4 s — the 250 ms budget refuses the first sleep
+        assert elapsed < 1.5, f"backoff outlived the budget: {elapsed}s"
+        assert r.status.code == ErrorCode.E_DEADLINE_EXCEEDED
+        assert _stat("meta.client.deadline_exceeded") > before
+
+    def test_no_deadline_means_no_behavior_change(self, duo):
+        """The whole plumbing is pay-for-what-you-use: with no binding
+        and query_deadline_ms=0 the statement runs exactly as before
+        (chaos-free sanity guard for the default path)."""
+        c, cl = duo
+        saved = flags.get("query_deadline_ms")
+        flags.set("query_deadline_ms", 0)
+        try:
+            r = cl.execute("GO FROM 1,2,3,4,5,6,7,8 OVER knows "
+                           "YIELD knows._dst")
+        finally:
+            flags.set("query_deadline_ms", saved)
+        assert r.ok(), r.error_msg
+        assert sorted(x[0] for x in r.rows) == ALL_DST

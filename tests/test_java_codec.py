@@ -1,0 +1,118 @@
+"""Java-encode -> Python-decode differential for
+clients/java/NativeCodec.java (ROADMAP carried-over debt: the JVM
+binding had never been compiled by any test).
+
+``NativeCodec.encodeRow`` is a pure-Java encoder of the framework's
+row wire format (codec/rows.py) — so the differential needs no native
+library and no JVM FFI at runtime: a tiny Java driver encodes a fixed
+row set and prints hex; Python asserts byte-exact equality with its
+own ``encode_row`` AND decodes the Java bytes through ``RowReader``.
+Both directions of drift (format change here, transcription bug there)
+fail the test.  Skips cleanly when javac is absent or predates JDK 22
+(the file uses the finalized FFM API for its decode half, which the
+compiler must accept even though the driver never calls it).
+"""
+import re
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from nebula_tpu.codec.rows import RowReader, encode_row
+from nebula_tpu.interface.common import ColumnDef, Schema, SupportedType
+
+REPO = Path(__file__).resolve().parent.parent
+JAVA_DIR = REPO / "clients" / "java"
+
+# one column of every wire type, exercising varint edge shapes
+# (negative zigzag, >32-bit magnitude), float vs double width, utf-8
+# multibyte strings, and both bool values across the row set
+COLUMNS = [
+    ("name", SupportedType.STRING),
+    ("age", SupportedType.INT),
+    ("vid", SupportedType.VID),
+    ("ratio", SupportedType.FLOAT),
+    ("score", SupportedType.DOUBLE),
+    ("active", SupportedType.BOOL),
+    ("ts", SupportedType.TIMESTAMP),
+]
+SCHEMA_VER = 3
+ROWS = [
+    {"name": "héllo☃", "age": -42, "vid": 1 << 40, "ratio": 1.25,
+     "score": 3.5, "active": True, "ts": 1_700_000_000},
+    {"name": "", "age": 0, "vid": 0, "ratio": -0.5, "score": -2.0,
+     "active": False, "ts": 0},
+    {"name": "x" * 200, "age": (1 << 62), "vid": -7, "ratio": 0.0,
+     "score": 1e300, "active": True, "ts": -1},
+]
+
+_DRIVER = """
+package com.nebulatpu.client;
+
+import java.util.List;
+
+public final class EncodeMain {
+    public static void main(String[] args) {
+        byte[] types = {NativeCodec.T_STRING, NativeCodec.T_INT,
+                        NativeCodec.T_VID, NativeCodec.T_FLOAT,
+                        NativeCodec.T_DOUBLE, NativeCodec.T_BOOL,
+                        NativeCodec.T_TIMESTAMP};
+        Object[][] rows = {
+            {"héllo☃", -42L, 1L << 40, 1.25f, 3.5d, true,
+             1700000000L},
+            {"", 0L, 0L, -0.5f, -2.0d, false, 0L},
+            {"x".repeat(200), 1L << 62, -7L, 0.0f, 1e300d, true, -1L},
+        };
+        for (Object[] row : rows) {
+            byte[] b = NativeCodec.encodeRow(3L, types, List.of(row));
+            StringBuilder sb = new StringBuilder();
+            for (byte x : b) sb.append(String.format("%02x", x));
+            System.out.println(sb);
+        }
+    }
+}
+"""
+
+
+def _javac_major():
+    out = subprocess.run(["javac", "--version"], capture_output=True,
+                         text=True)
+    m = re.search(r"(\d+)", out.stdout or out.stderr or "")
+    return int(m.group(1)) if m else 0
+
+
+@pytest.mark.skipif(shutil.which("javac") is None
+                    or shutil.which("java") is None, reason="no jdk")
+def test_java_encode_python_decode_differential(tmp_path):
+    if _javac_major() < 22:
+        pytest.skip("NativeCodec.java needs the JDK 22 FFM API")
+    driver = tmp_path / "EncodeMain.java"
+    driver.write_text(_DRIVER, encoding="utf-8")
+    subprocess.run(
+        ["javac", "-encoding", "utf-8", "-d", str(tmp_path),
+         str(JAVA_DIR / "NativeCodec.java"), str(driver)],
+        check=True, capture_output=True)
+    out = subprocess.run(
+        ["java", "-cp", str(tmp_path), "-Dfile.encoding=UTF-8",
+         "com.nebulatpu.client.EncodeMain"],
+        check=True, capture_output=True, text=True, encoding="utf-8")
+    blobs = [bytes.fromhex(line)
+             for line in out.stdout.strip().splitlines()]
+    assert len(blobs) == len(ROWS)
+
+    schema = Schema(columns=[ColumnDef(n, t) for n, t in COLUMNS],
+                    version=SCHEMA_VER)
+    for blob, expect in zip(blobs, ROWS):
+        # byte-exact: the Java encoder IS the Python wire format
+        assert blob == encode_row(schema, expect)
+        # and the Python reader round-trips every field
+        r = RowReader(blob, schema)
+        for name, typ in COLUMNS:
+            got = r.get(name)
+            if typ == SupportedType.FLOAT:
+                assert got == pytest.approx(expect[name], rel=1e-6)
+            elif typ == SupportedType.BOOL:
+                assert bool(got) is expect[name]
+            else:
+                assert got == expect[name], (name, got, expect[name])

@@ -496,8 +496,8 @@ def test_all_checks_registered():
     assert set(ALL_CHECKS) == {"lock-discipline", "lock-order",
                                "status-discard", "jax-hotpath",
                                "flag-registry", "span-registry",
-                               "metric-registry", "jaxpr-audit",
-                               "wire-contract"}
+                               "metric-registry", "event-registry",
+                               "jaxpr-audit", "wire-contract"}
 
 
 # ========================================== OrderedLock runtime watchdog
@@ -994,3 +994,69 @@ def test_wirecheck_endpoint_contract_drift(tmp_path):
                      checks=["wire-contract"])
     assert any("bogus_field" in v.message and "/faults" in v.message
                for v in vs), vs
+
+
+# ================================================ 10 · event-registry
+_EVENT_REG = """
+    from common.events import journal
+
+    EVENT_KINDS = ("raft.leader_elected", "query.shed")
+
+    def f():
+        journal.record("raft.leader_elected", detail="x")
+        journal.record("query.shed", detail="y", space=1)
+"""
+
+
+def test_event_registry_clean(tmp_path):
+    assert run_fixture(tmp_path, {"events.py": _EVENT_REG},
+                       checks=["event-registry"]) == []
+
+
+def test_event_registry_unknown_kind(tmp_path):
+    bad = _EVENT_REG.replace('journal.record("query.shed"',
+                             'journal.record("query.mystery"')
+    vs = run_fixture(tmp_path, {"events.py": bad},
+                     checks=["event-registry"])
+    msgs = [v.message for v in vs]
+    assert any("query.mystery" in m and "not in the EVENT_KINDS" in m
+               for m in msgs)
+    # the now-unrecorded registry entry is flagged dead too
+    assert any("'query.shed'" in m and "never recorded" in m
+               for m in msgs)
+
+
+def test_event_registry_dynamic_kind_rejected(tmp_path):
+    bad = _EVENT_REG.replace('journal.record("query.shed"',
+                             'journal.record(kind')
+    vs = run_fixture(tmp_path, {"events.py": bad},
+                     checks=["event-registry"])
+    assert any("literal" in v.message for v in vs)
+
+
+def test_event_registry_single_registry(tmp_path):
+    files = {"events.py": _EVENT_REG,
+             "other.py": 'EVENT_KINDS = ("dup.kind",)\n'}
+    vs = run_fixture(tmp_path, files, checks=["event-registry"])
+    assert any("ONE registry" in v.message for v in vs)
+
+
+def test_event_registry_ignores_unrelated_record_calls(tmp_path):
+    """slow-log / router `.record` methods are out of scope — only a
+    journal-named receiver is the event seam."""
+    assert run_fixture(tmp_path, {"mod.py": """
+        class R:
+            def f(self, slow_log, router):
+                slow_log.record("not an event", 12)
+                router.record(("k",), "device", 1.0)
+    """}, checks=["event-registry"]) == []
+
+
+def test_event_registry_suppression_round_trip(tmp_path):
+    bad = _EVENT_REG.replace(
+        'journal.record("query.shed", detail="y", space=1)',
+        'journal.record("query.mystery", detail="y")  '
+        '# nebulint: disable=event-registry — fixture')
+    vs = run_fixture(tmp_path, {"events.py": bad},
+                     checks=["event-registry"])
+    assert not any("query.mystery" in v.message for v in vs)
