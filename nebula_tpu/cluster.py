@@ -72,6 +72,10 @@ class StorageNode:
             raft_service=self.raft_service)
         self.part_man.register_handler(self.kv)
         self.kv.init()
+        # crash-recovery observability: a restart over durable state
+        # journals node.recovered (heartbeats carry it to metad)
+        from .kvstore.store import journal_recovered_parts
+        journal_recovered_parts(self.kv, host)
         self.service = StorageService(self.kv, self.schema_man,
                                       local_host=host,
                                       meta_client=self.meta_client,

@@ -46,6 +46,16 @@ EVENT_KINDS = (
     "query.shed",            # admission control rejected a query
                              # (queue full / budget provably unmeetable
                              # — graph/batch_dispatch.py)
+    "wal.truncated",         # recovery cut unverifiable frames off a
+                             # WAL segment (kvstore/wal.py CRC check —
+                             # docs/durability.md)
+    "tpu.breaker_open",      # the device circuit breaker opened for a
+                             # (space, kernel-class): queries decline to
+                             # the CPU path until a half-open probe
+                             # re-admits the device (tpu/runtime.py)
+    "node.recovered",        # a daemon booted over existing durable
+                             # state and recovered its parts' commit
+                             # watermarks (cluster.py / daemons)
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

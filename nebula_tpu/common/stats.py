@@ -109,6 +109,16 @@ METRIC_NAMES = (
     "tpu.prewarm.hits",
     "tpu.prewarm.misses",
     "tpu.dispatch.latency_us",
+    # device circuit breaker (tpu/runtime.py + storage/device.py,
+    # docs/durability.md): opened/reclosed transitions, classified
+    # runtime failures, fast-path declines while open, half-open
+    # probes, and the per-(space, class) state gauge
+    "tpu.breaker.*",
+    # crash-recovery counters (kvstore/wal.py, cluster.py,
+    # docs/durability.md): WAL truncations/dropped bytes on replay,
+    # flush failures that dropped an un-persisted tail, nodes that
+    # booted over recovered durable state
+    "recovery.*",
     # event journal
     "events.recorded",
 )

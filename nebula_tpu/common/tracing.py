@@ -76,6 +76,9 @@ SPAN_NAMES = (
     "rpc.fault",              # zero-duration marker: injected fault
     "graph.admission",        # zero-duration marker: admission decision
                               # (shed / deadline drop — batch_dispatch)
+    "tpu.breaker",            # zero-duration marker: device breaker
+                              # decline / classified runtime failure
+                              # (tpu/runtime.py, docs/durability.md)
 )
 
 _tls = threading.local()          # .ctx = (trace_id, span_id, True)

@@ -48,6 +48,10 @@ def build(args, cm=None):
                                data_paths=[data_path] if data_path else []),
                      raft_service=raft_service)
     pm.add_part(META_SPACE, META_PART, peers=metas if raft_service else None)
+    # crash-recovery observability: a metad restart over a durable
+    # catalog journals node.recovered (kvstore/store.py)
+    from ..kvstore.store import journal_recovered_parts
+    journal_recovered_parts(kv, local)
     service = MetaService(kv)
     service.wire_balancer(cm)
     # peer metads dial the SAME address for MetaService and raft RPCs —

@@ -308,8 +308,18 @@ class GoExecutor(Executor):
                     router.record(route_key, "device",
                                   time.perf_counter() - t0)
                 return out
-            except TpuDecline:
-                pass   # remote device runtime declined — CPU loop below
+            except TpuDecline as d:
+                # CPU loop below answers; a DEGRADED decline (device
+                # runtime failure / open circuit breaker) additionally
+                # surfaces on the response — completeness < 100 + a
+                # warning — so clients see the cluster is serving in a
+                # degraded mode, not silently (docs/durability.md)
+                if getattr(d, "degraded", False):
+                    self.ectx.completeness = min(self.ectx.completeness,
+                                                 99)
+                    self.ectx.warnings.append(
+                        f"device path degraded, served by CPU fallback: "
+                        f"{d}")
         t_cpu0 = time.perf_counter()
 
         # ---- input mapping (pipe/$var semantics) --------------------
@@ -1008,8 +1018,15 @@ class FindPathExecutor(Executor):
             try:
                 return rt.run_find_path(self, space, srcs, dsts, etypes,
                                         max_steps, s.shortest, etype_names)
-            except TpuDecline:
-                pass   # remote device runtime declined — CPU BFS below
+            except TpuDecline as d:
+                # CPU BFS below answers; degraded declines surface
+                # (same contract as the GO executor above)
+                if getattr(d, "degraded", False):
+                    self.ectx.completeness = min(self.ectx.completeness,
+                                                 99)
+                    self.ectx.warnings.append(
+                        f"device path degraded, served by CPU fallback: "
+                        f"{d}")
 
         # BFS recording predecessor edges. SHORTEST keeps only edges that
         # advance depth (depth-layered DAG); ALL keeps every discovered

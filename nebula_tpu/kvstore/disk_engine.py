@@ -44,13 +44,41 @@ from typing import Callable, Iterator, List, Optional, Tuple
 
 from ._sorted import SortedDict
 
+from ..common.flags import flags
 from ..common.status import ErrorCode, Status
 from .engine import KVEngine
+
+flags.define("disk_engine_mem_limit_bytes", 8 * 1024 * 1024,
+             "memtable bytes before a flush to a new run — operator "
+             "knob; the proc-level chaos suite shrinks it so SIGKILLs "
+             "land inside flush/compaction windows (docs/durability.md)")
+flags.define("disk_engine_compact_after_runs", 16,
+             "run-count threshold that triggers a background "
+             "compaction (reads probe runs newest->oldest, so an "
+             "unbounded run count degrades every get)")
 
 KV = Tuple[bytes, bytes]
 _FRAME = struct.Struct(">II")     # klen, vlen
 _TOMBSTONE_LEN = 0xFFFFFFFF
 _TOMBSTONE = object()             # memtable sentinel
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a completed rename/create survives power
+    loss — fsyncing the file alone does not persist its directory
+    entry, and a MANIFEST whose rename evaporates would resurrect the
+    pre-commit run list after a crash (kill-anywhere atomicity audit,
+    docs/durability.md)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return                    # platform without O_RDONLY dirs
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass                      # best effort (some filesystems refuse)
+    finally:
+        os.close(fd)
 
 
 class _PreadReader:
@@ -265,6 +293,7 @@ class DiskEngine(KVEngine):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path())
+        _fsync_dir(self.dir)
 
     # ---- memtable flush ----------------------------------------------
     def _write_run(self, items: Iterator[Tuple[bytes, object]]) -> Optional[_Run]:
@@ -292,6 +321,9 @@ class DiskEngine(KVEngine):
         if not wrote:
             os.remove(path)
             return None
+        # persist the directory entry too: a MANIFEST that commits this
+        # run must never outlive the run file itself after power loss
+        _fsync_dir(self.dir)
         return _Run(path, self.index_every)
 
     def _flush_mem_locked(self) -> None:
@@ -484,6 +516,7 @@ class DiskEngine(KVEngine):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
         return Status.OK()
 
     def ingest(self, path: str) -> Status:

@@ -183,8 +183,15 @@ class NebulaStore:
             # on-disk LSM engine (reference: RocksEngine over the
             # configured data dirs, RocksEngine.h:94-156)
             from .disk_engine import DiskEngine
-            return DiskEngine(os.path.join(path, f"nebula_space_{space_id}"),
-                              compaction_filter=cf)
+            # the flags are defined at disk_engine import time, so the
+            # gets can never miss — no fallback defaults here
+            return DiskEngine(
+                os.path.join(path, f"nebula_space_{space_id}"),
+                compaction_filter=cf,
+                mem_limit_bytes=int(
+                    flags.get("disk_engine_mem_limit_bytes")),
+                compact_after_runs=int(
+                    flags.get("disk_engine_compact_after_runs")))
         if kind == "disk":
             raise ValueError("storage_engine=disk requires a data path")
         if kind in ("auto", "native"):
@@ -414,6 +421,37 @@ class NebulaStore:
                     return st
         self._bump(space_id)   # ingest loads keys engine-side, not via Part
         return Status.OK()
+
+
+def journal_recovered_parts(kv: "NebulaStore", host: str) -> int:
+    """Journal a ``node.recovered`` event when this freshly-booted store
+    adopted parts carrying durable state from a previous life (commit
+    watermark > 0): the crash-recovery observability seam — a restarted
+    storaged/metad announces WHAT it recovered to, the heartbeat
+    piggyback carries it to metad's cluster journal, and the chaos
+    harness's wait-for-recovery asserts on it (tools/proc_cluster.py,
+    docs/durability.md).  Returns the recovered-part count."""
+    from ..common.events import journal
+    from ..common.stats import stats
+    recovered = 0
+    top_commit = 0
+    for space_id in list(kv.spaces):
+        for part_id in kv.part_ids(space_id):
+            part = kv.part(space_id, part_id)
+            if part is None:
+                continue
+            cid = part.last_committed_log_id()[0]
+            if cid > 0:
+                recovered += 1
+                top_commit = max(top_commit, cid)
+    if recovered:
+        stats.add_value("recovery.node_restarts")
+        journal.record("node.recovered",
+                       detail=f"{recovered} part(s) recovered, top "
+                              f"commit watermark {top_commit}",
+                       host=host, parts=recovered,
+                       top_commit=top_commit)
+    return recovered
 
 
 def collect_raft_gauges(kv: "NebulaStore", host: str) -> None:

@@ -5,23 +5,57 @@ FileBasedWal.h:31-206, Wal.h:19-52, BufferFlusher.h): append (id, term,
 msg), iterate a [first, last] window, rollbackToLog for divergence repair,
 first/last id tracking across restarts, and segment rotation.
 
-Design: segment files ``<dir>/wal.<firstId>.log`` of framed records
-    frame := log_id(8BE) | term(8BE) | len(4BE) | msg | crc-less
+Design: segment files ``<dir>/wal.<firstId>.log`` of framed records.
+Two on-disk formats coexist (docs/durability.md):
+
+    v1 (legacy, no segment header — what pre-CRC builds wrote)
+        frame := log_id(8BE) | term(8BE) | len(4BE) | msg
+    v2 (current; segment starts with the 8-byte magic ``NBWAL2\\r\\n``)
+        frame := log_id(8BE) | term(8BE) | len(4BE) | crc(4BE) | msg
+        crc   := crc32 over the (id, term, len) header fields + msg
+
+The reader stays backward-compatible: a segment without the magic parses
+crc-less (v1) so an upgraded node replays its old log; every NEW segment
+is v2, and a reopened log whose newest segment is v1 rotates to a fresh
+v2 segment on the first flush rather than mixing frame formats in one
+file.  (zlib's CRC32 rather than Castagnoli CRC32C: the container has no
+crc32c module and the C-speed zlib polynomial detects the same torn-tail
+and bit-rot corruption this frame check exists for.)
+
+Recovery TRUNCATES at the first bad frame (bad CRC, torn header/body):
+the segment file is physically cut back to its last good frame, every
+LATER segment is deleted (frames past a bad one are not contiguous with
+the verified prefix, and a stale later segment would otherwise shadow
+their re-appends on the next load), a ``wal.truncated`` event is
+journaled and ``recovery.wal_truncated`` /
+``recovery.wal_dropped_bytes`` count it — replaying a half-flushed or
+bit-rotted frame as a committed raft entry is the failure mode this
+whole format exists to prevent.
+
 Appends go through a bytearray buffer flushed when it exceeds
-``buffer_size`` or on explicit flush()/sync — the single-writer equivalent
-of the reference's shared BufferFlusher thread (raft appends are already
-serialized per part). An in-memory (id → (term, msg)) tail map serves reads
-of recent entries without file IO; older reads stream from segments.
+``buffer_size`` or on explicit flush()/sync — the single-writer
+equivalent of the reference's shared BufferFlusher thread (raft appends
+are already serialized per part).  ``flush`` returns a Status: on an IO
+failure the un-persisted tail is DROPPED from the in-memory map (so the
+acked set and the durable set can never diverge — the caller must not
+ack what did not reach disk) and the segment is truncated back to its
+pre-write length so a partial write can never sit under later frames.
+An in-memory (id → (term, msg)) tail map serves reads of recent entries
+without file IO; older reads stream from segments.
 """
 from __future__ import annotations
 
 import os
 import struct
+import zlib
 from typing import Iterator, List, Optional, Tuple
 
 from ..common.flags import flags
+from ..common.status import ErrorCode, Status
 
-_HDR = struct.Struct(">QQI")
+_HDR = struct.Struct(">QQI")        # v1 frame header: id, term, len
+_HDR2 = struct.Struct(">QQII")      # v2 frame header: id, term, len, crc
+_MAGIC2 = b"NBWAL2\r\n"             # v2 segment header (8 bytes)
 _SEGMENT_BYTES = 16 * 1024 * 1024
 
 flags.define(
@@ -34,6 +68,38 @@ flags.define(
     "across every append in the batch, so high-concurrency write "
     "throughput is barely affected.  Benchmarks chasing loopback "
     "numbers can turn it off")
+
+
+def _frame_crc(log_id: int, term: int, msg: bytes) -> int:
+    return zlib.crc32(msg, zlib.crc32(_HDR.pack(log_id, term, len(msg))))
+
+
+def _write_all(fd: int, data: bytes) -> None:
+    """os.write until every byte landed — a SHORT write (disk nearly
+    full, signal) silently persisting a prefix would let flush() claim
+    durability for frames that never reached the file."""
+    view = memoryview(data)
+    while view:
+        n = os.write(fd, view)
+        view = view[n:]
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the WAL DIRECTORY so a freshly rotated segment's directory
+    entry survives power loss — fsyncing the file alone does not
+    persist its name, and a whole acked segment evaporating on crash
+    would silently replay only the older ones (same helper stance as
+    disk_engine's MANIFEST commit)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class LogEntry:
@@ -62,12 +128,24 @@ class FileBasedWal:
         self.buffer_size = buffer_size if buffer_size is not None \
             else int(flags.get("wal_buffer_size_bytes", 256 * 1024))
         self._buf = bytearray()
-        self._fh = None
+        self._fd: Optional[int] = None     # raw fd of the current segment
         self._cur_seg_path: Optional[str] = None
         self._cur_seg_bytes = 0
+        # the current segment's frame format must match what we append;
+        # a reopened v1 tail segment forces rotation on the next flush
+        self._force_rotate = False
+        # a failed flush may leave partial bytes we could not truncate
+        # away (EIO): until the truncate succeeds, nothing more may be
+        # appended to this segment
+        self._tail_dirty = False
+        # new segment file whose directory entry is not yet fsync'd
+        self._seg_created = False
         # entries held in memory: full replay cache (bounded by the raft
         # snapshot floor via clean_up_to — raftex service polling)
         self._entries: List[LogEntry] = []
+        # last log id known persisted (flush success watermark): a flush
+        # failure drops every in-memory entry above it
+        self._durable_id = 0
         if wal_dir:
             self._load()
 
@@ -86,26 +164,88 @@ class FileBasedWal:
         segs.sort()
         return segs
 
+    def _absorb(self, log_id: int, term: int, msg: bytes) -> None:
+        # rollback artifacts: a reappended id supersedes the old run
+        if self._entries and log_id <= self._entries[-1].log_id:
+            while self._entries and self._entries[-1].log_id >= log_id:
+                self._entries.pop()
+        self._entries.append(LogEntry(log_id, term, msg))
+
+    def _parse_segment(self, data: bytes) -> Tuple[int, bool]:
+        """Absorb one segment's frames; returns (verified byte length,
+        clean) where clean=False means a torn/corrupt frame stopped the
+        parse before the end of the file."""
+        v2 = data.startswith(_MAGIC2)
+        pos = len(_MAGIC2) if v2 else 0
+        n = len(data)
+        hdr = _HDR2 if v2 else _HDR
+        while True:
+            if pos + hdr.size > n:
+                return pos, pos == n
+            if v2:
+                log_id, term, ln, crc = hdr.unpack_from(data, pos)
+            else:
+                log_id, term, ln = hdr.unpack_from(data, pos)
+                crc = None
+            body = pos + hdr.size
+            if body + ln > n:
+                return pos, False           # torn tail write
+            msg = data[body:body + ln]
+            if crc is not None and _frame_crc(log_id, term, msg) != crc:
+                return pos, False           # bit rot / half-flushed frame
+            self._absorb(log_id, term, msg)
+            pos = body + ln
+
     def _load(self) -> None:
-        for _, path in self._segments():
+        segs = self._segments()
+        truncated_at: Optional[Tuple[str, int, int]] = None
+        for i, (_, path) in enumerate(segs):
             with open(path, "rb") as f:
                 data = f.read()
-            pos, n = 0, len(data)
-            while pos + _HDR.size <= n:
-                log_id, term, ln = _HDR.unpack_from(data, pos)
-                if pos + _HDR.size + ln > n:
-                    break  # torn tail write — discard
-                msg = data[pos + _HDR.size:pos + _HDR.size + ln]
-                pos += _HDR.size + ln
-                # rollback artifacts: a reappended id supersedes the old run
-                if self._entries and log_id <= self._entries[-1].log_id:
-                    while self._entries and self._entries[-1].log_id >= log_id:
-                        self._entries.pop()
-                self._entries.append(LogEntry(log_id, term, msg))
+            good, clean = self._parse_segment(data)
+            if not clean:
+                # first bad frame: cut this segment back to its verified
+                # prefix and drop every later segment — their frames are
+                # not contiguous with what we kept, and leaving them on
+                # disk would shadow the re-appends of the same ids
+                dropped = len(data) - good
+                with open(path, "r+b") as f:
+                    f.truncate(good)
+                for _, later in segs[i + 1:]:
+                    try:
+                        dropped += os.path.getsize(later)
+                    except OSError:
+                        pass
+                    try:
+                        os.remove(later)
+                    except OSError:
+                        pass
+                truncated_at = (path, good, dropped)
+                break
+        if truncated_at is not None:
+            path, good, dropped = truncated_at
+            # lazy imports: the stats/events planes import flags, which
+            # this module already depends on — but keeping the recovery
+            # path's imports local means the common WAL read/write path
+            # costs nothing for them
+            from ..common.events import journal
+            from ..common.stats import stats
+            stats.add_value("recovery.wal_truncated")
+            stats.add_value("recovery.wal_dropped_bytes", dropped)
+            journal.record("wal.truncated",
+                           detail=f"cut {path} to {good}B "
+                                  f"({dropped}B of unverifiable frames "
+                                  f"dropped)",
+                           path=path, kept_bytes=good,
+                           dropped_bytes=dropped,
+                           last_good_id=self.last_log_id())
         segs = self._segments()
         if segs:
             self._cur_seg_path = segs[-1][1]
             self._cur_seg_bytes = os.path.getsize(self._cur_seg_path)
+            with open(self._cur_seg_path, "rb") as f:
+                self._force_rotate = f.read(len(_MAGIC2)) != _MAGIC2
+        self._durable_id = self.last_log_id()
 
     # ---- props ------------------------------------------------------
     def first_log_id(self) -> int:
@@ -138,10 +278,14 @@ class FileBasedWal:
         if last and log_id != last + 1:
             return False
         self._entries.append(LogEntry(log_id, term, msg))
-        self._buf += _HDR.pack(log_id, term, len(msg))
+        self._buf += _HDR2.pack(log_id, term, len(msg),
+                                _frame_crc(log_id, term, msg))
         self._buf += msg
         if len(self._buf) >= self.buffer_size:
-            self.flush()
+            # auto-flush failure drops the buffered tail (this entry
+            # included) from the in-memory map — report the append as
+            # not taken so the caller never acks it
+            return self.flush().ok()
         return True
 
     def append_logs(self, entries: List[LogEntry]) -> bool:
@@ -150,30 +294,95 @@ class FileBasedWal:
                 return False
         return True
 
-    def flush(self, sync: Optional[bool] = None) -> None:
-        """Push buffered appends to the OS (and fsync when ``sync`` —
-        default: the wal_sync flag).  Raft calls this before every
-        append ack, so acked entries survive process death; fsync
-        extends that to kernel crash / power loss."""
-        if not self._buf or not self.dir:
-            self._buf.clear()
-            return
-        if self._fh is None or self._cur_seg_bytes >= _SEGMENT_BYTES:
-            if self._fh:
-                self._fh.close()
+    def _open_segment(self) -> None:
+        """Rotate to / reopen the segment appends go to (caller is
+        flush()).  New segment files start with the v2 magic; rotation
+        never lands on an existing file (a name collision with a legacy
+        segment would splice v2 frames into a v1 file)."""
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        rotate = (self._cur_seg_path is None or self._force_rotate
+                  or self._cur_seg_bytes >= _SEGMENT_BYTES)
+        if rotate:
             first = self._entries[0].log_id if self._entries else 1
             # segment named by the first id it *may* contain
             next_first = self.last_log_id() or first
-            self._cur_seg_path = os.path.join(self.dir, f"wal.{next_first}.log")
-            self._fh = open(self._cur_seg_path, "ab")
-            self._cur_seg_bytes = os.path.getsize(self._cur_seg_path)
-        self._fh.write(self._buf)
-        self._fh.flush()
-        do_sync = flags.get("wal_sync") if sync is None else sync
-        if do_sync:
-            os.fsync(self._fh.fileno())
+            path = os.path.join(self.dir, f"wal.{next_first}.log")
+            while os.path.exists(path):
+                next_first += 1
+                path = os.path.join(self.dir, f"wal.{next_first}.log")
+            self._cur_seg_path = path
+            self._force_rotate = False
+            self._cur_seg_bytes = 0
+        flags_os = os.O_WRONLY | os.O_CREAT | os.O_APPEND
+        self._fd = os.open(self._cur_seg_path, flags_os, 0o644)
+        if os.fstat(self._fd).st_size == 0:
+            _write_all(self._fd, _MAGIC2)
+            self._cur_seg_bytes = len(_MAGIC2)
+            # a brand-new segment file: its directory entry must be
+            # fsynced with the first synced flush (below) or power loss
+            # could evaporate the whole acked segment
+            self._seg_created = True
+
+    def flush(self, sync: Optional[bool] = None) -> Status:
+        """Push buffered appends to the OS (and fsync when ``sync`` —
+        default: the wal_sync flag).  Raft calls this before every
+        append ack, so acked entries survive process death; fsync
+        extends that to kernel crash / power loss.
+
+        On an IO failure the un-persisted tail is dropped from the
+        in-memory map (entries above the durable watermark) and the
+        segment is truncated back so the partial write can never be
+        buried under later frames — the returned Status tells the
+        caller the appends did NOT take."""
+        if not self._buf or not self.dir:
+            self._buf.clear()
+            self._durable_id = self.last_log_id()
+            return Status.OK()
+        try:
+            if self._fd is None or self._force_rotate \
+                    or self._cur_seg_bytes >= _SEGMENT_BYTES:
+                self._open_segment()
+            if self._tail_dirty:
+                # a previous failed flush left bytes we could not cut
+                # off; nothing may append after them until they go
+                os.ftruncate(self._fd, self._cur_seg_bytes)
+                self._tail_dirty = False
+            _write_all(self._fd, bytes(self._buf))
+            do_sync = flags.get("wal_sync") if sync is None else sync
+            if do_sync:
+                os.fsync(self._fd)
+                if self._seg_created:
+                    _fsync_dir(self.dir)
+                    self._seg_created = False
+        except OSError as e:
+            return self._flush_failed(e)
         self._cur_seg_bytes += len(self._buf)
         self._buf.clear()
+        self._durable_id = self.last_log_id()
+        return Status.OK()
+
+    def _flush_failed(self, exc: OSError) -> Status:
+        """Disk refused the tail: drop it from memory (the caller must
+        not ack it), cut the partial write off the segment, count it."""
+        dropped_bytes = len(self._buf)
+        self._buf.clear()
+        while self._entries and self._entries[-1].log_id > self._durable_id:
+            self._entries.pop()
+        if self._fd is not None:
+            try:
+                os.ftruncate(self._fd, self._cur_seg_bytes)
+            except OSError:
+                # can't even truncate (EIO): poison the segment so the
+                # next flush retries the cut before writing anything
+                self._tail_dirty = True
+        from ..common.stats import stats
+        stats.add_value("recovery.wal_flush_failed")
+        return Status.Error(
+            f"wal flush failed, {dropped_bytes}B tail dropped "
+            f"(entries above {self._durable_id}): "
+            f"{type(exc).__name__}: {exc}", ErrorCode.E_WAL_FAIL)
 
     # ---- rollback / cleanup ----------------------------------------
     def rollback_to_log(self, log_id: int) -> bool:
@@ -190,28 +399,37 @@ class FileBasedWal:
             return True
         del self._entries[keep:]
         # durable: rewrite a single compacted segment (bounded by snapshot
-        # cleanup, so this is small in practice)
+        # cleanup, so this is small in practice) — same CRC framing as
+        # the append path, so a crash mid-rewrite truncates cleanly
         self._buf.clear()
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
         for _, path in self._segments():
             os.remove(path)
         self._cur_seg_path = None
         self._cur_seg_bytes = 0
+        self._force_rotate = False
+        self._tail_dirty = False
+        self._durable_id = 0
         for e in self._entries:
-            self._buf += _HDR.pack(e.log_id, e.term, len(e.msg))
+            self._buf += _HDR2.pack(e.log_id, e.term, len(e.msg),
+                                    _frame_crc(e.log_id, e.term, e.msg))
             self._buf += e.msg
-        self.flush()
-        return True
+        return self.flush().ok()
 
     def reset(self) -> None:
         """Drop ALL logs (snapshot installed)."""
         self._entries.clear()
         self._buf.clear()
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        self._durable_id = 0
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        self._cur_seg_path = None
+        self._cur_seg_bytes = 0
+        self._force_rotate = False
+        self._tail_dirty = False
         for _, path in self._segments():
             os.remove(path)
 
@@ -245,7 +463,9 @@ class FileBasedWal:
             i += 1
 
     def close(self) -> None:
-        self.flush()
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        self.flush()  # nebulint: disable=status-discard — best-effort
+        # teardown; a failed final flush already dropped its tail and
+        # there is no caller left to surface the Status to
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None

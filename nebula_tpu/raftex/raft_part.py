@@ -358,16 +358,61 @@ class RaftPart:
                         entries.append(LogEntry(next_id, term, log))
                         waiters.append(waiter)
                         next_id += 1
+                    wal_st = Status.OK()
                     if entries:
-                        self.wal.append_logs(entries)
-                        self.wal.flush()
-                        for e in entries:
-                            self._pre_process(e.log_id, e.term, e.msg)
+                        # a failed flush DROPPED the un-persisted tail
+                        # from the WAL (kvstore/wal.py): the batch must
+                        # fail loudly — acking (or replicating) entries
+                        # the leader's own log no longer holds would
+                        # diverge it from the quorum it just built
+                        if not self.wal.append_logs(entries):
+                            # an INTRA-batch auto-flush failure can
+                            # leave a durable prefix of the batch: roll
+                            # it back so the batch is all-or-nothing —
+                            # an orphan prefix would replicate and
+                            # commit later without its pre-process side
+                            # effects ever running on this leader, and
+                            # after its waiter was told it failed
+                            if self.wal.rollback_to_log(prev_id):
+                                wal_st = Status.Error(
+                                    "wal append refused (flush failure "
+                                    "dropped the tail)",
+                                    ErrorCode.E_WAL_FAIL)
+                            else:
+                                wal_st = Status.Error(
+                                    "wal append failed and the partial "
+                                    "batch could not be rolled back — "
+                                    "entries may still commit; do not "
+                                    "blindly retry non-idempotent ops",
+                                    ErrorCode.E_RESULT_UNKNOWN)
+                        else:
+                            wal_st = self.wal.flush()
+                            if not wal_st.ok() \
+                                    and self.wal.last_log_id() > prev_id \
+                                    and not self.wal.rollback_to_log(
+                                        prev_id):
+                                # same orphan-prefix hazard: an earlier
+                                # intra-batch auto-flush may have
+                                # persisted a prefix the failed final
+                                # flush did not drop
+                                wal_st = Status.Error(
+                                    "wal flush failed and the partial "
+                                    "batch could not be rolled back — "
+                                    "entries may still commit; do not "
+                                    "blindly retry non-idempotent ops",
+                                    ErrorCode.E_RESULT_UNKNOWN)
+                        if wal_st.ok():
+                            for e in entries:
+                                self._pre_process(e.log_id, e.term, e.msg)
                     committed = self.committed_id
                     peer_list = list(self.peers.values())
                 for waiter, st in skipped:
                     waiter.set(st)
                 if not entries:
+                    continue
+                if not wal_st.ok():
+                    for w in waiters:
+                        w.set(wal_st)
                     continue
                 rep_t0 = time.monotonic()
                 ok = self._replicate(term, prev_id, prev_term, entries,
@@ -773,7 +818,11 @@ class RaftPart:
                 if not self.wal.append_log(lid, lterm, msg):
                     return self._append_resp(ErrorCode.E_LOG_GAP)
                 self._pre_process(lid, lterm, msg)
-            self.wal.flush()
+            if not self.wal.flush().ok():
+                # the flush failure dropped the appended tail from the
+                # WAL — never ack what is not durable (the leader counts
+                # this a failed ack and retries / reports truthfully)
+                return self._append_resp(ErrorCode.E_WAL_FAIL)
             self._wal_advanced.notify_all()   # unblock held-back batches
 
             # Raft commit rule: only up to the index THIS request

@@ -65,6 +65,11 @@ class StorageClient:
         self._leaders: Dict[Tuple[int, int], str] = {}  # (space, part) -> host
         # round-robin cursor for leaderless fallback routing
         self._fallback_rr: Dict[Tuple[int, int], int] = {}
+        # host a just-failed RPC invalidated for the part: the fallback
+        # rotation skips it for ONE rotation so the first leaderless
+        # retry never re-dials the peer that just failed (it would when
+        # the cursor happened to land on it — client.py:66-88 fix)
+        self._invalidated: Dict[Tuple[int, int], str] = {}
 
     # ---- partition / leader routing ---------------------------------
     def part_id(self, space_id: int, vid: int) -> int:
@@ -87,16 +92,26 @@ class StorageClient:
         # same dead peers[0]
         with self._leader_lock:
             i = self._fallback_rr.get((space_id, part), 0)
+            pick = peers[i % len(peers)]
+            skipped = self._invalidated.pop((space_id, part), None)
+            if skipped is not None and pick == skipped and len(peers) > 1:
+                # the cursor landed on the host whose failure just
+                # invalidated the cache entry — skip it this rotation
+                i += 1
+                pick = peers[i % len(peers)]
             self._fallback_rr[(space_id, part)] = i + 1
-        return peers[i % len(peers)]
+        return pick
 
     def update_leader(self, space_id: int, part: int, leader: str) -> None:
         with self._leader_lock:
             self._leaders[(space_id, part)] = leader
+            self._invalidated.pop((space_id, part), None)
 
     def invalidate_leader(self, space_id: int, part: int) -> None:
         with self._leader_lock:
-            self._leaders.pop((space_id, part), None)
+            dropped = self._leaders.pop((space_id, part), None)
+            if dropped is not None:
+                self._invalidated[(space_id, part)] = dropped
 
     def cluster_by_part(self, space_id: int, vids: List[int]) -> Dict[int, List[int]]:
         out: Dict[int, List[int]] = {}

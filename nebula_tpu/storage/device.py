@@ -37,13 +37,218 @@ from ..interface.rpc import RpcError
 class TpuDecline(Exception):
     """The device path cannot serve this query — fall back to the CPU
     executor loop.  Raised by both the remote proxy (this module) and
-    the storaged-side runtime (tpu/runtime.py serve_go)."""
+    the storaged-side runtime (tpu/runtime.py serve_go).
+
+    ``degraded=True`` marks declines caused by a device RUNTIME failure
+    or an open circuit breaker (not a semantic can't-serve): the CPU
+    fallback still answers, but executors surface a warning +
+    completeness < 100 so operators see the degradation on the query
+    surface, not only on /metrics (docs/durability.md)."""
+
+    def __init__(self, msg: str = "", degraded: bool = False):
+        super().__init__(msg)
+        self.degraded = degraded
 
 
 class DeviceExecError(Exception):
     """A real query error on the storaged-side device path (schema
     drift mid-query, per-row missing props under graphd WHERE
     semantics) — maps to ExecutionResponse error, NOT a CPU fallback."""
+
+
+# ---------------------------------------------------------------- breaker
+flags.define("tpu_breaker_failures", 3,
+             "consecutive classified device-runtime failures of one "
+             "(space, kernel-class) before its circuit breaker OPENS "
+             "and queries decline straight to the CPU path; 0 disables "
+             "the breaker (docs/durability.md)")
+flags.define("tpu_breaker_open_s", 30.0,
+             "seconds an OPEN device breaker declines before it half-"
+             "opens and lets ONE probe query try the device again")
+
+
+def classify_device_failure(exc: BaseException) -> Optional[str]:
+    """Classify an exception as a device RUNTIME failure, or None.
+
+    tpu/runtime.py historically caught only CompileError; everything the
+    accelerator throws at dispatch/transfer time (jaxlib's
+    XlaRuntimeError, RESOURCE_EXHAUSTED / HBM OOM, transfer failures)
+    escaped as generic exceptions.  This classifier is what feeds the
+    circuit breaker — typed by NAME and message, not by import, so the
+    jax-free graphd daemon can classify a peer's reported failure too.
+    Typed query/control errors (declines, exec errors, deadline/shed)
+    are never device failures."""
+    if isinstance(exc, (TpuDecline, DeviceExecError, DeadlineExceeded)):
+        return None
+    low = str(exc).lower()
+    if "resource_exhausted" in low or "resource exhausted" in low \
+            or "out of memory" in low or "hbm" in low:
+        return "resource_exhausted"
+    if ("transfer" in low or "copy" in low) \
+            and ("fail" in low or "error" in low or "abort" in low):
+        return "transfer"
+    for klass in type(exc).__mro__:
+        if klass.__name__ == "XlaRuntimeError":
+            return "xla_runtime"
+    return None
+
+
+class _BreakerCell:
+    __slots__ = ("state", "fails", "opened_at", "probing", "last_reason")
+
+    def __init__(self):
+        self.state = "closed"
+        self.fails = 0
+        self.opened_at = 0.0
+        self.probing = False
+        self.last_reason = ""
+
+
+class DeviceCircuitBreaker:
+    """Circuit breaker per (space_id, kernel-class) over the device
+    dispatch path (docs/durability.md state machine):
+
+      CLOSED     serving; ``tpu_breaker_failures`` consecutive
+                 classified runtime failures -> OPEN (journal
+                 ``tpu.breaker_open``)
+      OPEN       every admit declines instantly (callers raise
+                 ``TpuDecline(degraded=True)`` -> CPU fallback with the
+                 degradation surfaced); after ``tpu_breaker_open_s``
+                 the next admit half-opens
+      HALF_OPEN  exactly one probe query runs on the device; success
+                 -> CLOSED (``tpu.breaker.reclosed``), failure -> OPEN
+                 with a fresh clock
+
+    The CLOSED check is one dict probe + one attribute compare with no
+    lock (micro_bench recovery_path pins it ≲1 µs/op) — the breaker is
+    off the hot path until something actually fails.  A mirror rebuild
+    (``reset_space``, called from the runtime's publish — the
+    generation-checked seam, like PR 4's ``_upto_declined``) half-opens
+    an OPEN breaker immediately: fresh state deserves a fresh probe."""
+
+    def __init__(self):
+        from ..common.ordered_lock import OrderedLock
+        self._lock = OrderedLock("tpu.breaker")
+        self._cells: Dict[Tuple[int, str], _BreakerCell] = {}
+
+    # ------------------------------------------------------- hot path
+    def admit(self, key: Tuple[int, str]) -> Optional[str]:
+        """None = run on the device (possibly as the half-open probe);
+        a string = decline reason (breaker open)."""
+        cell = self._cells.get(key)
+        if cell is None or cell.state == "closed":
+            return None                      # lock-free fast path
+        from ..common.stats import stats
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None or cell.state == "closed":
+                return None
+            if cell.state == "open":
+                open_s = float(flags.get("tpu_breaker_open_s") or 30.0)
+                if time.monotonic() - cell.opened_at >= open_s:
+                    cell.state = "half_open"
+                    cell.probing = False
+            if cell.state == "half_open" and not cell.probing:
+                cell.probing = True
+                stats.add_value("tpu.breaker.probes")
+                return None                  # this caller IS the probe
+            stats.add_value("tpu.breaker.fast_fail")
+            return (f"device breaker open for {key[1]} on space "
+                    f"{key[0]} ({cell.last_reason})")
+
+    def is_open(self, key: Tuple[int, str]) -> bool:
+        """Non-mutating peek (no probe token consumed): used by the
+        in-process can_run_* gates to route to CPU without paying a
+        plan/mirror attempt against a known-broken device."""
+        cell = self._cells.get(key)
+        if cell is None or cell.state == "closed":
+            return False
+        if cell.state == "open":
+            open_s = float(flags.get("tpu_breaker_open_s") or 30.0)
+            return time.monotonic() - cell.opened_at < open_s
+        return False                         # half-open: let it probe
+
+    # ------------------------------------------------------ accounting
+    def release_probe(self, key: Tuple[int, str]) -> None:
+        """A half-open probe ended WITHOUT exercising the device (a
+        deadline fired first, a semantic decline, a plain query error):
+        hand the token back so the NEXT query probes — but do NOT
+        close the cell (only a real device success proves health) and
+        do NOT clear the consecutive-failure count on closed cells (an
+        unclassified error is neutral, not a device success)."""
+        cell = self._cells.get(key)
+        if cell is None:
+            return
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is not None and cell.state == "half_open":
+                cell.probing = False
+
+    def record_success(self, key: Tuple[int, str]) -> None:
+        cell = self._cells.get(key)
+        if cell is None or (cell.state == "closed" and cell.fails == 0):
+            return                           # hot path: nothing tracked
+        from ..common.stats import stats
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                return
+            reclosed = cell.state != "closed"
+            cell.state = "closed"
+            cell.fails = 0
+            cell.probing = False
+        if reclosed:
+            stats.add_value("tpu.breaker.reclosed")
+
+    def record_failure(self, key: Tuple[int, str], reason: str) -> None:
+        from ..common.events import journal
+        from ..common.stats import stats
+        threshold = int(flags.get("tpu_breaker_failures") or 0)
+        if threshold <= 0:
+            return                           # breaker disabled
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _BreakerCell()
+            cell.fails += 1
+            cell.last_reason = reason
+            opened = False
+            if cell.state == "half_open" \
+                    or (cell.state == "closed" and cell.fails >= threshold):
+                cell.state = "open"
+                cell.opened_at = time.monotonic()
+                cell.probing = False
+                opened = True
+        stats.add_value("tpu.breaker.failures")
+        if opened:
+            stats.add_value("tpu.breaker.opened")
+            # journaled OUTSIDE the breaker lock (events takes its own
+            # leaf lock)
+            journal.record("tpu.breaker_open",
+                           detail=f"{key[1]} on space {key[0]}: {reason}",
+                           space=key[0], kernel_class=key[1],
+                           reason=reason)
+
+    def reset_space(self, space_id: int) -> None:
+        """Generation change (mirror rebuilt over fresh store state —
+        e.g. after a storaged restart re-heartbeats and the runtime
+        republishes): an OPEN breaker half-opens immediately so the
+        next query probes the device against the NEW mirror instead of
+        waiting out the clock; accumulated failure counts clear."""
+        with self._lock:
+            for k, cell in self._cells.items():
+                if k[0] != space_id:
+                    continue
+                if cell.state == "open":
+                    cell.opened_at = 0.0     # next admit half-opens
+                cell.fails = 0
+
+    def cells_snapshot(self) -> List[Tuple[Tuple[int, str], str, str]]:
+        """[(key, state, last_reason)] for /healthz + the metrics
+        collector (tpu.breaker.state gauges)."""
+        with self._lock:
+            return [(k, c.state, c.last_reason)
+                    for k, c in self._cells.items()]
 
 
 class _LedPartStub:
@@ -269,7 +474,11 @@ class RemoteDeviceRuntime:
                                                 "deadline exceeded"))
             if resp.get("error"):
                 raise ExecError(resp["error"])
-            raise TpuDecline(resp.get("reason", "declined"))
+            # a degraded decline (device runtime failure / open breaker
+            # on the storaged) keeps its class across the wire so the
+            # executor's CPU fallback surfaces the degradation
+            raise TpuDecline(resp.get("reason", "declined"),
+                             degraded=bool(resp.get("degraded")))
         return resp
 
     # ------------------------------------------------------------ GO

@@ -420,7 +420,13 @@ class StorageService:
                 upto=bool(req.get("upto", False)))
         except TpuDecline as d:
             stats.add_value("storage.device_decline.qps")
-            return {"ok": False, "reason": str(d)}
+            resp = {"ok": False, "reason": str(d)}
+            if getattr(d, "degraded", False):
+                # breaker-open / runtime-failure declines keep their
+                # class across the wire (storage/device.py _call) so
+                # graphd's CPU fallback surfaces the degradation
+                resp["degraded"] = True
+            return resp
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
         except DeadlineExceeded as e:
@@ -439,10 +445,14 @@ class StorageService:
             # (jax missing/broken, HBM OOM, ...): decline so graphd's
             # CPU per-hop loop still answers the query — but loudly, or
             # a permanently broken device path would be invisible
+            from .device import classify_device_failure
             self._log_device_failure("deviceGo", e)
             stats.add_value("storage.device_decline.qps")
-            return {"ok": False,
+            resp = {"ok": False,
                     "reason": f"device failure: {type(e).__name__}: {e}"}
+            if classify_device_failure(e) is not None:
+                resp["degraded"] = True
+            return resp
         stats.add_value("storage.device_go.qps")
         resp = {"ok": True, "columns": columns, "rows": rows}
         if req.get("upto"):
@@ -467,7 +477,10 @@ class StorageService:
                              for k, v in req["etype_names"].items()})
         except TpuDecline as d:
             stats.add_value("storage.device_decline.qps")
-            return {"ok": False, "reason": str(d)}
+            resp = {"ok": False, "reason": str(d)}
+            if getattr(d, "degraded", False):
+                resp["degraded"] = True
+            return resp
         except DeviceExecError as e:
             return {"ok": False, "error": str(e)}
         except DeadlineExceeded as e:
@@ -479,10 +492,14 @@ class StorageService:
                 resp["shed"] = True
             return resp
         except Exception as e:      # noqa: BLE001 — device-infra failure
+            from .device import classify_device_failure
             self._log_device_failure("deviceFindPath", e)
             stats.add_value("storage.device_decline.qps")
-            return {"ok": False,
+            resp = {"ok": False,
                     "reason": f"device failure: {type(e).__name__}: {e}"}
+            if classify_device_failure(e) is not None:
+                resp["degraded"] = True
+            return resp
         stats.add_value("storage.device_path.qps")
         return {"ok": True, "columns": columns, "rows": rows}
 
@@ -577,6 +594,20 @@ class StorageService:
                     "role": st["role"], "term": st["term"],
                     "committed": st["committed"],
                     "last_log_id": st["last_log_id"]}
+        return out
+
+    def breaker_snapshot(self):
+        """[(key, state, last_reason)] across the attached device
+        runtimes — the /healthz device_breaker check and tests read
+        breaker state through this one seam (docs/durability.md)."""
+        with self._device_rt_lock:
+            rts = [rt for rt in (self._device_rt, self._backend_rt)
+                   if rt is not None]
+        out = []
+        for rt in rts:
+            b = getattr(rt, "breaker", None)
+            if b is not None:
+                out.extend(b.cells_snapshot())
         return out
 
     def device_ready(self) -> bool:

@@ -111,6 +111,20 @@ def _meta_reachable(node):
     return st.ok(), "heartbeat ok" if st.ok() else st.to_string()
 
 
+def _breaker_health(node):
+    """Healthz: no device circuit breaker OPEN.  Queries still answer
+    (CPU fallback) while one is open, but the node is degraded — a 503
+    here lets load balancers prefer device-healthy peers, and the check
+    detail names the open (space, kernel-class) cells so an operator
+    sees WHAT tripped without scraping /metrics (docs/durability.md)."""
+    cells = node.service.breaker_snapshot()
+    opened = [f"space {k[0]}/{k[1]}: {reason or 'repeated failures'}"
+              for k, state, reason in cells if state == "open"]
+    if opened:
+        return False, "device breaker open — " + "; ".join(sorted(opened))
+    return True, f"{len(cells)} breaker cell(s), none open"
+
+
 def _parts_serving(node):
     """Healthz: every hosted partition exists and (when replicated)
     knows a raft leader — a part mid-election or mid-snapshot can't
@@ -144,3 +158,7 @@ def register_web_handlers(ws, node) -> None:
     ws.register_health_check(
         "device", lambda: (node.service.device_ready(),
                            "device runtime ready"))
+    # degradation signal: 503 while a device circuit breaker is OPEN
+    # (queries keep answering via the CPU fallback — docs/durability.md)
+    ws.register_health_check("device_breaker",
+                             lambda: _breaker_health(node))

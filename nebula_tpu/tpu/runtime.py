@@ -270,6 +270,13 @@ class TpuQueryRuntime:
         # have absorbed)
         self._prewarmed_shapes: set = set()
         self._live_shapes: set = set()
+        # device circuit breaker per (space, kernel-class): classified
+        # runtime failures (XlaRuntimeError / RESOURCE_EXHAUSTED /
+        # transfer — storage/device.py classify_device_failure) open it,
+        # open declines go straight to the CPU path as degraded
+        # TpuDeclines, half-open probes re-admit (docs/durability.md)
+        from ..storage.device import DeviceCircuitBreaker
+        self.breaker = DeviceCircuitBreaker()
         # device telemetry for the cluster metrics plane: the counters
         # above export as gauges at scrape time (weak bound method — a
         # discarded runtime unregisters itself), and every batched GO
@@ -312,6 +319,11 @@ class TpuQueryRuntime:
         _stats.set_gauge("tpu.prewarm.hits", snap.get("prewarm_hits", 0))
         _stats.set_gauge("tpu.prewarm.misses",
                          snap.get("prewarm_misses", 0))
+        for key, state, _reason in self.breaker.cells_snapshot():
+            _stats.set_gauge("tpu.breaker.state",
+                             {"closed": 0.0, "half_open": 0.5,
+                              "open": 1.0}.get(state, 1.0),
+                             space=key[0], kernel_class=key[1])
 
     def _tick(self, key: str, t0: float) -> float:
         """Accumulate wall time into a stats bucket; returns now."""
@@ -450,6 +462,11 @@ class TpuQueryRuntime:
                             for s in stores)
         self.stats["mirror_builds"] += 1
         self.mirrors[space_id] = m
+        # a freshly published mirror is a new device generation: an
+        # OPEN breaker half-opens so the next query probes against the
+        # new state instead of waiting out the clock (the PR 4
+        # _upto_declined generation-check stance, docs/durability.md)
+        self.breaker.reset_space(space_id)
         # NOTE: cached kernels are keyed by TABLE SHAPES and take the
         # tables as arguments (ell.py), so they survive mirror
         # rebuilds; only the fused-filter kernels bake mirror-specific
@@ -612,7 +629,15 @@ class TpuQueryRuntime:
         cross-process RPC entry (serve_go)."""
         try:
             m = self.mirror(space_id)
-        except Exception:
+        except Exception as e:      # noqa: BLE001 — build/transfer failed
+            # a classified device failure here (HBM OOM during the
+            # mirror upload, transfer error) feeds the breaker so
+            # repeated failing builds open it instead of every query
+            # re-paying a doomed build
+            from ..storage.device import classify_device_failure
+            reason = classify_device_failure(e)
+            if reason is not None:
+                self.breaker.record_failure((space_id, "go"), reason)
             return None
         filter_cval = None
         filter_used: Dict[str, Tuple] = {}
@@ -640,6 +665,11 @@ class TpuQueryRuntime:
         if flags.get("storage_backend") == "cpu":
             return False
         if has_input:
+            return False
+        if self.breaker.is_open((space_id, "go")):
+            # route to CPU without paying a plan/mirror attempt against
+            # a known-broken device (non-mutating peek: the half-open
+            # probe token is consumed at dispatch, not here)
             return False
         if getattr(sentence.step, "upto", False) \
                 and sentence.step.steps > 1 \
@@ -738,18 +768,49 @@ class TpuQueryRuntime:
         dispatcher (its kernel bakes the query's filter; UPTO keeps
         the dispatcher + host-filter path — the fused kernels have no
         union accumulator)."""
+        from ..storage.device import TpuDecline, classify_device_failure
+        bkey = (space_id, "go")
+        why = self.breaker.admit(bkey)
+        if why is not None:
+            # closed-breaker admit is a dict probe + compare
+            # (micro_bench recovery_path); an OPEN one declines here —
+            # degraded, so the CPU fallback surfaces the state
+            tracing.annotate("tpu.breaker", state="open", space=space_id,
+                             kernel_class="go")
+            raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
-        if plan.filter_cval is not None and not upto \
-                and flags.get("tpu_filter_mode") == "device":
-            return self._execute_fused(space_id, plan, start_vids,
-                                       et_tuple, steps, etype_to_alias,
-                                       yield_cols, distinct, where_expr,
-                                       ExcType)
-        q = _GoQuery(start_vids, plan, yield_cols, distinct, where_expr,
-                     etype_to_alias, ExcType, deadline=deadlines.current())
-        result, _m = self.dispatcher.submit_batched(
-            ("go_batch_execute", space_id, et_tuple, steps, upto), q)
+        try:
+            if plan.filter_cval is not None and not upto \
+                    and flags.get("tpu_filter_mode") == "device":
+                result = self._execute_fused(space_id, plan, start_vids,
+                                             et_tuple, steps,
+                                             etype_to_alias, yield_cols,
+                                             distinct, where_expr,
+                                             ExcType)
+            else:
+                q = _GoQuery(start_vids, plan, yield_cols, distinct,
+                             where_expr, etype_to_alias, ExcType,
+                             deadline=deadlines.current())
+                result, _m = self.dispatcher.submit_batched(
+                    ("go_batch_execute", space_id, et_tuple, steps, upto),
+                    q)
+        except Exception as e:      # noqa: BLE001 — classify, then rethrow
+            reason = classify_device_failure(e)
+            if reason is None:
+                # query/control errors (exec errors, deadline) pass
+                # through — they prove nothing about device health, so
+                # only hand a half-open probe token back (the next
+                # query re-probes); never close the cell on them
+                self.breaker.release_probe(bkey)
+                raise
+            self.breaker.record_failure(bkey, reason)
+            tracing.annotate("tpu.breaker", state="failure",
+                             space=space_id, kernel_class="go",
+                             reason=reason)
+            raise TpuDecline(f"device runtime failure ({reason}): {e}",
+                             degraded=True) from e
+        self.breaker.record_success(bkey)
         return result
 
     # ------------------------------------------------ batch entry point
@@ -2422,9 +2483,15 @@ class TpuQueryRuntime:
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
         if flags.get("storage_backend") == "cpu":
             return False
+        if self.breaker.is_open((space_id, "path")):
+            return False
         try:
             self.mirror(space_id)
-        except Exception:
+        except Exception as e:      # noqa: BLE001 — build/transfer failed
+            from ..storage.device import classify_device_failure
+            reason = classify_device_failure(e)
+            if reason is not None:
+                self.breaker.record_failure((space_id, "path"), reason)
             return False
         return True
 
@@ -2433,8 +2500,15 @@ class TpuQueryRuntime:
                       shortest: bool, etype_names: Dict[int, str]
                       ) -> InterimResult:
         from .ell import INT16_INF
+        from ..storage.device import TpuDecline, classify_device_failure
         if not srcs or not dsts:
             return InterimResult(["path"])
+        bkey = (space_id, "path")
+        why = self.breaker.admit(bkey)
+        if why is not None:
+            tracing.annotate("tpu.breaker", state="open", space=space_id,
+                             kernel_class="path")
+            raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
 
         # --- device half: batched ELL BFS depths, coalesced with any
@@ -2442,9 +2516,22 @@ class TpuQueryRuntime:
         # path uses).  The dispatch's mirror is the single source of
         # truth — evaluating emptiness against a separately fetched
         # mirror could disagree with the one the BFS actually used.
-        d16, m = self.dispatcher.submit_batched(
-            ("bfs_batch_dispatch", space_id, et_tuple, max_steps,
-             shortest), (srcs, dsts))
+        try:
+            d16, m = self.dispatcher.submit_batched(
+                ("bfs_batch_dispatch", space_id, et_tuple, max_steps,
+                 shortest), (srcs, dsts))
+        except Exception as e:      # noqa: BLE001 — classify, rethrow
+            reason = classify_device_failure(e)
+            if reason is None:
+                self.breaker.release_probe(bkey)    # neutral: re-probe
+                raise
+            self.breaker.record_failure(bkey, reason)
+            tracing.annotate("tpu.breaker", state="failure",
+                             space=space_id, kernel_class="path",
+                             reason=reason)
+            raise TpuDecline(f"device runtime failure ({reason}): {e}",
+                             degraded=True) from e
+        self.breaker.record_success(bkey)
         if m.m == 0:
             return InterimResult(["path"])
         depth = np.where(d16 == INT16_INF, kernels.INT32_INF,

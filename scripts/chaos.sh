@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Crash-recovery chaos driver (docs/durability.md).
+#
+# Runs the FULL kill matrix — real SIGKILL'd subprocess daemons
+# (tests/test_proc_chaos.py over tools/proc_cluster.py) plus the
+# wire-level fault-injection chaos suite (tests/test_chaos.py) — under
+# the runtime lock-order watchdog: NEBULA_LOCK_WATCHDOG=1 arms
+# common/ordered_lock.py in THIS process and is inherited by every
+# daemon subprocess ProcCluster spawns, so an inversion inside a
+# recovering storaged fails its scenario too.
+#
+# Usage: scripts/chaos.sh [extra pytest args]
+#   scripts/chaos.sh -k mid_append      # one matrix cell
+#   scripts/chaos.sh -m 'chaos and not slow'   # smoke cells only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export NEBULA_LOCK_WATCHDOG=1
+
+exec python -m pytest tests/test_proc_chaos.py tests/test_chaos.py \
+    tests/test_crash_recovery.py -v -m chaos -p no:cacheprovider "$@"

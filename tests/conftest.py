@@ -54,7 +54,7 @@ import pytest  # noqa: E402
 # (test_raftex.py is excluded: its adaptive-pipelining tests assert
 # sub-millisecond replication RTTs that per-acquire bookkeeping skews)
 _WATCHDOG_FILES = ("test_chaos.py", "test_cluster_replicated.py",
-                   "test_metad_replicated.py")
+                   "test_metad_replicated.py", "test_proc_chaos.py")
 
 
 @pytest.fixture(autouse=True)

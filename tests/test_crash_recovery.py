@@ -1,0 +1,557 @@
+"""Crash-recovery hardening suite (docs/durability.md).
+
+Covers the in-process half of the crash story: the CRC'd WAL v2 format
+(torn tails and bit rot truncate instead of replaying garbage; flush
+failures drop the un-persisted tail and surface a Status), the device
+circuit breaker state machine + its end-to-end surface (degraded
+declines with completeness/warnings, /healthz, events, half-open
+re-admission), and the StorageClient leaderless-fallback regression.
+The multi-PROCESS half (real SIGKILLs) lives in test_proc_chaos.py.
+"""
+import os
+import time
+
+import pytest
+
+from nebula_tpu.common.events import journal
+from nebula_tpu.common.flags import flags
+from nebula_tpu.common.stats import stats
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.kvstore.wal import (FileBasedWal, _HDR, _MAGIC2,
+                                    _frame_crc)
+
+pytestmark = pytest.mark.chaos
+
+
+def _stat(name: str) -> float:
+    return stats.read_stats(f"{name}.sum.60") or 0.0
+
+
+# ============================================================= WAL v2
+class TestWalCrc:
+    def test_v2_roundtrip_and_replay(self, tmp_path):
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 201):
+            assert w.append_log(i, 1 + i // 100, b"payload-%d" % i)
+        assert w.flush().ok()
+        w.close()
+        # new segments carry the v2 magic
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("wal."))
+        with open(tmp_path / segs[0], "rb") as f:
+            assert f.read(len(_MAGIC2)) == _MAGIC2
+        w2 = FileBasedWal(str(tmp_path))
+        assert w2.first_log_id() == 1
+        assert w2.last_log_id() == 200
+        assert w2._find(137).msg == b"payload-137"
+        assert w2.get_term(199) == 2
+        w2.close()
+
+    def test_corrupt_frame_truncates_and_journals(self, tmp_path):
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 101):
+            w.append_log(i, 1, b"m%d" % i)
+        assert w.flush().ok()
+        w.close()
+        seg = next(str(tmp_path / p) for p in os.listdir(tmp_path)
+                   if p.startswith("wal."))
+        data = bytearray(open(seg, "rb").read())
+        flip = len(data) * 6 // 10            # past the magic, mid-log
+        data[flip] ^= 0xFF
+        open(seg, "wb").write(bytes(data))
+        journal.clear_for_tests()
+        before = _stat("recovery.wal_truncated")
+        w2 = FileBasedWal(str(tmp_path))
+        # truncated at the first bad frame: a contiguous verified
+        # prefix survives, NOTHING after the corruption replays
+        assert 0 < w2.last_log_id() < 100
+        for i in range(1, w2.last_log_id() + 1):
+            assert w2._find(i).msg == b"m%d" % i
+        assert _stat("recovery.wal_truncated") > before
+        evs = [e for e in journal.dump() if e["kind"] == "wal.truncated"]
+        assert evs and evs[0]["dropped_bytes"] > 0
+        # the file was PHYSICALLY cut: appends chain cleanly and a
+        # third load agrees with the second
+        nxt = w2.last_log_id() + 1
+        assert w2.append_log(nxt, 9, b"after-repair")
+        assert w2.flush().ok()
+        w2.close()
+        w3 = FileBasedWal(str(tmp_path))
+        assert w3.last_log_id() == nxt
+        assert w3._find(nxt).msg == b"after-repair"
+        assert w3.get_term(nxt) == 9
+        w3.close()
+
+    def test_corruption_drops_later_segments(self, tmp_path):
+        """Frames after a bad one are not contiguous with the verified
+        prefix — recovery must delete LATER segment files too, or a
+        stale segment would shadow the re-appends of the same ids on
+        the next load."""
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 51):
+            w.append_log(i, 1, b"a%d" % i)
+        assert w.flush().ok()
+        # force a second segment by faking a full first one
+        w._cur_seg_bytes = 64 * 1024 * 1024
+        for i in range(51, 101):
+            w.append_log(i, 1, b"b%d" % i)
+        assert w.flush().ok()
+        w.close()
+        # numeric sort — segment names are wal.<firstId>.log
+        segs = sorted((p for p in os.listdir(tmp_path)
+                       if p.startswith("wal.")),
+                      key=lambda p: int(p[4:-4]))
+        assert len(segs) == 2
+        data = bytearray(open(tmp_path / segs[0], "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(tmp_path / segs[0], "wb").write(bytes(data))
+        journal.clear_for_tests()
+        w2 = FileBasedWal(str(tmp_path))
+        assert 0 < w2.last_log_id() < 50
+        w2.close()
+        left = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("wal."))
+        assert segs[1] not in left
+
+    def test_torn_tail_truncates_cleanly(self, tmp_path):
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 21):
+            w.append_log(i, 1, b"x" * 100)
+        assert w.flush().ok()
+        w.close()
+        seg = next(str(tmp_path / p) for p in os.listdir(tmp_path)
+                   if p.startswith("wal."))
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 37)             # tear the last frame
+        journal.clear_for_tests()
+        w2 = FileBasedWal(str(tmp_path))
+        assert w2.last_log_id() == 19
+        assert any(e["kind"] == "wal.truncated" for e in journal.dump())
+        assert w2.append_log(20, 2, b"rewrite")
+        assert w2.flush().ok()
+        w2.close()
+        w3 = FileBasedWal(str(tmp_path))
+        assert w3._find(20).msg == b"rewrite" and w3.get_term(20) == 2
+        w3.close()
+
+    def test_v1_segment_backward_compat_and_rotation(self, tmp_path):
+        """A crc-less legacy segment replays (reader compat) and the
+        first flush ROTATES to a fresh v2 segment rather than mixing
+        frame formats in one file."""
+        with open(tmp_path / "wal.1.log", "wb") as f:
+            for i in range(1, 11):
+                msg = b"legacy-%d" % i
+                f.write(_HDR.pack(i, 3, len(msg)))
+                f.write(msg)
+        w = FileBasedWal(str(tmp_path))
+        assert w.last_log_id() == 10
+        assert w._find(4).msg == b"legacy-4" and w.get_term(4) == 3
+        assert w.append_log(11, 3, b"fresh")
+        assert w.flush().ok()
+        w.close()
+        segs = sorted(p for p in os.listdir(tmp_path)
+                      if p.startswith("wal."))
+        assert len(segs) == 2
+        with open(tmp_path / segs[0], "rb") as f:
+            assert f.read(len(_MAGIC2)) != _MAGIC2      # legacy untouched
+        with open(tmp_path / segs[1], "rb") as f:
+            assert f.read(len(_MAGIC2)) == _MAGIC2      # new one is v2
+        w2 = FileBasedWal(str(tmp_path))
+        assert w2.last_log_id() == 11 and w2._find(11).msg == b"fresh"
+        w2.close()
+
+    def test_flush_failure_drops_tail_and_surfaces_status(self, tmp_path,
+                                                          monkeypatch):
+        """Satellite: an exception mid-flush must not leave buffered
+        frames acked in the tail map — the un-persisted tail drops, the
+        Status says so, and disk/memory agree afterwards."""
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 6):
+            w.append_log(i, 1, b"durable")
+        assert w.flush().ok()
+        w.append_log(6, 1, b"doomed")
+        w.append_log(7, 1, b"doomed-too")
+        before = _stat("recovery.wal_flush_failed")
+
+        def enospc(fd, data):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(os, "write", enospc)
+        st = w.flush()
+        monkeypatch.undo()
+        assert not st.ok()
+        assert st.code == ErrorCode.E_WAL_FAIL
+        # the tail map no longer claims the entries the disk refused
+        assert w.last_log_id() == 5
+        assert w._find(6) is None
+        assert _stat("recovery.wal_flush_failed") > before
+        # recovery of the writer: same ids re-append and persist
+        assert w.append_log(6, 2, b"retried")
+        assert w.flush().ok()
+        w.close()
+        w2 = FileBasedWal(str(tmp_path))
+        assert w2.last_log_id() == 6
+        assert w2._find(6).msg == b"retried" and w2.get_term(6) == 2
+        w2.close()
+
+    def test_raft_append_fails_cleanly_on_wal_failure(self, tmp_path,
+                                                      monkeypatch):
+        """The raft driver must FAIL the batch (typed status, waiter
+        woken) when the WAL refuses the flush — never ack, never hang,
+        and keep serving once the disk heals."""
+        import concurrent.futures
+        from nebula_tpu.raftex.raft_part import RaftPart
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        part = RaftPart(1, 1, "127.0.0.1:1", [], None, pool,
+                        wal_dir=str(tmp_path))
+        try:
+            assert part.append_async(b"healthy").ok()
+
+            def enospc(fd, data):
+                raise OSError(28, "No space left on device")
+
+            monkeypatch.setattr(os, "write", enospc)
+            st = part.append_async(b"doomed")
+            monkeypatch.undo()
+            assert not st.ok()
+            assert st.code == ErrorCode.E_WAL_FAIL
+            # the disk healed: appends serve again and the log is
+            # exactly the acked set
+            assert part.append_async(b"healed").ok()
+            msgs = [e.msg for e in part.wal.iterate(1)]
+            assert b"doomed" not in msgs and b"healed" in msgs
+        finally:
+            part.stop()
+            pool.shutdown(wait=False)
+
+    def test_rollback_rewrites_with_crc(self, tmp_path):
+        w = FileBasedWal(str(tmp_path))
+        for i in range(1, 31):
+            w.append_log(i, 1, b"r%d" % i)
+        assert w.flush().ok()
+        assert w.rollback_to_log(12)
+        assert w.last_log_id() == 12
+        for i in range(13, 18):
+            w.append_log(i, 4, b"n%d" % i)
+        assert w.flush().ok()
+        w.close()
+        # the rewritten segment is v2 and replays exactly
+        for p in sorted(os.listdir(tmp_path)):
+            if p.startswith("wal."):
+                with open(tmp_path / p, "rb") as f:
+                    assert f.read(len(_MAGIC2)) == _MAGIC2
+        w2 = FileBasedWal(str(tmp_path))
+        assert w2.last_log_id() == 17
+        assert w2.get_term(12) == 1 and w2.get_term(13) == 4
+        assert w2._find(15).msg == b"n15"
+        w2.close()
+
+    def test_frame_crc_covers_header_fields(self):
+        # flipping ANY header field must invalidate the crc, not just
+        # the payload bytes
+        c = _frame_crc(5, 2, b"msg")
+        assert c != _frame_crc(6, 2, b"msg")
+        assert c != _frame_crc(5, 3, b"msg")
+        assert c != _frame_crc(5, 2, b"msG")
+
+
+# ===================================================== breaker unit
+class TestDeviceBreakerUnit:
+    def _mk(self):
+        from nebula_tpu.storage.device import DeviceCircuitBreaker
+        return DeviceCircuitBreaker()
+
+    @pytest.fixture(autouse=True)
+    def _fast_breaker(self):
+        saved = (flags.get("tpu_breaker_failures"),
+                 flags.get("tpu_breaker_open_s"))
+        flags.set("tpu_breaker_failures", 3)
+        flags.set("tpu_breaker_open_s", 0.15)
+        yield
+        flags.set("tpu_breaker_failures", saved[0])
+        flags.set("tpu_breaker_open_s", saved[1])
+
+    def test_opens_after_threshold_and_fast_fails(self):
+        b = self._mk()
+        key = (7, "go")
+        journal.clear_for_tests()
+        assert b.admit(key) is None
+        for _ in range(2):
+            b.record_failure(key, "xla_runtime")
+            assert b.admit(key) is None         # still closed
+        b.record_failure(key, "xla_runtime")    # third: opens
+        why = b.admit(key)
+        assert why is not None and "breaker open" in why
+        assert any(e["kind"] == "tpu.breaker_open"
+                   for e in journal.dump())
+        assert [s for k, s, _ in b.cells_snapshot() if k == key] == ["open"]
+
+    def test_half_open_single_probe_then_reclose(self):
+        b = self._mk()
+        key = (7, "go")
+        for _ in range(3):
+            b.record_failure(key, "transfer")
+        assert b.admit(key) is not None
+        time.sleep(0.2)                         # open window elapses
+        assert b.admit(key) is None             # THE probe
+        assert b.admit(key) is not None         # everyone else declines
+        b.record_success(key)                   # probe succeeded
+        assert b.admit(key) is None
+        assert [s for k, s, _ in b.cells_snapshot()
+                if k == key] == ["closed"]
+
+    def test_probe_release_keeps_half_open(self):
+        """A probe that ends in an UNCLASSIFIED error (deadline, plain
+        query bug) proves nothing about device health: the token goes
+        back, the NEXT query probes, and the cell must not close (a
+        still-broken device would otherwise take full traffic again)."""
+        b = self._mk()
+        key = (7, "go")
+        for _ in range(3):
+            b.record_failure(key, "xla_runtime")
+        time.sleep(0.2)
+        assert b.admit(key) is None             # probe handed out
+        b.release_probe(key)                    # ...ended inconclusively
+        assert [s for k, s, _ in b.cells_snapshot()
+                if k == key] == ["half_open"]
+        assert b.admit(key) is None             # next query re-probes
+        b.record_failure(key, "xla_runtime")    # and a real failure
+        assert b.admit(key) is not None         # re-opens
+
+    def test_release_probe_does_not_clear_failure_streak(self):
+        b = self._mk()
+        key = (8, "go")
+        b.record_failure(key, "transfer")
+        b.record_failure(key, "transfer")
+        b.release_probe(key)                    # neutral on closed cells
+        b.record_failure(key, "transfer")       # third consecutive
+        assert b.admit(key) is not None         # opened
+
+    def test_half_open_probe_failure_reopens(self):
+        b = self._mk()
+        key = (7, "path")
+        for _ in range(3):
+            b.record_failure(key, "resource_exhausted")
+        time.sleep(0.2)
+        assert b.admit(key) is None             # probe admitted
+        b.record_failure(key, "resource_exhausted")
+        assert b.admit(key) is not None         # straight back to open
+
+    def test_success_resets_consecutive_count(self):
+        b = self._mk()
+        key = (1, "go")
+        b.record_failure(key, "transfer")
+        b.record_failure(key, "transfer")
+        b.record_success(key)
+        b.record_failure(key, "transfer")
+        b.record_failure(key, "transfer")
+        assert b.admit(key) is None             # never hit 3 in a row
+
+    def test_reset_space_half_opens_immediately(self):
+        b = self._mk()
+        key = (3, "go")
+        for _ in range(3):
+            b.record_failure(key, "xla_runtime")
+        assert b.admit(key) is not None
+        b.reset_space(3)                        # mirror republished
+        assert b.admit(key) is None             # probes NOW, no clock wait
+        b.record_success(key)
+        assert not b.is_open(key)
+
+    def test_threshold_zero_disables(self):
+        b = self._mk()
+        flags.set("tpu_breaker_failures", 0)
+        for _ in range(10):
+            b.record_failure((9, "go"), "xla_runtime")
+        assert b.admit((9, "go")) is None
+
+    def test_keys_are_independent(self):
+        b = self._mk()
+        for _ in range(3):
+            b.record_failure((1, "go"), "xla_runtime")
+        assert b.admit((1, "go")) is not None
+        assert b.admit((1, "path")) is None
+        assert b.admit((2, "go")) is None
+
+
+class TestClassifier:
+    def test_classifies_runtime_failures(self):
+        from nebula_tpu.storage.device import classify_device_failure
+
+        class XlaRuntimeError(Exception):
+            pass
+
+        assert classify_device_failure(
+            XlaRuntimeError("INTERNAL: something")) == "xla_runtime"
+        assert classify_device_failure(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                         "1.2G in HBM")) == "resource_exhausted"
+        assert classify_device_failure(
+            RuntimeError("device transfer failed mid-copy")) == "transfer"
+
+    def test_typed_control_errors_pass_through(self):
+        from nebula_tpu.common.deadline import DeadlineExceeded
+        from nebula_tpu.storage.device import (DeviceExecError, TpuDecline,
+                                               classify_device_failure)
+        assert classify_device_failure(TpuDecline("nope")) is None
+        assert classify_device_failure(DeviceExecError("bad expr")) is None
+        assert classify_device_failure(DeadlineExceeded("late")) is None
+        assert classify_device_failure(ValueError("plain bug")) is None
+
+
+# ====================================================== breaker e2e
+class TestDeviceBreakerE2E:
+    def test_runtime_failure_opens_breaker_cpu_serves_probe_readmits(self):
+        """Acceptance: a fault-injected device runtime failure opens
+        the breaker (metric + event + /healthz visible), queries keep
+        answering via the CPU fallback with completeness < 100 and a
+        warning surfaced, and a half-open probe restores device serving
+        without a daemon restart."""
+        import json
+        import urllib.error
+        import urllib.request
+        from nebula_tpu.cluster import LocalCluster
+        from nebula_tpu.storage.web import register_web_handlers
+        from nebula_tpu.webservice import WebService
+        saved = (flags.get("tpu_breaker_failures"),
+                 flags.get("tpu_breaker_open_s"))
+        flags.set("tpu_breaker_failures", 2)
+        flags.set("tpu_breaker_open_s", 30.0)
+        c = LocalCluster(num_storage=1, tpu_backend="remote")
+        cl = c.client()
+        ws = None
+        try:
+            def ok(stmt):
+                r = cl.execute(stmt)
+                assert r.ok(), f"{stmt}: {r.error_msg}"
+                return r
+
+            ok("CREATE SPACE brk(partition_num=2, replica_factor=1)")
+            c.refresh_all()
+            ok("USE brk")
+            ok("CREATE EDGE e(w int)")
+            c.refresh_all()
+            ok("INSERT EDGE e(w) VALUES 1->2:(5), 2->3:(6), 1->3:(7)")
+            q = "GO 2 STEPS FROM 1 OVER e YIELD e._dst"
+            expect = sorted(x[0] for x in ok(q).rows)
+            svc = c.storage_nodes[0].service
+            rt = svc._device_rt
+            assert rt is not None, "device runtime never attached"
+
+            class XlaRuntimeError(Exception):
+                pass
+
+            real = rt.go_batch_execute
+
+            def boom(*a, **k):
+                raise XlaRuntimeError(
+                    "RESOURCE_EXHAUSTED: out of memory in HBM")
+
+            rt.go_batch_execute = boom
+            journal.clear_for_tests()
+            opened_before = _stat("tpu.breaker.opened")
+            for _ in range(3):
+                r = ok(q)
+                # the CPU fallback keeps answering, degraded-marked
+                assert sorted(x[0] for x in r.rows) == expect
+                assert r.completeness == 99
+                assert r.warnings and "degraded" in r.warnings[0]
+            assert any(s == "open" for _k, s, _r in svc.breaker_snapshot())
+            assert _stat("tpu.breaker.opened") > opened_before
+            assert any(e["kind"] == "tpu.breaker_open"
+                       for e in journal.dump())
+
+            # /healthz flips 503 with the open cell named
+            ws = WebService("storaged-test").start()
+            register_web_handlers(ws, c.storage_nodes[0])
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{ws.port}/healthz")
+            assert ei.value.code == 503
+            body = json.load(ei.value)
+            assert not body["checks"]["device_breaker"]["ok"]
+            assert "breaker open" in \
+                body["checks"]["device_breaker"]["detail"]
+
+            # heal the device; the half-open probe re-admits WITHOUT a
+            # daemon restart
+            rt.go_batch_execute = real
+            flags.set("tpu_breaker_open_s", 0.05)
+            time.sleep(0.1)
+            r = ok(q)
+            assert sorted(x[0] for x in r.rows) == expect
+            assert r.completeness == 100 and not r.warnings
+            assert all(s == "closed"
+                       for _k, s, _r in svc.breaker_snapshot())
+            assert _stat("tpu.breaker.reclosed") > 0
+            got = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{ws.port}/healthz"))
+            assert got["checks"]["device_breaker"]["ok"]
+        finally:
+            if ws is not None:
+                ws.stop()
+            flags.set("tpu_breaker_failures", saved[0])
+            flags.set("tpu_breaker_open_s", saved[1])
+            cl.disconnect()
+            c.stop()
+
+
+# ============================================ client fallback regression
+class TestLeaderlessFallbackSkip:
+    class _Meta:
+        """Stub meta client: one space, one part, two replicas."""
+
+        def __init__(self, peers):
+            self._peers = peers
+
+        def part_num(self, space_id):
+            return 1
+
+        def parts_alloc(self, space_id):
+            return {0: list(self._peers)}
+
+    def test_fallback_skips_just_invalidated_host(self):
+        """Satellite regression (client.py:66-88): after
+        invalidate_leader(X) the round-robin fallback must NOT re-dial
+        X first — whatever the cursor position, the first leaderless
+        pick after an invalidation lands on a DIFFERENT replica."""
+        from nebula_tpu.storage.client import StorageClient
+        peers = ["hostA:1", "hostB:1"]
+        for spin in range(2):       # either cursor parity
+            sc = StorageClient(self._Meta(peers))
+            try:
+                for _ in range(spin):
+                    sc._leader_for(1, 0)        # advance the cursor
+                dead = sc._leader_for(1, 0)     # the host that will fail
+                sc.update_leader(1, 0, dead)
+                assert sc._leader_for(1, 0) == dead      # cached
+                sc.invalidate_leader(1, 0)
+                first_retry = sc._leader_for(1, 0)
+                assert first_retry != dead, (
+                    f"spin={spin}: re-dialed the just-invalidated host")
+            finally:
+                sc.pool.shutdown(wait=False)
+
+    def test_update_leader_clears_the_skip(self):
+        from nebula_tpu.storage.client import StorageClient
+        sc = StorageClient(self._Meta(["hostA:1", "hostB:1"]))
+        try:
+            sc.update_leader(1, 0, "hostA:1")
+            sc.invalidate_leader(1, 0)
+            sc.update_leader(1, 0, "hostA:1")   # a hint re-elected it
+            assert sc._leader_for(1, 0) == "hostA:1"
+        finally:
+            sc.pool.shutdown(wait=False)
+
+    def test_single_replica_never_starves(self):
+        from nebula_tpu.storage.client import StorageClient
+        sc = StorageClient(self._Meta(["only:1"]))
+        try:
+            sc.update_leader(1, 0, "only:1")
+            sc.invalidate_leader(1, 0)
+            # nothing else to dial: the lone replica must still be
+            # returned (skipping it would mean no route at all)
+            assert sc._leader_for(1, 0) == "only:1"
+        finally:
+            sc.pool.shutdown(wait=False)

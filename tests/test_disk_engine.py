@@ -347,6 +347,79 @@ def test_kill9_storaged_recovers_acked_writes(tmp_path):
                              stdin=subprocess.DEVNULL).wait(timeout=60)
 
 
+class TestKillMidCompaction:
+    """Kill-anywhere atomicity of the compaction commit point
+    (docs/durability.md): a SIGKILL landing between the merged run's
+    sstable write and the MANIFEST replace must recover to the
+    PRE-compaction view — the orphan run is swept, nothing is lost,
+    nothing half-applies.  Extends the torn-frame ingest guards; the
+    real-SIGKILL companion lives in tests/test_proc_chaos.py."""
+
+    def _seed(self, d, runs=4):
+        e = DiskEngine(d, mem_limit_bytes=1 << 30,
+                       compact_after_runs=1 << 30)   # manual control
+        for r in range(runs):
+            for i in range(25):
+                e.put(b"k%03d" % (r * 25 + i), b"v%d" % r)
+            e.put(b"shadow", b"gen%d" % r)           # rewritten each run
+            e.flush_memtable()
+        e.remove(b"k000")                            # a tombstone too
+        e.flush_memtable()
+        return e
+
+    def test_die_between_run_write_and_manifest_commit(self, tmp_path):
+        d = str(tmp_path / "e")
+        e = self._seed(d)
+        n_runs = len(e._runs)
+        assert n_runs >= 5
+        before = dict(e._merged(b""))
+
+        # the compaction's merged run hits disk exactly like
+        # _compact_offline writes it — then the process "dies" before
+        # _commit_manifest: the run file exists, the MANIFEST does not
+        # reference it
+        def survivors():
+            from nebula_tpu.kvstore.disk_engine import (_TOMBSTONE,
+                                                        _merge_sources)
+            sources = [r.scan(b"") for r in reversed(e._runs)]
+            for k, v in _merge_sources(sources):
+                if v is _TOMBSTONE:
+                    continue
+                yield k, v
+
+        orphan = e._write_run(survivors())
+        assert orphan is not None
+        orphan_name = os.path.basename(orphan.path)
+        assert os.path.exists(orphan.path)
+        del orphan          # close the fd — the "killed" process's view
+
+        # reopen the directory (the restart): pre-compaction view,
+        # orphan swept
+        e2 = DiskEngine(d)
+        assert dict(e2._merged(b"")) == before
+        assert e2.get(b"k000") is None               # tombstone honored
+        assert e2.get(b"shadow") == b"gen3"          # newest run wins
+        assert not os.path.exists(os.path.join(d, orphan_name)), \
+            "orphan compaction run not swept on recovery"
+        listed = sorted(os.path.basename(r.path) for r in e2._runs)
+        assert orphan_name not in listed
+        e2.close()
+
+    def test_committed_compaction_survives_reopen(self, tmp_path):
+        """Control cell: the same sequence WITH the manifest commit
+        recovers to the post-compaction view."""
+        d = str(tmp_path / "e")
+        e = self._seed(d)
+        before = dict(e._merged(b""))
+        assert e.compact().ok()
+        assert len(e._runs) == 1
+        e.close()
+        e2 = DiskEngine(d)
+        assert len(e2._runs) == 1
+        assert dict(e2._merged(b"")) == before
+        e2.close()
+
+
 class TestBatchAtomicity:
     def test_auto_compaction_bounds_run_count(self, tmp_path):
         # compaction runs on a BACKGROUND thread (the flush happens on

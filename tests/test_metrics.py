@@ -364,7 +364,9 @@ class TestHealthz:
         resp = _get(webservices["storaged"], "/healthz")
         body = json.load(resp)
         assert resp.status == 200 and body["healthy"] is True
-        assert set(body["checks"]) == {"meta", "parts", "device"}
+        assert set(body["checks"]) == {"meta", "parts", "device",
+                                       "device_breaker"}
+        assert body["checks"]["device_breaker"]["ok"]
 
     def test_no_checks_means_bare_liveness(self, webservices):
         resp = _get(webservices["graphd"], "/healthz")
