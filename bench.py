@@ -278,10 +278,25 @@ def main():
                           "sparse_overflows", "mirror_builds",
                           "prewarm_compiled", "prewarm_hits",
                           "prewarm_misses",
-                          "t_launch_s", "t_fetch_s", "t_assemble_s")}
+                          "t_launch_s", "t_fetch_s", "t_assemble_s",
+                          "t_device_s", "device_bytes_moved",
+                          "device_timed_dispatches", "fetch_bytes")}
         runtime_stats.update({k: rt.dispatcher.stats.get(k, 0) for k in
                               ("batches", "batched_queries", "max_batch",
                                "query_errors")})
+        # roofline columns (docs/roofline.md): sampled device-compute
+        # mean + achieved HBM GB/s under the dense_hop_bytes model,
+        # distinct from the link RTT probed above
+        timed = rt.stats.get("device_timed_dispatches", 0)
+        t_dev = rt.stats.get("t_device_s", 0.0)
+        runtime_stats["device_compute_ms_mean"] = \
+            round(t_dev / timed * 1e3, 3) if timed else None
+        runtime_stats["achieved_hbm_gbps"] = \
+            round(rt.stats.get("device_bytes_moved", 0) / t_dev / 1e9,
+                  3) if t_dev > 0 else None
+        runtime_stats["fetch_bytes_per_query"] = \
+            round(rt.stats.get("fetch_bytes", 0)
+                  / max(rt.stats.get("go_device", 1), 1), 1)
     finally:
         flags.set("storage_backend", "tpu")
         flags.set("flat_bound_mode", True)

@@ -109,6 +109,12 @@ METRIC_NAMES = (
     "tpu.prewarm.hits",
     "tpu.prewarm.misses",
     "tpu.dispatch.latency_us",
+    # roofline accounting (tpu/runtime.py collector, docs/roofline.md):
+    # sampled device-compute latency distinct from link RTT, achieved
+    # HBM GB/s under the dense_hop_bytes model, cumulative fetch bytes
+    "tpu.device_compute.latency_us",
+    "tpu.roofline.achieved_gbps",
+    "tpu.fetch.bytes",
     # device circuit breaker (tpu/runtime.py + storage/device.py,
     # docs/durability.md): opened/reclosed transitions, classified
     # runtime failures, fast-path declines while open, half-open

@@ -278,10 +278,21 @@ class GoBatchDispatcher:
         """Per-query-class priority (lower = sooner): cheap 1-hop GO
         ahead of multi-hop GO ahead of FIND PATH BFS — interactive
         short reads keep their latency while deep traversals absorb
-        the queueing (docs/admission.md)."""
+        the queueing (docs/admission.md).
+
+        GO keys are (method, space, OVER set, steps, upto, reduce):
+        the REDUCE descriptor — ("limit", n) / ("count",) / None, the
+        LIMIT/COUNT pushdown's per-query result cap — rides the shape
+        key so queries sharing a reduction batch into ONE reduced
+        device dispatch and never mix with full-fetch traffic whose
+        wire shape (and kernel) differs (docs/roofline.md).  A reduced
+        query ranks with the 1-hop class: its fetch is a few hundred
+        bytes, so it clears the pipeline fastest."""
         method = key[0]
         if method == "go_batch_execute":
             steps = key[3] if len(key) > 3 else 1
+            if len(key) > 5 and key[5] is not None:
+                return 0             # reduced fetch: interactive class
             try:
                 return 0 if int(steps) <= 1 else 1
             except (TypeError, ValueError):

@@ -52,6 +52,11 @@ class ExecutionContext:
         # adaptive device-vs-CPU router (graph/backend_router.py),
         # engine-scoped so estimates persist across queries
         self.router = router
+        # pipe-reduction hint (traverse.PipeExecutor → GoExecutor):
+        # ("limit", n) / ("count",) when the enclosing pipe can consume
+        # a device-reduced GO result (LIMIT/COUNT pushdown — fetch
+        # returns only surviving/reduced rows, docs/roofline.md)
+        self.go_reduce = None
 
     def note_partial(self, resp) -> None:
         """Record a degraded StorageRpcResponse (reference

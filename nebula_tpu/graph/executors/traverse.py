@@ -294,6 +294,10 @@ class GoExecutor(Executor):
         prefer_device = True
         if rt is not None and router is not None:
             prefer_device = router.choose(route_key) == "device"
+        # pipe-reduction hint (PipeExecutor._try_reduced_pipe): only
+        # meaningful on the device path — the CPU loop below ignores it
+        # and serves full rows, which the fused pipe handles identically
+        reduce = self.ectx.go_reduce
         if rt is not None and prefer_device \
                 and rt.can_run_go(space, etypes, s, pushed, remnant,
                                   src_refs, dst_refs,
@@ -303,7 +307,7 @@ class GoExecutor(Executor):
                 out = rt.run_go(self, space, start_vids, etypes, steps,
                                 etype_to_alias, yield_cols, distinct,
                                 where_expr, edge_props, vertex_props,
-                                upto=upto)
+                                upto=upto, reduce=reduce)
                 if router is not None:
                     router.record(route_key, "device",
                                   time.perf_counter() - t0)
@@ -951,6 +955,45 @@ class SetExecutor(Executor):
                              [r for r in left.rows if tuple(r) in keep])
 
 
+def _go_reduce_shape(left, right):
+    """-> ("limit", cap) | ("count", col_name) | None: the GO|LIMIT and
+    GO|YIELD COUNT(*) pipe shapes whose result the device can REDUCE
+    before the fetch (ROADMAP item 2 pushdown).  The gate is
+    conservative: the left GO must be unable to raise per-row errors
+    (meta-only YIELD columns — _dst/_src/_rank/_type never error — no
+    WHERE, no DISTINCT, no UPTO), because a truncated/counted result
+    would skip rows whose evaluation the CPU path would have failed
+    on."""
+    if not isinstance(left, ast.GoSentence):
+        return None
+    if left.where is not None:
+        return None
+    if getattr(left.step, "upto", False) and left.step.steps > 1:
+        return None
+    if left.yield_ is not None:
+        if left.yield_.distinct:
+            return None
+        for c in left.yield_.columns:
+            if not isinstance(c.expr, (EdgeDstIdExpr, EdgeSrcIdExpr,
+                                       EdgeRankExpr, EdgeTypeExpr)):
+                return None
+    if isinstance(right, ast.LimitSentence):
+        if right.count < 0 or right.offset < 0:
+            return None
+        return ("limit", right.offset + right.count)
+    if isinstance(right, ast.YieldSentence):
+        if right.where is not None or right.yield_.distinct:
+            return None
+        cols = right.yield_.columns
+        if len(cols) != 1:
+            return None
+        e = cols[0].expr
+        if isinstance(e, FunctionCallExpr) and e.name.lower() == "count" \
+                and not e.args:
+            return ("count", cols[0].alias or default_col_name(e))
+    return None
+
+
 class PipeExecutor(Executor):
     NAME = "PipeExecutor"
 
@@ -961,6 +1004,9 @@ class PipeExecutor(Executor):
         # enclosing pipe's input)
         from . import make_executor, traced_execute
         s: ast.PipedSentence = self.sentence
+        fused = self._try_reduced_pipe(s)
+        if fused is not None:
+            return fused
         left = traced_execute(make_executor(s.left, self.ectx),
                               self.ectx)
         saved = self.ectx.input
@@ -970,6 +1016,43 @@ class PipeExecutor(Executor):
                                   self.ectx)
         finally:
             self.ectx.input = saved
+
+    def _try_reduced_pipe(self, s) -> Optional[InterimResult]:
+        """GO|LIMIT / GO|YIELD COUNT(*) fusion: run the left GO with a
+        reduction hint so the device fetch carries only the
+        surviving/reduced rows, then finish the pipe inline.  When the
+        GO served on the CPU path instead (decline, has_input, router)
+        the hint was ignored and the FULL rows arrive — the same
+        slice/count below is then plain pipe semantics.  COUNT values
+        are route-independent; a device-cut LIMIT may pick a DIFFERENT
+        (deterministic) subset than the CPU path's first rows — the
+        unordered cut LIMIT-without-ORDER-BY permits (row count and
+        membership in the full result always hold; docs/roofline.md)."""
+        from . import make_executor, traced_execute
+        shape = _go_reduce_shape(s.left, s.right)
+        if shape is None or self.ectx.tpu_runtime is None:
+            return None
+        kind = shape[0]
+        saved_hint = self.ectx.go_reduce
+        self.ectx.go_reduce = ("limit", int(shape[1])) \
+            if kind == "limit" else ("count",)
+        try:
+            left = traced_execute(make_executor(s.left, self.ectx),
+                                  self.ectx)
+        finally:
+            self.ectx.go_reduce = saved_hint
+        left = left if left is not None else InterimResult([])
+        if kind == "limit":
+            lo = s.right.offset
+            hi = lo + s.right.count
+            return InterimResult(left.columns, left.rows[lo:hi])
+        if getattr(left, "reduced", None) == ("count",):
+            total = int(left.rows[0][0]) if left.rows else 0
+        else:
+            total = len(left.rows)
+        # CPU-path parity: YIELD COUNT(*) over ZERO input rows yields
+        # zero groups, hence zero rows (_aggregate_rows)
+        return InterimResult([shape[1]], [[total]] if total else [])
 
 
 class AssignmentExecutor(Executor):

@@ -202,12 +202,16 @@ def rows_from_wire(rows):
 
 
 class InterimResult:
-    __slots__ = ("columns", "rows", "_index")
+    __slots__ = ("columns", "rows", "_index", "reduced")
 
     def __init__(self, columns: List[str], rows: Optional[List[List[Value]]] = None):
         self.columns = list(columns)
         self.rows = rows if rows is not None else []
         self._index: Optional[Dict[str, int]] = None
+        # set by the device runtime when a pipe reduction (COUNT/LIMIT
+        # pushdown) was applied on device — the fused-pipe helper in
+        # traverse.py keys off it (None = full rows)
+        self.reduced = None
 
     # ---- column access ----------------------------------------------
     def col_index(self, name: str) -> int:

@@ -1159,6 +1159,13 @@ class _Parser:
     def p_name_expr(self) -> Expression:
         name = self.expect_id("name")
         if self.accept_sym("("):
+            # COUNT(*): the canonical aggregate spelling — equivalent
+            # to the no-arg form (one tally per input row,
+            # _aggregate_rows).  COUNT only: SUM(*)/AVG(*) have no
+            # defined meaning and must stay parse errors
+            if name.lower() == "count" and self.accept_sym("*"):
+                self.expect_sym(")")
+                return FunctionCallExpr(name, [])
             args: List[Expression] = []
             if not self.at_sym(")"):
                 while True:

@@ -508,7 +508,7 @@ class RemoteDeviceRuntime:
                etypes: List[int], steps: int,
                etype_to_alias: Dict[int, str], yield_cols, distinct: bool,
                where_expr, edge_props, vertex_props,
-               upto: bool = False) -> InterimResult:
+               upto: bool = False, reduce=None) -> InterimResult:
         from ..graph.executors.base import ExecError
 
         pushed_mode, placement = self._stash.pop(
@@ -537,6 +537,15 @@ class RemoteDeviceRuntime:
             "pushed_mode": pushed_mode,
             "upto": bool(upto),
         }
+        if reduce is not None:
+            # LIMIT/COUNT pushdown: the storaged's device runtime cuts
+            # the result BEFORE the fetch and the response carries only
+            # surviving/reduced rows; an older build ignores the field
+            # and serves full rows — correct either way (the fused pipe
+            # slices/counts full rows identically), so no echo gate is
+            # needed for LIMIT.  COUNT changes the result SHAPE, so its
+            # application is proven by the "reduce" echo below
+            req["reduce"] = list(reduce)
         try:
             resp = self._call(host, "deviceGo", req, ExecError)
         except TpuDecline:
@@ -553,8 +562,20 @@ class RemoteDeviceRuntime:
             self._note_upto_declined(space_id, host)
             raise TpuDecline("storaged build predates UPTO serving")
         from ..graph.interim import rows_from_wire
-        return InterimResult(list(resp["columns"]),
-                             rows_from_wire(resp["rows"]))
+        out = InterimResult(list(resp["columns"]),
+                            rows_from_wire(resp["rows"]))
+        if reduce is not None and resp.get("reduce") is True:
+            # capability echo (like upto): only a storaged that READ
+            # the reduce field may have changed the result shape —
+            # without it the rows are full and the pipe reduces them
+            # itself
+            out.reduced = tuple(reduce)
+        elif reduce is not None and reduce[0] == "count":
+            # older build served full GO rows for a COUNT pushdown:
+            # fold them here so the caller still sees a count result
+            out = InterimResult(["__count__"], [[len(out.rows)]])
+            out.reduced = tuple(reduce)
+        return out
 
     # ------------------------------------------------------------ FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:

@@ -417,7 +417,9 @@ class StorageService:
                 distinct=bool(req["distinct"]),
                 where_blob=req.get("where"),
                 pushed_mode=bool(req["pushed_mode"]),
-                upto=bool(req.get("upto", False)))
+                upto=bool(req.get("upto", False)),
+                reduce=(tuple(req["reduce"])
+                        if req.get("reduce") else None))
         except TpuDecline as d:
             stats.add_value("storage.device_decline.qps")
             resp = {"ok": False, "reason": str(d)}
@@ -460,6 +462,12 @@ class StorageService:
             # (an older build would silently serve exact depth; the
             # client treats a missing echo as a decline)
             resp["upto"] = True
+        if req.get("reduce"):
+            # reduction echo (same contract as upto): the result shape
+            # above is already reduced — COUNT rows or a LIMIT-cut
+            # subset — and the client must not re-derive from it as if
+            # it were the full row set
+            resp["reduce"] = True
         return resp
 
     def rpc_deviceFindPath(self, req: dict) -> dict:

@@ -219,6 +219,74 @@ def bench_ldbc_go(results: list, persons: int) -> None:
         c.stop()
 
 
+def bench_limit_pushdown(results: list, persons: int) -> None:
+    """Config: LIMIT/COUNT-shaped GO legs on the skewed graph — the
+    device-side reduction pushdown's fetched-bytes story (ROADMAP
+    item 2: fetched bytes/query must drop >= 4x on the LIMIT leg).
+
+    Three timed legs over the SAME start vertices: the full 2-hop GO,
+    the same GO | LIMIT 10, and GO | YIELD COUNT(*); fetch bytes per
+    query come from the runtime's fetch_bytes counter snapshotted
+    around each leg.  Correctness rails: the LIMIT rows are a subset
+    of the full rows at the requested count, and COUNT equals the full
+    row count, both against the CPU path."""
+    from ..cluster import LocalCluster
+    from .ldbc_gen import generate, load_cluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        src, dst, props = generate(persons)
+        load_cluster(c, "ldbc", src, dst, props)
+        rng = np.random.default_rng(17)
+        vids = rng.integers(1, persons + 1, 400)
+        full_qs = [f"GO 2 STEPS FROM {v} OVER knows "
+                   f"YIELD knows._dst AS d" for v in vids]
+        lim_qs = [q + " | LIMIT 10" for q in full_qs]
+        cnt_qs = [q + " | YIELD COUNT(*)" for q in full_qs]
+
+        # correctness rails (device vs CPU) on a sample
+        from ..common.flags import flags
+        g = c.client()
+        _ok(g, "USE ldbc")
+        rt = c.tpu_runtime
+        for fq, lq, cq in list(zip(full_qs, lim_qs, cnt_qs))[:6]:
+            flags.set("storage_backend", "cpu")
+            full_cpu = [tuple(r) for r in _ok(g, fq).rows]
+            cnt_cpu = _ok(g, cq).rows
+            flags.set("storage_backend", "tpu")
+            lim_dev = [tuple(r) for r in _ok(g, lq).rows]
+            cnt_dev = _ok(g, cq).rows
+            fset = set(full_cpu)
+            assert len(lim_dev) == min(10, len(full_cpu)), (lq, lim_dev)
+            assert all(r in fset for r in lim_dev), lq
+            assert cnt_dev == cnt_cpu, (cq, cnt_dev, cnt_cpu)
+
+        def leg(qs, config):
+            before = rt.stats.get("fetch_bytes", 0)
+            r = _timed_queries(c, qs, 16, "tpu", "ldbc")
+            r["config"] = config
+            r["fetch_bytes_per_query"] = round(
+                (rt.stats.get("fetch_bytes", 0) - before)
+                / max(len(qs), 1), 1)
+            results.append(r)
+            print(r, file=sys.stderr)
+            return r
+
+        r_full = leg(full_qs, "2-hop GO full fetch (LDBC-ish)")
+        r_lim = leg(lim_qs, "2-hop GO | LIMIT 10 (pushdown)")
+        r_cnt = leg(cnt_qs, "2-hop GO | YIELD COUNT(*) (pushdown)")
+        for r in (r_lim, r_cnt):
+            r["fetch_drop_x"] = round(
+                r_full["fetch_bytes_per_query"]
+                / max(r["fetch_bytes_per_query"], 1e-9), 1)
+        print(f"fetch bytes/query: full {r_full['fetch_bytes_per_query']}"
+              f" limit {r_lim['fetch_bytes_per_query']} "
+              f"(drop {r_lim['fetch_drop_x']}x) count "
+              f"{r_cnt['fetch_bytes_per_query']} "
+              f"(drop {r_cnt['fetch_drop_x']}x)", file=sys.stderr)
+    finally:
+        c.stop()
+
+
 _MESH_DRIVER = r"""
 import json, sys, time
 import numpy as np
@@ -563,6 +631,7 @@ def main(argv=None) -> int:
     bench_basketball(results)
     bench_ldbc_paths(results, persons_path)
     bench_ldbc_go(results, persons_go)
+    bench_limit_pushdown(results, persons_path)
     bench_mesh_virtual(results, persons_mesh)
 
     # markdown table

@@ -40,6 +40,8 @@ from __future__ import annotations
 import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from .core import PackageContext, Violation
 
 CHECK = "jaxpr-audit"
@@ -125,6 +127,7 @@ def _audit_one_trace(spec, closed, emit) -> None:
 
 
 def _audit_inputs(spec, avals, emit) -> None:
+    packed = getattr(spec, "packed", ())
     for idx, arg in enumerate(avals):
         for leaf in _leaf_avals(arg):
             dt = str(leaf.dtype)
@@ -135,6 +138,28 @@ def _audit_inputs(spec, avals, emit) -> None:
             if idx in spec.frontier and dt not in FRONTIER_DTYPES:
                 emit(f"kernel '{spec.name}': frontier argument {idx} "
                      f"is {dt}, not an int8/uint8/bool bitmap")
+            if idx in packed and dt != "uint8":
+                # the roofline arc's layout gate: a packed frontier
+                # regressing to int8-per-lane octuples the hop's
+                # gather traffic (docs/roofline.md)
+                emit(f"kernel '{spec.name}': frontier argument {idx} "
+                     f"is {dt}, not a bit-packed uint8 lane matrix — "
+                     f"8x the frontier HBM traffic per hop")
+
+
+def _audit_d2h_bytes(spec, fx, closed, key, emit) -> None:
+    """Reduction kernels (COUNT / LIMIT pushdown) declare a per-
+    dispatch fetch byte bound; the traced output avals must fit it."""
+    bound_fn = getattr(spec, "d2h_bytes_max", None)
+    if bound_fn is None:
+        return
+    bound = int(bound_fn(fx)) if callable(bound_fn) else int(bound_fn)
+    total = sum(int(np.prod(a.shape, dtype=np.int64)) * a.dtype.itemsize
+                for a in closed.out_avals)
+    if total > bound:
+        emit(f"kernel '{spec.name}': bucket {key!r} fetches {total} "
+             f"bytes per dispatch, over the declared reduction bound "
+             f"{bound} — the reduced wire shape regressed")
 
 
 def _audit_donation(spec, closed, avals, emit) -> None:
@@ -230,6 +255,7 @@ def audit_specs(specs, fx, phases_table: Dict[str, dict],
             _audit_inputs(spec, avals, emit)
             _audit_one_trace(spec, closed, emit)
             _audit_donation(spec, closed, avals, emit)
+            _audit_d2h_bytes(spec, fx, closed, key, emit)
             # --- transfer accounting -------------------------------
             row = phases_table.get(spec.phase_kind)
             if row is None:

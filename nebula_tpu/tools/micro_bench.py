@@ -305,6 +305,89 @@ def bench_recovery(reps: int, op_budget_us: float = 1.0) -> dict:
                               and admit_cell_us <= op_budget_us)}
 
 
+def bench_kernel_roofline(reps: int,
+                          slowdown_budget: float = 2.0) -> dict:
+    """Packed-vs-int8 frontier hop roofline (docs/roofline.md).
+
+    Times the SAME multi-hop batched GO dispatch with the int8
+    [rows, B] frontier and the bit-packed uint8 [rows, B/8] one over a
+    synthetic ELL index, reports ms/dispatch and achieved GB/s under
+    the shared ell.dense_hop_bytes traffic model, and verifies bit-
+    exact parity between the two layouts.  Budget guard (like
+    lint/admission/recovery): the packed hop must never run more than
+    ``slowdown_budget`` x the int8 hop — on HBM-bound hardware it is
+    the ~8x WIN the packing exists for; on cache-resident CPU shapes
+    the two converge, and anything past the budget is a packed-path
+    regression."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..tpu import ell as E
+
+    rng = np.random.default_rng(11)
+    n = 1 << 10 if reps <= 5 else (1 << 13 if reps <= 50 else 1 << 15)
+    m = n * 8
+    B, steps, etypes = 256, 4, (1,)
+    src = rng.integers(0, n, m, dtype=np.int32)
+    dst = rng.integers(0, n, m, dtype=np.int32)
+    et = np.ones(m, np.int32)
+    s2 = np.concatenate([src, dst])
+    d2 = np.concatenate([dst, src])
+    e2 = np.concatenate([et, -et])
+    ix = E.EllIndex.build(s2, d2, e2, n, use_native=False)
+    starts = [rng.integers(0, n, 4) for _ in range(B)]
+    f0 = ix.start_frontier(starts, B=B)
+    f0p = E.pack_lanes_host(f0)
+    args = ix.kernel_args()
+    eslot, hrows = ix.hub_merge()
+    k8 = E.make_batched_go_kernel(ix, steps, etypes)
+    kp = E.make_batched_go_lanes_kernel(ix, steps, etypes)
+
+    def run8():
+        return k8(jnp.asarray(f0), *args)
+
+    def runp():
+        return kp(jnp.asarray(f0p), jnp.asarray(eslot),
+                  jnp.asarray(hrows), *args[1:])
+
+    out8 = np.asarray(jax.block_until_ready(run8()))    # compile+warm
+    outp = np.asarray(jax.block_until_ready(runp()))
+    parity = bool(
+        (E.unpack_lanes_host(outp, B)[:ix.n]
+         == (out8[:ix.n] > 0)).all())
+    inner = 3 if reps <= 50 else 5
+
+    def best_of(fn):
+        best = float("inf")
+        for _ in range(3):
+            t0 = _t.perf_counter()
+            for _ in range(inner):
+                jax.block_until_ready(fn())
+            best = min(best, (_t.perf_counter() - t0) / inner)
+        return best
+
+    t8 = best_of(run8)
+    tp = best_of(runp)
+    bytes8 = E.dense_hop_bytes(ix, B, steps)
+    bytesp = E.dense_hop_bytes(ix, E.lanes_width(B), steps)
+    ratio = t8 / tp if tp > 0 else float("inf")
+    return {"graph": f"n=2^{n.bit_length() - 1}, slots={ix.m}",
+            "batch": B, "steps": steps,
+            "int8_ms_per_dispatch": round(t8 * 1e3, 3),
+            "packed_ms_per_dispatch": round(tp * 1e3, 3),
+            "packed_speedup": round(ratio, 3),
+            "int8_achieved_gbps": round(bytes8 / t8 / 1e9, 3),
+            "packed_achieved_gbps": round(bytesp / tp / 1e9, 3),
+            "frontier_bytes_per_hop_int8": bytes8 // max(steps - 1, 1),
+            "frontier_bytes_per_hop_packed":
+                bytesp // max(steps - 1, 1),
+            "parity": parity,
+            "slowdown_budget": slowdown_budget,
+            "within_budget": parity and tp <= t8 * slowdown_budget}
+
+
 def bench_lint(budget_s: float) -> dict:
     """Wall time of the whole-package nebulint run (all nine checks —
     the jaxpr tracing of every registered kernel bucket included).
@@ -328,10 +411,15 @@ def bench_lint(budget_s: float) -> dict:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--lint-budget-s", type=float, default=20.0,
+    ap.add_argument("--lint-budget-s", type=float, default=40.0,
                     help="fail when the whole-package nebulint run "
                          "exceeds this wall time (the static analysis "
-                         "must stay a few seconds to gate tier-1)")
+                         "must stay interactive to gate tier-1; raised "
+                         "20->40 in round 9 when the jaxpr audit "
+                         "gained the reduction-kernel families — "
+                         "ell_go_count/sparse_go_limit/sparse_go_count "
+                         "— measured ~27 s; tests/test_lint.py "
+                         "backstops at 60 s)")
     args = ap.parse_args(argv)
     reps = 50 if args.quick else 400
     rows = 20_000 if args.quick else 200_000
@@ -346,13 +434,15 @@ def main(argv=None) -> int:
         "metrics_path": bench_metrics(reps),
         "admission_path": bench_admission(reps),
         "recovery_path": bench_recovery(reps),
+        "kernel_roofline": bench_kernel_roofline(reps),
         "lint": bench_lint(args.lint_budget_s),
     }
     print(json.dumps(out))
     ok = out["lint"]["within_budget"] \
         and out["metrics_path"]["within_budget"] \
         and out["admission_path"]["within_budget"] \
-        and out["recovery_path"]["within_budget"]
+        and out["recovery_path"]["within_budget"] \
+        and out["kernel_roofline"]["within_budget"]
     return 0 if ok else 1
 
 

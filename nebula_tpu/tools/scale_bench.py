@@ -261,10 +261,31 @@ def main():
                             1, n + 1, nst))))
                     for _ in range(nq)]
             flags.set("storage_backend", "tpu")
+            snap0 = dict(rt.stats)
             out["tpu" + tag] = serve(c, "scale", queries,
                                      args.workers)
-            log(f"tpu path ({hops} hops, {nst} starts): "
-                f"{out['tpu' + tag]}")
+            snap1 = dict(rt.stats)
+            # per-leg roofline attribution (docs/roofline.md): sampled
+            # device-compute time DISTINCT from the serve() wall p50 —
+            # the difference is link RTT + queueing, so a leg losing to
+            # the CPU fallback names which side to fix
+            d_t = snap1.get("t_device_s", 0.0) \
+                - snap0.get("t_device_s", 0.0)
+            d_n = snap1.get("device_timed_dispatches", 0) \
+                - snap0.get("device_timed_dispatches", 0)
+            d_b = snap1.get("device_bytes_moved", 0) \
+                - snap0.get("device_bytes_moved", 0)
+            out["roofline" + tag] = {
+                "device_compute_ms_mean":
+                    round(d_t / d_n * 1e3, 3) if d_n else None,
+                "achieved_hbm_gbps":
+                    round(d_b / d_t / 1e9, 3) if d_t > 0 else None,
+                "fetch_bytes_per_query": round(
+                    (snap1.get("fetch_bytes", 0)
+                     - snap0.get("fetch_bytes", 0)) / max(len(queries),
+                                                          1), 1),
+            }
+            log(f"roofline ({hops} hops): {out['roofline' + tag]}")
             flags.set("storage_backend", "cpu")
             flags.set("flat_bound_mode", True)
             out["cpu_flat" + tag] = serve(
@@ -274,6 +295,24 @@ def main():
             out["p50_speedup_vs_flat_cpu" + tag] = round(
                 out["cpu_flat" + tag]["p50_ms"]
                 / out["tpu" + tag]["p50_ms"], 2)
+            # auto-routed leg: the backend router measures both paths
+            # and serves each family from the cheaper one — the light
+            # shapes where the flat CPU fallback beat the device
+            # (SCALE_r05 0.58x/0.9x) must recover to >= the max of
+            # both curves here
+            flags.set("storage_backend", "tpu")
+            flags.set("go_backend_router", True)
+            try:
+                out["auto" + tag] = serve(
+                    c, "scale", queries[:args.cpu_queries], args.workers)
+            finally:
+                flags.set("go_backend_router", False)
+            out["p50_auto_vs_flat_cpu" + tag] = round(
+                out["cpu_flat" + tag]["p50_ms"]
+                / out["auto" + tag]["p50_ms"], 2)
+            log(f"auto-routed ({hops} hops): {out['auto' + tag]} "
+                f"(p50 vs flat cpu "
+                f"{out['p50_auto_vs_flat_cpu' + tag]}x)")
         flags.set("storage_backend", "tpu")
         out["runtime_stats"] = {
             k: (round(v, 2) if isinstance(v, float) else v)

@@ -117,7 +117,7 @@ class TpuStorageBackend:
         plans = {}
         if filter_expr is not None:
             from .expr_compile import CompileError, ExprCompiler
-            from .runtime import _GoPlan
+            from .runtime import _GoPlan, _filter_has_or
             aliases = sorted({n.alias for n in _walk(filter_expr)
                               if isinstance(n, AliasPropExpr)}) or ["_"]
             for et in edge_types:
@@ -128,7 +128,8 @@ class TpuStorageBackend:
                 except CompileError:
                     self._decline("filter uncompilable against mirror")
                 plans[et] = _GoPlan(m, {a: et for a in aliases}, cval,
-                                    dict(comp.used), True, comp, None)
+                                    dict(comp.used), True, comp, None,
+                                    sc_or=_filter_has_or(filter_expr))
 
         # vectorized candidate assembly over ALL requested vids at once
         items: List[Tuple[int, int]] = [
@@ -152,11 +153,19 @@ class TpuStorageBackend:
                 col_cache[(et, p)] = col
         keep = np.ones(len(cand), dtype=bool)
         if plans:
+            from ..storage.device import TpuDecline
             for et in edge_types:
                 sel = m.edge_etype[cand] == et
                 if not sel.any():
                     continue
-                keep[sel] = self.rt._host_filter(m, plans[et], cand[sel])
+                try:
+                    keep[sel] = self.rt._host_filter(m, plans[et],
+                                                     cand[sel])
+                except TpuDecline as d:
+                    # || over a partially-valid prop: the vectorized
+                    # mask can't short-circuit — the per-row processor
+                    # owns these rows (runtime._host_filter)
+                    self._decline(str(d))
 
         vertices = []
         e_et = m.edge_etype[cand]

@@ -60,7 +60,8 @@ class EllIndex:
     """Degree-bucketed in-slot table over relabeled dense vertex ids."""
 
     __slots__ = ("n", "m", "perm", "inv", "bucket_D", "bucket_nbr",
-                 "bucket_et", "extra_owner", "n_rows", "_device")
+                 "bucket_et", "extra_owner", "n_rows", "_device",
+                 "_n_hubs")
 
     def __init__(self):
         self.n = 0                     # real vertices
@@ -73,6 +74,7 @@ class EllIndex:
         self.extra_owner = np.zeros(0, np.int32)  # hub extra row -> new id
         self.n_rows = 0                # n + len(extra_owner)
         self._device = None            # lazy jnp copies of bucket arrays
+        self._n_hubs = None            # lazy count of distinct hub owners
 
     # -------------------------------------------------------------- build
     @staticmethod
@@ -266,9 +268,31 @@ class EllIndex:
         the XLA program depends only on shapes — a mirror rebuild with
         unchanged table shapes re-dispatches the cached executable
         instead of recompiling; see the kernel builders below)."""
-        return (self.n, self.n_rows, len(self.extra_owner),
+        return (self.n, self.n_rows, len(self.extra_owner), self.n_hubs,
                 tuple((nbr.shape[0], nbr.shape[1])
                       for nbr in self.bucket_nbr))
+
+    @property
+    def n_hubs(self) -> int:
+        """Distinct hub owners — the packed hub-merge's compact-slot
+        count, part of shape_sig because it sizes a kernel argument."""
+        if self._n_hubs is None:
+            self._n_hubs = (int(len(np.unique(self.extra_owner)))
+                            if len(self.extra_owner) else 0)
+        return self._n_hubs
+
+    def hub_merge(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(extra_slot int32[n_extras], hub_rows int32[n_hubs]): each
+        extra row's index into the compact hub-owner list, and that
+        list itself — the packed kernels' OR-merge targets (a packed
+        frontier cannot scatter-max duplicate owners the way the int8
+        one does: max of packed BYTES loses bits, so the merge runs
+        per-bit over a compact per-hub accumulator and lands with ONE
+        unique-row scatter; see _scatter_or_rows)."""
+        if not len(self.extra_owner):
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+        owners, slot = np.unique(self.extra_owner, return_inverse=True)
+        return slot.astype(np.int32), owners.astype(np.int32)
 
     def hub_table(self) -> np.ndarray:
         """bool[n+1]: vertex owns hub extra rows (slot spill) — the
@@ -414,6 +438,263 @@ def unpack_bits(packed: np.ndarray, R1: int) -> np.ndarray:
     return np.unpackbits(packed, axis=0, bitorder="little")[:R1] > 0
 
 
+# ====================================================================
+# Bit-packed (1-bit-per-lane) frontier — the roofline arc.
+#
+# The int8 [n_rows+1, B] frontier spends one BYTE per query lane, so a
+# hop's D row-gathers move B bytes per visited row while carrying B
+# BITS of information — the kernel runs at <10% of HBM peak because
+# 7/8 of every gathered byte is padding (BENCH_r05: 68 GB/s of table
+# traffic against ~819 GB/s, ROADMAP item 1; the graph-accelerator
+# survey's memory-bound analysis, PAPERS.md arxiv 1902.10130).  Packing
+# 8 lanes into one uint8 word ([n_rows+1, B/8]) cuts frontier gather
+# traffic 8x; the hop max becomes a bitwise OR and the etype mask a
+# 0/1 word multiply, both free against the gather.
+#
+# The one op that does NOT translate is the hub fix-up scatter:
+# ``nxt.at[owner].max(extras)`` is correct on 0/1 lanes but max of
+# packed BYTES drops bits (max(0b01, 0b10) = 0b10, OR = 0b11).  The
+# packed merge instead max-scatters each extra row's 8 BIT-PLANES into
+# a compact [n_hubs, 8, W] accumulator (per-plane values are 0/1, so
+# max IS or), recombines, and lands with one unique-row scatter — work
+# stays O(n_extras x B) like the int8 fix-up, never O(n x B).
+# ====================================================================
+LANE_BITS = 8
+
+
+def lanes_width(B: int) -> int:
+    """uint8 words per frontier row for a B-query batch."""
+    return -(-B // LANE_BITS)
+
+
+def pack_lanes_host(f: np.ndarray) -> np.ndarray:
+    """[R, B] truthy -> uint8 [R, ceil(B/8)] (little bit order: bit k
+    of word j is lane j*8+k — matches the device pack/unpack below)."""
+    return np.packbits(np.asarray(f) != 0, axis=1, bitorder="little")
+
+
+def unpack_lanes_host(fp: np.ndarray, B: int) -> np.ndarray:
+    """uint8 [R, W] -> bool [R, B]."""
+    return np.unpackbits(fp, axis=1, bitorder="little")[:, :B] > 0
+
+
+def _unpack_lanes(jnp, fp):
+    """Device unpack: uint8 [R, W] -> int8 0/1 [R, W*8]."""
+    R, W = fp.shape
+    shifts = jnp.arange(LANE_BITS, dtype=jnp.uint8)
+    bits = (fp[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    return bits.reshape(R, W * LANE_BITS).astype(jnp.int8)
+
+
+def _pack_lanes(jnp, bits):
+    """Device pack: truthy [R, B] (B % 8 == 0) -> uint8 [R, B//8]."""
+    R, B = bits.shape
+    w = jnp.asarray((1 << np.arange(LANE_BITS)).astype(np.uint8))
+    b8 = (bits > 0).astype(jnp.uint8).reshape(R, B // LANE_BITS,
+                                              LANE_BITS)
+    return jnp.sum(b8 * w[None, None, :], axis=2, dtype=jnp.uint8)
+
+
+def _scatter_or_rows(jnp, nxt, vals, slot, rows):
+    """OR packed rows ``vals`` [k, W] into ``nxt`` at target rows
+    ``rows[slot[i]]``: bit-plane max into a compact [n_slots, 8, W]
+    accumulator (duplicate slots OR correctly because per-plane values
+    are 0/1), then one gather-OR-set at the UNIQUE target rows.  Rows
+    >= nxt.shape[0] are drop sentinels (padded slots)."""
+    n_slots = rows.shape[0]
+    if n_slots == 0:
+        return nxt
+    W = vals.shape[1]
+    shifts = jnp.arange(LANE_BITS, dtype=jnp.uint8)
+    planes = (vals[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    acc = jnp.zeros((n_slots, LANE_BITS, W), jnp.uint8) \
+        .at[slot].max(planes)
+    # distinct bit positions per plane: the sum IS the bitwise OR
+    merged = jnp.sum(acc << shifts[None, :, None], axis=1,
+                     dtype=jnp.uint8)
+    safe = jnp.minimum(rows, nxt.shape[0] - 1)
+    upd = nxt[safe] | merged
+    return nxt.at[rows].set(upd, mode="drop")
+
+
+def _bucket_expand_packed(jnp, jax, fp, nbr, et, etypes):
+    """Packed-lane twin of _bucket_expand: OR over D in-slot word
+    gathers, the OVER mask a 0/1 uint8 multiply per word."""
+    nb, D = nbr.shape
+    nbr_T = nbr.T
+    ok_T = _etype_ok(jnp, et, etypes).T.astype(jnp.uint8)
+
+    def body(j, acc):
+        g = fp[nbr_T[j]]                   # [nb, W] word-gather
+        return acc | (g * ok_T[j][:, None])
+
+    acc0 = jnp.zeros((nb, fp.shape[1]), dtype=jnp.uint8)
+    return jax.lax.fori_loop(0, D, body, acc0)
+
+
+def _hop_body_packed(jnp, jax, n: int, n_extras: int,
+                     etypes: Tuple[int, ...], nbr_dev, et_dev,
+                     eslot, hrows, fp):
+    """One packed frontier advance: fp [n_rows+1, W] uint8 -> same."""
+    outs = [_bucket_expand_packed(jnp, jax, fp, nbr, et, etypes)
+            for nbr, et in zip(nbr_dev, et_dev)]
+    if not outs:
+        return jnp.zeros_like(fp)
+    nxt = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    if n_extras:
+        extras = nxt[n:]
+        nxt = _scatter_or_rows(jnp, nxt, extras, eslot, hrows)
+    pad = jnp.zeros((1, fp.shape[1]), dtype=jnp.uint8)
+    return jnp.concatenate([nxt, pad], axis=0)
+
+
+def make_batched_go_lanes_kernel(ell: EllIndex, steps: int,
+                                 etypes: Tuple[int, ...],
+                                 upto: bool = False,
+                                 donate: bool = False,
+                                 count: bool = False):
+    """Bit-packed batched GO — the default dense path.
+
+    fn(f0p uint8 [n_rows+1, W], eslot int32[n_extras],
+       hrows int32[n_hubs], *tables) -> uint8 [n_rows+1, W] frontier
+    after ``steps-1`` advances (lane q of word j is query j*8+q;
+    unpack_lanes_host inverts).  With ``count`` the signature gains a
+    mirror-resident per-row final-hop degree vector and the output
+    collapses to int32 [W*8] per-query candidate-edge counts — the
+    COUNT(*) pushdown's fetch is B words instead of a bitmap:
+    fn(f0p, eslot, hrows, deg int32[n_rows+1], *tables)."""
+    import jax
+    import jax.numpy as jnp
+    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
+
+    def advance(f0p, eslot, hrows, nbrs, ets):
+        def one(_, f):
+            return _hop_body_packed(jnp, jax, n, n_extras, etypes,
+                                    nbrs, ets, eslot, hrows, f)
+
+        def one_acc(_, carry):
+            f, acc = carry
+            nxt = _hop_body_packed(jnp, jax, n, n_extras, etypes,
+                                   nbrs, ets, eslot, hrows, f)
+            return nxt, acc | nxt
+
+        if steps <= 1:
+            return f0p
+        if upto:
+            _, out = jax.lax.fori_loop(0, steps - 1, one_acc, (f0p, f0p))
+            return out
+        return jax.lax.fori_loop(0, steps - 1, one, f0p)
+
+    if count:
+        def go(f0p, eslot, hrows, deg, *tables):
+            nbrs, ets = tables[:nb], tables[nb:]
+            out = advance(f0p, eslot, hrows, nbrs, ets)
+            bits = _unpack_lanes(jnp, out).astype(jnp.int32)
+            # deg is zero for hub extra rows and the pad row, so junk
+            # extras never count; [R1] @ [R1, B] -> [B]
+            return deg @ bits
+    else:
+        def go(f0p, eslot, hrows, *tables):
+            nbrs, ets = tables[:nb], tables[nb:]
+            return advance(f0p, eslot, hrows, nbrs, ets)
+
+    # donation contract matches make_batched_go_kernel: f0p is built
+    # fresh per dispatch by the runtime (single-use), opt-in only
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
+
+
+def make_batched_go_delta_lanes_kernel(ell: EllIndex, steps: int,
+                                       etypes: Tuple[int, ...], cap: int,
+                                       donate: bool = False):
+    """Packed twin of make_batched_go_delta_kernel.  The overlay
+    scatter is the same OR-not-max problem as the hub fix-up, so the
+    runtime pre-groups the overlay edges by destination host-side:
+    ``dslot`` maps each overlay edge to its dst's index in the unique
+    ``drows`` list (padded with n_rows+1 = drop sentinel).
+
+    fn(f0p, dsrc int32[cap], det int32[cap], dslot int32[cap],
+       drows int32[cap], eslot, hrows, *tables) -> uint8 [n_rows+1, W].
+    """
+    import jax
+    import jax.numpy as jnp
+    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
+
+    def go(f0p, dsrc, det, dslot, drows, eslot, hrows, *tables):
+        nbrs, ets = tables[:nb], tables[nb:]
+        ok = _etype_ok(jnp, det, etypes).astype(jnp.uint8)
+
+        def one(_, f):
+            nxt = _hop_body_packed(jnp, jax, n, n_extras, etypes, nbrs,
+                                   ets, eslot, hrows, f)
+            act = f[dsrc] * ok[:, None]          # [cap, W] packed
+            return _scatter_or_rows(jnp, nxt, act, dslot, drows)
+
+        return f0p if steps <= 1 else \
+            jax.lax.fori_loop(0, steps - 1, one, f0p)
+
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
+
+
+def make_batched_bfs_lanes_kernel(ell: EllIndex, max_steps: int,
+                                  etypes: Tuple[int, ...],
+                                  stop_when_found: bool = True,
+                                  donate: bool = False):
+    """Packed twin of make_batched_bfs_kernel: the frontier rides the
+    hop gathers 1-bit packed (the gather traffic is the level loop's
+    cost center); the depth matrix stays per-lane (its updates are
+    streaming elementwise, and it IS the result).
+
+    fn(f0p, t0p, eslot, hrows, *tables) -> depth [n_rows+1, B] (int8
+    with -1 = unreachable when max_steps fits, else int16)."""
+    import jax
+    import jax.numpy as jnp
+    n, n_extras, nb_count = ell.n, len(ell.extra_owner), \
+        len(ell.bucket_nbr)
+    small = max_steps <= 120
+
+    def bfs(f0p, t0p, eslot, hrows, *tables):
+        nbrs, ets = tables[:nb_count], tables[nb_count:]
+        tb = _unpack_lanes(jnp, t0p) > 0
+        d0 = jnp.where(_unpack_lanes(jnp, f0p) > 0, jnp.int16(0),
+                       INT16_INF)
+
+        def cond(state):
+            d, fp, step = state
+            go_on = (step < max_steps) & (fp != 0).any()
+            if stop_when_found:
+                go_on = go_on & (tb & (d == INT16_INF)).any()
+            return go_on
+
+        def body(state):
+            d, fp, step = state
+            nxtp = _hop_body_packed(jnp, jax, n, n_extras, etypes,
+                                    nbrs, ets, eslot, hrows, fp)
+            newly = (_unpack_lanes(jnp, nxtp) > 0) & (d == INT16_INF)
+            d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
+            return d, _pack_lanes(jnp, newly), step + 1
+
+        d, _, _ = jax.lax.while_loop(cond, body,
+                                     (d0, f0p, jnp.int32(0)))
+        if small:
+            return jnp.where(d == INT16_INF, -1, d).astype(jnp.int8)
+        return d
+
+    return jax.jit(bfs, donate_argnums=(0, 1) if donate else ())
+
+
+def dense_hop_bytes(ell: EllIndex, lane_bytes_per_row: int,
+                    steps: int) -> int:
+    """HBM traffic model of one packed/int8 dense GO dispatch: per
+    advance, each bucket row pays D word-gathers of
+    ``lane_bytes_per_row`` plus an accumulator read+write; the hub
+    fix-up and pad are O(n_extras) noise.  The roofline numbers in
+    runtime_stats / micro_bench kernel_roofline / docs/roofline.md all
+    come from THIS model so they are comparable."""
+    per_advance = sum(nbr.shape[0] * (nbr.shape[1] + 2)
+                      for nbr in ell.bucket_nbr) * lane_bytes_per_row
+    return max(steps - 1, 1) * per_advance
+
+
 def make_batched_go_kernel(ell: EllIndex, steps: int,
                            etypes: Tuple[int, ...], pack: bool = False,
                            upto: bool = False, donate: bool = False):
@@ -519,11 +800,23 @@ def sparse_caps(c0: int, d_max: int, steps: int, cap: int,
     return tuple(caps)
 
 
+def sparse_limit_cap(caps: Tuple[int, ...], c0: int, limit: int) -> int:
+    """Static output capacity of a LIMIT-reduced sparse GO: every kept
+    vertex has final-hop degree >= 1, so a query keeps at most
+    ``limit`` pairs, and at most c0 queries are live (each live query
+    holds >= 1 start pair) — limit * c0 is a TRUE bound, rounded to a
+    power of two and never above the unreduced cap."""
+    return int(min(caps[-1],
+                   1 << (max(8, limit * max(c0, 1)) - 1).bit_length()))
+
+
 def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                                   etypes: Tuple[int, ...],
                                   caps: Tuple[int, ...],
                                   qmax: int = 1024,
-                                  upto: bool = False):
+                                  upto: bool = False,
+                                  limit: Optional[int] = None,
+                                  count: bool = False):
     """Sparse batched GO — B queries' frontiers ride ONE flat sorted
     (query, vertex) pair list instead of a dense [n_rows, B] bitmap.
 
@@ -556,7 +849,16 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
        qid int32[caps[0]], ecnt int32[n+1], e0 int32[n+1], *tables) ->
     int32 [2 + 2*caps[-1]]: [count, overflow, qids..., ids...] with the
     live pairs sorted by (qid, id) — a single array so the host pays one
-    transfer."""
+    transfer.
+
+    With ``limit`` (the LIMIT-n pushdown, ROADMAP item 2) the signature
+    gains a mirror-resident final-hop degree vector —
+    fn(ids, qid, ecnt, e0, deg int32[n_rows+1], *tables) — and the
+    final pair list is cut on device to each query's shortest
+    new-id-order prefix whose cumulative degree covers ``limit`` rows
+    (zero-degree vertices contribute no final rows and are dropped),
+    compacted to sparse_limit_cap pairs: the fetch shrinks from the
+    full caps[-1] tail to ~limit pairs per live query."""
     import jax
     import jax.numpy as jnp
     n, n_rows = ell.n, ell.n_rows
@@ -669,8 +971,30 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             out_i = jnp.where(out_q == BIG_Q, sentinel, out_i)
         return out_i, out_q, cnt
 
-    @jax.jit
-    def go(ids0, qid0, ecnt, e0, *tables):
+    c_red = sparse_limit_cap(caps, caps[0], limit) \
+        if limit is not None else None
+
+    def limit_cut(ids, qid, deg, overflow):
+        """Degree-weighted per-query prefix cut + compaction to c_red
+        (pairs arrive sorted by (qid, id); segment bases ride a cummax
+        over the nondecreasing exclusive cumsum)."""
+        w = jnp.where(ids == sentinel, 0,
+                      deg[jnp.minimum(ids, sentinel)])
+        seg = qid != jnp.roll(qid, 1)
+        seg = seg.at[0].set(True)
+        cum = jnp.cumsum(w)
+        excl = cum - w
+        base = jax.lax.cummax(jnp.where(seg, excl, jnp.int32(-1)))
+        keep = (ids != sentinel) & (w > 0) & ((excl - base) < limit)
+        pref = jnp.cumsum(keep.astype(jnp.int32))
+        kcnt = pref[-1]
+        pos = jnp.where(keep, pref - 1, c_red)
+        out_i = jnp.full((c_red,), jnp.int32(sentinel)) \
+            .at[pos].set(ids, mode="drop")
+        out_q = jnp.full((c_red,), BIG_Q).at[pos].set(qid, mode="drop")
+        return out_i, out_q, kcnt, overflow | (kcnt > c_red)
+
+    def go_impl(ids0, qid0, ecnt, e0, deg, *tables):
         nbrs, ets = tables[:nb_count], tables[nb_count:]
         ids, qid = ids0, jnp.where(ids0 == sentinel, BIG_Q, qid0)
         overflow = jnp.bool_(False)
@@ -697,7 +1021,20 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
                 overflow = overflow | (cnt > c_fin)
         if upto:
             ids, qid = acc_i, acc_q
-        if ids.shape[0] < c_fin:                 # steps == 1: pad up
+        if count:
+            # COUNT(*) pushdown: collapse the final pair list to per-
+            # query candidate-edge counts — the fetch is qmax words,
+            # never the caps[-1] pair tail
+            w = jnp.where(ids == sentinel, 0,
+                          deg[jnp.minimum(ids, sentinel)])
+            qsafe = jnp.clip(qid, 0, qmax - 1)
+            counts = jnp.zeros((qmax,), jnp.int32) \
+                .at[qsafe].add(jnp.where(qid == BIG_Q, 0, w))
+            head = jnp.stack([cnt, overflow.astype(jnp.int32)])
+            return jnp.concatenate([head, counts])
+        if limit is not None:
+            ids, qid, cnt, overflow = limit_cut(ids, qid, deg, overflow)
+        elif ids.shape[0] < c_fin:               # steps == 1: pad up
             padn = c_fin - ids.shape[0]
             ids = jnp.pad(ids, (0, padn), constant_values=sentinel)
             qid = jnp.pad(qid, (0, padn), constant_values=2**30)
@@ -711,6 +1048,15 @@ def make_batched_sparse_go_kernel(ell: EllIndex, steps: int,
             return jnp.concatenate([head, key])
         return jnp.concatenate(
             [head, jnp.where(qid == BIG_Q, -1, qid), ids])
+
+    if limit is not None or count:
+        go = jax.jit(go_impl)
+    else:
+        # unreduced signature stays (ids, qid, ecnt, e0, *tables) — the
+        # deg vector only rides the LIMIT/COUNT-pushdown variants
+        def go_nodeg(ids0, qid0, ecnt, e0, *tables):
+            return go_impl(ids0, qid0, ecnt, e0, None, *tables)
+        go = jax.jit(go_nodeg)
 
     go.pack32 = pack32              # host resolve unpacks accordingly
     go.R1 = R1
@@ -1545,20 +1891,39 @@ def make_frontier_sharded_sparse_bfs_kernel(mesh, axis: str,
 from .kernels import KernelSpec, register_kernel  # noqa: E402
 
 
-def _ell_go_buckets(fx):
+def _packed_frontier_avals(fx, B):
+    """(f0p, eslot, hrows) avals of the bit-packed dense kernels."""
     R1 = fx.ell.n_rows + 1
+    return (fx.aval((R1, lanes_width(B)), np.uint8),
+            fx.aval((len(fx.ell.extra_owner),), np.int32),
+            fx.aval((fx.ell.n_hubs,), np.int32))
+
+
+def _ell_go_buckets(fx):
     out = []
     for upto in (False, True):
         # audit-time instantiation: traced by jaxaudit, never
         # dispatched — not the serving hot path
-        kern = make_batched_go_kernel(  # nebulint: disable=jax-hotpath
+        kern = make_batched_go_lanes_kernel(  # nebulint: disable=jax-hotpath
             fx.ell, fx.steps, fx.etypes,
-            pack=True, upto=upto, donate=True)
+            upto=upto, donate=True)
         for B in fx.widths:
-            out.append((("ell_go", fx.ell.shape_sig(), fx.etypes,
+            out.append((("ell_go_packed", fx.ell.shape_sig(), fx.etypes,
                          fx.steps, upto), kern,
-                        (fx.aval((R1, B), np.int8),) + fx.table_avals()))
+                        _packed_frontier_avals(fx, B)
+                        + fx.table_avals()[1:]))
     return out
+
+
+def _ell_go_count_buckets(fx):
+    R1 = fx.ell.n_rows + 1
+    kern = make_batched_go_lanes_kernel(  # nebulint: disable=jax-hotpath
+        fx.ell, fx.steps, fx.etypes, count=True, donate=True)
+    return [(("ell_go_count", fx.ell.shape_sig(), fx.etypes, fx.steps),
+             kern,
+             _packed_frontier_avals(fx, B)
+             + (fx.aval((R1,), np.int32),) + fx.table_avals()[1:])
+            for B in fx.widths]
 
 
 def _sparse_go_buckets(fx):
@@ -1582,6 +1947,50 @@ def _sparse_go_buckets(fx):
     return out
 
 
+def _sparse_go_limit_buckets(fx):
+    d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
+    n1 = fx.ell.n + 1
+    R1 = fx.ell.n_rows + 1
+    out = []
+    for c0 in fx.c0s:
+        caps = sparse_caps(c0, d_max, fx.steps, fx.sparse_cap,
+                           growth=fx.sparse_growth)
+        kern = make_batched_sparse_go_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes, caps, qmax=fx.qmax,
+            limit=fx.limit)
+        out.append((("sparse_go_limit", fx.ell.shape_sig(), fx.etypes,
+                     fx.steps, caps, fx.qmax, fx.limit), kern,
+                    (fx.aval((c0,), np.int32),
+                     fx.aval((c0,), np.int32),
+                     fx.aval((n1,), np.int32),
+                     fx.aval((n1,), np.int32),
+                     fx.aval((R1,), np.int32))      # deg vector
+                    + fx.table_avals()[1:]))
+    return out
+
+
+def _sparse_go_count_buckets(fx):
+    d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
+    n1 = fx.ell.n + 1
+    R1 = fx.ell.n_rows + 1
+    out = []
+    for c0 in fx.c0s:
+        caps = sparse_caps(c0, d_max, fx.steps, fx.sparse_cap,
+                           growth=fx.sparse_growth)
+        kern = make_batched_sparse_go_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes, caps, qmax=fx.qmax,
+            count=True)
+        out.append((("sparse_go_count", fx.ell.shape_sig(), fx.etypes,
+                     fx.steps, caps, fx.qmax), kern,
+                    (fx.aval((c0,), np.int32),
+                     fx.aval((c0,), np.int32),
+                     fx.aval((n1,), np.int32),
+                     fx.aval((n1,), np.int32),
+                     fx.aval((R1,), np.int32))      # deg vector
+                    + fx.table_avals()[1:]))
+    return out
+
+
 def _adaptive_go_buckets(fx):
     entry = make_adaptive_go_kernel(fx.ell, fx.steps, fx.etypes,
                                     K=fx.adaptive_k)
@@ -1592,59 +2001,92 @@ def _adaptive_go_buckets(fx):
 
 
 def _ell_bfs_buckets(fx):
-    R1 = fx.ell.n_rows + 1
     out = []
     for shortest in (True, False):
-        kern = make_batched_bfs_kernel(  # nebulint: disable=jax-hotpath
+        kern = make_batched_bfs_lanes_kernel(  # nebulint: disable=jax-hotpath
             fx.ell, fx.steps, fx.etypes,
             stop_when_found=shortest, donate=True)
         for B in fx.widths:
-            out.append((("ell_bfs", fx.ell.shape_sig(), fx.etypes,
-                         fx.steps, shortest), kern,
-                        (fx.aval((R1, B), np.int8),
-                         fx.aval((R1, B), np.int8)) + fx.table_avals()))
+            pk = _packed_frontier_avals(fx, B)
+            out.append((("ell_bfs_packed", fx.ell.shape_sig(),
+                         fx.etypes, fx.steps, shortest), kern,
+                        (pk[0], pk[0], pk[1], pk[2])
+                        + fx.table_avals()[1:]))
     return out
 
 
 def _ell_go_delta_buckets(fx):
-    R1 = fx.ell.n_rows + 1
     out = []
     for cap in (8, 4096):           # the pow-2 overlay ladder's ends
-        kern = make_batched_go_delta_kernel(  # nebulint: disable=jax-hotpath
-            fx.ell, fx.steps, fx.etypes, cap, pack=True, donate=True)
-        out.append((("ell_go_delta", fx.ell.shape_sig(), fx.etypes,
-                     fx.steps, cap), kern,
-                    (fx.aval((R1, fx.widths[0]), np.int8),
-                     fx.aval((cap,), np.int32),
-                     fx.aval((cap,), np.int32),
-                     fx.aval((cap,), np.int32)) + fx.table_avals()))
+        kern = make_batched_go_delta_lanes_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, fx.steps, fx.etypes, cap, donate=True)
+        pk = _packed_frontier_avals(fx, fx.widths[0])
+        out.append((("ell_go_delta_packed", fx.ell.shape_sig(),
+                     fx.etypes, fx.steps, cap), kern,
+                    (pk[0],
+                     fx.aval((cap,), np.int32),    # dsrc
+                     fx.aval((cap,), np.int32),    # det
+                     fx.aval((cap,), np.int32),    # dslot
+                     fx.aval((cap,), np.int32),    # drows
+                     pk[1], pk[2]) + fx.table_avals()[1:]))
     return out
 
 
+def _limit_d2h_bound(fx) -> int:
+    d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
+    worst = 0
+    for c0 in fx.c0s:
+        caps = sparse_caps(c0, d_max, fx.steps, fx.sparse_cap,
+                           growth=fx.sparse_growth)
+        worst = max(worst, sparse_limit_cap(caps, c0, fx.limit))
+    return 4 * (2 + 2 * worst)      # non-pack32 worst case
+
+
 register_kernel(KernelSpec(
-    "ell_go", make_batched_go_kernel, phase_kind="ell_go",
+    "ell_go", make_batched_go_lanes_kernel, phase_kind="ell_go",
     # per steps value: one retrace per pinned batch width per
     # exact/upto variant (the runtime's prewarm compiles exactly these)
     budget=4, instantiate=_ell_go_buckets, donate=(0,), dispatch=(0,),
-    frontier=(0,)))
+    frontier=(0,), packed=(0,)))
+register_kernel(KernelSpec(
+    "ell_go_count", make_batched_go_lanes_kernel,
+    phase_kind="ell_go_count",
+    # COUNT(*) pushdown: one retrace per pinned batch width
+    budget=2, instantiate=_ell_go_count_buckets, donate=(0,),
+    dispatch=(0,), frontier=(0,), packed=(0,),
+    d2h_bytes_max=lambda fx: 4 * lanes_width(max(fx.widths)) * 8))
 register_kernel(KernelSpec(
     "sparse_go", make_batched_sparse_go_kernel, phase_kind="sparse_go",
     # per steps value: one retrace per sparse c0 rung per variant
     budget=4, instantiate=_sparse_go_buckets, dispatch=(0, 1)))
 register_kernel(KernelSpec(
+    "sparse_go_limit", make_batched_sparse_go_kernel,
+    phase_kind="sparse_go",
+    # LIMIT pushdown: one retrace per sparse c0 rung per limit value
+    # (limits themselves ride the dispatcher's shape key)
+    budget=2, instantiate=_sparse_go_limit_buckets, dispatch=(0, 1),
+    d2h_bytes_max=_limit_d2h_bound))
+register_kernel(KernelSpec(
+    "sparse_go_count", make_batched_sparse_go_kernel,
+    phase_kind="sparse_go",
+    # COUNT pushdown: one retrace per sparse c0 rung; the fetch is the
+    # qmax count vector, never the caps[-1] pair tail
+    budget=2, instantiate=_sparse_go_count_buckets, dispatch=(0, 1),
+    d2h_bytes_max=lambda fx: 4 * (2 + fx.qmax)))
+register_kernel(KernelSpec(
     "adaptive_go", make_adaptive_go_kernel, phase_kind="adaptive_go",
     budget=1, instantiate=_adaptive_go_buckets, dispatch=(0,)))
 register_kernel(KernelSpec(
-    "ell_bfs", make_batched_bfs_kernel, phase_kind="ell_bfs",
+    "ell_bfs", make_batched_bfs_lanes_kernel, phase_kind="ell_bfs",
     budget=4, instantiate=_ell_bfs_buckets, donate=(0, 1),
-    dispatch=(0, 1), frontier=(0, 1)))
+    dispatch=(0, 1), frontier=(0, 1), packed=(0, 1)))
 register_kernel(KernelSpec(
-    "ell_go_delta", make_batched_go_delta_kernel,
+    "ell_go_delta", make_batched_go_delta_lanes_kernel,
     phase_kind="ell_go_delta",
     # per steps value: one retrace per pow-2 overlay-capacity rung
     # (log2(mirror_delta_max) rungs bound the ladder)
     budget=12, instantiate=_ell_go_delta_buckets, donate=(0,),
-    dispatch=(0,), frontier=(0,)))
+    dispatch=(0,), frontier=(0,), packed=(0,)))
 
 
 def _ell_go_sharded_buckets(fx):

@@ -71,15 +71,26 @@ class KernelSpec:
                 declared h2d count in DEVICE_PHASES
     frontier    argument indices that are frontier bitmaps — their
                 avals must stay <= 8-bit (int8/uint8/bool)
+    packed      frontier indices that must be BIT-PACKED uint8 lanes
+                (ell.pack_lanes_host layout) — a regression to the
+                int8-per-lane layout (8x the hop's gather traffic,
+                docs/roofline.md) fails lint on the aval dtype
+    d2h_bytes_max  for reduction kernels (COUNT / LIMIT pushdown): a
+                callable(fixture) -> max bytes any bucket's device->
+                host fetch may total — the static proof that the
+                reduced wire shape actually shrank
     """
 
     __slots__ = ("name", "factory", "phase_kind", "budget", "instantiate",
-                 "donate", "dispatch", "frontier")
+                 "donate", "dispatch", "frontier", "packed",
+                 "d2h_bytes_max")
 
     def __init__(self, name: str, factory, phase_kind: str, budget: int,
                  instantiate, donate: Tuple[int, ...] = (),
                  dispatch: Tuple[int, ...] = (),
-                 frontier: Tuple[int, ...] = ()):
+                 frontier: Tuple[int, ...] = (),
+                 packed: Tuple[int, ...] = (),
+                 d2h_bytes_max=None):
         self.name = name
         self.factory = factory
         self.phase_kind = phase_kind
@@ -88,6 +99,8 @@ class KernelSpec:
         self.donate = tuple(donate)
         self.dispatch = tuple(dispatch)
         self.frontier = tuple(frontier)
+        self.packed = tuple(packed)
+        self.d2h_bytes_max = d2h_bytes_max
 
 
 KERNEL_REGISTRY: Dict[str, KernelSpec] = {}
@@ -145,6 +158,7 @@ class AuditFixture:
         self.sparse_growth = int(flags.get("tpu_sparse_growth") or 8)
         self.qmax = int(flags.get("go_batch_max") or 1024)
         self.steps = 3                 # representative multi-hop depth
+        self.limit = 10                # representative LIMIT pushdown
 
     # ---- abstract-signature helpers ---------------------------------
     @staticmethod

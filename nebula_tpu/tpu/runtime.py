@@ -63,10 +63,10 @@ class _GoPlan:
     """Prepared per-query state handed from can_run_go to run_go."""
 
     __slots__ = ("mirror", "alias_to_etype", "filter_cval", "filter_used",
-                 "pushed_mode", "compiler", "expr_str")
+                 "pushed_mode", "compiler", "expr_str", "sc_or")
 
     def __init__(self, mirror, alias_to_etype, filter_cval, filter_used,
-                 pushed_mode, compiler, expr_str):
+                 pushed_mode, compiler, expr_str, sc_or=False):
         self.mirror = mirror
         self.alias_to_etype = alias_to_etype
         self.filter_cval = filter_cval
@@ -74,6 +74,38 @@ class _GoPlan:
         self.pushed_mode = pushed_mode      # True: skip-invalid (storage
         self.compiler = compiler            # semantics); False: raise
         self.expr_str = expr_str            # canonical WHERE text (cache key)
+        # WHERE contains a disjunction: `x || missing` short-circuits
+        # on the CPU path (row kept without touching the prop), which
+        # the vectorized validity mask cannot reproduce — rows with
+        # invalid used props must decline to the CPU loop then
+        # (pure-conjunction masks match skip-on-error exactly)
+        self.sc_or = sc_or
+
+
+def _filter_has_or(expr) -> bool:
+    """True when the predicate can short-circuit PAST a prop read in a
+    way the validity AND-mask cannot reproduce (see _GoPlan.sc_or).
+
+    A pure conjunction is mask-safe: `false && missing` skips the row
+    either way, `true && missing` raises-and-skips = masked.  Anything
+    that can turn a skipped operand into a KEPT row is not: any
+    disjunction, and any `!` (or other non-logical operator) APPLIED
+    OVER a logical subtree — `!(false && missing)` keeps the row on
+    the CPU path without touching the prop."""
+    from ..filter.expressions import LogicalExpr
+    if expr is None:
+        return False
+
+    def scan(nd, under_non_logical: bool) -> bool:
+        if isinstance(nd, LogicalExpr):
+            if nd.op != "&&" or under_non_logical:
+                return True
+            return any(scan(c, False) for c in nd.children())
+        # every non-logical node (unary !, arithmetic, comparisons,
+        # function calls) makes a logical op underneath order-sensitive
+        return any(scan(c, True) for c in nd.children())
+
+    return scan(expr, False)
 
 
 class _GoQuery:
@@ -110,18 +142,47 @@ class _Pending:
         self.finish = finish
 
 
+class _DeviceCounts:
+    """Marker wrapper a count-reduced launch resolver returns instead
+    of per-query frontier vertex lists: the device already collapsed
+    the result to per-query candidate-edge counts (int64[nq]), so the
+    fetch was B words and assembly is skipped entirely."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+
+
 def _pad_pow2(arr: np.ndarray, fill=-1, min_size: int = 8) -> np.ndarray:
     size = max(min_size, 1 << (max(len(arr), 1) - 1).bit_length())
     return kernels.pad_to(arr, size, fill)
 
 
 flags.define(
-    "tpu_filter_mode", "host",
-    "where a GO's WHERE filter evaluates on the device path: 'host' "
-    "(default — float64 numpy over the candidate edges, bit-identical "
-    "to the CPU executor path, and every GO shape batches through the "
-    "dispatcher) or 'device' (the mask fuses into the XLA hop program; "
+    "tpu_filter_mode", "auto",
+    "where a GO's WHERE filter evaluates on the device path: 'auto' "
+    "(default — the mask fuses into the XLA hop program whenever "
+    "expr_compile covers the predicate, so fetch returns only "
+    "surviving rows; anything uncompilable keeps the host float64 "
+    "parity path), 'host' (always float64 numpy over the candidate "
+    "edges, bit-identical to the CPU executor path, and every GO "
+    "shape batches through the dispatcher) or 'device' (fuse always; "
     "no cross-query batching)")
+flags.define(
+    "tpu_packed_frontier", True,
+    "dense ELL GO/BFS frontiers ride BIT-PACKED uint8 lanes (8 "
+    "queries per byte) through the hop loop instead of int8-per-lane "
+    "— 8x less frontier gather traffic per hop, the ROADMAP item-1 "
+    "roofline claim (docs/roofline.md); off restores the int8 layout "
+    "(parity fallback, and the micro_bench kernel_roofline baseline)")
+flags.define(
+    "tpu_device_timing_every", 16,
+    "sample every Nth dense/sparse device dispatch with a "
+    "block_until_ready timestamp around the kernel — the device-"
+    "compute-vs-link split (tpu.device_compute.latency_us histogram, "
+    "achieved-GB/s gauge, BASELINE.md roofline columns).  0 disables "
+    "sampling (no serialization of the dispatch pipeline at all)")
 flags.define(
     "tpu_adaptive_single", True,
     "single-query GO runs the adaptive sparse-frontier kernel "
@@ -216,6 +277,8 @@ flags.define(
 DEVICE_PHASES = {
     "ell_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
                           "tpu.assemble"), "h2d": 1, "d2h": 1},
+    "ell_go_count": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
+                                "tpu.assemble"), "h2d": 1, "d2h": 1},
     "sparse_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
                              "tpu.assemble"), "h2d": 2, "d2h": 1},
     "adaptive_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
@@ -263,7 +326,16 @@ class TpuQueryRuntime:
                       "prewarm_compiled": 0, "prewarm_hits": 0,
                       "prewarm_misses": 0,
                       "t_launch_s": 0.0, "t_fetch_s": 0.0,
-                      "t_assemble_s": 0.0}
+                      "t_assemble_s": 0.0,
+                      # roofline accounting (docs/roofline.md): sampled
+                      # block_until_ready device-compute time, the HBM
+                      # traffic the sampled dispatches moved under the
+                      # ell.dense_hop_bytes model, and the bytes every
+                      # fetch pulled over the link
+                      "t_device_s": 0.0, "device_bytes_moved": 0,
+                      "device_timed_dispatches": 0,
+                      "fetch_bytes": 0, "go_reduced": 0}
+        self._timing_seq = 0
         # shapes the AOT pre-warm compiled / shapes live dispatch used
         # (prewarm_hits/misses make the pre-warm's p99 effect auditable:
         # a miss = a live query paid a first compile the warm should
@@ -283,6 +355,10 @@ class TpuQueryRuntime:
         # dispatch lands one latency observation keyed by its dense
         # batch-width rung
         _stats.register_histogram("tpu.dispatch.latency_us")
+        # device-compute time distinct from link RTT: one observation
+        # per SAMPLED dispatch (tpu_device_timing_every), measured by a
+        # block_until_ready timestamp around the kernel
+        _stats.register_histogram("tpu.device_compute.latency_us")
         _stats.register_collector(self._collect_metrics)
 
     @staticmethod
@@ -319,6 +395,16 @@ class TpuQueryRuntime:
         _stats.set_gauge("tpu.prewarm.hits", snap.get("prewarm_hits", 0))
         _stats.set_gauge("tpu.prewarm.misses",
                          snap.get("prewarm_misses", 0))
+        # roofline position: sampled-dispatch achieved HBM bandwidth
+        # under the dense_hop_bytes model, plus cumulative fetch bytes
+        # (the reduction pushdown's ≥4x drop shows here first)
+        t_dev = float(snap.get("t_device_s", 0.0))
+        if t_dev > 0:
+            _stats.set_gauge(
+                "tpu.roofline.achieved_gbps",
+                round(snap.get("device_bytes_moved", 0) / t_dev / 1e9,
+                      3))
+        _stats.set_gauge("tpu.fetch.bytes", snap.get("fetch_bytes", 0))
         for key, state, _reason in self.breaker.cells_snapshot():
             _stats.set_gauge("tpu.breaker.state",
                              {"closed": 0.0, "half_open": 0.5,
@@ -657,7 +743,8 @@ class TpuQueryRuntime:
         return _GoPlan(
             m, alias_to_etype, filter_cval, filter_used,
             pushed_mode=pushed_mode, compiler=compiler,
-            expr_str=(str(where_expr) if where_expr is not None else None))
+            expr_str=(str(where_expr) if where_expr is not None else None),
+            sc_or=_filter_has_or(where_expr))
 
     def can_run_go(self, space_id: int, etypes: List[int], sentence,
                    pushed: Optional[bytes], remnant: Optional[Expression],
@@ -706,7 +793,7 @@ class TpuQueryRuntime:
                etypes: List[int], steps: int, etype_to_alias: Dict[int, str],
                yield_cols, distinct: bool, where_expr,
                edge_props, vertex_props,
-               upto: bool = False) -> InterimResult:
+               upto: bool = False, reduce=None) -> InterimResult:
         from ..graph.executors.base import ExecError
 
         s = executor.sentence
@@ -715,14 +802,21 @@ class TpuQueryRuntime:
             raise ExecError("TPU plan missing (can_run_go not called)")
         columns, rows = self._go_via_dispatcher(
             space_id, plan, start_vids, etypes, steps, etype_to_alias,
-            yield_cols, distinct, where_expr, ExecError, upto=upto)
-        return InterimResult(columns, rows)
+            yield_cols, distinct, where_expr, ExecError, upto=upto,
+            reduce=reduce)
+        out = InterimResult(columns, rows)
+        if reduce is not None:
+            # marker for the fused-pipe helper (traverse.py): the
+            # device DID apply the reduction (a CPU fallback never
+            # sets it, so the helper re-derives from full rows there)
+            out.reduced = tuple(reduce)
+        return out
 
     def serve_go(self, space_id: int, start_vids: List[int],
                  etypes: List[int], steps: int,
                  etype_to_alias: Dict[int, str], yield_specs,
                  distinct: bool, where_blob: Optional[bytes],
-                 pushed_mode: bool, upto: bool = False):
+                 pushed_mode: bool, upto: bool = False, reduce=None):
         """storaged-side RPC half of the cross-process device path
         (storage/service.py rpc_deviceGo → here): decode the shipped
         WHERE/YIELD expression trees, plan against the local mirror and
@@ -755,13 +849,14 @@ class TpuQueryRuntime:
             raise TpuDecline("device cannot reproduce this query")
         return self._go_via_dispatcher(
             space_id, plan, start_vids, etypes, steps, etype_to_alias,
-            yield_cols, distinct, where_expr, DeviceExecError, upto=upto)
+            yield_cols, distinct, where_expr, DeviceExecError, upto=upto,
+            reduce=reduce)
 
     def _go_via_dispatcher(self, space_id: int, plan: _GoPlan,
                            start_vids: List[int], etypes: List[int],
                            steps: int, etype_to_alias: Dict[int, str],
                            yield_cols, distinct: bool, where_expr,
-                           ExcType, upto: bool = False):
+                           ExcType, upto: bool = False, reduce=None):
         """Submit one GO onto the coalescing dispatcher; the batch
         leader runs the whole device + host pipeline for every rider
         (go_batch_execute).  The fused device-filter mode bypasses the
@@ -780,9 +875,16 @@ class TpuQueryRuntime:
             raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
         self.stats["go_device"] += 1
+        # tpu_filter_mode: 'device' always fuses a compiled WHERE into
+        # the hop program; 'auto' (the shipped default, VERDICT r5 ask
+        # #5) fuses whenever expr_compile covered the predicate — fetch
+        # then returns only surviving rows — and keeps the host float64
+        # parity path for everything _plan_go declined (which routed to
+        # the CPU executor before we ever got here)
+        fmode = flags.get("tpu_filter_mode")
         try:
             if plan.filter_cval is not None and not upto \
-                    and flags.get("tpu_filter_mode") == "device":
+                    and reduce is None and fmode in ("device", "auto"):
                 result = self._execute_fused(space_id, plan, start_vids,
                                              et_tuple, steps,
                                              etype_to_alias, yield_cols,
@@ -793,7 +895,8 @@ class TpuQueryRuntime:
                              where_expr, etype_to_alias, ExcType,
                              deadline=deadlines.current())
                 result, _m = self.dispatcher.submit_batched(
-                    ("go_batch_execute", space_id, et_tuple, steps, upto),
+                    ("go_batch_execute", space_id, et_tuple, steps, upto,
+                     tuple(reduce) if reduce is not None else None),
                     q)
         except Exception as e:      # noqa: BLE001 — classify, then rethrow
             reason = classify_device_failure(e)
@@ -816,7 +919,7 @@ class TpuQueryRuntime:
     # ------------------------------------------------ batch entry point
     def go_batch_execute(self, space_id: int, queries: List[_GoQuery],
                          et_tuple: Tuple[int, ...], steps: int,
-                         upto: bool = False):
+                         upto: bool = False, reduce=None):
         """Dispatcher leader entry: run a whole batch of GO queries —
         one device launch for the frontier advance, then one vectorized
         host pass per (WHERE, YIELD) signature group.
@@ -851,7 +954,8 @@ class TpuQueryRuntime:
         with tracing.span("tpu.launch", queries=len(live),
                           steps=steps):
             launch = self._launch_frontiers(space_id, starts, et_tuple,
-                                            steps, upto=upto)
+                                            steps, upto=upto,
+                                            reduce=reduce)
         self._tick("t_launch_s", t0)
         # finish() may run on a different thread (the dispatcher
         # pipelines batches) — carry the leader's trace context across
@@ -863,10 +967,24 @@ class TpuQueryRuntime:
                 with tracing.span("tpu.fetch"):
                     vs_lists, m = launch()
                 t1 = self._tick("t_fetch_s", t1)
-                with tracing.span("tpu.assemble",
-                                  queries=len(live)):
-                    results = self._assemble_results(space_id, m, live,
-                                                     vs_lists, et_tuple)
+                if reduce is not None and reduce[0] == "count":
+                    # COUNT(*) pushdown: no candidate assembly, no row
+                    # materialization — the result per query is one
+                    # number (device-counted on the dense path, a
+                    # vectorized degree sum over the fetched frontier
+                    # everywhere else)
+                    results = self._count_results(m, vs_lists,
+                                                  len(live), et_tuple)
+                    with self._lock:
+                        self.stats["go_reduced"] += len(live)
+                else:
+                    if reduce is not None:
+                        with self._lock:
+                            self.stats["go_reduced"] += len(live)
+                    with tracing.span("tpu.assemble",
+                                      queries=len(live)):
+                        results = self._assemble_results(
+                            space_id, m, live, vs_lists, et_tuple)
             self._tick("t_assemble_s", t1)
             # whole-dispatch latency (launch -> fetch -> assemble),
             # bucketed by the dense batch-width rung this query count
@@ -882,10 +1000,24 @@ class TpuQueryRuntime:
 
         return _Pending(finish)
 
+    def _count_results(self, m: CsrMirror, vs_lists, nq: int,
+                       et_tuple: Tuple[int, ...]):
+        """Per-query COUNT(*) results from a reduced launch: either the
+        device already counted (_DeviceCounts) or the fetched frontier
+        lists fold through the cached per-vertex degree vector — never
+        row materialization."""
+        if isinstance(vs_lists, _DeviceCounts):
+            counts = vs_lists.arr
+        else:
+            deg = self._deg_host(m, et_tuple)
+            counts = [int(deg[np.asarray(vs, np.int64)].sum())
+                      if len(vs) else 0 for vs in vs_lists]
+        return [(["__count__"], [[int(c)]]) for c in counts[:nq]]
+
     # ------------------------------------------------ frontier launch
     def _launch_frontiers(self, space_id: int, starts_per_query,
                           et_tuple: Tuple[int, ...], steps: int,
-                          upto: bool = False):
+                          upto: bool = False, reduce=None):
         """Start the device work for ``steps - 1`` frontier advances of
         B queries; returns a zero-arg resolver -> (per-query ascending
         dense-id frontier arrays, mirror).  Selection order: host-only
@@ -900,6 +1032,13 @@ class TpuQueryRuntime:
         thread switch interval under a hundred request threads."""
         m = self.mirror(space_id)
         delta = self._live_delta(m)
+        if delta is not None and reduce is not None:
+            # a reduced result (COUNT / LIMIT pushdown) folds through
+            # the cached degree vectors of the BASE mirror; an overlay
+            # whose rows ride in at assembly would be invisible to the
+            # device-side reduction — pay the rebuild for exactness
+            m = self.mirror_full(space_id)
+            delta = None
         if delta is not None and steps > 1 \
                 and (upto or delta.has_deletes or len(delta.extra_vids)):
             # reachability changed (a base edge died) or the dense-id
@@ -970,7 +1109,8 @@ class TpuQueryRuntime:
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is not None:
             return self._launch_sparse(space_id, m, ix, d_all, q_all, nq,
-                                       et_tuple, steps, c0, upto=upto)
+                                       et_tuple, steps, c0, upto=upto,
+                                       reduce=reduce)
 
         if flags.get("tpu_sparse_go") and delta is None \
                 and mesh_mt is None and c0 is None and nq > 1:
@@ -982,11 +1122,12 @@ class TpuQueryRuntime:
             # dense fallback put 75 s on the 32-start leg's p99)
             launched = self._launch_sparse_split(
                 space_id, m, ix, d_all, q_all, nq, et_tuple, steps,
-                qbounds, upto=upto)
+                qbounds, upto=upto, reduce=reduce)
             if launched is not None:
                 return launched
 
         if nq == 1 and delta is None and mesh_mt is None and not upto \
+                and reduce is None \
                 and flags.get("tpu_adaptive_single") \
                 and len(d_all) <= int(flags.get("tpu_adaptive_k") or 2048):
             return self._launch_adaptive(space_id, m, ix, d_all,
@@ -994,13 +1135,14 @@ class TpuQueryRuntime:
 
         return self._launch_dense(space_id, m, ix, d_all, q_all, nq,
                                   et_tuple, steps, delta, mesh_mt,
-                                  upto=upto)
+                                  upto=upto, reduce=reduce)
 
     def _launch_sparse_split(self, space_id: int, m: CsrMirror,
                              ix: EllIndex, d_all: np.ndarray,
                              q_all: np.ndarray, nq: int,
                              et_tuple: Tuple[int, ...], steps: int,
-                             qbounds: np.ndarray, upto: bool = False):
+                             qbounds: np.ndarray, upto: bool = False,
+                             reduce=None):
         """Greedy query-boundary split of an over-wide batch into
         sparse sub-launches (each within the c0 ladder).  All sub
         kernels dispatch async back-to-back, so the launches pipeline
@@ -1030,11 +1172,29 @@ class TpuQueryRuntime:
                 continue
             parts.append((g_lo, g_hi, self._launch_sparse(
                 space_id, m, ix, d_seg, q_seg, g_hi - g_lo, et_tuple,
-                steps, c0g, upto=upto)))
+                steps, c0g, upto=upto, reduce=reduce)))
         self.stats["go_sparse_split"] = \
             self.stats.get("go_sparse_split", 0) + 1
 
         def resolve():
+            if reduce is not None and reduce[0] == "count":
+                # count sub-launches resolve to _DeviceCounts (device
+                # or dense-fallback counted) — stitch the per-query
+                # numbers, never slice-assign them as vertex lists
+                counts = np.zeros(nq, np.int64)
+                mm = m
+                for g_lo, g_hi, r in parts:
+                    if r is None:
+                        continue        # start-less queries count 0
+                    vals, mm = r()
+                    if isinstance(vals, _DeviceCounts):
+                        counts[g_lo:g_hi] = vals.arr
+                    else:               # defensive: vertex lists
+                        deg = self._deg_host(mm, et_tuple)
+                        counts[g_lo:g_hi] = [
+                            int(deg[np.asarray(v, np.int64)].sum())
+                            if len(v) else 0 for v in vals]
+                return _DeviceCounts(counts), mm
             out: List[np.ndarray] = [np.zeros(0, np.int64)] * nq
             mm = m
             for g_lo, g_hi, r in parts:
@@ -1090,7 +1250,7 @@ class TpuQueryRuntime:
     def _launch_sparse(self, space_id: int, m: CsrMirror, ix: EllIndex,
                        d_all: np.ndarray, q_all: np.ndarray, nq: int,
                        et_tuple: Tuple[int, ...], steps: int, c0: int,
-                       upto: bool = False):
+                       upto: bool = False, reduce=None):
         from .ell import make_batched_sparse_go_kernel, sparse_caps
         import jax.numpy as jnp
         d_max = max(ix.bucket_D) if ix.bucket_D else 1
@@ -1098,18 +1258,40 @@ class TpuQueryRuntime:
         caps = sparse_caps(c0, d_max, steps, cap,
                            growth=int(flags.get("tpu_sparse_growth") or 8))
         qmax = max(int(flags.get("go_batch_max") or 1024), nq)
-        kern = self._kernel(
-            ("sparse_go", ix.shape_sig(), et_tuple, steps, caps, qmax,
-             upto),
-            lambda: make_batched_sparse_go_kernel(ix, steps, et_tuple,
-                                                  caps, qmax=qmax,
-                                                  upto=upto))
+        # the LIMIT-n pushdown: the kernel cuts the final pair list on
+        # device so the fetch carries ~limit pairs per live query
+        # instead of the full caps[-1] tail (ROADMAP item 2 ≥4x ask)
+        limit = int(reduce[1]) if reduce is not None \
+            and reduce[0] == "limit" else None
+        count_mode = reduce is not None and reduce[0] == "count"
+        if limit is not None:
+            kern = self._kernel(
+                ("sparse_go_limit", ix.shape_sig(), et_tuple, steps,
+                 caps, qmax, limit),
+                lambda: make_batched_sparse_go_kernel(
+                    ix, steps, et_tuple, caps, qmax=qmax, limit=limit))
+        elif count_mode:
+            kern = self._kernel(
+                ("sparse_go_count", ix.shape_sig(), et_tuple, steps,
+                 caps, qmax),
+                lambda: make_batched_sparse_go_kernel(
+                    ix, steps, et_tuple, caps, qmax=qmax, count=True))
+        else:
+            kern = self._kernel(
+                ("sparse_go", ix.shape_sig(), et_tuple, steps, caps,
+                 qmax, upto),
+                lambda: make_batched_sparse_go_kernel(
+                    ix, steps, et_tuple, caps, qmax=qmax, upto=upto))
         first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
                                                  set())
         # an UPTO query compiled only the UPTO variant — every exact
         # rung still needs the warm
+        # reduced/upto dispatches compile their OWN kernel keys, so the
+        # warm must still cover the plain rung at this c0
         self._prewarm_family(m, ix, et_tuple, steps,
-                             skip_c0=None if upto else c0)
+                             skip_c0=None
+                             if (upto or limit is not None or count_mode)
+                             else c0)
         S = len(d_all)
         ids = np.full(c0, ix.n_rows, np.int32)
         qid = np.zeros(c0, np.int32)
@@ -1118,27 +1300,49 @@ class TpuQueryRuntime:
         ids[:S] = new[order]
         qid[:S] = q_all[order]
         ecnt, e0 = self._hub_expansion_dev(m, ix)
-        # upto shapes are outside the warm's scope (it compiles the
-        # exact-depth variants only) — register uncounted, like the
-        # family-triggering shape
+        # upto/limit shapes are outside the warm's scope (it compiles
+        # the exact-depth unreduced variants only) — register
+        # uncounted, like the family-triggering shape
         self._note_live_shape(("sparse_go", ix.shape_sig(), et_tuple,
                                steps, c0),
-                              first_of_family=first or upto)
+                              first_of_family=first or upto
+                              or limit is not None)
+        extra = (self._deg_dev(m, ix, et_tuple),) \
+            if (limit is not None or count_mode) else ()
         with tracing.span("tpu.kernel", kind="sparse_go", starts=S):
             out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
-                           *ix.kernel_args()[1:])
+                           *extra, *ix.kernel_args()[1:])
         self.stats["go_sparse"] += 1
+        self._maybe_time_device(
+            out_dev, sum(c * (d_max + 12) * 4 for c in caps[1:]),
+            kind="sparse_go")
+
+        if count_mode:
+            def resolve_counts():
+                out_host = np.asarray(out_dev)
+                self._note_fetch(out_host)
+                if bool(out_host[1]):            # hop overflow: dense
+                    self.stats["sparse_overflows"] += 1
+                    return self._launch_dense(
+                        space_id, m, ix, d_all, q_all, nq, et_tuple,
+                        steps, None, self._mesh_tables(m, ix),
+                        upto=upto, reduce=reduce)()
+                return _DeviceCounts(
+                    out_host[2:2 + nq].astype(np.int64)), m
+            return resolve_counts
 
         def resolve():
             from .ell import sparse_go_pairs
+            out_host = np.asarray(out_dev)
+            self._note_fetch(out_host)
             _cnt, overflow, qids, vids_new = sparse_go_pairs(
-                kern, np.asarray(out_dev))
+                kern, out_host)
             if overflow:
                 self.stats["sparse_overflows"] += 1
                 return self._launch_dense(space_id, m, ix, d_all, q_all,
                                           nq, et_tuple, steps, None,
                                           self._mesh_tables(m, ix),
-                                          upto=upto)()
+                                          upto=upto, reduce=reduce)()
             vs_old = ix.inv[vids_new]
             # sorted by (query, old dense id): deterministic row order
             # identical to the dense path's ascending nonzero scan
@@ -1237,6 +1441,7 @@ class TpuQueryRuntime:
 
         def resolve():
             packed = np.asarray(out_dev)
+            self._note_fetch(packed)
             bitmap = unpack_bits(packed[:, None], ix.n_rows + 1)[:, 0]
             vs_old = np.nonzero(bitmap[ix.perm])[0]
             return [vs_old], m
@@ -1246,26 +1451,54 @@ class TpuQueryRuntime:
     def _launch_dense(self, space_id: int, m: CsrMirror, ix: EllIndex,
                       d_all: np.ndarray, q_all: np.ndarray, nq: int,
                       et_tuple: Tuple[int, ...], steps: int,
-                      delta, mesh_mt, upto: bool = False):
-        from .ell import (make_batched_go_kernel,
+                      delta, mesh_mt, upto: bool = False,
+                      reduce=None):
+        from .ell import (dense_hop_bytes, lanes_width,
+                          make_batched_go_kernel,
                           make_batched_go_delta_kernel,
-                          make_sharded_batched_go_kernel, unpack_bits)
+                          make_batched_go_delta_lanes_kernel,
+                          make_batched_go_lanes_kernel,
+                          make_sharded_batched_go_kernel, unpack_bits,
+                          unpack_lanes_host)
         # callers guarantee: upto never reaches the delta or sharded
-        # variants (delta forces mirror_full, the mesh gate declines)
+        # variants (delta forces mirror_full, the mesh gate declines);
+        # a count reduction only rides the packed single-chip kernels
         assert not (upto and (delta is not None or mesh_mt is not None))
         B = self._batch_width(nq)
-        f0_dev = self._upload_frontier(ix, ix.perm[d_all],
-                                       q_all.astype(np.int32), B)
+        packed_mode = bool(flags.get("tpu_packed_frontier", True)) \
+            and mesh_mt is None
+        count_mode = reduce is not None and reduce[0] == "count" \
+            and packed_mode and delta is None
         args = ix.kernel_args()
+        if packed_mode:
+            f0_dev = self._upload_frontier_packed(
+                ix, ix.perm[d_all], q_all.astype(np.int32), B)
+            eslot, hrows = self._hub_merge_dev(m, ix)
+            hop_bytes = dense_hop_bytes(ix, lanes_width(B), steps)
+        else:
+            f0_dev = self._upload_frontier(ix, ix.perm[d_all],
+                                           q_all.astype(np.int32), B)
+            hop_bytes = dense_hop_bytes(ix, B, steps)
         if delta is not None:
-            cap, dsrc, ddst, det = self._delta_device(m, ix)
-            kern = self._kernel(
-                ("ell_go_delta", ix.shape_sig(), et_tuple, steps),
-                lambda: make_batched_go_delta_kernel(ix, steps, et_tuple,
-                                                     cap, pack=True,
-                                                     donate=True))
-            with tracing.span("tpu.kernel", kind="ell_go_delta"):
-                out_dev = kern(f0_dev, dsrc, ddst, det, *args)
+            cap, dsrc, ddst, det, dslot, drows = \
+                self._delta_device(m, ix)
+            if packed_mode:
+                kern = self._kernel(
+                    ("ell_go_delta_packed", ix.shape_sig(), et_tuple,
+                     steps),
+                    lambda: make_batched_go_delta_lanes_kernel(
+                        ix, steps, et_tuple, cap, donate=True))
+                with tracing.span("tpu.kernel", kind="ell_go_delta"):
+                    out_dev = kern(f0_dev, dsrc, det, dslot, drows,
+                                   eslot, hrows, *args[1:])
+            else:
+                kern = self._kernel(
+                    ("ell_go_delta", ix.shape_sig(), et_tuple, steps),
+                    lambda: make_batched_go_delta_kernel(
+                        ix, steps, et_tuple, cap, pack=True,
+                        donate=True))
+                with tracing.span("tpu.kernel", kind="ell_go_delta"):
+                    out_dev = kern(f0_dev, dsrc, ddst, det, *args)
         elif mesh_mt is not None:
             mesh, nbrs, ets, reals = mesh_mt
             kern = self._kernel(
@@ -1276,34 +1509,71 @@ class TpuQueryRuntime:
                     pack=True))
             with tracing.span("tpu.kernel", kind="ell_go_sharded"):
                 out_dev = kern(f0_dev, args[0], *nbrs, *ets)
-        else:
+        elif count_mode:
+            deg = self._deg_dev(m, ix, et_tuple)
             kern = self._kernel(
-                ("ell_go", ix.shape_sig(), et_tuple, steps, upto),
-                # donate=True: f0 is built fresh per dispatch right
-                # above (_upload_frontier) — single-use by construction
-                lambda: make_batched_go_kernel(ix, steps, et_tuple,
-                                               pack=True, upto=upto,
-                                               donate=True))
+                ("ell_go_count", ix.shape_sig(), et_tuple, steps),
+                lambda: make_batched_go_lanes_kernel(
+                    ix, steps, et_tuple, count=True, donate=True))
+            with tracing.span("tpu.kernel", kind="ell_go_count",
+                              width=B):
+                out_dev = kern(f0_dev, eslot, hrows, deg, *args[1:])
+        else:
             # family registration BEFORE the first/_note check (like
             # the sparse path): same-family queries racing the first
             # compile must still be counted against the warm
             first = (et_tuple, steps) not in getattr(m, "_prewarm_done",
                                                      set())
             self._prewarm_family(m, ix, et_tuple, steps)
-            self._note_live_shape(("ell_go", ix.shape_sig(), et_tuple,
-                                   steps, B),
-                                  first_of_family=first or upto)
-            with tracing.span("tpu.kernel", kind="ell_go", width=B):
-                out_dev = kern(f0_dev, *args)
+            if packed_mode:
+                kern = self._kernel(
+                    ("ell_go_packed", ix.shape_sig(), et_tuple, steps,
+                     upto),
+                    # donate=True: f0p is built fresh per dispatch
+                    # right above — single-use by construction
+                    lambda: make_batched_go_lanes_kernel(
+                        ix, steps, et_tuple, upto=upto, donate=True))
+                self._note_live_shape(
+                    ("ell_go_packed", ix.shape_sig(), et_tuple, steps,
+                     B), first_of_family=first or upto)
+                with tracing.span("tpu.kernel", kind="ell_go",
+                                  width=B, packed=True):
+                    out_dev = kern(f0_dev, eslot, hrows, *args[1:])
+            else:
+                kern = self._kernel(
+                    ("ell_go", ix.shape_sig(), et_tuple, steps, upto),
+                    lambda: make_batched_go_kernel(ix, steps, et_tuple,
+                                                   pack=True, upto=upto,
+                                                   donate=True))
+                self._note_live_shape(("ell_go", ix.shape_sig(),
+                                       et_tuple, steps, B),
+                                      first_of_family=first or upto)
+                with tracing.span("tpu.kernel", kind="ell_go", width=B):
+                    out_dev = kern(f0_dev, *args)
         self.stats["go_dense"] += 1
+        self._maybe_time_device(out_dev, hop_bytes, kind="ell_go")
+
+        if count_mode:
+            def resolve_counts():
+                counts = np.asarray(out_dev)      # [B] int32
+                self._note_fetch(counts)
+                return _DeviceCounts(counts[:nq].astype(np.int64)), m
+            return resolve_counts
 
         def resolve():
             # slice to the live query columns ON DEVICE before the
             # fetch — transferring all B padded columns at small nq
             # re-pays the cost the bit-packing exists to remove
-            nqp = min(B, max(8, -(-nq // 8) * 8))
-            packed = np.asarray(out_dev[:, :nqp])     # [G, nqp] uint8
-            bits = unpack_bits(packed[:, :nq], ix.n_rows + 1)
+            if packed_mode:
+                nwp = min(lanes_width(B), max(1, -(-nq // 8)))
+                lanes = np.asarray(out_dev[:, :nwp])  # [R1, nwp] uint8
+                self._note_fetch(lanes)
+                bits = unpack_lanes_host(lanes, nq)
+            else:
+                nqp = min(B, max(8, -(-nq // 8) * 8))
+                packed = np.asarray(out_dev[:, :nqp])  # [G, nqp] uint8
+                self._note_fetch(packed)
+                bits = unpack_bits(packed[:, :nq], ix.n_rows + 1)
             old = bits[ix.perm]                   # [n, nq] old dense ids
             qs, vs = np.nonzero(old.T)
             bounds = np.searchsorted(qs, np.arange(nq + 1))
@@ -1372,23 +1642,42 @@ class TpuQueryRuntime:
                     with self._lock:
                         self._prewarmed_shapes.add(shape_key)
                         self.stats["prewarm_compiled"] += 1
+                packed_mode = bool(flags.get("tpu_packed_frontier",
+                                             True))
+                if packed_mode:
+                    from .ell import (lanes_width,
+                                      make_batched_go_lanes_kernel)
+                    eslot, hrows = self._hub_merge_dev(m, ix)
                 for B in sorted(int(w) for w in
                                 str(flags.get("go_batch_widths") or
                                     "128,1024").split(",") if w.strip()):
                     if steps <= 1:
                         continue
-                    kern = self._kernel(
-                        ("ell_go", ix.shape_sig(), et_tuple, steps,
-                         False),
-                        lambda: make_batched_go_kernel(
-                            ix, steps, et_tuple, pack=True,
-                            donate=True))   # must match live dispatch
-                    kern.lower(i32((ix.n_rows + 1, B), np.int8),
-                               *args).compile()
-                    with self._lock:
-                        self._prewarmed_shapes.add(
+                    if packed_mode:
+                        kern = self._kernel(
+                            ("ell_go_packed", ix.shape_sig(), et_tuple,
+                             steps, False),
+                            lambda: make_batched_go_lanes_kernel(
+                                ix, steps, et_tuple, donate=True))
+                        kern.lower(
+                            i32((ix.n_rows + 1, lanes_width(B)),
+                                np.uint8),
+                            eslot, hrows, *args[1:]).compile()
+                        shape_key = ("ell_go_packed", ix.shape_sig(),
+                                     et_tuple, steps, B)
+                    else:
+                        kern = self._kernel(
                             ("ell_go", ix.shape_sig(), et_tuple, steps,
-                             B))
+                             False),
+                            lambda: make_batched_go_kernel(
+                                ix, steps, et_tuple, pack=True,
+                                donate=True))   # must match live dispatch
+                        kern.lower(i32((ix.n_rows + 1, B), np.int8),
+                                   *args).compile()
+                        shape_key = ("ell_go", ix.shape_sig(), et_tuple,
+                                     steps, B)
+                    with self._lock:
+                        self._prewarmed_shapes.add(shape_key)
                         self.stats["prewarm_compiled"] += 1
             except Exception:   # noqa: BLE001 — pre-warm must never
                 pass            # disturb serving
@@ -1413,6 +1702,86 @@ class TpuQueryRuntime:
             cached = m._hub_exp_cache = (jnp.asarray(ecnt),
                                          jnp.asarray(e0))
         return cached
+
+    def _hub_merge_dev(self, m: CsrMirror, ix: EllIndex):
+        """(eslot, hrows) device arrays for the packed kernels' OR-
+        merge (ell.EllIndex.hub_merge), cached per mirror."""
+        import jax.numpy as jnp
+        cached = getattr(m, "_hub_merge_cache", None)
+        if cached is None:
+            eslot, hrows = ix.hub_merge()
+            cached = m._hub_merge_cache = (jnp.asarray(eslot),
+                                           jnp.asarray(hrows))
+        return cached
+
+    def _deg_host(self, m: CsrMirror, et_tuple: Tuple[int, ...]
+                  ) -> np.ndarray:
+        """int64[n]: per-vertex final-hop candidate-edge count over the
+        OVER set — the COUNT(*)/LIMIT pushdown's degree vector, cached
+        per (mirror, OVER) beside _etype_edge_mask."""
+        cache = getattr(m, "_deg_cache", None)
+        if cache is None:
+            cache = m._deg_cache = {}
+        deg = cache.get(et_tuple)
+        if deg is None:
+            if len(cache) >= 8:
+                cache.clear()
+            mask = self._etype_edge_mask(m, et_tuple)
+            deg = np.bincount(m.edge_src[mask], minlength=m.n) \
+                .astype(np.int64)
+            cache[et_tuple] = deg
+        return deg
+
+    def _deg_dev(self, m: CsrMirror, ix: EllIndex,
+                 et_tuple: Tuple[int, ...]):
+        """int32[n_rows+1] NEW-id-space device copy of _deg_host (zero
+        for hub extra rows and the pad row, so junk extras never
+        count), cached per (mirror, OVER)."""
+        import jax.numpy as jnp
+        cache = getattr(m, "_deg_dev_cache", None)
+        if cache is None:
+            cache = m._deg_dev_cache = {}
+        dev = cache.get(et_tuple)
+        if dev is None:
+            if len(cache) >= 8:
+                cache.clear()
+            deg = np.zeros(ix.n_rows + 1, np.int32)
+            deg[ix.perm] = np.minimum(self._deg_host(m, et_tuple),
+                                      2**31 - 1).astype(np.int32)
+            dev = cache[et_tuple] = jnp.asarray(deg)
+        return dev
+
+    def _note_fetch(self, arr: np.ndarray) -> None:
+        """Account the bytes one resolver pulled over the link."""
+        with self._lock:
+            self.stats["fetch_bytes"] += int(arr.nbytes)
+
+    def _maybe_time_device(self, out_dev, bytes_moved: int,
+                           kind: str) -> None:
+        """Every Nth dispatch (tpu_device_timing_every): block on the
+        just-launched kernel and record device-compute time distinct
+        from link RTT — the roofline's compute-vs-link attribution.
+        Dispatch is async, so the wait measured here is (queue +)
+        device compute; the sampled dispatch serializes the pipeline,
+        which is why this is a sample, not every dispatch."""
+        n = int(flags.get("tpu_device_timing_every") or 0)
+        if n <= 0:
+            return
+        with self._lock:
+            self._timing_seq += 1
+            if self._timing_seq % n:
+                return
+        import time
+        import jax
+        t0 = time.perf_counter()
+        jax.block_until_ready(out_dev)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.stats["t_device_s"] += dt
+            self.stats["device_bytes_moved"] += int(bytes_moved)
+            self.stats["device_timed_dispatches"] += 1
+        _stats.observe("tpu.device_compute.latency_us", dt * 1e6,
+                       kind=kind)
 
     # ------------------------------------------------ host assembly
     def _assemble_results(self, space_id: int, m: CsrMirror,
@@ -1465,26 +1834,46 @@ class TpuQueryRuntime:
                 return
             plan = _GoPlan(m, plan.alias_to_etype, cval,
                            dict(compiler.used), plan.pushed_mode,
-                           compiler, plan.expr_str)
+                           compiler, plan.expr_str, sc_or=plan.sc_or)
 
         # concatenated final-hop candidates across the group
         vs_concat = [vs_lists[i] for i in idxs]
         cand, qseg, qbounds = self._frontier_edges_multi(m, vs_concat,
                                                          et_tuple)
 
-        # graphd-mode validity: a query with ANY invalid used prop on
-        # its candidates raises, per query (reference: ExprError in
-        # processFinalResult fails that query)
+        # WHERE validity: the compiled filter evaluates EVERY operand
+        # over vectorized columns, but the CPU executor SHORT-CIRCUITS
+        # (`x || $$.t.p > k` never touches the missing prop when x is
+        # truthy, and a missing prop only errors the query when the
+        # evaluation order actually reaches it).  A mask can't
+        # reproduce order-dependent semantics, so any query whose
+        # candidates carry an invalid used prop DECLINES to the CPU
+        # loop — which then short-circuits or raises exactly.  The
+        # all-valid common case (the generative differential's
+        # baseline) stays vectorized.
+        from ..storage.device import TpuDecline
         bad = np.zeros(len(idxs), dtype=bool)
-        if plan.filter_cval is not None and not plan.pushed_mode:
+        if plan.filter_cval is not None \
+                and (not plan.pushed_mode or plan.sc_or):
+            # pure-conjunction pushed filters keep the mask: skip-on-
+            # invalid == AND-with-validity.  Everything else declines
+            # the AFFECTED queries only (their batch neighbours keep
+            # their vectorized results)
             invalid = self._invalid_candidates(m, plan.filter_used, cand)
             if invalid is not None and invalid.any():
                 hit = np.unique(qseg[invalid])
                 bad[hit] = True
                 for g in hit:
                     i = idxs[int(g)]
-                    results[i] = queries[i].exc_type(
-                        "prop unavailable in WHERE")
+                    results[i] = TpuDecline(
+                        "WHERE reads a prop invalid on candidate rows; "
+                        "CPU short-circuit semantics decide")
+                # drop the declined queries' rows BEFORE the group
+                # mask: _host_filter re-raises on the same invalid
+                # bits, and a group-level raise would decline every
+                # healthy neighbour too
+                keep_rows = ~bad[qseg]
+                cand, qseg = cand[keep_rows], qseg[keep_rows]
 
         if plan.filter_cval is not None:
             mask = self._host_filter(m, plan, cand)
@@ -1571,9 +1960,13 @@ class TpuQueryRuntime:
             if comp.div_guards and not plan.pushed_mode:
                 raise TpuDecline("overlay div guard in graphd mode")
             dplan = _GoPlan(d, plan.alias_to_etype, cval, dict(comp.used),
-                            plan.pushed_mode, comp, plan.expr_str)
-            if not plan.pushed_mode:
-                self._check_valid(d, dplan.filter_used, cand, ExcType)
+                            plan.pushed_mode, comp, plan.expr_str,
+                            sc_or=plan.sc_or)
+            inv = self._invalid_candidates(d, dplan.filter_used, cand)
+            if inv is not None and inv.any() \
+                    and (not dplan.pushed_mode or dplan.sc_or):
+                raise TpuDecline("overlay WHERE reads an invalid prop; "
+                                 "CPU short-circuit semantics decide")
             idx = cand[self._host_filter(d, dplan, cand)]
         else:
             idx = cand
@@ -1598,6 +1991,23 @@ class TpuQueryRuntime:
             m = self.mirror_full(space_id)      # fused kernel: no overlay
             plan = self._replan_or_raise(space_id, plan, where_expr, m,
                                          ExcType)
+        from ..storage.device import TpuDecline
+        if plan.pushed_mode and plan.sc_or:
+            # the fused kernel ANDs validity into the mask; a
+            # disjunction short-circuits past missing props on the CPU
+            # path, so any invalid used column declines pre-dispatch
+            # (see _assemble_group — same rule, fused flavor)
+            for k, desc in plan.filter_used.items():
+                if desc[0] == "edge":
+                    col = m.edge_cols[(desc[1], desc[2])]
+                elif desc[0] == "vertex":
+                    col = m.vertex_cols[(desc[1], desc[2])]
+                else:
+                    continue
+                if not col.valid.all():
+                    raise TpuDecline(
+                        "fused WHERE with || reads a partially-invalid "
+                        "column; CPU short-circuit semantics decide")
         start_idx = _pad_pow2(m.to_dense(start_vids))
         # the fused dispatch must be phase-attributable like every
         # other kernel kind (DEVICE_PHASES) — PROFILE otherwise showed
@@ -1613,7 +2023,13 @@ class TpuQueryRuntime:
                     if not plan.pushed_mode else None)
         idx = np.nonzero(final_mask)[0]
         if not plan.pushed_mode:
-            self._check_valid(m, plan.filter_used, cand_idx, ExcType)
+            inv = self._invalid_candidates(m, plan.filter_used, cand_idx)
+            if inv is not None and inv.any():
+                # graphd-mode WHERE may or may not raise depending on
+                # the row-level evaluation order — the CPU loop decides
+                raise TpuDecline(
+                    "WHERE reads a prop invalid on candidate rows; "
+                    "CPU short-circuit semantics decide")
         rows = self._materialize(m, space_id, plan.alias_to_etype,
                                  etype_to_alias, yield_cols, idx, ExcType)
         if distinct:
@@ -1638,7 +2054,8 @@ class TpuQueryRuntime:
         except CompileError:
             raise ExcType("schema changed while the query ran")
         return _GoPlan(m, plan.alias_to_etype, cval, dict(compiler.used),
-                       plan.pushed_mode, compiler, plan.expr_str)
+                       plan.pushed_mode, compiler, plan.expr_str,
+                       sc_or=plan.sc_or)
 
     # -------------------------------------------------- host columns
     def _gather_cols(self, m: CsrMirror, alias_to_etype: Dict[str, int],
@@ -1693,6 +2110,17 @@ class TpuQueryRuntime:
                         else m.edge_dst[idx]
                     valid_snap[k] = \
                         m.vertex_cols[(desc[1], desc[2])].valid[gather]
+            if plan.sc_or and valid_snap \
+                    and not all(v.all() for v in valid_snap.values()):
+                # `x || missing` short-circuits on the per-row path
+                # (row kept without touching the prop); ANDing validity
+                # into the mask can't reproduce that — decline so the
+                # per-row evaluator decides (the generative WHERE
+                # differential's missing-column x disjunction cell)
+                from ..storage.device import TpuDecline
+                raise TpuDecline(
+                    "pushed WHERE with || over a partially-valid "
+                    "prop; per-row short-circuit semantics decide")
         env = Env(np, self._gather_cols(m, plan.alias_to_etype,
                                         plan.filter_used, idx))
         with np.errstate(divide="ignore", invalid="ignore"):
@@ -1920,22 +2348,6 @@ class TpuQueryRuntime:
             if hit.any():
                 idx, qseg = idx[~hit], qseg[~hit]
         return idx, qseg, np.searchsorted(qseg, np.arange(nq + 1))
-
-    # -------------------------------------------------- validity parity
-    @staticmethod
-    def _check_valid(m: CsrMirror, used: Dict[str, Tuple],
-                     cand_idx: np.ndarray, exc_type) -> None:
-        for k, desc in used.items():
-            if desc[0] == "edge":
-                col = m.edge_cols[(desc[1], desc[2])]
-                if not col.valid[cand_idx].all():
-                    raise exc_type(f"{desc[2]} unavailable")
-            elif desc[0] == "vertex":
-                col = m.vertex_cols[(desc[1], desc[2])]
-                gather = m.edge_src[cand_idx] if desc[3] == "src" \
-                    else m.edge_dst[cand_idx]
-                if not col.valid[gather].all():
-                    raise exc_type(f"{desc[2]} unavailable")
 
     # -------------------------------------------------- materialization
     def _materialize_group(self, m: CsrMirror, space_id: int,
@@ -2240,9 +2652,12 @@ class TpuQueryRuntime:
         return kern
 
     def _delta_device(self, m: CsrMirror, ix: EllIndex):
-        """(dsrc, ddst, det) device arrays for the insert overlay in the
-        ELL's new-id space, padded to a pow-2 capacity (cached per delta
-        generation)."""
+        """(cap, dsrc, ddst, det, dslot, drows) device arrays for the
+        insert overlay in the ELL's new-id space, padded to a pow-2
+        capacity (cached per delta generation).  dslot/drows are the
+        packed kernel's OR-merge grouping: each overlay edge's index
+        into the unique destination-row list (drows padded with the
+        out-of-bounds drop sentinel — ell._scatter_or_rows)."""
         import jax.numpy as jnp
         gen = m._delta_gen
         cached = getattr(m, "_delta_dev_cache", None)
@@ -2251,14 +2666,22 @@ class TpuQueryRuntime:
         d = m._delta
         cap = max(8, 1 << (max(d.m, 1) - 1).bit_length())
         pad = ix.n_rows            # the always-zero pad row
+        drop = ix.n_rows + 1       # out of bounds for [n_rows+1] rows
         dsrc = np.full(cap, pad, dtype=np.int32)
         ddst = np.full(cap, pad, dtype=np.int32)
         det = np.zeros(cap, dtype=np.int32)   # 0 never in an OVER set
+        dslot = np.zeros(cap, dtype=np.int32)
+        drows = np.full(cap, drop, dtype=np.int32)
         dsrc[:d.m] = ix.perm[d.edge_src]
         ddst[:d.m] = ix.perm[d.edge_dst]
         det[:d.m] = d.edge_etype
+        if d.m:
+            uniq, slot = np.unique(ddst[:d.m], return_inverse=True)
+            dslot[:d.m] = slot.astype(np.int32)
+            drows[:len(uniq)] = uniq.astype(np.int32)
         out = (cap, jnp.asarray(dsrc), jnp.asarray(ddst),
-               jnp.asarray(det))
+               jnp.asarray(det), jnp.asarray(dslot),
+               jnp.asarray(drows))
         m._delta_dev_cache = (gen, out)
         return out
 
@@ -2284,6 +2707,40 @@ class TpuQueryRuntime:
         f0 = jnp.zeros((ix.n_rows + 1, B), jnp.int8)
         return f0.at[jnp.asarray(rows_p), jnp.asarray(cols_p)].max(
             jnp.asarray(vals_p))
+
+    @staticmethod
+    def _upload_frontier_packed(ix: EllIndex, new_ids: np.ndarray,
+                                qcols: np.ndarray, B: int):
+        """Bit-packed twin of _upload_frontier: the device builds the
+        uint8 [rows+1, B/8] lane matrix from the same flat coordinate
+        upload.  (row, query) pairs are deduped HERE, so two bits never
+        collide in one scatter cell and scatter-ADD of distinct powers
+        of two is exact (a scatter-max would lose bits; see
+        ell._scatter_or_rows)."""
+        import jax.numpy as jnp
+        from .ell import lanes_width
+        if len(new_ids):
+            key = np.asarray(new_ids, np.int64) * max(B, 1) \
+                + np.asarray(qcols, np.int64)
+            _, first = np.unique(key, return_index=True)
+            new_ids = np.asarray(new_ids)[first]
+            qcols = np.asarray(qcols)[first]
+        S = len(new_ids)
+        Sp = max(8, 1 << (max(S, 1) - 1).bit_length())
+        pad_row = ix.n_rows
+        rows_p = np.full(Sp, pad_row, np.int32)
+        word_p = np.zeros(Sp, np.int32)
+        vals_p = np.zeros(Sp, np.uint8)
+        rows_p[:S] = new_ids
+        word_p[:S] = qcols >> 3
+        vals_p[:S] = np.uint8(1) << (qcols & 7).astype(np.uint8)
+        f0 = jnp.zeros((ix.n_rows + 1, lanes_width(B)), jnp.uint8)
+        f0 = f0.at[jnp.asarray(rows_p), jnp.asarray(word_p)].add(
+            jnp.asarray(vals_p))
+        # the pad row collected the Sp-S padding scatters (value 1<<0);
+        # it must stay all-zero — it is every sentinel slot's gather
+        # source
+        return f0.at[pad_row, :].set(0)
 
     def _go_batch_frontiers(self, space_id: int, starts_per_query,
                             et_tuple: Tuple[int, ...], kernel_steps: int):
@@ -2340,14 +2797,35 @@ class TpuQueryRuntime:
             # placement/overflow: replicated-frontier fallback below
         args = ix.kernel_args()
         mt = self._mesh_tables(m, ix)
-        if mt is None:
+        packed_mode = bool(flags.get("tpu_packed_frontier", True)) \
+            and mt is None
+        if packed_mode:
+            from .ell import make_batched_bfs_lanes_kernel
+            kern = self._kernel(
+                ("ell_bfs_packed", ix.shape_sig(), et_tuple, max_steps,
+                 shortest),
+                # donate=True: f0p/t0p are built fresh per dispatch
+                lambda: make_batched_bfs_lanes_kernel(
+                    ix, max_steps, et_tuple, stop_when_found=shortest,
+                    donate=True))
+            eslot, hrows = self._hub_merge_dev(m, ix)
+            f0_dev = self._upload_frontier_packed(
+                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
+            t0_dev = self._upload_frontier_packed(
+                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
+            call_args = (f0_dev, t0_dev, eslot, hrows, *args[1:])
+        elif mt is None:
             kern = self._kernel(
                 ("ell_bfs", ix.shape_sig(), et_tuple, max_steps, shortest),
                 # donate=True: f0/t0 are built fresh per dispatch below
                 lambda: make_batched_bfs_kernel(
                     ix, max_steps, et_tuple, stop_when_found=shortest,
                     donate=True))
-            table_args = args
+            f0_dev = self._upload_frontier(
+                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
+            t0_dev = self._upload_frontier(
+                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
+            call_args = (f0_dev, t0_dev, *args)
         else:
             mesh, nbrs, ets, reals = mt
             kern = self._kernel(
@@ -2356,19 +2834,26 @@ class TpuQueryRuntime:
                 lambda: make_sharded_batched_bfs_kernel(
                     mesh, "parts", ix, max_steps, et_tuple, nbrs, ets,
                     reals, stop_when_found=shortest))
-            table_args = (args[0], *nbrs, *ets)
-        f0_dev = self._upload_frontier(
-            ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
-        t0_dev = self._upload_frontier(
-            ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
+            f0_dev = self._upload_frontier(
+                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
+            t0_dev = self._upload_frontier(
+                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
+            call_args = (f0_dev, t0_dev, args[0], *nbrs, *ets)
         self.stats["path_device"] += nq
         with tracing.span("tpu.kernel",
                           kind="ell_bfs" if mt is None
                           else "ell_bfs_sharded", queries=nq):
-            d_dev = kern(f0_dev, t0_dev, *table_args)
+            d_dev = kern(*call_args)
+        from .ell import dense_hop_bytes, lanes_width
+        self._maybe_time_device(
+            d_dev,
+            dense_hop_bytes(ix, lanes_width(B) if packed_mode else B,
+                            max_steps + 1),
+            kind="ell_bfs")
         nqp = min(B, max(8, -(-nq // 8) * 8))
         with tracing.span("tpu.fetch"):
             host = np.asarray(d_dev[:, :nqp])[:, :nq]   # device slice
+            self._note_fetch(host)
         if host.dtype == np.int8:        # in-kernel compression (-1=INF)
             d = np.where(host < 0, INT16_INF, host).astype(np.int16)
         else:

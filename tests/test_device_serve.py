@@ -99,6 +99,41 @@ class TestRemoteParity:
                 or 0) > path0
 
 
+class TestReducePushdownWire:
+    """LIMIT/COUNT pushdown over the deviceGo RPC boundary: the reduce
+    descriptor rides the request, the response carries the reduced
+    shape + capability echo (storage/device.py, docs/roofline.md)."""
+
+    def test_limit_over_rpc(self, remote_cluster):
+        _, cl = remote_cluster
+        base = "GO 2 STEPS FROM 100 OVER follow YIELD follow._dst AS d"
+        full = cl.execute(base)
+        assert full.ok()
+        fset = {tuple(r) for r in full.rows}
+        r = cl.execute(base + " | LIMIT 1")
+        assert r.ok(), r.error_msg
+        assert len(r.rows) == min(1, len(full.rows))
+        assert all(tuple(row) in fset for row in r.rows)
+
+    def test_count_over_rpc_matches_cpu(self, remote_cluster):
+        _, cl = remote_cluster
+        q = ("GO 2 STEPS FROM 100, 102 OVER follow "
+             "YIELD follow._dst AS d | YIELD COUNT(*) AS n")
+        go0 = stats.read_stats("storage.device_go.qps.count.3600") or 0
+        r = cl.execute(q)
+        assert r.ok(), r.error_msg
+        assert (stats.read_stats("storage.device_go.qps.count.3600")
+                or 0) > go0, "count pipe must still serve on device"
+        flags.set("storage_backend", "cpu")
+        try:
+            r2 = cl.execute(q)
+        finally:
+            flags.set("storage_backend", "tpu")
+        assert r2.ok()
+        assert r.column_names == r2.column_names == ["n"]
+        assert sorted(map(tuple, r.rows)) == sorted(map(tuple, r2.rows))
+
+
 class TestDeclineFallback:
     def test_piped_input_runs_cpu(self, remote_cluster):
         """$- input is gated client-side; the piped GO must still return

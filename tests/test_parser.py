@@ -86,6 +86,19 @@ class TestPipesAndSets:
         s4 = parse1("GO FROM 1 OVER e INTERSECT GO FROM 2 OVER e")
         assert s4.op == ast.SetOpKind.INTERSECT
 
+    def test_count_star_parses_count_only(self):
+        """COUNT(*) is sugar for the no-arg aggregate; the star must
+        NOT generalize to other functions (SUM(*) has no meaning and
+        silently counting rows under a sum label would be wrong)."""
+        from nebula_tpu.filter.expressions import FunctionCallExpr
+        s = parse1("GO FROM 1 OVER e | YIELD COUNT(*)")
+        e = s.right.yield_.columns[0].expr
+        assert isinstance(e, FunctionCallExpr)
+        assert e.name.lower() == "count" and e.args == []
+        from nebula_tpu.graph.parser.parser import GQLParser
+        assert not GQLParser().parse(
+            "GO FROM 1 OVER e | YIELD SUM(*)").ok()
+
     def test_assignment(self):
         s = parse1("$var = GO FROM 1 OVER e")
         assert isinstance(s, ast.AssignmentSentence)
