@@ -454,6 +454,11 @@ class GoBatchDispatcher:
                 self.window.observe_depth(qlen)
                 no_wait = qlen <= 1 or \
                     qlen >= int(flags.get("go_batch_max") or 1024)
+                # snapshot the round-trip EMA while st.cond is still
+                # held: _window_s runs after the release below, and a
+                # concurrent leader's EMA update would race the bare
+                # read (guard-inference audit, round 10)
+                rt_ema_s = st.rt_ema_s
                 try:
                     # take the pipeline slot BEFORE snapshotting the
                     # batch: while go_batch_inflight batches are already
@@ -469,7 +474,7 @@ class GoBatchDispatcher:
                         # pipeline capacity the device could be using.
                         # (_window_s always evaluates so corrupt flag
                         # values fail fast even for lone requests)
-                        window = self._window_s(st)
+                        window = self._window_s(rt_ema_s)
                         if no_wait:
                             window = 0.0
                         if window > 0:
@@ -521,9 +526,11 @@ class GoBatchDispatcher:
         return req.result, req.mirror
 
     # ------------------------------------------------------------------
-    def _window_s(self, st: _KeyState) -> float:
+    def _window_s(self, rt_ema_s: float) -> float:
         """Pooling wait (seconds) the next leader observes before it
-        takes a pipeline slot.  Adaptive mode scales with the key's
+        takes a pipeline slot, from a round-trip EMA the caller
+        SNAPSHOTTED under the key's condition (this runs after the
+        leader released it).  Adaptive mode scales with the key's
         measured batch round-trip: on a ~100 ms-per-launch device link
         the wait pools arrivals into markedly wider batches (the
         per-batch link cost is flat in batch width), while on a local
@@ -539,7 +546,7 @@ class GoBatchDispatcher:
         # no falsy-`or` fallbacks here
         frac_raw = flags.get("go_batch_window_frac")
         frac = 0.12 if frac_raw is None else float(frac_raw)
-        return min(st.rt_ema_s * frac, self.window.cap_s())
+        return min(rt_ema_s * frac, self.window.cap_s())
 
     # ------------------------------------------------------------------
     def _run(self, key: Tuple, batch: List[_Request],

@@ -263,6 +263,8 @@ class DiskEngine(KVEngine):
         return os.path.join(self.dir, "MANIFEST")
 
     def _load_manifest(self) -> None:
+        """Caller holds the lock — or is ``__init__``'s recovery load,
+        before any reader/compactor thread exists."""
         path = self._manifest_path()
         if not os.path.exists(path):
             return
@@ -285,6 +287,10 @@ class DiskEngine(KVEngine):
                     pass
 
     def _commit_manifest(self) -> None:
+        """Caller holds the lock — the manifest must name exactly the
+        run set the holder just installed; the fsync'd tmp+rename is
+        the deliberate bounded-I/O-under-lock durability choice
+        (docs/durability.md)."""
         tmp = self._manifest_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"runs": [os.path.basename(r.path)
@@ -327,6 +333,11 @@ class DiskEngine(KVEngine):
         return _Run(path, self.index_every)
 
     def _flush_mem_locked(self) -> None:
+        """Caller holds the lock: the run write + manifest commit must
+        be atomic with the memtable swap (a reader between them would
+        miss the flushed rows), so this path deliberately pays bounded
+        run-file I/O under the engine lock; the O(dataset) compaction
+        merge is what runs on the background thread instead."""
         if not self._mem:
             return
         run = self._write_run(iter(self._mem.items()))
@@ -368,6 +379,8 @@ class DiskEngine(KVEngine):
             time.sleep(0.002)
 
     def _maybe_flush(self) -> None:
+        """Caller holds the lock (every write path checks the memtable
+        watermark inside its locked region)."""
         if self._mem_bytes >= self.mem_limit_bytes \
                 and self._batch_depth == 0:
             self._flush_mem_locked()
@@ -461,6 +474,8 @@ class DiskEngine(KVEngine):
 
     # ---- writes ------------------------------------------------------
     def _put_mem(self, key: bytes, value: object) -> None:
+        """Caller holds the lock (every put/remove path takes it
+        around the memtable update + flush check)."""
         old = self._mem.get(key)
         self._mem[key] = value
         vlen = 0 if value is _TOMBSTONE else len(value)
@@ -564,11 +579,20 @@ class DiskEngine(KVEngine):
                 # current memtable contents, so flush the memtable first
                 self._flush_mem_locked()
                 if sorted_ok:
+                    # snapshot ingest holds the lock across the run
+                    # write by design: the ingested rows must rank
+                    # newer than the just-flushed memtable and older
+                    # than any write landing after — an interleaved
+                    # writer would break last-wins ordering
+                    # nebulint: disable=blocking-under-lock
                     run = self._write_run(frames())
                 else:
                     dedup = {}                    # file order: last wins
+                    # same ingest-atomicity argument as above
+                    # nebulint: disable=blocking-under-lock
                     for k, v in frames():
                         dedup[k] = v
+                    # nebulint: disable=blocking-under-lock
                     run = self._write_run(iter(sorted(dedup.items())))
                 if run is not None:
                     self._runs.append(run)

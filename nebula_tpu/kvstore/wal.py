@@ -463,9 +463,9 @@ class FileBasedWal:
             i += 1
 
     def close(self) -> None:
-        self.flush()  # nebulint: disable=status-discard — best-effort
-        # teardown; a failed final flush already dropped its tail and
-        # there is no caller left to surface the Status to
+        self.flush()  # best-effort teardown; a failed final flush
+        # already dropped its tail and there is no caller left to
+        # surface the Status to
         if self._fd is not None:
             os.close(self._fd)
             self._fd = None

@@ -305,12 +305,19 @@ class MetaClient:
     # ---------------- cache load + diff ----------------
     def load_data(self) -> None:
         with self._load_lock:
+            # _load_lock is the SINGLE-FLIGHT gate, not a state lock:
+            # holding it across the meta RPCs is the point (concurrent
+            # refreshers wait for this load instead of duplicating the
+            # fan-out); cache swaps happen atomically at the end
+            # nebulint: disable=blocking-under-lock
             resp = self._call("listSpaces", {})
             new_spaces: Dict[int, SpaceInfoCache] = {}
             new_name_to_id: Dict[str, int] = {}
             for sp in resp["spaces"]:
                 sid = sp["id"]
                 try:
+                    # single-flight load, as above
+                    # nebulint: disable=blocking-under-lock
                     cache = self._load_space(sid, sp["name"])
                 except RpcError as e:
                     if e.status.code == ErrorCode.E_NOT_FOUND:

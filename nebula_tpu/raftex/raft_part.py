@@ -177,6 +177,10 @@ class RaftPart:
 
     # ------------------------------------------------------------ misc
     def _load_hard_state(self) -> None:
+        """Caller holds the lock — or is ``__init__``'s construction-
+        time load, before any worker thread exists (the guard-inference
+        contract: term/voted state is self._lock-guarded everywhere
+        else)."""
         if not self._state_path or not os.path.exists(self._state_path):
             return
         try:
@@ -208,6 +212,7 @@ class RaftPart:
         self._election_timeout = base * (1.0 + random.random())
 
     def _quorum(self) -> int:
+        """Caller holds the lock (peers is self._lock-guarded)."""
         voters = 1 + sum(1 for p in self.peers.values() if not p.is_learner)
         return voters // 2 + 1
 
@@ -290,6 +295,8 @@ class RaftPart:
         return Status.Error("append timed out", ErrorCode.E_CONSENSUS_ERROR)
 
     def _not_leader(self) -> Status:
+        """Caller holds the lock (the leader hint must be the one the
+        role check just read)."""
         return Status.Error(f"not a leader, leader is {self.leader}",
                             ErrorCode.E_LEADER_CHANGED)
 
@@ -607,7 +614,12 @@ class RaftPart:
                             s_prev_term = self.wal.get_term(s_prev_id) \
                                 if s_prev_id else 0
                             continue
-                    # WAL doesn't reach back that far → snapshot
+                    # WAL doesn't reach back that far → snapshot.
+                    # Peer.lock is the per-peer CONVERSATION lock: it
+                    # exists to serialize exactly this stream to one
+                    # follower (reference Host.h), so the RPCs run
+                    # under it by design; every other peer replicates
+                    # in parallel  # nebulint: disable=blocking-under-lock
                     if not self._send_snapshot(peer, term):
                         return False
                     with self._lock:
@@ -837,6 +849,8 @@ class RaftPart:
             return self._append_resp(None)
 
     def _append_resp(self, err: Optional[ErrorCode]) -> dict:
+        """Caller holds the lock — term/committed_id must be the values
+        the append decision was made against."""
         return {
             "code": int(err) if err else 0,
             "term": self.term,

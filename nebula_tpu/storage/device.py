@@ -129,15 +129,19 @@ class DeviceCircuitBreaker:
     def __init__(self):
         from ..common.ordered_lock import OrderedLock
         self._lock = OrderedLock("tpu.breaker")
+        # nebulint: guarded-by=_lock (state transitions; the CLOSED
+        # probes below are the documented lock-free exceptions)
         self._cells: Dict[Tuple[int, str], _BreakerCell] = {}
 
     # ------------------------------------------------------- hot path
     def admit(self, key: Tuple[int, str]) -> Optional[str]:
         """None = run on the device (possibly as the half-open probe);
         a string = decline reason (breaker open)."""
+        # lock-free fast path; anything non-closed re-reads under the
+        # lock below  # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None or cell.state == "closed":
-            return None                      # lock-free fast path
+            return None
         from ..common.stats import stats
         with self._lock:
             cell = self._cells.get(key)
@@ -160,6 +164,9 @@ class DeviceCircuitBreaker:
         """Non-mutating peek (no probe token consumed): used by the
         in-process can_run_* gates to route to CPU without paying a
         plan/mirror attempt against a known-broken device."""
+        # deliberately lock-free: a stale peek routes one query to the
+        # wrong path once, never corrupts breaker state
+        # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None or cell.state == "closed":
             return False
@@ -176,6 +183,8 @@ class DeviceCircuitBreaker:
         close the cell (only a real device success proves health) and
         do NOT clear the consecutive-failure count on closed cells (an
         unclassified error is neutral, not a device success)."""
+        # lock-free empty probe; the mutation re-reads under the lock
+        # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None:
             return
@@ -185,9 +194,12 @@ class DeviceCircuitBreaker:
                 cell.probing = False
 
     def record_success(self, key: Tuple[int, str]) -> None:
+        # hot path: nothing tracked for a healthy cell; any real
+        # transition re-reads under the lock below
+        # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None or (cell.state == "closed" and cell.fails == 0):
-            return                           # hot path: nothing tracked
+            return
         from ..common.stats import stats
         with self._lock:
             cell = self._cells.get(key)

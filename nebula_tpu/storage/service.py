@@ -623,8 +623,9 @@ class StorageService:
         (storage_backend=cpu) or its jax substrate imports/configures."""
         if flags.get("storage_backend") == "cpu":
             return True
-        if self._device_rt is not None or self._backend_rt is not None:
-            return True
+        with self._device_rt_lock:
+            if self._device_rt is not None or self._backend_rt is not None:
+                return True
         try:
             from ..tpu.jax_setup import ensure_jax_configured
             ensure_jax_configured()

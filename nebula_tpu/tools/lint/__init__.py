@@ -3,7 +3,7 @@
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
 on Status/StatusOr, clang-tidy, sanitizer builds) plus a Thrift IDL
 that makes wire drift a compile error — both lost in a Python
-reproduction.  nebulint restores the project-specific part as nine
+reproduction.  nebulint restores the project-specific part as fourteen
 whole-package checks gated as a tier-1 test (tests/test_lint.py):
 
   lock-discipline   attributes mutated from thread entry points without
@@ -25,19 +25,47 @@ whole-package checks gated as a tier-1 test (tests/test_lint.py):
                     METRIC_NAMES registry (common/stats.py); entries
                     ending `.*` license f-string families; dead
                     entries flagged
+  guard-inference   FLOW: for every lock-declaring class in the
+                    concurrency-bearing packages, infer which
+                    ``self._x`` attributes the lock guards (strict
+                    majority of accesses under ``with self._lock``)
+                    and flag unguarded/mixed-lock accesses;
+                    ``# nebulint: guarded-by=_lock`` pins the
+                    inference (guards.py — the static mini-TSan)
+  blocking-under-lock  FLOW: within-module call-graph propagation of
+                    blocking effects (RPC dials, sleeps, untimed
+                    cond-waits, file I/O, device syncs) into ``with
+                    <lock>`` regions — the interprocedural form of
+                    the "fan-out under the catalog write lock stalls
+                    heartbeats" bug class (blocking.py)
+  context-capture   FLOW: pool/Thread submissions from Deadline- or
+                    trace-bound code must capture-and-rebind both
+                    (tracing.capture/attach_captured +
+                    deadlines.bind); thread-local deadline consults
+                    inside pool workers outside any bind scope are
+                    flagged too (capture.py)
   jaxpr-audit       SEMANTIC: traces every registered kernel factory
                     (tpu/kernels.py KERNEL_REGISTRY) across the
                     runtime's real shape buckets and proves, on the
                     jaxpr: no host callbacks in loop bodies, no 64-bit
                     promotion of persistent buffers, donation where
                     claimed, a bounded recompile-key space, transfer
-                    counts matching runtime.DEVICE_PHASES
+                    counts matching runtime.DEVICE_PHASES, and — new
+                    in v3 — per-rung peak resident bytes within the
+                    declared per-device HBM budget plus the
+                    edge-ceiling arithmetic (runtime.HBM_MODEL)
   wire-contract     SEMANTIC: cross-checks every RPC client call site
                     against the rpc_* handlers (orphan methods and
                     handlers, request-key drift, response-envelope
                     drift, the transport frame contract, the
                     /get_stats//traces//faults endpoint payloads) —
                     the Thrift-IDL guarantee, restored mechanically
+
+  stale-suppression META: a ``# nebulint: disable=`` comment whose
+                    check ran but suppressed nothing at that site is
+                    itself flagged (core.py) — fossils must not swallow
+                    the NEXT violation landing on their line; the CLI
+                    treats unused baseline.json entries the same way
 
 Suppression: ``# nebulint: disable=<check>[,<check>]`` on the flagged
 line (or the line above), ``# nebulint: disable-file=<check>`` anywhere

@@ -48,15 +48,23 @@ def main(argv=None) -> int:
 
     for v in vs:
         print(f"{v.path}:{v.line}: [{v.check}] ({v.symbol}) {v.message}")
-    if bl is not None:
-        stale = bl.unused()
-        for e in stale:
-            print(f"stale baseline entry (no longer fires): "
-                  f"{e['file']} {e['symbol']} [{e['check']}]",
-                  file=sys.stderr)
+    stale = bl.unused() if bl is not None else []
+    for e in stale:
+        print(f"stale baseline entry (no longer fires): "
+              f"{e['file']} {e['symbol']} [{e['check']}]",
+              file=sys.stderr)
     n = len(vs)
-    if n:
-        print(f"nebulint: {n} unsuppressed violation(s)", file=sys.stderr)
+    if n or stale:
+        if n:
+            print(f"nebulint: {n} unsuppressed violation(s)",
+                  file=sys.stderr)
+        if stale:
+            # a fossilized baseline entry is a finding too (the
+            # stale-suppression stance, applied to baseline.json):
+            # prune it or it will silently swallow the NEXT violation
+            print(f"nebulint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}",
+                  file=sys.stderr)
         return 1
     print("nebulint: clean")
     return 0

@@ -1,0 +1,332 @@
+"""blocking-under-lock — interprocedural stall detection.
+
+lock-discipline (locks.py) flags a ``time.sleep``/RPC/``os.fsync``
+LEXICALLY inside a ``with <lock>`` block.  That misses the PR 6 class
+of bug entirely: ``rpc_download`` fanning out 120-second RPCs from a
+helper CALLED under the catalog write lock would stall every
+heartbeat, and no single function body shows both the lock and the
+dial.  This pass builds a within-module call graph (``self.m()``,
+same-module free functions, nested defs by local name) and propagates
+each callable's BLOCKING EFFECTS up it:
+
+  rpc        a client-manager ``.call(...)`` round trip
+  sleep      ``time.sleep`` / bare ``sleep``
+  cond-wait  ``.wait()`` / ``.wait_for()`` with NO timeout — an
+             untimed wait on some OTHER object while holding a lock
+             is an unbounded stall (waiting on the condition that
+             WRAPS the held lock is fine: the wait releases it)
+  file-io    ``open(...)`` / ``os.fsync`` — disk latency under a lock
+             serializes every other holder behind the spindle
+  device     ``.block_until_ready()`` / ``jax.device_put`` — a device
+             sync or transfer can take a full dispatch round trip
+
+A violation is any statement inside a ``with <lock>`` block whose call
+REACHES a blocking effect through the call graph (the chain is named
+in the message), or that performs a cond-wait/file-io/device effect
+directly.  Direct sleep/rpc/fsync stay lock-discipline's findings —
+this pass would only duplicate them.
+
+"Caller holds the lock" methods are not scanned for their OWN body
+(they have no ``with``); the call SITE under the lock inherits their
+effects, which is where the fix belongs.  Justified stalls (a WAL
+fsync that must be atomic with the tail map update) carry
+``# nebulint: disable=blocking-under-lock`` with their reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import PackageContext, Violation, dotted
+from .locks import (_CALLER_HOLDS, _RPC_RECEIVERS, _attr_owner_map,
+                    _collect_classes, _with_lock_ranks, _ClassInfo)
+
+CHECK = "blocking-under-lock"
+
+# effects a DIRECT op under a lock reports here (the others are
+# lock-discipline's findings when direct — only their interprocedural
+# reachability is new)
+_DIRECT_EFFECTS = ("cond-wait", "file-io", "device")
+
+
+def _timeout_missing(call: ast.Call, leaf: str) -> bool:
+    """True when a .wait()/.wait_for() call carries no timeout."""
+    if any(kw.arg == "timeout" and not (isinstance(kw.value, ast.Constant)
+                                        and kw.value.value is None)
+           for kw in call.keywords):
+        return False
+    limit = 0 if leaf == "wait" else 1     # wait_for(predicate, timeout)
+    return len(call.args) <= limit
+
+
+def _direct_effect(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(effect kind, op spelling) for a call that blocks by itself."""
+    d = dotted(call.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf == "sleep":
+        return "sleep", d or leaf
+    if d == "os.fsync":
+        return "file-io:direct", d
+    if d == "open" or d.endswith(".open") and d.startswith("os"):
+        return "file-io", d
+    if leaf == "block_until_ready" or d in ("jax.device_put", "device_put"):
+        return "device", d
+    if leaf in ("wait", "wait_for") and "." in d \
+            and _timeout_missing(call, leaf):
+        return "cond-wait", d
+    if leaf == "call":
+        parts = d.split(".")
+        if len(parts) >= 2 and parts[-2] in _RPC_RECEIVERS:
+            return "rpc", d
+    return None
+
+
+class _FnNode:
+    __slots__ = ("qual", "node", "cls", "direct", "calls", "effects",
+                 "vouched")
+
+    def __init__(self, qual: str, node: ast.AST, cls: Optional[str]):
+        self.qual = qual
+        self.node = node
+        self.cls = cls                      # owning class name or None
+        # (effect kind, op spelling, line) performed directly
+        self.direct: List[Tuple[str, str, int]] = []
+        # callee qualnames with the call line
+        self.calls: List[Tuple[str, int]] = []
+        # fixpoint: effect -> (chain string, representative line)
+        self.effects: Dict[str, Tuple[str, int]] = {}
+        # a "caller holds the lock" docstring contract VOUCHES for
+        # bounded disk I/O: the method documents that it runs under the
+        # lock, so an fsync there is a deliberate durability choice
+        # (raft hard-state persistence, the engine's memtable flush) —
+        # the written-down convention is what review needs, same stance
+        # as locks.py.  Unbounded effects (rpc, sleep, untimed waits,
+        # device syncs) are NEVER vouched: no docstring makes a
+        # heartbeat-stalling dial under a lock correct
+        doc = ast.get_docstring(node) if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+        self.vouched = bool(doc and _CALLER_HOLDS.search(doc))
+
+
+def _collect_fns(tree: ast.AST) -> Dict[str, _FnNode]:
+    """Every function/method/nested def keyed by dotted qualname."""
+    out: Dict[str, _FnNode] = {}
+
+    def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, q, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out[q] = _FnNode(q, child, cls)
+                walk(child, q, cls)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out
+
+
+def _resolve_callee(d: str, fn: _FnNode,
+                    fns: Dict[str, _FnNode]) -> Optional[str]:
+    """Within-module resolution: self.m() -> Class.m; bare f() -> a
+    nested def of an enclosing scope or a module-level function."""
+    if d.startswith("self.") and d.count(".") == 1 and fn.cls:
+        cand = f"{fn.cls}.{d.split('.', 1)[1]}"
+        if cand in fns:
+            return cand
+        return None
+    if "." in d:
+        return None
+    # nested def lookup, innermost scope outward, then module level
+    parts = fn.qual.split(".")
+    for depth in range(len(parts), -1, -1):
+        cand = ".".join(parts[:depth] + [d])
+        if cand in fns and cand != fn.qual:
+            return cand
+    return None
+
+
+def _scan_direct(fn: _FnNode, fns: Dict[str, _FnNode]) -> None:
+    """Direct effects + outgoing calls of ONE function body (nested
+    defs are their own nodes — a closure's op only blocks when the
+    closure is actually called)."""
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass                            # nested: separate node
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call) -> None:
+            eff = _direct_effect(node)
+            if eff:
+                fn.direct.append((eff[0], eff[1], node.lineno))
+            d = dotted(node.func)
+            if d:
+                callee = _resolve_callee(d, fn, fns)
+                if callee:
+                    fn.calls.append((callee, node.lineno))
+            self.generic_visit(node)
+
+    body = getattr(fn.node, "body", [])
+    for stmt in body:
+        V().visit(stmt)
+
+
+def _propagate(fns: Dict[str, _FnNode]) -> None:
+    """Fixpoint: a function inherits its callees' effects, with the
+    call chain recorded for the report.  Vouched functions (caller-
+    holds contract) never expose file-io — see _FnNode.vouched."""
+    for fn in fns.values():
+        for kind, op, line in fn.direct:
+            k = kind.split(":")[0]
+            if k == "file-io" and fn.vouched:
+                continue
+            fn.effects.setdefault(k, (op, line))
+    changed = True
+    while changed:
+        changed = False
+        for fn in fns.values():
+            for callee, line in fn.calls:
+                for k, (chain, _l) in fns[callee].effects.items():
+                    if k == "file-io" and fn.vouched:
+                        continue
+                    if k not in fn.effects:
+                        leaf = callee.rsplit(".", 1)[-1]
+                        fn.effects[k] = (f"{leaf}() -> {chain}", line)
+                        changed = True
+
+
+def check_blocking_under_lock(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        fns = _collect_fns(mod.tree)
+        if not fns:
+            continue
+        for fn in fns.values():
+            _scan_direct(fn, fns)
+        _propagate(fns)
+        infos = _module_classes(ctx, mod)
+        attr_owner = _attr_owner_map([i for lst in infos.values()
+                                      for i in lst] if infos else [])
+        for qual, fn in sorted(fns.items()):
+            info = _owning_info(infos, fn)
+            scan = _LockScan(mod, fn, fns, info, attr_owner)
+            for stmt in getattr(fn.node, "body", []):
+                scan.visit(stmt)
+            out += scan.out
+    return out
+
+
+def _module_classes(ctx: PackageContext, mod) -> Dict[str, List[_ClassInfo]]:
+    infos: Dict[str, List[_ClassInfo]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            infos.setdefault(node.name, []).append(
+                _ClassInfo(node, mod.rel))
+    # populate locks/methods the way locks._collect_classes does
+    from .locks import _is_lock_ctor
+    for lst in infos.values():
+        for info in lst:
+            for item in info.node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    info.methods[item.name] = item
+                    if "lock" in item.name.lower():
+                        info.lock_getters.add(item.name)
+            for sub in ast.walk(info.node):
+                if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            info.locks.add(tgt.attr)
+    return infos
+
+
+def _owning_info(infos: Dict[str, List[_ClassInfo]],
+                 fn: _FnNode) -> Optional[_ClassInfo]:
+    if fn.cls and fn.cls in infos:
+        return infos[fn.cls][0]
+    return None
+
+
+class _LockScan(ast.NodeVisitor):
+    """One function body: flag calls under a held lock that reach a
+    blocking effect (interprocedurally), or perform a cond-wait /
+    file-io / device effect directly."""
+
+    def __init__(self, mod, fn: _FnNode, fns: Dict[str, _FnNode],
+                 info: Optional[_ClassInfo], attr_owner):
+        self.mod = mod
+        self.fn = fn
+        self.fns = fns
+        self.info = info
+        self.attr_owner = attr_owner
+        self.held: List[Tuple[str, str]] = []    # (rank, source dotted)
+        self.out: List[Violation] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        # pair each rank with ITS context manager's source expression:
+        # _with_lock_ranks skips non-lock items (`with tracing.span(),
+        # self._cond:`), so ranks must be derived per item or the
+        # rank/source pairs misalign and _wait_on_held misfires
+        add = []
+        for item in node.items:
+            one = ast.With(items=[item], body=[])
+            for r in _with_lock_ranks(one, self.info, self.attr_owner):
+                d = dotted(item.context_expr) \
+                    or (dotted(item.context_expr.func)
+                        if isinstance(item.context_expr, ast.Call)
+                        else None)
+                add.append((r, d or ""))
+        self.held += add
+        for stmt in node.body:
+            self.visit(stmt)
+        if add:
+            del self.held[-len(add):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return                      # nested defs scanned as own nodes
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def _emit(self, line: int, effect: str, desc: str) -> None:
+        held = "/".join(r for r, _s in self.held)
+        self.out.append(Violation(
+            CHECK, self.mod.rel, line, self.fn.qual,
+            f"{effect} reached while holding {held}: {desc} — "
+            f"RPC dials, untimed waits, disk I/O and device syncs "
+            f"must not run under a lock"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            eff = _direct_effect(node)
+            if eff and eff[0] in _DIRECT_EFFECTS:
+                kind, op = eff
+                if kind != "cond-wait" or not self._wait_on_held(node):
+                    self._emit(node.lineno, kind, op)
+            d = dotted(node.func)
+            callee = _resolve_callee(d, self.fn, self.fns) if d else None
+            if callee and self.fns[callee].effects:
+                effs = self.fns[callee].effects
+                kinds = "+".join(sorted(effs))
+                chain = effs[sorted(effs)[0]][0]
+                leaf = callee.rsplit(".", 1)[-1]
+                self._emit(node.lineno, kinds, f"{leaf}() -> {chain}")
+        self.generic_visit(node)
+
+    def _wait_on_held(self, node: ast.Call) -> bool:
+        """self.cond.wait() inside ``with self.cond:`` releases the
+        held condition — not a stall on THAT lock.  It IS one when any
+        OTHER lock is held too."""
+        d = dotted(node.func) or ""
+        recv = d.rsplit(".", 1)[0]
+        held_srcs = [s for _r, s in self.held]
+        return len(self.held) == 1 and recv in held_srcs

@@ -1,0 +1,296 @@
+"""context-capture — escape analysis for Deadline/trace propagation.
+
+PR 3 gave every request a trace context and PR 6 a whole-request
+Deadline; both live in THREAD-LOCALS, so every hop onto a pool thread
+must explicitly carry them across (``tctx = tracing.capture()`` on the
+submitting side, ``with tracing.attach_captured(tctx)`` +
+``with deadlines.bind(dl)`` on the worker — storage/client.py
+collect/_call_host is the canonical pair).  A new pool submission that
+forgets either ships silently: spans orphan, and a worker's RPCs run
+UNBOUNDED while the query's budget keeps ticking — until chaos finds
+it.  This pass finds it first:
+
+  * drop-trace: a ``Thread(target=...)`` / ``pool.submit(...)`` /
+    ``run_in_executor`` whose submitting function is TRACE-BOUND (the
+    submission is lexically inside ``with tracing.span(...)`` /
+    ``start_trace(...)``, or the function took ``tracing.capture()``)
+    but whose submitted callable (resolved within the module: nested
+    def, lambda, ``self.method``, module function) never calls
+    ``tracing.attach_captured``/``attach``;
+  * drop-deadline: same submission where the submitting function is
+    DEADLINE-BOUND (inside ``with deadlines.bind(...)``, or it read
+    ``deadlines.current()``) but the callable never rebinds a deadline
+    (``deadlines.bind(...)``);
+  * escaped-deadline: inside a submitted callable, a thread-local
+    consult (``deadlines.current()`` / ``deadlines.remaining_or(...)``)
+    with no enclosing ``deadlines.bind(...)`` in that callable — the
+    binding scope it would read exited with the submitting thread, so
+    the read sees nothing (or worse, an unrelated request's budget).
+
+Deliberate drops are real: a background rebuild borrowed onto a
+request thread must NOT inherit the request's budget
+(common/deadline.py).  Those carry ``# nebulint:
+disable=context-capture`` with the justification, same as every check.
+Unresolvable callables (externally imported workers) are skipped —
+the pass proves what it can see, package-locally, per module.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import PackageContext, Violation, dotted
+
+CHECK = "context-capture"
+
+_TRACE_BINDERS = {"span", "start_trace"}        # tracing.<leaf>
+_TRACE_RECEIVERS = {"tracing"}
+_DEADLINE_RECEIVERS = {"deadline", "deadlines"}
+_REBIND_TRACE = {"attach_captured", "attach"}
+_SUBMITS = {"submit", "run_in_executor", "start_new_thread"}
+
+
+def _is_tracing_call(call: ast.Call, leaves: Set[str]) -> bool:
+    d = dotted(call.func) or ""
+    parts = d.split(".")
+    return len(parts) >= 2 and parts[-2] in _TRACE_RECEIVERS \
+        and parts[-1] in leaves
+
+
+def _is_deadline_call(call: ast.Call, leaves: Set[str]) -> bool:
+    d = dotted(call.func) or ""
+    parts = d.split(".")
+    return len(parts) >= 2 and parts[-2] in _DEADLINE_RECEIVERS \
+        and parts[-1] in leaves
+
+
+class _Submission:
+    __slots__ = ("line", "target", "trace_bound", "deadline_bound")
+
+    def __init__(self, line: int, target: ast.AST,
+                 trace_bound: bool, deadline_bound: bool):
+        self.line = line
+        self.target = target            # the callable expression
+        self.trace_bound = trace_bound
+        self.deadline_bound = deadline_bound
+
+
+def _submission_of(call: ast.Call) -> Optional[ast.AST]:
+    """The callable expression when ``call`` hands work to a
+    thread/pool, else None."""
+    d = dotted(call.func) or ""
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if leaf in _SUBMITS and call.args:
+        if leaf == "run_in_executor" and len(call.args) >= 2:
+            return call.args[1]
+        return call.args[0]
+    return None
+
+
+class _FnIndex:
+    """Resolvable callables of one module: nested defs and lambdas by
+    enclosing scope, methods by class, functions at module level."""
+
+    def __init__(self, tree: ast.AST):
+        self.defs: Dict[str, ast.AST] = {}
+
+        def walk(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    if not isinstance(child, ast.ClassDef):
+                        self.defs[q] = child
+                    walk(child, q)
+                else:
+                    walk(child, prefix)
+
+        walk(tree, "")
+
+    def resolve(self, expr: ast.AST, scope: str,
+                cls: Optional[str]) -> Optional[ast.AST]:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        d = dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and cls:
+            return self.defs.get(f"{cls}.{d.split('.', 1)[1]}")
+        if "." in d:
+            return None
+        parts = scope.split(".") if scope else []
+        for depth in range(len(parts), -1, -1):
+            hit = self.defs.get(".".join(parts[:depth] + [d]))
+            if hit is not None:
+                return hit
+        return None
+
+
+def _body_calls(fn: ast.AST):
+    """Calls in a callable's body, nested defs included (a worker may
+    delegate its rebinding to a helper it defines)."""
+    nodes = fn.body if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) else [fn.body]
+    for stmt in nodes:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+def _rebinds_trace(fn: ast.AST) -> bool:
+    return any(_is_tracing_call(c, _REBIND_TRACE) for c in _body_calls(fn))
+
+
+def _rebinds_deadline(fn: ast.AST) -> bool:
+    return any(_is_deadline_call(c, {"bind"}) for c in _body_calls(fn))
+
+
+class _SubmitScan(ast.NodeVisitor):
+    """One function: track trace/deadline-bound lexical scope and
+    collect submissions.  ``capture()``/``current()`` reads taint the
+    rest of the function (the captured value outlives the with block
+    it was taken in)."""
+
+    def __init__(self):
+        self.trace_depth = 0
+        self.deadline_depth = 0
+        self.trace_tainted = False
+        self.deadline_tainted = False
+        self.subs: List[_Submission] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        t = d = 0
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                if _is_tracing_call(expr, _TRACE_BINDERS):
+                    t += 1
+                if _is_deadline_call(expr, {"bind"}):
+                    d += 1
+        self.trace_depth += t
+        self.deadline_depth += d
+        self.generic_visit(node)
+        self.trace_depth -= t
+        self.deadline_depth -= d
+
+    def visit_FunctionDef(self, node):
+        pass                    # nested defs: their own submissions
+                                # are scanned in their own pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_tracing_call(node, {"capture"}):
+            self.trace_tainted = True
+        if _is_deadline_call(node, {"current"}):
+            self.deadline_tainted = True
+        target = _submission_of(node)
+        if target is not None:
+            self.subs.append(_Submission(
+                node.lineno, target,
+                self.trace_depth > 0 or self.trace_tainted,
+                self.deadline_depth > 0 or self.deadline_tainted))
+        self.generic_visit(node)
+
+
+class _EscapeScan(ast.NodeVisitor):
+    """Inside a SUBMITTED callable: thread-local deadline consults
+    outside any deadlines.bind() scope."""
+
+    def __init__(self):
+        self.depth = 0
+        self.hits: List[Tuple[int, str]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        d = sum(1 for item in node.items
+                if isinstance(item.context_expr, ast.Call)
+                and _is_deadline_call(item.context_expr, {"bind"}))
+        self.depth += d
+        self.generic_visit(node)
+        self.depth -= d
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.depth == 0 \
+                and _is_deadline_call(node, {"current", "remaining_or"}):
+            self.hits.append((node.lineno, dotted(node.func) or "?"))
+        self.generic_visit(node)
+
+
+def check_context_capture(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        index = _FnIndex(mod.tree)
+        # walk every function with its (scope qualname, owning class)
+        stack: List[Tuple[ast.AST, str, Optional[str]]] = [(mod.tree, "",
+                                                            None)]
+        fn_ctx: List[Tuple[ast.AST, str, Optional[str]]] = []
+        while stack:
+            node, prefix, cls = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    stack.append((child, q, child.name))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    fn_ctx.append((child, q, cls))
+                    stack.append((child, q, cls))
+                else:
+                    stack.append((child, prefix, cls))
+        submitted: List[Tuple[ast.AST, str, int]] = []
+        for fn, qual, cls in fn_ctx:
+            scan = _SubmitScan()
+            for stmt in fn.body:
+                scan.visit(stmt)
+            for sub in scan.subs:
+                target = index.resolve(sub.target, qual, cls)
+                tname = dotted(sub.target) or "<lambda>"
+                if target is not None:
+                    submitted.append((target, qual, sub.line))
+                if not (sub.trace_bound or sub.deadline_bound):
+                    continue
+                if target is None:
+                    continue        # externally defined worker: can't see
+                if sub.trace_bound and not _rebinds_trace(target):
+                    out.append(Violation(
+                        CHECK, mod.rel, sub.line, qual,
+                        f"pool submission of {tname} from trace-bound "
+                        f"code never calls tracing.attach_captured — "
+                        f"the worker's spans orphan (capture() on the "
+                        f"submitting side, attach_captured in the "
+                        f"worker)"))
+                if sub.deadline_bound and not _rebinds_deadline(target):
+                    out.append(Violation(
+                        CHECK, mod.rel, sub.line, qual,
+                        f"pool submission of {tname} from deadline-"
+                        f"bound code never rebinds the budget — the "
+                        f"worker's RPCs run unbounded while the "
+                        f"query's clock ticks (pass the Deadline and "
+                        f"deadlines.bind it in the worker)"))
+        seen_targets = set()
+        for target, qual, line in submitted:
+            # the same worker submitted from N sites is ONE defect —
+            # dedup by the resolved callable before the escape scan
+            if id(target) in seen_targets:
+                continue
+            seen_targets.add(id(target))
+            esc = _EscapeScan()
+            body = target.body if isinstance(
+                target, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                else [target.body]
+            for stmt in body:
+                esc.visit(stmt)
+            for hline, op in esc.hits:
+                out.append(Violation(
+                    CHECK, mod.rel, hline, qual,
+                    f"{op} consulted on a pool thread outside any "
+                    f"deadlines.bind scope — the submitting thread's "
+                    f"binding exited with it; capture the Deadline "
+                    f"object and bind it here"))
+    return out

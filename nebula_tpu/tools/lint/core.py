@@ -47,6 +47,10 @@ class Module:
         # line -> set of checks disabled on that line
         self.line_disable: Dict[int, set] = {}
         self.file_disable: set = set()
+        # (check, comment line) pairs that actually suppressed a
+        # violation this run — the stale-suppression detector's input
+        # (file-level hits record line 0)
+        self.suppress_hits: set = set()
         self._parse_suppressions()
 
     _SUPPRESS = re.compile(
@@ -65,10 +69,12 @@ class Module:
 
     def suppressed(self, check: str, line: int) -> bool:
         if check in self.file_disable or "all" in self.file_disable:
+            self.suppress_hits.add((check, 0))
             return True
         for ln in (line, line - 1):
             marks = self.line_disable.get(ln)
             if marks and (check in marks or "all" in marks):
+                self.suppress_hits.add((check, ln))
                 return True
         return False
 
@@ -101,6 +107,10 @@ class Baseline:
                         f"must carry a justification)")
             self.by_key[(e["check"], e["file"], e["symbol"])] = e
         self.hits: set = set()
+        # populated by run_lint: the checks that actually ran — an
+        # entry for a check that did NOT run cannot be judged stale
+        # (a partial --check run must not condemn the whole baseline)
+        self.ran: Optional[set] = None
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
@@ -119,7 +129,9 @@ class Baseline:
         return False
 
     def unused(self) -> List[dict]:
-        return [e for k, e in self.by_key.items() if k not in self.hits]
+        return [e for k, e in self.by_key.items()
+                if k not in self.hits
+                and (self.ran is None or k[0] in self.ran)]
 
 
 # ---------------------------------------------------------------- walking
@@ -163,8 +175,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
-    from . import events, flagsreg, hotpath, jaxaudit, locks, metrics, \
-        spans, status, wirecheck
+    from . import blocking, capture, events, flagsreg, guards, hotpath, \
+        jaxaudit, locks, metrics, spans, status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -174,15 +186,21 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "span-registry": spans.check_span_registry,
         "metric-registry": metrics.check_metric_registry,
         "event-registry": events.check_event_registry,
+        "guard-inference": guards.check_guard_inference,
+        "blocking-under-lock": blocking.check_blocking_under_lock,
+        "context-capture": capture.check_context_capture,
         "jaxpr-audit": jaxaudit.check_jaxpr_audit,
         "wire-contract": wirecheck.check_wire_contract,
     }
 
 
+# "stale-suppression" is not a ctx-check: it runs INSIDE lint_paths,
+# after the others, over the suppression hits they recorded
 ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "jax-hotpath", "flag-registry", "span-registry",
-              "metric-registry", "event-registry", "jaxpr-audit",
-              "wire-contract")
+              "metric-registry", "event-registry", "guard-inference",
+              "blocking-under-lock", "context-capture", "jaxpr-audit",
+              "wire-contract", "stale-suppression")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -196,16 +214,60 @@ def lint_paths(root: str, checks: Optional[Iterable[str]] = None,
     names = list(checks) if checks else list(ALL_CHECKS)
     by_rel = {m.rel: m for m in ctx.modules}
     out: List[Violation] = []
+    ran = []
     for name in names:
+        if name == "stale-suppression":
+            continue                 # runs after the others, below
         if name not in registry:
             raise LintError(f"unknown check {name!r} "
                             f"(have: {', '.join(ALL_CHECKS)})")
+        ran.append(name)
         for v in registry[name](ctx):
             mod = by_rel.get(v.path)
             if mod is not None and mod.suppressed(v.check, v.line):
                 continue
             out.append(v)
+    if "stale-suppression" in names:
+        for v in _stale_suppressions(ctx, ran):
+            mod = by_rel.get(v.path)
+            if mod is not None and mod.suppressed(v.check, v.line):
+                continue
+            out.append(v)
     out.sort(key=lambda v: (v.path, v.line, v.check))
+    return out
+
+
+def _stale_suppressions(ctx: PackageContext,
+                        ran: List[str]) -> List[Violation]:
+    """A ``# nebulint: disable=<check>`` comment whose check RAN this
+    invocation but suppressed nothing at that site is itself flagged —
+    the violation it once justified is gone, and a fossilized
+    suppression would silently swallow the NEXT, different, violation
+    landing on its line (the PR 2 baseline-rot argument, applied to
+    inline comments).  ``disable=all`` is exempt: it cannot be
+    attributed to one check."""
+    ran_set = set(ran)
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        for line, marks in sorted(mod.line_disable.items()):
+            for check in sorted(marks):
+                if check == "all" or check not in ran_set:
+                    continue
+                if (check, line) not in mod.suppress_hits:
+                    out.append(Violation(
+                        "stale-suppression", mod.rel, line, "<module>",
+                        f"'# nebulint: disable={check}' suppresses "
+                        f"nothing — {check} no longer fires here; "
+                        f"remove the comment"))
+        for check in sorted(mod.file_disable):
+            if check == "all" or check not in ran_set:
+                continue
+            if (check, 0) not in mod.suppress_hits:
+                out.append(Violation(
+                    "stale-suppression", mod.rel, 1, "<module>",
+                    f"'# nebulint: disable-file={check}' suppresses "
+                    f"nothing — {check} no longer fires in this file; "
+                    f"remove the comment"))
     return out
 
 
@@ -219,6 +281,7 @@ def run_lint(root: str, baseline_path: Optional[str] = DEFAULT_BASELINE,
     if baseline_path:
         if os.path.exists(baseline_path):
             baseline = Baseline.load(baseline_path)
+            baseline.ran = set(checks) if checks else set(ALL_CHECKS)
             vs = [v for v in vs if not baseline.match(v)]
         elif baseline_path != DEFAULT_BASELINE:
             # an explicitly requested baseline that is missing is a

@@ -1,0 +1,351 @@
+"""guard-inference — a static mini-TSan over lock-declaring classes.
+
+The lock-discipline check (locks.py) gates thread ENTRY POINTS; this
+pass closes the other half of the race surface: for every class that
+declares a lock (``self._x = threading.Lock()/RLock()/Condition()/
+OrderedLock()``) in the concurrency-bearing packages
+(``GUARD_SCOPE``), it infers which ``self._attr`` fields the lock
+GUARDS — an attribute is guarded when the strict MAJORITY of its
+accesses (reads and writes alike, at least two of them) happen inside
+``with self.<lock>`` blocks — and then flags:
+
+  * unguarded access: a read or write of an inferred-guarded attribute
+    outside any ``with`` of its guard (the classic
+    check-outside/mutate-inside race);
+  * mixed-lock access: an access under a DIFFERENT class lock than the
+    attribute's guard (two locks "protecting" one field protect
+    nothing).
+
+Inference can be PINNED where it matters with a ``GuardedBy``-style
+declaration: ``# nebulint: guarded-by=_lock`` on an access line (or
+the line above — conventionally the ``__init__`` assignment) declares
+the attribute's guard explicitly, majority be damned; ``# nebulint:
+guarded-by=none`` declares an attribute deliberately unguarded
+(single-writer counters, immutable-after-publish caches) and exempts
+it.  A declaration naming a lock the class does not declare is itself
+a violation — stale pins must not silently disable the analysis.
+
+Exemptions mirror locks.py: ``__init__``/``start`` run before the
+object is shared; attributes assigned ONLY there are configuration;
+``__repr__``/``__str__`` are diagnostic snapshots; a method whose
+docstring states the "caller holds the lock" contract is analysed as
+holding every class lock.  A deliberate lock-free fast path (the
+breaker's CLOSED probe, stats' hot counters) carries an inline
+``# nebulint: disable=guard-inference`` with its justification, like
+any other check.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Module, PackageContext, Violation
+from .locks import (_CALLER_HOLDS, _collect_classes, _init_only_attrs,
+                    _self_mut_attr, _ClassInfo)
+
+CHECK = "guard-inference"
+
+# the concurrency-bearing surface this pass audits (fixture roots use
+# the same rel-path fragments); everything else is out of scope — the
+# inference needs real multi-threaded access patterns to be meaningful
+GUARD_SCOPE = ("raftex/", "kvstore/", "storage/", "graph/batch_dispatch",
+               "tpu/runtime", "common/stats", "common/events")
+
+_EXEMPT_METHODS = ("__init__", "start", "__repr__", "__str__")
+
+_GUARDED_BY = re.compile(r"#\s*nebulint:\s*guarded-by\s*=\s*(\w+)")
+_SELF_ATTR = re.compile(r"self\.(\w+)")
+
+
+def in_scope(rel: str) -> bool:
+    return any(frag in rel for frag in GUARD_SCOPE)
+
+
+def _declarations(mod: Module) -> Dict[int, str]:
+    """line -> declared guard name ('none' = deliberately unguarded)."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(mod.lines, start=1):
+        m = _GUARDED_BY.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _attach_declarations(mod: Module) -> Tuple[List[Tuple[str, str, int]],
+                                               List[Tuple[int, str]]]:
+    """Resolve each guarded-by pin to its subject attribute: a trailing
+    comment names the first ``self.<attr>`` in the code part of its own
+    line; a comment-only pin (possibly wrapping onto further comment
+    lines) attaches to the first CODE line below it.  Returns
+    ([(attr, guard, line)], [(line, guard) that attached to nothing])
+    — kept as a list WITH the pin line so the caller can scope each
+    pin to the class whose body contains it (two classes in one file
+    may share an attribute name); a silently detached declaration
+    would fake enforcement, so the caller flags the orphans."""
+    attached: List[Tuple[str, str, int]] = []
+    orphans: List[Tuple[int, str]] = []
+    for line, guard in sorted(_declarations(mod).items()):
+        subject = None
+        probe = line
+        while probe <= len(mod.lines):
+            text = mod.lines[probe - 1]
+            code = text.split("#", 1)[0]
+            m = _SELF_ATTR.search(code)
+            if m:
+                subject = m.group(1)
+                break
+            stripped = text.strip()
+            if probe > line and stripped and not stripped.startswith("#"):
+                break               # a code line without self.<attr>
+            probe += 1
+        if subject is not None:
+            attached.append((subject, guard, line))
+        else:
+            orphans.append((line, guard))
+    return attached, orphans
+
+
+class _Access:
+    __slots__ = ("attr", "line", "method", "held", "write")
+
+    def __init__(self, attr: str, line: int, method: str,
+                 held: Tuple[str, ...], write: bool):
+        self.attr = attr
+        self.line = line
+        self.method = method
+        self.held = held          # lock ATTR names held lexically
+        self.write = write
+
+
+def _self_lock_names(stmt: ast.With, info: _ClassInfo) -> List[str]:
+    """Lock attr names acquired by a with statement — ``with
+    self._lock:`` / ``with self._cond:`` forms plus the class's
+    lock-getter methods (``with self._build_lock(space):``)."""
+    out: List[str] = []
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" and f.attr in info.lock_getters:
+                out.append(f.attr)
+            continue
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" \
+                and (expr.attr in info.locks or expr.attr in info.lock_getters):
+            out.append(expr.attr)
+    return out
+
+
+class _AccessScan(ast.NodeVisitor):
+    """Collect self-attribute accesses of one method with the lexically
+    held self-lock set.  Nested defs/lambdas run later on their own
+    stack (a closure handed to a pool does NOT inherit the with block),
+    so the held set resets inside them — their accesses still count,
+    as UNGUARDED ones, which is exactly the race they risk."""
+
+    def __init__(self, info: _ClassInfo, method: str, all_held: bool):
+        self.info = info
+        self.method = method
+        self.held: List[str] = list(info.locks) if all_held else []
+        self._pin_held = all_held
+        # Attribute nodes consumed by a write form (mutator receiver,
+        # subscript-store base) — their Load ctx must not ALSO count
+        # as a read, or one `self._q.append(x)` becomes two accesses
+        # and skews the majority
+        self._claimed: set = set()
+        self.out: List[_Access] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        names = _self_lock_names(node, self.info)
+        self.held += names
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        if names:
+            del self.held[-len(names):]
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved = self.held
+        self.held = list(self.info.locks) if self._pin_held else []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self.held
+        self.held = list(self.info.locks) if self._pin_held else []
+        self.visit(node.body)
+        self.held = saved
+
+    def _note(self, attr: str, line: int, write: bool) -> None:
+        self.out.append(_Access(attr, line, self.method,
+                                tuple(self.held), write))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load) \
+                and id(node) not in self._claimed:
+            self._note(node.attr, node.lineno, write=False)
+        self.generic_visit(node)
+
+    def _claim_target_bases(self, targets) -> None:
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Attribute) \
+                    and isinstance(t.value.value, ast.Name) \
+                    and t.value.value.id == "self":
+                self._claimed.add(id(t.value))
+
+    def _write(self, node: ast.AST) -> None:
+        hit = _self_mut_attr(node)
+        if hit:
+            self._note(hit[0], hit[1], write=True)
+            # a subscript store's base (`self._x[k] = v`) is Load ctx
+            # but belongs to the write just recorded
+            self._claim_target_bases(
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target])
+        # visit children for reads on the RHS; claimed write bases and
+        # Store-ctx targets never double-count
+        self.generic_visit(node)
+
+    visit_Assign = _write
+    visit_AugAssign = _write
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        t = node.target
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            self._note(t.attr, node.lineno, write=True)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        hit = _self_mut_attr(node)
+        if hit:
+            self._note(hit[0], hit[1], write=True)
+            # the mutator's receiver (`self._q` in `self._q.append`)
+            # is Load ctx but belongs to the write just recorded
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Attribute):
+                self._claimed.add(id(f.value))
+        self.generic_visit(node)
+
+
+def _collect_accesses(info: _ClassInfo) -> List[_Access]:
+    out: List[_Access] = []
+    for mname, mnode in sorted(info.methods.items()):
+        doc = ast.get_docstring(mnode) or ""
+        caller_holds = bool(_CALLER_HOLDS.search(doc))
+        scan = _AccessScan(info, mname, all_held=caller_holds)
+        for stmt in mnode.body:
+            scan.visit(stmt)
+        out += scan.out
+    return out
+
+
+def _resolve_guard(attr: str, accesses: List[_Access],
+                   declared: Optional[str]) -> Optional[str]:
+    """The attribute's guard: the declaration when pinned, else the
+    strict-majority inference (>= 2 guarded accesses and more guarded
+    than unguarded), else None (no guard — nothing to enforce)."""
+    if declared is not None:
+        return None if declared == "none" else declared
+    guarded = [a for a in accesses if a.held]
+    if len(guarded) < 2 or 2 * len(guarded) <= len(accesses):
+        return None
+    counts: Dict[str, int] = {}
+    for a in guarded:
+        for lk in a.held:
+            counts[lk] = counts.get(lk, 0) + 1
+    return max(sorted(counts), key=lambda k: counts[k])
+
+
+def check_guard_inference(ctx: PackageContext) -> List[Violation]:
+    classes = _collect_classes(ctx)
+    by_rel: Dict[str, List[_ClassInfo]] = {}
+    for info in classes:
+        by_rel.setdefault(info.rel, []).append(info)
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        if not in_scope(mod.rel):
+            continue
+        attached, orphans = _attach_declarations(mod)
+        for line, guard in orphans:
+            out.append(Violation(
+                CHECK, mod.rel, line, "<module>",
+                f"guarded-by={guard} declaration attaches to no "
+                f"self.<attr> line — move it onto (or directly above) "
+                f"the attribute it pins"))
+        for info in by_rel.get(mod.rel, []):
+            if not info.locks:
+                continue
+            config = _init_only_attrs(info)
+            accesses = _collect_accesses(info)
+            # methods named like accessors of other classes in the same
+            # file could collide; accesses are already per-info because
+            # _collect_accesses walks THIS class's methods only
+            by_attr: Dict[str, List[_Access]] = {}
+            for a in accesses:
+                if a.attr in info.locks or a.attr in info.methods:
+                    continue
+                if a.method in _EXEMPT_METHODS:
+                    # construction-time/diagnostic accesses neither
+                    # vote in the majority nor get flagged
+                    continue
+                by_attr.setdefault(a.attr, []).append(a)
+            # this class's share of the module's resolved pins: only
+            # pins whose comment lies inside THIS class body (a same-
+            # named attribute in a sibling class must not inherit it)
+            lo = info.node.lineno
+            hi = getattr(info.node, "end_lineno", len(mod.lines))
+            declared: Dict[str, str] = {
+                attr: guard for attr, guard, line in attached
+                if lo <= line <= hi and attr in by_attr}
+            for attr, guard in declared.items():
+                if guard != "none" and guard not in info.locks:
+                    line = min(a.line for a in by_attr.get(attr, [])) \
+                        if by_attr.get(attr) else 1
+                    out.append(Violation(
+                        CHECK, mod.rel, line, f"{info.name}",
+                        f"self.{attr} declared guarded-by={guard} but "
+                        f"{info.name} declares no lock named "
+                        f"{guard!r} ({', '.join(sorted(info.locks))})"))
+            for attr, accs in sorted(by_attr.items()):
+                if attr in config and attr not in declared:
+                    continue          # wired before threads exist
+                guard = _resolve_guard(attr, accs, declared.get(attr))
+                if guard is None or guard not in info.locks:
+                    continue
+                n_total = len(accs)
+                n_guarded = sum(1 for a in accs if guard in a.held)
+                for a in accs:
+                    # exempt-method accesses were already dropped when
+                    # by_attr was built
+                    if guard in a.held:
+                        continue
+                    kind = "write" if a.write else "read"
+                    if a.held:
+                        out.append(Violation(
+                            CHECK, mod.rel, a.line,
+                            f"{info.name}.{a.method}",
+                            f"mixed-lock {kind} of self.{attr} under "
+                            f"{'/'.join(a.held)} — the attribute is "
+                            f"guarded by self.{guard} "
+                            f"({n_guarded}/{n_total} accesses)"))
+                    else:
+                        out.append(Violation(
+                            CHECK, mod.rel, a.line,
+                            f"{info.name}.{a.method}",
+                            f"unguarded {kind} of self.{attr} — "
+                            f"guarded by self.{guard} "
+                            f"({n_guarded}/{n_total} accesses hold it); "
+                            f"take the lock or pin with "
+                            f"'# nebulint: guarded-by=none'"))
+    return out

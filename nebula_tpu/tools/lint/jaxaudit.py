@@ -147,6 +147,79 @@ def _audit_inputs(spec, avals, emit) -> None:
                      f"8x the frontier HBM traffic per hop")
 
 
+def _leaf_bytes(avals) -> int:
+    return sum(int(np.prod(a.shape, dtype=np.int64)) * a.dtype.itemsize
+               for a in _leaf_avals(avals))
+
+
+def hbm_residency(spec, closed, avals):
+    """Static peak-resident-bytes accounting for one traced bucket:
+    mirror-resident inputs (everything not uploaded per dispatch) +
+    per-dispatch uploads + outputs, minus what donation reuses (a
+    donated single-use frontier's buffer becomes the output's).
+    Returns (mirror, dispatch, out, peak) in bytes — the rows behind
+    docs/static_analysis.md's HBM budget table."""
+    mirror_b = dispatch_b = donated_b = 0
+    for idx, arg in enumerate(avals):
+        b = _leaf_bytes(arg)
+        if idx in spec.dispatch:
+            dispatch_b += b
+        else:
+            mirror_b += b
+        if idx in spec.donate:
+            donated_b += b
+    out_b = sum(int(np.prod(a.shape, dtype=np.int64)) * a.dtype.itemsize
+                for a in closed.out_avals)
+    peak = mirror_b + dispatch_b + max(0, out_b - donated_b)
+    return mirror_b, dispatch_b, out_b, peak
+
+
+def _audit_hbm(spec, closed, avals, key, hbm, emit) -> None:
+    """Per-rung budget gate: the bucket's peak resident bytes must fit
+    the declared per-device budget (runtime.HBM_MODEL) — the static
+    form of 'this ladder rung serves without an HBM OOM'."""
+    if not hbm:
+        return
+    budget = int(hbm.get("device_hbm_bytes") or 0)
+    if budget <= 0:
+        return
+    _m, _d, _o, peak = hbm_residency(spec, closed, avals)
+    if peak > budget:
+        emit(f"kernel '{spec.name}': bucket {key!r} holds {peak} "
+             f"bytes resident at dispatch (tables + frontier + "
+             f"outputs, donation-adjusted), over the declared "
+             f"per-device HBM budget {budget} — this ladder rung "
+             f"cannot serve")
+
+
+def hbm_ceiling_findings(hbm) -> List[str]:
+    """The published-capacity arithmetic, proven on the declaration:
+    edge_ceiling * table_bytes_per_edge must fit table_budget_bytes,
+    which must fit the physical device_hbm_bytes.  Returns messages
+    (empty = consistent) — the static proof behind the ~639M-edge
+    claim (BASELINE.md 'Scale')."""
+    out: List[str] = []
+    if not hbm:
+        return out
+    edge_bytes = float(hbm.get("table_bytes_per_edge") or 0.0)
+    ceiling = int(hbm.get("edge_ceiling") or 0)
+    table_budget = int(hbm.get("table_budget_bytes") or 0)
+    device = int(hbm.get("device_hbm_bytes") or 0)
+    need = int(ceiling * edge_bytes)
+    if need > table_budget:
+        out.append(
+            f"HBM_MODEL: the declared edge ceiling ({ceiling:,} edges "
+            f"x {edge_bytes} B/edge = {need:,} bytes of device tables) "
+            f"exceeds table_budget_bytes ({table_budget:,}) — the "
+            f"published per-chip capacity claim no longer holds")
+    if table_budget > device:
+        out.append(
+            f"HBM_MODEL: table_budget_bytes ({table_budget:,}) exceeds "
+            f"device_hbm_bytes ({device:,}) — no headroom for XLA "
+            f"scratch, frontier uploads or result buffers")
+    return out
+
+
 def _audit_d2h_bytes(spec, fx, closed, key, emit) -> None:
     """Reduction kernels (COUNT / LIMIT pushdown) declare a per-
     dispatch fetch byte bound; the traced output avals must fit it."""
@@ -197,11 +270,13 @@ def _audit_donation(spec, closed, avals, emit) -> None:
 
 def audit_specs(specs, fx, phases_table: Dict[str, dict],
                 span_names: Tuple[str, ...],
-                anchor) -> Tuple[List[Violation], set]:
+                anchor, hbm: Optional[dict] = None
+                ) -> Tuple[List[Violation], set]:
     """Pure audit core (fixture-testable): run every check over
     ``specs`` against the declared ``phases_table``; returns
     (violations, phase kinds actually used).  ``anchor(spec)`` ->
-    (rel_path, line) places each violation."""
+    (rel_path, line) places each violation.  ``hbm`` (the runtime's
+    HBM_MODEL) arms the per-rung resident-bytes budget gate."""
     import jax
     from jax.experimental import enable_x64
 
@@ -256,6 +331,7 @@ def audit_specs(specs, fx, phases_table: Dict[str, dict],
             _audit_one_trace(spec, closed, emit)
             _audit_donation(spec, closed, avals, emit)
             _audit_d2h_bytes(spec, fx, closed, key, emit)
+            _audit_hbm(spec, closed, avals, key, hbm, emit)
             # --- transfer accounting -------------------------------
             row = phases_table.get(spec.phase_kind)
             if row is None:
@@ -315,25 +391,35 @@ def check_jaxpr_audit(ctx: PackageContext) -> List[Violation]:
         return rel, code.co_firstlineno
 
     fx = AuditFixture()
+    hbm = getattr(rt, "HBM_MODEL", None)
     out, used_kinds = audit_specs(registry.values(), fx,
-                                  rt.DEVICE_PHASES, SPAN_NAMES, anchor)
+                                  rt.DEVICE_PHASES, SPAN_NAMES, anchor,
+                                  hbm=hbm)
+
+    rt_mod = next((m for m in ctx.modules
+                   if m.rel.endswith("tpu/runtime.py")), None)
+
+    def _rt_anchor(symbol: str):
+        line = 1
+        if rt_mod is not None:
+            for i, txt in enumerate(rt_mod.lines, start=1):
+                if txt.startswith(symbol):
+                    line = i
+                    break
+        return (rt_mod.rel if rt_mod is not None else host.rel), line
 
     # dead declaration rows: a DEVICE_PHASES kind no registered kernel
     # dispatches under is drift in the other direction
     dead = sorted(set(rt.DEVICE_PHASES) - used_kinds)
     if dead:
-        rt_mod = next((m for m in ctx.modules
-                       if m.rel.endswith("tpu/runtime.py")), None)
-        line = 1
-        if rt_mod is not None:
-            for i, txt in enumerate(rt_mod.lines, start=1):
-                if txt.startswith("DEVICE_PHASES"):
-                    line = i
-                    break
-        rel = rt_mod.rel if rt_mod is not None else host.rel
+        rel, line = _rt_anchor("DEVICE_PHASES")
         for kind in dead:
             out.append(Violation(
                 CHECK, rel, line, "DEVICE_PHASES",
                 f"declared phase kind '{kind}' has no registered "
                 f"kernel — stale declaration"))
+    # the published-capacity arithmetic, proven on the declaration
+    for msg in hbm_ceiling_findings(hbm):
+        rel, line = _rt_anchor("HBM_MODEL")
+        out.append(Violation(CHECK, rel, line, "HBM_MODEL", msg))
     return out

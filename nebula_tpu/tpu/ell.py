@@ -1917,7 +1917,7 @@ def _ell_go_buckets(fx):
 
 def _ell_go_count_buckets(fx):
     R1 = fx.ell.n_rows + 1
-    kern = make_batched_go_lanes_kernel(  # nebulint: disable=jax-hotpath
+    kern = make_batched_go_lanes_kernel(
         fx.ell, fx.steps, fx.etypes, count=True, donate=True)
     return [(("ell_go_count", fx.ell.shape_sig(), fx.etypes, fx.steps),
              kern,
@@ -2092,7 +2092,7 @@ register_kernel(KernelSpec(
 def _ell_go_sharded_buckets(fx):
     mesh = fx.mesh()
     nbrs, ets, reals = shard_ell(mesh, "parts", fx.ell)
-    kern = make_sharded_batched_go_kernel(  # nebulint: disable=jax-hotpath
+    kern = make_sharded_batched_go_kernel(
         mesh, "parts", fx.ell, fx.steps, fx.etypes, nbrs, ets, reals,
         pack=True)
     R1 = fx.ell.n_rows + 1

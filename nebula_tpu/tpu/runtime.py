@@ -274,6 +274,35 @@ flags.define(
 # — the declaration is the review surface, exactly like the
 # reference's Thrift IDL.
 # ====================================================================
+# ====================================================================
+# Declared per-device HBM budget — the arithmetic behind the published
+# ~639M-edge/chip ceiling (BASELINE.md "Scale", docs/tpu_backend.md),
+# now a LINT-ENFORCED declaration instead of a prose claim: the jaxpr
+# auditor's HBM pass (tools/lint/jaxaudit.py, docs/static_analysis.md
+# "HBM budget table") proves on every registered kernel's abstract
+# avals that each ladder rung's peak resident bytes (mirror tables +
+# per-dispatch frontier uploads + outputs, donation-adjusted) fit the
+# PHYSICAL device_hbm_bytes, and that edge_ceiling *
+# table_bytes_per_edge fits table_budget_bytes (the mirror-table
+# slice; its gap to device_hbm_bytes is the headroom rungs may use
+# for frontiers/outputs/scratch) — growing either side without
+# updating the other fails tier-1.
+#   device_hbm_bytes     physical HBM of the serving chip (v5e: 16 GB)
+#   table_budget_bytes   the slice the mirror publisher may fill with
+#                        ELL tables (the rest covers XLA scratch,
+#                        frontier uploads and result buffers)
+#   table_bytes_per_edge measured device table traffic per DECLARED
+#                        edge — both directions + ELL padding + hub
+#                        spill rows (SCALE_r05: 2.14 GiB / 105M edges)
+#   edge_ceiling         the serving claim the budget must cover
+# ====================================================================
+HBM_MODEL = {
+    "device_hbm_bytes": 16 * 1000**3,
+    "table_budget_bytes": 14 * 1000**3,
+    "table_bytes_per_edge": 21.9,
+    "edge_ceiling": 639_000_000,
+}
+
 DEVICE_PHASES = {
     "ell_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
                           "tpu.assemble"), "h2d": 1, "d2h": 1},
@@ -410,6 +439,13 @@ class TpuQueryRuntime:
                              {"closed": 0.0, "half_open": 0.5,
                               "open": 1.0}.get(state, 1.0),
                              space=key[0], kernel_class=key[1])
+
+    def _bump(self, key: str, n=1) -> None:
+        """Thread-safe stats counter bump — dispatch leaders run
+        concurrently, and a bare ``stats[k] += 1`` read-modify-write
+        loses updates between them (guard-inference audit, round 10)."""
+        with self._lock:
+            self.stats[key] = self.stats.get(key, 0) + n
 
     def _tick(self, key: str, t0: float) -> float:
         """Accumulate wall time into a stats bucket; returns now."""
@@ -874,7 +910,7 @@ class TpuQueryRuntime:
                              kernel_class="go")
             raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
-        self.stats["go_device"] += 1
+        self._bump("go_device")
         # tpu_filter_mode: 'device' always fuses a compiled WHERE into
         # the hop program; 'auto' (the shipped default, VERDICT r5 ask
         # #5) fuses whenever expr_compile covered the predicate — fetch
@@ -1173,8 +1209,7 @@ class TpuQueryRuntime:
             parts.append((g_lo, g_hi, self._launch_sparse(
                 space_id, m, ix, d_seg, q_seg, g_hi - g_lo, et_tuple,
                 steps, c0g, upto=upto, reduce=reduce)))
-        self.stats["go_sparse_split"] = \
-            self.stats.get("go_sparse_split", 0) + 1
+        self._bump("go_sparse_split")
 
         def resolve():
             if reduce is not None and reduce[0] == "count":
@@ -1234,6 +1269,8 @@ class TpuQueryRuntime:
         of an (OVER, steps) family — the one whose arrival STARTS the
         background warm) is registered uncounted: nothing could have
         warmed it, so neither hit nor miss is meaningful for it."""
+        # double-checked: re-verified under the lock just below
+        # nebulint: disable=guard-inference
         if shape_key in self._live_shapes:
             return
         with self._lock:
@@ -1312,7 +1349,7 @@ class TpuQueryRuntime:
         with tracing.span("tpu.kernel", kind="sparse_go", starts=S):
             out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                            *extra, *ix.kernel_args()[1:])
-        self.stats["go_sparse"] += 1
+        self._bump("go_sparse")
         self._maybe_time_device(
             out_dev, sum(c * (d_max + 12) * 4 for c in caps[1:]),
             kind="sparse_go")
@@ -1322,7 +1359,7 @@ class TpuQueryRuntime:
                 out_host = np.asarray(out_dev)
                 self._note_fetch(out_host)
                 if bool(out_host[1]):            # hop overflow: dense
-                    self.stats["sparse_overflows"] += 1
+                    self._bump("sparse_overflows")
                     return self._launch_dense(
                         space_id, m, ix, d_all, q_all, nq, et_tuple,
                         steps, None, self._mesh_tables(m, ix),
@@ -1338,7 +1375,7 @@ class TpuQueryRuntime:
             _cnt, overflow, qids, vids_new = sparse_go_pairs(
                 kern, out_host)
             if overflow:
-                self.stats["sparse_overflows"] += 1
+                self._bump("sparse_overflows")
                 return self._launch_dense(space_id, m, ix, d_all, q_all,
                                           nq, et_tuple, steps, None,
                                           self._mesh_tables(m, ix),
@@ -1406,14 +1443,13 @@ class TpuQueryRuntime:
         with tracing.span("tpu.kernel", kind="mesh_sparse_go"):
             out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
                            args[0], args[1], args[2], *args[3], *args[4])
-        self.stats["go_mesh_sparse"] = \
-            self.stats.get("go_mesh_sparse", 0) + 1
+        self._bump("go_mesh_sparse")
 
         def resolve():
             overflow, qids, vids_new = sharded_sparse_pairs(
                 np.asarray(out_dev))
             if overflow:
-                self.stats["sparse_overflows"] += 1
+                self._bump("sparse_overflows")
                 return self._launch_dense(
                     space_id, m, ix, d_all, q_all, nq, et_tuple, steps,
                     None, self._mesh_tables(m, ix))()
@@ -1437,7 +1473,7 @@ class TpuQueryRuntime:
         hub = self._hub_dev(m, ix)
         with tracing.span("tpu.kernel", kind="adaptive_go"):
             out_dev = kern(ix.perm[d_all], hub, *ix.kernel_args())
-        self.stats["go_adaptive"] += 1
+        self._bump("go_adaptive")
 
         def resolve():
             packed = np.asarray(out_dev)
@@ -1550,7 +1586,7 @@ class TpuQueryRuntime:
                                       first_of_family=first or upto)
                 with tracing.span("tpu.kernel", kind="ell_go", width=B):
                     out_dev = kern(f0_dev, *args)
-        self.stats["go_dense"] += 1
+        self._bump("go_dense")
         self._maybe_time_device(out_dev, hop_bytes, kind="ell_go")
 
         if count_mode:
@@ -2151,12 +2187,14 @@ class TpuQueryRuntime:
         filt = plan.filter_cval
         key = ("fused", space_id, m.build_version, steps, et_tuple,
                plan.pushed_mode, plan.expr_str, len(start_idx))
-        kern = self._kernels.get(key)
+        with self._lock:
+            kern = self._kernels.get(key)
 
         if filt is None:
             if kern is None:
                 kern = kernels.make_go_kernel(m.n, steps, et_tuple)
-                self._kernels[key] = kern
+                with self._lock:
+                    self._kernels[key] = kern
             return kern(dev["edge_src"], dev["edge_dst"], dev["edge_etype"],
                         jnp.asarray(start_idx))
 
@@ -2210,7 +2248,8 @@ class TpuQueryRuntime:
 
             kern = kernels.make_go_filtered_kernel(
                 m.n, steps, et_tuple, filter_fn)
-            self._kernels[key] = kern
+            with self._lock:
+                self._kernels[key] = kern
         return kern(dev["edge_src"], dev["edge_dst"], dev["edge_etype"],
                     jnp.asarray(start_idx), env_cols)
 
@@ -2764,7 +2803,7 @@ class TpuQueryRuntime:
         batches run in go_batch_max chunks so the frontier matrix stays
         memory-bounded."""
         et_tuple = tuple(sorted(set(etypes)))
-        self.stats["go_device"] += len(starts_per_query)
+        self._bump("go_device", len(starts_per_query))
         if not starts_per_query:
             m = self.mirror(space_id)
             return np.zeros((0, m.n), dtype=bool)
@@ -2839,7 +2878,7 @@ class TpuQueryRuntime:
             t0_dev = self._upload_frontier(
                 ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
             call_args = (f0_dev, t0_dev, args[0], *nbrs, *ets)
-        self.stats["path_device"] += nq
+        self._bump("path_device", nq)
         with tracing.span("tpu.kernel",
                           kind="ell_bfs" if mt is None
                           else "ell_bfs_sharded", queries=nq):
@@ -2922,11 +2961,10 @@ class TpuQueryRuntime:
             jnp.asarray(pt[0]), jnp.asarray(pt[1]),
             args[0], args[1], args[2], *args[3], *args[4])
         if np.asarray(ovf_dev).any():
-            self.stats["sparse_overflows"] += 1
+            self._bump("sparse_overflows")
             return None
-        self.stats["path_device"] += nq
-        self.stats["bfs_mesh_sparse"] = \
-            self.stats.get("bfs_mesh_sparse", 0) + 1
+        self._bump("path_device", nq)
+        self._bump("bfs_mesh_sparse")
         # device-side column slice before the fetch, like the
         # replicated path — B-nq padded columns are pure link waste
         nqp = min(B, max(8, -(-nq // 8) * 8))

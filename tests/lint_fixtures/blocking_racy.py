@@ -1,0 +1,23 @@
+"""Deliberately stalling class — the blocking-under-lock pass's seeded
+violation (see README.md): the RPC fan-out is only reachable THROUGH a
+helper call, so the lexical lock-discipline check cannot see it — the
+exact shape of the PR 6 "rpc_download under the catalog write lock
+would stall heartbeats" bug.  DO NOT fix."""
+import threading
+
+
+class RacyCatalog:
+    def __init__(self, cm):
+        self._lock = threading.Lock()
+        self.cm = cm
+        self.hosts = []
+
+    def _fan_out(self, method):
+        for h in self.hosts:
+            self.cm.call(h, method, {})
+
+    def rpc_download(self, req):
+        with self._lock:
+            # 120 s of peer dials under the write lock
+            self._fan_out("download")
+            return {"ok": True}

@@ -1,0 +1,24 @@
+"""Deliberately context-dropping class — the context-capture pass's
+seeded violation (see README.md): a pool fan-out from span- and
+deadline-bound code whose worker rebinds neither, plus a thread-local
+deadline consult inside the worker that reads a binding which exited
+with the submitting thread.  DO NOT fix."""
+from common import tracing
+from common import deadline as deadlines
+
+
+class RacyFanout:
+    def __init__(self, pool, cm):
+        self.pool = pool
+        self.cm = cm
+
+    def collect(self, hosts):
+        with tracing.span("storage.collect.pass"):
+            dl = deadlines.current()
+            futs = [self.pool.submit(self._worker, h, dl) for h in hosts]
+            return [f.result() for f in futs]
+
+    def _worker(self, host, dl):
+        # consults the submitting thread's binding, which is gone
+        timeout = deadlines.remaining_or(10.0)
+        return self.cm.call(host, "bulkGet", {}, timeout=timeout)

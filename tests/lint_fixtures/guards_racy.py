@@ -1,0 +1,32 @@
+"""Deliberately racy class — the guard-inference pass's seeded
+violation (see README.md; test_lint.py writes this under a kvstore/
+path so the scope filter applies).  DO NOT fix."""
+import threading
+
+
+class RacyJournal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._side = threading.Lock()
+        self._entries = []
+        self._seq = 0
+
+    def record(self, entry):
+        with self._lock:
+            self._entries.append(entry)
+            self._seq += 1
+
+    def trim(self, cap):
+        with self._lock:
+            del self._entries[:-cap]
+            self._seq += 0
+
+    def peek(self):
+        # the race: a bare read of the majority-guarded list (a
+        # concurrent trim can resize it mid-iteration)
+        return list(self._entries)
+
+    def renumber(self):
+        # the other race: touching guarded state under the WRONG lock
+        with self._side:
+            self._seq = 0

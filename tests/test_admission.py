@@ -164,10 +164,10 @@ class TestWindowController:
         st.rt_ema_s = 30.0                # frac * ema would be huge
         flags.set("go_batch_window_ms", -1)
         cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
-        assert d._window_s(st) == cap     # idle: the full flag cap
+        assert d._window_s(st.rt_ema_s) == cap     # idle: the full flag cap
         for _ in range(50):
             d.window.observe_depth(100)
-        assert d._window_s(st) < cap / 4  # loaded: controller shrinks it
+        assert d._window_s(st.rt_ema_s) < cap / 4  # loaded: controller shrinks it
 
 
 # ------------------------------------------------------------- shedding

@@ -240,17 +240,17 @@ def test_adaptive_window_scales_with_roundtrip():
     prev = flags.get("go_batch_window_ms")
     try:
         flags.set("go_batch_window_ms", -1)
-        assert d._window_s(st) == 0.0            # no sample yet
+        assert d._window_s(st.rt_ema_s) == 0.0            # no sample yet
         st.rt_ema_s = 0.2                        # 200 ms round trips
         frac = float(flags.get("go_batch_window_frac"))
-        assert abs(d._window_s(st) - 0.2 * frac) < 1e-9
+        assert abs(d._window_s(st.rt_ema_s) - 0.2 * frac) < 1e-9
         st.rt_ema_s = 30.0                       # compile outlier
         cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
-        assert d._window_s(st) == cap            # capped
+        assert d._window_s(st.rt_ema_s) == cap            # capped
         flags.set("go_batch_window_ms", 7)       # fixed override wins
-        assert abs(d._window_s(st) - 0.007) < 1e-9
+        assert abs(d._window_s(st.rt_ema_s) - 0.007) < 1e-9
         flags.set("go_batch_window_ms", 0)       # immediate mode
-        assert d._window_s(st) == 0.0
+        assert d._window_s(st.rt_ema_s) == 0.0
     finally:
         flags.set("go_batch_window_ms", prev)
 
@@ -281,7 +281,7 @@ def test_adaptive_window_ema_updates_from_batches():
             d.submit_batched(key, 2)
         assert st.rt_ema_s >= 0.05              # stays in regime
         # the observed window stays proportional and bounded
-        w = d._window_s(st)
+        w = d._window_s(st.rt_ema_s)
         frac = float(flags.get("go_batch_window_frac"))
         cap = float(flags.get("go_batch_window_max_ms")) / 1000.0
         assert w <= cap and w <= st.rt_ema_s * frac + 1e-9
@@ -320,10 +320,10 @@ def test_adaptive_window_skips_lone_requests_and_honors_zero_caps():
         prev_cap = flags.get("go_batch_window_max_ms")
         prev_frac = flags.get("go_batch_window_frac")
         flags.set("go_batch_window_max_ms", 0)
-        assert d._window_s(st2) == 0.0
+        assert d._window_s(st2.rt_ema_s) == 0.0
         flags.set("go_batch_window_max_ms", prev_cap)
         flags.set("go_batch_window_frac", 0)
-        assert d._window_s(st2) == 0.0
+        assert d._window_s(st2.rt_ema_s) == 0.0
         flags.set("go_batch_window_frac", prev_frac)
     finally:
         flags.set("go_batch_window_ms", prev)

@@ -19,6 +19,14 @@ pytestmark = pytest.mark.lint
 
 PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "nebula_tpu")
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "lint_fixtures")
+
+
+def fixture_src(name):
+    """One deliberately-broken module from tests/lint_fixtures/."""
+    with open(os.path.join(FIXTURE_DIR, name), encoding="utf-8") as fh:
+        return fh.read()
 
 
 def run_fixture(tmp_path, files, checks=None):
@@ -497,7 +505,9 @@ def test_all_checks_registered():
                                "status-discard", "jax-hotpath",
                                "flag-registry", "span-registry",
                                "metric-registry", "event-registry",
-                               "jaxpr-audit", "wire-contract"}
+                               "guard-inference", "blocking-under-lock",
+                               "context-capture", "jaxpr-audit",
+                               "wire-contract", "stale-suppression"}
 
 
 # ========================================== OrderedLock runtime watchdog
@@ -1060,3 +1070,807 @@ def test_event_registry_suppression_round_trip(tmp_path):
     vs = run_fixture(tmp_path, {"events.py": bad},
                      checks=["event-registry"])
     assert not any("query.mystery" in v.message for v in vs)
+
+
+# ================================================ 11 · guard-inference
+def test_guards_seeded_fixture_fires(tmp_path):
+    """The checked-in deliberately-racy module must trip BOTH rules:
+    the unguarded read and the mixed-lock access."""
+    vs = run_fixture(tmp_path,
+                     {"kvstore/racy.py": fixture_src("guards_racy.py")},
+                     checks=["guard-inference"])
+    msgs = [v.message for v in vs]
+    assert any("unguarded read of self._entries" in m for m in msgs), msgs
+    assert any("mixed-lock write of self._seq" in m and "_side" in m
+               for m in msgs), msgs
+
+
+def test_guards_fixed_fixture_is_clean(tmp_path):
+    """Taking the right lock at both seeded sites silences the pass."""
+    fixed = fixture_src("guards_racy.py").replace(
+        "        return list(self._entries)",
+        "        with self._lock:\n"
+        "            return list(self._entries)").replace(
+        "        with self._side:\n            self._seq = 0",
+        "        with self._lock:\n            self._seq = 0")
+    assert run_fixture(tmp_path, {"kvstore/racy.py": fixed},
+                       checks=["guard-inference"]) == []
+
+
+def test_guards_out_of_scope_path_ignored(tmp_path):
+    """The same racy class outside the concurrency-bearing packages
+    (GUARD_SCOPE) is not analysed — inference needs real threaded
+    access patterns to be meaningful."""
+    assert run_fixture(tmp_path,
+                       {"parser/racy.py": fixture_src("guards_racy.py")},
+                       checks=["guard-inference"]) == []
+
+
+def test_guards_guarded_by_pin_overrides_majority(tmp_path):
+    """A minority-guarded attribute is unflagged by inference; the
+    guarded-by declaration pins it and the bare accesses light up."""
+    src = """
+        import threading
+
+        class Pinned:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # nebulint: guarded-by=_lock
+                self._cache = {}
+
+            def fill(self, k, v):
+                with self._lock:
+                    self._cache[k] = v
+
+            def peek_a(self, k):
+                return self._cache.get(k)
+
+            def peek_b(self, k):
+                return self._cache.get(k)
+
+            def peek_c(self, k):
+                return self._cache.get(k)
+    """
+    # without the pin: 1 guarded / 3 bare -> no majority, clean
+    unpinned = src.replace("                # nebulint: guarded-by=_lock\n",
+                           "")
+    assert run_fixture(tmp_path, {"kvstore/mod.py": unpinned},
+                       checks=["guard-inference"]) == []
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": src},
+                     checks=["guard-inference"])
+    assert len([v for v in vs
+                if "unguarded read of self._cache" in v.message]) == 3, vs
+
+
+def test_guards_guarded_by_none_exempts(tmp_path):
+    """guarded-by=none declares a deliberately unguarded attribute —
+    majority inference is overridden the other way."""
+    racy = fixture_src("guards_racy.py").replace(
+        "        self._entries = []",
+        "        # nebulint: guarded-by=none\n"
+        "        self._entries = []")
+    vs = run_fixture(tmp_path, {"kvstore/racy.py": racy},
+                     checks=["guard-inference"])
+    assert not any("_entries" in v.message for v in vs), vs
+
+
+def test_guards_unknown_lock_name_flagged(tmp_path):
+    """A pin naming a lock the class does not declare is itself a
+    violation — stale declarations must not disable the analysis."""
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": """
+        import threading
+
+        class Typo:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # nebulint: guarded-by=_lok
+                self._x = 0
+
+            def a(self):
+                with self._lock:
+                    self._x += 1
+
+            def b(self):
+                with self._lock:
+                    self._x += 1
+    """}, checks=["guard-inference"])
+    assert any("no lock named '_lok'" in v.message for v in vs), vs
+
+
+def test_guards_caller_holds_contract(tmp_path):
+    """A documented caller-holds method is analysed as holding every
+    class lock (the locks.py convention, shared)."""
+    ok = fixture_src("guards_racy.py").replace(
+        "    def peek(self):",
+        "    def peek(self):\n"
+        '        """Caller holds the lock."""')
+    vs = run_fixture(tmp_path, {"kvstore/racy.py": ok},
+                     checks=["guard-inference"])
+    assert not any("unguarded read" in v.message for v in vs), vs
+
+
+def test_guards_suppression_round_trip(tmp_path):
+    sup = fixture_src("guards_racy.py").replace(
+        "        return list(self._entries)",
+        "        return list(self._entries)  "
+        "# nebulint: disable=guard-inference").replace(
+        "            self._seq = 0",
+        "            self._seq = 0  # nebulint: disable=guard-inference")
+    assert run_fixture(tmp_path, {"kvstore/racy.py": sup},
+                       checks=["guard-inference"]) == []
+
+
+def test_guards_init_only_attrs_exempt(tmp_path):
+    """Configuration wired in __init__ before threads exist is never
+    flagged, even when other attrs establish a guard."""
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": """
+        import threading
+
+        class Cfg:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.limit = 10
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def bump2(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                return self.limit
+    """}, checks=["guard-inference"])
+    assert vs == [], vs
+
+
+# ============================================ 12 · blocking-under-lock
+def test_blocking_seeded_fixture_fires(tmp_path):
+    """The PR 6 bug class, reconstructed: an RPC fan-out reached only
+    THROUGH a helper call while the catalog-style lock is held."""
+    vs = run_fixture(tmp_path,
+                     {"svc.py": fixture_src("blocking_racy.py")},
+                     checks=["blocking-under-lock"])
+    assert len(vs) == 1, vs
+    v = vs[0]
+    assert "rpc" in v.message and "_fan_out()" in v.message
+    assert v.symbol == "RacyCatalog.rpc_download"
+
+
+def test_blocking_fixed_fixture_is_clean(tmp_path):
+    """Moving the fan-out OUT of the locked region (snapshot under the
+    lock, dial outside — the rpc_download fix shape) silences it."""
+    fixed = fixture_src("blocking_racy.py").replace(
+        """    def rpc_download(self, req):
+        with self._lock:
+            # 120 s of peer dials under the write lock
+            self._fan_out("download")
+            return {"ok": True}""",
+        """    def rpc_download(self, req):
+        with self._lock:
+            pending = list(self.hosts)
+        del pending
+        self._fan_out("download")
+        return {"ok": True}""")
+    assert run_fixture(tmp_path, {"svc.py": fixed},
+                       checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_direct_sleep_left_to_lock_discipline(tmp_path):
+    """A DIRECT sleep under a lock is lock-discipline's finding — this
+    pass must not duplicate it (only interprocedural reachability and
+    the new effect classes are its job)."""
+    assert run_fixture(tmp_path, {"svc.py": """
+        import threading
+        import time
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1)
+    """}, checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_untimed_wait_on_other_lock(tmp_path):
+    """Waiting (no timeout) on some OTHER condition while holding a
+    lock is an unbounded stall; waiting on the condition that wraps
+    the single held lock is how Conditions work — clean."""
+    vs = run_fixture(tmp_path, {"svc.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.other = threading.Condition()
+
+            def stall(self):
+                with self._lock:
+                    self.other.wait()
+    """}, checks=["blocking-under-lock"])
+    assert len(vs) == 1 and "cond-wait" in vs[0].message, vs
+    assert run_fixture(tmp_path, {"svc.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.cond = threading.Condition()
+
+            def ok(self):
+                with self.cond:
+                    self.cond.wait()
+    """}, checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_timed_wait_is_clean(tmp_path):
+    assert run_fixture(tmp_path, {"svc.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.other = threading.Condition()
+
+            def bounded(self):
+                with self._lock:
+                    self.other.wait(0.5)
+    """}, checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_device_sync_under_lock(tmp_path):
+    vs = run_fixture(tmp_path, {"svc.py": """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def publish(self, arrs):
+                with self._lock:
+                    for a in arrs:
+                        a.block_until_ready()
+    """}, checks=["blocking-under-lock"])
+    assert len(vs) == 1 and "device" in vs[0].message, vs
+
+
+def test_blocking_caller_holds_vouches_file_io_not_rpc(tmp_path):
+    """A caller-holds docstring vouches for bounded disk I/O (the raft
+    hard-state fsync pattern) but can NEVER vouch for an RPC dial."""
+    vouched_io = """
+        import threading
+        import os
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _persist(self):
+                \"\"\"Caller holds the lock.\"\"\"
+                with open("/tmp/x", "w") as f:
+                    os.fsync(f.fileno())
+
+            def commit(self):
+                with self._lock:
+                    self._persist()
+    """
+    assert run_fixture(tmp_path, {"svc.py": vouched_io},
+                       checks=["blocking-under-lock"]) == []
+    vouched_rpc = vouched_io.replace(
+        'with open("/tmp/x", "w") as f:\n'
+        '                    os.fsync(f.fileno())',
+        'self.cm.call("h", "persist", {})')
+    vs = run_fixture(tmp_path, {"svc.py": vouched_rpc},
+                     checks=["blocking-under-lock"])
+    assert len(vs) == 1 and "rpc" in vs[0].message, vs
+
+
+def test_blocking_nested_def_not_charged_to_encloser(tmp_path):
+    """A closure DEFINED under the lock runs later on its own stack —
+    defining it is free; only calling it under the lock blocks."""
+    assert run_fixture(tmp_path, {"svc.py": """
+        import threading
+        import time
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def arm(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1)
+                    self.cb = later
+    """}, checks=["blocking-under-lock"]) == []
+
+
+def test_blocking_suppression_round_trip(tmp_path):
+    sup = fixture_src("blocking_racy.py").replace(
+        '            self._fan_out("download")',
+        '            # nebulint: disable=blocking-under-lock\n'
+        '            self._fan_out("download")')
+    assert run_fixture(tmp_path, {"svc.py": sup},
+                       checks=["blocking-under-lock"]) == []
+
+
+# ============================================== 13 · context-capture
+def test_capture_seeded_fixture_fires_all_three(tmp_path):
+    """The checked-in fixture drops the trace AND the deadline at the
+    submission, and consults the dead binding in the worker."""
+    vs = run_fixture(tmp_path,
+                     {"client.py": fixture_src("capture_racy.py")},
+                     checks=["context-capture"])
+    msgs = [v.message for v in vs]
+    assert any("never calls tracing.attach_captured" in m
+               for m in msgs), msgs
+    assert any("never rebinds the budget" in m for m in msgs), msgs
+    assert any("consulted on a pool thread" in m for m in msgs), msgs
+
+
+def test_capture_rebinding_worker_is_clean(tmp_path):
+    """The storage/client.py collect/_call_host idiom — capture on the
+    submitting side, attach + bind in the worker — is the clean
+    shape."""
+    fixed = fixture_src("capture_racy.py").replace(
+        """    def _worker(self, host, dl):
+        # consults the submitting thread's binding, which is gone
+        timeout = deadlines.remaining_or(10.0)
+        return self.cm.call(host, "bulkGet", {}, timeout=timeout)""",
+        """    def _worker(self, host, dl, tctx=None):
+        with tracing.attach_captured(tctx):
+            with deadlines.bind(dl):
+                timeout = deadlines.remaining_or(10.0)
+                return self.cm.call(host, "bulkGet", {},
+                                    timeout=timeout)""")
+    assert run_fixture(tmp_path, {"client.py": fixed},
+                       checks=["context-capture"]) == []
+
+
+def test_capture_unbound_background_thread_is_clean(tmp_path):
+    """A daemon background thread started OUTSIDE any span/deadline
+    scope carries no context to drop — never flagged."""
+    assert run_fixture(tmp_path, {"daemon.py": """
+        import threading
+
+        class Rebuilder:
+            def kick(self, space_id):
+                t = threading.Thread(target=self._rebuild,
+                                     args=(space_id,), daemon=True)
+                t.start()
+
+            def _rebuild(self, space_id):
+                return space_id
+    """}, checks=["context-capture"]) == []
+
+
+def test_capture_thread_target_from_span_scope(tmp_path):
+    """Thread(target=...) inside a span is a submission too."""
+    vs = run_fixture(tmp_path, {"mod.py": """
+        import threading
+        from common import tracing
+
+        class T:
+            def go(self):
+                with tracing.span("graph.query"):
+                    threading.Thread(target=self._work).start()
+
+            def _work(self):
+                return 1
+    """}, checks=["context-capture"])
+    assert len(vs) == 1 and "attach_captured" in vs[0].message, vs
+
+
+def test_capture_unresolvable_worker_skipped(tmp_path):
+    """An externally imported worker can't be proven either way — the
+    pass stays package-local and silent."""
+    assert run_fixture(tmp_path, {"mod.py": """
+        from common import tracing
+        from elsewhere import external_worker
+
+        class T:
+            def go(self, pool):
+                with tracing.span("graph.query"):
+                    pool.submit(external_worker, 1)
+    """}, checks=["context-capture"]) == []
+
+
+def test_capture_suppression_round_trip(tmp_path):
+    sup = fixture_src("capture_racy.py").replace(
+        "            futs = [self.pool.submit(self._worker, h, dl) "
+        "for h in hosts]",
+        "            # background probe: budget deliberately not "
+        "inherited\n"
+        "            # nebulint: disable=context-capture\n"
+        "            futs = [self.pool.submit(self._worker, h, dl) "
+        "for h in hosts]").replace(
+        "        timeout = deadlines.remaining_or(10.0)",
+        "        timeout = deadlines.remaining_or(10.0)  "
+        "# nebulint: disable=context-capture")
+    assert run_fixture(tmp_path, {"client.py": sup},
+                       checks=["context-capture"]) == []
+
+
+# ============================================ 14 · stale-suppression
+def test_stale_suppression_flags_fossil(tmp_path):
+    """A disable= comment whose check runs clean at that site is
+    itself a violation."""
+    src = _DISCARD.replace(
+        "    save()",
+        "    st = save()  # nebulint: disable=status-discard\n"
+        "    return st")
+    vs = run_fixture(tmp_path, {"mod.py": src},
+                     checks=["status-discard", "stale-suppression"])
+    assert len(vs) == 1, vs
+    assert vs[0].check == "stale-suppression"
+    assert "status-discard" in vs[0].message
+
+
+def test_stale_suppression_live_comment_not_flagged(tmp_path):
+    """A suppression that actually suppresses is not stale."""
+    src = _DISCARD.replace(
+        "    save()", "    save()  # nebulint: disable=status-discard")
+    assert run_fixture(tmp_path, {"mod.py": src},
+                       checks=["status-discard",
+                               "stale-suppression"]) == []
+
+
+def test_stale_suppression_only_for_checks_that_ran(tmp_path):
+    """A fossil for a check that did NOT run this invocation is not
+    judged — partial runs must not produce false staleness."""
+    src = _DISCARD.replace(
+        "    save()",
+        "    st = save()  # nebulint: disable=status-discard\n"
+        "    return st")
+    assert run_fixture(tmp_path, {"mod.py": src},
+                       checks=["lock-order", "stale-suppression"]) == []
+
+
+def test_stale_suppression_disable_all_exempt(tmp_path):
+    """disable=all cannot be attributed to one check — never stale."""
+    src = _DISCARD.replace(
+        "    save()",
+        "    st = save()  # nebulint: disable=all\n    return st")
+    assert run_fixture(tmp_path, {"mod.py": src},
+                       checks=["status-discard",
+                               "stale-suppression"]) == []
+
+
+def test_stale_suppression_stale_file_disable(tmp_path):
+    import textwrap as _tw
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        "# nebulint: disable-file=lock-order\nx = 1\n")
+    vs = lint_paths(str(root), checks=["lock-order", "stale-suppression"],
+                    repo_root=str(tmp_path))
+    assert len(vs) == 1 and "disable-file" in vs[0].message, vs
+
+
+# ====================================== 15 · jaxpr-audit: HBM budget
+def _hbm_audit(specs, hbm):
+    from nebula_tpu.common.tracing import SPAN_NAMES  # noqa: F401
+    from nebula_tpu.tools.lint.jaxaudit import audit_specs
+    vs, _k = audit_specs(specs, None, _PHASES_1IN_1OUT, ("tpu.kernel",),
+                         lambda s: ("pkg/fake.py", 1), hbm=hbm)
+    return vs
+
+
+def test_hbm_budget_seeded_violation():
+    """Seeded violation: a bucket whose resident bytes exceed the
+    declared per-device budget fails the rung gate."""
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def k(x):
+        return x + 1
+
+    av = (jax.ShapeDtypeStruct((1 << 16,), np.int32),)   # 256 KiB
+    vs = _hbm_audit([_spec(k, av, dispatch=(0,))],
+                    {"device_hbm_bytes": 1 << 10})
+    assert any("per-device HBM budget" in v.message for v in vs), vs
+    # and the same spec fits a real-sized budget
+    vs = _hbm_audit([_spec(k, av, dispatch=(0,))],
+                    {"device_hbm_bytes": 1 << 30})
+    assert not any("HBM budget" in v.message for v in vs), vs
+
+
+def test_hbm_donation_accounting():
+    """A donated single-use input's buffer is reused for the output —
+    the peak must not double-count it."""
+    import jax
+    import numpy as np
+
+    donated = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    n = 1 << 14
+    av = (jax.ShapeDtypeStruct((n,), np.int8),)
+    # budget fits input+0 extra but NOT input+output undonated
+    budget = int(n * 1.5)
+    vs = _hbm_audit([_spec(donated, av, donate=(0,), dispatch=(0,))],
+                    {"device_hbm_bytes": budget})
+    assert not any("HBM budget" in v.message for v in vs), vs
+    undonated = jax.jit(lambda x: x + 1)
+    vs = _hbm_audit([_spec(undonated, av, dispatch=(0,))],
+                    {"device_hbm_bytes": budget})
+    assert any("per-device HBM budget" in v.message for v in vs), vs
+
+
+def test_hbm_ceiling_arithmetic():
+    """The published-capacity proof: ceiling x bytes/edge must fit the
+    table budget, which must fit the device."""
+    from nebula_tpu.tools.lint.jaxaudit import hbm_ceiling_findings
+    ok = {"device_hbm_bytes": 16 * 1000**3,
+          "table_budget_bytes": 14 * 1000**3,
+          "table_bytes_per_edge": 21.9,
+          "edge_ceiling": 639_000_000}
+    assert hbm_ceiling_findings(ok) == []
+    over = dict(ok, edge_ceiling=800_000_000)
+    assert any("capacity claim" in m for m in hbm_ceiling_findings(over))
+    squeezed = dict(ok, table_budget_bytes=17 * 1000**3)
+    assert any("headroom" in m for m in hbm_ceiling_findings(squeezed))
+
+
+def test_hbm_model_consistent_and_enforced_package_wide():
+    """Acceptance: the shipped HBM_MODEL is arithmetically consistent,
+    every registered kernel rung fits it, and the audit path is ARMED
+    (a 1-byte budget makes every rung fail)."""
+    from nebula_tpu.common.tracing import SPAN_NAMES
+    from nebula_tpu.tools.lint.jaxaudit import (audit_specs,
+                                                hbm_ceiling_findings)
+    from nebula_tpu.tpu import runtime as rt
+    from nebula_tpu.tpu.kernels import AuditFixture, kernel_registry
+
+    assert hbm_ceiling_findings(rt.HBM_MODEL) == []
+    registry = kernel_registry()
+    fx = AuditFixture()
+    vs, _ = audit_specs(registry.values(), fx, rt.DEVICE_PHASES,
+                        SPAN_NAMES, lambda s: ("x", 1),
+                        hbm=rt.HBM_MODEL)
+    assert vs == [], "\n".join(repr(v) for v in vs)
+    vs, _ = audit_specs(registry.values(), fx, rt.DEVICE_PHASES,
+                        SPAN_NAMES, lambda s: ("x", 1),
+                        hbm={"device_hbm_bytes": 1})
+    assert any("per-device HBM budget" in v.message for v in vs)
+
+
+def test_hbm_residency_rows_positive():
+    """The docs budget table's source: every registered kernel bucket
+    reports a positive peak with mirror+dispatch+output parts."""
+    import jax
+    from jax.experimental import enable_x64
+    from nebula_tpu.tools.lint.jaxaudit import hbm_residency
+    from nebula_tpu.tpu.kernels import AuditFixture, kernel_registry
+
+    fx = AuditFixture()
+    spec = kernel_registry()["ell_go"]
+    key, fn, avals = spec.instantiate(fx)[0]
+    with enable_x64():
+        closed = jax.make_jaxpr(fn)(*avals)
+    mirror_b, dispatch_b, out_b, peak = hbm_residency(spec, closed, avals)
+    assert mirror_b > 0 and dispatch_b > 0 and out_b > 0
+    assert peak >= mirror_b + dispatch_b
+
+
+# ==================== round-10 audit regressions (named fixes)
+def test_guards_regression_device_ready_shape(tmp_path):
+    """Regression for the round-10 audit fix in storage/service.py
+    device_ready: a health probe reading lock-guarded runtime handles
+    WITHOUT the lock.  The old shape must fire; the fixed (locked)
+    shape must be clean."""
+    racy = """
+        import threading
+
+        class Service:
+            def __init__(self):
+                self._device_rt_lock = threading.Lock()
+                self._device_rt = None
+
+            def rpc_a(self):
+                with self._device_rt_lock:
+                    self._device_rt = object()
+
+            def rpc_b(self):
+                with self._device_rt_lock:
+                    self._device_rt = None
+
+            def device_ready(self):
+                return self._device_rt is not None
+    """
+    vs = run_fixture(tmp_path, {"storage/service.py": racy},
+                     checks=["guard-inference"])
+    assert any("unguarded read of self._device_rt" in v.message
+               for v in vs), vs
+    fixed = racy.replace(
+        "                return self._device_rt is not None",
+        "                with self._device_rt_lock:\n"
+        "                    return self._device_rt is not None")
+    assert run_fixture(tmp_path, {"storage/service.py": fixed},
+                       checks=["guard-inference"]) == []
+
+
+def test_window_s_takes_snapshot_value():
+    """Regression for the round-10 audit fix in batch_dispatch: the
+    pooling window computes from an EMA value the leader SNAPSHOTTED
+    under the key's condition — the helper must not reach back into
+    shared _KeyState after the lock was released."""
+    import inspect
+    from nebula_tpu.common.flags import flags
+    from nebula_tpu.graph.batch_dispatch import GoBatchDispatcher
+
+    d = GoBatchDispatcher(runtime=None)
+    prev = flags.get("go_batch_window_ms")
+    try:
+        flags.set("go_batch_window_ms", -1)
+        frac = float(flags.get("go_batch_window_frac"))
+        # a plain float in, deterministic window out — no shared state
+        assert abs(d._window_s(0.1) - min(
+            0.1 * frac, d.window.cap_s())) < 1e-9
+        assert d._window_s(0.0) == 0.0
+    finally:
+        flags.set("go_batch_window_ms", prev)
+    params = list(inspect.signature(d._window_s).parameters)
+    assert params == ["rt_ema_s"]
+
+
+def test_stale_baseline_judged_only_for_ran_checks(tmp_path):
+    """A partial --check run must not condemn baseline entries whose
+    check never ran (caught by the round-10 verify drive: --check
+    guard-inference reported all 24 wire-contract parity entries as
+    stale and exited 1)."""
+    vs, bl = run_lint(PKG_ROOT, baseline_path=DEFAULT_BASELINE,
+                      checks=["guard-inference", "stale-suppression"])
+    assert vs == []
+    assert bl is not None and bl.unused() == []
+
+
+def test_guards_wrapped_pin_attaches(tmp_path):
+    """Review regression: a guarded-by pin whose comment wraps onto a
+    continuation line must still attach to the first code line below
+    it (the breaker's _cells pin is written exactly this way)."""
+    src = """
+        import threading
+
+        class Pinned:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # nebulint: guarded-by=_lock (state transitions; the
+                # fast paths below are documented exceptions)
+                self._cache = {}
+
+            def fill(self, k, v):
+                with self._lock:
+                    self._cache[k] = v
+
+            def peek_a(self, k):
+                return self._cache.get(k)
+
+            def peek_b(self, k):
+                return self._cache.get(k)
+
+            def peek_c(self, k):
+                return self._cache.get(k)
+    """
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": src},
+                     checks=["guard-inference"])
+    assert len([v for v in vs
+                if "unguarded read of self._cache" in v.message]) == 3, vs
+
+
+def test_guards_orphan_pin_flagged(tmp_path):
+    """A pin that attaches to no attribute line is itself a violation
+    — a silently detached declaration would fake enforcement."""
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # nebulint: guarded-by=_lock
+
+            def noop(self):
+                return None
+    """}, checks=["guard-inference"])
+    assert any("attaches to no" in v.message for v in vs), vs
+
+
+def test_capture_escape_deduped_across_submitters(tmp_path):
+    """Review regression: one worker submitted from two sites is ONE
+    escaped-deadline defect, not two."""
+    src = fixture_src("capture_racy.py").replace(
+        "    def _worker(self, host, dl):",
+        "    def collect2(self, hosts):\n"
+        "        with tracing.span(\"storage.collect.pass\"):\n"
+        "            return [self.pool.submit(self._worker, h, None)\n"
+        "                    for h in hosts]\n"
+        "\n"
+        "    def _worker(self, host, dl):")
+    vs = run_fixture(tmp_path, {"client.py": src},
+                     checks=["context-capture"])
+    escapes = [v for v in vs if "consulted on a pool thread" in v.message]
+    assert len(escapes) == 1, vs
+
+
+def test_guards_mutator_counts_once(tmp_path):
+    """Review regression: `self._q.append(x)` is ONE write access, not
+    a write plus a read of the receiver — double-counting dilutes the
+    majority below inference threshold and hides the race."""
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []
+
+            def a(self):
+                with self._lock:
+                    x = self._q
+
+            def b(self):
+                with self._lock:
+                    y = self._q
+
+            def push(self, x):
+                self._q.append(x)
+    """}, checks=["guard-inference"])
+    # true counts: 2 guarded reads vs 1 unguarded write -> strict
+    # majority -> exactly ONE violation (the write), not two
+    assert len(vs) == 1, vs
+    assert "unguarded write of self._q" in vs[0].message
+
+
+def test_guards_pin_scoped_to_owning_class(tmp_path):
+    """Review regression: a pin inside class A must not bleed onto a
+    same-named attribute of class B in the same file."""
+    vs = run_fixture(tmp_path, {"kvstore/mod.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._mu = threading.Lock()
+                # nebulint: guarded-by=_mu
+                self._cells = {}
+
+            def w1(self):
+                with self._mu:
+                    self._cells[1] = 1
+
+            def w2(self):
+                with self._mu:
+                    self._cells[2] = 2
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cells = {}
+
+            def w1(self):
+                with self._lock:
+                    self._cells[1] = 1
+
+            def w2(self):
+                with self._lock:
+                    self._cells[2] = 2
+    """}, checks=["guard-inference"])
+    # B must NOT report "declares no lock named '_mu'" from A's pin
+    assert vs == [], vs
+
+
+def test_blocking_mixed_with_items_alignment(tmp_path):
+    """Review regression: `with tracing.span(...), self.cond:` then
+    `self.cond.wait()` is the normal Condition idiom — the span item
+    must not shift the rank/source pairing and fake a stall."""
+    assert run_fixture(tmp_path, {"svc.py": """
+        import threading
+
+        class W:
+            def __init__(self):
+                self.cond = threading.Condition()
+
+            def ok(self, tracing):
+                with tracing.span("x"), self.cond:
+                    self.cond.wait()
+    """}, checks=["blocking-under-lock"]) == []
