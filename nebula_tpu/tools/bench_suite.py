@@ -293,9 +293,9 @@ import numpy as np
 from nebula_tpu.tpu.ell import (
     EllIndex, build_sharded_ell, make_batched_go_kernel,
     make_batched_sparse_go_kernel, make_frontier_sharded_sparse_go_kernel,
-    make_sharded_batched_go_kernel, shard_ell, sharded_device_args,
-    sharded_sparse_pairs, sparse_caps, sparse_go_pairs,
-    split_start_pairs_by_owner)
+    make_sharded_batched_go_kernel, pack_lanes_host, shard_ell,
+    sharded_device_args, sharded_sparse_pairs, sparse_caps,
+    sparse_go_pairs, split_start_pairs_by_owner, unpack_lanes_host)
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
@@ -329,13 +329,15 @@ def timeit(fn, reps=3):
 nbrs, ets, reals = shard_ell(mesh, "parts", ix)
 go8 = make_sharded_batched_go_kernel(mesh, "parts", ix, steps, (1,),
                                      nbrs, ets, reals)
-owner = jnp.asarray(ix.extra_owner)
+eslot, hrows = (jnp.asarray(a) for a in ix.hub_merge())
+f0p = jnp.asarray(pack_lanes_host(np.asarray(f0)))
 single = make_batched_go_kernel(ix, steps, (1,))
 ref = single(f0, *ix.kernel_args())
-np.testing.assert_array_equal(np.asarray(go8(f0, owner, *nbrs, *ets)),
-                              np.asarray(ref))
+np.testing.assert_array_equal(
+    unpack_lanes_host(np.asarray(go8(f0p, eslot, hrows, *nbrs, *ets)), B),
+    np.asarray(ref) > 0)
 out["dense_sharded_dispatch_s"] = round(
-    timeit(lambda: go8(f0, owner, *nbrs, *ets)), 3)
+    timeit(lambda: go8(f0p, eslot, hrows, *nbrs, *ets)), 3)
 out["dense_1dev_dispatch_s"] = round(
     timeit(lambda: single(f0, *ix.kernel_args())), 3)
 
@@ -387,7 +389,7 @@ slots = sum(b.size for b in ix.bucket_nbr)
 out["slots_total"] = int(slots)
 out["slots_per_device"] = int(sum(a.shape[1] * a.shape[2]
                                   for a in sh.nbr_s))
-out["dense_frontier_bytes_per_device"] = int((ix.n_rows + 1) * B)
+out["dense_frontier_bytes_per_device"] = int((ix.n_rows + 1) * (B // 8))
 out["sparse_frontier_bytes_per_device"] = int(8 * caps[-1])
 print(json.dumps(out))
 """

@@ -3,7 +3,7 @@
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
 on Status/StatusOr, clang-tidy, sanitizer builds) plus a Thrift IDL
 that makes wire drift a compile error — both lost in a Python
-reproduction.  nebulint restores the project-specific part as fourteen
+reproduction.  nebulint restores the project-specific part as sixteen
 whole-package checks gated as a tier-1 test (tests/test_lint.py):
 
   lock-discipline   attributes mutated from thread entry points without
@@ -54,6 +54,25 @@ whole-package checks gated as a tier-1 test (tests/test_lint.py):
                     in v3 — per-rung peak resident bytes within the
                     declared per-device HBM budget plus the
                     edge-ceiling arithmetic (runtime.HBM_MODEL)
+  mesh-audit        SEMANTIC (v4): re-traces every sharded kernel
+                    family under REAL 2/4/8-way meshes and proves the
+                    declared COLLECTIVE_MODEL on the IR — exact
+                    collective inventory (psum/all_gather/all_to_all/
+                    ppermute + sharding_constraint re-replication,
+                    axes included), no closure-captured device
+                    buffers, per-dispatch ICI exchange bytes within
+                    the declared ici_bytes bound, bit-packed frontier
+                    layout across shard boundaries, donation through
+                    shard_map, per-shard HBM residency per mesh size,
+                    and the MESH_MODEL multi-chip capacity table
+                    arithmetic (meshaudit.py)
+  carveout-inventory  AST (v4): every CPU-decline site in
+                    tpu/runtime.py (TpuDecline raises, can_run_*
+                    gates) must carry a '# nebulint: carveout=<reason>'
+                    tag from the closed MESH_CARVEOUTS registry;
+                    untagged sites, unknown reasons and dead registry
+                    entries are flagged — the mesh carve-out list is
+                    enumerable and baselined (meshaudit.py)
   wire-contract     SEMANTIC: cross-checks every RPC client call site
                     against the rpc_* handlers (orphan methods and
                     handlers, request-key drift, response-envelope

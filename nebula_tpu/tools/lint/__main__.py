@@ -1,14 +1,83 @@
 """CLI: ``python -m nebula_tpu.tools.lint [options] [root]``.
 
 Exit status 0 when no unsuppressed violations remain, 1 otherwise,
-2 for configuration errors (bad baseline, unknown check)."""
+2 for configuration errors (bad baseline, unknown check).
+
+``--format=sarif`` emits SARIF 2.1.0 on stdout so findings land as CI
+annotations (GitHub code scanning ingests it natively); the human
+text format stays the default.  ``--no-cache`` bypasses the
+content-hash incremental cache (tools/lint/cache.py).
+"""
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
 from .core import (ALL_CHECKS, DEFAULT_BASELINE, LintError, run_lint)
+
+
+def _force_virtual_devices() -> None:
+    """The mesh audit traces sharded kernels at 2/4/8-way meshes;
+    tier-1 gets its 8 virtual CPU devices from tests/conftest.py, the
+    CLI must force the same BEFORE jax initializes.  A no-op when jax
+    is already imported (the audit then clamps to visible devices) or
+    the flag is already set."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _sarif(violations, stale) -> dict:
+    """SARIF 2.1.0: one run, one rule per check, one result per
+    violation (stale baseline entries ride as 'note' results so the
+    annotation surface shows them too)."""
+    rules = sorted({v.check for v in violations}
+                   | ({"stale-baseline"} if stale else set()))
+    results = [{
+        "ruleId": v.check,
+        "level": "error",
+        "message": {"text": f"({v.symbol}) {v.message}"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path},
+                "region": {"startLine": max(1, int(v.line))},
+            },
+        }],
+    } for v in violations]
+    for e in stale:
+        results.append({
+            "ruleId": "stale-baseline",
+            "level": "note",
+            "message": {"text":
+                        f"stale baseline entry (no longer fires): "
+                        f"{e['symbol']} [{e['check']}] — {e['reason']}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": e["file"]},
+                    "region": {"startLine": 1},
+                },
+            }],
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nebulint",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -27,8 +96,16 @@ def main(argv=None) -> int:
                    help="report baselined violations too")
     p.add_argument("--list-baseline", action="store_true",
                    help="print baseline entries with their reasons")
+    p.add_argument("--no-cache", action="store_true",
+                   help="re-analyze everything (bypass the per-check "
+                        "content-hash cache)")
+    p.add_argument("--format", choices=("text", "sarif"),
+                   default="text",
+                   help="output format: human text (default) or "
+                        "SARIF 2.1.0 for CI annotations")
     args = p.parse_args(argv)
 
+    _force_virtual_devices()
     root = args.root
     if root is None:
         import nebula_tpu
@@ -36,23 +113,33 @@ def main(argv=None) -> int:
     baseline = None if args.no_baseline else args.baseline
 
     try:
-        vs, bl = run_lint(root, baseline_path=baseline, checks=args.checks)
+        vs, bl = run_lint(root, baseline_path=baseline, checks=args.checks,
+                          use_cache=not args.no_cache)
     except LintError as e:
         print(f"nebulint: error: {e}", file=sys.stderr)
         return 2
 
     if args.list_baseline and bl is not None:
+        # in SARIF mode stdout must carry ONLY the JSON document (CI
+        # pipes it straight into a parser) — the listing goes to stderr
+        dest = sys.stderr if args.format == "sarif" else sys.stdout
         for e in bl.entries:
             print(f"baseline: {e['file']} {e['symbol']} [{e['check']}] "
-                  f"— {e['reason']}")
+                  f"— {e['reason']}", file=dest)
 
-    for v in vs:
-        print(f"{v.path}:{v.line}: [{v.check}] ({v.symbol}) {v.message}")
     stale = bl.unused() if bl is not None else []
-    for e in stale:
-        print(f"stale baseline entry (no longer fires): "
-              f"{e['file']} {e['symbol']} [{e['check']}]",
-              file=sys.stderr)
+    if args.format == "sarif":
+        json.dump(_sarif(vs, stale), sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        for v in vs:
+            print(f"{v.path}:{v.line}: [{v.check}] ({v.symbol}) "
+                  f"{v.message}")
+        for e in stale:
+            print(f"stale baseline entry (no longer fires): "
+                  f"{e['file']} {e['symbol']} [{e['check']}]",
+                  file=sys.stderr)
     n = len(vs)
     if n or stale:
         if n:
@@ -66,7 +153,8 @@ def main(argv=None) -> int:
                   f"{'y' if len(stale) == 1 else 'ies'}",
                   file=sys.stderr)
         return 1
-    print("nebulint: clean")
+    if args.format != "sarif":
+        print("nebulint: clean")
     return 0
 
 

@@ -1,0 +1,174 @@
+"""Content-hash incremental cache for nebulint.
+
+The jaxpr/mesh audits TRACE every registered kernel bucket — at 4 mesh
+sizes since v4 — which dominates the lint wall budget (40 s,
+micro_bench).  But their results are pure functions of (a) the linted
+sources, (b) the lint passes themselves, and (c) the tracing
+environment; so each check's raw (pre-suppression) violations are
+cached per run and replayed while none of those inputs changed.
+
+Keying — per check, a digest over:
+
+  * the sha1 of every in-scope source file (``CHECK_SCOPE`` narrows
+    the expensive device-path audits to tpu/ + the flag/tracing
+    registries they read; every other check rescans on ANY package
+    change — whole-package analyses cannot be partially invalidated
+    soundly);
+  * the sha1 of the lint package's own sources — editing any pass or
+    this file is a "check-version change" and drops the whole cache;
+  * an environment fingerprint (python + jax versions, the jax
+    platform/device-count env) — a trace under a different device
+    count is a different analysis.
+
+Only the checks' raw violations are cached; inline suppression,
+baseline filtering and the stale-suppression meta-check always run
+live against the CURRENT sources, so a cache replay can never mask a
+fresh suppression fossil.
+
+The store is one JSON file under ``~/.cache/nebula_tpu/nebulint/``
+(override: NEBULINT_CACHE_DIR), atomically replaced.  ``--no-cache``
+on the CLI bypasses it entirely; ``hits``/``misses`` counters make
+cache behavior assertable (tests/test_lint.py edits a file and proves
+re-analysis).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from .core import PackageContext, Violation
+
+CACHE_VERSION = 1
+
+# check -> in-package path prefixes that can change its outcome; None
+# (every other check) = the whole package including etc/ reference text
+CHECK_SCOPE: Dict[str, Tuple[str, ...]] = {
+    "jaxpr-audit": ("tpu/", "common/flags.py", "common/tracing.py"),
+    "mesh-audit": ("tpu/", "common/flags.py", "common/tracing.py"),
+    "carveout-inventory": ("tpu/runtime.py",),
+}
+
+
+def default_cache_path() -> str:
+    base = os.environ.get("NEBULINT_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "nebula_tpu", "nebulint")
+    return os.path.join(base, "cache.json")
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+
+
+_LINT_SHA: Optional[str] = None
+
+
+def _lint_sources_sha() -> str:
+    """One sha over the lint package's own sources — any pass edit is
+    a check-version change that invalidates everything."""
+    global _LINT_SHA
+    if _LINT_SHA is None:
+        h = hashlib.sha1()
+        d = os.path.dirname(os.path.abspath(__file__))
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".py"):
+                with open(os.path.join(d, fn), "rb") as fh:
+                    h.update(fn.encode())
+                    h.update(fh.read())
+        _LINT_SHA = h.hexdigest()
+    return _LINT_SHA
+
+
+def _env_fingerprint() -> str:
+    import sys
+    try:
+        from importlib.metadata import version
+        jax_v = version("jax")
+    except Exception:   # noqa: BLE001 — no jax = no trace checks anyway
+        jax_v = "none"
+    return "|".join([
+        sys.version.split()[0], jax_v,
+        os.environ.get("JAX_PLATFORMS", ""),
+        os.environ.get("XLA_FLAGS", ""),
+    ])
+
+
+def _in_pkg(rel: str) -> str:
+    """Module.rel is repo-root-relative ('nebula_tpu/tpu/ell.py');
+    scopes match on the path inside the linted package."""
+    return rel.split("/", 1)[1] if "/" in rel else rel
+
+
+class LintCache:
+    """Per-check violation cache; see the module docstring."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or default_cache_path()
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._data: Dict[str, dict] = {}
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                raw = json.load(fh)
+            if raw.get("version") == CACHE_VERSION:
+                self._data = raw.get("checks", {})
+        except (OSError, ValueError):
+            self._data = {}
+
+    # ---------------------------------------------------------- digest
+    def _digest(self, check: str, ctx: PackageContext) -> str:
+        scope = CHECK_SCOPE.get(check)
+        h = hashlib.sha1()
+        h.update(str(CACHE_VERSION).encode())
+        h.update(_lint_sources_sha().encode())
+        h.update(_env_fingerprint().encode())
+        h.update(ctx.root.encode())
+        for m in ctx.modules:
+            ip = _in_pkg(m.rel)
+            if scope is None or any(ip.startswith(p) for p in scope):
+                h.update(m.rel.encode())
+                h.update(_sha(m.source).encode())
+        if scope is None:
+            for rel, text in sorted(ctx.extra_text.items()):
+                h.update(rel.encode())
+                h.update(_sha(text).encode())
+        return h.hexdigest()
+
+    # ---------------------------------------------------------- lookup
+    def get(self, check: str, ctx: PackageContext
+            ) -> Optional[List[Violation]]:
+        entry = self._data.get(check)
+        if entry is None or entry.get("digest") != self._digest(check,
+                                                                ctx):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return [Violation(*row) for row in entry["violations"]]
+
+    def put(self, check: str, ctx: PackageContext,
+            violations: List[Violation]) -> None:
+        self._data[check] = {
+            "digest": self._digest(check, ctx),
+            "violations": [[v.check, v.path, v.line, v.symbol, v.message]
+                           for v in violations],
+        }
+        self._dirty = True
+
+    # ------------------------------------------------------------ save
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(self.path), suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump({"version": CACHE_VERSION,
+                           "checks": self._data}, fh)
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except OSError:
+            pass          # a read-only cache dir must never fail lint

@@ -176,7 +176,7 @@ def load_package(root: str, repo_root: Optional[str] = None
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
     from . import blocking, capture, events, flagsreg, guards, hotpath, \
-        jaxaudit, locks, metrics, spans, status, wirecheck
+        jaxaudit, locks, meshaudit, metrics, spans, status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -190,6 +190,8 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "blocking-under-lock": blocking.check_blocking_under_lock,
         "context-capture": capture.check_context_capture,
         "jaxpr-audit": jaxaudit.check_jaxpr_audit,
+        "mesh-audit": meshaudit.check_mesh_audit,
+        "carveout-inventory": meshaudit.check_carveout_inventory,
         "wire-contract": wirecheck.check_wire_contract,
     }
 
@@ -200,15 +202,21 @@ ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "jax-hotpath", "flag-registry", "span-registry",
               "metric-registry", "event-registry", "guard-inference",
               "blocking-under-lock", "context-capture", "jaxpr-audit",
+              "mesh-audit", "carveout-inventory",
               "wire-contract", "stale-suppression")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
 
 def lint_paths(root: str, checks: Optional[Iterable[str]] = None,
-               repo_root: Optional[str] = None) -> List[Violation]:
+               repo_root: Optional[str] = None,
+               cache=None) -> List[Violation]:
     """Run the selected checks; returns violations AFTER inline
-    suppression but BEFORE baseline filtering."""
+    suppression but BEFORE baseline filtering.  ``cache`` (a
+    cache.LintCache) replays a check's raw violations when neither its
+    in-scope sources, the lint package, nor the trace environment
+    changed — suppression and the stale-suppression meta-check still
+    run live, so replays can never mask a fresh fossil."""
     ctx = load_package(root, repo_root)
     registry = _checks()
     names = list(checks) if checks else list(ALL_CHECKS)
@@ -222,11 +230,18 @@ def lint_paths(root: str, checks: Optional[Iterable[str]] = None,
             raise LintError(f"unknown check {name!r} "
                             f"(have: {', '.join(ALL_CHECKS)})")
         ran.append(name)
-        for v in registry[name](ctx):
+        raw = cache.get(name, ctx) if cache is not None else None
+        if raw is None:
+            raw = registry[name](ctx)
+            if cache is not None:
+                cache.put(name, ctx, raw)
+        for v in raw:
             mod = by_rel.get(v.path)
             if mod is not None and mod.suppressed(v.check, v.line):
                 continue
             out.append(v)
+    if cache is not None:
+        cache.save()
     if "stale-suppression" in names:
         for v in _stale_suppressions(ctx, ran):
             mod = by_rel.get(v.path)
@@ -273,10 +288,17 @@ def _stale_suppressions(ctx: PackageContext,
 
 def run_lint(root: str, baseline_path: Optional[str] = DEFAULT_BASELINE,
              checks: Optional[Iterable[str]] = None,
-             repo_root: Optional[str] = None
+             repo_root: Optional[str] = None,
+             use_cache: bool = True
              ) -> Tuple[List[Violation], Optional[Baseline]]:
-    """Full run: (unsuppressed-and-unbaselined violations, baseline)."""
-    vs = lint_paths(root, checks, repo_root)
+    """Full run: (unsuppressed-and-unbaselined violations, baseline).
+    ``use_cache=False`` forces every check to re-analyze (the CLI's
+    --no-cache escape hatch)."""
+    cache = None
+    if use_cache:
+        from .cache import LintCache
+        cache = LintCache()
+    vs = lint_paths(root, checks, repo_root, cache=cache)
     baseline = None
     if baseline_path:
         if os.path.exists(baseline_path):

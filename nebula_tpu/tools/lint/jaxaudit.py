@@ -34,6 +34,15 @@ tpu_sparse_c0s / tpu_adaptive_k ladders), proving on the jaxpr:
 Violations anchor to the factory's ``def`` line, so
 ``# nebulint: disable=jaxpr-audit`` on that line suppresses a justified
 finding like any other check.
+
+v4: this module is also the shared audit core for the mesh layer —
+meshaudit.py re-traces every sharded family's ``mesh_instantiate``
+buckets at real 2/4/8-way meshes and reuses ``_audit_inputs`` (packed
+frontier layout), ``_audit_one_trace`` (loop callbacks, 64-bit
+promotion) and ``_audit_donation`` (donation through shard_map) per
+mesh size, adding the COLLECTIVE_MODEL inventory, the static ICI
+traffic model, per-shard residency and the MESH_MODEL capacity
+arithmetic on top.
 """
 from __future__ import annotations
 

@@ -389,37 +389,48 @@ def bench_kernel_roofline(reps: int,
 
 
 def bench_lint(budget_s: float) -> dict:
-    """Wall time of the whole-package nebulint run (all nine checks —
-    the jaxpr tracing of every registered kernel bucket included).
-    The analysis gates tier-1, so it must stay interactive: exceeding
-    ``budget_s`` is reported as a guard failure in the result (and
-    main() exits non-zero on it)."""
+    """Wall time of the whole-package nebulint run (all sixteen checks
+    — the jaxpr tracing of every registered kernel bucket AND the v4
+    mesh traces at 2/4/8-way included).  The analysis gates tier-1, so
+    it must stay interactive: exceeding ``budget_s`` is reported as a
+    guard failure in the result (and main() exits non-zero on it).
+    Both cache states are timed — the cold number is what a fresh
+    checkout pays, the warm number is the steady state the
+    content-hash cache (tools/lint/cache.py) buys; the BUDGET applies
+    to the cold run (cache off), because that is the guarantee."""
     from .lint import run_lint
     from .lint.core import DEFAULT_BASELINE
     import nebula_tpu
     import os
     root = os.path.dirname(os.path.abspath(nebula_tpu.__file__))
     t0 = time.perf_counter()
-    vs, _bl = run_lint(root, baseline_path=DEFAULT_BASELINE)
-    elapsed = time.perf_counter() - t0
-    return {"wall_s": round(elapsed, 2),
+    vs, _bl = run_lint(root, baseline_path=DEFAULT_BASELINE,
+                       use_cache=False)
+    cold = time.perf_counter() - t0
+    run_lint(root, baseline_path=DEFAULT_BASELINE)      # populate cache
+    t0 = time.perf_counter()
+    run_lint(root, baseline_path=DEFAULT_BASELINE)
+    warm = time.perf_counter() - t0
+    return {"wall_s": round(cold, 2),
+            "warm_wall_s": round(warm, 2),
             "budget_s": budget_s,
             "violations": len(vs),
-            "within_budget": elapsed <= budget_s}
+            "within_budget": cold <= budget_s}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--lint-budget-s", type=float, default=40.0,
-                    help="fail when the whole-package nebulint run "
-                         "exceeds this wall time (the static analysis "
-                         "must stay interactive to gate tier-1; raised "
-                         "20->40 in round 9 when the jaxpr audit "
-                         "gained the reduction-kernel families — "
-                         "ell_go_count/sparse_go_limit/sparse_go_count "
-                         "— measured ~27 s; tests/test_lint.py "
-                         "backstops at 60 s)")
+                    help="fail when the COLD whole-package nebulint "
+                         "run exceeds this wall time (the static "
+                         "analysis must stay interactive to gate "
+                         "tier-1; raised 20->40 in round 9 for the "
+                         "reduction-kernel families; round 11 added "
+                         "the v4 mesh traces — 2/4/8-way per sharded "
+                         "family — INSIDE the unchanged budget, cold "
+                         "~16 s / warm ~1.2 s via the content-hash "
+                         "cache; tests/test_lint.py backstops at 60 s)")
     args = ap.parse_args(argv)
     reps = 50 if args.quick else 400
     rows = 20_000 if args.quick else 200_000

@@ -1254,10 +1254,15 @@ def make_batched_bfs_kernel(ell: EllIndex, max_steps: int,
 # Multi-chip, two designs:
 #
 # 1. REPLICATED-FRONTIER dense (shard_ell + make_sharded_batched_*):
-#    bucket rows sharded, the [n_rows+1, B] frontier replicated and
-#    re-replicated per hop (all-gather over ICI).  Adding chips adds
-#    FLOPs but not servable scale — every chip still holds the whole
-#    frontier matrix.  Kept for the batched-BFS path.
+#    bucket rows sharded, the BIT-PACKED [n_rows+1, W] frontier
+#    replicated and re-replicated per hop (all-gather over ICI).
+#    Adding chips adds FLOPs but not servable scale — every chip still
+#    holds the whole frontier matrix — but packing the lanes cuts BOTH
+#    the per-hop ICI re-replication and the per-chip frontier gather
+#    traffic 8x versus the int8 carrier (same argument as the
+#    single-chip roofline arc, docs/roofline.md; the re-replication is
+#    the link cost meshaudit's ICI model prices).  Kept for the
+#    batched-BFS path.
 #
 # 2. FRONTIER-SHARDED sparse (build_sharded_ell +
 #    make_frontier_sharded_sparse_go_kernel): the new-id row space is
@@ -1298,30 +1303,42 @@ def shard_ell(mesh, axis: str, ell: EllIndex):
 def make_sharded_batched_go_kernel(mesh, axis: str, ell: EllIndex,
                                    steps: int, etypes: Tuple[int, ...],
                                    nbr_shards, et_shards, real_rows,
-                                   pack: bool = False):
-    """Sharded-bucket batched GO.  fn(f0 replicated [n_rows+1, B] int8,
-    owner, *tables)."""
+                                   donate: bool = False):
+    """Sharded-bucket batched GO over a BIT-PACKED replicated frontier.
+
+    fn(f0p replicated uint8 [n_rows+1, W], eslot, hrows, *tables) ->
+    uint8 [n_rows+1, W] — same lane layout as the single-chip
+    make_batched_go_lanes_kernel (pack_lanes_host / unpack_lanes_host
+    invert), so the sharded result is bit-exact against it.  eslot/
+    hrows are the hub OR-merge grouping (EllIndex.hub_merge): a packed
+    frontier cannot scatter-max duplicate hub owners the way the old
+    int8 carrier did — max of packed BYTES drops bits."""
     import jax
     import jax.numpy as jnp
-    hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
-                            real_rows)
+    hop = _make_sharded_hop_packed(mesh, axis, ell, etypes, nbr_shards,
+                                   et_shards, real_rows)
 
-    @jax.jit
-    def go(f0, owner, *tables):
-        out = f0 if steps <= 1 else jax.lax.fori_loop(
-            0, steps - 1, lambda _, f: hop(f, owner, *tables), f0)
-        return pack_bits(jnp, out) if pack else out
+    def go(f0p, eslot, hrows, *tables):
+        return f0p if steps <= 1 else jax.lax.fori_loop(
+            0, steps - 1, lambda _, f: hop(f, eslot, hrows, *tables),
+            f0p)
 
-    return go
+    # donation contract matches the single-chip packed kernels: the
+    # runtime builds f0p fresh per dispatch (single-use), opt-in only
+    return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
-def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
-                      etypes: Tuple[int, ...], nbr_shards, et_shards,
-                      real_rows):
-    """hop(f, owner, *tables) -> next frontier, with bucket rows
-    expanded on their owning device and the result re-replicated over
-    ICI.  Shared by the sharded GO and BFS builders (same split as
-    _hop_body vs its callers on the single-chip side)."""
+def _make_sharded_hop_packed(mesh, axis: str, ell: EllIndex,
+                             etypes: Tuple[int, ...], nbr_shards,
+                             et_shards, real_rows):
+    """hop(fp, eslot, hrows, *tables) -> next packed frontier, with
+    bucket rows expanded on their owning device and the result
+    re-replicated over ICI.  Shared by the sharded GO and BFS builders
+    (same split as _hop_body_packed vs its callers on the single-chip
+    side).  The re-replication sharding constraint is THE per-hop ICI
+    cost of this design — (k-1)/k of the [n_rows+1, W] frontier per
+    chip per hop, declared in the kernel registry's COLLECTIVE_MODEL
+    and priced by meshaudit's static traffic model."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1331,9 +1348,9 @@ def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
     n_extras = len(ell.extra_owner)
     n = ell.n
 
-    def per_shard(f, *tables):
+    def per_shard(fp, *tables):
         nbrs, ets = tables[:n_buckets], tables[n_buckets:]
-        return tuple(_bucket_expand(jnp, jax, f, nbr, et, etypes)
+        return tuple(_bucket_expand_packed(jnp, jax, fp, nbr, et, etypes)
                      for nbr, et in zip(nbrs, ets))
 
     sharded_hop = shard_map(
@@ -1344,19 +1361,28 @@ def _make_sharded_hop(mesh, axis: str, ell: EllIndex,
 
     replicate = NamedSharding(mesh, P())
 
-    def hop(f, owner, *tables):
+    def hop(fp, eslot, hrows, *tables):
         if n_buckets == 0:                   # empty graph: nothing moves
-            return jnp.zeros_like(f)
-        outs = sharded_hop(f, *tables)
+            return jnp.zeros_like(fp)
+        outs = sharded_hop(fp, *tables)
         trimmed = [o[:r] for o, r in zip(outs, real_rows)]
         nxt = jnp.concatenate(trimmed, axis=0) \
             if len(trimmed) > 1 else trimmed[0]
+        # re-replicate BEFORE the hub OR-merge: _scatter_or_rows ends
+        # in a scatter-SET, which the SPMD partitioner cannot mask to
+        # an identity on shards that don't own the target row (unlike
+        # the int8 path's scatter-max) — partitioned, it clamped the
+        # out-of-range index onto each shard's LAST row and corrupted
+        # row k*chunk-1 on every chip (caught by the mesh-driver
+        # parity gate).  Replicated, the merge is the same tiny
+        # O(n_extras x W) work on every chip, and the per-hop ICI
+        # cost — (k-1)/k of the packed frontier — is unchanged.
+        nxt = jax.lax.with_sharding_constraint(nxt, replicate)
         if n_extras:
             extras = nxt[n:]
-            nxt = nxt.at[owner].max(extras)
-        pad = jnp.zeros((1, f.shape[1]), dtype=jnp.int8)
-        nxt = jnp.concatenate([nxt, pad], axis=0)
-        return jax.lax.with_sharding_constraint(nxt, replicate)
+            nxt = _scatter_or_rows(jnp, nxt, extras, eslot, hrows)
+        pad = jnp.zeros((1, fp.shape[1]), dtype=jnp.uint8)
+        return jnp.concatenate([nxt, pad], axis=0)
 
     return hop
 
@@ -1365,41 +1391,47 @@ def make_sharded_batched_bfs_kernel(mesh, axis: str, ell: EllIndex,
                                     max_steps: int,
                                     etypes: Tuple[int, ...],
                                     nbr_shards, et_shards, real_rows,
-                                    stop_when_found: bool = True):
-    """Sharded-bucket batched BFS depths — the multi-chip counterpart of
-    make_batched_bfs_kernel, same depth/early-exit/compression
-    semantics.  fn(f0, targets, owner, *tables)."""
+                                    stop_when_found: bool = True,
+                                    donate: bool = False):
+    """Sharded-bucket batched BFS depths — the multi-chip counterpart
+    of make_batched_bfs_lanes_kernel, same depth/early-exit/compression
+    semantics: the frontier rides the hops (and the per-hop ICI
+    re-replication) bit-packed while the depth matrix stays per-lane
+    (it IS the result).  fn(f0p, t0p, eslot, hrows, *tables) -> depth
+    [n_rows+1, B] (int8 with -1 = unreachable when max_steps fits,
+    else int16)."""
     import jax
     import jax.numpy as jnp
-    hop = _make_sharded_hop(mesh, axis, ell, etypes, nbr_shards, et_shards,
-                            real_rows)
+    hop = _make_sharded_hop_packed(mesh, axis, ell, etypes, nbr_shards,
+                                   et_shards, real_rows)
     small = max_steps <= 120
 
-    @jax.jit
-    def bfs(f0, targets, owner, *tables):
-        d0 = jnp.where(f0 > 0, jnp.int16(0), INT16_INF)
+    def bfs(f0p, t0p, eslot, hrows, *tables):
+        tb = _unpack_lanes(jnp, t0p) > 0
+        d0 = jnp.where(_unpack_lanes(jnp, f0p) > 0, jnp.int16(0),
+                       INT16_INF)
 
         def cond(state):
-            d, f, step = state
-            go_on = (step < max_steps) & (f > 0).any()
+            d, fp, step = state
+            go_on = (step < max_steps) & (fp != 0).any()
             if stop_when_found:
-                go_on = go_on & ((targets > 0) & (d == INT16_INF)).any()
+                go_on = go_on & (tb & (d == INT16_INF)).any()
             return go_on
 
         def body(state):
-            d, f, step = state
-            nxt = hop(f, owner, *tables)
-            newly = (nxt > 0) & (d == INT16_INF)
+            d, fp, step = state
+            nxtp = hop(fp, eslot, hrows, *tables)
+            newly = (_unpack_lanes(jnp, nxtp) > 0) & (d == INT16_INF)
             d = jnp.where(newly, (step + 1).astype(jnp.int16), d)
-            return d, newly.astype(jnp.int8), step + 1
+            return d, _pack_lanes(jnp, newly), step + 1
 
         d, _, _ = jax.lax.while_loop(
-            cond, body, (d0, f0, jnp.int32(0)))
+            cond, body, (d0, f0p, jnp.int32(0)))
         if small:
             return jnp.where(d == INT16_INF, -1, d).astype(jnp.int8)
         return d
 
-    return bfs
+    return jax.jit(bfs, donate_argnums=(0, 1) if donate else ())
 
 
 # --------------------------------------------------------------------
@@ -2089,50 +2121,208 @@ register_kernel(KernelSpec(
     dispatch=(0,), frontier=(0,), packed=(0,)))
 
 
-def _ell_go_sharded_buckets(fx):
-    mesh = fx.mesh()
+def _sharded_table_avals(fx, nbrs, ets):
+    return tuple(fx.aval(a.shape, np.int32) for a in nbrs) \
+        + tuple(fx.aval(a.shape, np.int32) for a in ets)
+
+
+def _ell_sharded_arg_indices(fx):
+    """Replicated-frontier sharded GO: everything after the
+    (f0p, eslot, hrows) prefix is a row-sharded bucket table."""
+    nb = len(fx.ell.bucket_nbr)
+    return tuple(range(3, 3 + 2 * nb))
+
+
+def _ell_bfs_sharded_arg_indices(fx):
+    nb = len(fx.ell.bucket_nbr)
+    return tuple(range(4, 4 + 2 * nb))
+
+
+def _ell_go_sharded_mesh_buckets(fx, mesh):
+    k = mesh.shape["parts"]
     nbrs, ets, reals = shard_ell(mesh, "parts", fx.ell)
     kern = make_sharded_batched_go_kernel(
         mesh, "parts", fx.ell, fx.steps, fx.etypes, nbrs, ets, reals,
-        pack=True)
-    R1 = fx.ell.n_rows + 1
-    owner = fx.aval((len(fx.ell.extra_owner),), np.int32)
-    tables = tuple(fx.aval(a.shape, np.int32) for a in nbrs) \
-        + tuple(fx.aval(a.shape, np.int32) for a in ets)
+        donate=True)
+    tables = _sharded_table_avals(fx, nbrs, ets)
     return [(("ell_go_sharded", fx.ell.shape_sig(), fx.etypes,
-              fx.steps, 1), kern,
-             (fx.aval((R1, B), np.int8), owner) + tables)
+              fx.steps, k), kern,
+             _packed_frontier_avals(fx, B) + tables)
             for B in fx.widths]
 
 
-def _ell_bfs_sharded_buckets(fx):
-    mesh = fx.mesh()
+def _ell_go_sharded_buckets(fx):
+    return _ell_go_sharded_mesh_buckets(fx, fx.mesh())
+
+
+def _ell_bfs_sharded_mesh_buckets(fx, mesh):
+    k = mesh.shape["parts"]
     nbrs, ets, reals = shard_ell(mesh, "parts", fx.ell)
-    R1 = fx.ell.n_rows + 1
     B = fx.widths[0]
-    owner = fx.aval((len(fx.ell.extra_owner),), np.int32)
-    tables = tuple(fx.aval(a.shape, np.int32) for a in nbrs) \
-        + tuple(fx.aval(a.shape, np.int32) for a in ets)
+    tables = _sharded_table_avals(fx, nbrs, ets)
     out = []
     for shortest in (True, False):
         kern = make_sharded_batched_bfs_kernel(  # nebulint: disable=jax-hotpath
             mesh, "parts", fx.ell, fx.steps, fx.etypes, nbrs, ets,
-            reals, stop_when_found=shortest)
+            reals, stop_when_found=shortest, donate=True)
+        pk = _packed_frontier_avals(fx, B)
         out.append((("ell_bfs_sharded", fx.ell.shape_sig(), fx.etypes,
-                     fx.steps, shortest, 1), kern,
-                    (fx.aval((R1, B), np.int8),
-                     fx.aval((R1, B), np.int8), owner) + tables))
+                     fx.steps, shortest, k), kern,
+                    (pk[0], pk[0], pk[1], pk[2]) + tables))
     return out
+
+
+def _ell_bfs_sharded_buckets(fx):
+    return _ell_bfs_sharded_mesh_buckets(fx, fx.mesh())
+
+
+def _replicated_frontier_ici(fx, k):
+    """Per-hop ICI cost of the replicated designs: the re-replication
+    sharding constraint ships (k-1)/k of the packed [n_rows+1, W]
+    frontier to every chip — bounded by the full frontier bytes."""
+    return (fx.ell.n_rows + 1) * lanes_width(max(fx.widths))
 
 
 register_kernel(KernelSpec(
     "ell_go_sharded", make_sharded_batched_go_kernel,
     phase_kind="ell_go_sharded",
     # per steps value: one retrace per pinned batch width
-    budget=2, instantiate=_ell_go_sharded_buckets, dispatch=(0,),
-    frontier=(0,)))
+    budget=2, instantiate=_ell_go_sharded_buckets, donate=(0,),
+    dispatch=(0,), frontier=(0,), packed=(0,),
+    # COLLECTIVE_MODEL: the ONLY cross-chip movement is the per-hop
+    # frontier re-replication (a sharding constraint the partitioner
+    # lowers to an all-gather); any other collective — e.g. a full
+    # bucket-table all-gather from a closure-captured device array —
+    # is an undeclared regression
+    mesh_instantiate=_ell_go_sharded_mesh_buckets,
+    collective=(("sharding_constraint", ()),),
+    ici_bytes=lambda fx, k: _replicated_frontier_ici(fx, k)
+    * max(fx.steps - 1, 1),
+    shard_args=_ell_sharded_arg_indices))
 register_kernel(KernelSpec(
     "ell_bfs_sharded", make_sharded_batched_bfs_kernel,
     phase_kind="ell_bfs_sharded",
-    budget=2, instantiate=_ell_bfs_sharded_buckets, dispatch=(0, 1),
-    frontier=(0, 1)))
+    budget=2, instantiate=_ell_bfs_sharded_buckets, donate=(0, 1),
+    dispatch=(0, 1), frontier=(0, 1), packed=(0, 1),
+    mesh_instantiate=_ell_bfs_sharded_mesh_buckets,
+    collective=(("sharding_constraint", ()),),
+    # per BFS level (the while body traces once)
+    ici_bytes=_replicated_frontier_ici,
+    shard_args=_ell_bfs_sharded_arg_indices))
+
+
+# ------------------------------------------------ frontier-sharded (mesh)
+def _mesh_sparse_shapes(fx, k):
+    """runtime._launch_mesh_sparse's cap arithmetic at mesh size k, on
+    the audit fixture's ladder head (the BFS path has its OWN
+    arithmetic — _mesh_sparse_bfs_shapes below — because the runtime's
+    _mesh_sparse_bfs sizes pair capacity off tpu_sparse_cap, not the
+    per-hop GO ladder)."""
+    d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
+    c0 = fx.c0s[0]
+    caps = sparse_caps(c0, d_max, fx.steps, fx.sparse_cap,
+                       growth=fx.sparse_growth)
+    cap_x = max(256, caps[-1] // max(k // 2, 1))
+    cap_e = max(64, c0)
+    return c0, caps, cap_x, cap_e
+
+
+def _mesh_sparse_bfs_shapes(fx, k):
+    """runtime._mesh_sparse_bfs's cap arithmetic (runtime.py — cap =
+    tpu_sparse_cap, cap_x/cap_e derived from it), so the audited
+    buckets carry the REAL serving shapes: a regression that blows the
+    exchange buffers or per-shard residency at the 2^17-pair caps must
+    fail lint, not just at toy caps."""
+    cap = fx.sparse_cap
+    cap_x = max(256, cap // max(k // 2, 1))
+    cap_e = max(64, cap // 8)
+    return cap, cap_x, cap_e
+
+
+def _mesh_sparse_go_mesh_buckets(fx, mesh):
+    k = mesh.shape["parts"]
+    sh = build_sharded_ell(fx.ell, k)
+    c0, caps, cap_x, cap_e = _mesh_sparse_shapes(fx, k)
+    kern = make_frontier_sharded_sparse_go_kernel(
+        mesh, "parts", sh, fx.steps, fx.etypes, caps, cap_x=cap_x,
+        cap_e=cap_e)
+    avals = ((fx.aval((k, c0), np.int32), fx.aval((k, c0), np.int32),
+              fx.aval(sh.starts_s.shape, np.int32),
+              fx.aval(sh.ecnt_s.shape, np.int32),
+              fx.aval(sh.e0_s.shape, np.int32))
+             + tuple(fx.aval(a.shape, np.int32) for a in sh.nbr_s)
+             + tuple(fx.aval(a.shape, np.int32) for a in sh.et_s))
+    return [(("mesh_sparse_go", fx.ell.shape_sig(), fx.etypes,
+              fx.steps, caps, k, cap_x, cap_e), kern, avals)]
+
+
+def _mesh_sparse_go_buckets(fx):
+    return _mesh_sparse_go_mesh_buckets(fx, fx.mesh())
+
+
+def _mesh_sparse_bfs_mesh_buckets(fx, mesh):
+    k = mesh.shape["parts"]
+    sh = build_sharded_ell(fx.ell, k)
+    cap, cap_x, cap_e = _mesh_sparse_bfs_shapes(fx, k)
+    build = make_frontier_sharded_sparse_bfs_kernel(
+        mesh, "parts", sh, fx.steps, fx.etypes, cap, cap_x=cap_x,
+        cap_e=cap_e, stop_when_found=True)
+    kern = build(fx.qmax)
+    pair = fx.aval((k, cap), np.int32)
+    avals = ((pair, pair, pair, pair,
+              fx.aval(sh.starts_s.shape, np.int32),
+              fx.aval(sh.ecnt_s.shape, np.int32),
+              fx.aval(sh.e0_s.shape, np.int32))
+             + tuple(fx.aval(a.shape, np.int32) for a in sh.nbr_s)
+             + tuple(fx.aval(a.shape, np.int32) for a in sh.et_s))
+    return [(("mesh_sparse_bfs", fx.ell.shape_sig(), fx.etypes,
+              fx.steps, cap, k, cap_x, cap_e, fx.qmax, True), kern,
+             avals)]
+
+
+def _mesh_sparse_bfs_buckets(fx):
+    return _mesh_sparse_bfs_mesh_buckets(fx, fx.mesh())
+
+
+def _mesh_sparse_ici(fx, k):
+    """all_to_all budget: per hop the candidate router ships two
+    [k, cap_x] int32 planes and the hub router two [k, cap_e] planes
+    (each device keeps 1/k, so (k-1)/k of it crosses ICI); the psum'd
+    overflow/early-exit scalars are noise under the 4 KiB pad."""
+    _c0, _caps, cap_x, cap_e = _mesh_sparse_shapes(fx, k)
+    return 2 * 4 * k * (cap_x + cap_e) + 4096
+
+
+register_kernel(KernelSpec(
+    "mesh_sparse_go", make_frontier_sharded_sparse_go_kernel,
+    phase_kind="mesh_sparse_go",
+    # one retrace per sparse c0 rung per mesh size (the runtime keys
+    # caps/k/cap_x/cap_e into the kernel cache)
+    budget=2, instantiate=_mesh_sparse_go_buckets, dispatch=(0, 1),
+    mesh_instantiate=_mesh_sparse_go_mesh_buckets,
+    collective=(("all_to_all", ("parts",)), ("psum", ("parts",))),
+    # the hop loop is Python-unrolled: steps-1 candidate exchanges
+    # plus the pre-loop hub exchange
+    ici_bytes=lambda fx, k: _mesh_sparse_ici(fx, k) * fx.steps,
+    shard_args=lambda fx: tuple(
+        range(5 + 2 * len(fx.ell.bucket_nbr))),
+    shard_outs=(0,)))
+def _mesh_sparse_bfs_ici(fx, k):
+    """Per BFS level (the while body traces once): the candidate
+    router ships two [k, cap_x] int32 planes, the hub router two
+    [k, cap_e] — at the runtime's REAL tpu_sparse_cap-derived caps."""
+    _cap, cap_x, cap_e = _mesh_sparse_bfs_shapes(fx, k)
+    return 2 * 4 * k * (cap_x + cap_e) + 4096
+
+
+register_kernel(KernelSpec(
+    "mesh_sparse_bfs", make_frontier_sharded_sparse_bfs_kernel,
+    phase_kind="mesh_sparse_bfs",
+    budget=2, instantiate=_mesh_sparse_bfs_buckets,
+    dispatch=(0, 1, 2, 3),
+    mesh_instantiate=_mesh_sparse_bfs_mesh_buckets,
+    collective=(("all_to_all", ("parts",)), ("psum", ("parts",))),
+    ici_bytes=_mesh_sparse_bfs_ici,
+    shard_args=lambda fx: tuple(
+        range(7 + 2 * len(fx.ell.bucket_nbr))),
+    shard_outs=(0, 1)))

@@ -79,18 +79,56 @@ class KernelSpec:
                 callable(fixture) -> max bytes any bucket's device->
                 host fetch may total — the static proof that the
                 reduced wire shape actually shrank
+
+    meshaudit (nebulint v4) fields — sharded families only:
+
+    mesh_instantiate  fn(fixture, mesh) -> buckets like ``instantiate``
+                but built against a REAL multi-device mesh; meshaudit
+                traces them at every audited mesh size (2/4/8-way on
+                the forced-host-device CPU mesh) and proves the
+                COLLECTIVE_MODEL on the IR
+    collective  the declared COLLECTIVE_MODEL: a tuple of
+                (primitive_name, axes_tuple) pairs — the EXACT
+                collective inventory the traced jaxpr may contain
+                (psum/all_gather/all_to_all/ppermute, plus
+                'sharding_constraint' for the replicated designs'
+                re-replication points).  Any undeclared collective —
+                including an implicit resharding/all-gather introduced
+                by closure capture — fails lint, as does a declared
+                one that vanished
+    ici_bytes   callable(fixture, k) -> upper bound on the per-device
+                cross-shard exchange bytes of ONE traced dispatch at
+                mesh size k.  meshaudit derives the actual bytes from
+                the collective operand avals (the static ICI traffic
+                model, docs/static_analysis.md): eqns inside scan/fori
+                bodies multiply by their static trip counts; a data-
+                dependent while body counts ONCE, so for level-loop
+                kernels the bound is per level
+    shard_args  argument indices whose leading dim shards over the
+                mesh axis (per-shard residency = bytes / k); all
+                other arguments are replicated per chip.  A callable
+                (fixture) -> indices for families whose table count
+                is fixture-dependent
+    shard_outs  output indices sharded the same way (the rest are
+                replicated, e.g. the re-replicated frontier)
     """
 
     __slots__ = ("name", "factory", "phase_kind", "budget", "instantiate",
                  "donate", "dispatch", "frontier", "packed",
-                 "d2h_bytes_max")
+                 "d2h_bytes_max", "mesh_instantiate", "collective",
+                 "ici_bytes", "shard_args", "shard_outs")
 
     def __init__(self, name: str, factory, phase_kind: str, budget: int,
                  instantiate, donate: Tuple[int, ...] = (),
                  dispatch: Tuple[int, ...] = (),
                  frontier: Tuple[int, ...] = (),
                  packed: Tuple[int, ...] = (),
-                 d2h_bytes_max=None):
+                 d2h_bytes_max=None,
+                 mesh_instantiate=None,
+                 collective: Optional[Tuple] = None,
+                 ici_bytes=None,
+                 shard_args: Tuple[int, ...] = (),
+                 shard_outs: Tuple[int, ...] = ()):
         self.name = name
         self.factory = factory
         self.phase_kind = phase_kind
@@ -101,6 +139,13 @@ class KernelSpec:
         self.frontier = tuple(frontier)
         self.packed = tuple(packed)
         self.d2h_bytes_max = d2h_bytes_max
+        self.mesh_instantiate = mesh_instantiate
+        self.collective = (tuple(tuple(c) for c in collective)
+                          if collective is not None else None)
+        self.ici_bytes = ici_bytes
+        self.shard_args = (shard_args if callable(shard_args)
+                           else tuple(shard_args))
+        self.shard_outs = tuple(shard_outs)
 
 
 KERNEL_REGISTRY: Dict[str, KernelSpec] = {}
@@ -179,10 +224,24 @@ class AuditFixture:
         return (self.aval((self.m,), i32), self.aval((self.m,), i32),
                 self.aval((self.m,), i32))
 
-    def mesh(self):
-        """A 1-device mesh — shard_map/psum trace identically at any
-        axis size, so the single-device trace proves the IR shape."""
-        return Mesh(np.array(jax.devices()[:1]), ("parts",))
+    def mesh(self, k: int = 1):
+        """A k-device 1-D mesh over the visible devices (tier-1 forces
+        an 8-way virtual CPU host platform, tests/conftest.py; the lint
+        CLI forces the same before jax initializes).  jaxaudit's base
+        pass traces k=1; meshaudit re-traces every sharded family at
+        the REAL audited sizes because collective inventory, exchange
+        avals and per-shard residency all depend on the axis size."""
+        devs = jax.devices()
+        if len(devs) < k:
+            raise ValueError(f"mesh({k}) needs {k} devices, "
+                             f"have {len(devs)}")
+        return Mesh(np.array(devs[:k]), ("parts",))
+
+    def mesh_sizes(self) -> Tuple[int, ...]:
+        """The audited mesh-shape ladder, clamped to visible devices
+        (8 under the tier-1 forced host platform)."""
+        have = len(jax.devices())
+        return tuple(k for k in (1, 2, 4, 8) if k <= have)
 
 
 # ---------------------------------------------------------------- helpers
@@ -380,12 +439,18 @@ def _bfs_buckets(fx: "AuditFixture"):
     return out
 
 
-def _sharded_go_buckets(fx: "AuditFixture"):
-    mesh = fx.mesh()
+def _sharded_go_mesh_buckets(fx: "AuditFixture", mesh: Mesh):
+    """One bucket per mesh size; fx.m is a multiple of 8, so the edge
+    avals shard evenly at every audited axis size."""
+    k = mesh.shape["parts"]
     kern = make_sharded_go_kernel(mesh, "parts", fx.n, fx.steps,
                                   fx.etypes)
-    return [(("sharded_go", fx.steps, 1), kern,
+    return [(("sharded_go", fx.steps, k), kern,
              fx.edge_avals() + (fx.aval((fx.n,), np.bool_),))]
+
+
+def _sharded_go_buckets(fx: "AuditFixture"):
+    return _sharded_go_mesh_buckets(fx, fx.mesh())
 
 
 register_kernel(KernelSpec(
@@ -404,7 +469,16 @@ register_kernel(KernelSpec(
 register_kernel(KernelSpec(
     "sharded_go", make_sharded_go_kernel, phase_kind="go_sharded",
     budget=1, instantiate=_sharded_go_buckets, dispatch=(3,),
-    frontier=(3,)))
+    frontier=(3,),
+    # COLLECTIVE_MODEL: one explicit psum per hop merges the per-shard
+    # partial bitmaps over ICI — nothing else may move between chips
+    mesh_instantiate=_sharded_go_mesh_buckets,
+    collective=(("psum", ("parts",)),),
+    # ring all-reduce of the int32 [n] partial bitmap per hop:
+    # 2*(k-1)/k * 4n bytes per device, bounded by 8n, times the
+    # steps-1 hop scan
+    ici_bytes=lambda fx, k: 8 * fx.n * max(fx.steps - 1, 1),
+    shard_args=(0, 1, 2), shard_outs=(0,)))
 
 
 def shard_edges(mesh: Mesh, axis: str, edge_src: np.ndarray,

@@ -303,6 +303,76 @@ HBM_MODEL = {
     "edge_ceiling": 639_000_000,
 }
 
+# ====================================================================
+# MESH_MODEL — the multi-chip counterpart of HBM_MODEL, enforced by
+# meshaudit (tools/lint/meshaudit.py, nebulint v4).  The auditor
+# proves, per audited mesh size k:
+#   * capacity_edges[k] * table_bytes_per_edge <= k * table_budget —
+#     the published multi-chip capacity table (max edges vs #chips,
+#     docs/static_analysis.md + BASELINE.md) is ARITHMETIC over the
+#     declarations, so growing one side without the other fails tier-1;
+#   * every sharded kernel rung's per-shard residency (tables/k +
+#     replicated frontier + collective exchange buffers) fits
+#     device_hbm_bytes;
+#   * the per-dispatch ICI exchange bytes derived from the traced
+#     collective operand avals fit each kernel's declared ici_bytes
+#     bound (the static link-traffic model; ici_gbps prices it into
+#     the link-vs-compute table beside docs/roofline.md).
+#   ici_gbps   per-chip aggregate ICI bandwidth (v5e: 1,600 Gbps)
+#   hbm_gbps   measured HBM streaming rate (BENCH_r05, roofline.md)
+#   capacity_edges  the serving claim per mesh size — k chips hold
+#              k x the per-chip table budget (the frontier-sharded
+#              design adds no replicated state that scales with the
+#              graph; the replicated-frontier design's [n_rows+1, W]
+#              matrix is audited against the rung residency gate)
+# ====================================================================
+MESH_MODEL = {
+    "mesh_sizes": (1, 2, 4, 8),
+    "ici_gbps": 200.0,
+    "hbm_gbps": 819.0,
+    "capacity_edges": {1: 639_000_000, 2: 1_278_000_000,
+                       4: 2_556_000_000, 8: 5_112_000_000},
+}
+
+# ====================================================================
+# MESH_CARVEOUTS — the closed registry of reasons a sharded-space
+# query may decline to the CPU loop.  Every ``raise TpuDecline`` and
+# every ``return False`` inside a can_run_* gate in THIS module must
+# carry a ``# nebulint: carveout=<reason>`` tag naming one of these
+# keys (tools/lint/meshaudit.py carveout-inventory); untagged decline
+# sites and dead registry entries fail lint.  This makes ROADMAP-5's
+# "shrink the mesh carve-outs" an enumerable, baselined list: deleting
+# a carve-out means deleting its sites AND its row here.
+# ====================================================================
+MESH_CARVEOUTS = {
+    "cpu-backend": "storage_backend=cpu pins the space to the CPU "
+                   "loop by configuration",
+    "piped-input": "GO ... | GO feeds per-row inputs the batch "
+                   "planner cannot see statically",
+    "breaker-open": "device circuit breaker open — a known-broken "
+                    "device must not be re-probed per query "
+                    "(docs/durability.md)",
+    "upto-mesh": "GO UPTO needs the union accumulator the mesh "
+                 "kernels do not carry yet (ROADMAP-5)",
+    "schema-miss": "OVER names an edge type the schema manager "
+                   "cannot resolve",
+    "plan-decline": "the GO planner cannot reproduce the query's "
+                    "semantics on the device path",
+    "expr-undecodable": "a shipped WHERE/YIELD expression tree "
+                        "failed to decode on the serving side",
+    "device-failure": "classified device runtime failure — the "
+                      "breaker records it and the CPU loop serves",
+    "overlay-uncompilable": "delta-overlay WHERE not expressible in "
+                            "expr_compile",
+    "overlay-div-guard": "overlay division guard needs per-row error "
+                         "semantics the batched filter cannot give",
+    "invalid-prop-shortcircuit": "missing-prop disjunction needs the "
+                                 "CPU path's short-circuit evaluation "
+                                 "order",
+    "mirror-build-failed": "mirror build/transfer failed for the "
+                           "space — nothing resident to serve from",
+}
+
 DEVICE_PHASES = {
     "ell_go": {"phases": ("tpu.launch", "tpu.kernel", "tpu.fetch",
                           "tpu.assemble"), "h2d": 1, "d2h": 1},
@@ -321,6 +391,11 @@ DEVICE_PHASES = {
                        "h2d": 1, "d2h": 1},
     "ell_bfs_sharded": {"phases": ("tpu.kernel", "tpu.fetch"),
                         "h2d": 2, "d2h": 1},
+    "mesh_sparse_go": {"phases": ("tpu.launch", "tpu.kernel",
+                                  "tpu.fetch", "tpu.assemble"),
+                       "h2d": 2, "d2h": 1},
+    "mesh_sparse_bfs": {"phases": ("tpu.kernel", "tpu.fetch"),
+                        "h2d": 4, "d2h": 2},
     "go_fused": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 2},
     "go_filtered": {"phases": ("tpu.kernel",), "h2d": 3, "d2h": 2},
     "bfs_fused": {"phases": ("tpu.kernel",), "h2d": 2, "d2h": 1},
@@ -786,21 +861,21 @@ class TpuQueryRuntime:
                    pushed: Optional[bytes], remnant: Optional[Expression],
                    src_refs, dst_refs, has_input: bool) -> bool:
         if flags.get("storage_backend") == "cpu":
-            return False
+            return False        # nebulint: carveout=cpu-backend
         if has_input:
-            return False
+            return False        # nebulint: carveout=piped-input
         if self.breaker.is_open((space_id, "go")):
             # route to CPU without paying a plan/mirror attempt against
             # a known-broken device (non-mutating peek: the half-open
             # probe token is consumed at dispatch, not here)
-            return False
+            return False        # nebulint: carveout=breaker-open
         if getattr(sentence.step, "upto", False) \
                 and sentence.step.steps > 1 \
                 and int(flags.get("tpu_mesh_devices") or 0) > 1:
             # UPTO runs on the cumulative-frontier kernel variants
             # (single-device sparse + dense); the frontier-sharded
             # mesh kernels have no union accumulator — CPU loop there
-            return False
+            return False        # nebulint: carveout=upto-mesh
         # alias map (same resolution GoExecutor did)
         alias_to_etype: Dict[str, int] = {}
         s = sentence
@@ -812,7 +887,7 @@ class TpuQueryRuntime:
             for oe in s.over.edges:
                 r = self.sm.to_edge_type(space_id, oe.edge)
                 if not r.ok():
-                    return False
+                    return False        # nebulint: carveout=schema-miss
                 alias_to_etype[oe.alias or oe.edge] = \
                     -r.value() if s.over.reversely else r.value()
 
@@ -820,7 +895,7 @@ class TpuQueryRuntime:
         plan = self._plan_go(space_id, alias_to_etype, where_expr,
                              pushed_mode=(pushed is not None))
         if plan is None:
-            return False
+            return False        # nebulint: carveout=plan-decline
         self._plans[id(sentence)] = plan
         return True
 
@@ -870,6 +945,7 @@ class TpuQueryRuntime:
                                           alias=alias)
                           for blob, alias in yield_specs]
         except Exception as e:      # noqa: BLE001 — undecodable tree
+            # nebulint: carveout=expr-undecodable
             raise TpuDecline(f"undecodable expression: {e}")
         alias_to_etype = {a: et for et, a in etype_to_alias.items()}
         if upto and int(flags.get("tpu_mesh_devices") or 0) > 1:
@@ -878,10 +954,12 @@ class TpuQueryRuntime:
             # decline happens here — BEFORE the plan build, and the
             # client caches it per space so repeat UPTO queries don't
             # re-pay the RPC round trip (storage/device.py)
+            # nebulint: carveout=upto-mesh
             raise TpuDecline("UPTO on a mesh-sharded space")
         plan = self._plan_go(space_id, alias_to_etype, where_expr,
                              pushed_mode)
         if plan is None:
+            # nebulint: carveout=plan-decline
             raise TpuDecline("device cannot reproduce this query")
         return self._go_via_dispatcher(
             space_id, plan, start_vids, etypes, steps, etype_to_alias,
@@ -908,6 +986,7 @@ class TpuQueryRuntime:
             # degraded, so the CPU fallback surfaces the state
             tracing.annotate("tpu.breaker", state="open", space=space_id,
                              kernel_class="go")
+            # nebulint: carveout=breaker-open
             raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
         self._bump("go_device")
@@ -947,6 +1026,7 @@ class TpuQueryRuntime:
             tracing.annotate("tpu.breaker", state="failure",
                              space=space_id, kernel_class="go",
                              reason=reason)
+            # nebulint: carveout=device-failure
             raise TpuDecline(f"device runtime failure ({reason}): {e}",
                              degraded=True) from e
         self.breaker.record_success(bkey)
@@ -1501,10 +1581,14 @@ class TpuQueryRuntime:
         # a count reduction only rides the packed single-chip kernels
         assert not (upto and (delta is not None or mesh_mt is not None))
         B = self._batch_width(nq)
+        # the replicated-frontier mesh kernels are bit-packed ONLY (the
+        # int8 carriers were retired with them — lint enforces the
+        # layout via KernelSpec.packed), so a mesh dispatch is always
+        # packed regardless of the single-chip flag
         packed_mode = bool(flags.get("tpu_packed_frontier", True)) \
-            and mesh_mt is None
+            or mesh_mt is not None
         count_mode = reduce is not None and reduce[0] == "count" \
-            and packed_mode and delta is None
+            and packed_mode and delta is None and mesh_mt is None
         args = ix.kernel_args()
         if packed_mode:
             f0_dev = self._upload_frontier_packed(
@@ -1540,11 +1624,14 @@ class TpuQueryRuntime:
             kern = self._kernel(
                 ("ell_go_sharded", ix.shape_sig(), et_tuple, steps,
                  mesh.shape["parts"]),
+                # donate=True: f0p is fresh per dispatch, same as the
+                # single-chip packed kernel
                 lambda: make_sharded_batched_go_kernel(
                     mesh, "parts", ix, steps, et_tuple, nbrs, ets, reals,
-                    pack=True))
-            with tracing.span("tpu.kernel", kind="ell_go_sharded"):
-                out_dev = kern(f0_dev, args[0], *nbrs, *ets)
+                    donate=True))
+            with tracing.span("tpu.kernel", kind="ell_go_sharded",
+                              width=B, packed=True):
+                out_dev = kern(f0_dev, eslot, hrows, *nbrs, *ets)
         elif count_mode:
             deg = self._deg_dev(m, ix, et_tuple)
             kern = self._kernel(
@@ -1992,8 +2079,10 @@ class TpuQueryRuntime:
             try:
                 cval = comp.compile(where_expr)
             except CompileError:
+                # nebulint: carveout=overlay-uncompilable
                 raise TpuDecline("overlay filter uncompilable")
             if comp.div_guards and not plan.pushed_mode:
+                # nebulint: carveout=overlay-div-guard
                 raise TpuDecline("overlay div guard in graphd mode")
             dplan = _GoPlan(d, plan.alias_to_etype, cval, dict(comp.used),
                             plan.pushed_mode, comp, plan.expr_str,
@@ -2001,6 +2090,7 @@ class TpuQueryRuntime:
             inv = self._invalid_candidates(d, dplan.filter_used, cand)
             if inv is not None and inv.any() \
                     and (not dplan.pushed_mode or dplan.sc_or):
+                # nebulint: carveout=invalid-prop-shortcircuit
                 raise TpuDecline("overlay WHERE reads an invalid prop; "
                                  "CPU short-circuit semantics decide")
             idx = cand[self._host_filter(d, dplan, cand)]
@@ -2041,6 +2131,7 @@ class TpuQueryRuntime:
                 else:
                     continue
                 if not col.valid.all():
+                    # nebulint: carveout=invalid-prop-shortcircuit
                     raise TpuDecline(
                         "fused WHERE with || reads a partially-invalid "
                         "column; CPU short-circuit semantics decide")
@@ -2063,6 +2154,7 @@ class TpuQueryRuntime:
             if inv is not None and inv.any():
                 # graphd-mode WHERE may or may not raise depending on
                 # the row-level evaluation order — the CPU loop decides
+                # nebulint: carveout=invalid-prop-shortcircuit
                 raise TpuDecline(
                     "WHERE reads a prop invalid on candidate rows; "
                     "CPU short-circuit semantics decide")
@@ -2154,6 +2246,7 @@ class TpuQueryRuntime:
                 # per-row evaluator decides (the generative WHERE
                 # differential's missing-column x disjunction cell)
                 from ..storage.device import TpuDecline
+                # nebulint: carveout=invalid-prop-shortcircuit
                 raise TpuDecline(
                     "pushed WHERE with || over a partially-valid "
                     "prop; per-row short-circuit semantics decide")
@@ -2836,9 +2929,27 @@ class TpuQueryRuntime:
             # placement/overflow: replicated-frontier fallback below
         args = ix.kernel_args()
         mt = self._mesh_tables(m, ix)
+        # the sharded BFS frontier is bit-packed ONLY, like the sharded
+        # GO (KernelSpec.packed enforces the layout)
         packed_mode = bool(flags.get("tpu_packed_frontier", True)) \
-            and mt is None
+            or mt is not None
         if packed_mode:
+            eslot, hrows = self._hub_merge_dev(m, ix)
+            f0_dev = self._upload_frontier_packed(
+                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
+            t0_dev = self._upload_frontier_packed(
+                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
+        if mt is not None:
+            mesh, nbrs, ets, reals = mt
+            kern = self._kernel(
+                ("ell_bfs_sharded", ix.shape_sig(), et_tuple, max_steps,
+                 shortest, mesh.shape["parts"]),
+                # donate=True: f0p/t0p are built fresh per dispatch
+                lambda: make_sharded_batched_bfs_kernel(
+                    mesh, "parts", ix, max_steps, et_tuple, nbrs, ets,
+                    reals, stop_when_found=shortest, donate=True))
+            call_args = (f0_dev, t0_dev, eslot, hrows, *nbrs, *ets)
+        elif packed_mode:
             from .ell import make_batched_bfs_lanes_kernel
             kern = self._kernel(
                 ("ell_bfs_packed", ix.shape_sig(), et_tuple, max_steps,
@@ -2847,13 +2958,8 @@ class TpuQueryRuntime:
                 lambda: make_batched_bfs_lanes_kernel(
                     ix, max_steps, et_tuple, stop_when_found=shortest,
                     donate=True))
-            eslot, hrows = self._hub_merge_dev(m, ix)
-            f0_dev = self._upload_frontier_packed(
-                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
-            t0_dev = self._upload_frontier_packed(
-                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
             call_args = (f0_dev, t0_dev, eslot, hrows, *args[1:])
-        elif mt is None:
+        else:
             kern = self._kernel(
                 ("ell_bfs", ix.shape_sig(), et_tuple, max_steps, shortest),
                 # donate=True: f0/t0 are built fresh per dispatch below
@@ -2865,19 +2971,6 @@ class TpuQueryRuntime:
             t0_dev = self._upload_frontier(
                 ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
             call_args = (f0_dev, t0_dev, *args)
-        else:
-            mesh, nbrs, ets, reals = mt
-            kern = self._kernel(
-                ("ell_bfs_sharded", ix.shape_sig(), et_tuple, max_steps,
-                 shortest, mesh.shape["parts"]),
-                lambda: make_sharded_batched_bfs_kernel(
-                    mesh, "parts", ix, max_steps, et_tuple, nbrs, ets,
-                    reals, stop_when_found=shortest))
-            f0_dev = self._upload_frontier(
-                ix, *self._flat_coords(m, ix, starts_per_query, nq), B)
-            t0_dev = self._upload_frontier(
-                ix, *self._flat_coords(m, ix, targets_per_query, nq), B)
-            call_args = (f0_dev, t0_dev, args[0], *nbrs, *ets)
         self._bump("path_device", nq)
         with tracing.span("tpu.kernel",
                           kind="ell_bfs" if mt is None
@@ -2956,10 +3049,11 @@ class TpuQueryRuntime:
              shortest, k, cap, cap_x, cap_e, B),
             lambda: builder(B))
         args = sharded_device_args(mesh, "parts", sh)
-        dep_dev, ovf_dev = kern(
-            jnp.asarray(ps[0]), jnp.asarray(ps[1]),
-            jnp.asarray(pt[0]), jnp.asarray(pt[1]),
-            args[0], args[1], args[2], *args[3], *args[4])
+        with tracing.span("tpu.kernel", kind="mesh_sparse_bfs"):
+            dep_dev, ovf_dev = kern(
+                jnp.asarray(ps[0]), jnp.asarray(ps[1]),
+                jnp.asarray(pt[0]), jnp.asarray(pt[1]),
+                args[0], args[1], args[2], *args[3], *args[4])
         if np.asarray(ovf_dev).any():
             self._bump("sparse_overflows")
             return None
@@ -3005,9 +3099,9 @@ class TpuQueryRuntime:
     # ================================================== FIND PATH
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
         if flags.get("storage_backend") == "cpu":
-            return False
+            return False        # nebulint: carveout=cpu-backend
         if self.breaker.is_open((space_id, "path")):
-            return False
+            return False        # nebulint: carveout=breaker-open
         try:
             self.mirror(space_id)
         except Exception as e:      # noqa: BLE001 — build/transfer failed
@@ -3015,7 +3109,7 @@ class TpuQueryRuntime:
             reason = classify_device_failure(e)
             if reason is not None:
                 self.breaker.record_failure((space_id, "path"), reason)
-            return False
+            return False        # nebulint: carveout=mirror-build-failed
         return True
 
     def run_find_path(self, executor, space_id: int, srcs: List[int],
@@ -3031,6 +3125,7 @@ class TpuQueryRuntime:
         if why is not None:
             tracing.annotate("tpu.breaker", state="open", space=space_id,
                              kernel_class="path")
+            # nebulint: carveout=breaker-open
             raise TpuDecline(why, degraded=True)
         et_tuple = tuple(sorted(set(etypes)))
 
@@ -3052,6 +3147,7 @@ class TpuQueryRuntime:
             tracing.annotate("tpu.breaker", state="failure",
                              space=space_id, kernel_class="path",
                              reason=reason)
+            # nebulint: carveout=device-failure
             raise TpuDecline(f"device runtime failure ({reason}): {e}",
                              degraded=True) from e
         self.breaker.record_success(bkey)
@@ -3073,6 +3169,7 @@ class TpuQueryRuntime:
         the space."""
         from ..storage.device import TpuDecline
         if not self.can_run_path(space_id, etypes):
+            # nebulint: carveout=plan-decline
             raise TpuDecline("device path unavailable for space")
         interim = self.run_find_path(None, space_id, srcs, dsts, etypes,
                                      max_steps, shortest, etype_names)

@@ -1,16 +1,19 @@
 #!/usr/bin/env bash
 # Static-analysis + sanitizer gate (docs/static_analysis.md):
-#   1. nebulint — the fourteen whole-package checks over nebula_tpu:
+#   1. nebulint — the sixteen whole-package checks over nebula_tpu:
 #      the AST checks (lock discipline, lock-order cycles, Status
 #      discipline, JAX hot-path hygiene, flag/span/metric/event
 #      registries), the two SEMANTIC passes — the jaxpr device-path
 #      auditor (traces every registered kernel across its shape
 #      buckets, proves the per-device HBM budget; needs jax but no
 #      accelerator, hence JAX_PLATFORMS=cpu) and the RPC
-#      wire-contract checker — and the v3 FLOW passes: guard
+#      wire-contract checker — the v3 FLOW passes: guard
 #      inference (static mini-TSan), interprocedural
 #      blocking-under-lock, Deadline/trace context-capture escape
-#      analysis, plus the stale-suppression fossil detector;
+#      analysis, plus the stale-suppression fossil detector — and the
+#      v4 MESH layer: the SPMD collective/ICI-traffic/capacity
+#      auditor (2/4/8-way CPU-mesh traces) and the carve-out
+#      inventory over tpu/runtime.py's CPU-decline sites;
 #   2. asan_driver — the native C ABI driven under the ASan+UBSan build,
 #      when `make -C native asan` has produced the instrumented .so and
 #      libasan is present (skipped, loudly, otherwise).

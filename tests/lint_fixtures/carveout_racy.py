@@ -1,0 +1,39 @@
+"""Seeded carveout-inventory violations (lint fixture — see README).
+
+A miniature tpu/runtime.py: the registry carries one DEAD entry, one
+decline site is UNTAGGED, one cites an UNKNOWN reason, and the two
+clean sites prove tagged declines and gate returns pass.  The test
+copies this file to ``<root>/tpu/runtime.py`` so the pass's scope
+matcher sees the real module name.
+"""
+
+
+class TpuDecline(Exception):
+    pass
+
+
+MESH_CARVEOUTS = {
+    "cpu-backend": "configuration pins the space to the CPU loop",
+    "plan-decline": "the planner cannot reproduce the query on device",
+    "ghost-reason": "nothing cites this entry any more",
+}
+
+
+class Runtime:
+    def can_run_go(self, space_id):
+        if space_id < 0:
+            return False        # nebulint: carveout=cpu-backend
+        if space_id > 100:
+            return False        # untagged gate decline
+        return True
+
+    def serve_go(self, space_id):
+        if space_id == 7:
+            # nebulint: carveout=plan-decline
+            raise TpuDecline("device cannot reproduce this query")
+        if space_id == 9:
+            raise TpuDecline("untagged decline site")
+        if space_id == 11:
+            # nebulint: carveout=not-a-registered-reason
+            raise TpuDecline("tag cites an unknown reason")
+        return []

@@ -5,6 +5,7 @@ the OrderedLock watchdog must detect a deliberately seeded inversion.
 
 Run just these: ``pytest -m lint``.
 """
+import json
 import os
 import textwrap
 import threading
@@ -507,6 +508,7 @@ def test_all_checks_registered():
                                "metric-registry", "event-registry",
                                "guard-inference", "blocking-under-lock",
                                "context-capture", "jaxpr-audit",
+                               "mesh-audit", "carveout-inventory",
                                "wire-contract", "stale-suppression"}
 
 
@@ -1874,3 +1876,547 @@ def test_blocking_mixed_with_items_alignment(tmp_path):
                 with tracing.span("x"), self.cond:
                     self.cond.wait()
     """}, checks=["blocking-under-lock"]) == []
+
+
+# ================================================ 15 · mesh-audit (v4)
+def _mesh_fixture():
+    """A tiny shared mesh-audit fixture: 2 devices are enough to make
+    collectives real (tier-1 forces 8 virtual CPU devices)."""
+    from nebula_tpu.tpu.kernels import AuditFixture
+    return AuditFixture()
+
+
+def _mesh_spec(fn, avals, *, name="mk", collective=None, ici=None,
+               donate=(), shard_args=(), shard_outs=(), packed=(),
+               frontier=()):
+    from nebula_tpu.tpu.kernels import KernelSpec
+    return KernelSpec(
+        name, fn, phase_kind="mk", budget=4,
+        instantiate=lambda fx: [],
+        mesh_instantiate=lambda fx, mesh: [(("mk",
+                                             mesh.shape["parts"]),
+                                            fn, avals)],
+        collective=collective, ici_bytes=ici, donate=donate,
+        shard_args=shard_args, shard_outs=shard_outs, packed=packed,
+        frontier=frontier)
+
+
+def _mesh_audit(specs, hbm=None, sizes=(2,)):
+    from nebula_tpu.tools.lint.meshaudit import mesh_audit_specs
+    return mesh_audit_specs(specs, _mesh_fixture(),
+                            lambda s: ("pkg/fake.py", 1), hbm=hbm,
+                            sizes=sizes)
+
+
+def _psum_kernel(fx, mesh):
+    """A shard_map kernel whose ONLY collective is a psum over parts."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from nebula_tpu.tpu.compat import shard_map
+
+    def per_shard(x):
+        return jax.lax.psum(x, "parts")
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(P("parts"),),
+                             out_specs=P(), check_vma=False))
+
+
+def test_meshaudit_flags_undeclared_collective():
+    """Seeded violation: the trace psums but the COLLECTIVE_MODEL
+    declares nothing — undeclared ICI traffic."""
+    import numpy as np
+    fx = _mesh_fixture()
+    mesh = fx.mesh(2)
+    kern = _psum_kernel(fx, mesh)
+    spec = _mesh_spec(kern, (fx.aval((16,), np.float32),),
+                      collective=(), ici=lambda fx, k: 1 << 20)
+    vs = _mesh_audit([spec])
+    assert any("UNDECLARED collective" in v.message
+               and "psum" in v.message for v in vs), vs
+
+
+def test_meshaudit_flags_implicit_resharding():
+    """Seeded violation: a with_sharding_constraint re-replication the
+    model does not declare — the implicit-all-gather class."""
+    import numpy as np
+    fx = _mesh_fixture()
+    mesh = fx.mesh(2)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicate = NamedSharding(mesh, P())
+
+    @jax.jit
+    def kern(x):
+        return jax.lax.with_sharding_constraint(x * 2, replicate)
+
+    spec = _mesh_spec(kern, (fx.aval((16, 8), np.uint8),),
+                      collective=(("psum", ("parts",)),),
+                      ici=lambda fx, k: 1 << 20)
+    vs = _mesh_audit([spec])
+    assert any("UNDECLARED collective" in v.message
+               and "sharding_constraint" in v.message for v in vs), vs
+
+
+def test_meshaudit_flags_stale_declared_collective():
+    """A declared collective absent from the trace is a stale model."""
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def kern(x):
+        return x + 1
+
+    spec = _mesh_spec(kern, (_mesh_fixture().aval((16,), np.float32),),
+                      collective=(("psum", ("parts",)),),
+                      ici=lambda fx, k: 1 << 20)
+    vs = _mesh_audit([spec])
+    assert any("absent from the k=2 trace" in v.message for v in vs), vs
+
+
+def test_meshaudit_flags_ici_over_bound():
+    """Seeded violation: measured exchange bytes above the declared
+    ici_bytes bound."""
+    import numpy as np
+    fx = _mesh_fixture()
+    kern = _psum_kernel(fx, fx.mesh(2))
+    spec = _mesh_spec(kern, (fx.aval((1 << 12,), np.float32),),
+                      collective=(("psum", ("parts",)),),
+                      ici=lambda fx, k: 4)
+    vs = _mesh_audit([spec])
+    assert any("above the declared ici_bytes bound" in v.message
+               for v in vs), vs
+
+
+def test_meshaudit_flags_missing_ici_model():
+    import numpy as np
+    fx = _mesh_fixture()
+    kern = _psum_kernel(fx, fx.mesh(2))
+    spec = _mesh_spec(kern, (fx.aval((16,), np.float32),),
+                      collective=(("psum", ("parts",)),))
+    vs = _mesh_audit([spec])
+    assert any("no ici_bytes bound declared" in v.message for v in vs), vs
+
+
+def test_meshaudit_flags_over_budget_mesh_rung():
+    """Seeded violation: per-shard residency (replicated arg dominates)
+    over a tiny device budget."""
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def kern(x):
+        return x + 1
+
+    fx = _mesh_fixture()
+    spec = _mesh_spec(kern, (fx.aval((1 << 12,), np.float32),),
+                      collective=())
+    vs = _mesh_audit([spec], hbm={"device_hbm_bytes": 64})
+    assert any("this mesh rung cannot serve" in v.message
+               for v in vs), vs
+
+
+def test_meshaudit_flags_closure_captured_buffer():
+    """Seeded violation: a table closed over instead of passed as an
+    argument — every chip would pin a replica."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    big = jnp.asarray(np.zeros((1 << 18,), np.float32))
+
+    @jax.jit
+    def kern(x):
+        return x + big[:16]
+
+    fx = _mesh_fixture()
+    spec = _mesh_spec(kern, (fx.aval((16,), np.float32),),
+                      collective=())
+    vs = _mesh_audit([spec])
+    assert any("closes over" in v.message for v in vs), vs
+
+
+def test_meshaudit_int8_sharded_frontier_regression_fails():
+    """THE layout gate the issue names: a sharded family regressing to
+    the int8-per-lane frontier fails on the aval dtype at every mesh
+    size."""
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def kern(f):
+        return f
+
+    fx = _mesh_fixture()
+    spec = _mesh_spec(kern, (fx.aval((49, 128), np.int8),),
+                      collective=(), packed=(0,), frontier=(0,))
+    vs = _mesh_audit([spec])
+    assert any("not a bit-packed uint8 lane matrix" in v.message
+               for v in vs), vs
+
+
+def test_meshaudit_undeclared_sharded_family_flagged():
+    """mesh_instantiate without a COLLECTIVE_MODEL (and vice versa)
+    is itself a violation — no sharded family goes unaudited."""
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def kern(x):
+        return x
+
+    fx = _mesh_fixture()
+    spec = _mesh_spec(kern, (fx.aval((8,), np.float32),),
+                      collective=None)
+    vs = _mesh_audit([spec])
+    assert any("without a declared COLLECTIVE_MODEL" in v.message
+               for v in vs), vs
+    from nebula_tpu.tpu.kernels import KernelSpec
+    spec2 = KernelSpec("mk2", kern, phase_kind="mk", budget=1,
+                       instantiate=lambda fx: [],
+                       collective=(("psum", ("parts",)),))
+    vs2 = _mesh_audit([spec2])
+    assert any("unprovable" in v.message for v in vs2), vs2
+
+
+def test_meshaudit_clean_declared_kernel_passes():
+    """The fixed variant: declared psum + sane bounds = clean."""
+    import numpy as np
+    fx = _mesh_fixture()
+    kern = _psum_kernel(fx, fx.mesh(2))
+    spec = _mesh_spec(kern, (fx.aval((16,), np.float32),),
+                      collective=(("psum", ("parts",)),),
+                      ici=lambda fx, k: 1 << 20, shard_args=(0,))
+    assert _mesh_audit([spec],
+                       hbm={"device_hbm_bytes": 16 * 1000**3}) == []
+
+
+def test_meshaudit_capacity_table_arithmetic():
+    """The published multi-chip capacity table is arithmetic over the
+    declarations: an over-claimed rung, a shrinking rung, and a k=1
+    row disagreeing with HBM_MODEL all fire."""
+    from nebula_tpu.tools.lint.meshaudit import mesh_capacity_findings
+    hbm = {"table_bytes_per_edge": 20.0,
+           "table_budget_bytes": 1000, "edge_ceiling": 50}
+    ok = {"mesh_sizes": (1, 2), "capacity_edges": {1: 50, 2: 100}}
+    assert mesh_capacity_findings(hbm, ok) == []
+    over = {"mesh_sizes": (1, 2), "capacity_edges": {1: 50, 2: 200}}
+    assert any("exceeds" in m for m in mesh_capacity_findings(hbm, over))
+    shrink = {"mesh_sizes": (1, 2), "capacity_edges": {1: 50, 2: 40}}
+    msgs = mesh_capacity_findings(hbm, shrink)
+    assert any("below the previous rung" in m for m in msgs), msgs
+    drift = {"mesh_sizes": (1, 2), "capacity_edges": {1: 40, 2: 80}}
+    assert any("disagrees" in m for m in mesh_capacity_findings(
+        hbm, drift))
+    missing = {"mesh_sizes": (1, 2, 4), "capacity_edges": {1: 50}}
+    assert any("do not match mesh_sizes" in m
+               for m in mesh_capacity_findings(hbm, missing))
+
+
+def test_meshaudit_package_registry_is_clean():
+    """Every registered sharded family proves its COLLECTIVE_MODEL,
+    ICI bound and per-shard residency at every audited mesh size —
+    the tier-1 half of the acceptance criteria (mesh shapes {1,2,4,8}
+    under the conftest-forced 8-device platform)."""
+    import jax
+    assert len(jax.devices()) >= 8, jax.devices()
+    vs = lint_paths(PKG_ROOT, checks=["mesh-audit"])
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+def test_meshaudit_registry_covers_all_sharded_families():
+    """Every kernel family whose factory builds on a Mesh must carry
+    mesh_instantiate — a new sharded kernel cannot ship unaudited."""
+    from nebula_tpu.tpu.kernels import kernel_registry
+    reg = kernel_registry()
+    sharded = {name for name, s in reg.items()
+               if "sharded" in name or "mesh" in name}
+    assert sharded == {"sharded_go", "ell_go_sharded",
+                       "ell_bfs_sharded", "mesh_sparse_go",
+                       "mesh_sparse_bfs"}
+    for name in sharded:
+        assert reg[name].mesh_instantiate is not None, name
+        assert reg[name].collective is not None, name
+        assert reg[name].ici_bytes is not None, name
+
+
+def test_meshaudit_suppression_roundtrip(tmp_path):
+    """A justified mesh finding suppresses like any other check: the
+    capacity-table finding anchors at MESH_MODEL in a fixture
+    runtime.py (fixture roots carry no kernel registry, so only the
+    declaration checks run there)."""
+    bad = """
+    MESH_CARVEOUTS = {}
+    """
+    vs = run_fixture(tmp_path, {"tpu/runtime.py": bad},
+                     checks=["mesh-audit"])
+    assert vs == []        # no registry module -> no trace findings
+
+
+# ====================================== 16 · carveout-inventory (v4)
+def test_carveout_fixture_fires_all_three():
+    src = fixture_src("carveout_racy.py")
+    import tempfile
+    import textwrap
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "pkg")
+        os.makedirs(os.path.join(root, "tpu"))
+        with open(os.path.join(root, "tpu", "runtime.py"), "w") as fh:
+            fh.write(textwrap.dedent(src))
+        vs = lint_paths(root, checks=["carveout-inventory"],
+                        repo_root=td)
+    msgs = [v.message for v in vs]
+    assert any("untagged carve-out" in m for m in msgs), msgs
+    assert any("unknown carve-out reason "
+               "'not-a-registered-reason'" in m for m in msgs), msgs
+    assert any("dead carve-out registry entry 'ghost-reason'" in m
+               for m in msgs), msgs
+    # exactly two untagged sites (one gate return, one raise)
+    assert sum("untagged carve-out" in m for m in msgs) == 2, msgs
+
+
+def test_carveout_clean_module_passes(tmp_path):
+    clean = """
+    class TpuDecline(Exception):
+        pass
+
+    MESH_CARVEOUTS = {
+        "plan-decline": "planner cannot reproduce the query",
+    }
+
+    def can_run_go(space_id):
+        if space_id < 0:
+            return False        # nebulint: carveout=plan-decline
+        return True
+
+    def serve(space_id):
+        if space_id == 1:
+            # nebulint: carveout=plan-decline
+            raise TpuDecline("nope")
+    """
+    assert run_fixture(tmp_path, {"tpu/runtime.py": clean},
+                       checks=["carveout-inventory"]) == []
+
+
+def test_carveout_missing_registry_flagged(tmp_path):
+    src = """
+    class TpuDecline(Exception):
+        pass
+
+    def serve():
+        raise TpuDecline("nope")
+    """
+    vs = run_fixture(tmp_path, {"tpu/runtime.py": src},
+                     checks=["carveout-inventory"])
+    assert any("no MESH_CARVEOUTS registry" in v.message for v in vs), vs
+
+
+def test_carveout_reason_without_justification_flagged(tmp_path):
+    src = """
+    class TpuDecline(Exception):
+        pass
+
+    MESH_CARVEOUTS = {"x": ""}
+
+    def serve():
+        # nebulint: carveout=x
+        raise TpuDecline("nope")
+    """
+    vs = run_fixture(tmp_path, {"tpu/runtime.py": src},
+                     checks=["carveout-inventory"])
+    assert any("carries no justification" in v.message for v in vs), vs
+
+
+def test_carveout_scope_is_runtime_only(tmp_path):
+    """TpuDecline raises OUTSIDE tpu/runtime.py are other modules'
+    business (storage/device.py defines the type) — not this pass's."""
+    src = """
+    class TpuDecline(Exception):
+        pass
+
+    def serve():
+        raise TpuDecline("nope")
+    """
+    assert run_fixture(tmp_path, {"storage/device.py": src},
+                       checks=["carveout-inventory"]) == []
+
+
+def test_carveout_suppression_roundtrip(tmp_path):
+    src = """
+    class TpuDecline(Exception):
+        pass
+
+    MESH_CARVEOUTS = {"y": "kept for the suppression round-trip"}
+
+    def can_run_go(s):
+        if s:
+            return False        # nebulint: carveout=y
+        return True
+
+    def serve():  # noqa
+        raise TpuDecline("x")  # nebulint: disable=carveout-inventory
+    """
+    assert run_fixture(tmp_path, {"tpu/runtime.py": src},
+                       checks=["carveout-inventory"]) == []
+
+
+def test_carveout_package_sites_all_tagged():
+    vs = lint_paths(PKG_ROOT, checks=["carveout-inventory"])
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+# ================================================ 17 · incremental cache
+def _cached_lint(root, repo_root, cache_dir):
+    from nebula_tpu.tools.lint.cache import LintCache
+    cache = LintCache(path=os.path.join(str(cache_dir), "cache.json"))
+    vs = lint_paths(str(root), checks=["flag-registry"],
+                    repo_root=str(repo_root), cache=cache)
+    return vs, cache
+
+
+def test_cache_hit_and_invalidation_on_edit(tmp_path):
+    """The correctness contract: a warm cache replays, an EDIT to an
+    in-scope file forces re-analysis and surfaces the new violation."""
+    import textwrap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    mod = root / "m.py"
+    mod.write_text(textwrap.dedent("""
+        from common.flags import flags
+
+        def f():
+            return flags.get("undefined_flag_a")
+    """))
+    cdir = tmp_path / "cache"
+    vs1, c1 = _cached_lint(root, tmp_path, cdir)
+    assert c1.misses == 1 and c1.hits == 0
+    n1 = len(vs1)
+    vs2, c2 = _cached_lint(root, tmp_path, cdir)
+    assert c2.hits == 1 and c2.misses == 0
+    assert [repr(v) for v in vs2] == [repr(v) for v in vs1]
+    # edit the file: new flag read must be re-discovered, not replayed
+    mod.write_text(mod.read_text().replace(
+        'flags.get("undefined_flag_a")',
+        'flags.get("undefined_flag_a"), flags.get("undefined_flag_b")'))
+    vs3, c3 = _cached_lint(root, tmp_path, cdir)
+    assert c3.misses == 1 and c3.hits == 0
+    assert len(vs3) > n1
+    assert any("undefined_flag_b" in v.message for v in vs3), vs3
+
+
+def test_cache_suppression_still_live_on_replay(tmp_path):
+    """A suppression added AFTER the cache was written must apply on
+    replay (raw violations are cached pre-suppression) — and its
+    suppress hit feeds stale-suppression as usual."""
+    import textwrap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    mod = root / "m.py"
+    mod.write_text(textwrap.dedent("""
+        from common.flags import flags
+
+        def f():
+            return flags.get("undefined_flag_a")
+    """))
+    cdir = tmp_path / "cache"
+    vs1, _ = _cached_lint(root, tmp_path, cdir)
+    assert vs1, "fixture must fire"
+    # suppressing the line EDITS the file -> miss; the point is the
+    # round trip stays coherent through the cache layer
+    mod.write_text(mod.read_text().replace(
+        'return flags.get("undefined_flag_a")',
+        'return flags.get("undefined_flag_a")  '
+        '# nebulint: disable=flag-registry'))
+    vs2, c2 = _cached_lint(root, tmp_path, cdir)
+    assert vs2 == [] and c2.misses == 1
+    # replay (no edit): suppression applies against CACHED raw results
+    vs3, c3 = _cached_lint(root, tmp_path, cdir)
+    assert vs3 == [] and c3.hits == 1
+
+
+def test_cache_invalidated_by_lint_source_change(tmp_path, monkeypatch):
+    """Check-version invalidation: a different lint-package sha drops
+    every entry."""
+    import textwrap
+    import nebula_tpu.tools.lint.cache as cache_mod
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent("""
+        def f():
+            return 1
+    """))
+    cdir = tmp_path / "cache"
+    _vs, c1 = _cached_lint(root, tmp_path, cdir)
+    assert c1.misses == 1
+    monkeypatch.setattr(cache_mod, "_LINT_SHA", "deadbeef")
+    _vs, c2 = _cached_lint(root, tmp_path, cdir)
+    assert c2.misses == 1 and c2.hits == 0
+
+
+def test_cli_no_cache_flag(tmp_path, monkeypatch):
+    """--no-cache runs clean end-to-end (and never writes the store)."""
+    from nebula_tpu.tools.lint.__main__ import main
+    import textwrap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "m.py").write_text(textwrap.dedent("""
+        def f():
+            return 1
+    """))
+    monkeypatch.setenv("NEBULINT_CACHE_DIR", str(tmp_path / "cc"))
+    rc = main(["--no-cache", "--no-baseline", str(root)])
+    assert rc == 0
+    assert not (tmp_path / "cc").exists()
+
+
+# ==================================================== 18 · SARIF output
+SARIF_GOLDEN = os.path.join(FIXTURE_DIR, "golden.sarif")
+
+
+def _sarif_fixture_run(tmp_path, capsys):
+    """One seeded flag-registry violation through the CLI in SARIF
+    mode; paths are repo-root-relative, so the payload is stable."""
+    from nebula_tpu.tools.lint.__main__ import main
+    import textwrap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent("""
+        from common.flags import flags
+
+        def f():
+            return flags.get("undefined_flag_a")
+    """))
+    rc = main(["--format=sarif", "--no-baseline", "--no-cache",
+               "--check", "flag-registry", str(root)])
+    out = capsys.readouterr().out
+    return rc, json.loads(out)
+
+
+def test_sarif_golden_file(tmp_path, capsys):
+    """Golden-file contract: the SARIF payload for a seeded violation
+    is byte-stable (modulo the JSON round trip) — CI annotation
+    surfaces parse exactly this."""
+    rc, doc = _sarif_fixture_run(tmp_path, capsys)
+    assert rc == 1
+    with open(SARIF_GOLDEN, encoding="utf-8") as fh:
+        golden = json.load(fh)
+    assert doc == golden, json.dumps(doc, indent=2, sort_keys=True)
+
+
+def test_sarif_clean_run_is_valid_and_empty(tmp_path, capsys):
+    from nebula_tpu.tools.lint.__main__ import main
+    import textwrap
+    root = tmp_path / "pkg"
+    root.mkdir()
+    (root / "mod.py").write_text(textwrap.dedent("""
+        def f():
+            return 1
+    """))
+    rc = main(["--format=sarif", "--no-baseline", "--no-cache",
+               str(root)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []

@@ -448,3 +448,173 @@ class TestReductionPushdownE2E:
         finally:
             ccpu.stop()
             ctpu.stop()
+
+
+class TestShardedPackedParity:
+    """The mesh families' frontiers are bit-packed ONLY as of nebulint
+    v4 (KernelSpec.packed on ell_go_sharded/ell_bfs_sharded fails lint
+    on an int8 regression); these differentials prove the packed
+    sharded kernels bit-exact against BOTH the int8 single-chip oracle
+    and the packed single-chip kernel, at every audited mesh size."""
+
+    @staticmethod
+    def _mesh(k):
+        import jax
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        assert len(devs) >= k, devs
+        return Mesh(np.array(devs[:k]), ("parts",))
+
+    @pytest.mark.parametrize("hub", [False, True])
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_sharded_go_matches_int8_and_packed(self, hub, k):
+        import jax.numpy as jnp
+        ix, *_rest, rng = _graph(21 + k, 150, 900, hub)
+        B, steps = 128, 3
+        f0 = ix.start_frontier(_starts(rng, ix.n, B), B=B)
+        ref = np.asarray(E.make_batched_go_kernel(ix, steps, ETYPES)(
+            jnp.asarray(f0), *ix.kernel_args()))
+        eslot, hrows = (jnp.asarray(a) for a in ix.hub_merge())
+        packed1 = np.asarray(E.make_batched_go_lanes_kernel(
+            ix, steps, ETYPES)(
+            jnp.asarray(E.pack_lanes_host(f0)), eslot, hrows,
+            *ix.kernel_args()[1:]))
+        mesh = self._mesh(k)
+        nbrs, ets, reals = E.shard_ell(mesh, "parts", ix)
+        go = E.make_sharded_batched_go_kernel(
+            mesh, "parts", ix, steps, ETYPES, nbrs, ets, reals)
+        out = np.asarray(go(jnp.asarray(E.pack_lanes_host(f0)),
+                            eslot, hrows, *nbrs, *ets))
+        bits = E.unpack_lanes_host(out, B)
+        # vs the int8 oracle (real rows; extras may hold junk in both)
+        assert (bits[:ix.n] == (ref[:ix.n] > 0)).all()
+        # vs the single-chip packed kernel: bit-exact including extras
+        assert (bits[:ix.n]
+                == E.unpack_lanes_host(packed1, B)[:ix.n]).all()
+
+    @pytest.mark.parametrize("shortest", [True, False])
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_sharded_bfs_matches_int8(self, shortest, k):
+        import jax.numpy as jnp
+        ix, *_rest, rng = _graph(31 + k, 140, 800, True)
+        B, max_steps = 64, 6
+        f0 = ix.start_frontier(_starts(rng, ix.n, B), B=B)
+        t0 = ix.start_frontier(
+            [rng.integers(0, ix.n, 2) for _ in range(B)], B=B)
+        ref = np.asarray(E.make_batched_bfs_kernel(
+            ix, max_steps, ETYPES, stop_when_found=shortest)(
+            jnp.asarray(f0), jnp.asarray(t0), *ix.kernel_args()))
+        eslot, hrows = (jnp.asarray(a) for a in ix.hub_merge())
+        mesh = self._mesh(k)
+        nbrs, ets, reals = E.shard_ell(mesh, "parts", ix)
+        bfs = E.make_sharded_batched_bfs_kernel(
+            mesh, "parts", ix, max_steps, ETYPES, nbrs, ets, reals,
+            stop_when_found=shortest)
+        d = np.asarray(bfs(jnp.asarray(E.pack_lanes_host(f0)),
+                           jnp.asarray(E.pack_lanes_host(t0)),
+                           eslot, hrows, *nbrs, *ets))
+        np.testing.assert_array_equal(d, ref)
+
+    def test_sharded_donation_consumes_frontier(self):
+        """donate=True (the runtime's dispatch configuration) must
+        survive shard_map — the donated packed frontier is consumed."""
+        import jax
+        import jax.numpy as jnp
+        ix, *_rest, rng = _graph(41, 100, 500, False)
+        B = 64
+        f0 = ix.start_frontier(_starts(rng, ix.n, B), B=B)
+        mesh = self._mesh(2)
+        nbrs, ets, reals = E.shard_ell(mesh, "parts", ix)
+        go = E.make_sharded_batched_go_kernel(
+            mesh, "parts", ix, 3, ETYPES, nbrs, ets, reals,
+            donate=True)
+        eslot, hrows = (jnp.asarray(a) for a in ix.hub_merge())
+        f0p = jnp.asarray(E.pack_lanes_host(f0))
+        out = go(f0p, eslot, hrows, *nbrs, *ets)
+        jax.block_until_ready(out)
+        assert f0p.is_deleted(), \
+            "donated sharded frontier must be consumed"
+
+    def test_runtime_mesh_go_serves_packed(self):
+        """The runtime's replicated-frontier mesh branch now uploads
+        packed and dispatches the packed sharded kernel — rows must
+        match the single-device layout AND the CPU loop, and the
+        sharded kernel must actually run."""
+        from nebula_tpu.cluster import LocalCluster
+        from nebula_tpu.common.flags import flags
+        c = LocalCluster(num_storage=1, tpu_backend=True)
+        cl = c.client()
+        try:
+            def ok(stmt):
+                r = cl.execute(stmt)
+                assert r.ok(), f"{stmt}: {r.error_msg}"
+                return r
+
+            ok("CREATE SPACE mp(partition_num=3, replica_factor=1)")
+            c.refresh_all()
+            ok("USE mp; CREATE EDGE e(w int)")
+            c.refresh_all()
+            rng = np.random.default_rng(6)
+            edges = ", ".join(
+                f"{int(s)} -> {int(d)}:({int(s) % 5})"
+                for s, d in zip(rng.integers(1, 80, 400),
+                                rng.integers(1, 80, 400)))
+            ok(f"INSERT EDGE e(w) VALUES {edges}")
+            qs = ["GO 3 STEPS FROM 1,2,3 OVER e YIELD e._dst, e.w",
+                  "GO 2 STEPS FROM 5,9 OVER e REVERSELY"]
+            base = [sorted(map(tuple, ok(q).rows)) for q in qs]
+            rt = c.tpu_runtime
+            flags.set("tpu_mesh_devices", 8)
+            flags.set("tpu_mesh_mode", "dense")
+            try:
+                rt.mirrors.clear()      # rebuild under the mesh gate
+                got = [sorted(map(tuple, ok(q).rows)) for q in qs]
+            finally:
+                flags.set("tpu_mesh_devices", 0)
+                flags.set("tpu_mesh_mode", "sparse")
+                rt.mirrors.clear()
+            assert got == base
+            flags.set("storage_backend", "cpu")
+            try:
+                cpu = [sorted(map(tuple, ok(q).rows)) for q in qs]
+            finally:
+                flags.set("storage_backend", "tpu")
+            assert got == cpu
+        finally:
+            c.stop()
+
+    def test_sharded_hub_merge_at_shard_boundaries(self):
+        """Regression for the scatter-SET partitioning corruption: the
+        hub OR-merge must run on the RE-REPLICATED frontier — applied
+        to the row-sharded intermediate, the SPMD partitioner clamped
+        the out-of-range hub index onto every shard's last row
+        (rows k*chunk-1 flipped bits at the LDBC driver shape).  This
+        pins the exact failing configuration: heavy-tailed graph,
+        default cap, B=512, 4 hops, 8-way mesh."""
+        import jax.numpy as jnp
+        from nebula_tpu.tools.ldbc_gen import generate
+        persons, B, steps = 400, 512, 4
+        src, dst, _props = generate(persons)
+        src = np.asarray(src, np.int32) - 1
+        dst = np.asarray(dst, np.int32) - 1
+        es = np.concatenate([src, dst])
+        ed = np.concatenate([dst, src])
+        ee = np.concatenate([np.ones(len(src), np.int32),
+                             -np.ones(len(src), np.int32)])
+        ix = E.EllIndex.build(es, ed, ee, persons)
+        assert len(ix.extra_owner), "shape must exercise the hub merge"
+        rng = np.random.default_rng(1)
+        f0 = ix.start_frontier(
+            [rng.integers(0, persons, 1, np.int32) for _ in range(B)],
+            B=B)
+        ref = np.asarray(E.make_batched_go_kernel(ix, steps, (1,))(
+            jnp.asarray(f0), *ix.kernel_args()))
+        eslot, hrows = (jnp.asarray(a) for a in ix.hub_merge())
+        mesh = self._mesh(8)
+        nbrs, ets, reals = E.shard_ell(mesh, "parts", ix)
+        go = E.make_sharded_batched_go_kernel(
+            mesh, "parts", ix, steps, (1,), nbrs, ets, reals)
+        out = np.asarray(go(jnp.asarray(E.pack_lanes_host(f0)),
+                            eslot, hrows, *nbrs, *ets))
+        bits = E.unpack_lanes_host(out, B)
+        assert (bits[:ix.n] == (ref[:ix.n] > 0)).all()
