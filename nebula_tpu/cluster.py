@@ -218,6 +218,12 @@ class LocalCluster:
     def stop(self) -> None:
         for node in self.storage_nodes:
             node.stop()
+        if self.tpu_runtime is not None and \
+                hasattr(self.tpu_runtime, "shutdown"):
+            # in-process TpuQueryRuntime: join background prewarm
+            # compiles (RemoteDeviceRuntime has no local compiles —
+            # storaged's runtimes stop via StorageService.shutdown())
+            self.tpu_runtime.shutdown()
         self.graph_meta_client.stop()
         self.graph_service.sessions.stop()
         for srv in self.servers:

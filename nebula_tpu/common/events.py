@@ -56,6 +56,14 @@ EVENT_KINDS = (
     "node.recovered",        # a daemon booted over existing durable
                              # state and recovered its parts' commit
                              # watermarks (cluster.py / daemons)
+    "mirror.absorbed",       # a committed write delta folded into the
+                             # resident device tables as a new mirror
+                             # generation (tpu/runtime.py absorb path,
+                             # docs/durability.md)
+    "mirror.absorb_failed",  # an absorption declined (vertex-plan
+                             # change / slot overflow / delta-budget
+                             # overflow / opaque events) — a full
+                             # rebuild is about to be paid instead
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

@@ -104,6 +104,15 @@ METRIC_NAMES = (
     # TPU device telemetry (tpu/runtime.py collector)
     "tpu.mirror.hbm_bytes",
     "tpu.mirror.builds",
+    # mirror generations + incremental absorption (tpu/runtime.py
+    # absorb path, docs/durability.md): per-space generation gauge,
+    # delta-budget overflows (each one is a rebuild the write stream
+    # forced — the write-while-serve soak asserts zero), and the
+    # tpu.absorb.* family (absorb/decline counts + wall-time
+    # histogram, docs/roofline.md absorb cost model)
+    "tpu.mirror.generation",
+    "tpu.mirror.delta_overflow",
+    "tpu.absorb.*",
     "tpu.jit_cache.size",
     "tpu.compile.count",
     "tpu.prewarm.hits",

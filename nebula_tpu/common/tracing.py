@@ -66,7 +66,10 @@ SPAN_NAMES = (
     "storage.collect.pass",   # one scatter-gather retry pass
     "meta.call.pass",         # one meta whole-peer-set retry pass
     "tpu.mirror.build",       # full CSR/ELL mirror rebuild
-    "tpu.mirror.delta",       # incremental overlay absorb
+    "tpu.absorb",             # incremental delta absorption: fold the
+                              # committed write delta into the resident
+                              # tables as the next mirror generation
+                              # (tpu/runtime.py, docs/durability.md)
     "tpu.transfer",           # host→device mirror upload
     "tpu.jit.compile",        # kernel cache miss → XLA build/compile
     "tpu.kernel",             # device kernel dispatch (async launch)

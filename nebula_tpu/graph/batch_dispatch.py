@@ -287,7 +287,13 @@ class GoBatchDispatcher:
         device dispatch and never mix with full-fetch traffic whose
         wire shape (and kernel) differs (docs/roofline.md).  A reduced
         query ranks with the 1-hop class: its fetch is a few hundred
-        bytes, so it clears the pipeline fastest."""
+        bytes, so it clears the pipeline fastest.  Under a live write
+        stream the batch leader's mirror() call may absorb the
+        committed delta into the next generation before launching
+        (docs/durability.md) — riders coalesced into that dispatch
+        read the write-fresh tables, which is what makes the reduce
+        descriptor safe to batch at write traffic (the old overlay
+        path forced reduced queries onto a full rebuild instead)."""
         method = key[0]
         if method == "go_batch_execute":
             steps = key[3] if len(key) > 3 else 1

@@ -1023,7 +1023,12 @@ class PipeExecutor(Executor):
         surviving/reduced rows, then finish the pipe inline.  When the
         GO served on the CPU path instead (decline, has_input, router)
         the hint was ignored and the FULL rows arrive — the same
-        slice/count below is then plain pipe semantics.  COUNT values
+        slice/count below is then plain pipe semantics.  Live writes
+        no longer gate the hint: committed deltas ABSORB into the
+        mirror generation before dispatch (tpu/runtime.py,
+        docs/durability.md), so the device-side reduction always
+        folds a write-fresh table — the PR 8 "live delta forces
+        mirror_full" escape is gone.  COUNT values
         are route-independent; a device-cut LIMIT may pick a DIFFERENT
         (deterministic) subset than the CPU path's first rows — the
         unordered cut LIMIT-without-ORDER-BY permits (row count and

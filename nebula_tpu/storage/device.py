@@ -288,10 +288,14 @@ class RemoteStoreView:
 
     Consistency contract: the mirror rebuilds when any peer's polled
     version moves (remote deltas are never incremental — delta_since
-    returns None, forcing the rebuild path), so device results lag a
-    peer's writes by at most one version poll — the same bounded
-    staleness the reference accepts from its 120 s meta cache refresh
-    (MetaClient.cpp:13-14)."""
+    returns None, which the absorb path reports as an OBSERVABLE
+    `opaque-events` decline before taking the rebuild:
+    runtime._absorb_once), so device results lag a peer's writes by
+    at most one version poll — the same bounded staleness the
+    reference accepts from its 120 s meta cache refresh
+    (MetaClient.cpp:13-14).  Locally-led writes on the serving host
+    itself DO absorb incrementally; streaming peer delta logs over
+    this seam is the natural next shrink (ROADMAP item 5)."""
 
     POLL_REUSE_S = 0.02
     RPC_TIMEOUT_S = 10.0    # a hung peer fails the build fast instead of

@@ -193,9 +193,14 @@ class StorageService:
                 # two backends (split stats, duplicate mirror builds)
                 with self._device_rt_lock:
                     if self._backend_rt is None:
+                        # role="backend" keeps its gauge series apart
+                        # from the deviceGo runtime's (one cleared-per-
+                        # scrape table, two collectors — unlabeled they
+                        # shadow each other and the absorb/build
+                        # counters read zero)
                         self._backend_rt = TpuQueryRuntime(
                             [types.SimpleNamespace(kv=self.kv)],
-                            self.schema_man)
+                            self.schema_man, role="backend")
                     if self.backend is None:
                         self.backend = TpuStorageBackend(
                             self._backend_rt, self.schema_man)
@@ -667,3 +672,10 @@ class StorageService:
     def shutdown(self) -> None:
         stats.unregister_collector(self._collect_metrics)
         self.pool.shutdown(wait=False)
+        with self._device_rt_lock:
+            rts = [rt for rt in (self._device_rt, self._backend_rt)
+                   if rt is not None]
+        for rt in rts:
+            # stop background prewarm compiles — a daemon thread inside
+            # an XLA compile at process exit crashes the C++ teardown
+            rt.shutdown()

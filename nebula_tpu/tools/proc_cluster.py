@@ -163,6 +163,10 @@ class ProcCluster:
         env["PYTHONPATH"] = _repo_root() + os.pathsep + \
             env.get("PYTHONPATH", "")
         env.setdefault("PYTHONUNBUFFERED", "1")
+        # kept for add_graphd: extra front ends inherit the cluster's
+        # flag set (overridable per instance)
+        self._flag_args = list(flag_args)
+        self._env = env
 
         meta_port, meta_ws = _free_port(), _free_port()
         self.meta_addr = f"127.0.0.1:{meta_port}"
@@ -266,15 +270,42 @@ class ProcCluster:
     def events(self, name: str) -> List[dict]:
         return self.daemons[name].events()
 
+    def add_graphd(self, name: str,
+                   extra_flags: Optional[Dict[str, object]] = None,
+                   start: bool = True) -> str:
+        """Spawn an EXTRA stateless graphd against the same metad /
+        storaged fleet — e.g. a ``storage_backend=cpu`` front end as
+        the parity oracle beside a device-serving one (the
+        write-while-serve soak reads the same store through both and
+        diffs the rows).  Per-instance ``extra_flags`` append AFTER the
+        cluster's shared flag set, so later values win.  Returns the
+        new graphd's host:port (pass it to ``client(addr=...)``)."""
+        port, ws = _free_port(), _free_port()
+        flag_args: List[str] = []
+        for k, v in (extra_flags or {}).items():
+            flag_args += ["--flag", f"{k}={v}"]
+        self._register(name, [
+            sys.executable, "-m", "nebula_tpu.daemons.graphd",
+            "--local_ip", "127.0.0.1", "--port", str(port),
+            "--ws_http_port", str(ws),
+            "--meta_server_addrs", self.meta_addr,
+        ] + self._flag_args + flag_args, port, ws, self._env)
+        if start:
+            self.daemons[name].spawn()
+            self.wait_healthy(name, self.BOOT_TIMEOUT_S)
+        return f"127.0.0.1:{port}"
+
     # ------------------------------------------------------- clients
-    def client(self, connect_timeout_s: float = 30.0):
-        """A GraphClient dialing the graphd over real TCP (fresh
+    def client(self, connect_timeout_s: float = 30.0,
+               addr: Optional[str] = None):
+        """A GraphClient dialing a graphd over real TCP (fresh
         ClientManager per client: its socket pools must not outlive a
-        killed daemon's listener silently)."""
+        killed daemon's listener silently).  ``addr`` selects an extra
+        front end registered via add_graphd; default is the primary."""
         from ..clients.graph_client import GraphClient
         from ..interface.common import HostAddr
         from ..interface.rpc import ClientManager
-        cl = GraphClient(HostAddr.parse(self.graph_addr),
+        cl = GraphClient(HostAddr.parse(addr or self.graph_addr),
                          client_manager=ClientManager())
         deadline = time.monotonic() + connect_timeout_s
         while True:

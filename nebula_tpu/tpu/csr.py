@@ -98,6 +98,29 @@ class Column:
         else:  # INT / VID / TIMESTAMP
             self.values = np.zeros(size, dtype=np.int64)
 
+    @staticmethod
+    def numeric_device_ok(values: np.ndarray) -> bool:
+        """THE device-representability decision for a numeric column's
+        values — finalize() and the absorb-merge re-finalize
+        (_refinalize_numeric) both defer here so merged columns can
+        never earn a different device_ok than freshly built ones:
+        int64 must fit int32 or round-trip float32 exactly (the device
+        compares in float32, and CPU-float64 vs device-float32
+        comparisons could otherwise disagree at the boundary); float64
+        must round-trip float32 exactly.  absorb_form() applies the
+        same rules per scalar."""
+        if values.dtype == np.int64 and len(values):
+            lo, hi = int(values.min()), int(values.max())
+            if not (-2**31 < lo and hi < 2**31):
+                as32 = values.astype(np.float32)
+                return bool(np.array_equal(as32.astype(np.int64),
+                                           values))
+        elif values.dtype == np.float64 and len(values):
+            as32 = values.astype(np.float32)
+            return bool(np.array_equal(as32.astype(np.float64), values,
+                                       equal_nan=True))
+        return True
+
     def finalize(self) -> None:
         """Dictionary-encode strings; decide device representability."""
         if self.stype == SupportedType.STRING:
@@ -107,20 +130,8 @@ class Column:
             self.values = codes.astype(np.int32)
             self.raw = arr
             return
-        if self.values.dtype == np.int64 and len(self.values):
-            lo, hi = int(self.values.min()), int(self.values.max())
-            if not (-2**31 < lo and hi < 2**31):
-                # exactly representable in float32?
-                as32 = self.values.astype(np.float32)
-                self.device_ok = bool(
-                    np.array_equal(as32.astype(np.int64), self.values))
-        elif self.values.dtype == np.float64 and len(self.values):
-            # device compares in float32; only allow columns whose values
-            # round-trip exactly, else CPU-float64 vs device-float32
-            # comparisons could disagree at the boundary
-            as32 = self.values.astype(np.float32)
-            self.device_ok = bool(np.array_equal(
-                as32.astype(np.float64), self.values, equal_nan=True))
+        if not Column.numeric_device_ok(self.values):
+            self.device_ok = False
 
     def device_values(self):
         """int32/float32/bool view for the device (codes for strings)."""
@@ -487,6 +498,129 @@ def build_delta_mirror(base: CsrMirror, events, schema_man,
     counts = np.bincount(d.edge_src, minlength=d.n)
     d.row_ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
     return d
+
+
+def _refinalize_numeric(c: Column) -> None:
+    """Re-run finalize()'s device-representability decision on a
+    MERGED numeric column: two individually device_ok sides can mix
+    representation classes (base on the float32-exact path, overlay
+    int32-representable but not float32-exact), and the merged column
+    must re-earn its device_ok on the union of values — through the
+    same Column.numeric_device_ok decision a fresh build uses."""
+    c._int32_ok = None
+    if c.device_ok and c.values is not None and len(c.values):
+        c.device_ok = Column.numeric_device_ok(c.values)
+
+
+def _merge_edge_cols(base: CsrMirror, d: CsrMirror, keep: np.ndarray,
+                     order: np.ndarray,
+                     m_new: int) -> Dict[Tuple[int, str], Column]:
+    """Columnar half of absorb_overlay: splice overlay columns into
+    the kept base rows and restore canonical order.  Dictionary-coded
+    strings re-encode through the sorted UNION dictionary when the
+    sides' dictionaries differ (codes stay order-preserving, so
+    compiled comparisons keep translating); rows a side doesn't carry
+    stay invalid."""
+    kept = int(keep.sum())
+    cols: Dict[Tuple[int, str], Column] = {}
+    for key in set(base.edge_cols) | set(d.edge_cols):
+        b = base.edge_cols.get(key)
+        o = d.edge_cols.get(key)
+        ref = b if b is not None else o
+        c = Column.__new__(Column)
+        c.name, c.stype = ref.name, ref.stype
+        c.dictionary = None
+        c.raw = None
+        c._int32_ok = None
+        c.device_ok = (b is None or b.device_ok) \
+            and (o is None or o.device_ok)
+        valid = np.zeros(m_new, dtype=bool)
+        if b is not None:
+            valid[:kept] = b.valid[keep]
+        if o is not None:
+            valid[kept:] = o.valid
+        c.valid = valid[order]
+        if ref.stype == SupportedType.STRING:
+            raw = np.empty(m_new, dtype=object)
+            raw[:] = ""
+            if b is not None and b.raw is not None:
+                raw[:kept] = np.asarray(b.raw, dtype=object)[keep]
+            if o is not None and o.raw is not None:
+                raw[kept:] = np.asarray(o.raw, dtype=object)
+            c.raw = raw[order]
+            dicts = [x.dictionary for x in (b, o)
+                     if x is not None and x.dictionary is not None]
+            same = len(dicts) == 2 and np.array_equal(dicts[0], dicts[1])
+            codes = np.zeros(m_new, np.int32)
+            if len(dicts) <= 1 or same:
+                c.dictionary = dicts[0] if dicts else \
+                    np.zeros(0, dtype=object)
+                if b is not None:
+                    codes[:kept] = b.values[keep]
+                if o is not None:
+                    codes[kept:] = o.values
+            else:
+                union = np.unique(np.concatenate(dicts))
+                c.dictionary = union
+                remap_b = np.searchsorted(union, b.dictionary)
+                codes[:kept] = remap_b[b.values[keep]]
+                remap_o = np.searchsorted(union, o.dictionary)
+                codes[kept:] = remap_o[o.values]
+            c.values = codes[order].astype(np.int32)
+        else:
+            vals = np.zeros(m_new, dtype=ref.values.dtype)
+            if b is not None:
+                vals[:kept] = b.values[keep]
+            if o is not None:
+                vals[kept:] = o.values
+            c.values = vals[order]
+            _refinalize_numeric(c)
+        cols[key] = c
+    return cols
+
+
+def absorb_overlay(base: CsrMirror, d: CsrMirror) -> Optional[CsrMirror]:
+    """Fold an edge overlay (build_delta_mirror) into a NEW CsrMirror
+    — the host-CSR half of incremental delta absorption (the device
+    half is ell.make_ell_absorb_kernel; docs/durability.md "The
+    generation state machine").
+
+    The vertex side (vids / vertex_cols / has_tag) is SHARED with the
+    base: vertex writes commit in place (commit_vertex_plan) under the
+    documented values-first/valid-last bounded-staleness stance.  The
+    edge side is a vectorized splice — base rows minus the overlay's
+    tombstones (base_dead), plus the overlay rows, restored to the
+    canonical (src, etype, rank, dst) scan order every other builder
+    produces — O(m) host memcpy, never a store re-scan.
+
+    Returns None when the overlay grew the dense-id space
+    (extra_vids: a vertex-plan change only the rebuild can serve)."""
+    if len(getattr(d, "extra_vids", ())):
+        return None
+    keep = np.ones(base.m, dtype=bool)
+    dead = getattr(d, "base_dead", None)
+    if dead is not None and len(dead):
+        keep[np.asarray(dead, dtype=np.int64)] = False
+    out = CsrMirror(base.space_id)
+    out.vids, out.n = base.vids, base.n
+    out.vertex_cols = base.vertex_cols
+    out.has_tag = base.has_tag
+    out.expires_at_s = base.expires_at_s
+    src = np.concatenate([base.edge_src[keep], d.edge_src])
+    dst = np.concatenate([base.edge_dst[keep], d.edge_dst])
+    et = np.concatenate([base.edge_etype[keep], d.edge_etype])
+    rank = np.concatenate([base.edge_rank[keep], d.edge_rank])
+    order = np.lexsort((dst, rank, et, src))
+    out.edge_src = src[order].astype(np.int32)
+    out.edge_dst = dst[order].astype(np.int32)
+    out.edge_etype = et[order].astype(np.int32)
+    out.edge_rank = rank[order]
+    out.m = len(out.edge_src)
+    out.edge_cols = _merge_edge_cols(base, d, keep, order, out.m)
+    counts = np.bincount(out.edge_src, minlength=out.n)
+    out.row_ptr = np.concatenate([[0], np.cumsum(counts)]) \
+        .astype(np.int32)
+    return out
 
 
 def plan_vertex_events(base: CsrMirror, events, schema_man,

@@ -603,36 +603,266 @@ def make_batched_go_lanes_kernel(ell: EllIndex, steps: int,
     return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
-def make_batched_go_delta_lanes_kernel(ell: EllIndex, steps: int,
-                                       etypes: Tuple[int, ...], cap: int,
-                                       donate: bool = False):
-    """Packed twin of make_batched_go_delta_kernel.  The overlay
-    scatter is the same OR-not-max problem as the hub fix-up, so the
-    runtime pre-groups the overlay edges by destination host-side:
-    ``dslot`` maps each overlay edge to its dst's index in the unique
-    ``drows`` list (padded with n_rows+1 = drop sentinel).
+# ====================================================================
+# Incremental delta absorption — fold a committed edge overlay into
+# the RESIDENT slot tables instead of rebuilding them (ROADMAP item 5,
+# "serve writes at traffic").  Three pieces:
+#
+#   plan_ell_absorb        host: per affected owner row, recompute the
+#                          full replacement slot rows (inserts fill
+#                          sentinel slack in the main row and, for
+#                          hubs, in the EXISTING extra rows — the spill
+#                          path; deletes fold as tombstones: the dead
+#                          slot's entry drops and the row compacts).
+#                          None when a row outgrows its resident
+#                          capacity (slot overflow past the hub
+#                          budget) — the rebuild path then.
+#   apply_ell_absorb_host  copy-on-write clone of the EllIndex with the
+#                          replacement rows applied to the HOST bucket
+#                          arrays (untouched buckets share memory; the
+#                          old generation's arrays are never mutated —
+#                          in-flight dispatches finish on them).
+#   make_ell_absorb_kernel device: one row-scatter per bucket produces
+#                          the next generation's device tables FROM the
+#                          resident ones — the h2d upload is O(delta)
+#                          replacement rows, never the O(table) full
+#                          re-upload a rebuild pays (docs/roofline.md
+#                          "The absorb cost model").  The resident
+#                          input tables are NOT donated: they are the
+#                          published generation in-flight dispatches
+#                          still read (docs/durability.md).
+#
+# The conflict-free-scheduling framing (PAPERS.md arxiv 2202.11343)
+# applies directly: updates are grouped host-side into whole
+# replacement rows, so the device scatter has one writer per row and
+# no read-modify-write hazards.
+# ====================================================================
+def plan_ell_absorb(ell: EllIndex,
+                    ins_dst: np.ndarray, ins_src: np.ndarray,
+                    ins_et: np.ndarray,
+                    del_dst: np.ndarray, del_src: np.ndarray,
+                    del_et: np.ndarray):
+    """Replacement-row plan for absorbing overlay edges into ``ell``.
 
-    fn(f0p, dsrc int32[cap], det int32[cap], dslot int32[cap],
-       drows int32[cap], eslot, hrows, *tables) -> uint8 [n_rows+1, W].
-    """
+    Inputs are OLD-dense-id edge rows exactly as the CsrMirror stores
+    them (both directions present as separate rows; reverse rides
+    -etype).  Returns {bucket: (local_rows int32[k], nbr [k, D_b],
+    et [k, D_b])} — the full new content of every affected row — or
+    None when any owner's new slot count outgrows its resident
+    capacity (main row + existing extra rows), which only the rebuild
+    can serve.  Work is O(delta x row width): only affected owners'
+    rows are read and rewritten."""
+    import bisect
+    from collections import Counter
+
+    if ell.n == 0:
+        return None if (len(ins_dst) or len(del_dst)) else {}
+    sentinel = np.int32(ell.n_rows)
+    ecnt, e0 = ell.hub_expansion()
+    bstarts: List[int] = []
+    acc = 0
+    for nbr in ell.bucket_nbr:
+        bstarts.append(acc)
+        acc += nbr.shape[0]
+
+    owners: Dict[int, Tuple[Counter, list]] = {}
+
+    def owner_of(dst_old: int):
+        r = int(ell.perm[dst_old])
+        o = owners.get(r)
+        if o is None:
+            o = owners[r] = (Counter(), [])
+        return o
+
+    for i in range(len(ins_dst)):
+        owner_of(int(ins_dst[i]))[1].append(
+            (int(ell.perm[int(ins_src[i])]), int(ins_et[i])))
+    for i in range(len(del_dst)):
+        owner_of(int(del_dst[i]))[0][
+            (int(ell.perm[int(del_src[i])]), int(del_et[i]))] += 1
+
+    upd: Dict[int, Tuple[list, list, list]] = {}
+    for r, (dels_c, ins_l) in owners.items():
+        rows = [r] + list(range(int(e0[r]), int(e0[r]) + int(ecnt[r])))
+        entries: list = []
+        widths: List[Tuple[int, int, int]] = []
+        for row in rows:
+            b = bisect.bisect_right(bstarts, row) - 1
+            local = row - bstarts[b]
+            nbr_row = ell.bucket_nbr[b][local]
+            et_row = ell.bucket_et[b][local]
+            widths.append((b, local, int(nbr_row.shape[0])))
+            fill = nbr_row != sentinel
+            entries.extend(zip(nbr_row[fill].tolist(),
+                               et_row[fill].tolist()))
+        if dels_c:
+            left = Counter(dels_c)
+            kept = []
+            for ent in entries:
+                if left.get(ent, 0) > 0:
+                    left[ent] -= 1
+                else:
+                    kept.append(ent)
+            if any(v > 0 for v in left.values()):
+                # a tombstone names an edge the table doesn't hold —
+                # the overlay and the tables disagree; only the
+                # rebuild can reconcile
+                return None
+            entries = kept
+        entries.extend(ins_l)
+        if len(entries) > sum(w for _b, _l, w in widths):
+            return None          # slot overflow past the hub budget
+        pos = 0
+        for b, local, w in widths:
+            take = entries[pos:pos + w]
+            pos += w
+            nn = np.full(w, sentinel, np.int32)
+            ne = np.zeros(w, np.int32)
+            if take:
+                nn[:len(take)] = [t[0] for t in take]
+                ne[:len(take)] = [t[1] for t in take]
+            rb = upd.setdefault(b, ([], [], []))
+            rb[0].append(local)
+            rb[1].append(nn)
+            rb[2].append(ne)
+    return {b: (np.asarray(v[0], np.int32), np.vstack(v[1]),
+                np.vstack(v[2]))
+            for b, v in upd.items()}
+
+
+def apply_ell_absorb_host(ell: EllIndex, plan, m_new: int) -> EllIndex:
+    """Next-generation EllIndex: identical shapes/permutation (cached
+    kernels keyed by shape_sig keep serving), updated slot content.
+    Buckets WITH updates are copied before the scatter; untouched
+    buckets (and perm/inv/extra_owner) share memory with the old
+    generation, whose arrays stay exactly as published — the
+    immutable-generation contract in-flight dispatches rely on."""
+    out = EllIndex()
+    out.n, out.m = ell.n, m_new
+    out.perm, out.inv = ell.perm, ell.inv
+    out.bucket_D = list(ell.bucket_D)
+    out.extra_owner = ell.extra_owner
+    out.n_rows = ell.n_rows
+    out.bucket_nbr = list(ell.bucket_nbr)
+    out.bucket_et = list(ell.bucket_et)
+    for b, (rows, nn, ne) in plan.items():
+        nbr = ell.bucket_nbr[b].copy()
+        et = ell.bucket_et[b].copy()
+        nbr[rows] = nn
+        et[rows] = ne
+        out.bucket_nbr[b] = nbr
+        out.bucket_et[b] = et
+    return out
+
+
+def absorb_update_arrays(ell: EllIndex, plan):
+    """Device-kernel argument form of an absorb plan: per bucket,
+    (rows, nbr_rows, et_rows) padded to ONE UNIFORM pow-2 count — the
+    rung of the largest per-bucket update set — so the jitted scatter
+    sees a bounded shape space.  Uniformity is what bounds it: a
+    per-bucket ladder would make the cache key the cross product of
+    rungs across buckets (each novel mix a fresh synchronous XLA
+    compile under the per-space build lock), while one shared rung
+    keeps the key space at log2(mirror_delta_max) entries — the budget
+    the registry declares — for a few padded rows of h2d.  Pad entries
+    scatter a sentinel-filled row at index ``bucket row count`` — out
+    of range for the resident table, dropped by the kernel's
+    mode="drop" (on padded SHARDED tables the same index lands in a
+    padding row whose content is already all-sentinel, so the write is
+    a no-op either way).  Returns (counts tuple — the kernel cache key
+    — and the per-bucket arrays)."""
+    per_bucket = []
+    kmax = 1
+    for b, nbr_np in enumerate(ell.bucket_nbr):
+        nbk, D = nbr_np.shape
+        rows, nn, ne = plan.get(b, (np.zeros(0, np.int32),
+                                    np.zeros((0, D), np.int32),
+                                    np.zeros((0, D), np.int32)))
+        per_bucket.append((nbk, D, rows, nn, ne))
+        kmax = max(kmax, len(rows))
+    kp = max(8, 1 << (kmax - 1).bit_length())
+    counts: List[int] = []
+    outs = []
+    for nbk, D, rows, nn, ne in per_bucket:
+        k = len(rows)
+        rp = np.full(kp, nbk, np.int32)
+        pn = np.full((kp, D), np.int32(ell.n_rows), np.int32)
+        pe = np.zeros((kp, D), np.int32)
+        rp[:k] = rows
+        pn[:k] = nn
+        pe[:k] = ne
+        counts.append(kp)
+        outs.append((rp, pn, pe))
+    return tuple(counts), outs
+
+
+def make_ell_absorb_kernel(ell: EllIndex, counts: Tuple[int, ...]):
+    """fn(*rows_per_bucket, *nbr_upd_per_bucket, *et_upd_per_bucket,
+    *tables) -> (new bucket_nbr..., new bucket_et...): whole-row
+    scatter of the replacement rows into the resident tables.  The
+    inputs are NOT donated — the old tables are the still-published
+    generation — so the output generation is a fresh HBM allocation
+    (transiently 2x table residency, priced in docs/roofline.md)."""
+    import jax
+    nb = len(ell.bucket_nbr)
+
+    def absorb(*args):
+        rows = args[0:nb]
+        un = args[nb:2 * nb]
+        ue = args[2 * nb:3 * nb]
+        tables = args[3 * nb:]
+        nbrs, ets = tables[:nb], tables[nb:]
+        outs = [nbrs[b].at[rows[b]].set(un[b], mode="drop")
+                for b in range(nb)]
+        outs += [ets[b].at[rows[b]].set(ue[b], mode="drop")
+                 for b in range(nb)]
+        return tuple(outs)
+
+    return jax.jit(absorb)
+
+
+def make_sharded_ell_absorb_kernel(mesh, axis: str, ell: EllIndex,
+                                   padded_rows, counts: Tuple[int, ...]):
+    """Shard-local twin of make_ell_absorb_kernel for the row-sharded
+    replicated-frontier tables (shard_ell): the tiny replacement-row
+    set replicates to every chip, and each shard applies ONLY the rows
+    it owns (non-owned indices push out of range and drop) — zero
+    declared collectives, zero ICI exchange; hub rows live in the cap
+    bucket like any other row, and the serving-time hub re-replication
+    path is untouched.  The scatter runs INSIDE shard_map, so the SPMD
+    partitioner never sees a cross-shard scatter-set (the exact hazard
+    the packed hub merge hit, PR 10)."""
     import jax
     import jax.numpy as jnp
-    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
+    from jax.sharding import PartitionSpec as P
+    from .compat import shard_map
+    nb = len(ell.bucket_nbr)
+    ks = mesh.shape[axis]
 
-    def go(f0p, dsrc, det, dslot, drows, eslot, hrows, *tables):
+    def per_shard(*args):
+        rows = args[0:nb]
+        un = args[nb:2 * nb]
+        ue = args[2 * nb:3 * nb]
+        tables = args[3 * nb:]
         nbrs, ets = tables[:nb], tables[nb:]
-        ok = _etype_ok(jnp, det, etypes).astype(jnp.uint8)
+        d = jax.lax.axis_index(axis)
+        outs_n, outs_e = [], []
+        for b in range(nb):
+            chunk = padded_rows[b] // ks
+            loc = rows[b] - d * chunk
+            # a NEGATIVE local index would wrap (python-style) into a
+            # neighbour's row — push every non-owned update out of
+            # range instead, where mode="drop" discards it
+            loc = jnp.where((loc >= 0) & (loc < chunk), loc,
+                            jnp.int32(chunk))
+            outs_n.append(nbrs[b].at[loc].set(un[b], mode="drop"))
+            outs_e.append(ets[b].at[loc].set(ue[b], mode="drop"))
+        return tuple(outs_n + outs_e)
 
-        def one(_, f):
-            nxt = _hop_body_packed(jnp, jax, n, n_extras, etypes, nbrs,
-                                   ets, eslot, hrows, f)
-            act = f[dsrc] * ok[:, None]          # [cap, W] packed
-            return _scatter_or_rows(jnp, nxt, act, dslot, drows)
-
-        return f0p if steps <= 1 else \
-            jax.lax.fori_loop(0, steps - 1, one, f0p)
-
-    return jax.jit(go, donate_argnums=(0,) if donate else ())
+    in_spec = (P(),) * (3 * nb) + (P(axis),) * (2 * nb)
+    fn = shard_map(per_shard, mesh=mesh, in_specs=in_spec,
+                   out_specs=(P(axis),) * (2 * nb), check_vma=False)
+    return jax.jit(fn)
 
 
 def make_batched_bfs_lanes_kernel(ell: EllIndex, max_steps: int,
@@ -741,39 +971,6 @@ def make_batched_go_kernel(ell: EllIndex, steps: int,
     # callers that re-dispatch one frontier (bench drivers, parity
     # tests) — or that pass a numpy array jax may zero-copy alias on
     # CPU — must keep the default
-    return jax.jit(go, donate_argnums=(0,) if donate else ())
-
-
-def make_batched_go_delta_kernel(ell: EllIndex, steps: int,
-                                 etypes: Tuple[int, ...], cap: int,
-                                 pack: bool = False,
-                                 donate: bool = False):
-    """Batched GO over the base ELL plus up to ``cap`` overlay edges
-    (incremental CSR maintenance: freshly committed edge inserts ride
-    as (src, dst, etype) triples in the ell's NEW-id space instead of
-    forcing an O(m) table rebuild).  Padded slots use row index n_rows
-    (the always-zero pad row) and etype 0 (never in an OVER set)."""
-    import jax
-    import jax.numpy as jnp
-    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
-
-    def go(f0, dsrc, ddst, det, owner, *tables):
-        nbrs, ets = tables[:nb], tables[nb:]
-        ok = _etype_ok(jnp, det, etypes).astype(jnp.int8)
-
-        def one(_, f):
-            nxt = _hop_body(jnp, jax, n, n_extras, etypes, nbrs, ets,
-                            owner, f)
-            act = f[dsrc] * ok[:, None]          # [cap, B]
-            return nxt.at[ddst].max(act)
-        out = f0 if steps <= 1 else \
-            jax.lax.fori_loop(0, steps - 1, one, f0)
-        return pack_bits(jnp, out) if pack else out
-
-    # f0 only (opt-in, see make_batched_go_kernel): dsrc/ddst/det are
-    # CACHED per delta generation (runtime._delta_device) and
-    # re-dispatched, so donating them would invalidate a live cache
-    # entry
     return jax.jit(go, donate_argnums=(0,) if donate else ())
 
 
@@ -2047,20 +2244,28 @@ def _ell_bfs_buckets(fx):
     return out
 
 
-def _ell_go_delta_buckets(fx):
+def _absorb_update_avals(fx, kp: int):
+    """(rows, nbr_upd, et_upd) avals per bucket at padded count kp —
+    the single-bucket audit fixture keeps this flat."""
     out = []
-    for cap in (8, 4096):           # the pow-2 overlay ladder's ends
-        kern = make_batched_go_delta_lanes_kernel(  # nebulint: disable=jax-hotpath
-            fx.ell, fx.steps, fx.etypes, cap, donate=True)
-        pk = _packed_frontier_avals(fx, fx.widths[0])
-        out.append((("ell_go_delta_packed", fx.ell.shape_sig(),
-                     fx.etypes, fx.steps, cap), kern,
-                    (pk[0],
-                     fx.aval((cap,), np.int32),    # dsrc
-                     fx.aval((cap,), np.int32),    # det
-                     fx.aval((cap,), np.int32),    # dslot
-                     fx.aval((cap,), np.int32),    # drows
-                     pk[1], pk[2]) + fx.table_avals()[1:]))
+    for nbr in fx.ell.bucket_nbr:
+        out.append(fx.aval((kp,), np.int32))
+    for nbr in fx.ell.bucket_nbr:
+        out.append(fx.aval((kp, nbr.shape[1]), np.int32))
+    for nbr in fx.ell.bucket_nbr:
+        out.append(fx.aval((kp, nbr.shape[1]), np.int32))
+    return tuple(out)
+
+
+def _ell_absorb_buckets(fx):
+    out = []
+    for kp in (8, 64):              # the pow-2 update-count ladder's ends
+        counts = tuple(kp for _ in fx.ell.bucket_nbr)
+        kern = make_ell_absorb_kernel(  # nebulint: disable=jax-hotpath
+            fx.ell, counts)
+        out.append((("ell_absorb", fx.ell.shape_sig(), counts), kern,
+                    _absorb_update_avals(fx, kp)
+                    + fx.table_avals()[1:]))
     return out
 
 
@@ -2113,12 +2318,12 @@ register_kernel(KernelSpec(
     budget=4, instantiate=_ell_bfs_buckets, donate=(0, 1),
     dispatch=(0, 1), frontier=(0, 1), packed=(0, 1)))
 register_kernel(KernelSpec(
-    "ell_go_delta", make_batched_go_delta_lanes_kernel,
-    phase_kind="ell_go_delta",
-    # per steps value: one retrace per pow-2 overlay-capacity rung
-    # (log2(mirror_delta_max) rungs bound the ladder)
-    budget=12, instantiate=_ell_go_delta_buckets, donate=(0,),
-    dispatch=(0,), frontier=(0,), packed=(0,)))
+    "ell_absorb", make_ell_absorb_kernel, phase_kind="ell_absorb",
+    # one retrace per pow-2 update-count rung (log2(mirror_delta_max)
+    # rungs bound the ladder); NO donation: the resident tables are
+    # the still-published generation in-flight dispatches read — the
+    # output generation must be a fresh allocation (docs/durability.md)
+    budget=12, instantiate=_ell_absorb_buckets, dispatch=(0, 1, 2)))
 
 
 def _sharded_table_avals(fx, nbrs, ets):
@@ -2209,6 +2414,48 @@ register_kernel(KernelSpec(
     # per BFS level (the while body traces once)
     ici_bytes=_replicated_frontier_ici,
     shard_args=_ell_bfs_sharded_arg_indices))
+
+
+def _ell_absorb_sharded_mesh_buckets(fx, mesh):
+    k = mesh.shape["parts"]
+    nbrs, ets, _reals = shard_ell(mesh, "parts", fx.ell)
+    padded = [int(a.shape[0]) for a in nbrs]
+    out = []
+    for kp in (8, 64):
+        counts = tuple(kp for _ in fx.ell.bucket_nbr)
+        kern = make_sharded_ell_absorb_kernel(  # nebulint: disable=jax-hotpath
+            mesh, "parts", fx.ell, padded, counts)
+        out.append((("ell_absorb_sharded", fx.ell.shape_sig(), counts,
+                     k), kern,
+                    _absorb_update_avals(fx, kp)
+                    + _sharded_table_avals(fx, nbrs, ets)))
+    return out
+
+
+def _ell_absorb_sharded_buckets(fx):
+    return _ell_absorb_sharded_mesh_buckets(fx, fx.mesh())
+
+
+def _ell_absorb_sharded_arg_indices(fx):
+    nb = len(fx.ell.bucket_nbr)
+    return tuple(range(3 * nb, 5 * nb))
+
+
+register_kernel(KernelSpec(
+    "ell_absorb_sharded", make_sharded_ell_absorb_kernel,
+    phase_kind="ell_absorb",
+    budget=12, instantiate=_ell_absorb_sharded_buckets,
+    dispatch=(0, 1, 2),
+    mesh_instantiate=_ell_absorb_sharded_mesh_buckets,
+    # COLLECTIVE_MODEL: EMPTY by design — absorption is shard-local
+    # (each chip applies only the replacement rows it owns; the
+    # replicated update upload is input placement, not a collective),
+    # so a traced psum/all_gather here is a regression that would put
+    # table maintenance on the ICI critical path
+    collective=(),
+    ici_bytes=lambda fx, k: 0,
+    shard_args=_ell_absorb_sharded_arg_indices,
+    shard_outs=tuple(range(2))))
 
 
 # ------------------------------------------------ frontier-sharded (mesh)

@@ -19,4 +19,5 @@ export JAX_PLATFORMS=cpu
 export NEBULA_LOCK_WATCHDOG=1
 
 exec python -m pytest tests/test_proc_chaos.py tests/test_chaos.py \
-    tests/test_crash_recovery.py -v -m chaos -p no:cacheprovider "$@"
+    tests/test_crash_recovery.py tests/test_write_serve.py \
+    -v -m chaos -p no:cacheprovider "$@"

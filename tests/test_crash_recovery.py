@@ -267,7 +267,12 @@ class TestDeviceBreakerUnit:
         saved = (flags.get("tpu_breaker_failures"),
                  flags.get("tpu_breaker_open_s"))
         flags.set("tpu_breaker_failures", 3)
-        flags.set("tpu_breaker_open_s", 0.15)
+        # WIDE open window by default: tests asserting a cell STAYS
+        # open must not flake when a GC pause or suite load stalls
+        # longer than open_s between record_failure and admit (a 0.15s
+        # window half-opens under a loaded tier-1 run); the tests that
+        # need the window to ELAPSE shrink it themselves
+        flags.set("tpu_breaker_open_s", 30.0)
         yield
         flags.set("tpu_breaker_failures", saved[0])
         flags.set("tpu_breaker_open_s", saved[1])
@@ -290,6 +295,7 @@ class TestDeviceBreakerUnit:
     def test_half_open_single_probe_then_reclose(self):
         b = self._mk()
         key = (7, "go")
+        flags.set("tpu_breaker_open_s", 0.15)   # fixture restores
         for _ in range(3):
             b.record_failure(key, "transfer")
         assert b.admit(key) is not None
@@ -308,6 +314,7 @@ class TestDeviceBreakerUnit:
         still-broken device would otherwise take full traffic again)."""
         b = self._mk()
         key = (7, "go")
+        flags.set("tpu_breaker_open_s", 0.15)   # fixture restores
         for _ in range(3):
             b.record_failure(key, "xla_runtime")
         time.sleep(0.2)
@@ -331,6 +338,7 @@ class TestDeviceBreakerUnit:
     def test_half_open_probe_failure_reopens(self):
         b = self._mk()
         key = (7, "path")
+        flags.set("tpu_breaker_open_s", 0.15)   # fixture restores
         for _ in range(3):
             b.record_failure(key, "resource_exhausted")
         time.sleep(0.2)

@@ -233,9 +233,9 @@ def test_async_mirror_refresh_serves_stale_then_updates():
 
     flags.set("mirror_refresh_mode", "async")
     try:
-        # a VERTEX write is opaque to the insert overlay (edge deltas
-        # absorb incrementally since round 4), so it exercises the
-        # async rebuild path
+        # a NEW-vertex write changes the vertex plan, which absorption
+        # declines (docs/durability.md decision table), so it
+        # exercises the async rebuild path
         assert g.execute('INSERT VERTEX p(x) VALUES 9:(5)').ok()
         stale = rt.mirror(sid)          # triggers bg rebuild, serves stale
         assert stale is m1

@@ -765,8 +765,8 @@ def test_jaxaudit_package_registry_is_clean_within_budgets():
 
     registry = kernel_registry()
     assert {"go", "go_filtered", "bfs", "sharded_go", "ell_go",
-            "sparse_go", "adaptive_go", "ell_bfs", "ell_go_delta",
-            "expr_filter"} <= set(registry)
+            "sparse_go", "adaptive_go", "ell_bfs", "ell_absorb",
+            "ell_absorb_sharded", "expr_filter"} <= set(registry)
     fx = AuditFixture()
     vs, kinds = audit_specs(registry.values(), fx, rt.DEVICE_PHASES,
                             SPAN_NAMES, lambda s: ("x", 1))
@@ -2135,7 +2135,7 @@ def test_meshaudit_registry_covers_all_sharded_families():
                if "sharded" in name or "mesh" in name}
     assert sharded == {"sharded_go", "ell_go_sharded",
                        "ell_bfs_sharded", "mesh_sparse_go",
-                       "mesh_sparse_bfs"}
+                       "mesh_sparse_bfs", "ell_absorb_sharded"}
     for name in sharded:
         assert reg[name].mesh_instantiate is not None, name
         assert reg[name].collective is not None, name

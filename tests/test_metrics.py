@@ -337,8 +337,13 @@ class TestMetricsEndpoint:
     def test_tpu_device_gauges(self, webservices):
         _types, samples = parse_prom(
             _get(webservices["storaged"], "/metrics").read().decode())
-        assert ("nebula_tpu_jit_cache_size", "") in samples
-        assert ("nebula_tpu_compile_count", "") in samples
+        # series carry the runtime-role label: a storaged holds TWO
+        # runtimes (deviceGo + bulk-read backend) whose collectors
+        # would otherwise shadow each other's gauge values
+        assert any(k[0] == "nebula_tpu_jit_cache_size"
+                   and 'runtime="' in k[1] for k in samples), samples
+        assert any(k[0] == "nebula_tpu_compile_count"
+                   for k in samples)
 
     def test_latency_histogram_shape(self, webservices):
         types, samples = parse_prom(

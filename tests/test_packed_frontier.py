@@ -1,14 +1,14 @@
 """Bit-packed frontier + reduction-pushdown tests (docs/roofline.md).
 
 Three tiers:
-  * kernel parity — randomized dense/delta/BFS packed-vs-int8
+  * kernel parity — randomized dense/absorbed/BFS packed-vs-int8
     differentials across the go_batch_widths ladder, hub-heavy and
     hub-free graphs, donation safety (a donated packed frontier is
     consumed, never aliased), and the sparse LIMIT/COUNT reductions
     against the unreduced kernel;
   * runtime parity — the packed default must serve bit-identical rows
     to the int8 layout through the full launch/assemble pipeline,
-    including the delta-overlay path;
+    including hops over absorbed-generation tables;
   * pushdown e2e — GO | LIMIT and GO | YIELD COUNT(*) across CPU and
     device backends, with the runtime's go_reduced/fetch_bytes stats
     proving the reduced path actually ran.
@@ -95,38 +95,114 @@ class TestPackedKernelParity:
             *ix.kernel_args()[1:]))
         assert (ref[:ix.n] == out[:ix.n]).all()
 
-    def test_delta_overlay_matches_int8(self):
+    def test_absorbed_tables_match_int8_and_packed_hops(self):
+        """Absorb a delta into the resident tables (plan + host apply
+        + device scatter), then both frontier layouts hopping over the
+        ABSORBED tables must match the int8 kernel over an EllIndex
+        rebuilt from scratch on the merged edge list — slot ORDER may
+        differ (absorption refills rows), semantics may not."""
+        import bisect
         import jax.numpy as jnp
-        ix, *_rest, rng = _graph(19, 100, 500, hub=True)
-        B, steps, cap = 16, 3, 8
+        ix, s2, d2, e2, rng = _graph(19, 100, 500, hub=True)
+        B, steps = 16, 3
+        # pick dsts with >= 2 free slots in their main row so the plan
+        # is absorbable by construction (and shapes survive the oracle
+        # rebuild below); duplicate each dst to exercise multi-insert
+        # rows
+        bstarts = [0]
+        for a in ix.bucket_nbr[:-1]:
+            bstarts.append(bstarts[-1] + a.shape[0])
+
+        def slack_of(old: int) -> int:
+            r = int(ix.perm[old])
+            b = bisect.bisect_right(bstarts, r) - 1
+            row = ix.bucket_nbr[b][r - bstarts[b]]
+            return int((row == ix.n_rows).sum())
+
+        cand = [v for v in range(ix.n) if slack_of(v) >= 2][:3]
+        assert len(cand) == 3
+        ins_dst = np.asarray(cand * 2, np.int32)
+        k = len(ins_dst)
+        ins_src = rng.integers(0, ix.n, k).astype(np.int32)
+        ins_et = np.ones(k, np.int32)
+        plan = E.plan_ell_absorb(ix, ins_dst, ins_src, ins_et,
+                                 np.zeros(0, np.int32),
+                                 np.zeros(0, np.int32),
+                                 np.zeros(0, np.int32))
+        assert plan is not None
+        ix2 = E.apply_ell_absorb_host(ix, plan, ix.m + k)
+        counts, upd = E.absorb_update_arrays(ix, plan)
+        outs = E.make_ell_absorb_kernel(ix, counts)(
+            *[jnp.asarray(u[0]) for u in upd],
+            *[jnp.asarray(u[1]) for u in upd],
+            *[jnp.asarray(u[2]) for u in upd],
+            *[jnp.asarray(a) for a in ix.bucket_nbr],
+            *[jnp.asarray(a) for a in ix.bucket_et])
+        nb = len(ix.bucket_nbr)
+        for b in range(nb):     # device scatter == host apply
+            assert np.array_equal(np.asarray(outs[b]), ix2.bucket_nbr[b])
+            assert np.array_equal(np.asarray(outs[nb + b]),
+                                  ix2.bucket_et[b])
+        # oracle: rebuild from scratch on the merged edge list (same
+        # shapes by construction: inserts stay within slot slack)
+        ms = np.concatenate([s2, ins_src])
+        md = np.concatenate([d2, ins_dst])
+        me = np.concatenate([e2, ins_et])
+        ix_ref = E.EllIndex.build(ms, md, me, ix.n, cap=16,
+                                  use_native=False)
+        assert ix_ref.shape_sig() == ix2.shape_sig()
         f0 = ix.start_frontier(_starts(rng, ix.n, B), B=B)
-        # overlay edges in NEW-id space, duplicate dsts on purpose (the
-        # packed scatter must OR, not max)
-        dsrc = np.full(cap, ix.n_rows, np.int32)
-        ddst = np.full(cap, ix.n_rows, np.int32)
-        det = np.zeros(cap, np.int32)
-        k = 6
-        dsrc[:k] = ix.perm[rng.integers(0, ix.n, k)]
-        ddst[:k] = ix.perm[rng.integers(0, 3, k)]      # collide dsts
-        det[:k] = 1
-        ref = np.asarray(E.make_batched_go_delta_kernel(
-            ix, steps, ETYPES, cap)(
-            jnp.asarray(f0), jnp.asarray(dsrc), jnp.asarray(ddst),
-            jnp.asarray(det), *ix.kernel_args()))
-        uniq, slot = np.unique(ddst[:k], return_inverse=True)
-        dslot = np.zeros(cap, np.int32)
-        dslot[:k] = slot
-        drows = np.full(cap, ix.n_rows + 1, np.int32)
-        drows[:len(uniq)] = uniq
-        eslot, hrows = ix.hub_merge()
-        out = np.asarray(E.make_batched_go_delta_lanes_kernel(
-            ix, steps, ETYPES, cap)(
-            jnp.asarray(E.pack_lanes_host(f0)), jnp.asarray(dsrc),
-            jnp.asarray(det), jnp.asarray(dslot), jnp.asarray(drows),
-            jnp.asarray(eslot), jnp.asarray(hrows),
-            *ix.kernel_args()[1:]))
-        assert (E.unpack_lanes_host(out, B)[:ix.n]
+        ref = np.asarray(E.make_batched_go_kernel(ix_ref, steps, ETYPES)(
+            jnp.asarray(f0), *ix_ref.kernel_args()))
+        got8 = np.asarray(E.make_batched_go_kernel(ix2, steps, ETYPES)(
+            jnp.asarray(f0), *ix2.kernel_args()))
+        eslot, hrows = ix2.hub_merge()
+        gotp = np.asarray(E.make_batched_go_lanes_kernel(
+            ix2, steps, ETYPES)(
+            jnp.asarray(E.pack_lanes_host(f0)), jnp.asarray(eslot),
+            jnp.asarray(hrows), *ix2.kernel_args()[1:]))
+        assert ((got8[:ix.n] > 0) == (ref[:ix.n] > 0)).all()
+        assert (E.unpack_lanes_host(gotp, B)[:ix.n]
                 == (ref[:ix.n] > 0)).all()
+
+    def test_absorb_update_counts_are_uniform(self):
+        """The absorb kernel cache key is the padded-counts tuple: a
+        per-bucket pow-2 ladder would make the key space the CROSS
+        PRODUCT of rungs across buckets — each novel mix a fresh
+        synchronous XLA compile under the per-space build lock —
+        so absorb_update_arrays must pad every bucket to ONE shared
+        rung (the registry's log2(mirror_delta_max) budget depends on
+        it, and the audit fixture instantiates uniform counts)."""
+        ix, *_rest, rng = _graph(31, 100, 500, hub=True)
+        assert len(ix.bucket_nbr) >= 2
+
+        def mkplan(rows_per_bucket):
+            plan = {}
+            for b, k in enumerate(rows_per_bucket):
+                if not k:
+                    continue
+                D = ix.bucket_nbr[b].shape[1]
+                plan[b] = (np.arange(k, dtype=np.int32),
+                           np.full((k, D), ix.n_rows, np.int32),
+                           np.zeros((k, D), np.int32))
+            return plan
+
+        # a lopsided plan: many updates in one bucket, few elsewhere
+        lop = [0] * len(ix.bucket_nbr)
+        lop[0], lop[1] = 24, 2
+        counts, upd = E.absorb_update_arrays(ix, mkplan(lop))
+        assert len(set(counts)) == 1          # one shared rung
+        kp = counts[0]
+        assert kp >= 24
+        assert kp & (kp - 1) == 0             # pow-2 rung
+        for (rp, pn, pe) in upd:
+            assert len(rp) == kp == len(pn) == len(pe)
+        # key stability: a different bucket mix at the same max rung
+        # must reuse the same counts tuple (no recompile per novel mix)
+        flip = [0] * len(ix.bucket_nbr)
+        flip[0], flip[1] = 3, 24
+        counts2, _ = E.absorb_update_arrays(ix, mkplan(flip))
+        assert counts2 == counts
 
     def test_donated_packed_frontier_not_aliased(self):
         """donate=True consumes f0p: the caller's jnp buffer must be
@@ -243,7 +319,8 @@ class TestSparseReductions:
 
 class TestRuntimePackedParity:
     """The full launch/assemble pipeline must serve identical rows in
-    both frontier layouts — including the delta-overlay path."""
+    both frontier layouts — including hops over a freshly ABSORBED
+    mirror generation."""
 
     def _boot(self):
         from nebula_tpu.cluster import LocalCluster
@@ -284,9 +361,10 @@ class TestRuntimePackedParity:
             flags.set("tpu_packed_frontier", True)
             c.stop()
 
-    def test_delta_overlay_path_packed(self):
-        """Fresh edge inserts riding the overlay kernel (no rebuild)
-        must surface identically under the packed layout."""
+    def test_absorbed_generation_path_packed(self):
+        """Fresh edge inserts ABSORB into a new mirror generation (no
+        rebuild) and must surface identically under both frontier
+        layouts and the CPU oracle."""
         from nebula_tpu.common.flags import flags
         c, cl, ok = self._boot()
         try:
@@ -298,7 +376,8 @@ class TestRuntimePackedParity:
             flags.set("tpu_packed_frontier", True)
             a = sorted(map(tuple, ok(q).rows))
             assert rt.stats["mirror_builds"] == builds0, \
-                "insert should ride the delta overlay, not a rebuild"
+                "insert should absorb into the tables, not rebuild"
+            assert rt.stats.get("mirror_absorbs", 0) > 0
             assert rt.stats.get("mirror_deltas", 0) > 0
             flags.set("tpu_packed_frontier", False)
             b = sorted(map(tuple, ok(q).rows))

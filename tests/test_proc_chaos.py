@@ -324,6 +324,119 @@ class TestKillMatrix:
             # data-plane still intact end to end
             assert _dst_set(_ok(cl, q)) == [2]
 
+    def test_kill_storaged_mid_absorption_zero_acked_loss(self, tmp_path):
+        """Write-while-serve crash cell (ISSUE 11): the storaged
+        device-serves GO traffic while absorbing a live write stream
+        into mirror generations; SIGKILL lands with absorptions
+        verifiably in flight.  Restart must recover to a CONSISTENT
+        generation: every acked write visible (and deleted edges
+        gone), completeness 100 after convergence, and the absorb path
+        re-engaged post-recovery."""
+        from nebula_tpu.tools.bench_suite import _prom_value
+        with ProcCluster(str(tmp_path), num_storage=1,
+                         storage_backend="tpu") as c:
+            cl = c.client()
+            _ok(cl, "CREATE SPACE ka(partition_num=2, replica_factor=1)")
+            _ok(cl, "USE ka")
+            _ok(cl, "CREATE EDGE e(w int)")
+            n = 60
+            _ok(cl, "INSERT EDGE e(w) VALUES "
+                    + ", ".join(f"{i}->{i % n + 1}@0:({i})"
+                                for i in range(1, n + 1)))
+            goq = "GO 2 STEPS FROM 1, 7, 13 OVER e YIELD e._dst"
+            _ok(cl, goq)                      # device mirror builds
+
+            acked: list = []        # (src, dst, rank, w)
+            deleted: list = []
+            murky: list = []        # delete attempted, ack unknown
+            stop = threading.Event()
+
+            def writer():
+                g = c.client()
+                g.execute("USE ka")
+                i = 0
+                cursor = [0]
+                while not stop.is_set() and i < 4000:
+                    i += 1
+                    s, d, w = i % n + 1, (i * 7 + 3) % n + 1, 40000 + i
+                    r = g.execute(f"INSERT EDGE e(w) VALUES "
+                                  f"{s}->{d}@{w}:({w})")
+                    if r.ok():
+                        acked.append((s, d, w, w))
+                    if i % 5 == 0 and len(acked) > cursor[0] + 4:
+                        ent = acked[cursor[0]]
+                        cursor[0] += 1
+                        s2, d2, r2, _w2 = ent
+                        if g.execute(f"DELETE EDGE e {s2}->{d2}@{r2}") \
+                                .ok():
+                            deleted.append(ent)
+                        else:
+                            murky.append(ent)   # outcome unknown
+
+            def reader():
+                g = c.client()
+                g.execute("USE ka")
+                while not stop.is_set():
+                    g.execute(goq)            # keeps absorptions firing
+
+            ts = [threading.Thread(target=writer, daemon=True),
+                  threading.Thread(target=reader, daemon=True)]
+            for t in ts:
+                t.start()
+            # kill only once absorptions are PROVABLY in flight
+            deadline = time.monotonic() + 30
+            absorbs = 0.0
+            while time.monotonic() < deadline:
+                absorbs = _prom_value(c.metrics("storaged0"),
+                                      "nebula_tpu_absorb_count")
+                if absorbs >= 3 and len(acked) >= 30:
+                    break
+                time.sleep(0.2)
+            assert absorbs >= 3, "absorption never engaged pre-kill"
+            c.kill("storaged0", signal.SIGKILL)
+            c.wait_down("storaged0")
+            stop.set()
+            for t in ts:
+                t.join(timeout=60)
+            c.restart("storaged0")
+
+            # recovery: acked edges visible, acked deletes gone,
+            # completeness 100 — on the REBUILT + re-absorbing mirror
+            snap_acked = list(acked)
+            snap_deleted = set(deleted)
+            snap_murky = set(murky)     # unacked deletes: either way
+            live = [e for e in snap_acked
+                    if e not in snap_deleted and e not in snap_murky]
+            deadline = time.monotonic() + 40
+            rows = None
+            srcs = ",".join(str(s)
+                            for s in sorted({e[0] for e in live}))
+            while time.monotonic() < deadline:
+                r = cl.execute(f"GO FROM {srcs} OVER e "
+                               f"YIELD e._dst, e.w")
+                if r.ok() and r.completeness == 100:
+                    rows = set(map(tuple, r.rows))
+                    break
+                time.sleep(0.4)
+            assert rows is not None, "reads never converged"
+            lost = [e for e in live if (e[1], e[3]) not in rows]
+            assert not lost, f"acked writes lost mid-absorption: {lost[:5]}"
+            zombies = [e for e in snap_deleted
+                       if (e[1], e[3]) in rows]
+            assert not zombies, f"acked deletes resurrected: {zombies[:5]}"
+            # the absorb path re-engages on the recovered generation
+            _ok(cl, f"INSERT EDGE e(w) VALUES 1->{n // 2}@99999:(99999)")
+            deadline = time.monotonic() + 20
+            post = 0.0
+            while time.monotonic() < deadline:
+                _ok(cl, goq)
+                post = _prom_value(c.metrics("storaged0"),
+                                   "nebula_tpu_absorb_count")
+                if post > 0:
+                    break
+                time.sleep(0.2)
+            assert post > 0, "absorption did not resume after recovery"
+
     def test_kill_follower_mid_snapshot_install(self, tmp_path):
         """Snapshot cell: a follower dead long enough for the leader's
         WAL to trim past it must catch up via snapshot transfer on

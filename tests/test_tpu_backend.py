@@ -629,9 +629,10 @@ class TestIncrementalDelta:
         finally:
             c.stop()
 
-    def test_delete_with_multi_hop_rebuilds_and_stays_correct(self):
-        """Reachability-changing deletes must force the rebuild for
-        multi-hop queries (the base ELL can't subtract edges)."""
+    def test_delete_with_multi_hop_stays_correct(self):
+        """Reachability-changing deletes fold into the tables as
+        tombstones at absorb time — multi-hop queries stay exact
+        (the rebuild-free claim is pinned in tests/test_absorb.py)."""
         c, cl, ok = self._boot()
         try:
             rt = c.tpu_runtime
@@ -682,15 +683,17 @@ class TestIncrementalDelta:
         finally:
             c.stop()
 
-    def test_new_vertex_insert_absorbed_for_single_hop(self):
-        """Edges to brand-new vertices grow the overlay's dense space:
-        1-hop queries serve them from the mirror without a rebuild;
-        multi-hop and new-vertex starts pay the rebuild (exactness)."""
+    def test_new_vertex_insert_absorbs_known_dst_rebuilds_extra_vid(self):
+        """An edge to a KNOWN vertex absorbs into the tables (the dst
+        row exists — no rebuild); an edge to a vid with NO vertex
+        record grows the dense-id space, which only the rebuild can
+        serve — and that decline must be OBSERVABLE (mirror_absorb_
+        failed + the vertex-plan-change reason), never silent
+        (docs/durability.md decision table)."""
         c, cl, ok = self._boot()
         try:
             rt = c.tpu_runtime
             ok("GO FROM 100 OVER follow")
-            builds0 = rt.stats["mirror_builds"]
             ok('INSERT VERTEX player(name, age) VALUES 500:("new", 1)')
             # vertex-only write is opaque (rebuild) — anchor the count
             ok("GO FROM 100 OVER follow")
@@ -700,16 +703,24 @@ class TestIncrementalDelta:
                    "follow.degree")
             assert (500, 42) in set(map(tuple, r.rows))
             assert rt.stats["mirror_builds"] == builds1, \
-                "new-dst edge should absorb for 1-hop without a rebuild"
+                "known-dst edge should absorb without a rebuild"
             # an edge to a vid with NO vertex record at all grows the
-            # overlay's dense space (extra_vids) — still no rebuild
+            # dense-id space: a vertex-plan change — graceful,
+            # OBSERVABLE rebuild (results stay exact)
+            fails0 = rt.stats["mirror_absorb_failed"]
             ok("INSERT EDGE follow(degree) VALUES 100 -> 600:(44)")
             r = ok("GO FROM 100 OVER follow YIELD follow._dst, "
                    "follow.degree")
             assert (600, 44) in set(map(tuple, r.rows))
-            assert rt.stats["mirror_builds"] == builds1, \
-                "extra-vid edge should absorb for 1-hop without a rebuild"
-            # starting AT the fresh vertex must be exact too (rebuild)
+            assert rt.stats["mirror_builds"] > builds1, \
+                "extra-vid edge changes the vertex plan: rebuild path"
+            assert rt.stats["mirror_absorb_failed"] > fails0
+            from nebula_tpu.common.events import journal
+            kinds = [e for e in journal.dump(200)
+                     if e["kind"] == "mirror.absorb_failed"]
+            assert any(e.get("reason") == "vertex-plan-change"
+                       for e in kinds), kinds
+            # starting AT the fresh vertex must be exact too
             ok("INSERT EDGE follow(degree) VALUES 600 -> 103:(43)")
             r = ok("GO FROM 600 OVER follow YIELD follow._dst")
             assert set(map(tuple, r.rows)) == {(103,)}
