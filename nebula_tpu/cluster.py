@@ -83,6 +83,10 @@ class StorageNode:
         # heartbeats carry the per-part replication brief so metad's
         # SHOW PARTS can show term/commit/log lag without scraping us
         self.meta_client.hb_parts_provider = self.service.part_status_brief
+        # ...and the per-space device brief (mirror generation +
+        # breaker state) graphd's failover ladder orders replicas by
+        self.meta_client.hb_device_provider = \
+            self.service.device_status_brief
         self.handler = CompositeHandler(self.service, self.raft_service) \
             if self.raft_service else self.service
 

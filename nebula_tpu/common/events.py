@@ -62,8 +62,22 @@ EVENT_KINDS = (
                              # docs/durability.md)
     "mirror.absorb_failed",  # an absorption declined (vertex-plan
                              # change / slot overflow / delta-budget
-                             # overflow / opaque events) — a full
-                             # rebuild is about to be paid instead
+                             # overflow / opaque events / typed peer-*
+                             # delta-stream breaks) — a full rebuild
+                             # is about to be paid instead
+    "mirror.peer_absorbed",  # a PEER's committed writes streamed over
+                             # deviceScanDelta and folded into the
+                             # resident device tables at O(delta) —
+                             # the multi-host absorb path
+                             # (storage/device.py RemoteStoreView,
+                             # docs/durability.md)
+    "net.partitioned",       # a directional link cut was installed
+                             # (FaultInjector.partition — this
+                             # process's outbound calls to the named
+                             # host now blackhole;
+                             # docs/fault_injection.md)
+    "net.healed",            # directional link cuts matching a host
+                             # pattern were removed (FaultInjector.heal)
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

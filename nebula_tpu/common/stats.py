@@ -64,6 +64,11 @@ METRIC_NAMES = (
     "graph.stmt.*",                  # per-statement-kind latency family
     "graph.router.device.qps",
     "graph.router.cpu.qps",
+    # replica failover ladder (storage/device.py RemoteDeviceRuntime,
+    # docs/durability.md "The failover ladder"): retries onto another
+    # replica, queries a replica actually served after the primary
+    # degraded, ladders exhausted to the CPU loop, decline-cache skips
+    "graph.device_failover.*",
     # admission control / load shedding (graph/batch_dispatch.py,
     # docs/admission.md): queue depth observations + gauges, shed and
     # deadline-exceeded counters, admission wait histogram, the
@@ -113,6 +118,11 @@ METRIC_NAMES = (
     "tpu.mirror.generation",
     "tpu.mirror.delta_overflow",
     "tpu.absorb.*",
+    # streamed peer-delta absorption (storage/device.py RemoteStoreView
+    # + rpc_deviceScanDelta, docs/durability.md "The peer-delta cursor
+    # protocol"): absorbed windows / typed declines / events folded on
+    # the mirror side, windows served on the leading side
+    "tpu.peer_absorb.*",
     "tpu.jit_cache.size",
     "tpu.compile.count",
     "tpu.prewarm.hits",

@@ -70,6 +70,11 @@ SPAN_NAMES = (
                               # committed write delta into the resident
                               # tables as the next mirror generation
                               # (tpu/runtime.py, docs/durability.md)
+    "tpu.peer_absorb",        # one peer-delta stream window: the
+                              # deviceScanDelta fetch + cursor checks
+                              # that feed a remote store's events into
+                              # the absorption above (storage/device.py
+                              # RemoteStoreView.delta_since)
     "tpu.transfer",           # host→device mirror upload
     "tpu.jit.compile",        # kernel cache miss → XLA build/compile
     "tpu.kernel",             # device kernel dispatch (async launch)

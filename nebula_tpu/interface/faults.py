@@ -63,11 +63,11 @@ stats.register_stats("rpc.fault.injected")
 
 class FaultRule:
     __slots__ = ("kind", "host", "method", "p", "times", "skip", "delay_s",
-                 "leader", "hits", "fired")
+                 "leader", "tag", "hits", "fired")
 
     def __init__(self, kind: str, host: str = "*", method: str = "*",
                  p: float = 1.0, times: Optional[int] = None, skip: int = 0,
-                 delay_s: float = 0.0, leader: str = ""):
+                 delay_s: float = 0.0, leader: str = "", tag: str = ""):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
                              f"(one of {', '.join(KINDS)})")
@@ -79,13 +79,16 @@ class FaultRule:
         self.skip = int(skip)
         self.delay_s = float(delay_s)
         self.leader = str(leader)
+        # free-form rule label; partition()/heal() below manage the
+        # rules tagged "partition" without disturbing operator rules
+        self.tag = str(tag)
         self.hits = 0      # calls that matched (host, method)
         self.fired = 0     # matches that actually injected the fault
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FaultRule":
         unknown = set(d) - {"kind", "host", "method", "p", "times", "skip",
-                            "delay_s", "leader"}
+                            "delay_s", "leader", "tag"}
         if unknown:
             raise ValueError(f"unknown fault rule fields {sorted(unknown)}")
         if "kind" not in d:
@@ -96,7 +99,7 @@ class FaultRule:
         return {"kind": self.kind, "host": self.host, "method": self.method,
                 "p": self.p, "times": self.times, "skip": self.skip,
                 "delay_s": self.delay_s, "leader": self.leader,
-                "hits": self.hits, "fired": self.fired}
+                "tag": self.tag, "hits": self.hits, "fired": self.fired}
 
     def matches(self, host: str, method: str) -> bool:
         return fnmatch.fnmatchcase(host, self.host) and \
@@ -129,6 +132,52 @@ class FaultInjector:
 
     def clear(self) -> None:
         self.configure([])
+
+    # ------------------------------------------- directional partitions
+    # The asymmetric-link chaos primitives (docs/fault_injection.md
+    # "Network partitions"): this injector intercepts only OUTBOUND
+    # calls, so ``partition(a→b)`` is spelled by installing the rule
+    # on a's injector with b as the host pattern — the direction is
+    # WHERE the rule lives, following the partial-failure discipline
+    # of gray-failure fault injection (PAPERS.md arxiv 2108.11521).
+    # proc_cluster.ProcCluster.partition/netsplit drive these across
+    # real daemon subprocesses via the /faults endpoint.
+    def partition(self, host: str, method: str = "*") -> None:
+        """Cut THIS process's outbound link to ``host`` (fnmatch
+        pattern): every matching call fails with E_FAIL_TO_CONNECT
+        before reaching the wire, like a blackholed route.  Appending
+        (not replacing) preserves operator rules; journaled as
+        net.partitioned so chaos timelines read off /events."""
+        rule = FaultRule("blackhole", host=host, method=method,
+                         tag="partition")
+        with self._lock:
+            self._rules.append(rule)
+        from ..common.events import journal
+        journal.record("net.partitioned",
+                       detail=f"outbound {method}@{host} blackholed",
+                       host=host, method=method)
+
+    def heal(self, host: str = "*") -> None:
+        """Remove partition-tagged rules whose host pattern matches
+        ``host`` (default: all of them).  Operator-installed rules —
+        untagged — survive a heal."""
+        with self._lock:
+            before = len(self._rules)
+            self._rules = [
+                r for r in self._rules
+                if r.tag != "partition"
+                or not fnmatch.fnmatchcase(r.host, host)]
+            removed = before - len(self._rules)
+        if removed:
+            from ..common.events import journal
+            journal.record("net.healed",
+                           detail=f"{removed} link cut(s) to {host} "
+                                  f"removed", host=host)
+
+    def partitions(self) -> List[str]:
+        """Host patterns currently blackholed by partition rules."""
+        with self._lock:
+            return [r.host for r in self._rules if r.tag == "partition"]
 
     def dump(self) -> Dict[str, Any]:
         with self._lock:

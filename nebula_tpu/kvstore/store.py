@@ -14,10 +14,12 @@ single-replica — the mode metad's own store and unit tests use.
 from __future__ import annotations
 
 import os
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..common.flags import flags
 from ..common.status import ErrorCode, Status
 from ..interface.common import GraphSpaceID, HostAddr, PartitionID
 from .engine import KVEngine, MemEngine
@@ -48,6 +50,15 @@ class SpaceData:
         self.parts: Dict[PartitionID, Part] = {}
 
 
+flags.define("store_delta_log_cap", 4096,
+             "committed-mutation delta-log entries kept per space "
+             "(one per version bump).  A peer's delta cursor older "
+             "than the trim point forces its mirror onto the rebuild "
+             "path (tpu.peer_absorb decline reason "
+             "peer-cursor-truncated); chaos cells shrink it to force "
+             "that path deterministically (docs/durability.md)")
+
+
 class NebulaStore:
     def __init__(self, options: KVOptions, local_host: Optional[HostAddr] = None,
                  raft_service=None):
@@ -55,6 +66,16 @@ class NebulaStore:
         self.local_host = local_host
         self.raft_service = raft_service
         self.spaces: Dict[GraphSpaceID, SpaceData] = {}
+        # per-boot epoch: a peer streaming this store's delta log fuses
+        # it into its cursors (storage/device.py RemoteStoreView), so a
+        # restart — which resets/replays the version counter — can
+        # never be mistaken for a contiguous stream.  Random, not
+        # time-based: two restarts within one clock tick must differ.
+        # A PRIVATE Random instance: a harness seeding the module
+        # global for determinism (the events.py/_rng convention) must
+        # not make two boots draw the same epoch and void the restart
+        # detection.
+        self.boot_epoch = random.Random().getrandbits(30) or 1
         # per-space committed-write counter — the TPU runtime's CSR mirror
         # staleness check (tpu/runtime.py) compares this to its build
         # snapshot. Bumped from each Part's committed-batch listener (the
@@ -72,7 +93,7 @@ class NebulaStore:
         # mirror rebuild.  Bounded; trimming invalidates older cursors.
         self.delta_logs: Dict[GraphSpaceID, List] = {}
         self.delta_bases: Dict[GraphSpaceID, int] = {}
-        self.delta_cap = 4096
+        self.delta_cap = int(flags.get("store_delta_log_cap") or 4096)
         self._version_lock = threading.Lock()
         if options.part_man is not None:
             options.part_man.register_handler(self)
@@ -98,17 +119,46 @@ class NebulaStore:
         — ("put", key, value) | ("del", identity32) — or None when that
         range is unavailable (trimmed) or contains anything the event
         stream can't describe."""
+        events, _reason, _ver = self.delta_window(space_id, from_version)
+        return events
+
+    def delta_window(self, space_id: GraphSpaceID, from_version: int,
+                     upto: Optional[int] = None):
+        """The typed form of ``delta_since`` the peer-delta stream RPC
+        serves (storage/service.py rpc_deviceScanDelta): events for
+        versions in ``(from_version, upto]`` plus a machine-readable
+        decline reason and the version the events reach.  Returns
+        ``(events | None, reason, version)`` with reason one of
+
+          ok        events cover the window exactly
+          truncated the log trimmed past ``from_version`` — the
+                    peer's cursor names versions this store no longer
+                    holds (only a rebuild can re-anchor)
+          opaque    the window contains a mutation the event stream
+                    can't describe (ingest, compaction, partial
+                    remove, snapshot install)
+          ahead     ``from_version`` is beyond this store's current
+                    version — the cursor belongs to another boot or
+                    leadership history (gap by construction)
+
+        All three fields are sampled under ONE lock acquisition so the
+        returned version can never disagree with the events — the
+        consistency the peer's cursor re-anchoring depends on."""
         with self._version_lock:
+            cur = self.mutation_versions.get(space_id, 0)
+            end = cur if upto is None else min(int(upto), cur)
+            if from_version > cur:
+                return None, "ahead", cur
             base = self.delta_bases.get(space_id, 0)
             log = self.delta_logs.get(space_id, [])
             if from_version < base:
-                return None
+                return None, "truncated", end
             out = []
-            for entry in log[from_version - base:]:
+            for entry in log[from_version - base:end - base]:
                 if entry is None:
-                    return None
+                    return None, "opaque", end
                 out.extend(entry)
-            return out
+            return out, "ok", end
 
     # a remove_prefix whose prefix is a FULL edge identity
     # (part+src+etype+rank+dst, no version) deletes all versions of one

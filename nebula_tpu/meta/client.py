@@ -84,6 +84,16 @@ class MetaClient:
         # brief piggybacked on each heartbeat (storage/service.py
         # part_status_brief) so metad can answer SHOW PARTS lag columns
         self.hb_parts_provider = None
+        # optional callable -> {space: {"generation", "breaker_open"}}:
+        # per-space device-serving brief piggybacked on each heartbeat
+        # (storage/service.py device_status_brief); graphd's failover
+        # ladder reads it back via device_briefs() to prefer the
+        # freshest healthy replica (docs/durability.md)
+        self.hb_device_provider = None
+        # device-brief read cache (graphd side): one listDeviceBriefs
+        # round trip per heartbeat window, not per query
+        self._device_briefs: dict = {}
+        self._device_briefs_at = 0.0
         # event-journal piggyback cursor: entries with seq beyond this
         # already reached metad on an acked heartbeat
         self._event_seq = 0
@@ -277,6 +287,14 @@ class MetaClient:
                 ps = None           # must not stop liveness beats
             if ps:
                 payload["parts_status"] = ps
+        dev_provider = self.hb_device_provider
+        if dev_provider is not None:
+            try:
+                ds = dev_provider()
+            except Exception:       # noqa: BLE001 — same liveness stance
+                ds = None
+            if ds:
+                payload["device_status"] = ds
         # journal piggyback: events metad hasn't acked yet ride along;
         # the cursor only advances on an acked beat, and metad dedups
         # by event id, so a lost reply just re-sends
@@ -407,6 +425,35 @@ class MetaClient:
     def part_num(self, space_id: int) -> int:
         c = self.space_cache(space_id)
         return c.partition_num if c else 0
+
+    def device_briefs(self) -> Dict[str, dict]:
+        """{host: {space: {"generation", "breaker_open"}}} — the
+        heartbeat device briefs folded into metad's host table, cached
+        for one heartbeat window (the briefs can't be fresher than the
+        beats that carry them).  Advisory: any failure returns the
+        last snapshot (or {}), never raises — the failover ladder
+        orders replicas fine without freshness hints."""
+        import time as _time
+        ttl = float(flags.get("heartbeat_interval_secs", 10) or 10)
+        with self._cache_lock:
+            if _time.monotonic() - self._device_briefs_at <= ttl:
+                return dict(self._device_briefs)
+        try:
+            resp = self._call("listDeviceBriefs", {})
+            briefs = {str(h): dict(b) for h, b in
+                      (resp.get("briefs") or {}).items()}
+        except RpcError:
+            # negative-cache the failure for one window too: while
+            # metad is unreachable, every device-path query would
+            # otherwise pay the full meta retry/backoff budget inside
+            # placement (the briefs are advisory — stale is fine)
+            with self._cache_lock:
+                self._device_briefs_at = _time.monotonic()
+                return dict(self._device_briefs)
+        with self._cache_lock:
+            self._device_briefs = briefs
+            self._device_briefs_at = _time.monotonic()
+            return dict(briefs)
 
     def parts_alloc(self, space_id: int) -> Dict[int, List[str]]:
         c = self.space_cache(space_id)

@@ -392,6 +392,22 @@ class MetaService:
         return {"hosts": [{"host": h, "status": "online" if h in active else "offline"}
                           for h in sorted(hosts)]}
 
+    def rpc_listDeviceBriefs(self, req: dict) -> dict:
+        """Per-host device-serving briefs (heartbeat ``device_status``):
+        {host: {space: {"generation", "breaker_open"}}} for every
+        ACTIVE host — graphd's replica failover ladder orders replicas
+        by freshness/health from this one cheap read instead of
+        scraping every storaged's /healthz (docs/durability.md)."""
+        active = set(self.active_hosts.active_hosts())
+        briefs = {}
+        for host, rec in self.active_hosts.hosts().items():
+            if host not in active:
+                continue
+            ds = rec.get("device_status")
+            if ds:
+                briefs[host] = ds
+        return {"briefs": briefs}
+
     # ================= heartbeat (admin/HBProcessor) =================
     def rpc_heartBeat(self, req: dict) -> dict:
         dur = Duration()
@@ -404,6 +420,11 @@ class MetaService:
         # table instead of scraping every storaged
         if "parts_status" in req:
             info["parts_status"] = req["parts_status"]
+        # per-space device-serving brief (mirror generation + breaker
+        # state) — graphd's failover ladder reads it back through
+        # listDeviceBriefs to prefer the freshest healthy replica
+        if "device_status" in req:
+            info["device_status"] = req["device_status"]
         self.active_hosts.update_host(req["host"], info or None)
         # recent journal entries ride the heartbeat; the cluster store
         # dedups on event id, so re-sends after a failed beat are safe

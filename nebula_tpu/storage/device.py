@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..filter.expressions import encode_expr
 from ..graph.interim import InterimResult
@@ -45,15 +46,47 @@ class TpuDecline(Exception):
     completeness < 100 so operators see the degradation on the query
     surface, not only on /metrics (docs/durability.md)."""
 
-    def __init__(self, msg: str = "", degraded: bool = False):
+    def __init__(self, msg: str = "", degraded: bool = False,
+                 retriable: bool = False):
         super().__init__(msg)
         self.degraded = degraded
+        # the replica that raised this decline (tagged by the failover
+        # ladder) — negative caches blame it, not the preferred rung
+        self.host = None
+        # ``retriable=True`` marks declines another REPLICA of the same
+        # parts might serve (transport failure, degraded runtime, open
+        # breaker) — the failover ladder retries those on the next
+        # healthy replica before falling back to the CPU loop
+        # (docs/durability.md "The failover ladder").  Semantic
+        # declines (can't-serve-this-query) repeat identically on
+        # every replica and go straight to the CPU path.
+        self.retriable = retriable
 
 
 class DeviceExecError(Exception):
     """A real query error on the storaged-side device path (schema
     drift mid-query, per-row missing props under graphd WHERE
     semantics) — maps to ExecutionResponse error, NOT a CPU fallback."""
+
+
+# ------------------------------------------------------- failover ladder
+flags.define("device_failover_replicas", 3,
+             "replicas of the SAME parts graphd tries per device query "
+             "before falling back to the CPU loop: on a degraded "
+             "decline (device-runtime failure / open breaker) or a "
+             "transport failure, the next-freshest healthy replica "
+             "retries the query; 1 disables the ladder "
+             "(docs/durability.md \"The failover ladder\")")
+flags.define("device_decline_ttl_s", 15.0,
+             "seconds a replica that answered degraded (or was "
+             "unreachable) is deprioritized in the failover ladder "
+             "before graphd probes it again — the UPTO-style TTL'd "
+             "per-(host, space) decline cache")
+
+stats.register_stats("graph.device_failover.retries")
+stats.register_stats("graph.device_failover.served")
+stats.register_stats("graph.device_failover.exhausted")
+stats.register_stats("graph.device_failover.decline_skips")
 
 
 # ---------------------------------------------------------------- breaker
@@ -274,6 +307,31 @@ class _LedPartStub:
         return True
 
 
+# ---------------------------------------------------------- peer deltas
+# Fused peer-version encoding (docs/durability.md "The peer-delta
+# cursor protocol"): a RemoteStoreView reports (boot epoch, led-set
+# generation, mutation version) fused into ONE integer, so the
+# runtime's per-store delta cursors — plain ints captured at publish —
+# carry the peer's whole stream identity.  A restart or a leadership
+# move changes the fused value (staleness detected even when the
+# replayed version counter lands on the same number), and delta_since
+# decodes the anchor back out to type the decline exactly.
+_LED_MOD = 1 << 14
+_VER_MOD = 1 << 34
+
+
+def fuse_peer_version(epoch: int, led_gen: int, version: int) -> int:
+    return ((int(epoch) * _LED_MOD + int(led_gen) % _LED_MOD)
+            * _VER_MOD + int(version) % _VER_MOD)
+
+
+def split_peer_version(fused: int):
+    """(epoch, led_gen, version) back out of a fused cursor."""
+    return (int(fused) // (_LED_MOD * _VER_MOD),
+            (int(fused) // _VER_MOD) % _LED_MOD,
+            int(fused) % _VER_MOD)
+
+
 class RemoteStoreView:
     """Store-shaped READ view of one peer storaged's led parts, backing
     the multi-host CSR mirror fold (VERDICT round-2 missing #1): the
@@ -286,28 +344,42 @@ class RemoteStoreView:
     time, which is what lets the whole multi-hop loop stay in one
     device dispatch.
 
-    Consistency contract: the mirror rebuilds when any peer's polled
-    version moves (remote deltas are never incremental — delta_since
-    returns None, which the absorb path reports as an OBSERVABLE
-    `opaque-events` decline before taking the rebuild:
-    runtime._absorb_once), so device results lag a peer's writes by
-    at most one version poll — the same bounded staleness the
-    reference accepts from its 120 s meta cache refresh
-    (MetaClient.cpp:13-14).  Locally-led writes on the serving host
-    itself DO absorb incrementally; streaming peer delta logs over
-    this seam is the natural next shrink (ROADMAP item 5)."""
+    Consistency contract: a peer's committed writes STREAM over the
+    ``deviceScanDelta`` RPC as monotonically-sequenced typed events
+    (ROADMAP item 5 landed): ``delta_since`` fetches exactly the
+    ``(cursor, polled-version]`` window, so the runtime folds peer
+    writes through ``ell_absorb`` at O(delta) the same way locally-led
+    writes absorb.  Any break in the stream — peer restart (epoch),
+    leadership move (led_gen), trimmed log, opaque window, cursor gap
+    — is detected from the fused cursor + the peer's typed verdict and
+    surfaces as a ``mirror.absorb_failed`` reason (peer-*) that
+    degrades to the existing background rebuild; the rebuild's publish
+    re-anchors the cursor at the scan snapshot and absorption resumes
+    (re-subscribe is implicit: the next delta window continues from
+    the fresh anchor)."""
 
     POLL_REUSE_S = 0.02
     RPC_TIMEOUT_S = 10.0    # a hung peer fails the build fast instead of
                             # stalling the rebuilding space for 30 s/call
+    is_remote = True        # the absorb path labels peer windows with
+                            # this (tpu.peer_absorb.* accounting)
 
     def __init__(self, host: HostAddr, space_id: int, client_manager):
         self.host = host
         self.space_id = space_id
         self.cm = client_manager
         self._led: List[int] = []
-        self._version = -1
+        self._version = -1          # raw peer mutation version
+        self._epoch = 0
+        self._led_gen = 0
         self._polled_at = 0.0
+        # delta-stream health for the /healthz peer_mirror check
+        # (storage/web.py): when the subscribed cursor last advanced
+        # to the peer's published version, and since when it has been
+        # wedged (typed declines / unreachable peer) while the peer's
+        # version sat ahead of it
+        self.last_delta_decline: Optional[str] = None
+        self._stalled_since = 0.0
 
     def refresh(self) -> bool:
         """Poll version + led parts; False when the peer is down."""
@@ -322,7 +394,14 @@ class RemoteStoreView:
             return False
         self._led = [int(p) for p in resp.get("led_parts", [])]
         self._version = int(resp.get("version", 0))
+        self._epoch = int(resp.get("epoch") or 0)
+        self._led_gen = int(resp.get("led_gen") or 0)
         self._polled_at = time.monotonic()
+        if self.last_delta_decline == "peer-unreachable":
+            # the peer is back; an unreachable-stall must not outlive
+            # the outage (typed STREAM breaks instead clear when the
+            # rebuild's full scan completes — prefix() below)
+            self._note_advanced()
         return True
 
     # ---- store-shaped surface (what build_mirror + runtime touch) ----
@@ -339,19 +418,106 @@ class RemoteStoreView:
         # second identical round-trip per query.  Any poll taken after
         # a committed write sees it, so reuse never hides one
         if time.monotonic() - self._polled_at <= self.POLL_REUSE_S:
-            return self._version
+            return fuse_peer_version(self._epoch, self._led_gen,
+                                     self._version)
         if not self.refresh():
             # an unreachable peer must FAIL the version check / mirror
             # build (callers decline to the CPU path) — quietly
             # reporting an empty led set would let build_mirror publish
             # a partial mirror and serve incomplete rows as success
+            self._note_stalled("peer-unreachable")
             raise RpcError(Status(
                 ErrorCode.E_FAIL_TO_CONNECT,
                 f"peer {self.host} unreachable for device mirror"))
-        return self._version
+        return fuse_peer_version(self._epoch, self._led_gen,
+                                 self._version)
+
+    def _note_stalled(self, reason: str) -> None:
+        self.last_delta_decline = reason
+        if self._stalled_since == 0.0:
+            self._stalled_since = time.monotonic()
+
+    def _note_advanced(self) -> None:
+        self.last_delta_decline = None
+        self._stalled_since = 0.0
+
+    def stalled_for_s(self) -> float:
+        """Seconds the subscribed delta cursor has been wedged behind
+        the peer's published version (0.0 = healthy / idle) — the
+        /healthz peer_mirror probe's signal (storage/web.py)."""
+        if self._stalled_since == 0.0:
+            return 0.0
+        return time.monotonic() - self._stalled_since
 
     def delta_since(self, space_id: int, from_version: int):
-        return None                  # remote deltas: always rebuild
+        """Streamed peer-delta window: typed events covering
+        ``(anchor, polled-version]`` over the ``deviceScanDelta`` RPC,
+        or None with ``last_delta_decline`` typed (peer-restarted /
+        peer-leader-changed / peer-cursor-truncated /
+        peer-opaque-events / peer-cursor-gap / peer-unreachable /
+        peer-unsupported) — the absorb path journals the reason and
+        degrades to the background rebuild, which re-anchors the
+        cursor at its scan snapshot."""
+        from ..common import tracing
+        epoch_c, led_gen_c, ver_c = split_peer_version(from_version)
+        # SNAPSHOT the polled identity once: the view is shared across
+        # query threads and a concurrent refresh() (serving gate /
+        # another absorb) may re-poll mid-window — comparing against
+        # moving fields would fabricate gap declines
+        epoch_now, led_gen_now = self._epoch, self._led_gen
+        upto = self._version
+        # compare the ANCHOR identity against the freshly polled one:
+        # any mismatch means events after ver_c belong to a different
+        # history (reboot) or part membership (leadership move) and
+        # can never be contiguous with the anchor
+        if epoch_c != epoch_now:
+            self._note_stalled("peer-restarted")
+            return None
+        # the cursor carries led_gen modulo _LED_MOD — compare in the
+        # same ring, or a peer whose led set changed 2^14+ times would
+        # mismatch forever (every window paying the rebuild)
+        if led_gen_c != led_gen_now % _LED_MOD:
+            self._note_stalled("peer-leader-changed")
+            return None
+        with tracing.span("tpu.peer_absorb", space=space_id,
+                          peer=str(self.host)) as sp:
+            try:
+                resp = self.cm.call(self.host, "deviceScanDelta", {
+                    "space_id": space_id, "cursor": ver_c,
+                    "upto": upto, "epoch": epoch_c,
+                    "led_gen": led_gen_c}, timeout=self.RPC_TIMEOUT_S)
+            except RpcError as e:
+                reason = ("peer-unsupported"
+                          if e.status.code == ErrorCode.E_UNSUPPORTED
+                          else "peer-unreachable")
+                self._note_stalled(reason)
+                stats.add_value("tpu.peer_absorb.stream_errors")
+                if sp is not None:
+                    sp.tag(ok=False, reason=reason)
+                return None
+            if not resp.get("ok"):
+                reason = str(resp.get("reason") or "peer-opaque-events")
+                self._note_stalled(reason)
+                stats.add_value("tpu.peer_absorb.declines")
+                if sp is not None:
+                    sp.tag(ok=False, reason=reason)
+                return None
+            if int(resp.get("version", -1)) != upto:
+                # the peer served a different window than requested
+                # (its version regressed below the poll — a history
+                # break the epoch check should normally catch first):
+                # events and cursor would disagree — typed gap, the
+                # rebuild re-anchors
+                self._note_stalled("peer-cursor-gap")
+                if sp is not None:
+                    sp.tag(ok=False, reason="peer-cursor-gap")
+                return None
+            events = [tuple(e) for e in resp.get("events", [])]
+            self._note_advanced()
+            stats.add_value("tpu.peer_absorb.windows")
+            if sp is not None:
+                sp.tag(ok=True, events=len(events))
+            return events
 
     def prefix(self, space_id: int, part_id: int, prefix: bytes):
         """Chunk-streamed remote scan; raises RpcError on peer failure
@@ -386,6 +552,12 @@ class RemoteStoreView:
             for k, v in resp["rows"]:
                 yield k, v
             if resp.get("done"):
+                # a completed full scan is the rebuild re-anchoring the
+                # delta cursor at this snapshot: whatever wedged the
+                # stream (truncation, leadership move, restart) is
+                # reconciled once the build publishes — clear the
+                # /healthz peer_mirror stall (re-subscribe is implicit)
+                self._note_advanced()
                 return
             cursor = resp.get("cursor")
 
@@ -415,27 +587,67 @@ class RemoteDeviceRuntime:
         # space probes UPTO again without waiting out the TTL or
         # restarting graphd (ADVICE.md round 5)
         self._upto_declined: Dict[int, Tuple[float, str, int]] = {}
+        # failover-ladder decline cache, the UPTO style made per
+        # (space, host): a replica that answered degraded (or was
+        # unreachable) is deprioritized until its TTL lapses, so every
+        # query in the window rides a healthy replica WITHOUT paying
+        # the sick one's round trip first (docs/durability.md
+        # "The failover ladder")
+        self._dev_declined: Dict[Tuple[int, str], float] = {}
 
     # ------------------------------------------------------------ placement
-    def _device_host(self, space_id: int
-                     ) -> Optional[Tuple[HostAddr, List[int]]]:
-        """The storaged that should device-serve this space: the host
-        assigned the MOST parts (fewest remote-part scans for its
-        mirror fold).  Multi-host spaces serve too — the chosen host
-        composes peer parts through RemoteStoreView; if it can't cover
-        the space (peer down, leadership moved) it declines and the CPU
-        scatter-gather path answers."""
+    def _dev_decline_active(self, space_id: int, host: str) -> bool:
+        exp = self._dev_declined.get((space_id, host))
+        if exp is None:
+            return False
+        if time.monotonic() >= exp:
+            self._dev_declined.pop((space_id, host), None)
+            return False
+        return True
+
+    def _note_dev_declined(self, space_id: int, host: str) -> None:
+        ttl = float(flags.get("device_decline_ttl_s") or 15.0)
+        self._dev_declined[(space_id, host)] = time.monotonic() + ttl
+
+    def _device_hosts(self, space_id: int
+                      ) -> List[Tuple[HostAddr, List[int]]]:
+        """The replica failover ladder: every storaged holding parts
+        of the space can device-serve it (each composes the peers' led
+        parts through RemoteStoreView), ordered by preference —
+        healthy before breaker-open, freshest device generation first
+        (both from the heartbeat device briefs metad folds into the
+        host table), most locally-held parts next (fewest remote-part
+        streams for its mirror fold).  Hosts inside an active decline
+        window sort LAST, not out: when every replica is sick the
+        primary still gets one probe before the CPU loop answers."""
         alloc = self.meta.parts_alloc(space_id)
         if not alloc:
-            return None
+            return []
         counts: Dict[str, int] = {}
         for peers in alloc.values():
             for h in peers:
                 counts[h] = counts.get(h, 0) + 1
         if not counts:
-            return None
-        best = max(sorted(counts), key=lambda h: counts[h])
-        return HostAddr.parse(best), sorted(alloc.keys())
+            return []
+        briefs = {}
+        briefs_fn = getattr(self.meta, "device_briefs", None)
+        if briefs_fn is not None:
+            try:
+                briefs = briefs_fn() or {}
+            except Exception:   # noqa: BLE001 — briefs are advisory;
+                briefs = {}     # placement still works without them
+        parts = sorted(alloc.keys())
+
+        def rank(h: str):
+            b = (briefs.get(h) or {}).get(str(space_id)) \
+                or (briefs.get(h) or {}).get(space_id) or {}
+            return (self._dev_decline_active(space_id, h),  # healthy 1st
+                    bool(b.get("breaker_open")),    # closed breakers
+                    -int(b.get("generation") or 0),  # freshest mirror
+                    -counts[h],                     # most local parts
+                    h)                              # deterministic tie
+        return [(HostAddr.parse(h), parts) for h in
+                sorted(counts, key=rank)]
 
     # ------------------------------------------------- UPTO negative cache
     def _upto_decline_active(self, space_id: int, host) -> bool:
@@ -474,8 +686,11 @@ class RemoteDeviceRuntime:
                 # the budget is gone — falling back to the CPU loop
                 # would spend MORE time the query no longer has
                 raise DeadlineExceeded(e.status.msg) from e
-            # storaged down / old build without the method — CPU path
-            raise TpuDecline(f"{method} rpc failed: {e.status.msg}")
+            # storaged down / partitioned away / old build without the
+            # method — retriable: another replica of the same parts
+            # may still serve on the device (the failover ladder)
+            raise TpuDecline(f"{method} rpc failed: {e.status.msg}",
+                             retriable=True)
         if not resp.get("ok"):
             if resp.get("code") == int(ErrorCode.E_DEADLINE_EXCEEDED):
                 # storaged-side admission shed / expiry: typed fast
@@ -492,10 +707,53 @@ class RemoteDeviceRuntime:
                 raise ExecError(resp["error"])
             # a degraded decline (device runtime failure / open breaker
             # on the storaged) keeps its class across the wire so the
-            # executor's CPU fallback surfaces the degradation
+            # executor's CPU fallback surfaces the degradation — and is
+            # retriable: a healthy replica of the same parts can serve
             raise TpuDecline(resp.get("reason", "declined"),
-                             degraded=bool(resp.get("degraded")))
+                             degraded=bool(resp.get("degraded")),
+                             retriable=bool(resp.get("degraded")
+                                            or resp.get("retriable")))
         return resp
+
+    def _ladder_call(self, space_id: int, ladder, method: str,
+                     req: dict, ExecError) -> dict:
+        """One device query down the replica failover ladder
+        (docs/durability.md): try each replica in preference order;
+        a RETRIABLE decline (transport failure, degraded runtime, open
+        breaker) notes the replica in the TTL'd decline cache and
+        moves to the next rung; anything else — semantic declines,
+        query errors, deadline/shed — propagates immediately (tagged
+        with the declining host so callers' negative caches blame the
+        right replica).  The FIRST rung is always probed; later rungs
+        inside an active decline window are skipped — a fleet-wide
+        outage costs one failed RPC per query for the TTL, not one
+        per rung.  Only when every live rung declined does the
+        (degraded) decline reach the executor's CPU fallback."""
+        max_r = max(1, int(flags.get("device_failover_replicas") or 1))
+        last: Optional[TpuDecline] = None
+        for i, (host, _parts) in enumerate(ladder[:max_r]):
+            if i > 0 and self._dev_decline_active(space_id, str(host)):
+                stats.add_value("graph.device_failover.decline_skips")
+                continue
+            if i > 0:
+                stats.add_value("graph.device_failover.retries")
+            try:
+                resp = self._call(host, method, req, ExecError)
+            except TpuDecline as d:
+                d.host = host
+                if not d.retriable:
+                    raise
+                self._note_dev_declined(space_id, str(host))
+                last = d
+                continue
+            if i > 0:
+                # a replica served what the preferred host could not —
+                # the ladder paid for itself (the soak's proof counter)
+                stats.add_value("graph.device_failover.served")
+            return resp, host
+        stats.add_value("graph.device_failover.exhausted")
+        raise last if last is not None else TpuDecline(
+            "space has no device placement")
 
     # ------------------------------------------------------------ GO
     def can_run_go(self, space_id: int, etypes, sentence, pushed,
@@ -504,8 +762,8 @@ class RemoteDeviceRuntime:
             return False
         if has_input:      # per-root $-/$var inputs never run on device
             return False
-        placement = self._device_host(space_id)
-        if placement is None:
+        ladder = self._device_hosts(space_id)
+        if not ladder:
             return False
         # UPTO rides the cumulative-frontier kernels; the remote
         # runtime declines if ITS mesh config or build can't serve it
@@ -515,9 +773,9 @@ class RemoteDeviceRuntime:
         # or re-placed storaged out of UPTO traffic forever
         if getattr(sentence.step, "upto", False) \
                 and sentence.step.steps > 1 \
-                and self._upto_decline_active(space_id, placement[0]):
+                and self._upto_decline_active(space_id, ladder[0][0]):
             return False
-        self._stash[id(sentence)] = (pushed is not None, placement)
+        self._stash[id(sentence)] = (pushed is not None, ladder)
         return True
 
     def run_go(self, executor, space_id: int, start_vids: List[int],
@@ -527,13 +785,13 @@ class RemoteDeviceRuntime:
                upto: bool = False, reduce=None) -> InterimResult:
         from ..graph.executors.base import ExecError
 
-        pushed_mode, placement = self._stash.pop(
+        pushed_mode, ladder = self._stash.pop(
             id(executor.sentence), (False, None))
-        if placement is None:
-            placement = self._device_host(space_id)
-        if placement is None:
-            raise TpuDecline("space is not single-host placed")
-        host, parts = placement
+        if ladder is None:
+            ladder = self._device_hosts(space_id)
+        if not ladder:
+            raise TpuDecline("space has no device placement")
+        parts = ladder[0][1]
         try:
             yspecs = [[encode_expr(c.expr), c.alias] for c in yield_cols]
             wblob = (encode_expr(where_expr)
@@ -563,12 +821,18 @@ class RemoteDeviceRuntime:
             # application is proven by the "reduce" echo below
             req["reduce"] = list(reduce)
         try:
-            resp = self._call(host, "deviceGo", req, ExecError)
-        except TpuDecline:
+            resp, host = self._ladder_call(space_id, ladder, "deviceGo",
+                                           req, ExecError)
+        except TpuDecline as d:
             if upto:
                 # mesh-sharded there / older build: don't re-pay this
-                # round trip for the space's next UPTO query
-                self._note_upto_declined(space_id, host)
+                # round trip for the space's next UPTO query.  The
+                # decline is blamed on the replica that RAISED it
+                # (_ladder_call tags it), not on the preferred rung —
+                # a healthy primary must not inherit a stale replica's
+                # UPTO incapability
+                self._note_upto_declined(
+                    space_id, getattr(d, "host", ladder[0][0]))
             raise
         if upto and resp.get("upto") is not True:
             # version skew: an older storaged ignores the upto field
@@ -597,7 +861,10 @@ class RemoteDeviceRuntime:
     def can_run_path(self, space_id: int, etypes: List[int]) -> bool:
         if flags.get("storage_backend") == "cpu":
             return False
-        return self._device_host(space_id) is not None
+        # placement existence only — run_find_path builds the (brief-
+        # ranked) ladder once; building it here too would double the
+        # rank sort + briefs copies on every FIND PATH
+        return bool(self.meta.parts_alloc(space_id))
 
     def run_find_path(self, executor, space_id: int, srcs: List[int],
                       dsts: List[int], etypes: List[int], max_steps: int,
@@ -605,13 +872,12 @@ class RemoteDeviceRuntime:
                       ) -> InterimResult:
         from ..graph.executors.base import ExecError
 
-        placement = self._device_host(space_id)
-        if placement is None:
-            raise TpuDecline("space is not single-host placed")
-        host, parts = placement
+        ladder = self._device_hosts(space_id)
+        if not ladder:
+            raise TpuDecline("space has no device placement")
         req = {
             "space_id": space_id,
-            "parts": parts,
+            "parts": ladder[0][1],
             "srcs": list(srcs),
             "dsts": list(dsts),
             "etypes": list(etypes),
@@ -619,6 +885,7 @@ class RemoteDeviceRuntime:
             "shortest": bool(shortest),
             "etype_names": {int(k): v for k, v in etype_names.items()},
         }
-        resp = self._call(host, "deviceFindPath", req, ExecError)
+        resp, _host = self._ladder_call(space_id, ladder,
+                                        "deviceFindPath", req, ExecError)
         return InterimResult(list(resp["columns"]),
                              [list(r) for r in resp["rows"]])

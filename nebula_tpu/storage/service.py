@@ -65,6 +65,11 @@ class StorageService:
         self._device_rt_lock = OrderedLock("storage.device_rt")
         self._remote_views: Dict = {}   # (space_id, host_str) -> view
         self._device_fail_log: Dict = {}  # (method, exc type) -> last log
+        # per-space led-part-set generation: peers fuse it into their
+        # delta cursors (storage/device.py) so a leadership change
+        # between two delta windows surfaces as a TYPED decline
+        # (peer-leader-changed) instead of silently-wrong events
+        self._led_gens: Dict[int, tuple] = {}  # space -> (led tuple, gen)
         stats.register_histogram("storage.get_bound.latency_us")
         stats.register_histogram("storage.add.latency_us")
         stats.register_stats("storage.qps")
@@ -356,18 +361,80 @@ class StorageService:
                 f"[storage] {method} device failure — queries fall back "
                 f"to the CPU path: {type(exc).__name__}: {exc}\n")
 
-    def rpc_deviceVersion(self, req: dict) -> dict:
-        """Peer poll for multi-host mirror staleness: this host's
-        mutation counter for the space plus the parts it currently
-        leads (RemoteStoreView.refresh)."""
-        space_id = int(req["space_id"])
+    def _led_snapshot(self, space_id: int):
+        """(led part ids, led-set generation): the generation bumps
+        whenever the set of parts this host leads for the space
+        changes, and peers fuse it into their delta cursors — a
+        leadership move between two delta windows types the next
+        absorb decline as peer-leader-changed (docs/durability.md
+        "The peer-delta cursor protocol")."""
         led = []
         for pid in self.kv.part_ids(space_id):
             p = self.kv.part(space_id, pid)
             if p is not None and p.is_leader():
                 led.append(int(pid))
+        key = tuple(sorted(led))
+        with self._device_rt_lock:
+            cur = self._led_gens.get(space_id)
+            if cur is None:
+                cur = self._led_gens[space_id] = (key, 1)
+            elif cur[0] != key:
+                cur = self._led_gens[space_id] = (key, cur[1] + 1)
+        return led, cur[1]
+
+    def rpc_deviceVersion(self, req: dict) -> dict:
+        """Peer poll for multi-host mirror staleness: this host's
+        mutation counter for the space plus the parts it currently
+        leads (RemoteStoreView.refresh).  ``epoch`` (per boot) and
+        ``led_gen`` (per led-set change) ride along so the peer's
+        fused cursor detects restarts and leadership moves between
+        delta windows."""
+        space_id = int(req["space_id"])
+        led, led_gen = self._led_snapshot(space_id)
         return {"version": self.kv.mutation_version(space_id),
-                "led_parts": led}
+                "led_parts": led,
+                "epoch": getattr(self.kv, "boot_epoch", 1),
+                "led_gen": led_gen}
+
+    def rpc_deviceScanDelta(self, req: dict) -> dict:
+        """Peer-delta stream: the typed committed-mutation window
+        ``(cursor, upto]`` of this host's delta log, so a peer's
+        RemoteStoreView-backed mirror folds this host's writes through
+        ell_absorb at O(delta) instead of re-scanning every led part
+        at O(m) (ROADMAP item 5; docs/durability.md "The peer-delta
+        cursor protocol").  The peer's cursor names (epoch, led_gen,
+        version); any mismatch with this host's current identity is a
+        TYPED decline the peer turns into a mirror.absorb_failed
+        reason and a background rebuild:
+
+          peer-restarted       epoch moved (this process rebooted —
+                               its version counter is a new history)
+          peer-leader-changed  the led-part set changed (events alone
+                               cannot fix part membership)
+          peer-cursor-truncated / peer-opaque-events / peer-cursor-gap
+                               the store's own window verdicts
+        """
+        space_id = int(req["space_id"])
+        epoch = getattr(self.kv, "boot_epoch", 1)
+        if int(req.get("epoch") or 0) != epoch:
+            return {"ok": False, "reason": "peer-restarted"}
+        _led, led_gen = self._led_snapshot(space_id)
+        # peers carry led_gen modulo the fused-cursor ring
+        # (storage/device.py _LED_MOD) — compare in that ring
+        from .device import _LED_MOD
+        if int(req.get("led_gen") or 0) != led_gen % _LED_MOD:
+            return {"ok": False, "reason": "peer-leader-changed"}
+        events, reason, ver = self.kv.delta_window(
+            space_id, int(req["cursor"]), upto=req.get("upto"))
+        if events is None:
+            wire_reason = {"truncated": "peer-cursor-truncated",
+                           "opaque": "peer-opaque-events",
+                           "ahead": "peer-cursor-gap"}.get(
+                               reason, "peer-opaque-events")
+            return {"ok": False, "reason": wire_reason}
+        stats.add_value("tpu.peer_absorb.windows_served")
+        return {"ok": True, "events": [list(e) for e in events],
+                "version": ver}
 
     def rpc_deviceScan(self, req: dict) -> dict:
         """Chunked raw KV scan of one locally-led part — the transport
@@ -409,7 +476,10 @@ class StorageService:
         from .device import DeviceExecError, TpuDecline
         reason = self._device_gate(req["space_id"], req.get("parts", []))
         if reason is not None:
-            return {"ok": False, "reason": reason}
+            # coverage gaps are RETRIABLE: this host can't reach every
+            # part, but another replica one RPC away may (asymmetric
+            # partitions — the failover ladder's gray-failure case)
+            return {"ok": False, "reason": reason, "retriable": True}
         try:
             columns, rows = self._device_runtime().serve_go(
                 space_id=int(req["space_id"]),
@@ -449,9 +519,10 @@ class StorageService:
                 resp["shed"] = True
             return resp
         except Exception as e:      # noqa: BLE001 — device-infra failure
-            # (jax missing/broken, HBM OOM, ...): decline so graphd's
-            # CPU per-hop loop still answers the query — but loudly, or
-            # a permanently broken device path would be invisible
+            # (jax missing/broken, HBM OOM, unreachable peer, ...):
+            # decline so graphd's CPU per-hop loop still answers the
+            # query — but loudly, or a permanently broken device path
+            # would be invisible
             from .device import classify_device_failure
             self._log_device_failure("deviceGo", e)
             stats.add_value("storage.device_decline.qps")
@@ -459,6 +530,10 @@ class StorageService:
                     "reason": f"device failure: {type(e).__name__}: {e}"}
             if classify_device_failure(e) is not None:
                 resp["degraded"] = True
+            if isinstance(e, RpcError):
+                # a peer this host can't reach mid-build/poll: another
+                # replica with a healthy link may serve the same parts
+                resp["retriable"] = True
             return resp
         stats.add_value("storage.device_go.qps")
         resp = {"ok": True, "columns": columns, "rows": rows}
@@ -479,7 +554,8 @@ class StorageService:
         from .device import DeviceExecError, TpuDecline
         reason = self._device_gate(req["space_id"], req.get("parts", []))
         if reason is not None:
-            return {"ok": False, "reason": reason}
+            # retriable, as in rpc_deviceGo: another replica may cover
+            return {"ok": False, "reason": reason, "retriable": True}
         try:
             columns, rows = self._device_runtime().serve_find_path(
                 space_id=int(req["space_id"]),
@@ -512,6 +588,8 @@ class StorageService:
                     "reason": f"device failure: {type(e).__name__}: {e}"}
             if classify_device_failure(e) is not None:
                 resp["degraded"] = True
+            if isinstance(e, RpcError):
+                resp["retriable"] = True
             return resp
         stats.add_value("storage.device_path.qps")
         return {"ok": True, "columns": columns, "rows": rows}
@@ -607,6 +685,46 @@ class StorageService:
                     "role": st["role"], "term": st["term"],
                     "committed": st["committed"],
                     "last_log_id": st["last_log_id"]}
+        return out
+
+    def device_status_brief(self) -> Dict[str, dict]:
+        """Per-space device-serving brief piggybacked on heartbeats
+        (meta/client.py hb_device_provider): the serving runtime's
+        mirror generation (freshness) and whether any breaker cell for
+        the space is OPEN.  metad folds it into the host table and
+        graphd's failover ladder reads it back (listDeviceBriefs) to
+        prefer the freshest HEALTHY replica (docs/durability.md
+        "The failover ladder")."""
+        with self._device_rt_lock:
+            rt = self._device_rt
+        out: Dict[str, dict] = {}
+        if rt is not None:
+            with rt._lock:
+                mirrors = {sid: getattr(m, "generation", 0)
+                           for sid, m in rt.mirrors.items()}
+            for sid, gen in mirrors.items():
+                out[str(sid)] = {"generation": int(gen),
+                                 "breaker_open": False}
+        for key, state, _reason in self.breaker_snapshot():
+            if state != "open":
+                continue
+            ent = out.setdefault(str(key[0]),
+                                 {"generation": 0, "breaker_open": False})
+            ent["breaker_open"] = True
+        return out
+
+    def peer_mirror_stalls(self):
+        """[(space_id, peer host, stalled seconds, typed reason)] for
+        every subscribed peer-delta stream currently wedged — the
+        /healthz peer_mirror probe's source (storage/web.py)."""
+        with self._device_rt_lock:
+            views = list(self._remote_views.items())
+        out = []
+        for (space_id, host), v in views:
+            s = v.stalled_for_s()
+            if s > 0.0:
+                out.append((space_id, host, s,
+                            v.last_delta_decline or "stalled"))
         return out
 
     def breaker_snapshot(self):

@@ -125,6 +125,25 @@ def _breaker_health(node):
     return True, f"{len(cells)} breaker cell(s), none open"
 
 
+def _peer_mirror_health(node):
+    """Healthz: no subscribed peer-delta stream wedged.  A cursor that
+    has not advanced past a peer's published version for more than two
+    poll windows (heartbeat_interval_secs each) means the mirror is
+    serving stale rows and every absorb window is declining — a
+    503-worthy degradation operators (and the failover ladder, via the
+    degraded /healthz) should see BEFORE queries do
+    (docs/durability.md "The peer-delta cursor protocol")."""
+    from ..common.flags import flags
+    window_s = float(flags.get("heartbeat_interval_secs", 10) or 10)
+    stalls = node.service.peer_mirror_stalls()
+    wedged = [f"space {sid} peer {host}: {reason} for {s:.1f}s"
+              for sid, host, s, reason in stalls if s > 2 * window_s]
+    if wedged:
+        return False, "peer delta stream wedged — " + "; ".join(
+            sorted(wedged))
+    return True, f"{len(stalls)} stream(s) catching up, none wedged"
+
+
 def _parts_serving(node):
     """Healthz: every hosted partition exists and (when replicated)
     knows a raft leader — a part mid-election or mid-snapshot can't
@@ -162,3 +181,8 @@ def register_web_handlers(ws, node) -> None:
     # (queries keep answering via the CPU fallback — docs/durability.md)
     ws.register_health_check("device_breaker",
                              lambda: _breaker_health(node))
+    # degradation signal: 503 while a subscribed peer-delta stream is
+    # wedged (cursor not advancing past a peer's published version for
+    # > 2 poll windows) — the mirror is stale-serving and rebuilding
+    ws.register_health_check("peer_mirror",
+                             lambda: _peer_mirror_health(node))

@@ -564,7 +564,8 @@ def _prom_value(text: str, family: str, label: str = "") -> float:
 def bench_write_serve(results: list, duration_s: float = 180.0,
                       n_vertices: int = 120, writers: int = 2,
                       readers: int = 6, chaos: bool = True,
-                      run_dir: Optional[str] = None) -> dict:
+                      run_dir: Optional[str] = None,
+                      num_storage: int = 1) -> dict:
     """Write-while-serve soak (ISSUE 11 acceptance): bulk ingest +
     sustained point mutations (inserts / in-place updates / deletes)
     under live GO / COUNT-pushdown / FIND PATH traffic against REAL
@@ -583,6 +584,14 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
         grows, rebuild count is flat, delta_overflow stays 0 (storaged
         /metrics — the tpu.mirror.* / tpu.absorb.* gauges).
 
+    ``num_storage >= 2`` is the MULTI-HOST soak (ISSUE 13 acceptance):
+    parts spread across storageds, the serving host folds its peers
+    through RemoteStoreView, and two more gates arm — the steady
+    window records ``peer_absorbs > 0`` (peer writes STREAM through
+    deviceScanDelta and fold at O(delta)) and ``remote_rebuilds == 0``
+    (no peer write forced the O(m) remote mirror rebuild).  Metric
+    samples sum across every storaged.
+
     Returns (and appends) the result row with per-class p50/p99."""
     import random
     import tempfile
@@ -592,11 +601,15 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
     from .proc_cluster import ProcCluster
 
     rd = run_dir or tempfile.mkdtemp(prefix="nebula-write-serve-")
-    row: dict = {"config": f"write-while-serve soak ({writers}w/"
+    label = ("write-while-serve soak" if num_storage == 1
+             else f"peer-serve soak ({num_storage} storaged)")
+    row: dict = {"config": f"{label} ({writers}w/"
                            f"{readers}r, chaos={'on' if chaos else 'off'})",
                  "backend": "tpu", "chaos": chaos,
-                 "duration_s": duration_s}
-    with ProcCluster(rd, num_storage=1, storage_backend="tpu") as c:
+                 "duration_s": duration_s,
+                 "num_storage": num_storage}
+    with ProcCluster(rd, num_storage=num_storage,
+                     storage_backend="tpu") as c:
         cpu_addr = c.add_graphd("graphd-cpu",
                                 {"storage_backend": "cpu"})
         cl = c.client()
@@ -752,15 +765,21 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
         for t in ts:
             t.start()
         _time.sleep(settle)
+
+        def sample():
+            # one /metrics scrape per storaged: multi-host gates SUM
+            # across the fleet (whichever host device-serves)
+            return [c.metrics(s) for s in c.storage_names]
+
         # steady-window sample A: absorption must be carrying the
         # write stream from here on, rebuild-free
-        m_a = c.metrics("storaged0")
+        m_a = sample()
         killed_at = None
         if chaos:
             _time.sleep(max(0.0, duration_s * 0.5 - settle))
             # sample B closes the zero-rebuild steady window BEFORE
             # the kill (the restart legitimately rebuilds)
-            m_b = c.metrics("storaged0")
+            m_b = sample()
             import signal as _signal
             c.kill("storaged0", _signal.SIGKILL)
             c.wait_down("storaged0")
@@ -768,7 +787,7 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
             c.restart("storaged0")
         else:
             _time.sleep(max(0.0, duration_s * 0.5 - settle))
-            m_b = c.metrics("storaged0")
+            m_b = sample()
         for t in ts:
             t.join()
 
@@ -824,30 +843,48 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
         assert not garbage, f"rows nobody wrote: {garbage[:5]}"
 
         # ---- absorb-vs-rebuild accounting --------------------------
-        m_c = c.metrics("storaged0")
-        absorbs_steady = (_prom_value(m_b, "nebula_tpu_absorb_count", 'runtime="device"')
-                          - _prom_value(m_a, "nebula_tpu_absorb_count", 'runtime="device"'))
-        rebuilds_steady = (_prom_value(m_b, "nebula_tpu_mirror_builds", 'runtime="device"')
-                           - _prom_value(m_a,
-                                         "nebula_tpu_mirror_builds", 'runtime="device"'))
+        m_c = sample()
+
+        def psum(ms, family, label=""):
+            return sum(_prom_value(m, family, label) for m in ms)
+
+        absorbs_steady = (psum(m_b, "nebula_tpu_absorb_count", 'runtime="device"')
+                          - psum(m_a, "nebula_tpu_absorb_count", 'runtime="device"'))
+        # per-host: a replica whose FIRST device mirror lands inside
+        # the window (the failover ladder warming a second serving
+        # host) is not a write-forced rebuild — the zero-rebuild claim
+        # is about hosts already serving at sample A
+        rebuilds_steady = 0.0
+        for a, b in zip(m_a, m_b):
+            a0 = _prom_value(a, "nebula_tpu_mirror_builds",
+                             'runtime="device"')
+            if a0 > 0:
+                rebuilds_steady += _prom_value(
+                    b, "nebula_tpu_mirror_builds",
+                    'runtime="device"') - a0
+        peer_absorbs_steady = (
+            psum(m_b, "nebula_tpu_peer_absorb_count", 'runtime="device"')
+            - psum(m_a, "nebula_tpu_peer_absorb_count", 'runtime="device"'))
         # the SIGKILL resets the storaged's counters, so the overflow
         # gate must cover BOTH epochs: the pre-kill sample (m_b) and
         # the post-restart one (m_c) — a pre-kill overflow must not
         # hide behind the restart zeroing the gauge
         overflow = max(
-            _prom_value(m_b, "nebula_tpu_mirror_delta_overflow", 'runtime="device"'),
-            _prom_value(m_c, "nebula_tpu_mirror_delta_overflow", 'runtime="device"'))
+            psum(m_b, "nebula_tpu_mirror_delta_overflow", 'runtime="device"'),
+            psum(m_c, "nebula_tpu_mirror_delta_overflow", 'runtime="device"'))
         counters = {
-            "absorbs": [_prom_value(m, "nebula_tpu_absorb_count", 'runtime="device"')
+            "absorbs": [psum(m, "nebula_tpu_absorb_count", 'runtime="device"')
                         for m in (m_a, m_b, m_c)],
-            "builds": [_prom_value(m, "nebula_tpu_mirror_builds", 'runtime="device"')
+            "builds": [psum(m, "nebula_tpu_mirror_builds", 'runtime="device"')
                        for m in (m_a, m_b, m_c)],
-            "absorb_failed": [_prom_value(m, "nebula_tpu_absorb_failed", 'runtime="device"')
+            "absorb_failed": [psum(m, "nebula_tpu_absorb_failed", 'runtime="device"')
                               for m in (m_a, m_b, m_c)],
-            "device_go": [_prom_value(
+            "peer_absorbs": [psum(m, "nebula_tpu_peer_absorb_count", 'runtime="device"')
+                             for m in (m_a, m_b, m_c)],
+            "device_go": [psum(
                 m, "nebula_storage_device_go_qps_total")
                 for m in (m_a, m_b, m_c)],
-            "device_decline": [_prom_value(
+            "device_decline": [psum(
                 m, "nebula_storage_device_decline_qps_total")
                 for m in (m_a, m_b, m_c)],
         }
@@ -860,12 +897,13 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
             "killed_at_s": round(killed_at, 1) if killed_at else None,
             "absorbs_steady_window": absorbs_steady,
             "rebuilds_steady_window": rebuilds_steady,
+            "peer_absorbs_steady_window": peer_absorbs_steady,
             "delta_overflow": overflow,
             # counters are per-process: pre-kill and post-restart are
             # separate epochs (the kill zeroes them)
-            "absorbs_pre_kill": _prom_value(m_b,
-                                            "nebula_tpu_absorb_count", 'runtime="device"'),
-            "absorbs_post_restart": _prom_value(
+            "absorbs_pre_kill": psum(m_b,
+                                     "nebula_tpu_absorb_count", 'runtime="device"'),
+            "absorbs_post_restart": psum(
                 m_c, "nebula_tpu_absorb_count", 'runtime="device"'),
             "go_p50_ms": round(percentile(lat["go"], 50) / 1000, 3)
             if lat["go"] else None,
@@ -884,9 +922,30 @@ def bench_write_serve(results: list, duration_s: float = 180.0,
             f"rebuilds (absorption should carry it) ({counters}, {row})"
         assert overflow == 0, \
             f"delta budget overflowed {overflow} times ({row})"
+        if num_storage > 1:
+            # the ISSUE 13 multi-host gates: peer writes STREAMED and
+            # absorbed (never the O(m) remote mirror rebuild — the
+            # rebuild gate above already pinned builds flat)
+            assert peer_absorbs_steady > 0, \
+                f"multi-host steady window folded no PEER deltas — " \
+                f"the stream is not carrying remote writes " \
+                f"({counters}, {row})"
     results.append(row)
     print(row, file=sys.stderr)
     return row
+
+
+def bench_peer_serve(results: list, duration_s: float = 180.0,
+                     run_dir: Optional[str] = None) -> dict:
+    """The ISSUE 13 multi-host soak: ≥2 storaged, graphd on the device
+    path, a steady write window that must show ``peer_absorbs > 0``
+    with ``remote_rebuilds == 0`` — bit-exact vs the CPU-loop oracle
+    with zero acked-write loss.  Link-death chaos is covered by the
+    partition cells (scripts/chaos.sh --cell partition_*); this soak
+    keeps the fleet up and measures the stream under sustained load."""
+    return bench_write_serve(results, duration_s=duration_s,
+                             chaos=False, run_dir=run_dir,
+                             num_storage=2)
 
 
 def bench_mesh_virtual(results: list, persons: int) -> None:
@@ -964,12 +1023,28 @@ def main(argv=None) -> int:
                    help="write-while-serve soak wall budget")
     p.add_argument("--no-chaos", action="store_true",
                    help="write-while-serve without the SIGKILL")
+    p.add_argument("--peer-serve", action="store_true",
+                   help="run ONLY the multi-host peer-serve soak "
+                        "(ISSUE 13): 2 storaged, graphd on the device "
+                        "path, asserts peer_absorbs > 0 with zero "
+                        "remote rebuilds in the steady write window, "
+                        "bit-exact vs the CPU-loop oracle with zero "
+                        "acked-write loss")
+    p.add_argument("--peer-serve-secs", type=float, default=180.0,
+                   help="peer-serve soak wall budget")
     args = p.parse_args(argv)
     persons_path = args.persons or (2000 if args.quick else 10000)
     persons_go = args.persons or (2000 if args.quick else 100000)
     persons_mesh = args.persons or (2000 if args.quick else 50000)
 
     results: list = []
+    if args.peer_serve:
+        bench_peer_serve(results, duration_s=args.peer_serve_secs)
+        print(json.dumps(results))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(results, fh, indent=1)
+        return 0
     if args.write_serve:
         bench_write_serve(results, duration_s=args.write_serve_secs,
                           chaos=not args.no_chaos)

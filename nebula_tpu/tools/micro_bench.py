@@ -305,6 +305,72 @@ def bench_recovery(reps: int, op_budget_us: float = 1.0) -> dict:
                               and admit_cell_us <= op_budget_us)}
 
 
+def bench_peer_absorb(reps: int, window_budget_us: float = 2000.0,
+                      codec_budget_us: float = 5.0) -> dict:
+    """Peer-delta stream hot-path cost (docs/durability.md "The
+    peer-delta cursor protocol"): the per-window work a subscribed
+    mirror pays BEFORE any device scatter — fused-cursor identity
+    checks, the deviceScanDelta frame decode (a full msgpack round
+    trip, wire parity with the loopback channel), and typed-event
+    tuple conversion — for a 64-event window against a real
+    NebulaStore delta log.  Budget-guarded beside recovery_path: the
+    multi-host soak's zero-rebuild claim holds only while one stream
+    window stays far under a serving window.  The (epoch, led_gen,
+    version) fuse/split codec is budgeted separately at a few µs/op
+    (python bigint shifts) — it runs per staleness check, not per
+    window."""
+    from ..interface.common import HostAddr
+    from ..interface.rpc import _pack, _unpack
+    from ..kvstore.store import KVOptions, NebulaStore
+    from ..storage.device import (RemoteStoreView, fuse_peer_version,
+                                  split_peer_version)
+
+    k = 64
+    store = NebulaStore(KVOptions())
+    for i in range(k):
+        # realistic frame shape: 32B edge-identity keys + small rows
+        store._bump(1, [("put", i.to_bytes(8, "big") * 4,
+                         b"v" * 24)])
+
+    class _CM:
+        def call(self, addr, method, payload, timeout=None):
+            payload = _unpack(_pack(payload))
+            if method == "deviceVersion":
+                return _unpack(_pack(
+                    {"version": store.mutation_version(1),
+                     "led_parts": [1], "epoch": 7, "led_gen": 1}))
+            evs, _reason, ver = store.delta_window(
+                1, int(payload["cursor"]), upto=payload.get("upto"))
+            return _unpack(_pack({"ok": True,
+                                  "events": [list(e) for e in evs],
+                                  "version": ver}))
+
+    view = RemoteStoreView(HostAddr("peer", 1), 1, _CM())
+    assert view.refresh()
+    anchor = fuse_peer_version(7, 1, 0)
+    assert len(view.delta_since(1, anchor)) == k      # warm
+    rounds = max(200, reps)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        view.delta_since(1, anchor)
+    t_window = time.perf_counter() - t0
+    m = max(100_000, reps * 1000)
+    t0 = time.perf_counter()
+    for i in range(m):
+        split_peer_version(fuse_peer_version(7, 1, i))
+    t_codec = time.perf_counter() - t0
+    window_us = t_window / rounds * 1e6
+    codec_us = t_codec / m * 1e6
+    return {"window_us": round(window_us, 2),
+            "window_events": k,
+            "decode_us_per_event": round(window_us / k, 3),
+            "cursor_codec_us_per_op": round(codec_us, 4),
+            "window_budget_us": window_budget_us,
+            "codec_budget_us": codec_budget_us,
+            "within_budget": (window_us <= window_budget_us
+                              and codec_us <= codec_budget_us)}
+
+
 def bench_absorb(reps: int, wall_budget_ms: float = 250.0) -> dict:
     """Incremental delta absorption cost (docs/roofline.md "The absorb
     cost model"): host plan + copy-on-write apply + device row-scatter
@@ -518,6 +584,7 @@ def main(argv=None) -> int:
         "admission_path": bench_admission(reps),
         "recovery_path": bench_recovery(reps),
         "absorb_path": bench_absorb(reps),
+        "peer_absorb_path": bench_peer_absorb(reps),
         "kernel_roofline": bench_kernel_roofline(reps),
         "lint": bench_lint(args.lint_budget_s),
     }
@@ -527,6 +594,7 @@ def main(argv=None) -> int:
         and out["admission_path"]["within_budget"] \
         and out["recovery_path"]["within_budget"] \
         and out["absorb_path"]["within_budget"] \
+        and out["peer_absorb_path"]["within_budget"] \
         and out["kernel_roofline"]["within_budget"]
     return 0 if ok else 1
 

@@ -270,6 +270,61 @@ class ProcCluster:
     def events(self, name: str) -> List[dict]:
         return self.daemons[name].events()
 
+    # ------------------------------------------- directional partitions
+    # Link-death chaos over the same harness (the kill matrix's sixth
+    # primitive, docs/fault_injection.md "Network partitions"): a
+    # partition is ASYMMETRIC — partition(a, b) cuts only a's OUTBOUND
+    # calls to b (installed into a's fault injector via its /faults
+    # endpoint), so gray failures like "the leader can send heartbeats
+    # but not receive acks" are expressible.  Cuts cover every RPC the
+    # daemons exchange (storage, device serving, raft replication,
+    # meta heartbeats) because they all dial through the one
+    # ClientManager seam; the /healthz-and-/metrics ops plane stays
+    # reachable — the observer must survive the chaos it causes.
+    def _faults_op(self, name: str, body: dict) -> None:
+        d = self.daemons[name]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{d.ws_port}/faults",
+            data=json.dumps(body).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            resp.read()
+
+    def partition(self, src: str, dst: str, method: str = "*") -> None:
+        """Cut ``src``'s outbound link to ``dst`` (daemon names).
+        Direction matters: graphd still reaches a storaged whose
+        OUTBOUND rules blackhole it.  Journals net.partitioned inside
+        ``src`` so the chaos timeline reads off its /events."""
+        target = f"127.0.0.1:{self.daemons[dst].port}"
+        self._faults_op(src, {"partition": {"host": target,
+                                            "method": method}})
+
+    def heal(self, src: Optional[str] = None,
+             dst: Optional[str] = None) -> None:
+        """Remove partition cuts: all of them (no args), every cut a
+        single daemon installed (``src``), or one directed link
+        (``src`` + ``dst``)."""
+        names = [src] if src is not None else list(self.daemons)
+        host = (f"127.0.0.1:{self.daemons[dst].port}"
+                if dst is not None else "*")
+        for name in names:
+            if self.daemons[name].alive():
+                self._faults_op(name, {"heal": {"host": host}})
+
+    def netsplit(self, *groups: List[str]) -> None:
+        """Full split: daemons in DIFFERENT groups cannot reach each
+        other in either direction (both directed cuts installed);
+        daemons within a group stay connected.  Daemons in no group
+        (e.g. metad left out) keep full connectivity — the common
+        "data plane splits, control plane survives" topology."""
+        for g in groups:
+            for other in groups:
+                if other is g:
+                    continue
+                for a in g:
+                    for b in other:
+                        self.partition(a, b)
+
     def add_graphd(self, name: str,
                    extra_flags: Optional[Dict[str, object]] = None,
                    start: bool = True) -> str:

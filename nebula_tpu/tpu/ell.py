@@ -80,7 +80,8 @@ class EllIndex:
     @staticmethod
     def build(edge_src: np.ndarray, edge_dst: np.ndarray,
               edge_etype: np.ndarray, n: int, cap: int = 512,
-              min_d: int = 8, use_native: bool = True) -> "EllIndex":
+              min_d: int = 8, use_native: bool = True,
+              growth_slack: int = 0) -> "EllIndex":
         """Group the mirror's edge rows by dst into bucketed slot tables.
 
         ``edge_*`` are the CsrMirror arrays (dense ids, signed etypes,
@@ -88,6 +89,12 @@ class EllIndex:
         with more slots get extra rows merged by the fix-up scatter.
         ``min_d`` floors the bucket width — fewer buckets compile into
         fewer fori kernels at the price of a little padding.
+        ``growth_slack`` appends that many SPARE all-sentinel rows to
+        the widest bucket (owner = the spare sentinel): an absorb
+        window whose degree growth overflows a vertex's resident row
+        can CLAIM one in place (plan_ell_absorb) instead of paying the
+        re-bucketing rebuild — the in-place slot-growth path
+        (docs/durability.md decision table).
 
         When the native library is loaded (native/ell_build.cc) the
         table construction runs in C++ — several times faster at
@@ -99,7 +106,7 @@ class EllIndex:
             ell = EllIndex._build_native(edge_src, edge_dst, edge_etype,
                                          n, cap, min_d)
             if ell is not None:
-                return ell
+                return _append_growth_spares(ell, growth_slack)
         ell = EllIndex()
         ell.n = n
         m = len(edge_src)
@@ -168,7 +175,14 @@ class EllIndex:
             ell.bucket_nbr.append(nbr)
             ell.bucket_et.append(et)
             bstart += nb
-        return ell
+        return _append_growth_spares(ell, growth_slack)
+
+    def spare_sentinel(self) -> int:
+        """The extra_owner value marking an UNCLAIMED growth-spare row
+        (== n_rows, the same out-of-range row the slot sentinel names:
+        both the hub merge scatter and the int8 owner scatter drop
+        indices past the table, so an unclaimed spare merges nowhere)."""
+        return self.n_rows
 
     @staticmethod
     def _build_native(edge_src, edge_dst, edge_etype, n: int, cap: int,
@@ -300,10 +314,13 @@ class EllIndex:
         one enters its frontier, because a push from the main row
         alone would miss the spilled slots.  (The batched sparse
         kernel instead EXPANDS hubs into their extra rows on device —
-        hub_expansion below.)"""
+        hub_expansion below.)  Unclaimed growth spares (owner = the
+        spare sentinel, past every real vertex) are filtered: they
+        belong to nobody yet."""
         is_hub = np.zeros(self.n + 1, dtype=bool)
         if len(self.extra_owner):
-            is_hub[np.unique(self.extra_owner)] = True
+            u = np.unique(self.extra_owner)
+            is_hub[u[u < self.n]] = True
         return is_hub
 
     def hub_expansion(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -319,7 +336,11 @@ class EllIndex:
             owners, first = np.unique(self.extra_owner, return_index=True)
             cnts = np.bincount(self.extra_owner, minlength=self.n)
             ecnt[:self.n] = cnts[:self.n].astype(np.int32)
-            e0[owners] = (self.n + first).astype(np.int32)
+            # unclaimed growth spares carry the out-of-range spare
+            # sentinel as owner — scattering THAT into e0 would walk
+            # off the array; they have no expansion until claimed
+            real = owners < self.n
+            e0[owners[real]] = (self.n + first[real]).astype(np.int32)
         return ecnt, e0
 
     def kernel_args(self):
@@ -637,11 +658,40 @@ def make_batched_go_lanes_kernel(ell: EllIndex, steps: int,
 # replacement rows, so the device scatter has one writer per row and
 # no read-modify-write hazards.
 # ====================================================================
+def _append_growth_spares(ell: EllIndex, slack: int) -> EllIndex:
+    """Provision ``slack`` spare all-sentinel rows in the widest bucket
+    (owner = the spare sentinel) so plan_ell_absorb can GROW an
+    overflowing vertex's slot capacity in place — the degree-growth
+    path that used to be an unconditional slot-overflow rebuild.
+    Every pre-spare sentinel slot is re-pointed at the NEW pad row
+    (the slot sentinel is n_rows by contract, and n_rows just grew);
+    the tables are freshly built and unshared, so the rewrite is
+    safe in place."""
+    if slack <= 0 or ell.n == 0 or not ell.bucket_nbr:
+        return ell
+    old_sent = np.int32(ell.n_rows)
+    new_sent = np.int32(ell.n_rows + int(slack))
+    for b in range(len(ell.bucket_nbr)):
+        nbr = ell.bucket_nbr[b]
+        nbr[nbr == old_sent] = new_sent
+    D = int(ell.bucket_nbr[-1].shape[1])
+    ell.bucket_nbr[-1] = np.vstack(
+        [ell.bucket_nbr[-1],
+         np.full((int(slack), D), new_sent, np.int32)])
+    ell.bucket_et[-1] = np.vstack(
+        [ell.bucket_et[-1], np.zeros((int(slack), D), np.int32)])
+    ell.extra_owner = np.concatenate(
+        [ell.extra_owner,
+         np.full(int(slack), new_sent, np.int32)]).astype(np.int32)
+    ell.n_rows = int(new_sent)
+    return ell
+
+
 def plan_ell_absorb(ell: EllIndex,
                     ins_dst: np.ndarray, ins_src: np.ndarray,
                     ins_et: np.ndarray,
                     del_dst: np.ndarray, del_src: np.ndarray,
-                    del_et: np.ndarray):
+                    del_et: np.ndarray, claims_out: Optional[list] = None):
     """Replacement-row plan for absorbing overlay edges into ``ell``.
 
     Inputs are OLD-dense-id edge rows exactly as the CsrMirror stores
@@ -651,7 +701,18 @@ def plan_ell_absorb(ell: EllIndex,
     None when any owner's new slot count outgrows its resident
     capacity (main row + existing extra rows), which only the rebuild
     can serve.  Work is O(delta x row width): only affected owners'
-    rows are read and rewritten."""
+    rows are read and rewritten.
+
+    In-place slot growth: when ``claims_out`` is a list and the index
+    holds unclaimed growth spares (EllIndex.build growth_slack), an
+    overflowing owner that is NOT already a hub claims enough spare
+    rows to hold its new degree — ``(spare_index, owner_new_id)``
+    pairs are appended to ``claims_out`` and the plan rewrites the
+    claimed rows like any other.  Narrow by design: existing-vertex
+    slot extension only — hubs (and previously-grown vertices, which
+    look like hubs) and new-vertex ingest still take the rebuild, and
+    claims always consume the LOWEST free spares so the free set stays
+    a contiguous suffix (hub_expansion's contiguity contract)."""
     import bisect
     from collections import Counter
 
@@ -664,6 +725,11 @@ def plan_ell_absorb(ell: EllIndex,
     for nbr in ell.bucket_nbr:
         bstarts.append(acc)
         acc += nbr.shape[0]
+    free_spares: List[int] = []
+    if claims_out is not None and len(ell.extra_owner):
+        free_spares = np.nonzero(
+            ell.extra_owner == np.int32(ell.spare_sentinel()))[0] \
+            .tolist()
 
     owners: Dict[int, Tuple[Counter, list]] = {}
 
@@ -710,8 +776,25 @@ def plan_ell_absorb(ell: EllIndex,
                 return None
             entries = kept
         entries.extend(ins_l)
-        if len(entries) > sum(w for _b, _l, w in widths):
-            return None          # slot overflow past the hub budget
+        total_w = sum(w for _b, _l, w in widths)
+        if len(entries) > total_w:
+            # in-place slot growth: claim spare rows for a NON-hub
+            # owner whose degree outgrew its resident width (narrow
+            # scope — a hub, or a vertex grown in an earlier window,
+            # already owns extras whose contiguity a scattered claim
+            # would break: those still rebuild)
+            if not free_spares or int(ecnt[r]) > 0:
+                return None      # slot overflow past the hub budget
+            d_spare = int(ell.bucket_nbr[-1].shape[1])
+            need = -(-(len(entries) - total_w) // d_spare)
+            if need > len(free_spares):
+                return None      # growth slack exhausted: rebuild
+            take, free_spares[:need] = free_spares[:need], []
+            for idx in take:
+                row = ell.n + int(idx)
+                b = bisect.bisect_right(bstarts, row) - 1
+                widths.append((b, row - bstarts[b], d_spare))
+                claims_out.append((int(idx), int(r)))
         pos = 0
         for b, local, w in widths:
             take = entries[pos:pos + w]
@@ -730,18 +813,28 @@ def plan_ell_absorb(ell: EllIndex,
             for b, v in upd.items()}
 
 
-def apply_ell_absorb_host(ell: EllIndex, plan, m_new: int) -> EllIndex:
+def apply_ell_absorb_host(ell: EllIndex, plan, m_new: int,
+                          claims=()) -> EllIndex:
     """Next-generation EllIndex: identical shapes/permutation (cached
     kernels keyed by shape_sig keep serving), updated slot content.
     Buckets WITH updates are copied before the scatter; untouched
-    buckets (and perm/inv/extra_owner) share memory with the old
-    generation, whose arrays stay exactly as published — the
-    immutable-generation contract in-flight dispatches rely on."""
+    buckets (and perm/inv — and extra_owner when no spare was
+    claimed) share memory with the old generation, whose arrays stay
+    exactly as published — the immutable-generation contract
+    in-flight dispatches rely on.  ``claims`` are plan_ell_absorb's
+    (spare_index, owner) growth claims: the next generation's
+    extra_owner is a COPY with those spares assigned (table SHAPES
+    still survive — only n_hubs, a kernel-argument size, moves)."""
     out = EllIndex()
     out.n, out.m = ell.n, m_new
     out.perm, out.inv = ell.perm, ell.inv
     out.bucket_D = list(ell.bucket_D)
     out.extra_owner = ell.extra_owner
+    if claims:
+        eo = ell.extra_owner.copy()
+        for idx, owner in claims:
+            eo[idx] = owner
+        out.extra_owner = eo
     out.n_rows = ell.n_rows
     out.bucket_nbr = list(ell.bucket_nbr)
     out.bucket_et = list(ell.bucket_et)

@@ -260,6 +260,15 @@ flags.define(
     "per-hop candidate/sort width (d_max) at the price of more hub "
     "rows — worth tuning down on heavy-tailed graphs")
 flags.define(
+    "tpu_ell_growth_slack", 8,
+    "SPARE all-sentinel rows provisioned per ELL build in the widest "
+    "bucket (ell.EllIndex.build growth_slack): an absorb window whose "
+    "degree growth overflows an existing vertex's resident slot row "
+    "claims one IN PLACE instead of paying the slot-overflow "
+    "re-bucketing rebuild (narrow scope: non-hub existing vertices; "
+    "new-vertex ingest still rebuilds).  ~tpu_ell_cap*8 bytes of HBM "
+    "per spare; 0 disables growth (docs/durability.md decision table)")
+flags.define(
     "mirror_refresh_mode", "sync",
     "CSR-mirror refresh on space mutation: 'sync' rebuilds before the "
     "next device query (always fresh — the test/parity default); "
@@ -451,6 +460,15 @@ class TpuQueryRuntime:
                       "mirror_deltas": 0, "mirror_absorbs": 0,
                       "mirror_absorb_failed": 0,
                       "mirror_delta_overflow": 0,
+                      # streamed peer-delta absorption (multi-host
+                      # mirrors fold peer writes at O(delta) —
+                      # storage/device.py RemoteStoreView.delta_since)
+                      "peer_absorbs": 0, "peer_absorb_events": 0,
+                      "peer_absorb_failed": 0,
+                      # in-place ELL slot growth (cap-bucket spare-row
+                      # claims that absorbed what used to be a
+                      # slot-overflow rebuild — ell.plan_ell_absorb)
+                      "mirror_slot_grows": 0,
                       "go_sparse": 0, "go_dense": 0,
                       "go_adaptive": 0, "sparse_overflows": 0,
                       "prewarm_compiled": 0, "prewarm_hits": 0,
@@ -550,6 +568,16 @@ class TpuQueryRuntime:
         _stats.set_gauge("tpu.mirror.delta_overflow",
                          snap.get("mirror_delta_overflow", 0),
                          runtime=role)
+        # streamed peer-delta absorption (the multi-host soak's gates:
+        # peer_absorb.count grows, remote rebuilds stay flat)
+        _stats.set_gauge("tpu.peer_absorb.count",
+                         snap.get("peer_absorbs", 0), runtime=role)
+        _stats.set_gauge("tpu.peer_absorb.events",
+                         snap.get("peer_absorb_events", 0), runtime=role)
+        _stats.set_gauge("tpu.peer_absorb.failed",
+                         snap.get("peer_absorb_failed", 0), runtime=role)
+        _stats.set_gauge("tpu.absorb.slot_grows",
+                         snap.get("mirror_slot_grows", 0), runtime=role)
         _stats.set_gauge("tpu.jit_cache.size", n_kernels, runtime=role)
         _stats.set_gauge("tpu.compile.count",
                          snap.get("kernel_compiles", 0), runtime=role)
@@ -853,13 +881,26 @@ class TpuQueryRuntime:
             return None, "peer-set-changed", 0
         new_events = []
         cursors = dict(m._delta_cursors)
+        n_peer_events = 0
         for i, s in enumerate(stores):
             now_v = vers[i]
             if now_v == cursors[i]:
                 continue
             evs = s.delta_since(space_id, cursors[i])
             if evs is None:
-                return None, "opaque-events", 0
+                # a remote view types its stream break (peer-restarted,
+                # peer-leader-changed, peer-cursor-truncated, ...) —
+                # the journaled reason then names WHY the rebuild is
+                # about to be paid instead of a generic opaque-events
+                reason = getattr(s, "last_delta_decline", None) \
+                    or "opaque-events"
+                if getattr(s, "is_remote", False):
+                    with self._lock:
+                        self.stats["peer_absorb_failed"] = \
+                            self.stats.get("peer_absorb_failed", 0) + 1
+                return None, reason, 0
+            if getattr(s, "is_remote", False):
+                n_peer_events += len(evs)
             new_events.extend(evs)
             cursors[i] = now_v
         n_events = len(new_events)
@@ -884,6 +925,7 @@ class TpuQueryRuntime:
                 m._delta_cursors = cursors
                 m._fresh_version = ver
                 self.stats["mirror_deltas"] += 1
+            self._note_peer_absorbed(space_id, n_peer_events, m)
             return m
 
         if not edge_events:
@@ -914,8 +956,32 @@ class TpuQueryRuntime:
                               f"-> generation {new_m.generation}",
                        space=space_id,
                        generation=int(new_m.generation),
-                       edges=int(d.m), deletes=int(len(d.base_dead)))
+                       edges=int(d.m), deletes=int(len(d.base_dead)),
+                       claims=int(getattr(new_m, "_slot_claims", 0)))
+        self._note_peer_absorbed(space_id, n_peer_events, new_m)
         return new_m, "absorbed", n_events
+
+    def _note_peer_absorbed(self, space_id: int, n_peer_events: int,
+                            m: CsrMirror) -> None:
+        """Peer-delta accounting: an absorption window that folded ≥1
+        event STREAMED from a remote peer (deviceScanDelta) counts as
+        a peer absorb — the multi-host soak's proof that peer writes
+        ride ell_absorb at O(delta) instead of the O(m) remote mirror
+        rebuild (docs/durability.md)."""
+        if n_peer_events <= 0:
+            return
+        with self._lock:
+            self.stats["peer_absorbs"] = \
+                self.stats.get("peer_absorbs", 0) + 1
+            self.stats["peer_absorb_events"] = \
+                self.stats.get("peer_absorb_events", 0) + n_peer_events
+        from ..common.events import journal
+        journal.record("mirror.peer_absorbed",
+                       detail=f"space {space_id}: {n_peer_events} peer "
+                              f"events -> generation "
+                              f"{getattr(m, 'generation', 0)}",
+                       space=space_id, events=n_peer_events,
+                       generation=int(getattr(m, "generation", 0)))
 
     def _absorb_build(self, space_id: int, m: CsrMirror,
                       d) -> Optional[CsrMirror]:
@@ -935,16 +1001,20 @@ class TpuQueryRuntime:
         ix = self.ell(m)
         dead = np.asarray(getattr(d, "base_dead", ()), dtype=np.int64)
         # the ELL keys rows by DST (slots hold srcs) — overlay rows
-        # and tombstoned base rows feed the plan in that orientation
+        # and tombstoned base rows feed the plan in that orientation.
+        # claims collect in-place slot GROWTH (an overflowing vertex
+        # takes unclaimed spare rows instead of forcing the rebuild)
+        claims: List = []
         plan = plan_ell_absorb(
             ix, d.edge_dst, d.edge_src, d.edge_etype,
-            m.edge_dst[dead], m.edge_src[dead], m.edge_etype[dead])
+            m.edge_dst[dead], m.edge_src[dead], m.edge_etype[dead],
+            claims_out=claims)
         if plan is None:
             return None
         new_m = absorb_overlay(m, d)
         if new_m is None:
             return None
-        ix2 = apply_ell_absorb_host(ix, plan, new_m.m)
+        ix2 = apply_ell_absorb_host(ix, plan, new_m.m, claims=claims)
         counts, upd = absorb_update_arrays(ix, plan)
         rows_a = [jnp.asarray(u[0]) for u in upd]
         nn_a = [jnp.asarray(u[1]) for u in upd]
@@ -960,6 +1030,11 @@ class TpuQueryRuntime:
                 ("ell_absorb", ix.shape_sig(), counts),
                 lambda: make_ell_absorb_kernel(ix, counts))
             outs = kern(*rows_a, *nn_a, *ne_a, *nbr_dev, *et_dev)
+            if claims:
+                # a claimed spare changed extra_owner content: the
+                # next generation's owner scatter needs the NEW array
+                # on device (a few bytes — never the table re-upload)
+                owner_dev = jnp.asarray(ix2.extra_owner)
             ix2._device = (list(outs[:nb]), list(outs[nb:]), owner_dev)
         cached = getattr(m, "_mesh_tables_cache", None)
         if cached is not None and cached[1] is not None:
@@ -981,15 +1056,24 @@ class TpuQueryRuntime:
         # query — a device_put, never a store re-scan
         new_m._ell = ix2
         # carry what stays valid across generations: the warm ledger
-        # (kernels are shape-keyed) and the structural hub metadata
-        # (perm/extras are generation-invariant by construction)
+        # (kernels are shape-keyed) and the structural hub metadata —
+        # UNLESS a growth claim just changed extra_owner, which is
+        # exactly what those caches derive from (hub table, expansion
+        # runs, merge slots): a grown generation re-derives them
         if hasattr(m, "_prewarm_done"):
             new_m._prewarm_done = m._prewarm_done
-        for cache_attr in ("_hub_dev_cache", "_hub_exp_cache",
-                           "_hub_merge_cache"):
-            val = getattr(m, cache_attr, None)
-            if val is not None:
-                setattr(new_m, cache_attr, val)
+        if not claims:
+            for cache_attr in ("_hub_dev_cache", "_hub_exp_cache",
+                               "_hub_merge_cache"):
+                val = getattr(m, cache_attr, None)
+                if val is not None:
+                    setattr(new_m, cache_attr, val)
+        else:
+            self._bump("mirror_slot_grows", len(claims))
+            # the publish-time mirror.absorbed record (one per window,
+            # _absorb_once) carries the claim count — a second journal
+            # entry here would double-count absorptions on /events
+            new_m._slot_claims = len(claims)
         return new_m
 
     def mirror_full(self, space_id: int) -> Optional[CsrMirror]:
@@ -2859,7 +2943,10 @@ class TpuQueryRuntime:
         if ix is None:
             ix = EllIndex.build(m.edge_src, m.edge_dst, m.edge_etype,
                                 m.n,
-                                cap=int(flags.get("tpu_ell_cap") or 512))
+                                cap=int(flags.get("tpu_ell_cap") or 512),
+                                growth_slack=int(
+                                    flags.get("tpu_ell_growth_slack")
+                                    or 0))
             m._ell = ix
         return ix
 

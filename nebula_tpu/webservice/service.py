@@ -153,7 +153,13 @@ class WebService:
         """Runtime fault-injection control (docs/fault_injection.md):
         GET returns {seed, rules:[... with hits/fired]}; PUT with a JSON
         body {"seed": N, "rules": [...]} (or a bare rule list) replaces
-        the table atomically — {"rules": []} turns injection off."""
+        the table atomically — {"rules": []} turns injection off.
+        Directional-partition ops APPEND/REMOVE tagged rules without
+        disturbing the rest of the table (and journal net.partitioned
+        / net.healed inside THIS daemon): {"partition": {"host": H
+        [, "method": M]}} cuts this process's outbound link to H;
+        {"heal": {"host": H}} (or {"heal": {}}) removes matching cuts
+        (tools/proc_cluster.py drives these across subprocesses)."""
         from ..interface.faults import default_injector
         if q.get("__method__") in ("PUT", "POST"):
             try:
@@ -166,8 +172,17 @@ class WebService:
                 return 400, {"error": "body must be a rule list or "
                                       "{seed, rules}"}
             try:
-                default_injector.configure(spec.get("rules", []),
-                                           seed=spec.get("seed"))
+                if "partition" in spec:
+                    part = dict(spec["partition"] or {})
+                    default_injector.partition(
+                        str(part.get("host", "*")),
+                        method=str(part.get("method", "*")))
+                elif "heal" in spec:
+                    default_injector.heal(
+                        str((spec["heal"] or {}).get("host", "*")))
+                else:
+                    default_injector.configure(spec.get("rules", []),
+                                               seed=spec.get("seed"))
             except (TypeError, ValueError) as e:
                 return 400, {"error": str(e)}
         return 200, default_injector.dump()

@@ -1,23 +1,115 @@
 #!/usr/bin/env bash
-# Crash-recovery chaos driver (docs/durability.md).
+# Crash-recovery + network-partition chaos driver (docs/durability.md,
+# docs/fault_injection.md).
 #
-# Runs the FULL kill matrix — real SIGKILL'd subprocess daemons
-# (tests/test_proc_chaos.py over tools/proc_cluster.py) plus the
+# Runs the kill matrix — real SIGKILL'd subprocess daemons
+# (tests/test_proc_chaos.py over tools/proc_cluster.py), the partition
+# cells (directional link cuts via the /faults endpoint), and the
 # wire-level fault-injection chaos suite (tests/test_chaos.py) — under
 # the runtime lock-order watchdog: NEBULA_LOCK_WATCHDOG=1 arms
 # common/ordered_lock.py in THIS process and is inherited by every
 # daemon subprocess ProcCluster spawns, so an inversion inside a
 # recovering storaged fails its scenario too.
 #
-# Usage: scripts/chaos.sh [extra pytest args]
-#   scripts/chaos.sh -k mid_append      # one matrix cell
-#   scripts/chaos.sh -m 'chaos and not slow'   # smoke cells only
-set -euo pipefail
+# Usage:
+#   scripts/chaos.sh                      full matrix, per-cell summary
+#   scripts/chaos.sh --cell list          name the cells
+#   scripts/chaos.sh --cell partition_delta [--cell smoke ...]
+#                                         selected cells only
+#   scripts/chaos.sh [--cell ...] [extra pytest args]
+#
+# Every run ends with a per-cell PASS/FAIL table; any red cell makes
+# the exit code nonzero.
+set -uo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export NEBULA_LOCK_WATCHDOG=1
 
-exec python -m pytest tests/test_proc_chaos.py tests/test_chaos.py \
-    tests/test_crash_recovery.py tests/test_write_serve.py \
-    -v -m chaos -p no:cacheprovider "$@"
+PROC=tests/test_proc_chaos.py
+CELLS=(
+  "smoke|${PROC}::TestProcSmoke"
+  "mid_append|${PROC}::TestKillMatrix::test_kill_storaged_mid_append_no_acked_loss"
+  "mid_flush|${PROC}::TestKillMatrix::test_kill_storaged_mid_flush_and_compaction"
+  "leader_kill|${PROC}::TestKillMatrix::test_leader_kill_under_live_go_traffic"
+  "metad_kill|${PROC}::TestKillMatrix::test_metad_sigkill_and_restart"
+  "mid_absorb|${PROC}::TestKillMatrix::test_kill_storaged_mid_absorption_zero_acked_loss"
+  "partition_leader|${PROC}::TestKillMatrix::test_partitioned_raft_leader_zero_acked_loss"
+  "partition_delta|${PROC}::TestKillMatrix::test_mirror_host_partitioned_mid_delta_stream"
+  "partition_graphd|${PROC}::TestKillMatrix::test_graphd_partitioned_from_storaged_ladder_serves"
+  "snapshot_kill|${PROC}::TestKillMatrix::test_kill_follower_mid_snapshot_install"
+  "wire_faults|tests/test_chaos.py"
+  "crash_recovery|tests/test_crash_recovery.py"
+  "write_serve|tests/test_write_serve.py"
+)
+
+cell_target() {
+  local name=$1 entry
+  for entry in "${CELLS[@]}"; do
+    if [[ "${entry%%|*}" == "$name" ]]; then
+      echo "${entry#*|}"
+      return 0
+    fi
+  done
+  return 1
+}
+
+selected=()
+extra=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --cell)
+      shift
+      [[ $# -gt 0 ]] || { echo "--cell needs a name" >&2; exit 2; }
+      if [[ "$1" == "list" ]]; then
+        for entry in "${CELLS[@]}"; do echo "${entry%%|*}"; done
+        exit 0
+      fi
+      cell_target "$1" >/dev/null || {
+        echo "unknown cell '$1' (scripts/chaos.sh --cell list)" >&2
+        exit 2
+      }
+      selected+=("$1")
+      shift
+      ;;
+    *)
+      extra+=("$1")
+      shift
+      ;;
+  esac
+done
+
+if [[ ${#selected[@]} -eq 0 ]]; then
+  for entry in "${CELLS[@]}"; do selected+=("${entry%%|*}"); done
+fi
+
+names=()
+results=()
+secs=()
+red=0
+for name in "${selected[@]}"; do
+  target=$(cell_target "$name")
+  echo
+  echo "==== chaos cell: ${name} -> ${target}"
+  t0=$SECONDS
+  if python -m pytest "$target" -v -m chaos -p no:cacheprovider \
+      ${extra[@]+"${extra[@]}"}; then
+    results+=("PASS")
+  else
+    results+=("FAIL")
+    red=1
+  fi
+  names+=("$name")
+  secs+=($((SECONDS - t0)))
+done
+
+echo
+echo "==== chaos matrix summary"
+printf '%-20s %-6s %8s\n' CELL RESULT SECONDS
+for i in "${!names[@]}"; do
+  printf '%-20s %-6s %8s\n' "${names[$i]}" "${results[$i]}" "${secs[$i]}"
+done
+if [[ $red -ne 0 ]]; then
+  echo "RED: at least one chaos cell failed" >&2
+fi
+exit $red

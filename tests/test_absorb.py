@@ -295,6 +295,65 @@ class TestGenerationSemantics:
             c.stop()
 
 
+class TestSlotGrowth:
+    """In-place ELL slot growth (ISSUE 13 satellite): degree growth
+    past an existing vertex's resident row claims a cap-bucket spare
+    (EllIndex.build growth_slack) instead of paying the slot-overflow
+    rebuild — narrow scope: existing-vertex extension only."""
+
+    GROW_Q = "GO FROM 117 OVER follow REVERSELY YIELD follow._dst"
+
+    def test_degree_growth_claims_spare_in_place(self):
+        c, cl, ok = _boot(space="grow")
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow YIELD follow._dst")
+            builds0 = rt.stats["mirror_builds"]
+            grows0 = rt.stats["mirror_slot_grows"]
+            # vertex 117 holds 2 in-slots (ring fwd + rev) in a D=8
+            # row; 9 fresh in-edges in one window overflow it — the
+            # spare claim must absorb what used to re-bucket
+            ok("INSERT EDGE follow(degree) VALUES "
+               + ", ".join(f"{100 + i} -> 117@7:({i})"
+                           for i in range(2, 11)))
+            rows = _cpu_parity(ok, self.GROW_Q)
+            assert len(rows) >= 10
+            assert rt.stats["mirror_builds"] == builds0, \
+                "degree growth within the slack must absorb, not rebuild"
+            assert rt.stats["mirror_slot_grows"] > grows0
+            assert rt.stats["mirror_absorbs"] > 0
+            # multi-hop + packed paths serve the grown generation
+            _cpu_parity(ok, "GO 2 STEPS FROM 116 OVER follow "
+                            "YIELD follow._dst")
+            # rebuild oracle: a from-scratch scan serves identical rows
+            final_a = sorted(map(tuple, ok(self.GROW_Q).rows))
+            with rt._lock:
+                rt.mirrors.clear()
+            assert sorted(map(tuple, ok(self.GROW_Q).rows)) == final_a
+        finally:
+            c.stop()
+
+    def test_growth_disabled_restores_rebuild(self):
+        saved = flags.get("tpu_ell_growth_slack")
+        flags.set("tpu_ell_growth_slack", 0)
+        c, cl, ok = _boot(space="grow0")
+        try:
+            rt = c.tpu_runtime
+            ok("GO FROM 100 OVER follow YIELD follow._dst")
+            builds0 = rt.stats["mirror_builds"]
+            ok("INSERT EDGE follow(degree) VALUES "
+               + ", ".join(f"{100 + i} -> 117@7:({i})"
+                           for i in range(2, 11)))
+            rows = _cpu_parity(ok, self.GROW_Q)
+            assert len(rows) >= 10
+            assert rt.stats["mirror_builds"] > builds0, \
+                "slack 0 must restore the slot-overflow rebuild"
+            assert rt.stats["mirror_slot_grows"] == 0
+        finally:
+            flags.set("tpu_ell_growth_slack", saved)
+            c.stop()
+
+
 class TestOverflowObservability:
     def test_delta_overflow_counted_and_journaled(self):
         """A write burst past mirror_delta_max pays the rebuild — and

@@ -320,7 +320,7 @@ class TestUptoRpcSkew:
             return responses.pop(0)
 
         rt._call = fake_call
-        rt._device_host = lambda sid: (("h", 1), [1])
+        rt._device_hosts = lambda sid: [(("h", 1), [1])]
         rt.calls = calls
         return rt
 
@@ -405,7 +405,7 @@ class TestUptoDeclineCacheHealing:
         assert self._can_run(rt) is False
         # placement refresh moved the space's device host: the old
         # host's decline no longer describes the serving storaged
-        rt._device_host = lambda sid: (("h2", 1), [1])
+        rt._device_hosts = lambda sid: [(("h2", 1), [1])]
         assert self._can_run(rt) is True
         assert 7 not in rt._upto_declined
 

@@ -370,8 +370,9 @@ class TestHealthz:
         body = json.load(resp)
         assert resp.status == 200 and body["healthy"] is True
         assert set(body["checks"]) == {"meta", "parts", "device",
-                                       "device_breaker"}
+                                       "device_breaker", "peer_mirror"}
         assert body["checks"]["device_breaker"]["ok"]
+        assert body["checks"]["peer_mirror"]["ok"]
 
     def test_no_checks_means_bare_liveness(self, webservices):
         resp = _get(webservices["graphd"], "/healthz")

@@ -1,0 +1,578 @@
+"""Streamed peer-delta absorption + the replica failover ladder
+(ISSUE 13) — unit tier, no daemons.
+
+Covers the delta-stream edge cases as units (the partition chaos cells
+in tests/test_proc_chaos.py prove the same seams under real link
+death):
+
+  * the store's typed delta window verdicts (ok / truncated / opaque /
+    ahead) and the fused (epoch, led_gen, version) cursor codec;
+  * RemoteStoreView.delta_since against a scripted peer: cursor gap,
+    leader change mid-stream, truncated log, peer restart, peer
+    unreachable — each a TYPED decline; plus the /healthz stall
+    tracking those declines feed;
+  * duplicate delivery: re-applying an already-absorbed window is
+    idempotent (the overlay collapses per edge identity);
+  * absorb-vs-rebuild oracle parity on the REMOTE path (mirroring
+    tests/test_absorb.py's differential for the local one);
+  * the failover ladder: degraded/transport declines retry the next
+    replica, semantic declines do not, the TTL decline cache reorders,
+    heartbeat device briefs rank freshest-healthy first.
+"""
+import time
+
+import pytest
+
+from nebula_tpu.common.flags import flags
+from nebula_tpu.common.status import ErrorCode, Status
+from nebula_tpu.interface.common import HostAddr
+from nebula_tpu.interface.rpc import RpcError
+from nebula_tpu.kvstore.store import KVOptions, NebulaStore
+from nebula_tpu.storage.device import (RemoteStoreView, TpuDecline,
+                                       fuse_peer_version,
+                                       split_peer_version)
+
+
+# ===================================================== store delta window
+class TestDeltaWindow:
+    def _store(self):
+        s = NebulaStore(KVOptions())
+        s.delta_cap = 8
+        return s
+
+    def test_ok_window_and_upto_bound(self):
+        s = self._store()
+        for i in range(5):
+            s._bump(1, [("put", b"k%d" % i, b"v")])
+        evs, reason, ver = s.delta_window(1, 0)
+        assert reason == "ok" and ver == 5 and len(evs) == 5
+        evs, reason, ver = s.delta_window(1, 2, upto=4)
+        assert reason == "ok" and ver == 4
+        assert [e[1] for e in evs] == [b"k2", b"k3"]
+
+    def test_truncated_cursor(self):
+        s = self._store()
+        for i in range(12):                  # cap 8: base advances to 4
+            s._bump(1, [("put", b"k%d" % i, b"v")])
+        evs, reason, _ver = s.delta_window(1, 2)
+        assert evs is None and reason == "truncated"
+        evs, reason, _ver = s.delta_window(1, 4)
+        assert reason == "ok" and len(evs) == 8
+
+    def test_opaque_window(self):
+        s = self._store()
+        s._bump(1, [("put", b"k", b"v")])
+        s._bump(1, None)                     # ingest/compaction: opaque
+        evs, reason, _ver = s.delta_window(1, 0)
+        assert evs is None and reason == "opaque"
+
+    def test_cursor_ahead(self):
+        s = self._store()
+        s._bump(1, [("put", b"k", b"v")])
+        evs, reason, _ver = s.delta_window(1, 9)
+        assert evs is None and reason == "ahead"
+
+    def test_boot_epoch_randomized(self):
+        a, b = NebulaStore(KVOptions()), NebulaStore(KVOptions())
+        assert a.boot_epoch >= 1 and b.boot_epoch >= 1
+        # 30 random bits: two boots virtually never collide (and the
+        # codec below would catch a restart even on version replay)
+        assert a.boot_epoch != b.boot_epoch or a is b
+
+
+class TestFusedCursorCodec:
+    def test_round_trip(self):
+        for tup in [(1, 1, 0), (923_441_123, 13, 7_654_321),
+                    (2 ** 30 - 1, 2 ** 14 - 1, 2 ** 34 - 1)]:
+            assert split_peer_version(fuse_peer_version(*tup)) == tup
+
+    def test_each_component_moves_the_fused_value(self):
+        base = fuse_peer_version(7, 3, 100)
+        assert fuse_peer_version(8, 3, 100) != base
+        assert fuse_peer_version(7, 4, 100) != base
+        assert fuse_peer_version(7, 3, 101) != base
+
+    def test_led_gen_wraps_in_the_ring(self):
+        """led_gen rides the cursor modulo 2^14; both comparison sides
+        reduce into the ring, so a long-flapping peer (16384+ led-set
+        changes) still streams instead of rebuilding forever."""
+        fused = fuse_peer_version(7, (1 << 14) + 3, 9)
+        assert split_peer_version(fused) == (7, 3, 9)
+        peer = _ScriptedPeer()
+        peer.led_gen = (1 << 14) + 3         # raw counter past the ring
+        v = _view(peer)
+        v.mutation_version(1)
+        peer.write(b"k1")
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        anchor = fuse_peer_version(peer.epoch, peer.led_gen, 0)
+        v.mutation_version(1)
+        evs = v.delta_since(1, anchor)
+        assert evs is not None and [e[1] for e in evs] == [b"k1"]
+
+
+# ================================================ RemoteStoreView stream
+class _ScriptedPeer:
+    """ClientManager double serving deviceVersion/deviceScanDelta from
+    an in-memory delta log, with knobs for every stream break."""
+
+    def __init__(self):
+        self.epoch = 41
+        self.led_gen = 1
+        self.led = [0, 1]
+        self.version = 0
+        self.log = []                        # one event list per version
+        self.base = 0
+        self.unreachable = False
+        self.calls = []
+
+    def write(self, key=b"k", value=b"v"):
+        self.version += 1
+        self.log.append([["put", key, value]])
+
+    def trim(self, upto):
+        drop = upto - self.base
+        del self.log[:drop]
+        self.base = upto
+
+    def call(self, addr, method, payload, timeout=None):
+        self.calls.append(method)
+        if self.unreachable:
+            raise RpcError(Status(ErrorCode.E_FAIL_TO_CONNECT, "down"))
+        if method == "deviceVersion":
+            return {"version": self.version, "led_parts": self.led,
+                    "epoch": self.epoch, "led_gen": self.led_gen}
+        assert method == "deviceScanDelta"
+        if int(payload["epoch"]) != self.epoch:
+            return {"ok": False, "reason": "peer-restarted"}
+        # mirror the real server: led_gen compares in the fused ring
+        if int(payload["led_gen"]) != self.led_gen % (1 << 14):
+            return {"ok": False, "reason": "peer-leader-changed"}
+        cur = int(payload["cursor"])
+        upto = min(int(payload["upto"]), self.version)
+        if cur > self.version:
+            return {"ok": False, "reason": "peer-cursor-gap"}
+        if cur < self.base:
+            return {"ok": False, "reason": "peer-cursor-truncated"}
+        out = []
+        for entry in self.log[cur - self.base:upto - self.base]:
+            out.extend(entry)
+        return {"ok": True, "events": out, "version": upto}
+
+
+def _view(peer):
+    return RemoteStoreView(HostAddr("p", 1), 1, peer)
+
+
+class TestPeerDeltaStream:
+    def test_window_streams_typed_events(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        anchor = v.mutation_version(1)       # polls: version 0
+        peer.write(b"k1")
+        peer.write(b"k2")
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        now = v.mutation_version(1)          # re-polls: version 2
+        assert now != anchor
+        evs = v.delta_since(1, anchor)
+        assert [e[1] for e in evs] == [b"k1", b"k2"]
+        assert all(isinstance(e, tuple) for e in evs)
+        assert v.last_delta_decline is None
+        assert v.stalled_for_s() == 0.0
+
+    def _advance(self, peer, v, writes=1):
+        anchor = v.mutation_version(1)
+        for _ in range(writes):
+            peer.write()
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        v.mutation_version(1)                # fresh poll
+        return anchor
+
+    def test_truncated_log_is_typed(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        anchor = self._advance(peer, v, writes=6)
+        peer.trim(5)
+        assert v.delta_since(1, anchor) is None
+        assert v.last_delta_decline == "peer-cursor-truncated"
+        assert v.stalled_for_s() > 0.0
+
+    def test_leader_change_mid_stream_is_typed(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        anchor = self._advance(peer, v)
+        peer.led_gen += 1                    # leadership moved
+        peer.led = [0]
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        v.mutation_version(1)                # poll sees the new led_gen
+        assert v.delta_since(1, anchor) is None
+        assert v.last_delta_decline == "peer-leader-changed"
+
+    def test_peer_restart_is_typed_even_on_version_replay(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        anchor = self._advance(peer, v)
+        old_version = peer.version
+        peer.epoch = 42                      # reboot...
+        peer.version = old_version           # ...replays to the SAME
+        peer.log = [[["put", b"x", b"y"]]] * old_version  # number
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        assert v.mutation_version(1) != fuse_peer_version(
+            41, 1, old_version)              # fused version moved
+        assert v.delta_since(1, anchor) is None
+        assert v.last_delta_decline == "peer-restarted"
+
+    def test_cursor_gap_is_typed(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        self._advance(peer, v)
+        ahead = fuse_peer_version(peer.epoch, peer.led_gen,
+                                  peer.version + 5)
+        assert v.delta_since(1, ahead) is None
+        assert v.last_delta_decline == "peer-cursor-gap"
+
+    def test_unreachable_peer_stalls_then_heals(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        v.mutation_version(1)
+        peer.unreachable = True
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        with pytest.raises(RpcError):
+            v.mutation_version(1)
+        assert v.last_delta_decline == "peer-unreachable"
+        assert v.stalled_for_s() > 0.0
+        peer.unreachable = False
+        time.sleep(RemoteStoreView.POLL_REUSE_S + 0.01)
+        v.mutation_version(1)                # the peer is back
+        assert v.stalled_for_s() == 0.0
+
+    def test_full_scan_completion_clears_a_stream_stall(self):
+        peer = _ScriptedPeer()
+        v = _view(peer)
+        anchor = self._advance(peer, v, writes=3)
+        peer.trim(2)
+        assert v.delta_since(1, anchor) is None
+        assert v.stalled_for_s() > 0.0
+
+        class _ScanPeer:
+            def call(self, addr, method, payload, timeout=None):
+                assert method == "deviceScan"
+                return {"ok": True, "rows": [(b"a", b"b")],
+                        "cursor": b"a", "done": True, "version": 9}
+
+        v.cm = _ScanPeer()
+        # the rebuild's full part scan completes -> cursor re-anchors
+        assert list(v.prefix(1, 0, b"")) == [(b"a", b"b")]
+        assert v.stalled_for_s() == 0.0
+
+
+# ====================================== duplicate delivery (idempotence)
+class TestDuplicateDelivery:
+    def test_duplicated_window_absorbs_idempotently(self):
+        """A replayed delta window (same events delivered twice — the
+        reply-lost re-poll case) must fold to the SAME state: the
+        overlay collapses per edge identity, so re-applied puts/dels
+        are no-ops.  Checked against the CPU loop AND the rebuild
+        oracle."""
+        from nebula_tpu.cluster import LocalCluster
+        prev = flags.get("storage_backend")
+        flags.set("storage_backend", "tpu")
+        c = LocalCluster(num_storage=1, tpu_backend=True)
+        try:
+            cl = c.client()
+
+            def ok(s):
+                r = cl.execute(s)
+                assert r.ok(), f"{s}: {r.error_msg}"
+                return r
+
+            ok("CREATE SPACE dup(partition_num=2, replica_factor=1)")
+            c.refresh_all()
+            ok("USE dup")
+            ok("CREATE EDGE e(w int)")
+            c.refresh_all()
+            ok("INSERT EDGE e(w) VALUES "
+               + ", ".join(f"{i}->{i % 12 + 1}:({i})"
+                           for i in range(1, 13)))
+            q = "GO 2 STEPS FROM 1, 5 OVER e YIELD e._dst"
+            ok(q)                            # mirror builds
+            kv = c.storage_nodes[0].kv
+            orig = kv.delta_since
+            kv.delta_since = lambda sid, ver: (
+                lambda evs: evs + evs if evs else evs)(orig(sid, ver))
+            try:
+                rt = c.tpu_runtime
+                builds0 = rt.stats["mirror_builds"]
+                ok("INSERT EDGE e(w) VALUES 1->7@9:(70), 5->2@9:(52)")
+                ok("DELETE EDGE e 1 -> 2@0")
+                rows_dev = sorted(map(tuple, ok(q).rows))
+                flags.set("storage_backend", "cpu")
+                try:
+                    rows_cpu = sorted(map(tuple, ok(q).rows))
+                finally:
+                    flags.set("storage_backend", "tpu")
+                assert rows_dev == rows_cpu
+                assert rt.stats["mirror_builds"] == builds0, \
+                    "duplicate delivery forced a rebuild"
+                assert rt.stats["mirror_absorbs"] > 0
+                # rebuild oracle: a from-scratch scan agrees
+                with rt._lock:
+                    rt.mirrors.clear()
+                assert sorted(map(tuple, ok(q).rows)) == rows_dev
+            finally:
+                kv.delta_since = orig
+        finally:
+            flags.set("storage_backend", prev)
+            c.stop()
+
+
+# ==================================== remote absorb-vs-rebuild parity
+class TestRemoteAbsorbParity:
+    def test_peer_writes_absorb_over_the_wire_with_parity(self):
+        """The remote differential, mirroring tests/test_absorb.py: a
+        2-storaged space served across the RPC boundary folds PEER
+        writes through the delta stream — peer_absorbs grows, the
+        steady window pays zero rebuilds, and every step stays
+        bit-exact with the CPU loop (plus the final rebuild oracle)."""
+        from nebula_tpu.cluster import LocalCluster
+        prev = flags.get("storage_backend")
+        flags.set("storage_backend", "tpu")
+        c = LocalCluster(num_storage=2, tpu_backend="remote")
+        try:
+            cl = c.client()
+
+            def ok(s):
+                r = cl.execute(s)
+                assert r.ok(), f"{s}: {r.error_msg}"
+                return r
+
+            ok("CREATE SPACE rp(partition_num=4, replica_factor=1)")
+            c.refresh_all()
+            ok("USE rp")
+            ok("CREATE EDGE e(w int)")
+            c.refresh_all()
+            n = 24
+            ok("INSERT EDGE e(w) VALUES "
+               + ", ".join(f"{i}->{i % n + 1}:({i})"
+                           for i in range(1, n + 1)))
+            qs = ["GO 2 STEPS FROM 1, 9 OVER e YIELD e._dst",
+                  "GO FROM 3, 4, 5 OVER e YIELD e._dst, e.w",
+                  "GO FROM 2 OVER e REVERSELY YIELD e._dst"]
+            for q in qs:
+                ok(q)                        # device mirror builds
+
+            def serving_rt():
+                # the storaged-side deviceGo runtime that actually built
+                rts = [node.service._device_rt for node in c.storage_nodes
+                       if node.service._device_rt is not None]
+                rts = [rt for rt in rts if rt.mirrors]
+                assert rts, "no device runtime built a mirror"
+                return rts[0]
+
+            rt = serving_rt()
+            builds0 = rt.stats["mirror_builds"]
+            import random
+            rng = random.Random(29)
+            for step in range(8):
+                s, d = rng.randrange(n) + 1, rng.randrange(n) + 1
+                ok(f"INSERT EDGE e(w) VALUES {s}->{d}@{50 + step}"
+                   f":({step})")
+                q = qs[step % len(qs)]
+                rows_dev = sorted(map(tuple, ok(q).rows))
+                flags.set("storage_backend", "cpu")
+                try:
+                    rows_cpu = sorted(map(tuple, ok(q).rows))
+                finally:
+                    flags.set("storage_backend", "tpu")
+                assert rows_dev == rows_cpu, q
+            assert rt.stats["mirror_builds"] == builds0, \
+                "peer writes forced remote rebuilds"
+            assert rt.stats["peer_absorbs"] > 0, \
+                "no write window folded events streamed from the peer"
+            assert rt.stats["peer_absorb_events"] > 0
+            # rebuild oracle on the remote path
+            finals = [sorted(map(tuple, ok(q).rows)) for q in qs]
+            with rt._lock:
+                rt.mirrors.clear()
+            assert [sorted(map(tuple, ok(q).rows)) for q in qs] == finals
+        finally:
+            flags.set("storage_backend", prev)
+            c.stop()
+
+
+# ============================================== failover ladder units
+class _LadderRt:
+    """RemoteDeviceRuntime with scripted per-host responses."""
+
+    def __new__(cls, script):
+        from nebula_tpu.storage.device import RemoteDeviceRuntime
+        rt = RemoteDeviceRuntime(meta_client=None, schema_man=None,
+                                 client_manager=None)
+        rt.attempts = []
+
+        def fake_call(host, method, req, ExcType):
+            rt.attempts.append(str(host))
+            out = script[str(host)]
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+        rt._call = fake_call
+        return rt
+
+
+def _go(rt, ladder):
+    from types import SimpleNamespace
+    rt._device_hosts = lambda sid: ladder
+    sentence = SimpleNamespace(step=SimpleNamespace(steps=1, upto=False))
+    executor = SimpleNamespace(sentence=sentence)
+    return rt.run_go(executor, 5, [1], [1], 1, {1: "e"}, [], False,
+                     None, {}, [])
+
+
+class TestFailoverLadder:
+    LADDER = [(("h1", 1), [1, 2]), (("h2", 1), [1, 2])]
+
+    def test_degraded_decline_retries_replica(self):
+        ok = {"ok": True, "columns": ["c"], "rows": []}
+        rt = _LadderRt({"('h1', 1)": TpuDecline("sick", degraded=True,
+                                                retriable=True),
+                        "('h2', 1)": ok})
+        out = _go(rt, list(self.LADDER))
+        assert out is not None
+        assert rt.attempts == ["('h1', 1)", "('h2', 1)"]
+        # the sick replica is decline-cached for the TTL window
+        assert rt._dev_decline_active(5, "('h1', 1)")
+        assert not rt._dev_decline_active(5, "('h2', 1)")
+
+    def test_transport_failure_retries_replica(self):
+        ok = {"ok": True, "columns": ["c"], "rows": []}
+        rt = _LadderRt({"('h1', 1)": TpuDecline("rpc failed",
+                                                retriable=True),
+                        "('h2', 1)": ok})
+        assert _go(rt, list(self.LADDER)) is not None
+        assert len(rt.attempts) == 2
+
+    def test_semantic_decline_goes_straight_to_cpu(self):
+        rt = _LadderRt({"('h1', 1)": TpuDecline("mesh-sharded"),
+                        "('h2', 1)": {"ok": True, "columns": [],
+                                      "rows": []}})
+        with pytest.raises(TpuDecline):
+            _go(rt, list(self.LADDER))
+        assert rt.attempts == ["('h1', 1)"], \
+            "a semantic decline must not burn replica round trips"
+
+    def test_exhausted_ladder_raises_last_degraded(self):
+        rt = _LadderRt({"('h1', 1)": TpuDecline("a", degraded=True,
+                                                retriable=True),
+                        "('h2', 1)": TpuDecline("b", degraded=True,
+                                                retriable=True)})
+        with pytest.raises(TpuDecline) as ei:
+            _go(rt, list(self.LADDER))
+        assert ei.value.degraded
+        assert len(rt.attempts) == 2
+
+    def test_fully_declined_ladder_probes_only_primary(self):
+        """During a fleet-wide outage the decline cache must cheapen
+        the ladder to ONE probe per query (the primary), not one
+        failed RPC per rung for the whole TTL window."""
+        rt = _LadderRt({k: TpuDecline("sick", degraded=True,
+                                      retriable=True)
+                        for k in ("('h1', 1)", "('h2', 1)")})
+        with pytest.raises(TpuDecline):
+            _go(rt, list(self.LADDER))        # both probed + noted
+        assert len(rt.attempts) == 2
+        rt.attempts.clear()
+        with pytest.raises(TpuDecline):
+            _go(rt, list(self.LADDER))        # within the TTL window
+        assert len(rt.attempts) == 1, \
+            "later rungs inside a decline window must be skipped"
+
+    def test_semantic_decline_blames_the_raising_host(self):
+        """A semantic decline raised by rung 2 after rung 1's
+        transport failure carries rung 2's host, so UPTO-style
+        negative caches never pin the healthy primary."""
+        rt = _LadderRt({"('h1', 1)": TpuDecline("rpc failed",
+                                                retriable=True),
+                        "('h2', 1)": TpuDecline("mesh-sharded there")})
+        with pytest.raises(TpuDecline) as ei:
+            _go(rt, list(self.LADDER))
+        assert str(ei.value.host) == "('h2', 1)"
+
+    def test_replica_cap_bounds_the_ladder(self):
+        saved = flags.get("device_failover_replicas")
+        flags.set("device_failover_replicas", 1)
+        try:
+            rt = _LadderRt({"('h1', 1)": TpuDecline("a", degraded=True,
+                                                    retriable=True),
+                            "('h2', 1)": {"ok": True, "columns": [],
+                                          "rows": []}})
+            with pytest.raises(TpuDecline):
+                _go(rt, list(self.LADDER))
+            assert len(rt.attempts) == 1, "ladder must be off at 1"
+        finally:
+            flags.set("device_failover_replicas", saved)
+
+    def test_decline_ttl_lapses(self):
+        saved = flags.get("device_decline_ttl_s")
+        flags.set("device_decline_ttl_s", 0.05)
+        try:
+            rt = _LadderRt({})
+            rt._note_dev_declined(5, "h1")
+            assert rt._dev_decline_active(5, "h1")
+            time.sleep(0.06)
+            assert not rt._dev_decline_active(5, "h1")
+        finally:
+            flags.set("device_decline_ttl_s", saved)
+
+
+class TestLadderOrdering:
+    def _rt(self, alloc, briefs, declined=()):
+        from types import SimpleNamespace
+
+        from nebula_tpu.storage.device import RemoteDeviceRuntime
+        meta = SimpleNamespace(parts_alloc=lambda sid: alloc,
+                               device_briefs=lambda: briefs)
+        rt = RemoteDeviceRuntime(meta_client=meta, schema_man=None,
+                                 client_manager=None)
+        for h in declined:
+            rt._note_dev_declined(7, h)
+        return rt
+
+    ALLOC = {1: ["127.0.0.1:1", "127.0.0.1:2"],
+             2: ["127.0.0.1:1", "127.0.0.1:2"]}
+
+    def test_freshest_healthy_replica_first(self):
+        briefs = {"127.0.0.1:1": {"7": {"generation": 3}},
+                  "127.0.0.1:2": {"7": {"generation": 9}}}
+        rt = self._rt(self.ALLOC, briefs)
+        ladder = rt._device_hosts(7)
+        assert [str(h) for h, _p in ladder] == \
+            ["127.0.0.1:2", "127.0.0.1:1"]
+        assert ladder[0][1] == [1, 2]        # the SAME parts, any rung
+
+    def test_open_breaker_ranks_behind_healthy(self):
+        briefs = {"127.0.0.1:1": {"7": {"generation": 9,
+                                        "breaker_open": True}},
+                  "127.0.0.1:2": {"7": {"generation": 1}}}
+        rt = self._rt(self.ALLOC, briefs)
+        assert str(rt._device_hosts(7)[0][0]) == "127.0.0.1:2"
+
+    def test_declined_replica_sorts_last_but_stays(self):
+        rt = self._rt(self.ALLOC, {}, declined=("127.0.0.1:1",))
+        ladder = rt._device_hosts(7)
+        assert [str(h) for h, _p in ladder] == \
+            ["127.0.0.1:2", "127.0.0.1:1"]
+        assert len(ladder) == 2, "declined replicas stay as last resort"
+
+    def test_briefs_failure_is_advisory(self):
+        from types import SimpleNamespace
+
+        def boom():
+            raise RuntimeError("metad away")
+
+        from nebula_tpu.storage.device import RemoteDeviceRuntime
+        meta = SimpleNamespace(parts_alloc=lambda sid: self.ALLOC,
+                               device_briefs=boom)
+        rt = RemoteDeviceRuntime(meta_client=meta, schema_man=None,
+                                 client_manager=None)
+        assert len(rt._device_hosts(7)) == 2

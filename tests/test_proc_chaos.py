@@ -437,6 +437,263 @@ class TestKillMatrix:
                 time.sleep(0.2)
             assert post > 0, "absorption did not resume after recovery"
 
+    def test_partitioned_raft_leader_zero_acked_loss(self, tmp_path):
+        """Partition cell (ISSUE 13): the raft leader of the queried
+        part is netsplit away from its followers while a write stream
+        is live.  The survivors elect, the client's leader chase
+        converges on the new leader, writes keep acking — and after
+        the heal, EVERY acked write is served (zero acked loss) with
+        nothing present that was never attempted (no split-brain
+        divergence)."""
+        with ProcCluster(str(tmp_path), num_storage=3,
+                         extra_flags=FAST_RAFT) as c:
+            cl = c.client()
+            _seed_space(cl, "pl", partition_num=1, replica_factor=3)
+            import json
+            leader = None
+            for name in c.storage_names:
+                admin = json.loads(c.daemons[name]._http("/admin"))
+                if any(st["space"] > 0 and st["role"] == "LEADER"
+                       for st in admin["parts"]):
+                    leader = name
+                    break
+            assert leader, "no data-part leader found"
+            followers = [n for n in c.storage_names if n != leader]
+
+            acked, attempted = [], []
+            stop = threading.Event()
+
+            def writer():
+                g = c.client()
+                g.execute("USE pl")
+                i = 0
+                while not stop.is_set() and i < 3000:
+                    i += 1
+                    attempted.append(i)
+                    # a statement budget keeps every write attempt
+                    # bounded while the deposed leader still thinks it
+                    # leads (its quorum-less appends fail typed, the
+                    # client re-discovers) — a timed-out write is
+                    # simply not acked
+                    if g.execute(f"TIMEOUT 4000 INSERT EDGE e(w) "
+                                 f"VALUES {i}->{i + 50000}:({i})").ok():
+                        acked.append(i)
+                g.disconnect()
+
+            t = threading.Thread(target=writer, daemon=True)
+            t.start()
+            while len(acked) < 20:          # stream provably live
+                time.sleep(0.02)
+            # the split: leader alone vs both followers, both
+            # directions cut; graphd + metad keep full connectivity
+            c.netsplit([leader], followers)
+            # net.partitioned journaled inside the leader (the /events
+            # chaos timeline)
+            assert any(e["kind"] == "net.partitioned"
+                       for e in c.events(leader))
+            pre_heal = len(acked)
+            # the surviving majority must elect and resume acking —
+            # generously bounded: the client must first burn typed
+            # failures against the deposed leader, invalidate its
+            # leader cache, and chase hints to the new one
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline \
+                    and len(acked) < pre_heal + 10:
+                time.sleep(0.2)
+            assert len(acked) >= pre_heal + 10, \
+                "writes never resumed through the surviving quorum"
+            c.heal()
+            assert any(e["kind"] == "net.healed"
+                       for e in c.events(leader))
+            time.sleep(2.0)                 # deposed leader rejoins
+            stop.set()
+            t.join(timeout=60)
+
+            vids = ",".join(str(i) for i in acked)
+            deadline = time.monotonic() + 40
+            rows = None
+            while time.monotonic() < deadline:
+                r = cl.execute(f"GO FROM {vids} OVER e YIELD e._dst")
+                if r.ok() and r.completeness == 100:
+                    rows = _dst_set(r)
+                    break
+                time.sleep(0.3)
+            assert rows is not None, "reads never converged after heal"
+            missing = [i for i in acked if i + 50000 not in rows]
+            assert not missing, \
+                f"ACKED writes lost across the partition: {missing[:5]}"
+            allowed = {i + 50000 for i in attempted}
+            garbage = [d for d in rows if d >= 50000 and d not in allowed]
+            assert not garbage, f"split-brain rows nobody wrote: {garbage}"
+
+    def test_mirror_host_partitioned_mid_delta_stream(self, tmp_path):
+        """Partition cell (ISSUE 13): the device-serving storaged is
+        split from the peer whose delta log feeds its mirror, while
+        writes keep landing on the peer.  During the split every query
+        still completes at 100 (ladder / CPU loop).  The shrunk delta
+        log trims past the wedged cursor, so the heal surfaces a TYPED
+        mirror.absorb_failed (peer-cursor-truncated / peer-cursor-gap)
+        that degrades to the rebuild — and absorption then RESUMES
+        (peer_absorb counter grows past its pre-split value)."""
+        from nebula_tpu.tools.bench_suite import _prom_value
+        extra = {"store_delta_log_cap": 8, "device_decline_ttl_s": 1.0}
+        with ProcCluster(str(tmp_path), num_storage=2,
+                         storage_backend="tpu", extra_flags=extra) as c:
+            cl = c.client()
+            _ok(cl, "CREATE SPACE md(partition_num=4, replica_factor=1)")
+            _ok(cl, "USE md")
+            _ok(cl, "CREATE EDGE e(w int)")
+            n = 40
+            _ok(cl, "INSERT EDGE e(w) VALUES "
+                + ", ".join(f"{i}->{i % n + 1}@0:({i})"
+                            for i in range(1, n + 1)))
+            goq = "GO 2 STEPS FROM 1, 9, 17 OVER e YIELD e._dst"
+            _ok(cl, goq)                    # the device mirror builds
+
+            def peer_absorbs_total():
+                return sum(_prom_value(c.metrics(s),
+                                       "nebula_tpu_peer_absorb_count")
+                           for s in c.storage_names)
+
+            # prove the STREAM works before the chaos: writes landing
+            # on peer-led parts absorb at O(delta), no remote rebuild
+            deadline = time.monotonic() + 30
+            i = 0
+            while time.monotonic() < deadline \
+                    and peer_absorbs_total() == 0:
+                i += 1
+                _ok(cl, f"INSERT EDGE e(w) VALUES "
+                        f"{i % n + 1}->{(i * 7) % n + 1}@{100 + i}"
+                        f":({i})")
+                _ok(cl, goq)
+            assert peer_absorbs_total() > 0, \
+                "peer-delta absorption never engaged pre-partition"
+            pre_split = peer_absorbs_total()
+
+            # the serving host is whichever built a device mirror
+            server = max(c.storage_names, key=lambda s: _prom_value(
+                c.metrics(s), "nebula_tpu_mirror_builds",
+                'runtime="device"'))
+            peer = next(s for s in c.storage_names if s != server)
+            # vids whose part the PEER leads: writes during the split
+            # (BOTH endpoints — the reverse in-edge lands on the dst's
+            # part) stay in the peer's delta log, so the trim wedges
+            # exactly the STREAMED cursor (a local-log trim on the
+            # server would mask the typed peer reason)
+            import json
+            admin = json.loads(c.daemons[peer]._http("/admin"))
+            peer_parts = {st["part"] for st in admin["parts"]
+                          if st["space"] > 0 and st["role"] == "LEADER"}
+            assert peer_parts, "peer leads no parts"
+            peer_srcs = [v for v in range(1, n + 1)
+                         if id_hash(v, 4) in peer_parts]
+            assert len(peer_srcs) >= 2
+            c.netsplit([server], [peer])
+
+            # during the split: writes keep acking (graphd reaches
+            # both) and every read completes at 100 — ladder or CPU.
+            # 30 single-edge commits to peer-led parts drive the
+            # peer's delta log far past the shrunk cap, so the wedged
+            # cursor is trimmed and the post-heal stream break is the
+            # TYPED truncation, not a seamless catch-up
+            for j in range(30):
+                s = peer_srcs[j % len(peer_srcs)]
+                d = peer_srcs[(j + 1) % len(peer_srcs)]
+                _ok(cl, f"INSERT EDGE e(w) VALUES "
+                        f"{s}->{d}@{500 + j}:({j})")
+            r = _ok(cl, goq)
+            assert r.completeness == 100, \
+                "query lost completeness during the partition"
+
+            c.heal()
+            # post-heal: the wedged cursor is typed and the rebuild
+            # re-anchors; fresh writes then absorb again
+            deadline = time.monotonic() + 40
+            resumed = False
+            k = 0
+            while time.monotonic() < deadline:
+                k += 1
+                s = peer_srcs[k % len(peer_srcs)]
+                d = peer_srcs[(k + 1) % len(peer_srcs)]
+                _ok(cl, f"INSERT EDGE e(w) VALUES "
+                        f"{s}->{d}@{900 + k}:({k})")
+                _ok(cl, goq)
+                if peer_absorbs_total() > pre_split:
+                    resumed = True
+                    break
+                time.sleep(0.2)
+            assert resumed, \
+                "peer-delta absorption did not resume after the heal"
+            evs = [e for e in c.events(server) + c.events(peer)
+                   if e["kind"] == "mirror.absorb_failed"
+                   and str(e.get("reason", "")).startswith("peer-")]
+            assert evs, ("no TYPED peer-delta stream break journaled "
+                         "across the partition")
+            # parity after the chaos: device rows == CPU rows
+            rows_dev = _dst_set(_ok(cl, goq))
+            cpu_addr = c.add_graphd("graphd-cpu",
+                                    {"storage_backend": "cpu"})
+            cpu = c.client(addr=cpu_addr)
+            _ok(cpu, "USE md")
+            assert _dst_set(_ok(cpu, goq)) == rows_dev, \
+                "device/CPU divergence after partition chaos"
+
+    def test_graphd_partitioned_from_storaged_ladder_serves(
+            self, tmp_path):
+        """Partition cell (ISSUE 13): graphd loses its link to the
+        PREFERRED device-serving storaged while the replica one RPC
+        away stays healthy.  The failover ladder must retry the same
+        parts on that replica — device-path completeness stays 100 and
+        the failover counters prove a replica (not the CPU loop)
+        served."""
+        from nebula_tpu.tools.bench_suite import _prom_value
+        with ProcCluster(str(tmp_path), num_storage=2,
+                         storage_backend="tpu") as c:
+            cl = c.client()
+            _ok(cl, "CREATE SPACE gp(partition_num=4, replica_factor=1)")
+            _ok(cl, "USE gp")
+            _ok(cl, "CREATE EDGE e(w int)")
+            n = 30
+            _ok(cl, "INSERT EDGE e(w) VALUES "
+                + ", ".join(f"{i}->{i % n + 1}@0:({i})"
+                            for i in range(1, n + 1)))
+            goq = "GO 2 STEPS FROM 1, 5 OVER e YIELD e._dst"
+            want = _dst_set(_ok(cl, goq))
+            # the preferred rung: the storaged that has device-served
+            server = max(c.storage_names, key=lambda s: _prom_value(
+                c.metrics(s), "nebula_storage_device_go_qps_total"))
+            other = next(s for s in c.storage_names if s != server)
+            served0 = _prom_value(c.metrics(other),
+                                  "nebula_storage_device_go_qps_total")
+            c.partition("graphd", server)
+
+            # the ladder serves the SAME parts from the other replica:
+            # complete rows, device-served, failover counters move
+            deadline = time.monotonic() + 30
+            good = None
+            while time.monotonic() < deadline:
+                r = cl.execute(goq)
+                if r.ok() and r.completeness == 100 \
+                        and _dst_set(r) == want \
+                        and _prom_value(
+                            c.metrics(other),
+                            "nebula_storage_device_go_qps_total") \
+                        > served0:
+                    good = r
+                    break
+                time.sleep(0.3)
+            assert good is not None, \
+                "replica never device-served behind the partition"
+            gm = c.metrics("graphd")
+            assert _prom_value(
+                gm, "nebula_graph_device_failover_retries_total") > 0, \
+                "ladder never retried"
+            assert _prom_value(
+                gm, "nebula_graph_device_failover_served_total") > 0, \
+                "no query was served by a replica via the ladder"
+            c.heal()
+            assert _dst_set(_ok(cl, goq)) == want
+
     def test_kill_follower_mid_snapshot_install(self, tmp_path):
         """Snapshot cell: a follower dead long enough for the leader's
         WAL to trim past it must catch up via snapshot transfer on

@@ -31,3 +31,21 @@ def test_write_while_serve_soak_smoke(tmp_path):
     assert row["killed_at_s"] is not None
     assert row["go_p99_ms"] is not None
     assert row["path_p99_ms"] is not None
+
+
+def test_peer_serve_soak_smoke(tmp_path):
+    """Multi-host leg (ISSUE 13 acceptance): 2 storaged, parts spread,
+    the serving host folds its peer through the deviceScanDelta
+    stream.  Beyond the shared invariants (parity, zero acked loss,
+    zero steady-window rebuilds) the bench asserts peer_absorbs > 0 —
+    peer writes rode the stream, not the O(m) remote rebuild."""
+    from nebula_tpu.tools.bench_suite import bench_peer_serve
+    results: list = []
+    row = bench_peer_serve(results, duration_s=40.0,
+                           run_dir=str(tmp_path))
+    assert row["num_storage"] == 2
+    assert row["peer_absorbs_steady_window"] > 0
+    assert row["rebuilds_steady_window"] == 0
+    assert row["absorbs_steady_window"] > 0
+    assert row["delta_overflow"] == 0
+    assert row["write_ops"] > 100
