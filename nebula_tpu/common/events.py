@@ -46,6 +46,11 @@ EVENT_KINDS = (
     "query.shed",            # admission control rejected a query
                              # (queue full / budget provably unmeetable
                              # — graph/batch_dispatch.py)
+    "query.joined_midflight",  # a query's start frontier OR-merged
+                             # into an ALREADY-RUNNING continuous lane
+                             # batch at a hop boundary
+                             # (graph/batch_dispatch.py
+                             # _ContinuousStream, docs/admission.md)
     "wal.truncated",         # recovery cut unverifiable frames off a
                              # WAL segment (kvstore/wal.py CRC check —
                              # docs/durability.md)

@@ -74,6 +74,15 @@ METRIC_NAMES = (
     # deadline-exceeded counters, admission wait histogram, the
     # closed-loop batch-window gauge
     "graph.admission.*",
+    # continuous hop-boundary dispatch (graph/batch_dispatch.py
+    # ContinuousGoScheduler, docs/admission.md "Continuous dispatch"):
+    # join/leave/eviction counters, the per-tick lane-occupancy
+    # histogram, live seated/queued gauges (the chaos lane-leak
+    # assertion's surface) and the idle-fraction share
+    "graph.continuous.*",
+    # the window controller's depth/shed signals as a replica-count
+    # recommendation for an external autoscaler (docs/admission.md)
+    "graph.autoscale.recommended_replicas",
     # rpc / fault injection
     "rpc.fault.injected",
     "rpc.fault_injected.*",          # per-method fault counters
@@ -134,6 +143,11 @@ METRIC_NAMES = (
     "tpu.device_compute.latency_us",
     "tpu.roofline.achieved_gbps",
     "tpu.fetch.bytes",
+    # device idle share since the previous scrape, both dispatch modes
+    # (graph/batch_dispatch.py _DeviceBusyMeter): windowed mode idles
+    # between windows, the continuous pipeline's double-buffered hop
+    # loop exists to drive this toward zero (docs/admission.md)
+    "tpu.device_idle_frac",
     # device circuit breaker (tpu/runtime.py + storage/device.py,
     # docs/durability.md): opened/reclosed transitions, classified
     # runtime failures, fast-path declines while open, half-open

@@ -84,6 +84,10 @@ SPAN_NAMES = (
     "rpc.fault",              # zero-duration marker: injected fault
     "graph.admission",        # zero-duration marker: admission decision
                               # (shed / deadline drop — batch_dispatch)
+    "graph.continuous",       # zero-duration marker: a query's seat
+                              # trajectory through the continuous lane
+                              # batch (lane, join tick, midflight —
+                              # batch_dispatch _ContinuousStream)
     "tpu.breaker",            # zero-duration marker: device breaker
                               # decline / classified runtime failure
                               # (tpu/runtime.py, docs/durability.md)

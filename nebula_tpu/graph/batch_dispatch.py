@@ -43,6 +43,26 @@ static window cap is replaced by a closed-loop controller
 deep queues already pool, so the artificial wait collapses to zero
 exactly when it would only add latency.
 
+Continuous dispatch (round 15, docs/admission.md "Continuous
+dispatch"): the windowed pipeline above still serves DISCRETE batches
+— every window pays a pooling wait, a full h2d/compute/d2h round trip,
+and a device-idle gap before the next window forms.  With
+``go_dispatch_mode=continuous`` (the default) multi-hop GO queries
+instead join and leave ONE in-flight lane batch per (space, OVER set)
+at hop boundaries, LLM-serving style: the 1-bit-packed uint8 lane
+dimension of the dense frontier is the seat map (_LaneLedger), a
+finishing query's lanes clear at its last hop, and a queued arrival's
+start frontier is scatter-merged into the freed lanes before the next
+hop dispatches (tpu/runtime.py _ContinuousGoSession).  No recompile
+moves: the lane width stays on the go_batch_widths rung ladder — only
+lane OCCUPANCY changes, and occupancy is data.  The pump pipeline is
+double-buffered: while hop k computes, the host assembles hop k-1's
+leavers and uploads the next joiners (tpu.device_idle_frac proves the
+overlap).  The windowed path is kept verbatim as the bit-exact parity
+oracle and rollback (``go_dispatch_mode=windowed``); BFS, mesh-sharded
+spaces, fused-filter and single-hop queries stay on their existing
+paths.
+
 The reference has no cross-query batching (each GO is its own RPC
 fan-out); this is TPU-native serving the same way the reference's
 per-request vertex bucketing (QueryBaseProcessor.inl:433-460) is
@@ -51,6 +71,7 @@ CPU-native parallelism.
 from __future__ import annotations
 
 import heapq
+import math
 import threading
 import time
 from typing import Dict, List, Tuple
@@ -63,13 +84,18 @@ from ..common.flags import flags
 from ..common.stats import stats
 
 flags.define("go_batch_window_ms", -1,
-             "batch-leader wait before dispatching coalesced device "
-             "queries — GO and FIND PATH both.  -1 (default): ADAPTIVE "
-             "— the wait tracks go_batch_window_frac of the key's "
-             "recent batch round-trip, so a high-latency device link "
-             "(remote tunnel: ~100 ms/launch) pools wide batches while "
-             "a local chip pays ~nothing.  0: dispatch immediately; "
-             ">0: fixed wait in ms")
+             "WINDOWED-mode batch-leader wait before dispatching "
+             "coalesced device queries — GO and FIND PATH both "
+             "(continuous-mode GO never sleeps: arrivals merge at the "
+             "next hop boundary instead).  -1 (default): ADAPTIVE — "
+             "the wait tracks go_batch_window_frac of the key's "
+             "recent batch round-trip, capped by the closed-loop "
+             "controller (_WindowController: the go_batch_window_max_ms "
+             "ceiling scales DOWN with queue depth), so a high-latency "
+             "device link (remote tunnel: ~100 ms/launch) pools wide "
+             "batches while a loaded or local-chip dispatcher pays "
+             "~nothing.  0: dispatch immediately; >0: fixed wait in ms "
+             "(bypasses the controller entirely)")
 flags.define("go_batch_window_frac", 0.12,
              "adaptive window as a fraction of the EMA batch "
              "round-trip (launch -> results ready), capped by the "
@@ -119,7 +145,27 @@ flags.define("admission_window_depth_ref", 8,
              "effective pooling-window cap is go_batch_window_max_ms "
              "/ (1 + depth_ema / ref) — at the reference depth the "
              "cap halves, and a saturated queue drives it toward 0 "
-             "because arrivals already pool behind in-flight batches")
+             "because arrivals already pool behind in-flight batches. "
+             "Also the autoscale signal's reference: "
+             "graph.autoscale.recommended_replicas grows as depth_ema "
+             "passes multiples of this depth (docs/admission.md)")
+
+# ---- continuous dispatch (docs/admission.md "Continuous dispatch") --
+flags.define("go_dispatch_mode", "continuous",
+             "multi-hop GO dispatch pipeline: 'continuous' (default) "
+             "keeps one in-flight lane batch per (space, OVER set) — "
+             "queries join/leave at hop boundaries over a resident "
+             "packed frontier, the device never idles between windows "
+             "— 'windowed' restores the discrete coalescing pipeline "
+             "(the bit-exact parity oracle and rollback).  BFS, "
+             "single-hop GO, fused-filter and mesh-sharded dispatch "
+             "always use the windowed pipeline")
+flags.define("autoscale_max_replicas", 8,
+             "ceiling of the graph.autoscale.recommended_replicas "
+             "gauge — the window controller's depth EMA plus the "
+             "recent shed rate, expressed as a graphd replica count "
+             "for an external autoscaler (proc_cluster boots them; "
+             "docs/admission.md)")
 
 
 # registered at import (not per-dispatcher) so SHOW STATS always has
@@ -127,6 +173,16 @@ flags.define("admission_window_depth_ref", 8,
 stats.register_stats("graph.admission.shed")
 stats.register_stats("graph.admission.deadline_exceeded")
 stats.register_histogram("graph.admission.wait_us")
+# continuous-dispatch lifecycle (zero in windowed mode): every seat
+# grant is a join, every completed extraction a leave, every
+# deadline/drain removal an eviction; occupancy is observed once per
+# hop tick (seat-count buckets, not latency buckets)
+stats.register_stats("graph.continuous.joins")
+stats.register_stats("graph.continuous.leaves")
+stats.register_stats("graph.continuous.evictions")
+stats.register_histogram("graph.continuous.lane_occupancy",
+                         buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0,
+                                  64.0, 128.0, 256.0, 512.0, 1024.0))
 
 
 class AdmissionShed(DeadlineExceeded):
@@ -238,6 +294,11 @@ class _WindowController:
             self.lat_ema_s = (seconds if self.lat_ema_s == 0.0
                               else 0.7 * self.lat_ema_s + 0.3 * seconds)
 
+    def depth(self) -> float:
+        """Current queue-depth EMA — the autoscale signal's input."""
+        with self._lock:
+            return self.depth_ema
+
     def cap_s(self) -> float:
         cap_raw = flags.get("go_batch_window_max_ms")
         cap_s = (25.0 if cap_raw is None else float(cap_raw)) / 1000.0
@@ -250,6 +311,757 @@ class _WindowController:
         return cap_s / (1.0 + depth / ref)
 
 
+class _DeviceBusyMeter:
+    """Wall-clock device-utilization proxy shared by both dispatch
+    modes: accumulates time during which at least one device dispatch
+    is in flight (windowed: a pipeline slot is held; continuous: a
+    stream has seated lanes) versus time the device sits idle.  The
+    scrape-time ``tpu.device_idle_frac`` gauge is the idle share since
+    the previous scrape — the number the continuous pipeline exists to
+    drive down (docs/admission.md "Continuous dispatch")."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self._mark = time.perf_counter()
+        self.busy_s = 0.0
+        self.idle_s = 0.0
+
+    def _roll(self, now: float) -> None:
+        """caller holds self._lock"""
+        span = now - self._mark
+        if span > 0:
+            if self._active > 0:
+                self.busy_s += span
+            else:
+                self.idle_s += span
+        self._mark = now
+
+    def begin(self) -> None:
+        with self._lock:
+            self._roll(time.perf_counter())
+            self._active += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self._roll(time.perf_counter())
+            self._active = max(0, self._active - 1)
+
+    def snapshot(self) -> Tuple[float, float]:
+        """(busy_s, idle_s) cumulative, rolled to now."""
+        with self._lock:
+            self._roll(time.perf_counter())
+            return self.busy_s, self.idle_s
+
+
+class _LaneLedger:
+    """The continuous batch's seat map: which of the B packed lanes
+    (bit k of word k>>3 in the resident uint8 frontier) are occupied.
+    Lanes hand out lowest-index-first so a lightly loaded stream's
+    occupancy clusters into few WORDS (the leave-extract fetch is per
+    word, docs/admission.md).  Pure bookkeeping — the caller (the
+    stream, under its condition) sequences it against the device-side
+    clear: a lane re-enters the free heap only after its bits were
+    cleared from the resident pair, which is what makes the join
+    kernel's scatter-add exact.  Double-seating any lane raises."""
+
+    __slots__ = ("width", "_free", "_seated")
+
+    def __init__(self, width: int):
+        self.width = int(width)
+        self._free = list(range(self.width))
+        heapq.heapify(self._free)
+        self._seated: set = set()
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("lane ledger exhausted")
+        lane = heapq.heappop(self._free)
+        if lane in self._seated:        # pragma: no cover — invariant
+            raise RuntimeError(f"lane {lane} double-seated")
+        self._seated.add(lane)
+        return lane
+
+    def release(self, lane: int) -> None:
+        if lane not in self._seated:
+            raise RuntimeError(f"lane {lane} released but not seated")
+        self._seated.discard(lane)
+        heapq.heappush(self._free, lane)
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def seated_count(self) -> int:
+        return len(self._seated)
+
+
+# an idle continuous stream releases its resident device frontier
+# pair (two uint8 [n_rows+1, W] buffers + table references) after this
+# long with no riders — the next arrival re-anchors against the
+# then-current mirror generation, which the drain path already
+# supports.  Keeps per-(space, OVER set) HBM from accumulating on
+# servers that touch many spaces.
+CONTINUOUS_IDLE_RELEASE_S = 30.0
+
+
+class ContinuousUnavailable(Exception):
+    """The stream could not anchor a device session for this space
+    (empty mirror, mesh-sharded tables, packing off): the submit
+    falls back to the windowed pipeline.  Internal control flow —
+    never surfaces to a caller of submit_batched."""
+
+
+class _Rider:
+    """One query riding the continuous batch: queued until a lane
+    frees, seated for steps-1 hop ticks, extracted + assembled at its
+    last hop (or evicted at its deadline).  Fields are written by the
+    stream pump under the stream condition; the submitting thread
+    reads result/error after ``done`` flips."""
+
+    __slots__ = ("payload", "steps", "upto", "reduce", "deadline",
+                 "tctx", "enq_t", "lane", "remaining", "joined_tick",
+                 "midflight", "done", "result", "mirror", "error")
+
+    def __init__(self, payload, steps: int, upto: bool, reduce,
+                 deadline):
+        self.payload = payload
+        self.steps = int(steps)
+        self.upto = bool(upto)
+        self.reduce = tuple(reduce) if reduce is not None else None
+        self.deadline = deadline
+        # the submitter's trace snapshot: the pump attaches it around
+        # the device phases this rider participates in, so a PROFILE
+        # still shows mirror/launch/kernel/fetch/assemble exactly like
+        # a windowed batch leader's would
+        self.tctx = tracing.capture()
+        self.enq_t = time.perf_counter()
+        self.lane = -1
+        self.remaining = 0
+        self.joined_tick = -1
+        self.midflight = False
+        self.done = False
+        self.result = None
+        self.mirror = None
+        self.error = None
+
+
+class _ContinuousStream:
+    """One (space, OVER set) continuous lane batch: a single pump
+    thread owns the device session (tpu/runtime.py
+    _ContinuousGoSession) and runs the hop-tick loop —
+
+        seat joiners -> scatter-merge their start frontiers ->
+        dispatch hop k -> mark leavers/evictions -> enqueue their
+        lane extraction + clear -> assemble hop k-1's leavers while
+        hop k computes -> wake their waiters
+
+    so the device always has the next hop enqueued while the host
+    does per-query work (the double-buffer overlap).  Mirror
+    generation changes drain the stream: seated riders finish on the
+    generation they captured (the published-generation contract,
+    docs/durability.md), new arrivals wait for the re-anchor —
+    read-your-writes holds because a query admitted after generation
+    g publishes is seated on a session anchored at >= g."""
+
+    def __init__(self, sched: "ContinuousGoScheduler", space_id: int,
+                 et_tuple: Tuple):
+        self.sched = sched
+        self.space_id = space_id
+        self.et_tuple = et_tuple
+        self.cond = threading.Condition()
+        self.queue: List[_Rider] = []
+        self.seated: Dict[int, _Rider] = {}
+        self.ledger = None              # _LaneLedger once anchored
+        self.hop_ema_s = 0.0            # EMA of one tick's wall time
+        self.tick_no = 0
+        self.draining = False           # generation change: no seats
+        self.stopping = False
+        self.retired = False            # scheduler replaces the stream
+        # pump-thread-only device state: the session is created,
+        # advanced and discarded exclusively on the pump thread — the
+        # condition above guards the SEAT bookkeeping, not this
+        self.session = None             # nebulint: guarded-by=none
+        # pump-only: the seat map saturated with a backlog — drain and
+        # re-anchor one batch-width rung wider (at least _widen_min
+        # lanes, so the re-anchor provably moves UP the ladder)
+        self._widen = False             # nebulint: guarded-by=none
+        self._widen_min = 0             # nebulint: guarded-by=none
+        # test hook: sleep this long before each tick so differential
+        # tests can force arrivals to land mid-flight deterministically
+        self.tick_delay_s = 0.0         # nebulint: guarded-by=none
+        self._meter_open = False        # nebulint: guarded-by=none
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True,
+            name=f"continuous-go-{space_id}")
+        self._pump_thread.start()
+
+    # --------------------------------------------------------- pump
+    def _pump(self) -> None:
+        pending = None
+        idle_since = None
+        while True:
+            with self.cond:
+                idle = (not self.queue and not self.seated
+                        and not self.stopping and pending is None)
+                stopping = self.stopping
+            if stopping:
+                break
+            if idle:
+                # end the busy interval OUTSIDE the condition — the
+                # device sync must not block submitters — then
+                # re-check under it before sleeping
+                self._meter_close()
+                now = time.perf_counter()
+                if idle_since is None:
+                    idle_since = now
+                elif self.session is not None and \
+                        now - idle_since > CONTINUOUS_IDLE_RELEASE_S:
+                    self._release_idle_session()
+                elif self.session is None and \
+                        now - idle_since > 3 * CONTINUOUS_IDLE_RELEASE_S:
+                    # long-dead stream: retire the pump thread too —
+                    # the scheduler replaces a retired stream on the
+                    # next submit, so per-(space, OVER set) threads
+                    # don't accumulate forever on long-lived servers
+                    with self.cond:
+                        if not self.queue and not self.seated:
+                            self.retired = True
+                            self.stopping = True
+                    continue
+                with self.cond:
+                    if not self.queue and not self.seated \
+                            and not self.stopping:
+                        self.cond.wait(0.25)
+                continue
+            idle_since = None
+            delay = self.tick_delay_s
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                pending = self._tick(pending)
+            except BaseException as ex:  # noqa: BLE001 — pump must
+                # survive: a dead pump wedges every future submit on
+                # this stream.  Fail everyone currently riding —
+                # INCLUDING the extracted-but-unassembled previous
+                # cohort, whose riders already left the seat map —
+                # drop the session (its donated buffers may be dead),
+                # and keep serving
+                err = (ex if isinstance(ex, Exception)
+                       else RuntimeError(f"pump interrupted: {ex!r}"))
+                self._fail_all(err)
+                if pending is not None:
+                    self._fail_cohort(pending, err)
+                    pending = None
+                if not isinstance(ex, Exception):
+                    raise
+        self._fail_all(RuntimeError("continuous dispatcher stopped"))
+        if pending is not None:
+            self._finish(pending)
+        self._meter_close()
+
+    def _release_idle_session(self) -> None:
+        """Drop the resident device pair after a sustained idle window
+        (CONTINUOUS_IDLE_RELEASE_S): the buffers free, the next
+        arrival re-anchors on the current mirror generation.  Pump
+        thread only."""
+        with self.cond:
+            if self.queue or self.seated:
+                return                  # woke up meanwhile
+            self.ledger = None
+        # pump-thread-only state (see __init__)
+        self.session = None  # nebulint: disable=lock-discipline
+
+    def _meter_close(self) -> None:
+        """Idle transition: force the in-flight device work to
+        completion so the busy interval ends honestly, then flip the
+        meter.  Pump thread only."""
+        if not self._meter_open:
+            return
+        sess = self.session
+        if sess is not None:
+            try:
+                sess.fp.block_until_ready()
+            except Exception:       # noqa: BLE001 — a dead session
+                pass                # still ends the busy interval
+        self.sched.meter.end()
+        # pump-thread-only state, like self.session
+        self._meter_open = False  # nebulint: disable=lock-discipline
+
+    def _fail_cohort(self, pending, ex: Exception) -> None:
+        """Wake an extracted-but-unassembled leave cohort with ``ex``
+        — its riders already left the seat map, so _fail_all cannot
+        reach them."""
+        _resolver, leavers, _m = pending
+        with self.cond:
+            for r in leavers:
+                if r.error is None and r.result is None:
+                    r.error = ex
+                r.done = True
+            self.cond.notify_all()
+
+    def _fail_all(self, ex: Exception) -> None:
+        """Batch-level failure: wake every queued and seated rider
+        with ``ex`` (their submitters classify it against the device
+        breaker exactly like a windowed batch failure) and reset the
+        seat map."""
+        # pump-thread-only state (see __init__)
+        self.session = None  # nebulint: disable=lock-discipline
+        with self.cond:
+            riders = list(self.queue) + list(self.seated.values())
+            self.queue.clear()
+            self.seated.clear()
+            self.ledger = None
+            self.draining = False
+            for r in riders:
+                if r.error is None and r.result is None:
+                    r.error = ex
+                r.done = True
+            self.cond.notify_all()
+
+    def _anchor(self) -> None:
+        """Ensure a device session over the CURRENT mirror generation
+        (pump thread, outside the condition — mirror() may build or
+        absorb for seconds).  A generation change while lanes are
+        seated flips ``draining`` instead: the seated riders finish on
+        what they captured, the stream re-anchors once empty."""
+        rt = self.sched.runtime
+        # a mirror build/absorb on the pump belongs to the FIRST
+        # queued rider's trace (windowed equivalence: the batch leader
+        # pays and shows it)
+        with self.cond:
+            tctx = self.queue[0].tctx if self.queue else None
+        with tracing.attach_captured(tctx):
+            self._anchor_traced(rt)
+
+    def _anchor_traced(self, rt) -> None:
+        sess = self.session
+        if sess is not None:
+            m = rt.mirror(self.space_id)
+            if m is not sess.m or self._widen:
+                with self.cond:
+                    if self.seated:
+                        self.draining = True
+                        return
+                # pump-thread-only state (see __init__)
+                self.session = None  # nebulint: disable=lock-discipline
+                self._widen = False  # nebulint: disable=lock-discipline
+                sess = None
+            else:
+                with self.cond:
+                    self.draining = False
+                return
+        with self.cond:
+            backlog = len(self.queue)
+        new_sess = rt.continuous_session(
+            self.space_id, self.et_tuple,
+            min_lanes=max(backlog, self._widen_min))
+        self._widen_min = 0  # nebulint: disable=lock-discipline
+        if new_sess is None:
+            raise ContinuousUnavailable(
+                f"space {self.space_id} cannot ride continuous "
+                f"dispatch")
+        # pump-thread-only state (see __init__)
+        self.session = new_sess  # nebulint: disable=lock-discipline
+        with self.cond:
+            self.draining = False
+            self.ledger = _LaneLedger(new_sess.B)
+
+    def _tick(self, pending):
+        """One hop tick; returns the next tick's pending leave cohort
+        (or None).  ``pending`` is the PREVIOUS tick's cohort — its
+        fetch+assembly runs here, after this tick's hop is enqueued,
+        which is the overlap the idle-frac gauge measures."""
+        t0 = time.perf_counter()
+        with self.cond:
+            # riders present BEFORE this tick's generation check are
+            # seatable this tick; later arrivals wait for the next
+            # tick's _anchor so a query admitted after generation g
+            # publishes can never seat on a < g session
+            # (read-your-writes — the windowed leader's mirror()-at-
+            # launch gives the same guarantee)
+            n_eligible = len(self.queue)
+            want_seats = n_eligible > 0 and not self.stopping
+        if want_seats:
+            try:
+                self._anchor()
+            except ContinuousUnavailable as ex:
+                # typed fallback: ONLY the queued riders bounce to the
+                # windowed pipeline; seated riders (an anchored session
+                # that went away is a _fail_all case, not this) ride on
+                with self.cond:
+                    waiting = list(self.queue)
+                    self.queue.clear()
+                    for r in waiting:
+                        r.error = ex
+                        r.done = True
+                    self.cond.notify_all()
+
+        sess = self.session
+        joiners: List[_Rider] = []
+        evicted: List[_Rider] = []
+        with self.cond:
+            # feed the closed-loop controller the continuous queue
+            # depth too — the autoscale recommendation must see the
+            # DEFAULT path's backlog, not just windowed leaders'
+            qdepth = len(self.queue)
+            if sess is not None and not self.draining \
+                    and not self.stopping:
+                # mid-flight means hops are ALREADY dispatched for
+                # previously seated riders — co-arrivals pooling into
+                # a fresh batch this same tick are the windowed case
+                was_running = bool(self.seated)
+                while self.queue and n_eligible > 0 \
+                        and self.ledger.free_count() > 0:
+                    n_eligible -= 1
+                    r = self.queue.pop(0)
+                    if r.deadline is not None and r.deadline.expired():
+                        r.error = DeadlineExceeded(
+                            "go: budget exhausted in the continuous "
+                            "admission queue")
+                        r.done = True
+                        self.sched.dispatcher._note_deadline_drop(
+                            ("go_batch_execute", self.space_id,
+                             self.et_tuple))
+                        continue
+                    r.lane = self.ledger.alloc()
+                    r.remaining = r.steps - 1
+                    r.joined_tick = self.tick_no
+                    r.midflight = was_running
+                    self.seated[r.lane] = r
+                    joiners.append(r)
+            # deadline evictions leave their seat this tick — their
+            # lanes clear alongside the leavers' and free next tick
+            for lane, r in list(self.seated.items()):
+                if r.deadline is not None and r.deadline.expired():
+                    del self.seated[lane]
+                    evicted.append(r)
+            seated_now = bool(self.seated)
+            backlog = len(self.queue)
+            lanes_full = (self.ledger is not None
+                          and self.ledger.free_count() == 0)
+            width = self.ledger.width if self.ledger is not None else 0
+            self.cond.notify_all()      # wake shed/expired waiters
+        self.sched.dispatcher.window.observe_depth(qdepth)
+        if sess is not None and backlog and lanes_full \
+                and not self._widen:
+            # seat map saturated with a waiting backlog: drain and
+            # re-anchor one batch-width rung wider (the ladder the
+            # windowed kernels already compile for — never a new
+            # program shape)
+            ladder = sorted(int(w) for w in
+                            str(flags.get("go_batch_widths") or
+                                "128,1024").split(",") if w.strip())
+            if ladder and width < ladder[-1]:
+                # pump-thread-only state (see __init__)
+                self._widen = True  # nebulint: disable=lock-discipline
+                self._widen_min = width + 1  # nebulint: disable=lock-discipline
+
+        new_pending = None
+        if sess is not None and (joiners or evicted or seated_now):
+            if not self._meter_open:
+                self.sched.meter.begin()
+                # pump-thread-only state (see __init__)
+                self._meter_open = True  # nebulint: disable=lock-discipline
+            if joiners:
+                # admission wait of the oldest rider seated this tick
+                # — the windowed leader's per-batch observation
+                stats.observe(
+                    "graph.admission.wait_us",
+                    (time.perf_counter()
+                     - min(r.enq_t for r in joiners)) * 1e6)
+            # device phase spans land on the FIRST joiner's trace —
+            # the windowed equivalence (the leader thread's PROFILE
+            # shows launch/kernel; riders see the seat markers)
+            jctx = joiners[0].tctx if joiners else None
+            leavers: List[_Rider] = []
+            resolver = None
+            try:
+                with tracing.attach_captured(jctx):
+                    with tracing.span("tpu.launch",
+                                      joiners=len(joiners), steps=1):
+                        if joiners:
+                            sess.join([(r.lane, r.payload.start_vids)
+                                       for r in joiners])
+                        with self.cond:
+                            has_work = bool(self.seated)
+                        if has_work:
+                            sess.hop()
+                            with self.cond:
+                                self.tick_no += 1
+                                for lane, r in \
+                                        list(self.seated.items()):
+                                    r.remaining -= 1
+                                    if r.remaining <= 0:
+                                        del self.seated[lane]
+                                        leavers.append(r)
+                    if leavers:
+                        resolver = sess.extract([(r.lane, r.upto)
+                                                 for r in leavers])
+                    if leavers or evicted:
+                        sess.clear([r.lane for r in leavers]
+                                   + [r.lane for r in evicted
+                                      if r.lane >= 0])
+            except BaseException as ex:
+                # leavers/evicted already left the seat map — the
+                # pump-level _fail_all can no longer reach them, so
+                # they must be woken HERE or their waiters hang
+                if isinstance(ex, Exception):
+                    with self.cond:
+                        for r in leavers + evicted:
+                            if r.error is None and r.result is None:
+                                r.error = ex
+                            r.done = True
+                        self.cond.notify_all()
+                raise
+            if joiners:
+                stats.add_value("graph.continuous.joins",
+                                len(joiners))
+                for r in joiners:
+                    if r.midflight:
+                        journal.record(
+                            "query.joined_midflight",
+                            detail=f"lane={r.lane} hops={r.steps - 1} "
+                                   f"tick={r.joined_tick}",
+                            space=self.space_id)
+            if leavers or evicted:
+                with self.cond:
+                    for r in leavers:
+                        self.ledger.release(r.lane)
+                    for r in evicted:
+                        if r.lane >= 0:
+                            self.ledger.release(r.lane)
+            with self.cond:
+                occupancy = len(self.seated)
+            stats.observe("graph.continuous.lane_occupancy",
+                          float(occupancy))
+            if leavers:
+                new_pending = (resolver, leavers, sess.m)
+        if evicted:
+            stats.add_value("graph.continuous.evictions",
+                            len(evicted))
+            with self.cond:
+                for r in evicted:
+                    r.error = DeadlineExceeded(
+                        "go: deadline expired mid-flight (evicted at "
+                        "a hop boundary)")
+                    r.done = True
+                self.cond.notify_all()
+
+        # hop k's work is on the device; assemble hop k-1's leavers
+        # NOW — host post-processing overlaps device compute
+        if pending is not None:
+            self._finish(pending)
+        # nothing left in flight: the cohort just produced has no hop
+        # to hide behind — flush it immediately rather than letting it
+        # age one idle-poll interval
+        if new_pending is not None:
+            with self.cond:
+                empty = not self.seated and not self.queue
+            if empty:
+                self._finish(new_pending)
+                new_pending = None
+        dur = time.perf_counter() - t0
+        with self.cond:
+            self.hop_ema_s = dur if self.hop_ema_s == 0.0 \
+                else 0.7 * self.hop_ema_s + 0.3 * dur
+        return new_pending
+
+    def _finish(self, pending) -> None:
+        """Force the leave cohort's extraction fetch, run the same
+        grouped assembly the windowed leader uses, wake the waiters.
+        Per-query failures stay per-query (Exception entries); a
+        cohort-level failure wakes every cohort member with it."""
+        resolver, leavers, m = pending
+        rt = self.sched.runtime
+        try:
+            # fetch + assembly spans land on the first leaver's trace
+            with tracing.attach_captured(leavers[0].tctx):
+                vs_lists = resolver()
+                results = rt.continuous_results(
+                    self.space_id, m, [r.payload for r in leavers],
+                    [r.reduce for r in leavers], vs_lists,
+                    self.et_tuple)
+        except Exception as ex:         # noqa: BLE001 — cohort-level
+            results = [ex] * len(leavers)
+        stats.add_value("graph.continuous.leaves", len(leavers))
+        with self.cond:
+            for r, out in zip(leavers, results):
+                if isinstance(out, Exception):
+                    r.error = out
+                else:
+                    r.result = out
+                    r.mirror = m
+                r.done = True
+            self.cond.notify_all()
+
+    # ------------------------------------------------------- submit
+    def submit(self, key: Tuple, payload, steps: int, upto: bool,
+               reduce):
+        """Queue one rider and block until its leave (or typed
+        failure).  Admission happens here, under the stream condition:
+        bounded queue + free-lane deadline feasibility — the estimate
+        counts SEATS (a lane frees at a hop boundary), not whole
+        windows (docs/admission.md)."""
+        dl = deadlines.current()
+        rider = _Rider(payload, steps, upto, reduce, dl)
+        disp = self.sched.dispatcher
+        with self.cond:
+            if flags.get("admission_control", True):
+                depth = len(self.queue)
+                qraw = flags.get("admission_queue_max")
+                qmax = 256 if qraw is None else int(qraw)
+                if depth >= qmax:
+                    disp._shed(key, "queue_full", depth)
+                if dl is not None:
+                    rem = dl.remaining_s()
+                    if rem <= 0:
+                        disp._deadline_reject(key, "expired", depth)
+                    elif self.hop_ema_s > 0.0:
+                        # seats free at hop boundaries: if every free
+                        # lane seats someone ahead of us we wait >= 1
+                        # tick for churn, then ride steps-1 hops — a
+                        # conservative LOWER bound, so a shed is
+                        # provably unmeetable
+                        free = self.ledger.free_count() \
+                            if self.ledger is not None else None
+                        wait_ticks = 0 if (free is None
+                                           or free > depth) else 1
+                        est_s = self.hop_ema_s \
+                            * (wait_ticks + max(1, steps - 1))
+                        if rem < est_s:
+                            if depth > 0:
+                                disp._shed(key, "deadline_unmeetable",
+                                           depth)
+                            disp._deadline_reject(
+                                key, "budget_below_round_trip", depth)
+            if self.stopping:
+                raise ContinuousUnavailable("stream stopping")
+            self.queue.append(rider)
+            self.cond.notify_all()
+            while not rider.done:
+                if dl is not None and dl.expired():
+                    if rider in self.queue:
+                        try:
+                            # plain list.remove, not a package Status
+                            self.queue.remove(rider)  # nebulint: disable=status-discard
+                        except ValueError:
+                            pass        # pump seated it meanwhile
+                        rider.error = DeadlineExceeded(
+                            f"go: deadline expired after "
+                            f"{(time.perf_counter() - rider.enq_t) * 1e3:.0f}"
+                            f" ms in the continuous queue")
+                        disp._note_deadline_drop(key)
+                        break
+                    # seated: the pump evicts at the next hop
+                    # boundary; bound the wait to the deadline so the
+                    # WAITER never blocks past it either way
+                if dl is None:
+                    self.cond.wait()
+                else:
+                    self.cond.wait(max(0.01, dl.remaining_s()))
+                    if not rider.done and dl.expired() \
+                            and rider not in self.queue:
+                        rider.error = DeadlineExceeded(
+                            "go: deadline expired mid-flight")
+                        disp._note_deadline_drop(key)
+                        break
+        if rider.error is not None:
+            raise rider.error
+        # the seat trajectory lands on the WAITER's own trace: a
+        # PROFILE of the query shows its lane, join tick and whether
+        # it merged into an already-running batch
+        tracing.annotate("graph.continuous", lane=rider.lane,
+                         joined_tick=rider.joined_tick,
+                         hops=rider.steps - 1,
+                         midflight=rider.midflight)
+        with self.sched.dispatcher._lock:
+            self.sched.dispatcher.stats["continuous_queries"] = \
+                self.sched.dispatcher.stats.get("continuous_queries",
+                                                0) + 1
+        return rider.result, rider.mirror
+
+    # ------------------------------------------------------ control
+    def stop(self, timeout_s: float = 10.0) -> None:
+        with self.cond:
+            self.stopping = True
+            self.cond.notify_all()
+        self._pump_thread.join(timeout=timeout_s)
+
+
+class ContinuousGoScheduler:
+    """The continuous-dispatch tier: one _ContinuousStream per
+    (space, OVER set), routed to from submit_batched when
+    ``go_dispatch_mode=continuous`` and the key is eligible (multi-hop
+    GO; BFS/mesh/fused stay windowed).  Scrape-time gauges expose the
+    live seat maps — the chaos suite's lane-leak assertion reads
+    graph.continuous.seated from /metrics."""
+
+    def __init__(self, runtime, dispatcher: "GoBatchDispatcher"):
+        self.runtime = runtime
+        self.dispatcher = dispatcher
+        self.meter = dispatcher.meter
+        self._lock = threading.Lock()
+        self._streams: Dict[Tuple, _ContinuousStream] = {}
+
+    @staticmethod
+    def route_eligible(key: Tuple) -> bool:
+        """Static routing decision from the shape key alone:
+        ('go_batch_execute', space, et_tuple, steps, upto, reduce).
+        Session-level eligibility (empty mirror, mesh tables) is the
+        pump's ContinuousUnavailable fallback."""
+        if flags.get("go_dispatch_mode") != "continuous":
+            return False
+        if not flags.get("tpu_packed_frontier", True):
+            return False
+        if int(flags.get("tpu_mesh_devices") or 0) > 1:
+            return False
+        if key[0] != "go_batch_execute" or len(key) < 6:
+            return False
+        try:
+            steps = int(key[3])
+        except (TypeError, ValueError):
+            return False
+        reduce = key[5]
+        if reduce is not None and reduce[0] not in ("count", "limit"):
+            return False
+        return steps >= 2
+
+    def submit(self, key: Tuple, payload):
+        st = self._stream(key[1], key[2])
+        return st.submit(key, payload, int(key[3]), bool(key[4]),
+                         key[5])
+
+    def _stream(self, space_id: int, et_tuple: Tuple
+                ) -> _ContinuousStream:
+        with self._lock:
+            st = self._streams.get((space_id, et_tuple))
+            # a long-idle stream retires its pump thread; the next
+            # submit replaces it (plain bool read — the retired flag
+            # only ever flips False -> True)
+            if st is None or st.retired:
+                st = self._streams[(space_id, et_tuple)] = \
+                    _ContinuousStream(self, space_id, et_tuple)
+            return st
+
+    def streams(self) -> List[_ContinuousStream]:
+        with self._lock:
+            return list(self._streams.values())
+
+    def seat_counts(self) -> Tuple[int, int]:
+        """(seated, queued) across every stream — the /metrics lane-
+        leak surface."""
+        seated = queued = 0
+        for st in self.streams():
+            with st.cond:
+                seated += len(st.seated)
+                queued += len(st.queue)
+        return seated, queued
+
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        for st in self.streams():
+            st.stop(timeout_s=timeout_s)
+
+
 class GoBatchDispatcher:
     def __init__(self, runtime):
         self.runtime = runtime
@@ -259,7 +1071,17 @@ class GoBatchDispatcher:
             max(1, int(flags.get("go_batch_inflight") or 3)))
         self.window = _WindowController()
         self.stats = {"batches": 0, "batched_queries": 0, "max_batch": 0,
-                      "query_errors": 0, "sheds": 0, "deadline_drops": 0}
+                      "query_errors": 0, "sheds": 0, "deadline_drops": 0,
+                      "continuous_queries": 0}
+        # device-utilization proxy shared by both dispatch modes
+        # (tpu.device_idle_frac) + the continuous seat-map tier; a
+        # runtime without continuous_session (the micro-bench fakes)
+        # keeps the windowed pipeline only
+        self.meter = _DeviceBusyMeter()
+        self.continuous = (ContinuousGoScheduler(runtime, self)
+                           if hasattr(runtime, "continuous_session")
+                           else None)
+        self._idle_mark = (0.0, 0.0)    # (busy_s, idle_s) last scrape
         # scrape-time gauges: live per-key queue depths + the current
         # closed-loop window cap (weak bound method — a discarded
         # dispatcher unregisters itself)
@@ -398,6 +1220,41 @@ class GoBatchDispatcher:
                             method=str(key[0]), space=str(key[1]))
         stats.set_gauge("graph.admission.window_ms",
                         round(self.window.cap_s() * 1000.0, 3))
+        # device idle share since the previous scrape — the continuous
+        # pipeline's headline gauge (1.0 = the device did nothing)
+        busy, idle = self.meter.snapshot()
+        d_busy = busy - self._idle_mark[0]
+        d_idle = idle - self._idle_mark[1]
+        self._idle_mark = (busy, idle)
+        if d_busy + d_idle > 0:
+            stats.set_gauge("tpu.device_idle_frac",
+                            round(d_idle / (d_busy + d_idle), 4))
+        if self.continuous is not None:
+            seated, queued = self.continuous.seat_counts()
+            stats.set_gauge("graph.continuous.seated", seated)
+            stats.set_gauge("graph.continuous.queued", queued)
+            if d_busy + d_idle > 0:
+                # deliberately the SAME measurement as
+                # tpu.device_idle_frac, exported under the serving-
+                # tier family name too: one _DeviceBusyMeter covers
+                # both dispatch modes (dashboards keyed on either
+                # name read identical values by design)
+                stats.set_gauge("graph.continuous.idle_frac",
+                                round(d_idle / (d_busy + d_idle), 4))
+        # the window controller's depth EMA + the recent shed rate as
+        # a replica-count recommendation (docs/admission.md): depth at
+        # the reference means the fleet needs ~2x the capacity; active
+        # shedding always asks for one more
+        depth_ema = self.window.depth()
+        ref_raw = flags.get("admission_window_depth_ref")
+        ref = 8.0 if ref_raw is None else float(ref_raw)
+        shed5 = stats.read_stats("graph.admission.shed.count.5") or 0.0
+        reco = math.ceil(1.0 + (depth_ema / ref if ref > 0 else 0.0))
+        if shed5 > 0:
+            reco += 1
+        cap = int(flags.get("autoscale_max_replicas") or 8)
+        stats.set_gauge("graph.autoscale.recommended_replicas",
+                        min(max(1, reco), cap))
 
     # ---------------------------------------------------------- submit
     def submit_batched(self, key: Tuple, payload):
@@ -413,7 +1270,20 @@ class GoBatchDispatcher:
         captured at admission: an unmeetable budget sheds here, an
         expired one wakes the waiter with DEADLINE_EXCEEDED even while
         its batch is still in flight — no waiter ever blocks past its
-        deadline."""
+        deadline.
+
+        Continuous routing (docs/admission.md "Continuous dispatch"):
+        an eligible multi-hop GO key rides the seat-map tier instead
+        of the windowed pipeline below; a stream that cannot anchor a
+        device session (empty mirror, mesh tables) bounces the rider
+        back here typed, so the windowed path stays the universal
+        fallback."""
+        if self.continuous is not None \
+                and ContinuousGoScheduler.route_eligible(key):
+            try:
+                return self.continuous.submit(key, payload)
+            except ContinuousUnavailable:
+                pass                    # windowed fallback below
         st = self._state(key)
         dl = deadlines.current()
         req = _Request(payload, dl)
@@ -487,6 +1357,7 @@ class GoBatchDispatcher:
                             time.sleep(window)
                         self._inflight.acquire(self._priority_for_key(key))
                         sem_held = True
+                        self.meter.begin()
                     finally:
                         st.cond.acquire()
                     max_b = int(flags.get("go_batch_max") or 1024)
@@ -495,6 +1366,7 @@ class GoBatchDispatcher:
                 except BaseException:       # cond is held here
                     if sem_held:
                         self._inflight.release()
+                        self.meter.end()
                     st.dispatching = False
                     st.cond.notify_all()
                     raise
@@ -610,6 +1482,7 @@ class GoBatchDispatcher:
                     results, mirror = [], None
             finally:
                 self._inflight.release()
+                self.meter.end()
             for i, r in enumerate(live):
                 out = results[i]
                 if isinstance(out, Exception):

@@ -548,6 +548,13 @@ class StorageService:
             # subset — and the client must not re-derive from it as if
             # it were the full row set
             resp["reduce"] = True
+        # capability echo: this build routes eligible multi-hop GO
+        # through the continuous seat-map tier (docs/admission.md).
+        # Advisory — result semantics are dispatch-mode-invariant (the
+        # windowed path is the bit-exact oracle), but the bench/chaos
+        # harnesses use the echo to prove which pipeline served
+        resp["continuous"] = flags.get("go_dispatch_mode") == \
+            "continuous"
         return resp
 
     def rpc_deviceFindPath(self, req: dict) -> dict:

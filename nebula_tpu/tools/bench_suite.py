@@ -948,6 +948,294 @@ def bench_peer_serve(results: list, duration_s: float = 180.0,
                              num_storage=2)
 
 
+def _paced_pass(c, space: str, queries: List[str], workers: int,
+                offered_qps: float, duration_s: float) -> dict:
+    """Open-loop FIXED-OFFERED-LOAD pass: worker w owns slots
+    w, w+W, w+2W... of a global ``offered_qps`` schedule and fires its
+    query at each slot time (never early; late slots fire immediately,
+    so backlog shows up as latency, exactly like a real arrival
+    process).  This is what makes the windowed-vs-continuous
+    comparison fair: both modes see the SAME arrival schedule."""
+    import time as _time
+
+    from ..common.status import ErrorCode
+    lock = threading.Lock()
+    lat_us: List[float] = []
+    sheds = [0]
+    errors: List[str] = []
+    start = [0.0]
+
+    def worker(wid: int):
+        g = c.client()
+        g.execute(f"USE {space}")
+        k = wid
+        interval = 1.0 / offered_qps
+        while True:
+            slot_t = start[0] + k * interval
+            now = _time.perf_counter()
+            if slot_t >= start[0] + duration_s:
+                return
+            if slot_t > now:
+                _time.sleep(slot_t - now)
+            q = queries[k % len(queries)]
+            t0 = _time.perf_counter()
+            r = g.execute(q)
+            dt_us = (_time.perf_counter() - t0) * 1e6
+            with lock:
+                if r.ok():
+                    lat_us.append(dt_us)
+                elif r.error_code == ErrorCode.E_DEADLINE_EXCEEDED:
+                    sheds[0] += 1
+                else:
+                    errors.append(r.error_msg)
+            k += workers
+
+    start[0] = _time.perf_counter()
+    ts = [threading.Thread(target=worker, args=(w,))
+          for w in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = _time.perf_counter() - start[0]
+    out = {
+        "workers": workers, "offered_qps": offered_qps,
+        "wall_s": round(wall, 1), "requests": len(lat_us),
+        "sheds": sheds[0], "errors": len(errors),
+        "qps": round(len(lat_us) / wall, 1),
+        "p50_ms": round(percentile(lat_us, 50) / 1000, 3)
+        if lat_us else None,
+        "p99_ms": round(percentile(lat_us, 99) / 1000, 3)
+        if lat_us else None,
+    }
+    if errors:
+        out["first_errors"] = errors[:3]
+    return out
+
+
+def bench_continuous(results: list, persons: int,
+                     duration_s: float = 120.0,
+                     offered_qps: float = 80.0,
+                     workers: int = 8) -> None:
+    """ISSUE 15 headline proof #1: at FIXED offered load, continuous
+    hop-boundary dispatch vs the windowed oracle — same seeded query
+    stream, same arrival schedule, p50/p99 per dispatch mode plus the
+    measured device idle fraction over each leg
+    (graph/batch_dispatch.py _DeviceBusyMeter: idle share of wall
+    time) and the join/leave counters proving the seat map actually
+    served.  The claim: continuous cuts multi-hop GO p99 at equal
+    offered qps BECAUSE the device idle fraction drops — arrivals
+    merge at hop boundaries instead of pooling behind a window."""
+    from ..cluster import LocalCluster
+    from ..common.flags import flags
+    from ..common.stats import stats as _stats_mgr
+    from .ldbc_gen import generate, load_cluster
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    saved = {n: flags.get(n) for n in ("go_dispatch_mode",
+                                       "storage_backend",
+                                       "admission_control",
+                                       "query_deadline_ms",
+                                       "tpu_sparse_go")}
+    try:
+        src, dst, props = generate(persons)
+        load_cluster(c, "ldbc", src, dst, props)
+        rng = np.random.default_rng(23)
+        vids = rng.integers(1, persons + 1, 512)
+        go_qs = [f"GO 3 STEPS FROM {v} OVER knows" for v in vids]
+        flags.set("storage_backend", "tpu")
+        # both legs on the DENSE packed kernel family: continuous only
+        # rides the dense seat map, and letting the windowed leg pick
+        # sparse would measure kernel choice, not dispatch mode
+        flags.set("tpu_sparse_go", False)
+        d = c.tpu_runtime.dispatcher
+        per_leg = duration_s / 2
+        for mode in ("windowed", "continuous"):
+            flags.set("go_dispatch_mode", mode)
+            # warm with the valve open (bench_soak stance): first-tick
+            # XLA compiles inflate the hop EMA past any sane budget,
+            # and a leg that sheds its own warmup records nothing
+            flags.set("admission_control", False)
+            flags.set("query_deadline_ms", 0)
+            g = c.client()
+            g.execute("USE ldbc")
+            for q in go_qs[:2 * workers]:       # warm kernels + stream
+                _ok(g, q)
+            flags.set("admission_control", True)
+            flags.set("query_deadline_ms", 10000)
+            busy0, idle0 = d.meter.snapshot()
+            joins0 = _stats_mgr.read_stats(
+                "graph.continuous.joins.sum.600") or 0.0
+            r = _paced_pass(c, "ldbc", go_qs, workers, offered_qps,
+                            per_leg)
+            busy1, idle1 = d.meter.snapshot()
+            joins1 = _stats_mgr.read_stats(
+                "graph.continuous.joins.sum.600") or 0.0
+            span = (busy1 - busy0) + (idle1 - idle0)
+            r["config"] = (f"continuous-vs-windowed GO 3 STEPS "
+                           f"({mode}, offered {offered_qps} qps)")
+            r["backend"] = "tpu"
+            r["dispatch_mode"] = mode
+            r["device_idle_frac"] = round((idle1 - idle0) / span, 4) \
+                if span > 0 else None
+            # the load-invariant form of the idle claim: how long the
+            # device pipeline is OCCUPIED per served query.  At a
+            # fixed offered load a mode that can't keep up shows low
+            # idle (saturated on padded windows) while stretching its
+            # wall clock — busy seconds per query is what actually
+            # drops when arrivals merge at hop boundaries
+            if r["requests"]:
+                r["busy_ms_per_query"] = round(
+                    (busy1 - busy0) / r["requests"] * 1e3, 3)
+            r["continuous_joins"] = int(joins1 - joins0)
+            results.append(r)
+            print(r, file=sys.stderr)
+        seated, queued = (d.continuous.seat_counts()
+                          if d.continuous else (0, 0))
+        assert (seated, queued) == (0, 0), "lane leak after the leg"
+    finally:
+        for k, v in saved.items():
+            flags.set(k, v)
+        c.stop()
+
+
+def bench_horizontal(results: list, duration_s: float = 120.0,
+                     workers: int = 16, n_vertices: int = 400,
+                     run_dir: Optional[str] = None) -> None:
+    """ISSUE 15 headline proof #2: the stateless tier scales
+    horizontally — a SECOND graphd subprocess against the SAME
+    storaged/device runtime behind a round-robin client must lift
+    aggregate closed-loop throughput >= 1.6x at <= 1.2x the
+    single-graphd p99.  graphd is the parse/plan/merge tier (pure
+    Python, one GIL per process); the storaged device runtime serves
+    both front ends from one seat-map batch, which is exactly the
+    continuous tier's horizontal story (ROADMAP item 3).
+
+    The recorded ratio is a function of the HOST's core count (the
+    JSON carries it): each graphd is a ~1-core GIL-bound process, so
+    the >= 1.6x acceptance needs at least one spare core for the
+    second front end — on a single-core container every process
+    multiplexes one core and the aggregate is core-bound (the
+    measured residual gain there is reduced GIL/scheduler
+    contention), exactly like the virtual-mesh leg is a semantics
+    measurement, not a multi-chip claim."""
+    import os
+    import tempfile
+
+    from .proc_cluster import ProcCluster
+    rd = run_dir or tempfile.mkdtemp(prefix="bench-horizontal-")
+    with ProcCluster(rd, num_storage=1, storage_backend="tpu") as c:
+        cl = c.client()
+        _ok(cl, "CREATE SPACE hz(partition_num=2, replica_factor=1)")
+        _ok(cl, "USE hz")
+        _ok(cl, "CREATE EDGE e(w int)")
+
+        def okr(stmt, tries=40):
+            # schema propagation to the storaged subprocess rides the
+            # shrunk load_data interval — poll the first write in
+            last = None
+            for _ in range(tries):
+                last = cl.execute(stmt)
+                if last.ok():
+                    return last
+                time.sleep(0.25)
+            raise AssertionError(f"{stmt}: {last.error_msg}")
+
+        okr("INSERT EDGE e(w) VALUES 999001->999002@0:(1)")
+        n = n_vertices
+        edges = [f"{i}->{i % n + 1}@0:({i})" for i in range(1, n + 1)]
+        edges += [f"{i}->{(i * 7 + 3) % n + 1}@1:({i})"
+                  for i in range(1, n + 1, 2)]
+        for lo in range(0, len(edges), 200):
+            _ok(cl, "INSERT EDGE e(w) VALUES "
+                + ", ".join(edges[lo:lo + 200]))
+        rng = np.random.default_rng(31)
+        qs = [f"GO 3 STEPS FROM {int(v)} OVER e YIELD e._dst"
+              for v in rng.integers(1, n + 1, 256)]
+        _ok(cl, qs[0])                    # device mirror builds
+
+        def closed_loop(addrs: List[str], secs: float) -> dict:
+            lock = threading.Lock()
+            lat_us: List[float] = []
+            errors: List[str] = []
+            stop_at = [time.perf_counter() + secs]
+
+            def worker(wid: int):
+                g = c.round_robin_client(addrs)
+                g.use("hz")
+                i = wid
+                while time.perf_counter() < stop_at[0]:
+                    t0 = time.perf_counter()
+                    r = g.execute(qs[i % len(qs)])
+                    dt = (time.perf_counter() - t0) * 1e6
+                    with lock:
+                        if r.ok():
+                            lat_us.append(dt)
+                        else:
+                            errors.append(r.error_msg)
+                    i += workers
+
+            # warm at the leg's concurrency, then measure
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(workers)]
+            stop_at[0] = time.perf_counter() + min(5.0, secs / 3)
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            with lock:
+                lat_us.clear()
+                errors.clear()
+            start = time.perf_counter()
+            stop_at[0] = start + secs
+            ts = [threading.Thread(target=worker, args=(w,))
+                  for w in range(workers)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - start
+            return {
+                "workers": workers, "wall_s": round(wall, 1),
+                "requests": len(lat_us), "errors": len(errors),
+                "qps": round(len(lat_us) / wall, 1),
+                "p50_ms": round(percentile(lat_us, 50) / 1000, 3)
+                if lat_us else None,
+                "p99_ms": round(percentile(lat_us, 99) / 1000, 3)
+                if lat_us else None,
+                "graphds": len(addrs),
+                "first_errors": errors[:3] if errors else [],
+            }
+
+        cores = os.cpu_count() or 1
+        per_leg = duration_s / 2
+        one = closed_loop([c.graph_addr], per_leg)
+        one["config"] = f"horizontal scale-out (1 graphd, {workers}w)"
+        one["backend"] = "tpu"
+        one["host_cores"] = cores
+        results.append(one)
+        print(one, file=sys.stderr)
+        addr2 = c.add_graphd("graphd2")
+        two = closed_loop([c.graph_addr, addr2], per_leg)
+        two["config"] = f"horizontal scale-out (2 graphd, {workers}w)"
+        two["backend"] = "tpu"
+        two["host_cores"] = cores
+        if one["qps"]:
+            two["throughput_ratio"] = round(two["qps"] / one["qps"], 2)
+        if one["p99_ms"]:
+            two["p99_ratio"] = round(two["p99_ms"] / one["p99_ms"], 2)
+        if cores < 3:
+            two["platform_note"] = (
+                f"{cores}-core host: metad+storaged+graphds multiplex "
+                f"one core, so aggregate qps is core-bound and the "
+                f">=1.6x acceptance needs a spare core for the second "
+                f"front end; the residual gain here is reduced "
+                f"GIL/scheduler contention.  The scaling MECHANISM "
+                f"(add_graphd + RoundRobinClient + autoscale signal) "
+                f"is what this leg proves on this host")
+        results.append(two)
+        print(two, file=sys.stderr)
+
+
 def bench_mesh_virtual(results: list, persons: int) -> None:
     """Config 5: cross-partition multi-hop GO sharded over an 8-device
     mesh.  Real multi-chip hardware is not available, so this runs the
@@ -1032,12 +1320,42 @@ def main(argv=None) -> int:
                         "acked-write loss")
     p.add_argument("--peer-serve-secs", type=float, default=180.0,
                    help="peer-serve soak wall budget")
+    p.add_argument("--continuous", action="store_true",
+                   help="run ONLY the continuous-vs-windowed dispatch "
+                        "leg (ISSUE 15): same fixed offered load "
+                        "through both go_dispatch_mode settings, "
+                        "recording p50/p99 + the measured device idle "
+                        "fraction per leg")
+    p.add_argument("--continuous-secs", type=float, default=120.0,
+                   help="continuous leg wall budget (split across the "
+                        "two modes)")
+    p.add_argument("--horizontal", action="store_true",
+                   help="run ONLY the horizontal scale-out leg "
+                        "(ISSUE 15): 1 vs 2 graphd subprocesses "
+                        "sharing one storaged/device runtime behind a "
+                        "round-robin client; acceptance >= 1.6x "
+                        "aggregate qps at <= 1.2x p99")
+    p.add_argument("--horizontal-secs", type=float, default=120.0,
+                   help="horizontal leg wall budget (split across the "
+                        "1- and 2-graphd legs)")
     args = p.parse_args(argv)
     persons_path = args.persons or (2000 if args.quick else 10000)
     persons_go = args.persons or (2000 if args.quick else 100000)
     persons_mesh = args.persons or (2000 if args.quick else 50000)
 
     results: list = []
+    if args.continuous or args.horizontal:
+        if args.continuous:
+            bench_continuous(results, args.persons or 2000,
+                             duration_s=args.continuous_secs)
+        if args.horizontal:
+            bench_horizontal(results,
+                             duration_s=args.horizontal_secs)
+        print(json.dumps(results))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(results, fh, indent=1)
+        return 0
     if args.peer_serve:
         bench_peer_serve(results, duration_s=args.peer_serve_secs)
         print(json.dumps(results))

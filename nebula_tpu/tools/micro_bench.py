@@ -443,6 +443,139 @@ def bench_absorb(reps: int, wall_budget_ms: float = 250.0) -> dict:
     }
 
 
+def bench_continuous_path(reps: int,
+                          seat_budget_us: float = 25_000.0,
+                          idle_budget: float = 0.8) -> dict:
+    """Continuous-dispatch costs (docs/admission.md "Continuous
+    dispatch"), budget-guarded like lint/admission/recovery:
+
+      * SEAT OPS: join-merge, leave-extract and lane-clear µs/op
+        against a ~131k-slot resident frontier pair — real kernels on
+        a synthetic ELL, each op forced to completion (the per-tick
+        overhead the hop pipeline must hide);
+      * OVERLAP: steady-state device idle fraction while a live
+        LocalCluster stream serves a closed-loop multi-hop GO load —
+        the double-buffer claim: the device must be busy most of the
+        loaded window (idle_frac <= idle_budget)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..tpu import ell as E
+
+    rng = np.random.default_rng(5)
+    n, m = 1 << 13, 1 << 16
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    et = rng.integers(1, 3, m).astype(np.int32)
+    ix = E.EllIndex.build(src, dst, et, n, cap=64)
+    B = 128
+    W = E.lanes_width(B)
+    R1 = ix.n_rows + 1
+    fp = jnp.zeros((R1, W), jnp.uint8)
+    acc = fp.copy()
+    joink = E.make_lane_join_kernel(ix, donate=True)
+    clear = E.make_lane_clear_kernel(donate=True)
+    ext = E.make_lane_extract_kernel()
+    Sp = 64
+    rows = rng.integers(0, ix.n_rows, Sp).astype(np.int32)
+    words = np.zeros(Sp, np.int32)
+    vals = np.full(Sp, 1, np.uint8)
+    ewords = np.zeros(8, np.int32)
+    esel = np.zeros(8, np.uint8)
+    keep = np.full(W, 0xFE, np.uint8)
+    # compile outside the timed region
+    fp, acc = joink(fp, acc, rows, words, vals)
+    np.asarray(ext(fp, acc, ewords, esel))
+    fp, acc = clear(fp, acc, keep)
+    jax.block_until_ready(fp)
+    rounds = max(20, reps // 10)
+    t_join = t_ext = t_clear = 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        fp, acc = joink(fp, acc, rows, words, vals)
+        jax.block_until_ready(fp)
+        t_join += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(ext(fp, acc, ewords, esel))
+        t_ext += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fp, acc = clear(fp, acc, keep)
+        jax.block_until_ready(fp)
+        t_clear += time.perf_counter() - t0
+    join_us = t_join / rounds * 1e6
+    ext_us = t_ext / rounds * 1e6
+    clear_us = t_clear / rounds * 1e6
+
+    # --- overlap: a live stream under closed-loop load -------------
+    import threading as _threading
+
+    from ..cluster import LocalCluster
+    from ..common.flags import flags
+    saved = {k: flags.get(k) for k in ("go_dispatch_mode",
+                                       "storage_backend")}
+    flags.set("go_dispatch_mode", "continuous")
+    c = LocalCluster(num_storage=1, tpu_backend=True)
+    try:
+        g = c.client()
+
+        def okq(stmt):
+            r = g.execute(stmt)
+            assert r.ok(), f"{stmt}: {r.error_msg}"
+            return r
+
+        okq("CREATE SPACE cb(partition_num=2, replica_factor=1)")
+        c.refresh_all()
+        okq("USE cb")
+        okq("CREATE EDGE e(w int)")
+        c.refresh_all()
+        nn = 60
+        okq("INSERT EDGE e(w) VALUES "
+            + ", ".join(f"{i}->{i % nn + 1}:({i})"
+                        for i in range(1, nn + 1)))
+        okq("GO 3 STEPS FROM 1 OVER e")          # warm stream
+        d = c.tpu_runtime.dispatcher
+        stop_at = time.perf_counter() + 1.5
+        busy0, idle0 = d.meter.snapshot()
+
+        def worker(wid):
+            g2 = c.client()
+            g2.execute("USE cb")
+            i = wid
+            while time.perf_counter() < stop_at:
+                g2.execute(f"GO 3 STEPS FROM {i % nn + 1} OVER e")
+                i += 6
+
+        ts = [_threading.Thread(target=worker, args=(w,))
+              for w in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        busy1, idle1 = d.meter.snapshot()
+        span = (busy1 - busy0) + (idle1 - idle0)
+        idle_frac = (idle1 - idle0) / span if span > 0 else 1.0
+    finally:
+        c.stop()
+        for k, v in saved.items():
+            flags.set(k, v)
+    return {
+        "join_merge_us_per_op": round(join_us, 1),
+        "leave_extract_us_per_op": round(ext_us, 1),
+        "lane_clear_us_per_op": round(clear_us, 1),
+        "table_slots": int(sum(a.size for a in ix.bucket_nbr)),
+        "lanes": B,
+        "loaded_idle_frac": round(idle_frac, 4),
+        "seat_budget_us": seat_budget_us,
+        "idle_budget": idle_budget,
+        "within_budget": (join_us <= seat_budget_us
+                          and ext_us <= seat_budget_us
+                          and clear_us <= seat_budget_us
+                          and idle_frac <= idle_budget),
+    }
+
+
 def bench_kernel_roofline(reps: int,
                           slowdown_budget: float = 2.0) -> dict:
     """Packed-vs-int8 frontier hop roofline (docs/roofline.md).
@@ -585,6 +718,7 @@ def main(argv=None) -> int:
         "recovery_path": bench_recovery(reps),
         "absorb_path": bench_absorb(reps),
         "peer_absorb_path": bench_peer_absorb(reps),
+        "continuous_path": bench_continuous_path(reps),
         "kernel_roofline": bench_kernel_roofline(reps),
         "lint": bench_lint(args.lint_budget_s),
     }
@@ -595,6 +729,7 @@ def main(argv=None) -> int:
         and out["recovery_path"]["within_budget"] \
         and out["absorb_path"]["within_budget"] \
         and out["peer_absorb_path"]["within_budget"] \
+        and out["continuous_path"]["within_budget"] \
         and out["kernel_roofline"]["within_budget"]
     return 0 if ok else 1
 

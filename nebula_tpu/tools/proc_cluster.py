@@ -34,10 +34,43 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
+
+
+class RoundRobinClient:
+    """Thread-safe round-robin façade over N graphd clients — the
+    horizontal-scale tier's balancer stand-in (ROADMAP item 3: N
+    stateless graphd instances sharing one storaged/device runtime
+    behind a balancer).  Statements rotate across the front ends;
+    per-statement affinity is irrelevant because graphd is stateless
+    between statements EXCEPT session state (USE <space>), so
+    ``use(space)`` pins the space on every backend first."""
+
+    def __init__(self, clients: List):
+        if not clients:
+            raise ValueError("RoundRobinClient needs >= 1 client")
+        self._clients = list(clients)
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def use(self, space: str) -> None:
+        for cl in self._clients:
+            r = cl.execute(f"USE {space}")
+            if not r.ok():
+                raise RuntimeError(f"USE {space}: {r.error_msg}")
+
+    def pick(self):
+        with self._lock:
+            cl = self._clients[self._i % len(self._clients)]
+            self._i += 1
+        return cl
+
+    def execute(self, stmt: str):
+        return self.pick().execute(stmt)
 
 
 def _free_port() -> int:
@@ -371,9 +404,24 @@ class ProcCluster:
                 raise RuntimeError(f"graphd connect failed: {st}")
             time.sleep(0.3)
 
+    def round_robin_client(self, addrs: List[str],
+                           connect_timeout_s: float = 30.0
+                           ) -> "RoundRobinClient":
+        """A round-robin balancer façade over one FRESH client per
+        graphd address (the horizontal-scale bench's load-balancer
+        stand-in — each worker thread should hold its own instance,
+        exactly like plain ``client()``)."""
+        return RoundRobinClient(
+            [self.client(connect_timeout_s=connect_timeout_s,
+                         addr=a) for a in addrs])
+
     # ------------------------------------------------------- teardown
     def stop(self) -> None:
-        for name in ("graphd", *reversed(self.storage_names), "metad"):
+        # every graphd (the primary plus any add_graphd extras) first,
+        # then storage, then meta
+        graphds = [n for n in self.daemons
+                   if n not in self.storage_names and n != "metad"]
+        for name in (*graphds, *reversed(self.storage_names), "metad"):
             d = self.daemons.get(name)
             if d is not None and d.alive():
                 d.kill(signal.SIGTERM)

@@ -625,6 +625,125 @@ def make_batched_go_lanes_kernel(ell: EllIndex, steps: int,
 
 
 # ====================================================================
+# Continuous hop-boundary batching — the seat-map kernels
+# (docs/admission.md "Continuous dispatch").
+#
+# The windowed kernels above bake the hop count into the program and
+# run a whole batch start-to-finish; the serving tier then pays a
+# pooling wait + a device-idle gap between windows.  Continuous mode
+# instead keeps ONE resident packed frontier pair on the device per
+# (space, OVER set) stream and dispatches a SINGLE hop at a time; the
+# 1-bit lane dimension is the seat map (graph/batch_dispatch.py
+# _LaneLedger): a finishing query's lane bits clear at its last hop
+# and a queued arrival's start frontier is scatter-merged into the
+# freed lanes before the next hop dispatches.  No recompile moves:
+# the lane width stays on the go_batch_widths rung ladder, only lane
+# OCCUPANCY changes — and occupancy is data, not shape.
+#
+#   make_continuous_hop_kernel   one frontier advance + UPTO union:
+#                                (fp, accp) -> (hop(fp), accp|hop(fp));
+#                                both carriers donated (the stream owns
+#                                them, nothing else ever reads the old
+#                                generation of the pair)
+#   make_lane_join_kernel        scatter-ADD of single lane bits into
+#                                FREE lanes.  Exact by the clear
+#                                contract: a freed lane's bit is zero
+#                                in every word it touches, and the host
+#                                dedups (row, lane) pairs, so each add
+#                                lands on a zero bit — add IS or (the
+#                                same argument as
+#                                _upload_frontier_packed's build)
+#   make_lane_clear_kernel       AND with a per-word keep mask: the
+#                                leavers' lane bits drop from both
+#                                carriers in one fused op
+#   make_lane_extract_kernel     gather the leaving lanes' WORD columns
+#                                (per column choosing the exact-depth
+#                                frontier or the UPTO accumulator) —
+#                                the d2h fetch is R1 bytes per leaving
+#                                word, never the whole matrix
+# ====================================================================
+def make_continuous_hop_kernel(ell: EllIndex,
+                               etypes: Tuple[int, ...],
+                               donate: bool = True):
+    """One continuous-mode frontier advance.
+
+    fn(fp uint8 [n_rows+1, W], accp uint8 [n_rows+1, W],
+       eslot int32[n_extras], hrows int32[n_hubs], *tables)
+    -> (fp', accp'): fp' is one packed hop of fp, accp' accumulates
+    the union (the per-lane UPTO carrier — exact-depth lanes simply
+    never read it).  Unlike the windowed kernels the hop count is NOT
+    baked in: one jitted program serves every mix of per-query depths,
+    so the cache key space per (mirror, OVER) family is ONE entry per
+    lane-width rung."""
+    import jax
+    import jax.numpy as jnp
+    n, n_extras, nb = ell.n, len(ell.extra_owner), len(ell.bucket_nbr)
+
+    def hop(fp, accp, eslot, hrows, *tables):
+        nbrs, ets = tables[:nb], tables[nb:]
+        nxt = _hop_body_packed(jnp, jax, n, n_extras, etypes,
+                               nbrs, ets, eslot, hrows, fp)
+        return nxt, accp | nxt
+
+    return jax.jit(hop, donate_argnums=(0, 1) if donate else ())
+
+
+def make_lane_join_kernel(ell: EllIndex, donate: bool = True):
+    """Merge queued arrivals' start frontiers into their assigned free
+    lanes: fn(fp, accp, rows int32[Sp], words int32[Sp], vals uint8[Sp])
+    -> (fp', accp').  ``vals[i]`` is the single lane bit 1 << (lane & 7)
+    for row ``rows[i]`` / word ``words[i]``; padding scatters target the
+    pad row, which is re-zeroed (it is every sentinel slot's gather
+    source and must stay all-zero).  The accumulator gets the same bits:
+    an UPTO union includes depth 0."""
+    import jax
+    import jax.numpy as jnp
+    pad_row = ell.n_rows
+
+    def join(fp, accp, rows, words, vals):
+        fp = fp.at[rows, words].add(vals)
+        fp = fp.at[pad_row, :].set(0)
+        accp = accp.at[rows, words].add(vals)
+        accp = accp.at[pad_row, :].set(0)
+        return fp, accp
+
+    return jax.jit(join, donate_argnums=(0, 1) if donate else ())
+
+
+def make_lane_clear_kernel(donate: bool = True):
+    """Drop leaving lanes from both resident carriers:
+    fn(fp, accp, keep uint8[W]) -> (fp & keep, accp & keep).  ``keep``
+    has the leavers' lane bits LOW; the freed bits are what makes the
+    join kernel's scatter-add exact on reseat."""
+    import jax
+
+    def clear(fp, accp, keep):
+        return fp & keep[None, :], accp & keep[None, :]
+
+    return jax.jit(clear, donate_argnums=(0, 1) if donate else ())
+
+
+def make_lane_extract_kernel():
+    """Slice the leaving lanes' word columns off the resident pair:
+    fn(fp, accp, words int32[P], sel uint8[P]) -> uint8 [n_rows+1, P]
+    where column j is accp[:, words[j]] when sel[j] else fp[:, words[j]]
+    (UPTO leavers read the union accumulator, exact-depth leavers the
+    frontier).  Not donated: the carriers keep serving the lanes that
+    stay seated — the output is a fresh fetch-sized buffer the host
+    np.asarray()s while the NEXT hop computes (the double-buffer
+    overlap, docs/admission.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    def extract(fp, accp, words, sel):
+        fg = jnp.take(fp, words, axis=1)         # [R1, P]
+        ag = jnp.take(accp, words, axis=1)
+        return jnp.where(sel[None, :] != 0, ag, fg)
+
+    return jax.jit(extract)
+
+
+# ====================================================================
 # Incremental delta absorption — fold a committed edge overlay into
 # the RESIDENT slot tables instead of rebuilding them (ROADMAP item 5,
 # "serve writes at traffic").  Three pieces:
@@ -2248,6 +2367,58 @@ def _ell_go_count_buckets(fx):
             for B in fx.widths]
 
 
+def _ell_go_hop_buckets(fx):
+    """Continuous-mode hop: ONE cache key per (mirror, OVER) family —
+    the per-steps key dimension is gone (the host loop owns the hop
+    count), so the retrace space is just the lane-width rung ladder."""
+    kern = make_continuous_hop_kernel(fx.ell, fx.etypes, donate=True)
+    out = []
+    for B in fx.widths:
+        pk = _packed_frontier_avals(fx, B)
+        out.append((("ell_go_hop", fx.ell.shape_sig(), fx.etypes), kern,
+                    (pk[0], pk[0], pk[1], pk[2])
+                    + fx.table_avals()[1:]))
+    return out
+
+
+def _ell_lane_join_buckets(fx):
+    kern = make_lane_join_kernel(fx.ell, donate=True)
+    out = []
+    for B in fx.widths:
+        pk = _packed_frontier_avals(fx, B)
+        for Sp in (8, 64):          # pow-2 scatter-pad ladder ends
+            out.append((("ell_lane_join", fx.ell.shape_sig()), kern,
+                        (pk[0], pk[0],
+                         fx.aval((Sp,), np.int32),
+                         fx.aval((Sp,), np.int32),
+                         fx.aval((Sp,), np.uint8))))
+    return out
+
+
+def _ell_lane_clear_buckets(fx):
+    kern = make_lane_clear_kernel(donate=True)
+    out = []
+    for B in fx.widths:
+        pk = _packed_frontier_avals(fx, B)
+        out.append((("ell_lane_clear", fx.ell.shape_sig()), kern,
+                    (pk[0], pk[0],
+                     fx.aval((lanes_width(B),), np.uint8))))
+    return out
+
+
+def _ell_lane_extract_buckets(fx):
+    kern = make_lane_extract_kernel()
+    out = []
+    for B in fx.widths:
+        pk = _packed_frontier_avals(fx, B)
+        for P in (8,):              # leaving-word pow-2 pad rung
+            out.append((("ell_lane_extract", fx.ell.shape_sig()), kern,
+                        (pk[0], pk[0],
+                         fx.aval((P,), np.int32),
+                         fx.aval((P,), np.uint8))))
+    return out
+
+
 def _sparse_go_buckets(fx):
     d_max = max(fx.ell.bucket_D) if fx.ell.bucket_D else 1
     n1 = fx.ell.n + 1
@@ -2385,6 +2556,34 @@ register_kernel(KernelSpec(
     budget=2, instantiate=_ell_go_count_buckets, donate=(0,),
     dispatch=(0,), frontier=(0,), packed=(0,),
     d2h_bytes_max=lambda fx: 4 * lanes_width(max(fx.widths)) * 8))
+register_kernel(KernelSpec(
+    "ell_go_hop", make_continuous_hop_kernel, phase_kind="ell_go_hop",
+    # continuous dispatch: one retrace per lane-width rung, steps
+    # folded out of the key entirely (the host tick loop owns depth)
+    budget=2, instantiate=_ell_go_hop_buckets, donate=(0, 1),
+    frontier=(0, 1), packed=(0, 1)))
+register_kernel(KernelSpec(
+    "ell_lane_join", make_lane_join_kernel, phase_kind="ell_lane_join",
+    # one retrace per (width rung, pow-2 scatter-pad rung) pair — the
+    # same Sp ladder _upload_frontier_packed rides
+    budget=48, instantiate=_ell_lane_join_buckets, donate=(0, 1),
+    dispatch=(2, 3, 4), frontier=(0, 1), packed=(0, 1)))
+register_kernel(KernelSpec(
+    "ell_lane_clear", make_lane_clear_kernel,
+    phase_kind="ell_lane_clear",
+    budget=2, instantiate=_ell_lane_clear_buckets, donate=(0, 1),
+    dispatch=(2,), frontier=(0, 1), packed=(0, 1)))
+register_kernel(KernelSpec(
+    "ell_lane_extract", make_lane_extract_kernel,
+    phase_kind="ell_lane_extract",
+    # one retrace per (width rung, pow-2 leaving-word rung) pair
+    budget=48, instantiate=_ell_lane_extract_buckets,
+    dispatch=(2, 3), frontier=(0, 1), packed=(0, 1),
+    # the leave-extract fetch is R1 bytes per leaving word column —
+    # never the [R1, W] matrix (lanes_width(qmax) words bound a batch
+    # where every seat leaves in one tick)
+    d2h_bytes_max=lambda fx: (fx.ell.n_rows + 1)
+    * lanes_width(fx.qmax)))
 register_kernel(KernelSpec(
     "sparse_go", make_batched_sparse_go_kernel, phase_kind="sparse_go",
     # per steps value: one retrace per sparse c0 rung per variant

@@ -28,6 +28,12 @@ tunnel, where per-dispatch latency is ~100 ms and bandwidth ~40 MB/s):
   frontier and the transfer is a compact pair list.  Overflow or hub
   contact falls back to the dense bitmap kernel, whose output crosses
   the link bit-packed (ell.pack_bits).
+* Multi-hop GO dispatch is CONTINUOUS by default (round 15,
+  ``go_dispatch_mode``): queries join and leave an in-flight lane
+  batch at hop boundaries over a resident packed frontier pair
+  (_ContinuousGoSession + graph/batch_dispatch.py's seat-map ledger),
+  so the device never idles between windows; the windowed pipeline
+  stays as the bit-exact parity oracle and the rollback path.
 
 Fallback contract: ``can_run_go``/``can_run_path`` decline anything the
 device can't reproduce bit-for-bit (per-root $-/$var inputs, expressions
@@ -409,6 +415,16 @@ DEVICE_PHASES = {
     "ell_absorb": {"phases": ("tpu.absorb",), "h2d": 3, "d2h": 2},
     "ell_bfs": {"phases": ("tpu.kernel", "tpu.fetch"), "h2d": 2,
                 "d2h": 1},
+    # continuous hop-boundary batching (graph/batch_dispatch.py,
+    # docs/admission.md "Continuous dispatch"): the resident frontier
+    # pair never crosses the link — hop/join/clear "fetches" are the
+    # next resident (fp, accp) generation (donated in, stays on
+    # device); only the leave-extract's word columns actually move d2h
+    "ell_go_hop": {"phases": ("tpu.kernel",), "h2d": 0, "d2h": 2},
+    "ell_lane_join": {"phases": ("tpu.kernel",), "h2d": 3, "d2h": 2},
+    "ell_lane_clear": {"phases": ("tpu.kernel",), "h2d": 1, "d2h": 2},
+    "ell_lane_extract": {"phases": ("tpu.kernel", "tpu.fetch"),
+                         "h2d": 2, "d2h": 1},
     "ell_go_sharded": {"phases": ("tpu.launch", "tpu.kernel",
                                   "tpu.fetch", "tpu.assemble"),
                        "h2d": 1, "d2h": 1},
@@ -1471,6 +1487,86 @@ class TpuQueryRuntime:
                       if len(vs) else 0 for vs in vs_lists]
         return [(["__count__"], [[int(c)]]) for c in counts[:nq]]
 
+    # ------------------------------------- continuous dispatch seam
+    def continuous_session(self, space_id: int,
+                           et_tuple: Tuple[int, ...],
+                           min_lanes: int = 1):
+        """Anchor one continuous-dispatch device session for a
+        (space, OVER set) stream (graph/batch_dispatch.py
+        ContinuousGoScheduler): the resident packed frontier pair plus
+        the hop/join/clear/extract kernels over the CURRENT mirror
+        generation.  Returns None when the space cannot ride the
+        seat-map path — mesh-sharded tables (the replicated-frontier
+        mesh kernels have no resident-pair protocol yet), bit-packing
+        disabled, or an empty/unbuildable mirror — and the caller
+        falls back to the windowed pipeline."""
+        if not flags.get("tpu_packed_frontier", True):
+            return None
+        # flag check, not _mesh_only(): the mesh cache is request-path
+        # state and the pump must not warm it from its own thread
+        if int(flags.get("tpu_mesh_devices") or 0) > 1:
+            return None
+        m = self.mirror(space_id)
+        if m is None or m.m == 0:
+            return None
+        ix = self.ell(m)
+        # smallest batch-width rung covering the caller's demand
+        # (``min_lanes`` = arrival backlog at anchor time): the stream
+        # re-anchors one rung wider when the seat map saturates, so
+        # lane capacity rides the SAME pinned ladder the windowed
+        # kernels use — never a new program shape
+        ladder = sorted(int(w) for w in
+                        str(flags.get("go_batch_widths") or
+                            "128,1024").split(",") if w.strip()) \
+            or [128]
+        B = ladder[-1]
+        for w in ladder:
+            if min_lanes <= w:
+                B = w
+                break
+        return _ContinuousGoSession(self, space_id, m, ix, et_tuple, B)
+
+    def continuous_results(self, space_id: int, m: CsrMirror,
+                           queries: List[_GoQuery], reduces,
+                           vs_lists, et_tuple: Tuple[int, ...]):
+        """Post-frontier half for a continuous leave cohort: COUNT
+        riders fold the cached degree vector over their extracted
+        frontier (route-independent — identical to the windowed
+        non-device count fold), everything else (full fetch, LIMIT
+        riders whose pipe slices, UPTO unions) runs the same grouped
+        assembly the windowed leader uses.  results[i] is
+        (columns, rows) or an Exception for per-query failures."""
+        results: List[object] = [None] * len(queries)
+        other_idx = []
+        count_idx = []
+        for i, red in enumerate(reduces):
+            if red is not None and red[0] == "count":
+                count_idx.append(i)
+            else:
+                other_idx.append(i)
+        if count_idx:
+            folded = self._count_results(
+                m, [vs_lists[i] for i in count_idx], len(count_idx),
+                et_tuple)
+            for j, i in enumerate(count_idx):
+                results[i] = folded[j]
+            with self._lock:
+                self.stats["go_reduced"] += len(count_idx)
+        if other_idx:
+            with tracing.span("tpu.assemble", queries=len(other_idx)):
+                sub = self._assemble_results(
+                    space_id, m, [queries[i] for i in other_idx],
+                    [vs_lists[i] for i in other_idx], et_tuple)
+            n_lim = 0
+            for j, i in enumerate(other_idx):
+                results[i] = sub[j]
+                if reduces[i] is not None:
+                    n_lim += 1
+            if n_lim:
+                with self._lock:
+                    self.stats["go_reduced"] += n_lim
+        return results
+
     # ------------------------------------------------ frontier launch
     def _launch_frontiers(self, space_id: int, starts_per_query,
                           et_tuple: Tuple[int, ...], steps: int,
@@ -2132,6 +2228,12 @@ class TpuQueryRuntime:
         .shutdown() and LocalCluster.stop()."""
         import time
         self._bg_stop.set()
+        d = self._dispatcher
+        if d is not None and getattr(d, "continuous", None) is not None:
+            # continuous-dispatch pump threads sit in the same XLA
+            # trap: a pump mid-hop at interpreter exit crashes the
+            # C++ teardown — drain the seat maps and join the pumps
+            d.continuous.shutdown(timeout_s=timeout_s / 2)
         with self._lock:
             threads = list(self._bg_threads)
         deadline = time.monotonic() + timeout_s
@@ -3390,6 +3492,158 @@ class TpuQueryRuntime:
         interim = self.run_find_path(None, space_id, srcs, dsts, etypes,
                                      max_steps, shortest, etype_names)
         return interim.columns, interim.rows
+
+
+# ================================================ continuous dispatch
+class _ContinuousGoSession:
+    """Resident device state of ONE continuous-dispatch stream: the
+    packed frontier pair (exact-depth frontier + UPTO union
+    accumulator) for a (space, OVER set) lane batch, advanced one hop
+    per tick (docs/admission.md "Continuous dispatch").
+
+    Owned by the stream's single pump thread (graph/batch_dispatch.py
+    _ContinuousStream) — every method here runs on that one thread, so
+    the session carries no lock by design; the seat bookkeeping that
+    IS shared (the lane ledger, the rider queue) lives stream-side
+    under its condition.  The device ops are all async under JAX: the
+    pump enqueues join -> hop -> extract -> clear for tick k, then
+    np.asarray-forces tick k-1's extract buffer while hop k computes —
+    that forced fetch is the only point the host ever waits on the
+    device (the double-buffer overlap tpu.device_idle_frac measures).
+
+    Donation discipline: hop/join/clear consume the resident pair and
+    return its next generation (the old buffers are dead the moment
+    the op is enqueued — nothing else holds them); extract does NOT
+    donate, its output is a fresh fetch-sized buffer."""
+
+    def __init__(self, rt, space_id: int, m: CsrMirror, ix: EllIndex,
+                 et_tuple: Tuple[int, ...], B: int):
+        import jax.numpy as jnp
+        from .ell import lanes_width
+        self.rt = rt
+        self.space_id = space_id
+        self.m = m
+        self.ix = ix
+        self.et_tuple = et_tuple
+        self.B = B                          # lane count (width rung)
+        self.W = lanes_width(B)
+        self._tables = ix.kernel_args()[1:]  # mirror-resident buckets
+        self.eslot, self.hrows = rt._hub_merge_dev(m, ix)
+        fp = jnp.zeros((ix.n_rows + 1, self.W), jnp.uint8)
+        # .copy(): the pair is donated together every hop — two
+        # argument slots must never alias one device buffer
+        self.fp, self.accp = fp, fp.copy()
+        self.hops = 0
+
+    def join(self, joiners) -> None:
+        """Scatter the arrivals' start frontiers into their assigned
+        lanes: ``joiners`` is [(lane, start_vids)].  Unmappable vids
+        drop exactly like the windowed upload; the (row, lane-bit)
+        scatter coordinates are deduped per lane so the add lands on
+        zero bits only (the clear contract)."""
+        from .ell import make_lane_join_kernel
+        rows_l: List[np.ndarray] = []
+        words_l: List[np.ndarray] = []
+        vals_l: List[np.ndarray] = []
+        for lane, start_vids in joiners:
+            d = self.m.to_dense(np.asarray(list(start_vids), np.int64))
+            d = np.unique(d[d >= 0]).astype(np.int64)
+            if not len(d):
+                continue                    # empty start: stays zero
+            r = self.ix.perm[d].astype(np.int32)
+            rows_l.append(r)
+            words_l.append(np.full(len(r), lane >> 3, np.int32))
+            vals_l.append(np.full(len(r), np.uint8(1) << (lane & 7),
+                                  np.uint8))
+        S = sum(len(r) for r in rows_l)
+        if S == 0:
+            return
+        Sp = max(8, 1 << (S - 1).bit_length())   # stable shapes
+        rows_p = np.full(Sp, self.ix.n_rows, np.int32)   # pad row
+        words_p = np.zeros(Sp, np.int32)
+        vals_p = np.zeros(Sp, np.uint8)          # zero add: no-op
+        rows_p[:S] = np.concatenate(rows_l)
+        words_p[:S] = np.concatenate(words_l)
+        vals_p[:S] = np.concatenate(vals_l)
+        kern = self.rt._kernel(
+            ("ell_lane_join", self.ix.shape_sig()),
+            lambda: make_lane_join_kernel(self.ix, donate=True))
+        with tracing.span("tpu.kernel", kind="ell_lane_join",
+                          width=self.B):
+            self.fp, self.accp = kern(self.fp, self.accp, rows_p,
+                                      words_p, vals_p)
+
+    def hop(self) -> None:
+        """Advance every seated lane one hop; the UPTO accumulator
+        unions the new frontier (exact-depth lanes never read it)."""
+        from .ell import dense_hop_bytes, make_continuous_hop_kernel
+        kern = self.rt._kernel(
+            ("ell_go_hop", self.ix.shape_sig(), self.et_tuple),
+            lambda: make_continuous_hop_kernel(self.ix, self.et_tuple,
+                                               donate=True))
+        with tracing.span("tpu.kernel", kind="ell_go_hop",
+                          width=self.B, packed=True):
+            self.fp, self.accp = kern(self.fp, self.accp, self.eslot,
+                                      self.hrows, *self._tables)
+        self.hops += 1
+        self.rt._maybe_time_device(
+            self.fp, dense_hop_bytes(self.ix, self.W, 2),
+            kind="ell_go_hop")
+
+    def extract(self, leavers):
+        """Slice the leaving lanes' word columns (UPTO lanes read the
+        accumulator) and return a zero-arg resolver -> per-leaver
+        ascending old-dense-id frontier arrays.  The resolver is where
+        the d2h fetch forces — call it AFTER enqueueing the next hop
+        so the host assembly overlaps the device compute."""
+        from .ell import make_lane_extract_kernel
+        pair_ix: Dict[Tuple[int, bool], int] = {}
+        for lane, upto in leavers:
+            pair_ix.setdefault((lane >> 3, bool(upto)), len(pair_ix))
+        np_pairs = len(pair_ix)
+        P = max(8, 1 << (np_pairs - 1).bit_length())
+        words_p = np.zeros(P, np.int32)
+        sel_p = np.zeros(P, np.uint8)
+        for (word, upto), j in pair_ix.items():
+            words_p[j] = word
+            sel_p[j] = 1 if upto else 0
+        kern = self.rt._kernel(
+            ("ell_lane_extract", self.ix.shape_sig()),
+            make_lane_extract_kernel)
+        with tracing.span("tpu.kernel", kind="ell_lane_extract",
+                          width=self.B):
+            out_dev = kern(self.fp, self.accp, words_p, sel_p)
+        cols_of = [pair_ix[(lane >> 3, bool(upto))]
+                   for lane, upto in leavers]
+
+        def resolve():
+            with tracing.span("tpu.fetch"):
+                cols = np.asarray(out_dev)          # [R1, P] uint8
+            self.rt._note_fetch(cols[:, :np_pairs])
+            outs = []
+            for (lane, _upto), j in zip(leavers, cols_of):
+                bit = (cols[:, j] >> (lane & 7)) & np.uint8(1)
+                old = bit[self.ix.perm]             # old dense order
+                outs.append(np.nonzero(old)[0].astype(np.int64))
+            return outs
+
+        return resolve
+
+    def clear(self, lanes) -> None:
+        """Zero the freed lanes' bits in both carriers — the seat-map
+        half of a leave/evict; the ledger hands the lanes out again
+        only after this op is enqueued (device program order makes the
+        next join's scatter exact)."""
+        from .ell import make_lane_clear_kernel
+        keep = np.full(self.W, 0xFF, np.uint8)
+        for lane in lanes:
+            keep[lane >> 3] &= np.uint8(0xFF ^ (1 << (lane & 7)))
+        kern = self.rt._kernel(
+            ("ell_lane_clear", self.ix.shape_sig()),
+            lambda: make_lane_clear_kernel(donate=True))
+        with tracing.span("tpu.kernel", kind="ell_lane_clear",
+                          width=self.B):
+            self.fp, self.accp = kern(self.fp, self.accp, keep)
 
 
 # ================================================== path reconstruction

@@ -34,6 +34,7 @@ CELLS=(
   "leader_kill|${PROC}::TestKillMatrix::test_leader_kill_under_live_go_traffic"
   "metad_kill|${PROC}::TestKillMatrix::test_metad_sigkill_and_restart"
   "mid_absorb|${PROC}::TestKillMatrix::test_kill_storaged_mid_absorption_zero_acked_loss"
+  "mid_continuous|${PROC}::TestKillMatrix::test_kill_storaged_mid_continuous_flight"
   "partition_leader|${PROC}::TestKillMatrix::test_partitioned_raft_leader_zero_acked_loss"
   "partition_delta|${PROC}::TestKillMatrix::test_mirror_host_partitioned_mid_delta_stream"
   "partition_graphd|${PROC}::TestKillMatrix::test_graphd_partitioned_from_storaged_ladder_serves"

@@ -467,16 +467,19 @@ class TestEndToEnd:
     def test_profile_of_rejected_query_carries_admission_tag(self, nba):
         c, g, ok = nba
         ok("GO 2 STEPS FROM 1 OVER follow")
-        # make the budget provably unmeetable: a warm key with a huge
-        # measured round trip
+        # make the budget provably unmeetable: a warm continuous
+        # stream with a huge measured hop time (the free-lane
+        # feasibility math — docs/admission.md "Continuous dispatch")
         d = c.tpu_runtime.dispatcher
-        key = next(k for k in d._keys if k[0] == "go_batch_execute")
-        d._state(key).rt_ema_s = 30.0
+        st = next(iter(d.continuous.streams()))
+        with st.cond:
+            st.hop_ema_s = 30.0
         try:
             r = g.execute("PROFILE TIMEOUT 50 GO 2 STEPS FROM 1 "
                           "OVER follow")
         finally:
-            d._state(key).rt_ema_s = 0.0
+            with st.cond:
+                st.hop_ema_s = 0.0
         assert r.error_code == ErrorCode.E_DEADLINE_EXCEEDED
 
         prof = r.raw.get("profile")

@@ -13,6 +13,10 @@ from nebula_tpu.common.flags import flags
 
 @pytest.fixture
 def nba():
+    # this suite exercises the WINDOWED pipeline's internals (leader
+    # election, coalescing, pooling windows); the continuous seat-map
+    # tier has its own suite (test_continuous.py)
+    flags.set("go_dispatch_mode", "windowed")
     c = LocalCluster(num_storage=1, tpu_backend=True)
     g = c.client()
 
@@ -31,6 +35,7 @@ def nba():
     yield c, ok
     c.stop()
     flags.set("go_batch_window_ms", 0)
+    flags.set("go_dispatch_mode", "continuous")
 
 
 def test_unfiltered_go_uses_dispatcher(nba):

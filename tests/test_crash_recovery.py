@@ -456,7 +456,16 @@ class TestDeviceBreakerE2E:
                 raise XlaRuntimeError(
                     "RESOURCE_EXHAUSTED: out of memory in HBM")
 
+            # break BOTH dispatch pipelines: windowed batches enter
+            # via go_batch_execute; continuous streams fail at the
+            # next hop of their LIVE session and at every re-anchor
+            # attempt (the pump's _fail_all hands the classified
+            # error back to every rider)
             rt.go_batch_execute = boom
+            rt.continuous_session = boom
+            for _st in rt.dispatcher.continuous.streams():
+                if _st.session is not None:
+                    _st.session.hop = boom
             journal.clear_for_tests()
             opened_before = _stat("tpu.breaker.opened")
             for _ in range(3):
@@ -485,6 +494,7 @@ class TestDeviceBreakerE2E:
             # heal the device; the half-open probe re-admits WITHOUT a
             # daemon restart
             rt.go_batch_execute = real
+            del rt.continuous_session       # class method again
             flags.set("tpu_breaker_open_s", 0.05)
             time.sleep(0.1)
             r = ok(q)

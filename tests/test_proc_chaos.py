@@ -437,6 +437,112 @@ class TestKillMatrix:
                 time.sleep(0.2)
             assert post > 0, "absorption did not resume after recovery"
 
+    def test_kill_storaged_mid_continuous_flight(self, tmp_path):
+        """Continuous-dispatch crash cell (ISSUE 15): the storaged
+        device-serves multi-hop GO through the continuous seat map
+        (docs/admission.md "Continuous dispatch"); SIGKILL lands with
+        the lane batch provably in flight.  Acceptance: every
+        in-flight rider ends TYPED within its deadline (never a
+        hang), and after restart the seat map drains cleanly — zero
+        seated/queued lanes on /metrics and joins balancing
+        leaves + evictions.  No lane leak."""
+        from nebula_tpu.tools.bench_suite import _prom_value
+        with ProcCluster(str(tmp_path), num_storage=1,
+                         storage_backend="tpu") as c:
+            cl = c.client()
+            _ok(cl, "CREATE SPACE ck(partition_num=2, "
+                    "replica_factor=1)")
+            _ok(cl, "USE ck")
+            _ok(cl, "CREATE EDGE e(w int)")
+            n = 60
+            _ok(cl, "INSERT EDGE e(w) VALUES "
+                    + ", ".join(f"{i}->{i % n + 1}@0:({i})"
+                                for i in range(1, n + 1))
+                    + ", " + ", ".join(
+                        f"{i}->{(i * 7 + 3) % n + 1}@1:({i})"
+                        for i in range(1, n + 1, 3)))
+            goq = "GO 3 STEPS FROM 1, 7, 13 OVER e YIELD e._dst"
+            _ok(cl, goq)                  # device mirror + stream warm
+
+            # the continuous tier is provably serving before the kill
+            deadline = time.monotonic() + 20
+            joins = 0.0
+            while time.monotonic() < deadline:
+                _ok(cl, goq)
+                joins = _prom_value(c.metrics("storaged0"),
+                                    "nebula_graph_continuous_joins_total")
+                if joins >= 3:
+                    break
+                time.sleep(0.2)
+            assert joins >= 3, "continuous dispatch never engaged"
+
+            stop = threading.Event()
+            outcomes: list = []       # (wall_s, ok, completeness)
+
+            def reader(wid: int):
+                g = c.client(connect_timeout_s=60)
+                g.execute("USE ck")
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    r = g.execute("TIMEOUT 4000 " + goq)
+                    outcomes.append((time.monotonic() - t0, r.ok(),
+                                     r.completeness if r.ok() else 0))
+
+            ts = [threading.Thread(target=reader, args=(w,),
+                                   daemon=True) for w in range(6)]
+            for t in ts:
+                t.start()
+            time.sleep(1.0)           # riders in flight
+            n_pre = len(outcomes)
+            c.kill("storaged0", signal.SIGKILL)
+            c.wait_down("storaged0")
+            time.sleep(3.0)           # the dead window
+            c.restart("storaged0")
+            deadline = time.monotonic() + 40
+            converged = False
+            while time.monotonic() < deadline:
+                r = cl.execute(goq)
+                if r.ok() and r.completeness == 100:
+                    converged = True
+                    break
+                time.sleep(0.4)
+            stop.set()
+            for t in ts:
+                t.join(timeout=60)
+            assert converged, "continuous serving never recovered"
+            # every response across the kill window ended within a
+            # bounded multiple of its deadline — typed, never a hang
+            walls = [w for w, _ok_, _c in outcomes[n_pre:]]
+            assert walls, "no traffic crossed the kill window"
+            assert max(walls) < 15.0, f"rider hung {max(walls):.1f}s"
+
+            # seat-map drain on the RECOVERED storaged: run traffic,
+            # stop, and the ledger must empty with joins balancing
+            # leaves + evictions (post-restart counters are fresh)
+            for _ in range(5):
+                _ok(cl, goq)
+            deadline = time.monotonic() + 15
+            seated = queued = -1.0
+            while time.monotonic() < deadline:
+                mtx = c.metrics("storaged0")
+                seated = _prom_value(mtx,
+                                     "nebula_graph_continuous_seated")
+                queued = _prom_value(mtx,
+                                     "nebula_graph_continuous_queued")
+                if seated == 0.0 and queued == 0.0:
+                    break
+                time.sleep(0.3)
+            assert (seated, queued) == (0.0, 0.0), "lane leak"
+            mtx = c.metrics("storaged0")
+            joins2 = _prom_value(mtx,
+                                 "nebula_graph_continuous_joins_total")
+            leaves2 = _prom_value(mtx,
+                                  "nebula_graph_continuous_leaves_total")
+            evic2 = _prom_value(
+                mtx, "nebula_graph_continuous_evictions_total")
+            assert joins2 > 0
+            assert joins2 == leaves2 + evic2, (joins2, leaves2, evic2)
+
     def test_partitioned_raft_leader_zero_acked_loss(self, tmp_path):
         """Partition cell (ISSUE 13): the raft leader of the queried
         part is netsplit away from its followers while a write stream

@@ -890,6 +890,9 @@ class TestSparseSplit:
 
         from nebula_tpu.common.flags import flags
 
+        # the sparse split is a WINDOWED-pipeline path (continuous
+        # mode rides the resident dense seat map instead)
+        flags.set("go_dispatch_mode", "windowed")
         c, g = _boot(tpu_backend=True)
         try:
             rng = np.random.default_rng(3)
@@ -938,6 +941,7 @@ class TestSparseSplit:
                 flags.set("go_batch_window_ms", -1)
         finally:
             flags.set("storage_backend", "tpu")
+            flags.set("go_dispatch_mode", "continuous")
             c.stop()
 
 
@@ -949,6 +953,10 @@ class TestUptoDevice:
     def test_upto_runs_on_device_and_matches_cpu(self):
         from nebula_tpu.common.flags import flags
 
+        # pin the windowed pipeline: this asserts the SPARSE UPTO
+        # kernel ran (continuous mode serves UPTO from the dense
+        # union accumulator instead — covered in test_continuous.py)
+        flags.set("go_dispatch_mode", "windowed")
         c, g = _boot(tpu_backend=True)
         try:
             q = (f"GO UPTO 3 STEPS FROM {TIM} OVER follow "
@@ -966,6 +974,7 @@ class TestUptoDevice:
             assert rt.stats["go_sparse"] == before_sparse + 1
         finally:
             flags.set("storage_backend", "tpu")
+            flags.set("go_dispatch_mode", "continuous")
             c.stop()
 
     def test_upto_dense_kernel_union(self):
