@@ -65,11 +65,14 @@ EVENT_KINDS = (
                              # resident device tables as a new mirror
                              # generation (tpu/runtime.py absorb path,
                              # docs/durability.md)
-    "mirror.absorb_failed",  # an absorption declined (vertex-plan
-                             # change / slot overflow / delta-budget
-                             # overflow / opaque events / typed peer-*
-                             # delta-stream breaks) — a full rebuild
-                             # is about to be paid instead
+    "mirror.absorb_failed",  # an absorption declined — a full rebuild
+                             # is about to be paid instead.  The
+                             # ``reason`` payload is CLOSED the same
+                             # way this tuple is: it must be one of
+                             # common/protocol.py's "absorb-decline" /
+                             # "peer-delta" constants (the
+                             # protocol-registry lint pass proves the
+                             # producers only emit those)
     "mirror.peer_absorbed",  # a PEER's committed writes streamed over
                              # deviceScanDelta and folded into the
                              # resident device tables at O(delta) —

@@ -1,0 +1,149 @@
+"""Typed-protocol registry — the closed vocabulary of reasons the
+serving tier speaks.
+
+The metrics plane already closes its nouns (SPAN_NAMES, METRIC_NAMES,
+EVENT_KINDS); this module closes the VERBS' payloads: why an absorb
+declined, why a peer-delta stream broke, why admission shed a query,
+why a continuous rider bounced to the windowed pipeline, how a rider's
+wait ended, and how the device failure classifier names a breaker
+trip.  Every raise/journal/record/annotate site passes one of these
+constants — the protocol-registry lint pass (tools/lint/protocol.py)
+proves it statically, flags reasons nobody emits (dead dashboard
+vocabulary), and keeps the state-machine fields below writable only
+inside their declared transition methods.
+
+A reason string is an API: dashboards filter on it, the chaos soaks
+assert on it, and a peer daemon may receive it over the wire
+(storage/service.py forwards absorb-decline reasons verbatim).  Adding
+a reason here is cheap; an unregistered literal at a call site is a
+lint error, the same contract EVENT_KINDS enforces at runtime.
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------------- absorb
+# Why _try_absorb paid (or is about to pay) a rebuild instead of an
+# O(delta) absorption — journaled as mirror.absorb_failed{reason=...}
+# (tpu/runtime.py _absorb_once; docs/durability.md "The generation
+# state machine").
+ABSORB_PART_MOVED = "part-moved"
+ABSORB_PEER_SET_CHANGED = "peer-set-changed"
+ABSORB_DELTA_OVERFLOW = "delta-overflow"
+ABSORB_VERTEX_UNABSORBABLE = "vertex-write-unabsorbable"
+ABSORB_OVERLAY_UNBUILDABLE = "overlay-unbuildable"
+ABSORB_VERTEX_PLAN_CHANGE = "vertex-plan-change"
+ABSORB_SLOT_OVERFLOW = "slot-overflow"
+ABSORB_OPAQUE_EVENTS = "opaque-events"
+# non-decline absorb outcomes (the span tag still names them)
+ABSORB_VERTEX_IN_PLACE = "vertex-in-place"
+ABSORB_NO_OP = "no-op"
+
+# ----------------------------------------------------------- peer delta
+# Typed breaks in the deviceScanDelta stream (storage/device.py
+# RemoteStoreView.delta_since; the wire map in storage/service.py
+# translates a peer's local decline into this vocabulary).
+PEER_RESTARTED = "peer-restarted"
+PEER_LEADER_CHANGED = "peer-leader-changed"
+PEER_CURSOR_TRUNCATED = "peer-cursor-truncated"
+PEER_OPAQUE_EVENTS = "peer-opaque-events"
+PEER_CURSOR_GAP = "peer-cursor-gap"
+PEER_UNREACHABLE = "peer-unreachable"
+PEER_UNSUPPORTED = "peer-unsupported"
+PEER_STALLED = "stalled"         # healthz fallback when no typed break
+
+# ------------------------------------------------------------ admission
+# Shed classes (AdmissionShed.reason — overload, counted against
+# /healthz) and client-budget reject classes (typed DEADLINE_EXCEEDED
+# that is NOT overload) — graph/batch_dispatch.py docs/admission.md.
+SHED_QUEUE_FULL = "queue_full"
+SHED_DEADLINE_UNMEETABLE = "deadline_unmeetable"
+SHED_REMOTE = "remote_shed"      # a storaged's shed kept its class
+                                 # across the wire (storage/device.py)
+REJECT_EXPIRED = "expired"
+REJECT_BUDGET_BELOW_ROUND_TRIP = "budget_below_round_trip"
+# trace-marker decisions on the graph.admission annotate
+DECISION_SHED = "shed"
+DECISION_DEADLINE_DROP = "deadline_drop"
+
+# ----------------------------------------------------------- continuous
+# Why a rider bounced off the continuous tier back to the windowed
+# pipeline (ContinuousUnavailable.reason) ...
+BOUNCE_NO_SESSION = "no-session"         # stream cannot anchor a
+                                         # device session (empty
+                                         # mirror, mesh tables,
+                                         # packing off)
+BOUNCE_STREAM_STOPPING = "stream-stopping"
+# ... and how a continuous rider's wait ended (the graph.continuous
+# trace marker's `ending` field): the closed set the eviction/ending
+# dashboards key on.
+END_LEFT = "left-batch"          # extracted + assembled at its last hop
+END_EVICTED = "evicted"          # deadline expired mid-flight; lane
+                                 # cleared at the next hop boundary
+END_EXPIRED_QUEUED = "expired-queued"    # budget ran out before a seat
+END_BOUNCED = "bounced"          # ContinuousUnavailable: windowed
+                                 # fallback served it instead
+END_STREAM_FAILED = "stream-failed"      # pump-level failure woke it
+
+# ----------------------------------------------------- device failures
+# classify_device_failure's verdicts (storage/device.py): the breaker's
+# failure vocabulary, also what a peer reports over the wire so a
+# jax-free graphd can classify too.
+DEVFAIL_RESOURCE_EXHAUSTED = "resource_exhausted"
+DEVFAIL_TRANSFER = "transfer"
+DEVFAIL_XLA_RUNTIME = "xla_runtime"
+
+
+# One registry, grouped by family — the protocol-registry lint pass
+# resolves the constant names above through this dict; a reason absent
+# here is unknown at every typed site, and a reason present but never
+# emitted anywhere is flagged dead.
+PROTOCOL_REASONS = {
+    "absorb-decline": (
+        ABSORB_PART_MOVED, ABSORB_PEER_SET_CHANGED, ABSORB_DELTA_OVERFLOW,
+        ABSORB_VERTEX_UNABSORBABLE, ABSORB_OVERLAY_UNBUILDABLE,
+        ABSORB_VERTEX_PLAN_CHANGE, ABSORB_SLOT_OVERFLOW,
+        ABSORB_OPAQUE_EVENTS,
+    ),
+    "absorb-commit": (ABSORB_VERTEX_IN_PLACE, ABSORB_NO_OP),
+    "peer-delta": (
+        PEER_RESTARTED, PEER_LEADER_CHANGED, PEER_CURSOR_TRUNCATED,
+        PEER_OPAQUE_EVENTS, PEER_CURSOR_GAP, PEER_UNREACHABLE,
+        PEER_UNSUPPORTED, PEER_STALLED,
+    ),
+    "shed": (SHED_QUEUE_FULL, SHED_DEADLINE_UNMEETABLE, SHED_REMOTE),
+    "deadline-reject": (REJECT_EXPIRED, REJECT_BUDGET_BELOW_ROUND_TRIP),
+    "admission-decision": (DECISION_SHED, DECISION_DEADLINE_DROP),
+    "continuous-bounce": (BOUNCE_NO_SESSION, BOUNCE_STREAM_STOPPING),
+    "continuous-ending": (
+        END_LEFT, END_EVICTED, END_EXPIRED_QUEUED, END_BOUNCED,
+        END_STREAM_FAILED,
+    ),
+    "device-failure": (
+        DEVFAIL_RESOURCE_EXHAUSTED, DEVFAIL_TRANSFER, DEVFAIL_XLA_RUNTIME,
+    ),
+}
+
+# Exceptions that must always carry a typed reason when constructed —
+# an untyped bounce cannot be counted, routed, or asserted on.
+TYPED_RAISES = ("AdmissionShed", "ContinuousUnavailable")
+
+# State-machine fields writable ONLY inside their declared transition
+# methods (matched by method name within the named module).  The
+# breaker's CLOSED/OPEN/HALF_OPEN machine and the mirror generation
+# spine are the two protocols whose invariants every serving path
+# leans on (docs/durability.md); a write from anywhere else is a
+# protocol violation even when it happens to hold the right lock.
+STATE_MACHINES = {
+    "breaker-cell": {
+        "module": "storage/device.py",
+        "fields": ("state", "fails", "opened_at", "probing",
+                   "last_reason"),
+        "writers": ("__init__", "admit", "release_probe",
+                    "record_success", "record_failure", "reset_space"),
+    },
+    "mirror-generation": {
+        "module": "tpu/runtime.py",
+        "fields": ("generation", "_fresh_version", "_delta_cursors",
+                   "_absorb_declined_ver", "_part_sig"),
+        "writers": ("_publish", "_try_absorb", "commit_in_place"),
+    },
+}

@@ -77,6 +77,7 @@ import time
 from typing import Dict, List, Tuple
 
 from ..common import deadline as deadlines
+from ..common import protocol
 from ..common import tracing
 from ..common.deadline import DeadlineExceeded
 from ..common.events import journal
@@ -408,7 +409,15 @@ class ContinuousUnavailable(Exception):
     """The stream could not anchor a device session for this space
     (empty mirror, mesh-sharded tables, packing off): the submit
     falls back to the windowed pipeline.  Internal control flow —
-    never surfaces to a caller of submit_batched."""
+    never surfaces to a caller of submit_batched.
+
+    ``reason`` is a protocol.PROTOCOL_REASONS "continuous-bounce"
+    constant: the fallback counter and the graph.continuous trace
+    marker's ``ending`` classification key on it."""
+
+    def __init__(self, msg: str, reason: str):
+        super().__init__(msg)
+        self.reason = reason
 
 
 class _Rider:
@@ -659,7 +668,7 @@ class _ContinuousStream:
         if new_sess is None:
             raise ContinuousUnavailable(
                 f"space {self.space_id} cannot ride continuous "
-                f"dispatch")
+                f"dispatch", protocol.BOUNCE_NO_SESSION)
         # pump-thread-only state (see __init__)
         self.session = new_sess  # nebulint: disable=lock-discipline
         with self.cond:
@@ -723,6 +732,11 @@ class _ContinuousStream:
                             ("go_batch_execute", self.space_id,
                              self.et_tuple))
                         continue
+                    # the seat outlives this call by design: it is
+                    # released when its rider leaves or is evicted on
+                    # a LATER tick, and a pump death retires the whole
+                    # seat map via _fail_all
+                    # nebulint: obligation=handed-off/seat-map-retired-by-fail-all
                     r.lane = self.ledger.alloc()
                     r.remaining = r.steps - 1
                     r.joined_tick = self.tick_no
@@ -759,6 +773,9 @@ class _ContinuousStream:
         new_pending = None
         if sess is not None and (joiners or evicted or seated_now):
             if not self._meter_open:
+                # one busy interval spans MANY ticks: _meter_close
+                # ends it at idle / drain / pump retirement
+                # nebulint: obligation=handed-off/meter-closed-at-idle
                 self.sched.meter.begin()
                 # pump-thread-only state (see __init__)
                 self._meter_open = True  # nebulint: disable=lock-discipline
@@ -911,11 +928,12 @@ class _ContinuousStream:
                 qraw = flags.get("admission_queue_max")
                 qmax = 256 if qraw is None else int(qraw)
                 if depth >= qmax:
-                    disp._shed(key, "queue_full", depth)
+                    disp._shed(key, protocol.SHED_QUEUE_FULL, depth)
                 if dl is not None:
                     rem = dl.remaining_s()
                     if rem <= 0:
-                        disp._deadline_reject(key, "expired", depth)
+                        disp._deadline_reject(
+                            key, protocol.REJECT_EXPIRED, depth)
                     elif self.hop_ema_s > 0.0:
                         # seats free at hop boundaries: if every free
                         # lane seats someone ahead of us we wait >= 1
@@ -930,12 +948,17 @@ class _ContinuousStream:
                             * (wait_ticks + max(1, steps - 1))
                         if rem < est_s:
                             if depth > 0:
-                                disp._shed(key, "deadline_unmeetable",
-                                           depth)
+                                disp._shed(
+                                    key,
+                                    protocol.SHED_DEADLINE_UNMEETABLE,
+                                    depth)
                             disp._deadline_reject(
-                                key, "budget_below_round_trip", depth)
+                                key,
+                                protocol.REJECT_BUDGET_BELOW_ROUND_TRIP,
+                                depth)
             if self.stopping:
-                raise ContinuousUnavailable("stream stopping")
+                raise ContinuousUnavailable(
+                    "stream stopping", protocol.BOUNCE_STREAM_STOPPING)
             self.queue.append(rider)
             self.cond.notify_all()
             while not rider.done:
@@ -965,15 +988,28 @@ class _ContinuousStream:
                             "go: deadline expired mid-flight")
                         disp._note_deadline_drop(key)
                         break
-        if rider.error is not None:
-            raise rider.error
         # the seat trajectory lands on the WAITER's own trace: a
-        # PROFILE of the query shows its lane, join tick and whether
-        # it merged into an already-running batch
+        # PROFILE of the query shows its lane, join tick, whether it
+        # merged into an already-running batch, and HOW its wait ended
+        # — one of protocol's closed "continuous-ending" kinds, the
+        # vocabulary the eviction dashboards key on
+        if rider.error is not None:
+            if isinstance(rider.error, ContinuousUnavailable):
+                ending = protocol.END_BOUNCED
+            elif isinstance(rider.error, DeadlineExceeded):
+                ending = (protocol.END_EVICTED if rider.lane >= 0
+                          else protocol.END_EXPIRED_QUEUED)
+            else:
+                ending = protocol.END_STREAM_FAILED
+            tracing.annotate("graph.continuous", lane=rider.lane,
+                             joined_tick=rider.joined_tick,
+                             ending=ending)
+            raise rider.error
         tracing.annotate("graph.continuous", lane=rider.lane,
                          joined_tick=rider.joined_tick,
                          hops=rider.steps - 1,
-                         midflight=rider.midflight)
+                         midflight=rider.midflight,
+                         ending=protocol.END_LEFT)
         with self.sched.dispatcher._lock:
             self.sched.dispatcher.stats["continuous_queries"] = \
                 self.sched.dispatcher.stats.get("continuous_queries",
@@ -1142,7 +1178,7 @@ class GoBatchDispatcher:
         # graphd) — no falsy-`or` default here
         qmax = 256 if qraw is None else int(qraw)
         if depth >= qmax:
-            self._shed(key, "queue_full", depth)
+            self._shed(key, protocol.SHED_QUEUE_FULL, depth)
         if dl is not None:
             rem = dl.remaining_s()
             if rem <= 0:
@@ -1150,7 +1186,8 @@ class GoBatchDispatcher:
                 # failed, not this daemon — typed fast failure without
                 # the shed/overload counters (a tight TIMEOUT on an
                 # idle graphd must never flip /healthz)
-                self._deadline_reject(key, "expired", depth)
+                self._deadline_reject(key, protocol.REJECT_EXPIRED,
+                                      depth)
             elif st.rt_ema_s > 0.0:
                 # batches ahead of us (the backlog dispatches in
                 # ceil(depth/max_b) batches) plus our own — each costs
@@ -1164,22 +1201,26 @@ class GoBatchDispatcher:
                     if depth > 0:
                         # a BACKLOG makes the budget unmeetable —
                         # that is overload: shed
-                        self._shed(key, "deadline_unmeetable", depth)
+                        self._shed(key,
+                                   protocol.SHED_DEADLINE_UNMEETABLE,
+                                   depth)
                     # empty queue: the budget is simply smaller than
                     # one batch round trip — client-chosen, not load
-                    self._deadline_reject(key, "budget_below_round_trip",
-                                          depth)
+                    self._deadline_reject(
+                        key, protocol.REJECT_BUDGET_BELOW_ROUND_TRIP,
+                        depth)
 
     def _shed(self, key: Tuple, reason: str, depth: int) -> None:
         stats.add_value("graph.admission.shed")
-        if reason != "queue_full":
+        if reason != protocol.SHED_QUEUE_FULL:
             stats.add_value("graph.admission.deadline_exceeded")
         with self._lock:
             self.stats["sheds"] += 1
         journal.record("query.shed",
                        detail=f"{reason} {key[0]} depth={depth}",
                        space=key[1])
-        tracing.annotate("graph.admission", decision="shed",
+        tracing.annotate("graph.admission",
+                         decision=protocol.DECISION_SHED,
                          reason=reason, depth=depth, method=key[0])
         raise AdmissionShed(
             f"query shed at admission ({reason}): {key[0]} queue depth "
@@ -1398,7 +1439,7 @@ class GoBatchDispatcher:
                 # trace (the leader thread can't reach it): a PROFILE
                 # of the failed query shows why it never launched
                 tracing.annotate("graph.admission",
-                                 decision="deadline_drop",
+                                 decision=protocol.DECISION_DEADLINE_DROP,
                                  method=key[0])
             raise req.error
         return req.result, req.mirror

@@ -25,6 +25,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import protocol
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
 from ..common.stats import stats
@@ -114,15 +115,16 @@ def classify_device_failure(exc: BaseException) -> Optional[str]:
     if isinstance(exc, (TpuDecline, DeviceExecError, DeadlineExceeded)):
         return None
     low = str(exc).lower()
-    if "resource_exhausted" in low or "resource exhausted" in low \
+    if protocol.DEVFAIL_RESOURCE_EXHAUSTED in low \
+            or "resource exhausted" in low \
             or "out of memory" in low or "hbm" in low:
-        return "resource_exhausted"
-    if ("transfer" in low or "copy" in low) \
+        return protocol.DEVFAIL_RESOURCE_EXHAUSTED
+    if (protocol.DEVFAIL_TRANSFER in low or "copy" in low) \
             and ("fail" in low or "error" in low or "abort" in low):
-        return "transfer"
+        return protocol.DEVFAIL_TRANSFER
     for klass in type(exc).__mro__:
         if klass.__name__ == "XlaRuntimeError":
-            return "xla_runtime"
+            return protocol.DEVFAIL_XLA_RUNTIME
     return None
 
 
@@ -397,7 +399,7 @@ class RemoteStoreView:
         self._epoch = int(resp.get("epoch") or 0)
         self._led_gen = int(resp.get("led_gen") or 0)
         self._polled_at = time.monotonic()
-        if self.last_delta_decline == "peer-unreachable":
+        if self.last_delta_decline == protocol.PEER_UNREACHABLE:
             # the peer is back; an unreachable-stall must not outlive
             # the outage (typed STREAM breaks instead clear when the
             # rebuild's full scan completes — prefix() below)
@@ -425,7 +427,7 @@ class RemoteStoreView:
             # build (callers decline to the CPU path) — quietly
             # reporting an empty led set would let build_mirror publish
             # a partial mirror and serve incomplete rows as success
-            self._note_stalled("peer-unreachable")
+            self._note_stalled(protocol.PEER_UNREACHABLE)
             raise RpcError(Status(
                 ErrorCode.E_FAIL_TO_CONNECT,
                 f"peer {self.host} unreachable for device mirror"))
@@ -471,13 +473,13 @@ class RemoteStoreView:
         # history (reboot) or part membership (leadership move) and
         # can never be contiguous with the anchor
         if epoch_c != epoch_now:
-            self._note_stalled("peer-restarted")
+            self._note_stalled(protocol.PEER_RESTARTED)
             return None
         # the cursor carries led_gen modulo _LED_MOD — compare in the
         # same ring, or a peer whose led set changed 2^14+ times would
         # mismatch forever (every window paying the rebuild)
         if led_gen_c != led_gen_now % _LED_MOD:
-            self._note_stalled("peer-leader-changed")
+            self._note_stalled(protocol.PEER_LEADER_CHANGED)
             return None
         with tracing.span("tpu.peer_absorb", space=space_id,
                           peer=str(self.host)) as sp:
@@ -487,16 +489,17 @@ class RemoteStoreView:
                     "upto": upto, "epoch": epoch_c,
                     "led_gen": led_gen_c}, timeout=self.RPC_TIMEOUT_S)
             except RpcError as e:
-                reason = ("peer-unsupported"
+                reason = (protocol.PEER_UNSUPPORTED
                           if e.status.code == ErrorCode.E_UNSUPPORTED
-                          else "peer-unreachable")
+                          else protocol.PEER_UNREACHABLE)
                 self._note_stalled(reason)
                 stats.add_value("tpu.peer_absorb.stream_errors")
                 if sp is not None:
                     sp.tag(ok=False, reason=reason)
                 return None
             if not resp.get("ok"):
-                reason = str(resp.get("reason") or "peer-opaque-events")
+                reason = str(resp.get("reason")
+                             or protocol.PEER_OPAQUE_EVENTS)
                 self._note_stalled(reason)
                 stats.add_value("tpu.peer_absorb.declines")
                 if sp is not None:
@@ -508,9 +511,9 @@ class RemoteStoreView:
                 # break the epoch check should normally catch first):
                 # events and cursor would disagree — typed gap, the
                 # rebuild re-anchors
-                self._note_stalled("peer-cursor-gap")
+                self._note_stalled(protocol.PEER_CURSOR_GAP)
                 if sp is not None:
-                    sp.tag(ok=False, reason="peer-cursor-gap")
+                    sp.tag(ok=False, reason=protocol.PEER_CURSOR_GAP)
                 return None
             events = [tuple(e) for e in resp.get("events", [])]
             self._note_advanced()
@@ -700,7 +703,8 @@ class RemoteDeviceRuntime:
                 if resp.get("shed"):
                     from ..graph.batch_dispatch import AdmissionShed
                     raise AdmissionShed(
-                        resp.get("error", "query shed"), "remote_shed")
+                        resp.get("error", "query shed"),
+                        protocol.SHED_REMOTE)
                 raise DeadlineExceeded(resp.get("error",
                                                 "deadline exceeded"))
             if resp.get("error"):

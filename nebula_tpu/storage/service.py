@@ -17,6 +17,7 @@ import concurrent.futures
 import threading
 from typing import Dict, List, Optional
 
+from ..common import protocol
 from ..common.clock import Duration
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
@@ -417,20 +418,21 @@ class StorageService:
         space_id = int(req["space_id"])
         epoch = getattr(self.kv, "boot_epoch", 1)
         if int(req.get("epoch") or 0) != epoch:
-            return {"ok": False, "reason": "peer-restarted"}
+            return {"ok": False, "reason": protocol.PEER_RESTARTED}
         _led, led_gen = self._led_snapshot(space_id)
         # peers carry led_gen modulo the fused-cursor ring
         # (storage/device.py _LED_MOD) — compare in that ring
         from .device import _LED_MOD
         if int(req.get("led_gen") or 0) != led_gen % _LED_MOD:
-            return {"ok": False, "reason": "peer-leader-changed"}
+            return {"ok": False,
+                    "reason": protocol.PEER_LEADER_CHANGED}
         events, reason, ver = self.kv.delta_window(
             space_id, int(req["cursor"]), upto=req.get("upto"))
         if events is None:
-            wire_reason = {"truncated": "peer-cursor-truncated",
-                           "opaque": "peer-opaque-events",
-                           "ahead": "peer-cursor-gap"}.get(
-                               reason, "peer-opaque-events")
+            wire_reason = {"truncated": protocol.PEER_CURSOR_TRUNCATED,
+                           "opaque": protocol.PEER_OPAQUE_EVENTS,
+                           "ahead": protocol.PEER_CURSOR_GAP}.get(
+                               reason, protocol.PEER_OPAQUE_EVENTS)
             return {"ok": False, "reason": wire_reason}
         stats.add_value("tpu.peer_absorb.windows_served")
         return {"ok": True, "events": [list(e) for e in events],
@@ -731,7 +733,8 @@ class StorageService:
             s = v.stalled_for_s()
             if s > 0.0:
                 out.append((space_id, host, s,
-                            v.last_delta_decline or "stalled"))
+                            v.last_delta_decline
+                            or protocol.PEER_STALLED))
         return out
 
     def breaker_snapshot(self):

@@ -45,10 +45,16 @@ CACHE_VERSION = 1
 
 # check -> in-package path prefixes that can change its outcome; None
 # (every other check) = the whole package including etc/ reference text
-CHECK_SCOPE: Dict[str, Tuple[str, ...]] = {
+CHECK_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     "jaxpr-audit": ("tpu/", "common/flags.py", "common/tracing.py"),
     "mesh-audit": ("tpu/", "common/flags.py", "common/tracing.py"),
     "carveout-inventory": ("tpu/runtime.py",),
+    # the v5 flow passes are whole-package BY DESIGN, recorded
+    # explicitly: an OBLIGATIONS receiver hint or a registered reason
+    # literal can appear in ANY module, so no prefix set is sound —
+    # both passes are pure AST (no tracing), cheap enough to rescan
+    "obligation-tracking": None,
+    "protocol-registry": None,
 }
 
 

@@ -176,7 +176,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
     from . import blocking, capture, events, flagsreg, guards, hotpath, \
-        jaxaudit, locks, meshaudit, metrics, spans, status, wirecheck
+        jaxaudit, locks, meshaudit, metrics, obligations, protocol, \
+        spans, status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -193,6 +194,8 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "mesh-audit": meshaudit.check_mesh_audit,
         "carveout-inventory": meshaudit.check_carveout_inventory,
         "wire-contract": wirecheck.check_wire_contract,
+        "obligation-tracking": obligations.check_obligations,
+        "protocol-registry": protocol.check_protocol_registry,
     }
 
 
@@ -202,8 +205,9 @@ ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "jax-hotpath", "flag-registry", "span-registry",
               "metric-registry", "event-registry", "guard-inference",
               "blocking-under-lock", "context-capture", "jaxpr-audit",
-              "mesh-audit", "carveout-inventory",
-              "wire-contract", "stale-suppression")
+              "mesh-audit", "carveout-inventory", "wire-contract",
+              "obligation-tracking", "protocol-registry",
+              "stale-suppression")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 

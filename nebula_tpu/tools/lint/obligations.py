@@ -1,0 +1,477 @@
+"""obligation-tracking — must-call-on-all-paths analysis over the
+declared OBLIGATIONS registry of acquire/release pairs.
+
+The continuous serving tier is a web of hand-maintained protocols:
+a lane seat allocated from the ledger must be released, a half-open
+probe token taken from the breaker must be settled (``record_*`` /
+``release_probe``), a priority pipeline slot must be handed back, a
+waiter heap entry must be popped (or the heap rebuilt), the busy
+meter's ``begin`` needs its ``end``, and a per-space rebuild marker
+must be discarded.  The review record shows this defect class
+recurring — the ``_PrioritySlots`` missed wakeup (PR 6), the
+unreleased half-open probe token (PR 7), the unwoken leave cohort on
+extract failure (PR 15) — so lint owns it statically now, in the
+RacerD/pulse must-call tradition (the MUST_USE_RESULT lineage of
+status.py, lifted from one return value to a resource's whole
+lifetime).
+
+For every acquire site the enclosing function must discharge the
+obligation on EVERY exit path:
+
+  * a discharge must exist on the normal path lexically after the
+    acquire (a discharge only inside an except handler leaks on
+    success);
+  * every ``return``/``raise`` between the acquire and the first
+    normal-path discharge is a leak — EXCEPT the decline branch
+    (an exit inside an ``if`` testing the acquire's own result:
+    ``why = breaker.admit(k)``'s non-None arm never took the token)
+    and exits inside a handler that already discharged;
+  * rules with ``exception_edges`` additionally require a discharge
+    inside an ``except`` handler or ``finally`` block — the region-
+    level approximation of "the exception edge discharges too"
+    (per-statement path sensitivity is not worth the false-positive
+    budget; the three historical bugs are all region-visible).
+
+Discharges THROUGH a same-module helper count: the within-module call
+graph (blocking.py's machinery) propagates "this callee discharges
+rule R", so ``submit_batched``'s slot is settled by the ``_run`` it
+hands off to.
+
+Legitimate escapes carry ``# nebulint: obligation=handed-off/<reason>``
+on the acquire line (waives the whole instance) or on one exit line
+(waives that exit): the lane seats a pump failure strands are retired
+WITH the stream, the busy meter closes at idle, the rebuild marker is
+discarded by the background worker it was handed to.  A reason-less
+``handed-off/`` is itself a violation — same stance as the baseline's
+mandatory justifications.
+
+Two special forms ride along:
+
+  * rider-wake — ``X.done = True`` inside a ``with <...cond...>:``
+    region requires a ``notify_all()`` in the SAME locked region, or
+    the flipped flag wakes nobody (the PR 6/PR 15 missed-wakeup
+    class, generalized);
+  * context-bind — ``deadlines.bind(...)`` / ``tracing.attach(...)``
+    / ``attach_captured(...)`` must be ``with``-items (extending
+    capture.py's scope): a bound context that is never unbound leaks
+    onto the thread and poisons every later query on it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .blocking import _collect_fns, _resolve_callee
+from .core import Module, PackageContext, Violation, dotted
+
+CHECK = "obligation-tracking"
+
+
+class _Rule:
+    __slots__ = ("name", "what", "hints", "acquire", "discharge",
+                 "arg_receiver", "assign_discharge", "exception_edges")
+
+    def __init__(self, name: str, what: str, hints: Tuple[str, ...],
+                 acquire: Tuple[str, ...], discharge: Tuple[str, ...],
+                 arg_receiver: bool = False,
+                 assign_discharge: bool = False,
+                 exception_edges: bool = True):
+        self.name = name
+        self.what = what                  # human name of the resource
+        self.hints = hints                # receiver-component substrings
+        self.acquire = acquire            # method leaves that acquire
+        self.discharge = discharge        # method leaves that discharge
+        # waiter-heap style: the resource is the CALL ARGUMENT
+        # (heappush(self._waiters, ...)), not the attribute receiver
+        self.arg_receiver = arg_receiver
+        # reassigning the hinted attribute (heap rebuild) discharges
+        self.assign_discharge = assign_discharge
+        self.exception_edges = exception_edges
+
+
+# The registry: every hand-maintained acquire/release protocol in the
+# serving tier.  Receiver hints are substring matches on the dotted
+# receiver's components, so ``self.sched.meter.begin()`` and
+# ``self.meter.begin()`` both bind to busy-meter while ``lock.acquire``
+# stays out of pipeline-slot's way.
+OBLIGATIONS = (
+    _Rule("lane-seat",
+          "a continuous lane seat (_LaneLedger.alloc)",
+          ("ledger",), ("alloc",), ("release",)),
+    _Rule("pipeline-slot",
+          "a priority pipeline slot (_PrioritySlots.acquire)",
+          ("inflight",), ("acquire",), ("release",)),
+    _Rule("probe-token",
+          "the breaker's half-open probe token (admit returned None)",
+          ("breaker",), ("admit",),
+          ("record_success", "record_failure", "release_probe")),
+    _Rule("waiter-heap",
+          "a waiter-heap entry (heappush onto a *waiters* heap)",
+          ("waiters",), ("heappush",), ("heappop",),
+          arg_receiver=True, assign_discharge=True),
+    _Rule("busy-meter",
+          "the device busy meter (_DeviceBusyMeter.begin)",
+          ("meter",), ("begin",), ("end",)),
+    _Rule("rebuild-marker",
+          "the per-space rebuild marker (_rebuilding.add)",
+          ("rebuilding",), ("add",), ("discard", "remove")),
+)
+
+_ANN = re.compile(
+    r"#\s*nebulint:\s*obligation\s*=\s*handed-off(?:/([^#]*))?")
+
+# context-bind matchers — capture.py's receivers, extended to the
+# binder calls themselves
+_BIND_RECEIVERS = {"deadline", "deadlines"}
+_ATTACH_LEAVES = {"attach", "attach_captured"}
+
+
+def _annotation(mod: Module, line: int) -> Optional[str]:
+    """The handed-off reason on ``line`` (or the line above); None if
+    unannotated, "" if annotated without a reason."""
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(mod.lines):
+            m = _ANN.search(mod.lines[ln - 1])
+            if m:
+                return (m.group(1) or "").strip()
+    return None
+
+
+def _components(d: Optional[str]) -> List[str]:
+    return d.split(".") if d else []
+
+
+def _hint_hit(parts: List[str], hints: Tuple[str, ...]) -> bool:
+    return any(h in p for p in parts for h in hints)
+
+
+def _match_call(call: ast.Call, rule: _Rule,
+                leaves: Tuple[str, ...]) -> bool:
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = _components(d)
+    if parts[-1] not in leaves:
+        return False
+    if rule.arg_receiver:
+        if not call.args:
+            return False
+        return _hint_hit(_components(dotted(call.args[0])), rule.hints)
+    return _hint_hit(parts[:-1], rule.hints)
+
+
+class _Acquire:
+    __slots__ = ("rule", "line", "target")
+
+    def __init__(self, rule: _Rule, line: int, target: Optional[str]):
+        self.rule = rule
+        self.line = line
+        self.target = target              # Name the result binds to
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body (nested defs excluded — a closure's discharge
+    only runs when the closure does): acquire/discharge/exit events in
+    source order, each tagged with its handler region and the Name
+    guards of its enclosing ``if`` tests."""
+
+    def __init__(self, fns, fn):
+        self.fns = fns
+        self.fn = fn
+        self.acquires: List[_Acquire] = []
+        # (rule name, line, handler id | None)
+        self.discharges: List[Tuple[str, int, Optional[int]]] = []
+        # (callee qualname, line, handler id | None)
+        self.calls: List[Tuple[str, int, Optional[int]]] = []
+        # (line, guard names, handler id | None)
+        self.exits: List[Tuple[int, frozenset, Optional[int]]] = []
+        self._handler: Optional[int] = None
+        self._next_handler = 0
+        self._guards: List[Set[str]] = []
+        self._assign_target: Optional[str] = None
+
+    # -- scope fences --------------------------------------------------
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    # -- regions -------------------------------------------------------
+    def visit_Try(self, node: ast.Try) -> None:
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        for region in [h.body for h in node.handlers] + [node.finalbody]:
+            if not region:
+                continue
+            prev, self._handler = self._handler, self._next_handler
+            self._next_handler += 1
+            for stmt in region:
+                self.visit(stmt)
+            self._handler = prev
+
+    def visit_If(self, node: ast.If) -> None:
+        names = {n.id for n in ast.walk(node.test)
+                 if isinstance(n, ast.Name)}
+        self.visit(node.test)
+        self._guards.append(names)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._guards.pop()
+
+    # -- events --------------------------------------------------------
+    def visit_Return(self, node: ast.Return) -> None:
+        self._exit(node)
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        self._exit(node)
+        self.generic_visit(node)
+
+    def _exit(self, node: ast.AST) -> None:
+        guards = frozenset().union(*self._guards) if self._guards \
+            else frozenset()
+        self.exits.append((node.lineno, guards, self._handler))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        target = None
+        if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                 ast.Name):
+            target = node.targets[0].id
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                parts = _components(dotted(tgt))
+                for rule in OBLIGATIONS:
+                    if rule.assign_discharge \
+                            and _hint_hit(parts, rule.hints):
+                        self.discharges.append(
+                            (rule.name, node.lineno, self._handler))
+        self._assign_target = target
+        self.visit(node.value)
+        self._assign_target = None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        for rule in OBLIGATIONS:
+            if _match_call(node, rule, rule.acquire):
+                self.acquires.append(_Acquire(rule, node.lineno,
+                                              self._assign_target))
+            if _match_call(node, rule, rule.discharge):
+                self.discharges.append(
+                    (rule.name, node.lineno, self._handler))
+        d = dotted(node.func)
+        if d:
+            callee = _resolve_callee(d, self.fn, self.fns)
+            if callee:
+                self.calls.append((callee, node.lineno, self._handler))
+        prev, self._assign_target = self._assign_target, None
+        self.generic_visit(node)
+        self._assign_target = prev
+
+
+def _callee_discharges(fns) -> Tuple[Dict[str, Set[str]],
+                                     Dict[str, "_FnScan"]]:
+    """Fixpoint: the rule names each function discharges, directly or
+    through same-module callees — blocking.py's effect propagation,
+    with 'discharges R' as the effect.  Returns (effects, scans)."""
+    scans: Dict[str, _FnScan] = {}
+    for qual, fn in fns.items():
+        scan = _FnScan(fns, fn)
+        for stmt in getattr(fn.node, "body", []):
+            scan.visit(stmt)
+        scans[qual] = scan
+    effects: Dict[str, Set[str]] = {
+        q: {name for name, _l, _h in s.discharges}
+        for q, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, scan in scans.items():
+            for callee, _line, _h in scan.calls:
+                extra = effects[callee] - effects[qual]
+                if extra:
+                    effects[qual] |= extra
+                    changed = True
+    return effects, scans
+
+
+def check_obligations(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    for mod in ctx.modules:
+        fns = _collect_fns(mod.tree)
+        if fns:
+            effects, scans = _callee_discharges(fns)
+            for qual in sorted(fns):
+                _check_fn(mod, qual, scans[qual], effects, out)
+        _scan_special_forms(mod, out)
+    return out
+
+
+def _check_fn(mod: Module, qual: str, scan: _FnScan,
+              effects: Dict[str, Set[str]],
+              out: List[Violation]) -> None:
+    if not scan.acquires:
+        return
+    # expand helper calls into discharge events for the rules they
+    # (transitively) discharge — the call site inherits its region
+    discharges = list(scan.discharges)
+    for callee, line, handler in scan.calls:
+        for rname in effects.get(callee, ()):
+            discharges.append((rname, line, handler))
+
+    for acq in scan.acquires:
+        rule = acq.rule
+        ann = _annotation(mod, acq.line)
+        if ann is not None:
+            if not ann:
+                out.append(Violation(
+                    CHECK, mod.rel, acq.line, qual,
+                    "obligation=handed-off without a reason — name "
+                    "WHO discharges it (handed-off/<reason>), same "
+                    "stance as baseline justifications"))
+            continue                          # annotated: whole
+                                              # instance waived
+        after = [(ln, h) for name, ln, h in discharges
+                 if name == rule.name and ln >= acq.line]
+        normal = [ln for ln, h in after if h is None]
+        on_edge = [ln for ln, h in after if h is not None]
+        if not normal:
+            where = ("only discharged inside an except/finally — the "
+                     "SUCCESS path leaks it" if on_edge else
+                     "never discharged in this function")
+            out.append(Violation(
+                CHECK, mod.rel, acq.line, qual,
+                f"{rule.what} acquired here is {where}: every exit "
+                f"path must call {' / '.join(rule.discharge)}, or the "
+                f"acquire carries "
+                f"'# nebulint: obligation=handed-off/<reason>'"))
+            continue
+        first_normal = min(normal)
+        for eline, guards, ehandler in scan.exits:
+            if not (acq.line < eline < first_normal):
+                continue
+            if acq.target and acq.target in guards:
+                continue          # the decline branch: admit returned
+                                  # a reason, no token was taken
+            if ehandler is not None and any(
+                    h == ehandler and ln <= eline for ln, h in after):
+                continue          # handler discharged before raising on
+            eann = _annotation(mod, eline)
+            if eann is not None:
+                if not eann:
+                    out.append(Violation(
+                        CHECK, mod.rel, eline, qual,
+                        "obligation=handed-off without a reason — "
+                        "name WHO discharges it (handed-off/<reason>)"))
+                continue
+            out.append(Violation(
+                CHECK, mod.rel, eline, qual,
+                f"exit between acquiring {rule.what} (line "
+                f"{acq.line}) and its first discharge (line "
+                f"{first_normal}) leaks the obligation — discharge "
+                f"before leaving, or annotate the handoff"))
+        if rule.exception_edges and not on_edge:
+            out.append(Violation(
+                CHECK, mod.rel, acq.line, qual,
+                f"{rule.what} has no discharge on the exception edge "
+                f"— an exception between acquire and discharge leaks "
+                f"it forever: discharge in an except/finally (the "
+                f"_PrioritySlots/probe-token pattern), or annotate "
+                f"the handoff"))
+
+
+# ------------------------------------------------------- special forms
+def _scan_special_forms(mod: Module, out: List[Violation]) -> None:
+    def symbol(stack: List[str]) -> str:
+        return stack[-1] if stack else "<module>"
+
+    def is_cond_item(item: ast.withitem) -> bool:
+        d = dotted(item.context_expr)
+        return bool(d) and "cond" in _components(d)[-1]
+
+    with_items: Set[int] = set()       # id()s of with-item call nodes
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+
+    def walk(node: ast.AST, stack: List[str],
+             cond_with: Optional[ast.With]) -> None:
+        for child in ast.iter_child_nodes(node):
+            nstack = stack
+            ncond = cond_with
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                q = f"{stack[-1]}.{child.name}" if stack else child.name
+                nstack = stack + [q]
+                ncond = None              # a nested def is its own
+                                          # locked-region world
+            elif isinstance(child, ast.ClassDef):
+                nstack = stack + [child.name]
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                if any(is_cond_item(i) for i in child.items):
+                    ncond = child
+            elif isinstance(child, ast.Assign) and ncond is not None:
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr == "done" \
+                            and isinstance(child.value, ast.Constant) \
+                            and child.value.value is True:
+                        _check_rider_wake(mod, child, ncond,
+                                          symbol(stack), out)
+            elif isinstance(child, ast.Call):
+                _check_context_bind(mod, child, with_items,
+                                    symbol(stack), out)
+            walk(child, nstack, ncond)
+
+    walk(mod.tree, [], None)
+
+
+def _region_notifies(region: ast.AST) -> bool:
+    for sub in ast.walk(region):
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func) or ""
+            if d.rsplit(".", 1)[-1] == "notify_all":
+                return True
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+    return False
+
+
+def _check_rider_wake(mod: Module, assign: ast.Assign,
+                      cond_with: ast.With, symbol: str,
+                      out: List[Violation]) -> None:
+    if _region_notifies(cond_with):
+        return
+    ann = _annotation(mod, assign.lineno)
+    if ann:
+        return
+    out.append(Violation(
+        CHECK, mod.rel, assign.lineno, symbol,
+        "rider marked done=True under the condition with no "
+        "notify_all() in the same locked region — the flipped flag "
+        "wakes nobody and its waiter sleeps to timeout (the "
+        "missed-wakeup class: unseat/finish/evict must notify)"))
+
+
+def _check_context_bind(mod: Module, call: ast.Call,
+                        with_items: Set[int], symbol: str,
+                        out: List[Violation]) -> None:
+    d = dotted(call.func)
+    if not d:
+        return
+    parts = _components(d)
+    leaf = parts[-1]
+    recv = parts[-2] if len(parts) >= 2 else ""
+    binder = (leaf == "bind" and recv in _BIND_RECEIVERS) or \
+        (leaf in _ATTACH_LEAVES and recv == "tracing")
+    if not binder or id(call) in with_items:
+        return
+    if _annotation(mod, call.lineno):
+        return
+    out.append(Violation(
+        CHECK, mod.rel, call.lineno, symbol,
+        f"{d}(...) binds a thread context outside a with-statement — "
+        f"a bound deadline/trace that is never unbound poisons every "
+        f"later query on this thread: use 'with {d}(...):' (or "
+        f"annotate the handoff)"))

@@ -1,0 +1,348 @@
+"""protocol-registry — the typed-reason vocabulary is closed, and
+state-machine fields only move inside their declared transitions.
+
+``common/protocol.py`` holds ONE ``PROTOCOL_REASONS`` registry (the
+EVENT_KINDS stance applied to reason strings: absorb declines,
+peer-delta stream breaks, shed/reject classes, continuous bounce and
+ending kinds, device-failure verdicts), a ``TYPED_RAISES`` tuple of
+exceptions that must always carry a reason, and a ``STATE_MACHINES``
+table declaring which fields the breaker/mirror-generation machines
+own and which methods may write them.  This pass proves, statically:
+
+  * registered reason values never appear as bare string literals
+    outside the registry module — a copy-pasted literal drifts from
+    the vocabulary the dashboards and soaks filter on (use the
+    constant; dict-KEY and ``.get("key")`` positions are field names,
+    not reasons, and stay out of scope);
+  * every typed reason SITE — a ``reason=`` / ``decision=`` /
+    ``ending=`` keyword, the reason argument of ``_shed`` /
+    ``_deadline_reject`` / ``_note_stalled`` / ``record_failure``,
+    and the second argument of a TYPED_RAISES constructor — passes a
+    registered constant (or a variable, which the producers above
+    already typed); an unregistered literal there is an UNKNOWN
+    reason: register it first, exactly EventJournal.record's runtime
+    contract, statically;
+  * a TYPED_RAISES exception constructed without any reason is an
+    untyped bounce (it cannot be counted, routed or asserted on);
+  * registered constants nobody references are dead vocabulary
+    (the dead-flag/dead-event-kind argument);
+  * fields declared in STATE_MACHINES are assigned only inside their
+    declared writer methods within their module — a state write from
+    anywhere else is a protocol violation even under the right lock
+    (the breaker's CLOSED/OPEN/HALF_OPEN and the mirror generation
+    spine are load-bearing for every serving path).
+
+The registry must exist exactly once; like MESH_CARVEOUTS, a second
+copy is itself a violation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import PackageContext, Violation, dotted, enclosing_symbol, \
+    qualname_map
+
+CHECK = "protocol-registry"
+
+# call leaves whose Nth positional argument is a typed reason
+_ARG_SITES = {
+    "_shed": 1, "_deadline_reject": 1, "record_failure": 1,
+    "_note_stalled": 0,
+    "AdmissionShed": 1, "ContinuousUnavailable": 1,
+}
+_KWARG_SITES = ("reason", "decision", "ending")
+
+
+class _Registry:
+    __slots__ = ("rel", "line", "values", "consts", "families",
+                 "typed_raises", "machines", "const_lines")
+
+    def __init__(self, rel: str, line: int):
+        self.rel = rel
+        self.line = line
+        self.values: Dict[str, str] = {}     # value -> constant name
+        self.consts: Dict[str, str] = {}     # constant name -> value
+        self.families: Dict[str, List[str]] = {}
+        self.typed_raises: Tuple[str, ...] = ()
+        self.machines: Dict[str, dict] = {}
+        self.const_lines: Dict[str, int] = {}
+
+
+def _module_consts(tree: ast.AST) -> Dict[str, Tuple[str, int]]:
+    """Module-level ``NAME = "literal"`` assignments."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = (node.value.value, node.lineno)
+    return out
+
+
+def _resolve(node: ast.AST,
+             consts: Dict[str, Tuple[str, int]]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id][0]
+    return None
+
+
+def _load_registry(mod) -> Optional[_Registry]:
+    consts = _module_consts(mod.tree)
+    reg: Optional[_Registry] = None
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name == "PROTOCOL_REASONS" and isinstance(node.value,
+                                                     ast.Dict):
+            reg = reg or _Registry(mod.rel, node.lineno)
+            reg.line = node.lineno
+            for k, v in zip(node.value.keys, node.value.values):
+                fam = _resolve(k, consts)
+                if fam is None or not isinstance(v, (ast.Tuple,
+                                                     ast.List)):
+                    continue
+                vals = []
+                for el in v.elts:
+                    val = _resolve(el, consts)
+                    if val is None:
+                        continue
+                    vals.append(val)
+                    cname = el.id if isinstance(el, ast.Name) else None
+                    if cname is None:
+                        # a raw literal in the registry still closes
+                        # the set; it just has no constant to point at
+                        cname = val
+                        reg.const_lines.setdefault(val, el.lineno)
+                    else:
+                        reg.const_lines[cname] = consts.get(
+                            cname, (val, el.lineno))[1]
+                    reg.values[val] = cname
+                    reg.consts[cname] = val
+                reg.families[fam] = vals
+    if reg is None:
+        return None
+    try:
+        ns: Dict[str, object] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in ("TYPED_RAISES",
+                                               "STATE_MACHINES"):
+                ns[node.targets[0].id] = ast.literal_eval(node.value)
+        tr = ns.get("TYPED_RAISES")
+        if isinstance(tr, tuple):
+            reg.typed_raises = tuple(str(t) for t in tr)
+        sm = ns.get("STATE_MACHINES")
+        if isinstance(sm, dict):
+            reg.machines = sm
+    except (ValueError, SyntaxError):
+        pass        # non-literal tables: the reason legs still run
+    return reg
+
+
+def check_protocol_registry(ctx: PackageContext) -> List[Violation]:
+    out: List[Violation] = []
+    regs: List[Tuple[_Registry, object]] = []
+    for mod in ctx.modules:
+        reg = _load_registry(mod)
+        if reg is not None:
+            regs.append((reg, mod))
+    if len(regs) > 1:
+        for reg, _m in regs[1:]:
+            out.append(Violation(
+                CHECK, reg.rel, reg.line, "<module>",
+                "second PROTOCOL_REASONS registry — typed reasons must "
+                f"come from ONE registry (first at {regs[0][0].rel}:"
+                f"{regs[0][0].line})"))
+    if not regs:
+        return out
+    reg = regs[0][0]
+    used: Set[str] = set()
+
+    for mod in ctx.modules:
+        if mod.rel == reg.rel:
+            continue
+        _scan_module(mod, reg, used, out)
+        _scan_state_machines(mod, reg, out)
+
+    for cname, value in sorted(reg.consts.items()):
+        if cname not in used:
+            out.append(Violation(
+                CHECK, reg.rel, reg.const_lines.get(cname, reg.line),
+                "<module>",
+                f"protocol reason {value!r} ({cname}) is registered "
+                f"but never emitted by any site — dead vocabulary: "
+                f"delete it or instrument the seam"))
+    return out
+
+
+def _scan_state_machines(mod, reg: _Registry,
+                         out: List[Violation]) -> None:
+    """STATE_MACHINES leg: fields move only in declared transitions."""
+    machines = [(name, m) for name, m in reg.machines.items()
+                if isinstance(m, dict)
+                and mod.rel.endswith(str(m.get("module", "\0")))]
+    if not machines:
+        return
+    qmap = qualname_map(mod.tree)
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            targets = ()
+            if isinstance(child, ast.Assign):
+                targets = child.targets
+            elif isinstance(child, ast.AugAssign):
+                targets = (child.target,)
+            for t in targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                for name, m in machines:
+                    if t.attr not in m.get("fields", ()):
+                        continue
+                    sym = enclosing_symbol(qmap, stack)
+                    leaf = sym.rsplit(".", 1)[-1]
+                    if leaf in m.get("writers", ()):
+                        continue
+                    out.append(Violation(
+                        CHECK, mod.rel, child.lineno, sym,
+                        f"write to {name} state field .{t.attr} "
+                        f"outside its declared transition methods "
+                        f"({', '.join(m.get('writers', ()))}) — state "
+                        f"machines move only inside their own "
+                        f"transitions, even under the right lock"))
+            new_stack = stack + [child] if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)) else stack
+            walk(child, new_stack)
+
+    walk(mod.tree, [])
+
+
+def _scan_module(mod, reg: _Registry, used: Set[str],
+                 out: List[Violation]) -> None:
+    qmap = qualname_map(mod.tree)
+    # literals that sit in key-ish positions (dict keys, subscripts,
+    # .get("k") lookups) are field names, not reason values
+    key_pos: Set[int] = set()
+    # literal nodes consumed by a typed SITE (reported there, not by
+    # the generic literal-leak scan)
+    site_nodes: Set[int] = set()
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    key_pos.add(id(k))
+        elif isinstance(node, ast.Subscript):
+            key_pos.add(id(node.slice))
+        elif isinstance(node, ast.Compare):
+            # `reason == CONST` wants the constant too, but a literal
+            # compared against a NON-reason (state strings, wire field
+            # probes like `"transfer" in low`) is someone else's
+            # business: only flag equality against a registered value
+            pass
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf == "get" and node.args:
+                key_pos.add(id(node.args[0]))
+
+    def mark_expr(expr: ast.AST, site: str, line: int,
+                  sym: str) -> None:
+        """One typed site's reason expression."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Constant) \
+                    and isinstance(sub.value, str):
+                site_nodes.add(id(sub))
+                if sub.value in reg.values:
+                    used.add(reg.values[sub.value])
+                    out.append(Violation(
+                        CHECK, mod.rel, line, sym,
+                        f"bare literal {sub.value!r} at a typed "
+                        f"{site} site — use "
+                        f"protocol.{reg.values[sub.value]} so the "
+                        f"vocabulary stays closed"))
+                else:
+                    out.append(Violation(
+                        CHECK, mod.rel, line, sym,
+                        f"unknown reason {sub.value!r} at a typed "
+                        f"{site} site — register it in "
+                        f"PROTOCOL_REASONS ({reg.rel}) first"))
+            elif isinstance(sub, ast.Name) and sub.id in reg.consts:
+                used.add(sub.id)
+            elif isinstance(sub, ast.Attribute) \
+                    and sub.attr in reg.consts:
+                used.add(sub.attr)
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call):
+                sym = enclosing_symbol(qmap, stack)
+                d = dotted(child.func) or ""
+                leaf = d.rsplit(".", 1)[-1]
+                for kw in child.keywords:
+                    if kw.arg in _KWARG_SITES:
+                        mark_expr(kw.value, kw.arg, child.lineno, sym)
+                idx = _ARG_SITES.get(leaf)
+                if idx is not None and len(child.args) > idx:
+                    mark_expr(child.args[idx], leaf, child.lineno, sym)
+                if leaf in reg.typed_raises:
+                    has_reason = len(child.args) >= 2 or any(
+                        kw.arg == "reason" for kw in child.keywords)
+                    if not has_reason:
+                        out.append(Violation(
+                            CHECK, mod.rel, child.lineno, sym,
+                            f"{leaf}(...) constructed without a typed "
+                            f"reason — an untyped bounce cannot be "
+                            f"counted, routed or asserted on: pass a "
+                            f"PROTOCOL_REASONS constant"))
+            new_stack = stack + [child] if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)) else stack
+            walk(child, new_stack)
+
+    walk(mod.tree, [])
+
+    # generic literal-leak scan + constant-reference accounting
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Name) and node.id in reg.consts:
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute) and node.attr in reg.consts:
+            used.add(node.attr)
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def sym_for(node: ast.AST) -> str:
+        cur = node
+        while cur is not None:
+            if cur in qmap:
+                return qmap[cur]
+            cur = parents.get(id(cur))
+        return "<module>"
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        if node.value not in reg.values:
+            continue
+        if id(node) in site_nodes or id(node) in key_pos:
+            continue
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Expr):
+            continue                       # docstring / bare literal
+        used.add(reg.values[node.value])
+        out.append(Violation(
+            CHECK, mod.rel, node.lineno, sym_for(node),
+            f"bare literal {node.value!r} duplicates a registered "
+            f"protocol reason — use "
+            f"protocol.{reg.values[node.value]} (a drifting copy "
+            f"breaks every dashboard and soak that filters on it)"))

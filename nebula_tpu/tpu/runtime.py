@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import deadline as deadlines
+from ..common import protocol
 from ..common import tracing
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
@@ -706,6 +707,10 @@ class TpuQueryRuntime:
                         and space_id not in self._rebuilding \
                         and not self._bg_stop.is_set()
                     if spawn:
+                        # the marker outlives this call by design: the
+                        # background _rebuild_async's finally discards
+                        # it when the rebuild lands (or dies)
+                        # nebulint: obligation=handed-off/discarded-by-rebuild-async
                         self._rebuilding.add(space_id)
                 if cur is not None:
                     if spawn:
@@ -875,7 +880,7 @@ class TpuQueryRuntime:
         from ..common.events import journal
         with self._lock:
             self.stats["mirror_absorb_failed"] += 1
-            if reason == "delta-overflow":
+            if reason == protocol.ABSORB_DELTA_OVERFLOW:
                 self.stats["mirror_delta_overflow"] += 1
         journal.record("mirror.absorb_failed",
                        detail=f"space {space_id}: {reason} "
@@ -892,9 +897,9 @@ class TpuQueryRuntime:
         reason."""
         sig = tuple(len(s.part_ids(space_id)) for s in stores)
         if getattr(m, "_part_sig", None) != sig:
-            return None, "part-moved", 0
+            return None, protocol.ABSORB_PART_MOVED, 0
         if len(stores) != len(m._delta_cursors):
-            return None, "peer-set-changed", 0
+            return None, protocol.ABSORB_PEER_SET_CHANGED, 0
         new_events = []
         cursors = dict(m._delta_cursors)
         n_peer_events = 0
@@ -909,7 +914,7 @@ class TpuQueryRuntime:
                 # the journaled reason then names WHY the rebuild is
                 # about to be paid instead of a generic opaque-events
                 reason = getattr(s, "last_delta_decline", None) \
-                    or "opaque-events"
+                    or protocol.ABSORB_OPAQUE_EVENTS
                 if getattr(s, "is_remote", False):
                     with self._lock:
                         self.stats["peer_absorb_failed"] = \
@@ -922,7 +927,7 @@ class TpuQueryRuntime:
         n_events = len(new_events)
         edge_events = [e for e in new_events if e[0] != "vput"]
         if len(edge_events) > int(flags.get("mirror_delta_max") or 4096):
-            return None, "delta-overflow", n_events
+            return None, protocol.ABSORB_DELTA_OVERFLOW, n_events
         from .csr import (build_delta_mirror, commit_vertex_plan,
                           plan_vertex_events)
         # ORDER MATTERS for commit atomicity: plan the vertex writes
@@ -933,7 +938,7 @@ class TpuQueryRuntime:
         # (the device-side analogue of the torn-scan guard)
         vplan = plan_vertex_events(m, new_events, self.sm, space_id)
         if vplan is None:
-            return None, "vertex-write-unabsorbable", n_events
+            return None, protocol.ABSORB_VERTEX_UNABSORBABLE, n_events
 
         def commit_in_place():
             with self._lock:
@@ -948,19 +953,20 @@ class TpuQueryRuntime:
             # vertex-only window: numeric single-element stores commit
             # in place (csr.commit_vertex_plan's values-first/valid-
             # last stance) — no table content moves, no new generation
-            return commit_in_place(), "vertex-in-place", n_events
+            return commit_in_place(), protocol.ABSORB_VERTEX_IN_PLACE, \
+                n_events
         d = build_delta_mirror(m, edge_events, self.sm, space_id)
         if d is None:
-            return None, "overlay-unbuildable", n_events
+            return None, protocol.ABSORB_OVERLAY_UNBUILDABLE, n_events
         if len(d.extra_vids):
-            return None, "vertex-plan-change", n_events
+            return None, protocol.ABSORB_VERTEX_PLAN_CHANGE, n_events
         if d.m == 0 and not len(d.base_dead):
             # the window's edge events collapsed to nothing (e.g. a
             # put+delete of the same fresh edge): cursors still advance
-            return commit_in_place(), "no-op", n_events
+            return commit_in_place(), protocol.ABSORB_NO_OP, n_events
         new_m = self._absorb_build(space_id, m, d)
         if new_m is None:
-            return None, "slot-overflow", n_events
+            return None, protocol.ABSORB_SLOT_OVERFLOW, n_events
         with self._lock:
             commit_vertex_plan(m, vplan)
             self._publish(space_id, new_m, ver, stores, vers,

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-shot merge gate (docs/STATUS.md "round 17"): everything a PR
+# must hold, in the order a failure is cheapest to see.
+#
+#   1. tier-1 — the fast test suite on the forced-CPU jax platform
+#      (the same invocation the driver scores; `-m 'not slow'` keeps
+#      the chaos soaks and bench legs out of the gate);
+#   2. nebulint — the eighteen-check static/semantic/flow suite, run
+#      ONCE in SARIF mode with the baseline applied; the JSON lands in
+#      $CI_ARTIFACT_DIR (default build/) so CI uploads it as an
+#      annotation artifact, and a non-empty `results` array fails the
+#      gate exactly like the plain CLI would;
+#   3. micro_bench — the performance-budget components (`--quick`
+#      statistics are noisier but the budgets are sized for it); the
+#      lint cold-wall budget (40 s), the admission/recovery/absorb/
+#      continuous path budgets and the kernel roofline all gate here
+#      via micro_bench's own exit status.
+#
+# scripts/lint.sh remains the interactive lint + sanitizer entry
+# point; this script is the merge gate CI calls.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARTIFACT_DIR="${CI_ARTIFACT_DIR:-build}"
+mkdir -p "${ARTIFACT_DIR}"
+
+echo "== tier-1 (pytest, JAX_PLATFORMS=cpu) =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== nebulint (SARIF artifact -> ${ARTIFACT_DIR}/nebulint.sarif) =="
+JAX_PLATFORMS=cpu python -m nebula_tpu.tools.lint --format=sarif \
+  > "${ARTIFACT_DIR}/nebulint.sarif"
+
+echo "== micro_bench (budget components, --quick) =="
+JAX_PLATFORMS=cpu python -m nebula_tpu.tools.micro_bench --quick \
+  > "${ARTIFACT_DIR}/micro_bench.json"
+
+echo "ci.sh: merge gate green (artifacts in ${ARTIFACT_DIR}/)"

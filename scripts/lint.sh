@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Static-analysis + sanitizer gate (docs/static_analysis.md):
-#   1. nebulint — the sixteen whole-package checks over nebula_tpu:
+#   1. nebulint — the eighteen whole-package checks over nebula_tpu:
 #      the AST checks (lock discipline, lock-order cycles, Status
 #      discipline, JAX hot-path hygiene, flag/span/metric/event
 #      registries), the two SEMANTIC passes — the jaxpr device-path
@@ -13,7 +13,13 @@
 #      analysis, plus the stale-suppression fossil detector — and the
 #      v4 MESH layer: the SPMD collective/ICI-traffic/capacity
 #      auditor (2/4/8-way CPU-mesh traces) and the carve-out
-#      inventory over tpu/runtime.py's CPU-decline sites;
+#      inventory over tpu/runtime.py's CPU-decline sites — and the
+#      v5 OBLIGATION layer: must-call-on-all-paths tracking over the
+#      acquire/release registry (lane seats, probe tokens, pipeline
+#      slots, waiter heaps, the busy meter, rebuild markers, rider
+#      wakeups, context binds) and the typed-protocol registry
+#      closing every reason string + state-machine transition
+#      (common/protocol.py);
 #   2. asan_driver — the native C ABI driven under the ASan+UBSan build,
 #      when `make -C native asan` has produced the instrumented .so and
 #      libasan is present (skipped, loudly, otherwise).

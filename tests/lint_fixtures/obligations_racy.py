@@ -1,0 +1,94 @@
+"""Seeded obligation-tracking violations (lint fixture — see README).
+
+A miniature continuous-serving module carrying the THREE historical
+bug classes the pass exists to catch, plus the annotation edge cases:
+
+  * ``go_via_device`` — the PR 7 class: a half-open probe token taken
+    from the breaker leaks on an early ``return`` (the decline branch
+    and the except-handler settle are the CLEAN shapes around it);
+  * ``finish`` — the PR 6 class: a rider marked ``done=True`` under
+    the condition with no ``notify_all`` in the locked region (the
+    missed wakeup);
+  * ``tick`` — the PR 15 class: a lane seat allocated, released only
+    on the normal path — ``extract`` raising strands the seat and its
+    waiter (no exception-edge discharge);
+  * ``seat_forever`` — a seat that is never released at all;
+  * ``handoff_unnamed`` — a handed-off annotation with no reason;
+  * ``poison_thread`` — ``deadlines.bind`` outside a with-statement.
+
+``handoff_ok`` and ``acquire`` prove the waivers and the canonical
+try/except settle pass clean.
+"""
+import heapq
+
+
+class TpuDecline(Exception):
+    pass
+
+
+class Stream:
+    def go_via_device(self, key):
+        why = self.breaker.admit(key)
+        if why is not None:
+            # decline branch: no token was taken — clean
+            raise TpuDecline(why)
+        if self.mirror is None:
+            return None             # PR 7: the probe token leaks here
+        try:
+            out = self.device.run(key)
+        except Exception as ex:
+            self.breaker.record_failure(key, "xla_runtime")
+            raise
+        self.breaker.record_success(key)
+        return out
+
+    def finish(self, rider):
+        with self.cond:
+            rider.result = 1
+            rider.done = True       # PR 6: nobody is notified
+
+    def tick(self, rider):
+        lane = self.ledger.alloc()  # PR 15: extract() raising strands
+        self.seated[lane] = rider   # the seat — no except/finally
+        resolver = self.sess.extract([(lane, rider)])
+        self.ledger.release(lane)
+        return resolver
+
+    def seat_forever(self, rider):
+        lane = self.ledger.alloc()  # never released at all
+        self.seated[lane] = rider
+
+    def handoff_unnamed(self):
+        # nebulint: obligation=handed-off/
+        lane = self.ledger.alloc()
+        self.seated[lane] = 1
+
+    def handoff_ok(self):
+        # nebulint: obligation=handed-off/retired-with-the-stream
+        lane = self.ledger.alloc()
+        self.seated[lane] = 1
+
+    def acquire(self, prio, seq):
+        # the canonical _PrioritySlots shape: heap entry + slot both
+        # settle on the exception edge — clean
+        with self.cond:
+            heapq.heappush(self._waiters, (prio, seq))
+            try:
+                while self._used >= self.limit:
+                    self.cond.wait()
+            except BaseException:
+                self._waiters = [w for w in self._waiters
+                                 if w[1] != seq]
+                heapq.heapify(self._waiters)
+                self.cond.notify_all()
+                raise
+            heapq.heappop(self._waiters)
+            self._used += 1
+
+    def poison_thread(self, dl):
+        deadlines.bind(dl)          # bound, never unbound
+        return self.run()
+
+    def bind_ok(self, dl):
+        with deadlines.bind(dl):
+            return self.run()

@@ -1,0 +1,59 @@
+"""Seeded protocol-registry violations (lint fixture — see README).
+
+The SITES module of a two-module fixture: tests/test_lint.py pairs it
+with a miniature ``common/protocol.py`` registry (PROTOCOL_REASONS /
+TYPED_RAISES / STATE_MACHINES) and copies this file to
+``<root>/storage/device.py`` so the breaker-cell state machine's
+module matcher sees the real module name.  Seeds, in order: a bare
+registered literal at a typed ``_shed`` site, an UNKNOWN reason, an
+untyped ``AdmissionShed``, a bare literal at a ``reason=`` keyword, a
+registered literal leaking into a comparison, and a state-field write
+outside the declared transition methods.  ``record_failure`` and
+``admit_ok`` prove variable flow and constant references pass clean.
+"""
+
+
+class AdmissionShed(Exception):
+    pass
+
+
+class Breaker:
+    def __init__(self):
+        self.state = "closed"           # declared writer — clean
+
+    def record_failure(self, key, reason):
+        self.state = "open"             # declared writer — clean
+        journal(reason=reason)          # variable flow — clean
+
+    def force_open(self):
+        self.state = "open"             # write outside the writers
+
+
+def _shed(key, reason, depth):
+    raise AdmissionShed(f"shed at admission ({reason})", reason)
+
+
+def admit(key, depth):
+    if depth > 10:
+        _shed(key, "queue_full", depth)      # bare registered literal
+    if depth < 0:
+        _shed(key, "weird-reason", depth)    # unknown reason
+    if depth == 7:
+        raise AdmissionShed("untyped")       # no reason argument
+
+
+def note_absorb(space_id):
+    journal(detail=f"space {space_id}",
+            reason="part-moved")             # bare literal at reason=
+
+
+def count_overflow(reason):
+    if reason == "delta-overflow":           # literal leaks into a
+        return 1                             # comparison
+    return 0
+
+
+def admit_ok(key, depth):
+    if depth > 10:
+        _shed(key, protocol.SHED_QUEUE_FULL,
+              depth)                         # constant ref — clean

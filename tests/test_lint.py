@@ -509,7 +509,9 @@ def test_all_checks_registered():
                                "guard-inference", "blocking-under-lock",
                                "context-capture", "jaxpr-audit",
                                "mesh-audit", "carveout-inventory",
-                               "wire-contract", "stale-suppression"}
+                               "wire-contract", "obligation-tracking",
+                               "protocol-registry",
+                               "stale-suppression"}
 
 
 # ========================================== OrderedLock runtime watchdog
@@ -2376,8 +2378,9 @@ SARIF_GOLDEN = os.path.join(FIXTURE_DIR, "golden.sarif")
 
 
 def _sarif_fixture_run(tmp_path, capsys):
-    """One seeded flag-registry violation through the CLI in SARIF
-    mode; paths are repo-root-relative, so the payload is stable."""
+    """One seeded flag-registry violation plus one seeded
+    obligation-tracking violation through the CLI in SARIF mode; paths
+    are repo-root-relative, so the payload is stable."""
     from nebula_tpu.tools.lint.__main__ import main
     import textwrap
     root = tmp_path / "pkg"
@@ -2387,9 +2390,14 @@ def _sarif_fixture_run(tmp_path, capsys):
 
         def f():
             return flags.get("undefined_flag_a")
+
+        def seat(self):
+            lane = self.ledger.alloc()
+            return lane
     """))
     rc = main(["--format=sarif", "--no-baseline", "--no-cache",
-               "--check", "flag-registry", str(root)])
+               "--check", "flag-registry",
+               "--check", "obligation-tracking", str(root)])
     out = capsys.readouterr().out
     return rc, json.loads(out)
 
@@ -2420,3 +2428,244 @@ def test_sarif_clean_run_is_valid_and_empty(tmp_path, capsys):
     assert rc == 0
     assert doc["version"] == "2.1.0"
     assert doc["runs"][0]["results"] == []
+
+# ============================================= 19 · obligation-tracking
+def test_obligation_fixture_fires_all_historical_bugs(tmp_path):
+    """The three review-record bug classes (PR 7 unreleased probe
+    token, PR 6 missed wakeup, PR 15 stranded seat on extract failure)
+    plus the annotation edge cases — six violations, no more: the
+    decline branch, the handler settle, the canonical _PrioritySlots
+    shape, the named handoff and the with-bound deadline all pass."""
+    vs = run_fixture(tmp_path,
+                     {"graph/stream.py": fixture_src(
+                         "obligations_racy.py")},
+                     checks=["obligation-tracking"])
+    msgs = {v.symbol: v.message for v in vs}
+    assert len(vs) == 6, "\n".join(repr(v) for v in vs)
+    assert "probe token" in msgs["Stream.go_via_device"]
+    assert "leaks the obligation" in msgs["Stream.go_via_device"]
+    assert "wakes nobody" in msgs["Stream.finish"]
+    assert "exception edge" in msgs["Stream.tick"]
+    assert "never discharged" in msgs["Stream.seat_forever"]
+    assert "without a reason" in msgs["Stream.handoff_unnamed"]
+    assert "binds a thread context" in msgs["Stream.poison_thread"]
+
+
+def test_obligation_historical_fixes_restore_clean(tmp_path):
+    """Each historical bug's FIX, re-applied to the fixture, silences
+    exactly its violation — the fixture is the reverted-fix state."""
+    src = fixture_src("obligations_racy.py")
+    # PR 7: settle the probe token before the early return
+    src = src.replace(
+        "            return None             "
+        "# PR 7: the probe token leaks here",
+        "            self.breaker.release_probe(key)\n"
+        "            return None")
+    # PR 6: notify under the same condition
+    src = src.replace(
+        "            rider.done = True       # PR 6: nobody is notified",
+        "            rider.done = True\n"
+        "            self.cond.notify_all()")
+    # PR 15: release the seat on the extract exception edge too
+    src = src.replace(
+        "        resolver = self.sess.extract([(lane, rider)])\n"
+        "        self.ledger.release(lane)",
+        "        try:\n"
+        "            resolver = self.sess.extract([(lane, rider)])\n"
+        "        except BaseException:\n"
+        "            self.ledger.release(lane)\n"
+        "            raise\n"
+        "        self.ledger.release(lane)")
+    vs = run_fixture(tmp_path, {"graph/stream.py": src},
+                     checks=["obligation-tracking"])
+    symbols = sorted(v.symbol for v in vs)
+    assert symbols == ["Stream.handoff_unnamed", "Stream.poison_thread",
+                       "Stream.seat_forever"], \
+        "\n".join(repr(v) for v in vs)
+
+
+def test_obligation_handed_off_annotation_waives(tmp_path):
+    src = """
+    class S:
+        def seat(self, r):
+            # nebulint: obligation=handed-off/released-by-the-pump
+            lane = self.ledger.alloc()
+            self.seated[lane] = r
+    """
+    assert run_fixture(tmp_path, {"m.py": src},
+                       checks=["obligation-tracking"]) == []
+
+
+def test_obligation_callee_discharge_propagates(tmp_path):
+    """The blocking.py call-graph reuse: submit's slot is settled by
+    the _run it hands the batch to — no violation at the acquire."""
+    src = """
+    class D:
+        def submit(self, req):
+            self._inflight.acquire(1)
+            try:
+                return self._run(req)
+            except BaseException:
+                self._inflight.release()
+                raise
+
+        def _run(self, req):
+            try:
+                return req
+            finally:
+                self._inflight.release()
+    """
+    assert run_fixture(tmp_path, {"m.py": src},
+                       checks=["obligation-tracking"]) == []
+
+
+def test_obligation_suppression_roundtrip(tmp_path):
+    src = """
+    class S:
+        def seat(self):
+            lane = self.ledger.alloc()  # nebulint: disable=obligation-tracking
+            return lane
+    """
+    assert run_fixture(tmp_path, {"m.py": src},
+                       checks=["obligation-tracking"]) == []
+
+
+def test_obligation_package_sites_all_discharged():
+    vs = lint_paths(PKG_ROOT, checks=["obligation-tracking"])
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+# ============================================== 20 · protocol-registry
+_PROTO_REGISTRY = """
+    ABSORB_PART_MOVED = "part-moved"
+    ABSORB_DELTA_OVERFLOW = "delta-overflow"
+    SHED_QUEUE_FULL = "queue_full"
+    DEAD_REASON = "never-emitted"
+
+    PROTOCOL_REASONS = {
+        "absorb-decline": (ABSORB_PART_MOVED, ABSORB_DELTA_OVERFLOW),
+        "shed": (SHED_QUEUE_FULL,),
+        "dead": (DEAD_REASON,),
+    }
+
+    TYPED_RAISES = ("AdmissionShed",)
+
+    STATE_MACHINES = {
+        "breaker-cell": {
+            "module": "storage/device.py",
+            "fields": ("state",),
+            "writers": ("__init__", "record_failure"),
+        },
+    }
+"""
+
+
+def test_protocol_fixture_fires_every_leg(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "common/protocol.py": _PROTO_REGISTRY,
+        "storage/device.py": fixture_src("protocol_racy.py"),
+    }, checks=["protocol-registry"])
+    msgs = [v.message for v in vs]
+    assert any("bare literal 'queue_full' at a typed _shed site" in m
+               for m in msgs), msgs
+    assert any("unknown reason 'weird-reason'" in m for m in msgs), msgs
+    assert any("AdmissionShed(...) constructed without a typed reason"
+               in m for m in msgs), msgs
+    assert any("bare literal 'part-moved' at a typed reason site" in m
+               for m in msgs), msgs
+    assert any("bare literal 'delta-overflow' duplicates" in m
+               for m in msgs), msgs
+    assert any("write to breaker-cell state field .state outside" in m
+               for m in msgs), msgs
+    assert any("'never-emitted' (DEAD_REASON) is registered but never"
+               in m for m in msgs), msgs
+    assert len(vs) == 7, "\n".join(repr(v) for v in vs)
+
+
+def test_protocol_constants_everywhere_is_clean(tmp_path):
+    sites = """
+    class AdmissionShed(Exception):
+        pass
+
+
+    def _shed(key, reason, depth):
+        raise AdmissionShed(f"shed ({reason})", reason)
+
+
+    def admit(key, depth):
+        if depth > 10:
+            _shed(key, protocol.SHED_QUEUE_FULL, depth)
+
+
+    def note(space_id):
+        journal(reason=protocol.ABSORB_PART_MOVED)
+
+
+    def count_overflow(reason):
+        if reason == protocol.ABSORB_DELTA_OVERFLOW:
+            return 1
+        return 0
+
+
+    def legacy():
+        return protocol.DEAD_REASON
+
+
+    class Breaker:
+        def __init__(self):
+            self.state = "closed"
+
+        def record_failure(self, key, reason):
+            self.state = "open"
+    """
+    assert run_fixture(tmp_path, {
+        "common/protocol.py": _PROTO_REGISTRY,
+        "storage/device.py": sites,
+    }, checks=["protocol-registry"]) == []
+
+
+def test_protocol_unknown_reason_flagged(tmp_path):
+    sites = """
+    def _shed(key, reason, depth):
+        pass
+
+    def admit(key, depth):
+        _shed(key, "mystery", depth)
+    """
+    vs = run_fixture(tmp_path, {
+        "common/protocol.py": _PROTO_REGISTRY,
+        "graph/dispatch.py": sites,
+    }, checks=["protocol-registry"])
+    assert any("unknown reason 'mystery'" in v.message for v in vs), vs
+
+
+def test_protocol_second_registry_flagged(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "common/protocol.py": _PROTO_REGISTRY,
+        "common/protocol_copy.py": _PROTO_REGISTRY,
+    }, checks=["protocol-registry"])
+    assert any("second PROTOCOL_REASONS registry" in v.message
+               for v in vs), vs
+
+
+def test_protocol_suppression_roundtrip(tmp_path):
+    reg = """
+    SHED_QUEUE_FULL = "queue_full"
+    PROTOCOL_REASONS = {"shed": (SHED_QUEUE_FULL,)}
+    """
+    sites = """
+    def _shed(key, reason, depth):
+        pass
+
+    def admit(key, depth):
+        _shed(key, "queue_full", depth)  # nebulint: disable=protocol-registry
+    """
+    assert run_fixture(tmp_path, {
+        "common/protocol.py": reg,
+        "graph/dispatch.py": sites,
+    }, checks=["protocol-registry"]) == []
+
+
+def test_protocol_package_vocabulary_closed():
+    vs = lint_paths(PKG_ROOT, checks=["protocol-registry"])
+    assert vs == [], "\n".join(repr(v) for v in vs)
