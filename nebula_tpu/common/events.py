@@ -86,6 +86,18 @@ EVENT_KINDS = (
                              # docs/fault_injection.md)
     "net.healed",            # directional link cuts matching a host
                              # pattern were removed (FaultInjector.heal)
+    "query.killed",          # KILL QUERY <id> ended a statement —
+                             # seated continuous riders evict at the
+                             # next hop boundary, windowed/queued
+                             # waiters wake typed E_KILLED
+                             # (graph/query_registry.py,
+                             # docs/observability.md)
+    "slo.burn_alert",        # a declared SLO's burn rate crossed its
+                             # threshold on BOTH windows of a pair
+                             # (fast or slow) — or recovered; the
+                             # ``state`` field says which
+                             # (common/slo.py, docs/observability.md
+                             # "SLO burn rates")
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

@@ -82,6 +82,11 @@ END_EXPIRED_QUEUED = "expired-queued"    # budget ran out before a seat
 END_BOUNCED = "bounced"          # ContinuousUnavailable: windowed
                                  # fallback served it instead
 END_STREAM_FAILED = "stream-failed"      # pump-level failure woke it
+END_KILLED = "killed"            # KILL QUERY <id> ended it: seated
+                                 # riders evict at the next hop
+                                 # boundary, queued/windowed waiters
+                                 # wake through the per-query
+                                 # exception machinery (E_KILLED)
 
 # ----------------------------------------------------- device failures
 # classify_device_failure's verdicts (storage/device.py): the breaker's
@@ -115,7 +120,7 @@ PROTOCOL_REASONS = {
     "continuous-bounce": (BOUNCE_NO_SESSION, BOUNCE_STREAM_STOPPING),
     "continuous-ending": (
         END_LEFT, END_EVICTED, END_EXPIRED_QUEUED, END_BOUNCED,
-        END_STREAM_FAILED,
+        END_STREAM_FAILED, END_KILLED,
     ),
     "device-failure": (
         DEVFAIL_RESOURCE_EXHAUSTED, DEVFAIL_TRANSFER, DEVFAIL_XLA_RUNTIME,

@@ -1,0 +1,276 @@
+"""SLO burn-rate engine — declared objectives evaluated as
+multi-window burn rates off the StatsManager ring reservoirs
+(docs/observability.md "SLO burn rates").
+
+The metrics plane says what the serving tier IS doing; nothing says
+whether that is GOOD ENOUGH, or how fast the error budget is being
+spent.  This module closes that loop the SRE-workbook way: a CLOSED
+registry of declared SLOs (per query-class latency objectives plus an
+availability target), each evaluated as a burn rate — the fraction of
+the error budget consumed per unit time, where burn 1.0 means
+"spending exactly the budget" — over two window PAIRS read straight
+from the existing per-second rings (common/stats.py ``_WINDOWS``):
+
+  * fast pair  (5 s + 60 s)    — pages on sharp regressions quickly,
+    the short window gating re-fire flapping;
+  * slow pair  (600 s + 3600 s) — catches slow leaks the fast pair's
+    short memory forgets.
+
+An alert FIRES when the burn rate crosses the pair's threshold on
+BOTH windows (the classic multi-window guard against one-bucket
+spikes) and SELF-CLEARS when either window recovers.  Transitions
+journal ``slo.burn_alert`` events; burn rates and firing states are
+published as the ``graph.slo.*`` gauge family at scrape time; graphd
+registers the ``slo`` /healthz check (503 while any alert fires); and
+SHOW STATS appends one row per declared objective.
+
+The engine consumes three counters per class that the execution
+engine bumps on every finished statement (graph/service.py):
+``graph.slo.<class>.served`` / ``.breach`` (latency over objective) /
+``.errors`` — plain registered counters, so the hot-path cost is the
+usual few float ops and evaluation is read-only.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .events import journal
+from .flags import flags
+from .ordered_lock import OrderedLock
+from .stats import stats
+
+flags.define("slo_enabled", True,
+             "evaluate declared SLO burn rates (common/slo.py): "
+             "slo.burn_alert events, graph.slo.* gauges, the graphd "
+             "/healthz slo check and SHOW STATS rows")
+flags.define("slo_fast_burn_threshold", 10.0,
+             "burn-rate threshold for the fast window pair (5s+60s); "
+             "an alert fires when BOTH windows exceed it "
+             "(burn 1.0 = spending exactly the error budget)")
+flags.define("slo_slow_burn_threshold", 2.0,
+             "burn-rate threshold for the slow window pair "
+             "(600s+3600s) — catches slow leaks under the fast "
+             "pair's radar")
+
+# ---------------------------------------------------------------------
+# The declared-SLO registry — CLOSED like SPAN_NAMES/EVENT_KINDS: a
+# query class absent here has no objective and is never evaluated;
+# adding one is a reviewed change, not a config knob (objectives are a
+# contract with users, not a tuning dial).  Classes are the coarse
+# statement families the engine classifies into (graph/service.py
+# slo_class): traversals, point fetches, writes, admin/DDL.
+SLO_OBJECTIVES: Dict[str, Dict[str, float]] = {
+    # multi-hop traversals ride device dispatch — the loosest latency
+    # objective, the availability target the serving tier is sized for
+    "go": {"latency_objective_us": 1_000_000.0,
+           "latency_target": 0.99, "availability": 0.999},
+    # point lookups must stay interactive
+    "fetch": {"latency_objective_us": 500_000.0,
+              "latency_target": 0.99, "availability": 0.999},
+    # writes pay consensus; the budget reflects it
+    "mutate": {"latency_objective_us": 2_000_000.0,
+               "latency_target": 0.99, "availability": 0.999},
+    # DDL/admin — latency is not the contract, availability is
+    "admin": {"latency_objective_us": 5_000_000.0,
+              "latency_target": 0.95, "availability": 0.99},
+}
+
+_FAST_PAIR = (5, 60)
+_SLOW_PAIR = (600, 3600)
+
+# the three per-class counters the engine bumps (graph/service.py) —
+# registered up front so the read path never auto-registers
+for _cls in SLO_OBJECTIVES:
+    stats.register_stats(f"graph.slo.{_cls}.served")
+    stats.register_stats(f"graph.slo.{_cls}.breach")
+    stats.register_stats(f"graph.slo.{_cls}.errors")
+
+
+def note(cls: str, latency_us: float, ok: bool) -> None:
+    """One finished statement of class ``cls`` — the engine's per-query
+    hook (three counter bumps, nothing else)."""
+    obj = SLO_OBJECTIVES.get(cls)
+    if obj is None:
+        return
+    stats.add_value(f"graph.slo.{cls}.served")
+    if not ok:
+        stats.add_value(f"graph.slo.{cls}.errors")
+    elif latency_us > obj["latency_objective_us"]:
+        stats.add_value(f"graph.slo.{cls}.breach")
+
+
+_ALL_WINDOWS = _FAST_PAIR + _SLOW_PAIR
+
+
+def _counts(name: str, sec: int) -> Dict[int, float]:
+    """One counter's event count per evaluation window."""
+    return {w: stats.read_stats(f"{name}.count.{w}", now=sec) or 0.0
+            for w in _ALL_WINDOWS}
+
+
+def _burns(served: Dict[int, float], bad: Dict[int, float],
+           allowed: float) -> Dict[int, float]:
+    """Burn rate per window: the bad fraction relative to the fraction
+    the error budget allows (1.0 = spending exactly the budget)."""
+    if allowed <= 0.0:
+        return {w: 0.0 for w in _ALL_WINDOWS}
+    return {w: (bad[w] / served[w]) / allowed if served[w] else 0.0
+            for w in _ALL_WINDOWS}
+
+
+class SloEngine:
+    """Evaluates the declared registry; owns alert state.  Process
+    singleton (``slo_engine`` below) — LocalCluster daemons share it
+    the way they share the stats registry."""
+
+    def __init__(self):
+        self._lock = OrderedLock("slo.engine")
+        # (cls, objective) -> ("fast"|"slow") while firing
+        self._firing: Dict[Tuple[str, str], str] = {}
+        # (epoch second, rows): ring buckets are per-second, so two
+        # evaluations inside one second read IDENTICAL data — the memo
+        # caps the full ring walk (a few ms over the 3600 s windows) at
+        # once per second no matter how many scrapes / healthz probes /
+        # SHOW STATS land in it
+        self._memo: Tuple[int, List[dict]] = (-1, [])
+        stats.register_collector(self._collect_gauges)
+
+    # ---------------------------------------------------- evaluation
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One burn-rate pass over every declared objective.  Returns
+        the current state rows (the SHOW STATS / gauge source) and
+        journals slo.burn_alert on every transition.  Read-only over
+        the stat rings, memoized per epoch second — the steady-state
+        cost of a scrape or healthz probe is a dict probe."""
+        if not flags.get("slo_enabled"):
+            return []
+        sec = int(now if now is not None else time.time())
+        with self._lock:
+            if self._memo[0] == sec:
+                return self._memo[1]
+        fast_thr = float(flags.get("slo_fast_burn_threshold") or 10.0)
+        slow_thr = float(flags.get("slo_slow_burn_threshold") or 2.0)
+        rows: List[dict] = []
+        for cls, obj in sorted(SLO_OBJECTIVES.items()):
+            # reads are ring walks, the widest window the whole ring —
+            # so spend ONE walk deciding idleness (an event inside any
+            # shorter window is inside the 3600 s window too), hoist
+            # the served counts both objectives share, and an idle
+            # class costs one walk instead of sixteen
+            if not stats.read_stats(f"graph.slo.{cls}.served.count."
+                                    f"{_ALL_WINDOWS[-1]}", now=sec):
+                zero = {w: 0.0 for w in _ALL_WINDOWS}
+                for objective in ("latency", "availability"):
+                    self._transition(cls, objective, None, zero)
+                    rows.append({"class": cls, "objective": objective,
+                                 "burns": zero, "firing": None})
+                continue
+            served = _counts(f"graph.slo.{cls}.served", sec)
+            for objective, numer, allowed in (
+                    ("latency", "breach", 1.0 - obj["latency_target"]),
+                    ("availability", "errors",
+                     1.0 - obj["availability"])):
+                burns = _burns(served,
+                               _counts(f"graph.slo.{cls}.{numer}",
+                                       sec),
+                               allowed)
+                fast = all(burns[w] > fast_thr for w in _FAST_PAIR)
+                slow = all(burns[w] > slow_thr for w in _SLOW_PAIR)
+                firing = "fast" if fast else ("slow" if slow else None)
+                self._transition(cls, objective, firing, burns)
+                rows.append({"class": cls, "objective": objective,
+                             "burns": burns, "firing": firing})
+        with self._lock:
+            self._memo = (sec, rows)
+        return rows
+
+    def _transition(self, cls: str, objective: str,
+                    firing: Optional[str], burns: Dict[int, float]
+                    ) -> None:
+        key = (cls, objective)
+        with self._lock:
+            was = self._firing.get(key)
+            if firing == was:
+                return
+            if firing is None:
+                del self._firing[key]
+            else:
+                self._firing[key] = firing
+        detail = ", ".join(f"{w}s={burns[w]:.2f}"
+                           for w in sorted(burns))
+        if firing is not None:
+            journal.record(
+                "slo.burn_alert",
+                f"{cls}/{objective} burn over the {firing} pair "
+                f"threshold ({detail})",
+                state="firing", slo_class=cls, objective=objective,
+                pair=firing)
+        else:
+            journal.record(
+                "slo.burn_alert",
+                f"{cls}/{objective} burn recovered ({detail})",
+                state="resolved", slo_class=cls, objective=objective,
+                pair=was)
+        stats.add_value("graph.slo.transitions")
+
+    # ------------------------------------------------------ surfaces
+    def firing(self) -> Dict[Tuple[str, str], str]:
+        with self._lock:
+            return dict(self._firing)
+
+    def health(self) -> Tuple[bool, str]:
+        """The graphd /healthz "slo" check: evaluate, then report.
+        Self-clears the same way admission_health does — one healed
+        evaluation flips it back."""
+        self.evaluate()
+        firing = self.firing()
+        if firing:
+            worst = ", ".join(f"{c}/{o} ({p})"
+                              for (c, o), p in sorted(firing.items()))
+            return False, f"burning error budget: {worst}"
+        return True, "within error budget"
+
+    def _collect_gauges(self) -> None:
+        for row in self.evaluate():
+            cls, objective = row["class"], row["objective"]
+            for w, b in row["burns"].items():
+                stats.set_gauge("graph.slo.burn_rate", b,
+                                slo_class=cls, objective=objective,
+                                window=w)
+            stats.set_gauge("graph.slo.firing",
+                            0.0 if row["firing"] is None else 1.0,
+                            slo_class=cls, objective=objective)
+
+    def stats_rows(self) -> List[List]:
+        """SHOW STATS rows: one per declared objective —
+        [Stat, 5s burn, 60s burn, 600s burn, 3600s burn, state]."""
+        out = []
+        for row in self.evaluate():
+            out.append([f"slo.{row['class']}.{row['objective']}"]
+                       + [round(row["burns"][w], 3)
+                          for w in _ALL_WINDOWS]
+                       + [row["firing"] or "ok"])
+        return out
+
+    def clear_for_tests(self) -> None:
+        """Reset alert state AND the per-class counter rings — without
+        the latter, a test inherits every breach the rest of the suite
+        noted into the shared 600/3600 s windows."""
+        with self._lock:
+            self._firing.clear()
+            self._memo = (-1, [])
+        for cls in SLO_OBJECTIVES:
+            for counter in ("served", "breach", "errors"):
+                st = stats._stats.get(f"graph.slo.{cls}.{counter}")
+                if st is None:
+                    continue
+                with st.lock:
+                    st.sums = [0.0] * len(st.sums)
+                    st.counts = [0] * len(st.counts)
+                    st.stamps = [0] * len(st.stamps)
+
+
+stats.register_stats("graph.slo.transitions")
+
+slo_engine = SloEngine()

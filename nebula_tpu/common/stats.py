@@ -83,6 +83,22 @@ METRIC_NAMES = (
     # the window controller's depth/shed signals as a replica-count
     # recommendation for an external autoscaler (docs/admission.md)
     "graph.autoscale.recommended_replicas",
+    # live query registry (graph/query_registry.py, SHOW QUERIES /
+    # /queries / KILL QUERY — docs/observability.md "The live query
+    # plane"): admitted/finished/killed counters + live-size gauge
+    "graph.query_registry.*",
+    # per-phase critical-path micros folded out of a finished span
+    # tree (common/tracing.py critical_path — labeled phase=queue/
+    # mirror/hop-kernel/fetch/assemble/other)
+    "graph.query.phase_us",
+    # SLO burn-rate engine (common/slo.py, docs/observability.md "SLO
+    # burn rates"): per-objective burn-rate gauges, breach counters,
+    # and the alert state gauge the healthz check reads
+    "graph.slo.*",
+    # per-replica serving load brief (the same struct the graphd
+    # heartbeat ships to metad listDeviceBriefs — queue depth, lane
+    # occupancy, busy fraction, 5s shed rate) as scrape-time gauges
+    "graph.load.*",
     # rpc / fault injection
     "rpc.fault.injected",
     "rpc.fault_injected.*",          # per-method fault counters

@@ -39,6 +39,11 @@ class ErrorCode(enum.IntEnum):
     # docs/admission.md); retrying without a fresh budget is pointless,
     # which is why this is distinct from E_RPC_FAILURE
     E_DEADLINE_EXCEEDED = -11
+    # an operator ended the statement with KILL QUERY <id> — distinct
+    # from E_DEADLINE_EXCEEDED so clients can tell "budget ran out"
+    # from "someone chose to end this" (docs/observability.md "The
+    # live query plane")
+    E_KILLED = -12
 
     # Storage
     E_KEY_NOT_FOUND = -100

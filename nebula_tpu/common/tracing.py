@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from .clock import Duration, now_micros, test_offset_micros
 from .flags import flags
 from .ordered_lock import OrderedLock
+from .stats import stats
 
 flags.define("trace_sample_rate", 0.0,
              "fraction of root operations (queries) traced when not "
@@ -52,8 +53,11 @@ flags.define("trace_buffer_size", 256,
              "by the /traces web endpoint")
 flags.define("slow_query_threshold_ms", 0,
              "statements slower than this land in the slow-query log "
-             "(/traces?slow=1) with their trace id when sampled; "
-             "0 disables")
+             "(/traces?slow=1) and journal a query.slow event, with "
+             "their trace id when sampled; entries carry the dispatch "
+             "seat markers of the continuous tier (lane, joined_tick, "
+             "hop count, typed ending) when the statement rode a lane "
+             "batch; 0 disables")
 
 # The single span-name registry (lint: span-registry).  Add here FIRST,
 # then use the literal at the call site.
@@ -373,7 +377,14 @@ class SlowQueryLog:
     _MAX_STMT = 4096
 
     def record(self, stmt: str, latency_us: int,
-               trace_id: Optional[int]) -> None:
+               trace_id: Optional[int],
+               seat: Optional[dict] = None) -> None:
+        """``seat`` carries the continuous-dispatch markers of a slow
+        statement that rode a lane batch — lane, joined_tick, hops and
+        the typed ``ending`` (common/protocol.py continuous-ending
+        vocabulary) — so the slow log attributes a slow rider to its
+        seat trajectory, not just its wall time (windowed statements
+        pass None and keep the PR 3 entry shape)."""
         if self._PASSWORD_KW.search(stmt):
             stmt = self._STRING_RE.sub('"***"', stmt)
         if len(stmt) > self._MAX_STMT:
@@ -385,6 +396,10 @@ class SlowQueryLog:
                  "time_us": now_micros(),
                  "trace_id": (f"{trace_id:016x}"
                               if trace_id is not None else None)}
+        if seat:
+            for k in ("lane", "joined_tick", "hops", "ending"):
+                if seat.get(k) is not None:
+                    entry[k] = seat[k]
         with self._lock:
             self._entries.append(entry)
             if len(self._entries) > self._CAP:
@@ -422,3 +437,110 @@ def annotate(name: str, **tags) -> None:
     s = Span(name, ctx[0], ctx[1], tags)
     s.start_us = now_micros()
     _record(s.to_wire())
+
+
+# ------------------------------------------- critical-path analyzer
+# Per-phase decomposition of a finished span tree: where did this
+# query's wall time actually go?  Device phases map by span name; a
+# carrier span's SELF time (its duration minus the stretch its
+# children cover) is attributed to "queue" — for a dispatched GO that
+# is exactly the stretch the statement sat blocked waiting for a
+# window to close or a lane seat to launch, the time no child span
+# owns.  Unmapped leaves (parse, markers) fold into "other".
+PHASE_QUEUE = "queue"
+PHASE_MIRROR = "mirror"
+PHASE_KERNEL = "hop-kernel"
+PHASE_FETCH = "fetch"
+PHASE_ASSEMBLE = "assemble"
+PHASE_OTHER = "other"
+
+CRITICAL_PHASES = (PHASE_QUEUE, PHASE_MIRROR, PHASE_KERNEL,
+                   PHASE_FETCH, PHASE_ASSEMBLE, PHASE_OTHER)
+
+# leaf-span phase map; names absent here are carriers (self time →
+# queue) when they have children, "other" otherwise
+_PHASE_OF = {
+    "tpu.mirror.build": PHASE_MIRROR,
+    "tpu.absorb": PHASE_MIRROR,
+    "tpu.peer_absorb": PHASE_MIRROR,
+    "tpu.transfer": PHASE_MIRROR,
+    "tpu.jit.compile": PHASE_KERNEL,
+    "tpu.launch": PHASE_KERNEL,
+    "tpu.kernel": PHASE_KERNEL,
+    "tpu.fetch": PHASE_FETCH,
+    "tpu.assemble": PHASE_ASSEMBLE,
+}
+
+stats.register_histogram("graph.query.phase_us")
+
+
+def _covered_us(node: dict) -> int:
+    """Wall stretch of ``node`` covered by its children, interval-
+    merged and clipped to the node's own window."""
+    lo = node.get("start_us", 0)
+    hi = lo + node.get("duration_us", 0)
+    ivs = []
+    for ch in node.get("children", ()):
+        s = ch.get("start_us", 0)
+        e = s + ch.get("duration_us", 0)
+        s, e = max(s, lo), min(e, hi)
+        if e > s:
+            ivs.append((s, e))
+    ivs.sort()
+    total, cur_s, cur_e = 0, None, None
+    for s, e in ivs:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        elif e > cur_e:
+            cur_e = e
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def critical_path(tree: Optional[dict]) -> Optional[Dict[str, int]]:
+    """Fold a TraceStore.tree() span tree into per-phase micros.
+
+    Each span's self time (duration minus merged child coverage) is
+    charged to its phase; parallel siblings each charge their own time
+    (a scatter-gather's branches are all real work), so the phase sum
+    can exceed wall clock on fanned-out queries — the decomposition
+    answers "what would shortening this phase buy", not "what is the
+    wall total"."""
+    if not tree or not tree.get("roots"):
+        return None
+    phases = dict.fromkeys(CRITICAL_PHASES, 0)
+
+    def walk(node):
+        self_us = max(node.get("duration_us", 0) - _covered_us(node), 0)
+        phase = _PHASE_OF.get(node.get("name"))
+        if phase is None:
+            phase = PHASE_QUEUE if node.get("children") else PHASE_OTHER
+        phases[phase] += self_us
+        for ch in node.get("children", ()):
+            walk(ch)
+
+    for root in tree["roots"]:
+        walk(root)
+    return phases
+
+
+def critical_path_summary(phases: Dict[str, int]) -> str:
+    """The one-line PROFILE footer."""
+    parts = [f"{p} {phases.get(p, 0)}us" for p in CRITICAL_PHASES
+             if phases.get(p, 0) > 0]
+    total = sum(phases.values())
+    return ("critical path: " + " | ".join(parts or ["idle"])
+            + f" (total {total}us)")
+
+
+def observe_phases(phases: Optional[Dict[str, int]]) -> None:
+    """Feed the per-phase histogram family — one labeled observation
+    per non-zero phase of a finished traced query."""
+    if not phases:
+        return
+    for p, us in phases.items():
+        if us > 0:
+            stats.observe("graph.query.phase_us", float(us), phase=p)

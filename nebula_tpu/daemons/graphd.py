@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import sys
 
+from ..common.slo import slo_engine
 from ..graph.service import ExecutionEngine, GraphService, admission_health
 from ..interface.common import ConfigModule
 from ..interface.rpc import ClientManager, RpcServer
@@ -42,7 +43,12 @@ def main(argv=None) -> int:
 
     cm = ClientManager()
     metas = parse_meta_addrs(args.meta_server_addrs)
-    meta_client = MetaClient(metas, client_manager=cm)
+    # role=graph heartbeats: liveness + serving-load brief into
+    # metad's graph_hosts map (the SHOW QUERIES / KILL QUERY fan-out
+    # set) — never the part-allocation host table
+    meta_client = MetaClient(metas, client_manager=cm,
+                             local_host=f"{args.local_ip}:{args.port}",
+                             send_heartbeat=True, role="graph")
     meta_client.wait_for_metad_ready()
     GflagsManager(meta_client, ConfigModule.GRAPH).declare_gflags()
     schema_man = ServerBasedSchemaManager(meta_client)
@@ -56,6 +62,14 @@ def main(argv=None) -> int:
     engine = ExecutionEngine(meta_client, schema_man, storage_client,
                              tpu_runtime=device_rt)
     service = GraphService(engine)
+
+    def _load_brief():
+        # the dispatcher is lazy (first GO constructs it) — resolve
+        # per beat; an idle graphd just sends no brief
+        d = getattr(device_rt, "_dispatcher", None)
+        return d.load_brief() if d is not None else {}
+
+    meta_client.hb_device_provider = _load_brief
     meta_client.start()
 
     rpc = RpcServer(service, host=args.local_ip, port=args.port).start()
@@ -70,6 +84,10 @@ def main(argv=None) -> int:
     # degradation signal: 503 while actively shedding (admission
     # control, docs/admission.md) so load balancers drain this graphd
     ws.register_health_check("admission", admission_health)
+    # error-budget signal: 503 while any declared SLO burns over its
+    # multi-window threshold; self-clears on a healed evaluation
+    # (common/slo.py, docs/observability.md "SLO burn rates")
+    ws.register_health_check("slo", slo_engine.health)
     sys.stderr.write(f"graphd serving on {rpc.addr} (ws :{ws.port})\n")
 
     def cleanup():

@@ -83,6 +83,8 @@ from ..common.deadline import DeadlineExceeded
 from ..common.events import journal
 from ..common.flags import flags
 from ..common.stats import stats
+from .query_registry import (KilledError, current as current_qid,
+                             registry as query_registry)
 
 flags.define("go_batch_window_ms", -1,
              "WINDOWED-mode batch-leader wait before dispatching "
@@ -200,7 +202,7 @@ class AdmissionShed(DeadlineExceeded):
 
 class _Request:
     __slots__ = ("payload", "done", "result", "mirror", "error",
-                 "deadline", "enq_t")
+                 "deadline", "enq_t", "qid")
 
     def __init__(self, payload, deadline=None):
         self.payload = payload   # per-query input, method-defined (GO:
@@ -211,6 +213,9 @@ class _Request:
         self.error = None
         self.deadline = deadline         # common/deadline.py Deadline|None
         self.enq_t = time.perf_counter()
+        # live-query-registry id (KILL QUERY's handle on this waiter),
+        # captured thread-locally like the deadline budget
+        self.qid = current_qid()
 
 
 class _KeyState:
@@ -429,7 +434,8 @@ class _Rider:
 
     __slots__ = ("payload", "steps", "upto", "reduce", "deadline",
                  "tctx", "enq_t", "lane", "remaining", "joined_tick",
-                 "midflight", "done", "result", "mirror", "error")
+                 "midflight", "done", "result", "mirror", "error",
+                 "qid")
 
     def __init__(self, payload, steps: int, upto: bool, reduce,
                  deadline):
@@ -452,6 +458,9 @@ class _Rider:
         self.result = None
         self.mirror = None
         self.error = None
+        # live-query-registry id — the pump reports this rider's seat /
+        # hop progress through it, and KILL QUERY evicts by it
+        self.qid = current_qid()
 
 
 class _ContinuousStream:
@@ -743,12 +752,28 @@ class _ContinuousStream:
                     r.midflight = was_running
                     self.seated[r.lane] = r
                     joiners.append(r)
-            # deadline evictions leave their seat this tick — their
-            # lanes clear alongside the leavers' and free next tick
+                    query_registry.note_seat(r.qid, r.lane,
+                                             r.joined_tick)
+            # deadline evictions and KILL QUERY both leave their seat
+            # this tick — their lanes clear alongside the leavers' and
+            # free next tick (the "within one hop boundary" contract)
             for lane, r in list(self.seated.items()):
-                if r.deadline is not None and r.deadline.expired():
+                if (r.deadline is not None and r.deadline.expired()) \
+                        or query_registry.is_killed(r.qid):
                     del self.seated[lane]
                     evicted.append(r)
+            # a KILLed rider still waiting for a lane must not sit out
+            # a full seat map it will never use — end it this tick too
+            still = []
+            for r in self.queue:
+                if not query_registry.is_killed(r.qid):
+                    still.append(r)
+                    continue
+                r.error = KilledError(
+                    "go: ended by KILL QUERY in the continuous "
+                    "admission queue")
+                r.done = True
+            self.queue[:] = still
             seated_now = bool(self.seated)
             backlog = len(self.queue)
             lanes_full = (self.ledger is not None
@@ -808,6 +833,9 @@ class _ContinuousStream:
                                 for lane, r in \
                                         list(self.seated.items()):
                                     r.remaining -= 1
+                                    query_registry.note_hop(
+                                        r.qid,
+                                        r.steps - 1 - r.remaining)
                                     if r.remaining <= 0:
                                         del self.seated[lane]
                                         leavers.append(r)
@@ -858,9 +886,14 @@ class _ContinuousStream:
                             len(evicted))
             with self.cond:
                 for r in evicted:
-                    r.error = DeadlineExceeded(
-                        "go: deadline expired mid-flight (evicted at "
-                        "a hop boundary)")
+                    if query_registry.is_killed(r.qid):
+                        r.error = KilledError(
+                            "go: ended by KILL QUERY (evicted at a "
+                            "hop boundary)")
+                    else:
+                        r.error = DeadlineExceeded(
+                            "go: deadline expired mid-flight (evicted "
+                            "at a hop boundary)")
                     r.done = True
                 self.cond.notify_all()
 
@@ -996,15 +1029,19 @@ class _ContinuousStream:
         if rider.error is not None:
             if isinstance(rider.error, ContinuousUnavailable):
                 ending = protocol.END_BOUNCED
+            elif isinstance(rider.error, KilledError):
+                ending = protocol.END_KILLED
             elif isinstance(rider.error, DeadlineExceeded):
                 ending = (protocol.END_EVICTED if rider.lane >= 0
                           else protocol.END_EXPIRED_QUEUED)
             else:
                 ending = protocol.END_STREAM_FAILED
+            query_registry.note_ending(rider.qid, ending)
             tracing.annotate("graph.continuous", lane=rider.lane,
                              joined_tick=rider.joined_tick,
                              ending=ending)
             raise rider.error
+        query_registry.note_ending(rider.qid, protocol.END_LEFT)
         tracing.annotate("graph.continuous", lane=rider.lane,
                          joined_tick=rider.joined_tick,
                          hops=rider.steps - 1,
@@ -1118,6 +1155,7 @@ class GoBatchDispatcher:
                            if hasattr(runtime, "continuous_session")
                            else None)
         self._idle_mark = (0.0, 0.0)    # (busy_s, idle_s) last scrape
+        self._load_mark = (0.0, 0.0)    # same meter, load-brief cadence
         # scrape-time gauges: live per-key queue depths + the current
         # closed-loop window cap (weak bound method — a discarded
         # dispatcher unregisters itself)
@@ -1255,7 +1293,36 @@ class GoBatchDispatcher:
                 out[key] = len(st.queue)
         return out
 
+    def load_brief(self) -> dict:
+        """One rankable serving-load struct per graphd replica
+        (docs/observability.md): live queue depth summed across keys,
+        continuous lane occupancy, device busy fraction since the
+        last brief, and the 5 s shed rate.  Rides the role=graph
+        heartbeat into metad's ``listDeviceBriefs`` — an external
+        balancer ranks replicas on it — and is republished verbatim
+        as the graph.load.* gauges so the ranking input is always
+        inspectable on /metrics."""
+        seated = queued = 0
+        if self.continuous is not None:
+            seated, queued = self.continuous.seat_counts()
+        busy, idle = self.meter.snapshot()
+        d_busy = busy - self._load_mark[0]
+        d_idle = idle - self._load_mark[1]
+        self._load_mark = (busy, idle)
+        total = d_busy + d_idle
+        return {
+            "queue_depth": int(sum(self.queue_depths().values())),
+            "lane_seated": int(seated),
+            "lane_queued": int(queued),
+            "busy_frac": round(d_busy / total, 4) if total > 0 else 0.0,
+            "shed_rate_5s":
+                stats.read_stats("graph.admission.shed.count.5") or 0.0,
+        }
+
     def _collect_gauges(self) -> None:
+        brief = self.load_brief()
+        for k, v in brief.items():
+            stats.set_gauge(f"graph.load.{k}", float(v))
         for key, depth in self.queue_depths().items():
             stats.set_gauge("graph.admission.queue_depth", depth,
                             method=str(key[0]), space=str(key[1]))
@@ -1488,6 +1555,14 @@ class GoBatchDispatcher:
                             f"{method}: budget exhausted in the "
                             f"admission queue (dropped pre-launch)")
                         self._note_deadline_drop(key)
+                    elif query_registry.is_killed(r.qid):
+                        # KILL QUERY of a windowed waiter rides the
+                        # same per-query exception machinery as a
+                        # pre-launch expiry: the batch launches
+                        # without it, the waiter wakes typed
+                        r.error = KilledError(
+                            f"{method}: ended by KILL QUERY (dropped "
+                            f"pre-launch)")
                     else:
                         live.append(r)
             if live:

@@ -52,6 +52,7 @@ _REGISTRY = {
     ast.Kind.CHANGE_PASSWORD: admin.ChangePasswordExecutor,
     ast.Kind.GRANT: admin.GrantExecutor,
     ast.Kind.REVOKE: admin.RevokeExecutor,
+    ast.Kind.KILL_QUERY: admin.KillQueryExecutor,
 }
 
 

@@ -285,6 +285,8 @@ class ShowExecutor(Executor):
             return self._show_stats()
         if t == ast.ShowTarget.EVENTS:
             return self._show_events()
+        if t == ast.ShowTarget.QUERIES:
+            return self._show_queries()
         if t == ast.ShowTarget.USERS:
             resp = _meta_call(self, "listUsers", {})
             return InterimResult(["Account"],
@@ -360,9 +362,51 @@ class ShowExecutor(Executor):
             rows.append(["graphd", "graph.admission.queue_depth.live",
                          float(sum(depths.values())), float(len(depths)),
                          0.0, 0.0, 0.0, 0.0])
+        # declared-SLO burn rates (common/slo.py): one row per
+        # objective under the <slo> pseudo-host — the numeric columns
+        # carry the 5s/60s/600s/3600s burns in window order, the last
+        # column the firing state (docs/observability.md)
+        from ...common.slo import slo_engine
+        for srow in slo_engine.stats_rows():
+            name, b5, b60, b600, b3600, state = srow
+            rows.append(["<slo>", name, b5, b60, b600, b3600, 0.0,
+                         state])
         return InterimResult(
             ["Host", "Stat", "Sum(60s)", "Count(60s)", "Avg(60s)",
              "Rate(60s)", "p95(60s)", "p99(60s)"], rows)
+
+    _QUERY_COLS = ["Id", "Session", "User", "Statement", "Class",
+                   "Space", "Mode", "Phase", "Hop", "Lane",
+                   "Elapsed(us)", "DeadlineLeft(ms)"]
+
+    def _show_queries(self) -> InterimResult:
+        """SHOW QUERIES: the live query registry, cluster-wide — metad
+        fans ``showQueries`` out across every heartbeating graphd
+        replica (the SHOW STATS shape), and this graphd merges its OWN
+        registry on top (standalone graphd / metad unreachable), deduped
+        by the process-unique query id.  Oldest first, so the statement
+        most worth killing reads first (docs/observability.md "The live
+        query plane")."""
+        from ..query_registry import registry
+        resp = _meta_call(self, "showQueries", {},
+                          ignore=(ErrorCode.E_RPC_FAILURE,))
+        merged: dict = {}
+        for q in resp.get("queries", []) if resp else []:
+            merged[q["id"]] = q
+        for q in registry.snapshot():
+            merged[q["id"]] = q
+        rows = []
+        for q in sorted(merged.values(),
+                        key=lambda q: -q.get("elapsed_us", 0)):
+            dl = q.get("deadline_left_ms")
+            rows.append([q["id"], q.get("session", -1),
+                         q.get("user", ""), q.get("stmt", ""),
+                         q.get("class", ""), q.get("space", ""),
+                         q.get("mode", ""), q.get("phase", ""),
+                         q.get("hop", -1), q.get("lane", -1),
+                         q.get("elapsed_us", 0),
+                         "-" if dl is None else dl])
+        return InterimResult(list(self._QUERY_COLS), rows)
 
     def _show_events(self) -> InterimResult:
         """SHOW EVENTS: metad's cluster-wide aggregation (heartbeat
@@ -575,3 +619,27 @@ class RevokeExecutor(Executor):
         _meta_call(self, "revokeRole", {"account": s.account,
                                         "space_id": r.value()})
         return None
+
+
+class KillQueryExecutor(Executor):
+    """KILL QUERY <id>: mark the statement killed in its registry.  The
+    local registry is tried first (ids are process-unique, so a hit
+    here IS the query); a miss fans out through metad's ``killQuery``
+    across the other graphd replicas.  The statement itself ends typed
+    (ErrorCode.E_KILLED) through the machinery it is already inside —
+    hop-boundary eviction for seated continuous riders, the per-query
+    exception path for windowed waiters (graph/batch_dispatch.py)."""
+    NAME = "KillQueryExecutor"
+
+    def execute(self) -> InterimResult:
+        s: ast.KillQuerySentence = self.sentence
+        from ..query_registry import registry
+        killed = registry.kill(s.qid)
+        if not killed:
+            resp = _meta_call(self, "killQuery", {"qid": s.qid},
+                              ignore=(ErrorCode.E_RPC_FAILURE,))
+            killed = bool(resp.get("killed")) if resp else False
+        if not killed:
+            raise ExecError(f"query {s.qid} not found",
+                            ErrorCode.E_KEY_NOT_FOUND)
+        return InterimResult(["Id", "Killed"], [[s.qid, True]])

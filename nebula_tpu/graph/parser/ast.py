@@ -58,6 +58,7 @@ class Kind(enum.Enum):
     BALANCE = "balance"
     DOWNLOAD = "download"
     INGEST = "ingest"
+    KILL_QUERY = "kill_query"
     # users
     CREATE_USER = "create_user"
     ALTER_USER = "alter_user"
@@ -458,6 +459,7 @@ class ShowTarget(enum.Enum):
     CONFIGS = "configs"
     STATS = "stats"                # SHOW STATS: daemon + cluster rollup
     EVENTS = "events"              # SHOW EVENTS: cluster event journal
+    QUERIES = "queries"            # SHOW QUERIES: live query registry
 
 
 @dataclass
@@ -466,6 +468,15 @@ class ShowSentence(Sentence):
     target: ShowTarget = ShowTarget.SPACES
     module: Optional[str] = None  # SHOW CONFIGS graph
     name: Optional[str] = None    # SHOW USER/ROLES IN/CREATE * <name>
+
+
+@dataclass
+class KillQuerySentence(Sentence):
+    """KILL QUERY <id> — ends one live statement through the query
+    registry (graph/query_registry.py); fans out across graphd
+    replicas via metad when the id is not local."""
+    kind = Kind.KILL_QUERY
+    qid: int = 0
 
 
 @dataclass

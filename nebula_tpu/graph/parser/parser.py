@@ -95,6 +95,9 @@ class _Parser:
         "roles", "god", "admin", "guest", "balance", "data", "leader",
         "graph", "meta",
         "storage", "path", "all", "in", "out", "both", "step", "of",
+        # the live-query-plane words stay usable as names — only the
+        # SHOW target / statement-head positions consume them as KWs
+        "queries", "kill", "query",
     })
 
     def expect_id(self, what: str = "identifier") -> str:
@@ -218,6 +221,7 @@ class _Parser:
             "balance": self.p_balance, "change": self.p_change_password,
             "grant": self.p_grant, "revoke": self.p_revoke,
             "download": self.p_download, "ingest": self.p_ingest,
+            "kill": self.p_kill,
         }.get(kw)
         if handler is None:
             self.fail(f"unexpected keyword {kw.upper()}")
@@ -932,11 +936,21 @@ class _Parser:
                    "edges": ast.ShowTarget.EDGES, "hosts": ast.ShowTarget.HOSTS,
                    "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS,
                    "stats": ast.ShowTarget.STATS,
-                   "events": ast.ShowTarget.EVENTS}
+                   "events": ast.ShowTarget.EVENTS,
+                   "queries": ast.ShowTarget.QUERIES}
         kw = self.next()
         if kw.type != "KW" or kw.value not in mapping:
             self.fail("expected SHOW target")
         return ast.ShowSentence(target=mapping[kw.value])
+
+    def p_kill(self) -> ast.KillQuerySentence:
+        self.expect_kw("kill")
+        self.expect_kw("query")
+        t = self.peek()
+        if t.type != "INT":
+            self.fail("expected query id after KILL QUERY")
+        self.next()
+        return ast.KillQuerySentence(qid=t.value)
 
     def _host_list(self) -> List[str]:
         """Quoted "ip:port" strings or bare 127.0.0.1:port literals

@@ -16,6 +16,7 @@ import threading
 from typing import Dict, Optional
 
 from ..common import deadline as deadlines
+from ..common import slo
 from ..common import tracing
 from ..common.clock import Duration
 from ..common.deadline import Deadline, DeadlineExceeded
@@ -33,6 +34,9 @@ from .executors import make_executor, traced_execute
 from .executors.base import ExecError
 from .interim import ColumnarRows, InterimResult
 from .parser import GQLParser
+from .parser import ast
+from .query_registry import (KilledError, bind as qid_bind,
+                             registry as query_registry)
 from .parser.lexer import COMMENT_RE as LEX_COMMENT_RE
 from .parser.parser import ParseError
 
@@ -44,6 +48,29 @@ flags.define("query_deadline_ms", 300000,
              "launch.  Per-statement `TIMEOUT n` prefix or the "
              "client's timeout_ms execute option override it; 0 "
              "disables the default deadline")
+
+
+# statement Kind → declared-SLO query class (common/slo.py
+# SLO_OBJECTIVES): traversals ride device dispatch, point fetches must
+# stay interactive, writes pay consensus, everything else is admin/DDL.
+_SLO_CLASS = {
+    ast.Kind.GO: "go", ast.Kind.MATCH: "go", ast.Kind.FIND: "go",
+    ast.Kind.FIND_PATH: "go",
+    # composites wrap traversals — they inherit the traversal budget
+    ast.Kind.PIPE: "go", ast.Kind.SET_OP: "go", ast.Kind.ASSIGNMENT: "go",
+    ast.Kind.FETCH_VERTICES: "fetch", ast.Kind.FETCH_EDGES: "fetch",
+    ast.Kind.INSERT_VERTEX: "mutate", ast.Kind.INSERT_EDGE: "mutate",
+    ast.Kind.UPDATE_VERTEX: "mutate", ast.Kind.UPDATE_EDGE: "mutate",
+    ast.Kind.DELETE_VERTEX: "mutate", ast.Kind.DELETE_EDGE: "mutate",
+}
+
+
+def slo_class(seq) -> str:
+    """The declared-SLO class of a parsed statement list — the first
+    sentence names a multi-statement input, like the per-kind stats."""
+    if not seq.sentences:
+        return "admin"
+    return _SLO_CLASS.get(seq.sentences[0].kind, "admin")
 
 
 class Authenticator:
@@ -208,19 +235,36 @@ class ExecutionEngine:
                 # and nothing below (slow log) may reference it either
                 tracing.trace_store.discard(trace_id)
                 trace_id = None
-        if profiled and trace_id is not None:
-            # root span just closed — the tree is complete now
-            resp["profile"] = tracing.trace_store.tree(trace_id)
+        if trace_id is not None:
+            # root span just closed — the tree is complete now.  Fold
+            # it into per-phase critical-path micros for every finished
+            # trace (sampled or PROFILE-forced): the graph.query.phase_us
+            # histogram is how "where does latency live" stays answerable
+            # without asking anyone to run PROFILE (common/tracing.py
+            # critical_path)
+            tree = tracing.trace_store.tree(trace_id)
+            phases = tracing.critical_path(tree) if tree else None
+            if phases:
+                tracing.observe_phases(phases)
+            if profiled and tree is not None:
+                resp["profile"] = tree
+                if phases:
+                    resp["profile"]["critical_path"] = phases
+                    resp["profile"]["critical_path_summary"] = \
+                        tracing.critical_path_summary(phases)
+        qid = resp.pop("_qid", None)
         threshold = flags.get("slow_query_threshold_ms", 0)
         if threshold and resp.get("latency_in_us", 0) >= threshold * 1000:
             stats.add_value("graph.slow_query.qps")
-            tracing.slow_log.record(text, resp["latency_in_us"], trace_id)
+            tracing.slow_log.record(text, resp["latency_in_us"], trace_id,
+                                    seat=query_registry.seat_markers(qid))
             # the event journal carries the masked/truncated statement
             # only via the slow log; SHOW EVENTS shows the occurrence
             journal.record("query.slow",
                            detail=f"{resp['latency_in_us']} us",
                            latency_us=resp["latency_in_us"],
                            host="graphd")
+        query_registry.unregister(qid)
         return resp
 
     def _execute_traced(self, session: ClientSession, text: str,
@@ -265,16 +309,37 @@ class ExecutionEngine:
             rs.tag(deadline_ms=int(budget_ms))
         result: Optional[InterimResult] = None
         shed = False
+        cls = slo_class(seq)
         with deadlines.bind(dl):
+            # the live query registry entry (SHOW QUERIES / KILL QUERY)
+            # — registered inside the deadline bind so the row carries
+            # the remaining budget; the id travels thread-locally so
+            # dispatch riders capture it without signature plumbing
+            qid = query_registry.register(
+                text, session=session.session_id, user=session.user,
+                cls=cls, space=session.space_name,
+                mode=flags.get("go_dispatch_mode") or "windowed")
+            resp["_qid"] = qid
             try:
-                # SequentialExecutor semantics: run each; last rowset
-                # wins
-                for sentence in seq.sentences:
-                    out = traced_execute(make_executor(sentence, ectx),
-                                         ectx)
-                    ectx.input = None  # pipes scope their own input
-                    if out is not None:
-                        result = out
+                with qid_bind(qid):
+                    # SequentialExecutor semantics: run each; last
+                    # rowset wins
+                    for sentence in seq.sentences:
+                        query_registry.check_killed(qid)
+                        query_registry.note_phase(qid, "executing")
+                        out = traced_execute(
+                            make_executor(sentence, ectx), ectx)
+                        ectx.input = None  # pipes scope their own input
+                        if out is not None:
+                            result = out
+            except KilledError as e:
+                resp["error_code"] = int(ErrorCode.E_KILLED)
+                resp["error_msg"] = str(e)
+                ectx.completeness = 0
+                ectx.warnings.append("ended by KILL QUERY")
+                journal.record("query.killed",
+                               detail=f"query {qid} ended by operator",
+                               host="graphd")
             except AdmissionShed as e:
                 resp["error_code"] = int(ErrorCode.E_DEADLINE_EXCEEDED)
                 resp["error_msg"] = str(e)
@@ -293,6 +358,12 @@ class ExecutionEngine:
             except RpcError as e:
                 resp["error_code"] = int(e.status.code)
                 resp["error_msg"] = e.status.to_string()
+            except BaseException:
+                # unexpected exceptions propagate past execute()'s
+                # bookkeeping — drop the registry entry here or it
+                # leaks until process exit
+                query_registry.unregister(qid)
+                raise
         if resp["error_code"] == int(ErrorCode.E_DEADLINE_EXCEEDED):
             # shed/expired responses keep the partial-result surface:
             # completeness < 100 + warnings say WHY the rows are
@@ -336,6 +407,20 @@ class ExecutionEngine:
             rs.tag(stmt_kind=kind)
         if resp["error_code"] != int(ErrorCode.SUCCEEDED):
             stats.add_value("graph.error.qps")
+        # the declared-SLO counters (common/slo.py): served always,
+        # errors on any non-success, breach on over-objective latency.
+        # Caller-class outcomes must not burn the availability budget:
+        # a KILL is an operator action, and a syntax error / bad name
+        # is a bad request served correctly — only server-side
+        # failures are unavailability
+        slo.note(cls, resp["latency_in_us"],
+                 resp["error_code"] in (
+                     int(ErrorCode.SUCCEEDED),
+                     int(ErrorCode.E_KILLED),
+                     int(ErrorCode.E_SYNTAX_ERROR),
+                     int(ErrorCode.E_STATEMENT_EMPTY),
+                     int(ErrorCode.E_KEY_NOT_FOUND),
+                     int(ErrorCode.E_SPACE_NOT_FOUND)))
         return resp, seq.profile
 
     @staticmethod
@@ -403,6 +488,18 @@ class GraphService:
                 resp = dict(resp)
                 resp["rows"] = rows._mat()
         return resp
+
+    # metad's SHOW QUERIES / KILL QUERY fan-out targets (the
+    # daemonStats shape, meta/service.py rpc_showQueries/rpc_killQuery)
+    def rpc_listQueries(self, req: dict) -> dict:
+        return {"queries": query_registry.snapshot()}
+
+    def rpc_killQuery(self, req: dict) -> dict:
+        try:
+            qid = int(req.get("qid", 0))
+        except (TypeError, ValueError):
+            return {"killed": False}
+        return {"killed": query_registry.kill(qid)}
 
 
 def admission_health():

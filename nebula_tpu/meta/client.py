@@ -72,10 +72,16 @@ class MetaChangedListener:
 class MetaClient:
     def __init__(self, addrs: List[HostAddr], local_host: Optional[str] = None,
                  send_heartbeat: bool = False,
-                 client_manager: Optional[ClientManager] = None):
+                 client_manager: Optional[ClientManager] = None,
+                 role: Optional[str] = None):
         self.addrs = list(addrs)
         self.local_host = local_host
         self.send_heartbeat = send_heartbeat
+        # daemon role advertised on heartbeats: None/"storage" beats
+        # feed ActiveHostsMan (part allocation); "graph" beats land in
+        # metad's graph_hosts map instead — liveness + load brief for
+        # the SHOW QUERIES fan-out, never part placement
+        self.role = role
         self.cm = client_manager or default_client_manager
         self.listener: Optional[MetaChangedListener] = None
         self.cluster_id = 0
@@ -91,8 +97,10 @@ class MetaClient:
         # freshest healthy replica (docs/durability.md)
         self.hb_device_provider = None
         # device-brief read cache (graphd side): one listDeviceBriefs
-        # round trip per heartbeat window, not per query
+        # round trip per heartbeat window, not per query; the same
+        # answer carries the serving-tier load briefs (graph_briefs)
         self._device_briefs: dict = {}
+        self._graph_briefs: dict = {}
         self._device_briefs_at = 0.0
         # event-journal piggyback cursor: entries with seq beyond this
         # already reached metad on an acked heartbeat
@@ -276,6 +284,8 @@ class MetaClient:
         if not self.local_host:
             return Status.Error("no local host for heartbeat")
         payload = {"host": self.local_host, "cluster_id": self.cluster_id}
+        if self.role:
+            payload["role"] = self.role
         if self.hb_info:
             # daemon-advertised metadata (ws_port for bulk-load dispatch)
             payload["info"] = dict(self.hb_info)
@@ -442,6 +452,8 @@ class MetaClient:
             resp = self._call("listDeviceBriefs", {})
             briefs = {str(h): dict(b) for h, b in
                       (resp.get("briefs") or {}).items()}
+            graph = {str(h): dict(b) for h, b in
+                     (resp.get("graph_briefs") or {}).items()}
         except RpcError:
             # negative-cache the failure for one window too: while
             # metad is unreachable, every device-path query would
@@ -452,8 +464,19 @@ class MetaClient:
                 return dict(self._device_briefs)
         with self._cache_lock:
             self._device_briefs = briefs
+            self._graph_briefs = graph
             self._device_briefs_at = _time.monotonic()
             return dict(briefs)
+
+    def graph_briefs(self) -> Dict[str, dict]:
+        """{graphd host: load brief} — the serving-tier half of the
+        ``listDeviceBriefs`` answer (queue depth, lane occupancy, busy
+        fraction, shed rate from each graphd's role=graph heartbeat;
+        graph/batch_dispatch.py ``load_brief``).  Shares the
+        device-brief cache window: calling this refreshes both."""
+        self.device_briefs()
+        with self._cache_lock:
+            return dict(self._graph_briefs)
 
     def parts_alloc(self, space_id: int) -> Dict[int, List[str]]:
         c = self.space_cache(space_id)

@@ -114,6 +114,13 @@ class MetaService:
         # recent journal entries on heartbeats; SHOW EVENTS reads the
         # merged view (common/events.py)
         self.cluster_events = ClusterEventStore()
+        # role=graph heartbeaters: {host: {"time_s", "load"}} —
+        # deliberately NOT ActiveHostsMan, whose table feeds part
+        # allocation (a graphd must never be offered parts).  SHOW
+        # QUERIES / KILL QUERY fan out over this map the way SHOW
+        # STATS fans over active storage hosts, and listDeviceBriefs
+        # serves each replica's serving-load brief from it.
+        self.graph_hosts: Dict[str, dict] = {}
         stats.register_histogram("meta.heartbeat.latency_us")
         # replicated-catalog raft gauges (space 0 / part 0); weak bound
         # method — dropped with the service
@@ -149,7 +156,8 @@ class MetaService:
     # showStats fans RPCs to every storaged and listEvents reads the
     # event stores (their own locks) — same reasoning.
     _UNLOCKED_RPCS = ("rpc_download", "rpc_ingest", "rpc_showStats",
-                      "rpc_listEvents")
+                      "rpc_listEvents", "rpc_showQueries",
+                      "rpc_killQuery")
 
     def _locked(self, fn):
         if fn.__name__ in self._UNLOCKED_RPCS:
@@ -356,6 +364,54 @@ class MetaService:
                                   "stats": r["stats"], "proc": proc})
         return {"hosts": hosts}
 
+    def _live_graph_hosts(self) -> List[str]:
+        """graphd replicas whose role=graph beat is recent — the SHOW
+        QUERIES / KILL QUERY fan-out set."""
+        from ..common.flags import flags
+        ttl = float(flags.get("heartbeat_interval_secs", 10) or 10) * 5
+        now = time.monotonic()
+        with self._write_lock:
+            return sorted(h for h, rec in self.graph_hosts.items()
+                          if now - rec.get("time_s", 0.0) <= ttl)
+
+    def rpc_showQueries(self, req: dict) -> dict:
+        """SHOW QUERIES fan-out: one ``listQueries`` RPC per live
+        graphd replica (the showStats shape).  Query ids are
+        process-unique (graph/query_registry.py), so the merge is a
+        plain union; an unreachable replica is skipped — the registry
+        statement must not hang on a dead graphd."""
+        admin = getattr(self.balancer, "admin", None)
+        queries: Dict[int, dict] = {}
+        if admin is not None:
+            for h in self._live_graph_hosts():
+                try:
+                    r = admin.cm.call(HostAddr.parse(h),
+                                      "listQueries", {})
+                except Exception:  # noqa: BLE001 — replica churn
+                    continue
+                for q in (r or {}).get("queries", []):
+                    queries[q["id"]] = dict(q, host=h)
+        return {"queries": list(queries.values())}
+
+    def rpc_killQuery(self, req: dict) -> dict:
+        """KILL QUERY fan-out: ids carry a process tag, so the first
+        replica that answers ``killed`` IS the owner — stop there."""
+        try:
+            qid = int(req.get("qid", 0))
+        except (TypeError, ValueError):
+            return {"killed": False}
+        admin = getattr(self.balancer, "admin", None)
+        if admin is not None:
+            for h in self._live_graph_hosts():
+                try:
+                    r = admin.cm.call(HostAddr.parse(h), "killQuery",
+                                      {"qid": qid})
+                except Exception:  # noqa: BLE001 — replica churn
+                    continue
+                if r and r.get("killed"):
+                    return {"killed": True}
+        return {"killed": False}
+
     def rpc_listEvents(self, req: dict) -> dict:
         """Cluster-wide event view: heartbeat-absorbed events merged
         with this process's own journal, newest first."""
@@ -406,7 +462,16 @@ class MetaService:
             ds = rec.get("device_status")
             if ds:
                 briefs[host] = ds
-        return {"briefs": briefs}
+        # serving-tier load briefs (queue depth, lane occupancy, busy
+        # fraction, shed rate — graph/batch_dispatch.py load_brief)
+        # ride the same answer: one read ranks BOTH the storage
+        # replicas by freshness/health and the graphd replicas by load
+        graph = {}
+        for h in self._live_graph_hosts():
+            load = self.graph_hosts[h].get("load")
+            if load:
+                graph[h] = load
+        return {"briefs": briefs, "graph_briefs": graph}
 
     # ================= heartbeat (admin/HBProcessor) =================
     def rpc_heartBeat(self, req: dict) -> dict:
@@ -414,6 +479,20 @@ class MetaService:
         cid = req.get("cluster_id", 0)
         if cid and cid != self.cluster_id:
             raise _err(ErrorCode.E_WRONGCLUSTER, "cluster id mismatch")
+        if req.get("role") == "graph":
+            # serving-tier beat: liveness + load brief for the SHOW
+            # QUERIES fan-out and listDeviceBriefs ranking — NEVER
+            # ActiveHostsMan (that would offer the graphd parts)
+            with self._write_lock:
+                self.graph_hosts[req["host"]] = {
+                    "time_s": time.monotonic(),
+                    "load": dict(req.get("device_status") or {})}
+            if req.get("events"):
+                self.cluster_events.absorb(req["host"], req["events"])
+            stats.add_value("meta.heartbeat.latency_us",
+                            dur.elapsed_in_usec())
+            return {"cluster_id": self.cluster_id,
+                    "last_update_time_in_us": self.last_update_time()}
         info = dict(req.get("info") or {})
         # per-part replication brief (term/committed/last_log per
         # hosted raft part) — SHOW PARTS reads it back out of the host

@@ -720,6 +720,17 @@ class StorageService:
             ent = out.setdefault(str(key[0]),
                                  {"generation": 0, "breaker_open": False})
             ent["breaker_open"] = True
+        # serving-load extension (docs/observability.md): the same
+        # rankable fields the graphd brief carries — a remote-device
+        # storaged IS the serving tier for its spaces, and a balancer
+        # reading listDeviceBriefs ranks on freshness AND load from
+        # one struct.  Extra keys are invisible to the failover
+        # ladder's rank() (it reads generation/breaker_open only).
+        disp = getattr(rt, "_dispatcher", None) if rt is not None else None
+        if disp is not None and out:
+            load = disp.load_brief()
+            for ent in out.values():
+                ent.update(load)
         return out
 
     def peer_mirror_stalls(self):

@@ -68,6 +68,7 @@ ENDPOINT_CONTRACT = {
     "/metrics": {"keys": set(), "dynamic": True},   # text exposition
     "/healthz": {"keys": {"healthy", "checks"}, "dynamic": True},
     "/events": {"keys": {"error", "events"}, "dynamic": True},
+    "/queries": {"keys": {"error", "queries"}, "dynamic": True},
 }
 
 

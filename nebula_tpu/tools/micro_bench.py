@@ -259,6 +259,64 @@ def bench_admission(reps: int, op_budget_us: float = 200.0) -> dict:
             "within_budget": per_us <= op_budget_us}
 
 
+def bench_slo_path(reps: int, op_budget_us: float = 50.0,
+                   eval_budget_us: float = 50.0,
+                   cold_budget_us: float = 20_000.0) -> dict:
+    """Observability hot-path cost (docs/observability.md "The live
+    query plane"): what the PR 18 control plane adds to EVERY admitted
+    statement — one query-registry register/unregister pair (the
+    SHOW QUERIES seat) plus one slo.note (two counter bumps and a
+    deadline-vs-latency compare).  Budget-guarded at ``op_budget_us``
+    per statement, like admission/recovery: the registry is a dict
+    insert under an OrderedLock, so anything near the budget means a
+    lock regression.  The burn-rate tick is measured in BOTH states:
+    the steady state a scrape / healthz probe actually pays (the
+    engine memoizes per epoch second — a dict probe, ``eval_budget_us``)
+    and the once-per-second cold pass (full ring walks over the
+    3600 s windows, ``cold_budget_us``).  The end-to-end confirmation
+    is query_path's GO/s, whose serving loop now crosses the
+    register/unregister seam."""
+    from ..common import slo
+    from ..graph.query_registry import registry
+
+    n = max(2_000, reps * 50)
+    qid = registry.register("bench", cls="go")   # warm
+    registry.unregister(qid)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        qid = registry.register("GO FROM \"a\" OVER e", session=1,
+                                user="bench", cls="go", space="s")
+        slo.note("go", 1200.0, True)
+        registry.unregister(qid)
+    dt = time.perf_counter() - t0
+    per_us = dt / n * 1e6
+    # cold tick: a distinct `now` second per call busts the memo, so
+    # every iteration pays the full multi-window ring walk
+    m = max(50, reps)
+    base = int(time.time())
+    t0 = time.perf_counter()
+    for i in range(m):
+        slo.slo_engine.evaluate(now=base + i + 1)
+    cold_us = (time.perf_counter() - t0) / m * 1e6
+    # memoized steady state: what scrapes inside one second pay
+    k = max(2_000, reps * 50)
+    t0 = time.perf_counter()
+    for _ in range(k):
+        slo.slo_engine.evaluate(now=base)
+    eval_us = (time.perf_counter() - t0) / k * 1e6
+    slo.slo_engine.clear_for_tests()
+    return {"register_note_unregister_us_per_op": round(per_us, 2),
+            "evaluate_memo_us_per_tick": round(eval_us, 2),
+            "evaluate_cold_us_per_tick": round(cold_us, 2),
+            "objectives": len(slo.SLO_OBJECTIVES),
+            "op_budget_us": op_budget_us,
+            "eval_budget_us": eval_budget_us,
+            "cold_budget_us": cold_budget_us,
+            "within_budget": (per_us <= op_budget_us
+                              and eval_us <= eval_budget_us
+                              and cold_us <= cold_budget_us)}
+
+
 def bench_recovery(reps: int, op_budget_us: float = 1.0) -> dict:
     """Crash-recovery substrate hot-path cost (docs/durability.md).
 
@@ -720,6 +778,7 @@ def main(argv=None) -> int:
         "query_path": bench_query(qreps),
         "metrics_path": bench_metrics(reps),
         "admission_path": bench_admission(reps),
+        "slo_path": bench_slo_path(reps),
         "recovery_path": bench_recovery(reps),
         "absorb_path": bench_absorb(reps),
         "peer_absorb_path": bench_peer_absorb(reps),
@@ -731,6 +790,7 @@ def main(argv=None) -> int:
     ok = out["lint"]["within_budget"] \
         and out["metrics_path"]["within_budget"] \
         and out["admission_path"]["within_budget"] \
+        and out["slo_path"]["within_budget"] \
         and out["recovery_path"]["within_budget"] \
         and out["absorb_path"]["within_budget"] \
         and out["peer_absorb_path"]["within_budget"] \

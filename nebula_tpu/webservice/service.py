@@ -57,6 +57,7 @@ class WebService:
         self.register_handler("/metrics", self._metrics)
         self.register_handler("/healthz", self._healthz)
         self.register_handler("/events", self._events)
+        self.register_handler("/queries", self._queries)
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -242,6 +243,14 @@ class WebService:
         except ValueError:
             return 400, {"error": f"bad limit {q.get('limit')!r}"}
         return 200, {"events": journal.dump(limit=limit)}
+
+    def _queries(self, q: dict, body: bytes):
+        """The live query registry, THIS process only
+        (graph/query_registry.py; cluster-wide is SHOW QUERIES' metad
+        fan-out).  Oldest first — the statement most worth killing
+        reads first."""
+        from ..graph.query_registry import registry
+        return 200, {"queries": registry.snapshot()}
 
     def _get_stats(self, q: dict, body: bytes):
         exprs = q.get("stats")
