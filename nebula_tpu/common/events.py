@@ -109,7 +109,11 @@ class EventJournal:
     """Bounded ring of event dicts, oldest evicted first."""
 
     def __init__(self):
-        self._lock = OrderedLock("events.journal")
+        # seam-constructed (common/mc_hooks.py): the real OrderedLock
+        # in production; nebulamc's journal-cursor scenario swaps in an
+        # instrumented shim to interleave record() against since()
+        from . import mc_hooks
+        self._lock = mc_hooks.OrderedLock("events.journal")
         self._entries: List[dict] = []
         self._seq = 0
 

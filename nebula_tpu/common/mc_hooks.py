@@ -1,0 +1,97 @@
+"""Model-checker sync seam — construction-time indirection for the
+nebulamc deterministic scheduler (tools/mc/, docs/static_analysis.md
+"The model-checking layer").
+
+Production code constructs its synchronization primitives through the
+factory functions below instead of naming ``threading.Condition`` /
+``OrderedLock`` directly.  With no model-check run active (the
+permanent production state) each factory returns exactly the primitive
+it names — one module-global load and a ``None`` compare of overhead
+at CONSTRUCTION time and zero per operation, so the hot path is
+untouched (micro_bench query_path/admission_path pin this).  While a
+nebulamc scenario is exploring interleavings, the active scheduler
+substitutes instrumented shims for objects constructed BY ITS OWN
+logical threads (thread-scoped: a background absorb thread elsewhere
+in the process still gets real primitives), which is what turns every
+lock acquire/release, condition wait/notify and explicit
+``mc_yield`` point into a deterministic scheduling decision.
+
+The factories deliberately keep the constructor LEAF NAMES the lint
+passes key on (``Condition``/``Lock``/``OrderedLock``,
+tools/lint/locks.py _LOCK_CTORS): a class declaring
+``self._cond = mc_hooks.Condition(...)`` is still a lock-declaring
+class to lock-discipline and guard-inference, so routing construction
+through the seam never sheds static coverage.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+# The active model-check runtime (tools/mc/scheduler.py installs and
+# uninstalls it around each explored execution).  None in production.
+_runtime = None
+
+
+def install(runtime) -> None:
+    """Arm the seam: subsequent construction/yield calls from threads
+    the runtime claims (``runtime.applies()``) get instrumented."""
+    global _runtime
+    _runtime = runtime
+
+
+def uninstall() -> None:
+    global _runtime
+    _runtime = None
+
+
+def active():
+    """The installed mc runtime, or None (production)."""
+    return _runtime
+
+
+def _claimed():
+    """The runtime, iff it claims the calling thread."""
+    rt = _runtime
+    if rt is not None and rt.applies():
+        return rt
+    return None
+
+
+def Condition(name: str = "cond", lock=None):
+    """A condition variable: ``threading.Condition`` in production, the
+    scheduler's instrumented condition under an active mc run."""
+    rt = _claimed()
+    if rt is not None:
+        return rt.new_condition(name, lock)
+    return threading.Condition(lock)
+
+
+def Lock(name: str = "lock"):
+    """A plain mutex: ``threading.Lock`` in production."""
+    rt = _claimed()
+    if rt is not None:
+        return rt.new_lock(name)
+    return threading.Lock()
+
+
+def OrderedLock(rank: str, reentrant: bool = False):
+    """A ranked lock: common/ordered_lock.py's OrderedLock in
+    production (watchdog-visible), an instrumented shim under mc."""
+    rt = _claimed()
+    if rt is not None:
+        return rt.new_lock(rank, reentrant=reentrant)
+    from .ordered_lock import OrderedLock as _Real
+    return _Real(rank, reentrant=reentrant)
+
+
+def mc_yield(note: str, obj: Optional[object] = None) -> None:
+    """Explicit yield point: a no-op in production (one global load),
+    a scheduling decision under an active mc run.  Placed at the
+    documented LOCK-FREE shared-state reads (the breaker's CLOSED fast
+    paths, the runtime's mirror capture) so the explorer can interleave
+    another thread between the bare read and the locked re-read —
+    exactly the window the fast paths are designed to tolerate."""
+    rt = _runtime
+    if rt is not None and rt.applies():
+        rt.yield_point(note, obj)

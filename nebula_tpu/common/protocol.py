@@ -137,6 +137,11 @@ TYPED_RAISES = ("AdmissionShed", "ContinuousUnavailable")
 # spine are the two protocols whose invariants every serving path
 # leans on (docs/durability.md); a write from anywhere else is a
 # protocol violation even when it happens to hold the right lock.
+# Since round 19 each machine is ALSO a runtime model: nebulamc
+# (tools/mc/) re-checks every declared transition dynamically while
+# exhaustively interleaving the registered scenarios, and the
+# mc-coverage lint pass proves every entry below is exercised by at
+# least one scenario.
 STATE_MACHINES = {
     "breaker-cell": {
         "module": "storage/device.py",
@@ -150,5 +155,71 @@ STATE_MACHINES = {
         "fields": ("generation", "_fresh_version", "_delta_cursors",
                    "_absorb_declined_ver", "_part_sig"),
         "writers": ("_publish", "_try_absorb", "commit_in_place"),
+    },
+    "journal-cursor": {
+        "module": "common/events.py",
+        "fields": ("_seq", "_entries"),
+        "writers": ("__init__", "record"),
+    },
+}
+
+# The acquire/discharge protocols the serving tier hand-maintains —
+# ONE declaration consumed by BOTH enforcement layers: the
+# obligation-tracking lint pass (tools/lint/obligations.py builds its
+# must-call-on-all-paths rules from these specs) and nebulamc
+# (tools/mc/scenarios.py asserts the matching ``quiescence`` property
+# at the end of every explored interleaving — seats drained, probes
+# released, slots freed, markers discarded).  Keys are the registry
+# vocabulary the mc-coverage pass closes: every entry here must be
+# covered by at least one registered scenario.  Pure literals only —
+# both the protocol-registry and mc-coverage passes read this table
+# with ast.literal_eval.
+OBLIGATIONS = {
+    "lane-seat": {
+        "what": "a continuous lane seat (_LaneLedger.alloc)",
+        "hints": ("ledger",),
+        "acquire": ("alloc",),
+        "discharge": ("release",),
+        "quiescence": "every allocated lane released: seated_count()==0"
+                      " and free_count() back to width",
+    },
+    "pipeline-slot": {
+        "what": "a priority pipeline slot (_PrioritySlots.acquire)",
+        "hints": ("inflight",),
+        "acquire": ("acquire",),
+        "discharge": ("release",),
+        "quiescence": "all slots free and the waiter heap empty",
+    },
+    "probe-token": {
+        "what": "the breaker's half-open probe token (admit returned "
+                "None)",
+        "hints": ("breaker",),
+        "acquire": ("admit",),
+        "discharge": ("record_success", "record_failure",
+                      "release_probe"),
+        "quiescence": "no cell left with probing=True",
+    },
+    "waiter-heap": {
+        "what": "a waiter-heap entry (heappush onto a *waiters* heap)",
+        "hints": ("waiters",),
+        "acquire": ("heappush",),
+        "discharge": ("heappop",),
+        "arg_receiver": True,
+        "assign_discharge": True,
+        "quiescence": "the heap drained: no abandoned waiter entries",
+    },
+    "busy-meter": {
+        "what": "the device busy meter (_DeviceBusyMeter.begin)",
+        "hints": ("meter",),
+        "acquire": ("begin",),
+        "discharge": ("end",),
+        "quiescence": "active count back to zero",
+    },
+    "rebuild-marker": {
+        "what": "the per-space rebuild marker (_rebuilding.add)",
+        "hints": ("rebuilding",),
+        "acquire": ("add",),
+        "discharge": ("discard", "remove"),
+        "quiescence": "the rebuilding set empty",
     },
 }

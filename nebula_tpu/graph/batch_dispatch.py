@@ -77,6 +77,7 @@ import time
 from typing import Dict, List, Tuple
 
 from ..common import deadline as deadlines
+from ..common import mc_hooks
 from ..common import protocol
 from ..common import tracing
 from ..common.deadline import DeadlineExceeded
@@ -222,7 +223,10 @@ class _KeyState:
     __slots__ = ("cond", "queue", "dispatching", "rt_ema_s")
 
     def __init__(self):
-        self.cond = threading.Condition()
+        # constructed through the mc seam: a plain threading.Condition
+        # in production, an instrumented shim while a nebulamc scenario
+        # explores this key's leader election (docs/static_analysis.md)
+        self.cond = mc_hooks.Condition("dispatch.key")
         self.queue: List[_Request] = []
         self.dispatching = False
         # EMA of this key's batch round-trip (leader entering _run ->
@@ -241,7 +245,7 @@ class _PrioritySlots:
     contention this degenerates to the plain semaphore it replaced."""
 
     def __init__(self, n: int):
-        self._cond = threading.Condition()
+        self._cond = mc_hooks.Condition("dispatch.slots")
         self._free = max(1, int(n))
         self._seq = 0
         self._waiters: List[Tuple[int, int]] = []   # heap (prio, seq)
@@ -325,6 +329,9 @@ class _DeviceBusyMeter:
     scrape-time ``tpu.device_idle_frac`` gauge is the idle share since
     the previous scrape — the number the continuous pipeline exists to
     drive down (docs/admission.md "Continuous dispatch")."""
+    # nebulint: mc=caller-synced/every access runs under self._lock;
+    # the busy-meter obligation is modeled by the dispatch-admission
+    # scenario through begin/end rather than a shimmed internal lock
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -370,6 +377,8 @@ class _LaneLedger:
     clear: a lane re-enters the free heap only after its bits were
     cleared from the resident pair, which is what makes the join
     kernel's scatter-add exact.  Double-seating any lane raises."""
+    # nebulint: mc=caller-synced/the stream cond sequences every access;
+    # the lane-churn scenario models it under an instrumented condition
 
     __slots__ = ("width", "_free", "_seated")
 
@@ -1293,6 +1302,9 @@ class GoBatchDispatcher:
                 out[key] = len(st.queue)
         return out
 
+    # nebulint: mc=caller-synced/_load_mark is written solely from the
+    # single metrics scrape thread (heartbeat loop); no scenario thread
+    # ever enters this read-side brief
     def load_brief(self) -> dict:
         """One rankable serving-load struct per graphd replica
         (docs/observability.md): live queue depth summed across keys,
@@ -1319,6 +1331,8 @@ class GoBatchDispatcher:
                 stats.read_stats("graph.admission.shed.count.5") or 0.0,
         }
 
+    # nebulint: mc=caller-synced/_idle_mark is written solely from the
+    # single metrics scrape thread registered with stats.add_collector
     def _collect_gauges(self) -> None:
         brief = self.load_brief()
         for k, v in brief.items():

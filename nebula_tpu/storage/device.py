@@ -25,7 +25,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..common import protocol
+from ..common import mc_hooks, protocol
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
 from ..common.stats import stats
@@ -162,8 +162,10 @@ class DeviceCircuitBreaker:
     an OPEN breaker immediately: fresh state deserves a fresh probe."""
 
     def __init__(self):
-        from ..common.ordered_lock import OrderedLock
-        self._lock = OrderedLock("tpu.breaker")
+        # seam-constructed: the real OrderedLock in production, an
+        # instrumented shim while nebulamc explores the half-open
+        # probe races (tools/mc/scenarios.py breaker-probe)
+        self._lock = mc_hooks.OrderedLock("tpu.breaker")
         # nebulint: guarded-by=_lock (state transitions; the CLOSED
         # probes below are the documented lock-free exceptions)
         self._cells: Dict[Tuple[int, str], _BreakerCell] = {}
@@ -173,7 +175,12 @@ class DeviceCircuitBreaker:
         """None = run on the device (possibly as the half-open probe);
         a string = decline reason (breaker open)."""
         # lock-free fast path; anything non-closed re-reads under the
-        # lock below  # nebulint: disable=guard-inference
+        # lock below.  The mc_yield marks the bare read as a scheduling
+        # point so the explorer can interleave a state transition
+        # between it and the locked re-read — the exact window this
+        # fast path is designed to tolerate
+        mc_hooks.mc_yield("breaker.admit.fast", self)
+        # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None or cell.state == "closed":
             return None
@@ -219,6 +226,7 @@ class DeviceCircuitBreaker:
         do NOT clear the consecutive-failure count on closed cells (an
         unclassified error is neutral, not a device success)."""
         # lock-free empty probe; the mutation re-reads under the lock
+        mc_hooks.mc_yield("breaker.release_probe.fast", self)
         # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None:
@@ -231,6 +239,7 @@ class DeviceCircuitBreaker:
     def record_success(self, key: Tuple[int, str]) -> None:
         # hot path: nothing tracked for a healthy cell; any real
         # transition re-reads under the lock below
+        mc_hooks.mc_yield("breaker.record_success.fast", self)
         # nebulint: disable=guard-inference
         cell = self._cells.get(key)
         if cell is None or (cell.state == "closed" and cell.fails == 0):

@@ -3,7 +3,7 @@
 The reference C++ Nebula leans on compiler enforcement (MUST_USE_RESULT
 on Status/StatusOr, clang-tidy, sanitizer builds) plus a Thrift IDL
 that makes wire drift a compile error — both lost in a Python
-reproduction.  nebulint restores the project-specific part as sixteen
+reproduction.  nebulint restores the project-specific part as nineteen
 whole-package checks gated as a tier-1 test (tests/test_lint.py):
 
   lock-discipline   attributes mutated from thread entry points without
@@ -79,6 +79,25 @@ whole-package checks gated as a tier-1 test (tests/test_lint.py):
                     drift, the transport frame contract, the
                     /get_stats//traces//faults endpoint payloads) —
                     the Thrift-IDL guarantee, restored mechanically
+  event-registry    EventJournal.record() kinds must be literals from
+                    the single EVENT_KINDS registry (common/events.py);
+                    dead kinds flagged
+  obligation-tracking  FLOW (v5): acquire/discharge pairs declared in
+                    common/protocol.py OBLIGATIONS (lane seats, probe
+                    tokens, pipeline slots, waiter-heap entries, busy-
+                    meter marks, rebuild markers) discharged on every
+                    path, including exceptional ones (obligations.py)
+  protocol-registry  the typed-reason vocabulary is closed and
+                    STATE_MACHINES fields move only inside their
+                    declared transition methods (protocol.py)
+  mc-coverage       v6: the protocol registries and the nebulamc
+                    scenario registry (tools/mc/scenarios.py) move
+                    together — every STATE_MACHINES / OBLIGATIONS
+                    entry covered by >=1 registered scenario, no stale
+                    covers tags, and every scenario-driven class free
+                    of shared-state writes the scheduler cannot
+                    preempt ('# nebulint: mc=caller-synced/<reason>'
+                    waives caller-sequenced classes) (mccheck.py)
 
   stale-suppression META: a ``# nebulint: disable=`` comment whose
                     check ran but suppressed nothing at that site is

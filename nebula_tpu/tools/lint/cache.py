@@ -55,6 +55,9 @@ CHECK_SCOPE: Dict[str, Optional[Tuple[str, ...]]] = {
     # both passes are pure AST (no tracing), cheap enough to rescan
     "obligation-tracking": None,
     "protocol-registry": None,
+    # whole-package too: scenario `classes` tuples can point anywhere,
+    # and the scenario registry itself is hashed with the lint sources
+    "mc-coverage": None,
 }
 
 
@@ -77,12 +80,19 @@ def _lint_sources_sha() -> str:
     global _LINT_SHA
     if _LINT_SHA is None:
         h = hashlib.sha1()
-        d = os.path.dirname(os.path.abspath(__file__))
-        for fn in sorted(os.listdir(d)):
-            if fn.endswith(".py"):
-                with open(os.path.join(d, fn), "rb") as fh:
-                    h.update(fn.encode())
-                    h.update(fh.read())
+        here = os.path.dirname(os.path.abspath(__file__))
+        # tools/mc rides along: mc-coverage reads the live scenario
+        # registry, so editing a scenario is a check-version change
+        dirs = [here, os.path.join(os.path.dirname(here), "mc")]
+        for d in dirs:
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".py"):
+                    with open(os.path.join(d, fn), "rb") as fh:
+                        h.update(os.path.basename(d).encode())
+                        h.update(fn.encode())
+                        h.update(fh.read())
         _LINT_SHA = h.hexdigest()
     return _LINT_SHA
 

@@ -135,7 +135,9 @@ class Baseline:
 
 
 # ---------------------------------------------------------------- walking
-_SKIP_DIRS = {"__pycache__", ".git", "lint"}  # lint never lints itself
+_SKIP_DIRS = {"__pycache__", ".git", "lint", "mc"}  # the lint and mc
+# layers never lint themselves (the mc scheduler's shims deliberately
+# break the lock idioms the passes enforce)
 
 
 def _iter_py(root: str) -> Iterable[str]:
@@ -176,8 +178,8 @@ def load_package(root: str, repo_root: Optional[str] = None
 # ---------------------------------------------------------------- registry
 def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
     from . import blocking, capture, events, flagsreg, guards, hotpath, \
-        jaxaudit, locks, meshaudit, metrics, obligations, protocol, \
-        spans, status, wirecheck
+        jaxaudit, locks, mccheck, meshaudit, metrics, obligations, \
+        protocol, spans, status, wirecheck
     return {
         "lock-discipline": locks.check_lock_discipline,
         "lock-order": locks.check_lock_order,
@@ -196,6 +198,7 @@ def _checks() -> Dict[str, Callable[[PackageContext], List[Violation]]]:
         "wire-contract": wirecheck.check_wire_contract,
         "obligation-tracking": obligations.check_obligations,
         "protocol-registry": protocol.check_protocol_registry,
+        "mc-coverage": mccheck.check_mc_coverage,
     }
 
 
@@ -206,7 +209,7 @@ ALL_CHECKS = ("lock-discipline", "lock-order", "status-discard",
               "metric-registry", "event-registry", "guard-inference",
               "blocking-under-lock", "context-capture", "jaxpr-audit",
               "mesh-audit", "carveout-inventory", "wire-contract",
-              "obligation-tracking", "protocol-registry",
+              "obligation-tracking", "protocol-registry", "mc-coverage",
               "stale-suppression")
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")

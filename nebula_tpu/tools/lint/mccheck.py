@@ -1,0 +1,274 @@
+"""mc-coverage — the protocol registries and the nebulamc scenario
+registry can only move together.
+
+``common/protocol.py`` declares the runtime protocols twice over: the
+``STATE_MACHINES`` table (fields + transition writers) and the
+``OBLIGATIONS`` table (acquire/discharge pairs with a quiescence
+property).  nebulamc (tools/mc/) is the layer that actually EXECUTES
+those declarations — each registered scenario names the entries it
+exercises with ``covers=("machine:<name>", "obligation:<name>")``
+tags.  This pass closes the loop statically:
+
+  * every STATE_MACHINES / OBLIGATIONS entry must be covered by at
+    least one registered scenario — a declared protocol nobody model-
+    checks is documentation, not enforcement (add a scenario or
+    delete the entry);
+  * every ``covers`` tag must name a LIVE registry entry — a stale
+    tag (scenario outlives the declaration, or a typo'd name) claims
+    coverage that does not exist;
+  * every class a scenario drives (its ``classes`` tuple) is scanned
+    for shared-state writes reachable without an instrumented sync
+    op: a method that assigns ``self.<field>`` but never enters a
+    ``with`` block, never calls an acquire/release/wait/notify, and
+    never passes an ``mc_yield`` point is invisible to the scheduler
+    — the model checker cannot preempt inside it, so its
+    interleavings are silently unexplored.  Classes (or single
+    methods) whose synchronization lives in the caller carry
+    ``# nebulint: mc=caller-synced/<reason>`` — the reason is
+    mandatory, same contract as the baseline.
+
+The scenario registry is imported live from tools/mc/scenarios.py
+(the mc package itself is never linted — ``_SKIP_DIRS`` — exactly as
+the lint package never lints itself); tests inject a fake registry
+through the ``registry`` parameter.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import PackageContext, Violation
+
+CHECK = "mc-coverage"
+
+_WAIVER = re.compile(r"#\s*nebulint:\s*mc=caller-synced/(\S.*)")
+
+# a call to any of these leaves inside a method means the scheduler
+# gets control there (mc_hooks factories produce instrumented shims;
+# the ops announce; mc_yield is an explicit preemption point)
+_SYNC_OPS = {"acquire", "release", "wait", "notify", "notify_all",
+             "mc_yield"}
+
+
+def _scenario_registry() -> Dict[str, object]:
+    from ..mc.scenarios import SCENARIOS
+    return dict(SCENARIOS)
+
+
+def _load_tables(mod) -> Optional[Tuple[dict, dict, Dict[str, int]]]:
+    """literal_eval STATE_MACHINES / OBLIGATIONS off ``mod``'s AST,
+    recording each key's line for precise violations.  Returns None
+    when the module declares neither table."""
+    machines: dict = {}
+    obligations: dict = {}
+    key_lines: Dict[str, int] = {}
+    found = False
+    for node in mod.tree.body if isinstance(mod.tree, ast.Module) else []:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name not in ("STATE_MACHINES", "OBLIGATIONS"):
+            continue
+        try:
+            table = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            continue        # protocol-registry already polices shape
+        if not isinstance(table, dict):
+            continue
+        found = True
+        prefix = "machine" if name == "STATE_MACHINES" else "obligation"
+        if name == "STATE_MACHINES":
+            machines = table
+        else:
+            obligations = table
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    key_lines[f"{prefix}:{k.value}"] = k.lineno
+    return (machines, obligations, key_lines) if found else None
+
+
+def _class_span_waived(mod, cls: ast.ClassDef) -> bool:
+    """Class-level waiver: the annotation sits in the class HEADER —
+    the line above the def, or between the docstring and the first
+    real statement (the _LaneLedger idiom) — never inside a method,
+    and never in the comment block CONTIGUOUS to the first statement
+    when that statement is a def: a comment touching a def is that
+    method's waiver (leave a blank line to make it class-wide)."""
+    body = [n for n in cls.body
+            if not (isinstance(n, ast.Expr)
+                    and isinstance(n.value, ast.Constant)
+                    and isinstance(n.value.value, str))]
+    header_end = body[0].lineno if body else (
+        getattr(cls, "end_lineno", cls.lineno) or cls.lineno) + 1
+    if body and isinstance(body[0], (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+        while header_end - 1 > cls.lineno and \
+                mod.lines[header_end - 2].lstrip().startswith("#"):
+            header_end -= 1
+    for line in mod.lines[max(0, cls.lineno - 2):header_end - 1]:
+        if _WAIVER.search(line):
+            return True
+    return False
+
+
+def _method_waived(mod, fn) -> bool:
+    """Method-level waiver: on the def line or anywhere in the
+    contiguous comment block directly above it."""
+    if _WAIVER.search(mod.lines[fn.lineno - 1]):
+        return True
+    i = fn.lineno - 1
+    while i >= 1 and mod.lines[i - 1].lstrip().startswith("#"):
+        if _WAIVER.search(mod.lines[i - 1]):
+            return True
+        i -= 1
+    return False
+
+
+def _naked_writes(fn) -> List[Tuple[int, str]]:
+    """(line, field) for every ``self.<field>`` assignment in ``fn``
+    when the body contains NO sync op at all; [] otherwise."""
+    writes: List[Tuple[int, str]] = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.With):
+            return []
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if leaf in _SYNC_OPS:
+                return []
+        targets = ()
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, ast.AugAssign):
+            targets = (sub.target,)
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                writes.append((t.lineno, t.attr))
+    return writes
+
+
+def check_mc_coverage(ctx: PackageContext,
+                      registry: Optional[Dict[str, object]] = None
+                      ) -> List[Violation]:
+    out: List[Violation] = []
+    proto = None
+    machines: dict = {}
+    obligations: dict = {}
+    key_lines: Dict[str, int] = {}
+    for mod in ctx.modules:
+        if not mod.rel.endswith("common/protocol.py"):
+            continue
+        tables = _load_tables(mod)
+        if tables is not None:
+            proto = mod
+            machines, obligations, key_lines = tables
+            break
+    if proto is None:
+        return out          # nothing declared, nothing to cover
+
+    if registry is None:
+        try:
+            registry = _scenario_registry()
+        except Exception as e:     # noqa: BLE001 — a broken scenario
+            out.append(Violation(   # module must fail lint, not crash it
+                CHECK, proto.rel, 1, "<module>",
+                f"cannot import the nebulamc scenario registry "
+                f"(tools/mc/scenarios.py): {e} — the protocol tables "
+                f"are unverifiable until it loads"))
+            return out
+
+    # ------------------------------------------------- coverage leg
+    covered = set()
+    for s in registry.values():
+        covered.update(getattr(s, "covers", ()))
+    for key in machines:
+        tag = f"machine:{key}"
+        if tag not in covered:
+            out.append(Violation(
+                CHECK, proto.rel, key_lines.get(tag, 1), key,
+                f"STATE_MACHINES entry {key!r} is covered by no "
+                f"registered nebulamc scenario — a declared machine "
+                f"nobody model-checks is documentation, not "
+                f"enforcement: add a scenario covering "
+                f"{tag!r} or delete the entry"))
+    for key in obligations:
+        tag = f"obligation:{key}"
+        if tag not in covered:
+            out.append(Violation(
+                CHECK, proto.rel, key_lines.get(tag, 1), key,
+                f"OBLIGATIONS entry {key!r} is covered by no "
+                f"registered nebulamc scenario — its quiescence "
+                f"property is never asserted over an explored "
+                f"interleaving: add a scenario covering {tag!r} "
+                f"or delete the entry"))
+
+    # ---------------------------------------------- stale-tag leg
+    for name in sorted(registry):
+        s = registry[name]
+        for tag in getattr(s, "covers", ()):
+            kind, _, entry = tag.partition(":")
+            live = (machines if kind == "machine"
+                    else obligations if kind == "obligation" else None)
+            if live is None:
+                out.append(Violation(
+                    CHECK, proto.rel, 1, name,
+                    f"scenario {name!r} covers malformed tag {tag!r} "
+                    f"— tags are 'machine:<name>' or "
+                    f"'obligation:<name>'"))
+            elif entry not in live:
+                out.append(Violation(
+                    CHECK, proto.rel, 1, name,
+                    f"scenario {name!r} covers {tag!r} but no such "
+                    f"entry exists in the protocol registry — a "
+                    f"stale tag claims coverage that does not exist"))
+
+    # ------------------------------------------ instrumentation leg
+    by_rel = {m.rel: m for m in ctx.modules}
+    for name in sorted(registry):
+        s = registry[name]
+        for dotted_cls in getattr(s, "classes", ()):
+            parts = dotted_cls.split(".")
+            mod_rel = "/".join(parts[:-1]) + ".py"
+            cls_name = parts[-1]
+            mod = by_rel.get(mod_rel) or next(
+                (m for m in ctx.modules if m.rel.endswith(mod_rel)),
+                None)
+            cls = None
+            if mod is not None:
+                cls = next((n for n in ast.walk(mod.tree)
+                            if isinstance(n, ast.ClassDef)
+                            and n.name == cls_name), None)
+            if cls is None:
+                out.append(Violation(
+                    CHECK, proto.rel, 1, name,
+                    f"scenario {name!r} drives {dotted_cls} but the "
+                    f"class is not in the linted package — fix the "
+                    f"scenario's classes tuple"))
+                continue
+            if _class_span_waived(mod, cls):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__" or _method_waived(mod, fn):
+                    continue    # construction precedes concurrency
+                for line, field in _naked_writes(fn):
+                    out.append(Violation(
+                        CHECK, mod.rel, line,
+                        f"{cls_name}.{fn.name}",
+                        f"shared-state write .{field} = ... is "
+                        f"reachable without an instrumented sync op "
+                        f"— nebulamc cannot preempt inside "
+                        f"{fn.name}(), so scenario {name!r} silently "
+                        f"under-explores it; take the class lock, "
+                        f"add an mc_yield point, or annotate "
+                        f"'# nebulint: mc=caller-synced/<reason>'"))
+    return out

@@ -91,32 +91,25 @@ class _Rule:
 
 
 # The registry: every hand-maintained acquire/release protocol in the
-# serving tier.  Receiver hints are substring matches on the dotted
-# receiver's components, so ``self.sched.meter.begin()`` and
-# ``self.meter.begin()`` both bind to busy-meter while ``lock.acquire``
-# stays out of pipeline-slot's way.
-OBLIGATIONS = (
-    _Rule("lane-seat",
-          "a continuous lane seat (_LaneLedger.alloc)",
-          ("ledger",), ("alloc",), ("release",)),
-    _Rule("pipeline-slot",
-          "a priority pipeline slot (_PrioritySlots.acquire)",
-          ("inflight",), ("acquire",), ("release",)),
-    _Rule("probe-token",
-          "the breaker's half-open probe token (admit returned None)",
-          ("breaker",), ("admit",),
-          ("record_success", "record_failure", "release_probe")),
-    _Rule("waiter-heap",
-          "a waiter-heap entry (heappush onto a *waiters* heap)",
-          ("waiters",), ("heappush",), ("heappop",),
-          arg_receiver=True, assign_discharge=True),
-    _Rule("busy-meter",
-          "the device busy meter (_DeviceBusyMeter.begin)",
-          ("meter",), ("begin",), ("end",)),
-    _Rule("rebuild-marker",
-          "the per-space rebuild marker (_rebuilding.add)",
-          ("rebuilding",), ("add",), ("discard", "remove")),
-)
+# serving tier, DECLARED ONCE in common/protocol.py (round 19 moved
+# the data there so nebulamc's quiescence checks and this pass consume
+# the same table; mc-coverage proves every entry is also exercised by
+# a registered interleaving scenario).  Receiver hints are substring
+# matches on the dotted receiver's components, so
+# ``self.sched.meter.begin()`` and ``self.meter.begin()`` both bind to
+# busy-meter while ``lock.acquire`` stays out of pipeline-slot's way.
+def _load_rules() -> Tuple[_Rule, ...]:
+    from ...common.protocol import OBLIGATIONS as specs
+    return tuple(
+        _Rule(name, spec["what"], tuple(spec["hints"]),
+              tuple(spec["acquire"]), tuple(spec["discharge"]),
+              arg_receiver=bool(spec.get("arg_receiver", False)),
+              assign_discharge=bool(spec.get("assign_discharge", False)),
+              exception_edges=bool(spec.get("exception_edges", True)))
+        for name, spec in specs.items())
+
+
+OBLIGATIONS = _load_rules()
 
 _ANN = re.compile(
     r"#\s*nebulint:\s*obligation\s*=\s*handed-off(?:/([^#]*))?")

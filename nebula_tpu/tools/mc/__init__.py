@@ -1,0 +1,34 @@
+"""nebulamc — deterministic interleaving model checking over the
+declared protocol state machines (nebulint v6's dynamic layer).
+
+The static passes in tools/lint prove structural properties of the
+SOURCE (fields written only inside declared transitions, obligations
+discharged on every path); nebulamc re-checks the same
+common/protocol.py declarations against EXECUTIONS: a cooperative
+scheduler (scheduler.py) runs small registered scenarios
+(scenarios.py) as logical threads over the production classes' real
+sync seams (common/mc_hooks.py), an explorer (explore.py) enumerates
+every interleaving within a preemption bound (iterative context
+bounding + sleep-set reduction), and a monitor (machines.py) asserts
+each state-field write lands inside a declared transition while every
+quiescence property from OBLIGATIONS holds at the end of every
+explored schedule.  Failures print a replayable schedule id:
+
+    python -m nebula_tpu.tools.mc replay --schedule=<scenario>@<id>
+
+See docs/static_analysis.md "The model-checking layer".
+"""
+from .explore import (ExploreResult, decode_schedule, encode_schedule,
+                      explore)
+from .machines import Monitor
+from .scheduler import (ExecResult, McError, McViolation, Schedule,
+                        Scheduler)
+from .scenarios import (SCENARIOS, Scenario, explore_scenario,
+                        run_scenario)
+
+__all__ = [
+    "ExecResult", "ExploreResult", "McError", "McViolation", "Monitor",
+    "SCENARIOS", "Scenario", "Schedule", "Scheduler",
+    "decode_schedule", "encode_schedule", "explore",
+    "explore_scenario", "run_scenario",
+]

@@ -1,0 +1,171 @@
+"""nebulamc CLI.
+
+    python -m nebula_tpu.tools.mc list
+    python -m nebula_tpu.tools.mc run [scenario ...] [--smoke|--full]
+        [--max-preemptions=N] [--max-executions=N] [--max-seconds=S]
+        [--format=text|sarif] [--fixtures=PATH]
+    python -m nebula_tpu.tools.mc replay --schedule=<scenario>@<id>
+        [--fixtures=PATH]
+
+``run`` explores every (or the named) registered scenario within its
+bounded budget — ``--smoke`` uses each scenario's small tier-1 budget,
+``--full`` the exhaustive sweep budget (the chaos lane).  A violation
+prints the failing schedule id; ``replay`` re-executes exactly that
+interleaving with the full trace.  ``--fixtures`` loads an extra
+scenario module (tests/lint_fixtures/mc_racy.py style: a module-level
+``FIXTURE_SCENARIOS`` dict) so historical-bug reconstructions replay
+through the same CLI.  Exit codes: 0 clean, 1 violation found,
+2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+from .explore import decode_schedule, encode_schedule
+from .scenarios import (SCENARIOS, Scenario, explore_scenario,
+                        run_scenario)
+
+
+def _load_registry(fixtures: str) -> Dict[str, Scenario]:
+    reg = dict(SCENARIOS)
+    if fixtures:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_mc_fixtures",
+                                                      fixtures)
+        if spec is None or spec.loader is None:
+            raise SystemExit(f"cannot load fixtures from {fixtures}")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        reg.update(getattr(mod, "FIXTURE_SCENARIOS", {}))
+    return reg
+
+
+def _sarif(findings) -> str:
+    """Minimal SARIF 2.1.0 document for mc findings — same envelope
+    nebulint emits, tool name nebulamc."""
+    results = []
+    for scen, sid, msg in findings:
+        results.append({
+            "ruleId": "mc-violation",
+            "level": "error",
+            "message": {"text": f"[{scen}] {msg} "
+                                f"(replay: --schedule={sid})"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": "nebula_tpu/tools/mc/scenarios.py"},
+                    "region": {"startLine": 1},
+                }}],
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                    ".json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "nebulamc",
+                "informationUri":
+                    "docs/static_analysis.md",
+                "rules": [{
+                    "id": "mc-violation",
+                    "shortDescription": {
+                        "text": "model-checked interleaving violated "
+                                "a declared protocol property"}}],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m nebula_tpu.tools.mc")
+    sub = ap.add_subparsers(dest="cmd")
+    sub.add_parser("list", help="print the scenario registry")
+    runp = sub.add_parser("run", help="explore scenarios")
+    runp.add_argument("scenarios", nargs="*",
+                      help="scenario names (default: all registered)")
+    runp.add_argument("--smoke", action="store_true",
+                      help="per-scenario tier-1 budgets (small bounds)")
+    runp.add_argument("--full", action="store_true",
+                      help="per-scenario exhaustive-sweep budgets")
+    runp.add_argument("--max-preemptions", type=int, default=None)
+    runp.add_argument("--max-executions", type=int, default=None)
+    runp.add_argument("--max-seconds", type=float, default=None)
+    runp.add_argument("--format", choices=("text", "sarif"),
+                      default="text")
+    runp.add_argument("--fixtures", default="")
+    rep = sub.add_parser("replay", help="re-run one failing schedule")
+    rep.add_argument("--schedule", required=True,
+                     help="<scenario>@<base36 choices>")
+    rep.add_argument("--fixtures", default="")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "list":
+        for name, s in sorted(SCENARIOS.items()):
+            cov = ", ".join(s.covers)
+            print(f"{name:20s} {s.title}  [{cov}]")
+        return 0
+
+    if args.cmd == "run":
+        reg = _load_registry(args.fixtures)
+        names = args.scenarios or sorted(SCENARIOS)
+        unknown = [n for n in names if n not in reg]
+        if unknown:
+            print(f"unknown scenario(s): {', '.join(unknown)} — "
+                  f"the registry is closed; see `list`",
+                  file=sys.stderr)
+            return 2
+        findings = []
+        for name in names:
+            s = reg[name]
+            bound, execs, secs = s.smoke if args.smoke else s.full
+            if args.max_preemptions is not None:
+                bound = args.max_preemptions
+            if args.max_executions is not None:
+                execs = args.max_executions
+            if args.max_seconds is not None:
+                secs = args.max_seconds
+            r = explore_scenario(s, bound, execs, secs)
+            if r.violation is not None:
+                sid = encode_schedule(name, r.failing_choices)
+                findings.append((name, sid, str(r.violation)))
+                if args.format == "text":
+                    print(f"FAIL {name}: {r.violation}")
+                    print(f"     replay: python -m nebula_tpu.tools.mc "
+                          f"replay --schedule={sid}")
+            elif args.format == "text":
+                state = ("exhausted" if r.exhausted
+                         else "budget-bounded")
+                print(f"ok   {name}: {r.executions} executions, "
+                      f"bound {r.bound}, {r.seconds:.1f}s ({state})")
+        if args.format == "sarif":
+            print(_sarif(findings))
+        return 1 if findings else 0
+
+    if args.cmd == "replay":
+        reg = _load_registry(args.fixtures)
+        name, schedule = decode_schedule(args.schedule)
+        if name not in reg:
+            print(f"unknown scenario {name!r}", file=sys.stderr)
+            return 2
+        r = run_scenario(reg[name], schedule)
+        for thread, op in r.trace:
+            print(f"  {thread:12s} {op}")
+        if r.violation is not None:
+            print(f"FAIL {name}: {r.violation}")
+            return 1
+        print(f"ok   {name}: schedule replayed clean "
+              f"({len(r.trace)} steps)")
+        return 0
+
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

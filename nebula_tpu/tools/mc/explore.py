@@ -1,0 +1,181 @@
+"""nebulamc explorer — bounded-preemption enumeration of a scenario's
+interleavings with sleep-set partial-order reduction.
+
+The search is STATELESS (CHESS-style): an execution is identified by
+its schedule prefix; to visit a different interleaving we re-run the
+scenario from scratch with a forced prefix and let the scheduler's
+default policy (lowest-index enabled thread) extend it.  Each run's
+``ExecResult.steps`` records, per step, the sorted enabled set and
+every candidate's op footprint — exactly what the explorer needs to
+enumerate the siblings it has not yet visited.
+
+Iterative context bounding
+--------------------------
+Executions are admitted by their PREEMPTION count — a choice is a
+preemption when the previously-running thread is still enabled but a
+different one is scheduled (voluntary blocking is free).  The search
+runs the full DFS at bound 0, then 1, then 2, ... up to
+``max_preemptions``: empirically almost every real concurrency bug
+needs very few preemptions (the three historical soak bugs here all
+reproduce within 2), and low bounds keep the state space tractable.
+The per-bound ``seen`` set resets each round — the SAME prefix admits
+MORE sibling expansions at a higher bound — while executed results are
+cached by prefix across bounds (same prefix => bit-identical run).
+
+Sleep sets
+----------
+After exploring the subtree where thread t moved at a node, t's move
+goes to sleep for the node's remaining siblings: re-executing it first
+in a sibling subtree reaches an already-covered state unless some
+DEPENDENT op runs in between (footprints intersect, or either is the
+``"*"`` wildcard a yield point carries — see scheduler.Op.resources).
+A sleeping entry wakes when a dependent op executes; a node whose
+entire enabled set is asleep is pruned.
+
+Schedule ids
+------------
+``<scenario>@<base36 choice digits>`` — one digit per step, the index
+into that step's sorted enabled set.  Any failure report prints one;
+``python -m nebula_tpu.tools.mc replay --schedule=<id>`` re-runs it
+deterministically.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from .scheduler import ExecResult, Schedule
+
+_B36 = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def encode_schedule(scenario: str, choices) -> str:
+    body = "".join(_B36[c] for c in choices) or "-"
+    return f"{scenario}@{body}"
+
+
+def decode_schedule(schedule_id: str) -> Tuple[str, Schedule]:
+    name, sep, body = schedule_id.partition("@")
+    if not sep:
+        raise ValueError(f"malformed schedule id {schedule_id!r} "
+                         f"(expected <scenario>@<choices>)")
+    if body in ("", "-"):
+        return name, Schedule([])
+    try:
+        return name, Schedule([_B36.index(ch) for ch in body])
+    except ValueError:
+        raise ValueError(f"malformed schedule id {schedule_id!r}: "
+                         f"non-base36 choice digit")
+
+
+def _dependent(a: frozenset, b: frozenset) -> bool:
+    return "*" in a or "*" in b or bool(a & b)
+
+
+class ExploreResult:
+    """Outcome of one bounded exploration."""
+
+    __slots__ = ("executions", "violation", "failing_choices",
+                 "exhausted", "bound", "seconds")
+
+    def __init__(self, executions: int,
+                 violation: Optional[BaseException],
+                 failing_choices: Optional[Tuple[int, ...]],
+                 exhausted: bool, bound: int, seconds: float):
+        self.executions = executions
+        self.violation = violation
+        # the FULL executed choice sequence of the failing run (prefix
+        # + default extension): replaying it reproduces the failure
+        self.failing_choices = failing_choices
+        # True iff every interleaving within max_preemptions was
+        # visited (no budget cut) and none violated
+        self.exhausted = exhausted
+        self.bound = bound            # last bound attempted
+        self.seconds = seconds
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+def explore(run_one: Callable[[Schedule], ExecResult],
+            max_preemptions: int = 2,
+            max_executions: int = 20_000,
+            max_seconds: float = 120.0) -> ExploreResult:
+    """Enumerate interleavings of ``run_one`` (a nullary scenario
+    execution parameterized only by its schedule) up to
+    ``max_preemptions``, stopping at the first violation or when the
+    execution/wall budget runs out."""
+    t0 = time.monotonic()
+    cache: Dict[Tuple[int, ...], ExecResult] = {}
+    state = {"executions": 0, "cut": False}
+
+    def run_prefix(prefix: Tuple[int, ...]) -> Optional[ExecResult]:
+        r = cache.get(prefix)
+        if r is not None:
+            return r
+        if state["executions"] >= max_executions \
+                or time.monotonic() - t0 > max_seconds:
+            state["cut"] = True
+            return None
+        state["executions"] += 1
+        r = run_one(Schedule(list(prefix)))
+        cache[prefix] = r
+        return r
+
+    def done(violation, choices, exhausted, bound):
+        return ExploreResult(state["executions"], violation, choices,
+                             exhausted, bound,
+                             time.monotonic() - t0)
+
+    bound = 0
+    for bound in range(max_preemptions + 1):
+        seen = {()}                   # per-bound: expansions depend on
+        stack = [((), {})]            # the bound (see module doc)
+        while stack:
+            prefix, sleep0 = stack.pop()
+            r = run_prefix(prefix)
+            if r is None:
+                return done(None, None, False, bound)
+            if r.violation is not None:
+                return done(r.violation, r.choices, False, bound)
+            if r.divergence:          # pragma: no cover - prefix from
+                continue              # our own steps never diverges
+            # walk the executed extension, generating unvisited
+            # siblings at every step past the forced prefix
+            chosen = [s[0][s[1]] for s in r.steps]
+            # preemption count of the executed prefix up to step k
+            pre = [0] * (len(r.steps) + 1)
+            for i, (enabled, pos, _f) in enumerate(r.steps):
+                prev = chosen[i - 1] if i else None
+                bump = int(prev is not None and prev in enabled
+                           and chosen[i] != prev)
+                pre[i + 1] = pre[i] + bump
+            sleep = dict(sleep0)
+            for k in range(len(prefix), len(r.steps)):
+                enabled, pos, foots = r.steps[k]
+                if all(t in sleep for t in enabled):
+                    break             # whole node redundant: prune
+                prev = chosen[k - 1] if k else None
+                sibling_sleep = dict(sleep)
+                sibling_sleep[chosen[k]] = foots[pos]
+                for j, tj in enumerate(enabled):
+                    if j == pos or tj in sleep:
+                        continue
+                    preempts = pre[k] + int(prev is not None
+                                            and prev in enabled
+                                            and tj != prev)
+                    if preempts > bound:
+                        continue
+                    np = r.choices[:k] + (j,)
+                    if np in seen:
+                        continue
+                    seen.add(np)
+                    stack.append((np, dict(sibling_sleep)))
+                    sibling_sleep[tj] = foots[j]
+                # advance the walking sleep set past the executed op
+                f = foots[pos]
+                sleep = {t: ft for t, ft in sleep.items()
+                         if not _dependent(ft, f)}
+                sleep.pop(chosen[k], None)
+    return done(None, None, True, bound)

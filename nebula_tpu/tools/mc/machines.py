@@ -1,0 +1,132 @@
+"""nebulamc state-machine monitor — the dynamic half of the
+protocol-registry contract.
+
+The protocol-registry lint pass (tools/lint/protocol.py) proves
+STATICALLY that no statement outside a machine's declared writer
+methods assigns its fields.  This module re-checks the same
+declaration DYNAMICALLY while the scheduler interleaves a scenario:
+every ``setattr`` of a declared field is verified to be executing
+under one of the declared transition methods, so a write that the
+static pass cannot see (through an alias, a helper, ``setattr`` by
+string) still trips the model checker.
+
+Binding mechanics: the holder class's ``__setattr__`` is patched
+(class-level, so ``__slots__`` holders work too) and every declared
+writer — on the writer class, which may differ from the holder (the
+breaker cell's transitions live on DeviceCircuitBreaker) — is wrapped
+to maintain a thread-local depth.  A depth of zero at field-write
+time is a violation, EXCEPT inside the holder's own ``__init__``
+(construction must be able to create the fields).  Violations are
+recorded on the monitor AND raised as McViolation so the exploring
+scheduler surfaces the schedule that reached them.
+
+Bindings restore the patched classes in ``unbind_all`` — always call
+it in a finally; scenarios.run_scenario does.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .scheduler import McViolation
+
+
+class Monitor:
+    """Aggregates MachineBindings for one execution."""
+
+    def __init__(self):
+        self.violations: List[str] = []
+        self._bindings: List[_Binding] = []
+
+    def bind(self, machine: str, holder_cls: type,
+             writer_cls: Optional[type] = None) -> None:
+        """Arm ``machine`` (a STATE_MACHINES key) over ``holder_cls``
+        instances, with the transition methods looked up on
+        ``writer_cls`` (defaults to the holder itself)."""
+        from ...common.protocol import STATE_MACHINES
+        spec = STATE_MACHINES[machine]
+        self._bindings.append(_Binding(
+            self, machine, holder_cls, writer_cls or holder_cls,
+            tuple(spec["fields"]), tuple(spec["writers"])))
+
+    def unbind_all(self) -> None:
+        while self._bindings:
+            self._bindings.pop()._restore()
+
+    def _flag(self, msg: str) -> None:
+        self.violations.append(msg)
+        raise McViolation(msg, kind="state-machine")
+
+
+class _Binding:
+    def __init__(self, mon: Monitor, machine: str, holder_cls: type,
+                 writer_cls: type, fields: Tuple[str, ...],
+                 writers: Tuple[str, ...]):
+        self.machine = machine
+        self.fields = frozenset(fields)
+        self._tl = threading.local()
+        self._saved: List[Tuple[type, str, object]] = []
+
+        tl = self._tl
+
+        def depth() -> int:
+            return getattr(tl, "d", 0)
+
+        # wrap every declared writer that exists on the writer class
+        # (plus the holder's __init__, which is always a legal writer)
+        wrap_sites: List[Tuple[type, str]] = [
+            (writer_cls, w) for w in writers
+            if callable(writer_cls.__dict__.get(w))]
+        if "__init__" not in [w for _c, w in wrap_sites] \
+                or writer_cls is not holder_cls:
+            if callable(holder_cls.__dict__.get("__init__")):
+                wrap_sites.append((holder_cls, "__init__"))
+        for cls, name in wrap_sites:
+            orig = cls.__dict__[name]
+            self._saved.append((cls, name, orig))
+            setattr(cls, name, _wrap_writer(orig, tl))
+
+        holder_set = holder_cls.__setattr__
+        # restore must DELETE our patch when the class had no own
+        # __setattr__ (it inherited object's), not pin the inherited
+        # slot wrapper into the class dict
+        self._saved.append((
+            holder_cls, "__setattr__",
+            holder_set if "__setattr__" in holder_cls.__dict__
+            else _DELETE))
+        fields_fs = self.fields
+        machine_name = machine
+
+        def checked_setattr(obj, name, value):
+            if name in fields_fs and depth() == 0:
+                mon._flag(
+                    f"state-machine '{machine_name}': field "
+                    f"{name!r} written outside its declared "
+                    f"transitions "
+                    f"(thread {threading.current_thread().name})")
+            holder_set(obj, name, value)
+
+        holder_cls.__setattr__ = checked_setattr
+
+    def _restore(self) -> None:
+        for cls, name, orig in reversed(self._saved):
+            if orig is _DELETE:
+                delattr(cls, name)
+            else:
+                setattr(cls, name, orig)
+        self._saved.clear()
+
+
+_DELETE = object()
+
+
+def _wrap_writer(orig, tl):
+    def writer(*a, **kw):
+        tl.d = getattr(tl, "d", 0) + 1
+        try:
+            return orig(*a, **kw)
+        finally:
+            tl.d -= 1
+    writer.__name__ = getattr(orig, "__name__", "writer")
+    writer.__mc_wrapped__ = orig
+    return writer

@@ -1,0 +1,601 @@
+"""nebulamc scenarios — the closed registry of concurrency surfaces
+the model checker explores.
+
+Each Scenario drives REAL production classes (constructed through the
+common/mc_hooks seam so their locks and conditions become the
+scheduler's instrumented shims) with a handful of logical threads,
+declares which protocol-registry entries it covers
+(``machine:<name>`` / ``obligation:<name>`` — the mc-coverage lint
+pass proves the union covers every STATE_MACHINES and OBLIGATIONS
+entry), binds the state-machine monitor over the classes it churns,
+and asserts its OBLIGATIONS ``quiescence`` properties once every
+thread has finished.
+
+The registry is CLOSED the same way nebulint's check registry is: the
+six scenarios below are the vocabulary; ``python -m
+nebula_tpu.tools.mc list`` prints it, the CLI rejects unknown names,
+and an OBLIGATIONS/STATE_MACHINES entry no scenario covers is an
+mc-coverage lint error — the registries and the scenarios can only
+move together.
+
+Two surfaces are modeled rather than driven end-to-end:
+
+* mirror-swap uses ``_MirrorSpine``, a reduced model of
+  tpu/runtime.py's generation spine (global lock + per-space build
+  lock + the ``runtime.mirror.capture`` yield point, the same seam
+  names the real runtime constructs through) — the real ``mirror()``
+  needs stores, a schema manager and XLA, none of which belong in an
+  interleaving search.  The mirror-generation machine is bound over
+  the model's generation holder, whose fields and writer names match
+  the declaration exactly.
+* lane-churn drives the REAL ``_LaneLedger`` under a model of the
+  stream's condition/tick choreography (join -> seat, tick -> extract
+  outside the condition -> release + notify), the shape
+  docs/admission.md documents and PR 15's stranded-seat bug broke.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...common import mc_hooks
+from ...common.flags import flags
+from .explore import ExploreResult, explore
+from .machines import Monitor
+from .scheduler import (ExecResult, McError, McViolation, Schedule,
+                        Scheduler)
+
+
+class Scenario:
+    """One registered concurrency surface.
+
+    ``prepare`` runs under the scheduler's CONSTRUCTION claim (the
+    calling thread gets instrumented primitives from the mc_hooks
+    factories, but lock OPERATIONS pass through — there is no
+    concurrency yet), returns the shared context dict.  ``bodies``
+    maps that context to the logical threads.  ``quiesce`` asserts
+    the covered OBLIGATIONS' quiescence properties after a clean
+    execution, raising McViolation.  ``machines`` lists
+    (machine-name, holder-class, writer-class) monitor bindings.
+    """
+
+    def __init__(self, name: str, title: str,
+                 prepare: Callable[[], dict],
+                 bodies: Callable[[dict], List[Tuple[str, Callable]]],
+                 quiesce: Callable[[dict], None],
+                 covers: Tuple[str, ...],
+                 classes: Tuple[str, ...] = (),
+                 machines: Optional[Callable[[], List[Tuple]]] = None,
+                 flag_overrides: Optional[Dict[str, object]] = None,
+                 smoke: Tuple[int, int, float] = (1, 150, 15.0),
+                 full: Tuple[int, int, float] = (2, 4000, 120.0)):
+        self.name = name
+        self.title = title
+        self.prepare = prepare
+        self.bodies = bodies
+        self.quiesce = quiesce
+        self.covers = tuple(covers)
+        self.classes = tuple(classes)
+        self.machines = machines or (lambda: [])
+        self.flag_overrides = dict(flag_overrides or {})
+        self.smoke = smoke   # (max_preemptions, max_execs, max_seconds)
+        self.full = full
+
+
+def run_scenario(scenario: Scenario,
+                 schedule: Optional[Schedule] = None) -> ExecResult:
+    """One deterministic execution of ``scenario`` under
+    ``schedule`` (monitors armed, quiescence checked)."""
+    # import the production modules BEFORE any scheduler is installed:
+    # (a) flag definitions live on their defining modules — set()
+    # silently no-ops on a flag nothing defined yet, and the restore
+    # would leak the override into the rest of the process; (b) module
+    # SINGLETONS built during a construct claim (the process-global
+    # EventJournal) would otherwise be born with shims pinned to one
+    # execution's scheduler and carried into every later run
+    from ...common import events as _ev               # noqa: F401
+    from ...graph import batch_dispatch as _bd        # noqa: F401
+    from ...storage import device as _dev             # noqa: F401
+    saved = {k: flags.get(k) for k in scenario.flag_overrides}
+    for k, v in scenario.flag_overrides.items():
+        if not flags.set(k, v, force=True):
+            raise McError(f"scenario {scenario.name}: flag {k!r} "
+                          f"rejected override {v!r}")
+    mon = Monitor()
+    try:
+        for machine, holder, writer in scenario.machines():
+            mon.bind(machine, holder, writer)
+        sched = Scheduler(schedule, monitors=(mon,))
+        ctx = sched.construct(scenario.prepare)
+        result = sched.run(scenario.bodies(ctx))
+        if result.violation is None and mon.violations:
+            # the raise was swallowed by a production except block;
+            # the recorded message still fails the execution
+            result.violation = McViolation(mon.violations[0],
+                                           kind="state-machine")
+        if result.violation is None:
+            try:
+                scenario.quiesce(ctx)
+            except AssertionError as v:
+                result.violation = v
+        return result
+    finally:
+        mon.unbind_all()
+        for k, v in saved.items():
+            flags.set(k, v, force=True)
+
+
+def explore_scenario(scenario: Scenario, max_preemptions: int,
+                     max_executions: int,
+                     max_seconds: float) -> ExploreResult:
+    return explore(lambda sc: run_scenario(scenario, sc),
+                   max_preemptions=max_preemptions,
+                   max_executions=max_executions,
+                   max_seconds=max_seconds)
+
+
+# ===================================================== prioslots-handoff
+def _prioslots_prepare() -> dict:
+    from ...graph.batch_dispatch import _PrioritySlots
+    return {"slots": _PrioritySlots(1), "order": []}
+
+
+def _prioslots_bodies(ctx) -> List[Tuple[str, Callable]]:
+    slots, order = ctx["slots"], ctx["order"]
+
+    def worker(prio: int, tag: str):
+        def body():
+            slots.acquire(prio)
+            order.append(tag)
+            slots.release()
+        return body
+
+    return [("go1hop", worker(0, "go1hop")),
+            ("go3hop", worker(1, "go3hop")),
+            ("bfs", worker(2, "bfs"))]
+
+
+def _prioslots_quiesce(ctx) -> None:
+    slots = ctx["slots"]
+    if slots._free != 1:
+        raise McViolation(
+            f"pipeline-slot obligation: {1 - slots._free} slot(s) "
+            f"acquired but never released", kind="obligation")
+    if slots._waiters:
+        raise McViolation(
+            f"waiter-heap obligation: abandoned waiter entries "
+            f"{slots._waiters!r}", kind="obligation")
+    if len(ctx["order"]) != 3:
+        raise McViolation(
+            f"only {len(ctx['order'])}/3 acquirers completed "
+            f"(lost slot handoff)", kind="obligation")
+
+
+# ========================================================== lane-churn
+def _lane_prepare() -> dict:
+    from ...graph.batch_dispatch import _LaneLedger
+    return {"cond": mc_hooks.Condition("cont.stream"),
+            "ledger": _LaneLedger(1), "seated": {}, "served": []}
+
+
+def _lane_bodies(ctx) -> List[Tuple[str, Callable]]:
+    cond, ledger = ctx["cond"], ctx["ledger"]
+    seated, served = ctx["seated"], ctx["served"]
+
+    def rider(tag: str):
+        def body():
+            with cond:
+                while ledger.free_count() == 0:
+                    cond.wait()
+                lane = ledger.alloc()
+                seated[lane] = tag
+                cond.notify_all()          # the tick thread may be
+                                           # waiting for riders
+                while seated.get(lane) == tag:
+                    cond.wait()            # seated until extracted
+        return body
+
+    def ticker():
+        while len(served) < 2:
+            with cond:
+                while not seated:
+                    cond.wait()
+                leavers = list(seated.items())
+                for lane, _tag in leavers:
+                    del seated[lane]
+            # the extract/clear device fetch runs OUTSIDE the stream
+            # condition (docs/admission.md) — the window PR 15's
+            # stranded-seat bug lived in
+            mc_hooks.mc_yield("cont.extract", ledger)
+            with cond:
+                for lane, tag in leavers:
+                    ledger.release(lane)
+                    served.append(tag)
+                cond.notify_all()
+
+    return [("rider-a", rider("a")), ("rider-b", rider("b")),
+            ("tick", ticker)]
+
+
+def _lane_quiesce(ctx) -> None:
+    ledger = ctx["ledger"]
+    if ledger.seated_count() != 0 \
+            or ledger.free_count() != ledger.width:
+        raise McViolation(
+            f"lane-seat obligation: {ledger.seated_count()} seat(s) "
+            f"still allocated at quiescence "
+            f"(free {ledger.free_count()}/{ledger.width})",
+            kind="obligation")
+    if ctx["seated"]:
+        raise McViolation(f"seat map not drained: {ctx['seated']!r}",
+                          kind="obligation")
+    if sorted(ctx["served"]) != ["a", "b"]:
+        raise McViolation(
+            f"riders served {ctx['served']!r}, expected both",
+            kind="obligation")
+
+
+# ======================================================= breaker-probe
+def _breaker_prepare() -> dict:
+    from ...common import protocol
+    from ...storage.device import DeviceCircuitBreaker
+    b = DeviceCircuitBreaker()
+    key = (7, "go")
+    # one classified failure at threshold 1 opens the cell;
+    # reset_space zeroes opened_at (the generation-change half-open,
+    # PR 4's seam) so the open clock reads expired under EVERY
+    # schedule — the next admit half-opens deterministically.  (An
+    # explicit tpu_breaker_open_s=0.0 would NOT work: the flag read
+    # is `flags.get(...) or 30.0`, and 0.0 is falsy.)
+    b.record_failure(key, protocol.DEVFAIL_TRANSFER)
+    b.reset_space(key[0])
+    return {"b": b, "key": key, "outcomes": []}
+
+
+def _breaker_bodies(ctx) -> List[Tuple[str, Callable]]:
+    from ...common import protocol
+    b, key, outcomes = ctx["b"], ctx["key"], ctx["outcomes"]
+
+    def probe_unclassified():
+        # a probe that ends WITHOUT exercising the device must hand
+        # the token back (PR 7's leak): release_probe, never reclose
+        if b.admit(key) is None:
+            outcomes.append("probe-released")
+            b.release_probe(key)
+        else:
+            outcomes.append("declined")
+
+    def probe_success():
+        if b.admit(key) is None:
+            outcomes.append("probe-success")
+            b.record_success(key)
+        else:
+            outcomes.append("declined")
+
+    def failer():
+        b.record_failure(key, protocol.DEVFAIL_XLA_RUNTIME)
+
+    return [("probe-u", probe_unclassified),
+            ("probe-s", probe_success), ("failer", failer)]
+
+
+def _breaker_quiesce(ctx) -> None:
+    cell = ctx["b"]._cells.get(ctx["key"])
+    if cell is not None and cell.probing:
+        raise McViolation(
+            "probe-token obligation: a half-open probe token was "
+            "never discharged (cell left probing=True)",
+            kind="obligation")
+    if len(ctx["outcomes"]) != 2:
+        raise McViolation(
+            f"prober outcomes {ctx['outcomes']!r}: a prober never "
+            f"completed", kind="obligation")
+
+
+def _breaker_machines() -> List[Tuple]:
+    from ...storage.device import DeviceCircuitBreaker, _BreakerCell
+    return [("breaker-cell", _BreakerCell, DeviceCircuitBreaker)]
+
+
+# ========================================================= mirror-swap
+class _Generation:
+    """Holder for the mirror-generation machine's fields — the model
+    counterpart of tpu/csr.py's CsrMirror, field-for-field what
+    common/protocol.py declares."""
+
+    def __init__(self):
+        self.generation = 0
+        self._fresh_version = -1
+        self._delta_cursors: Dict[int, int] = {}
+        self._absorb_declined_ver = -1
+        self._part_sig: Tuple[int, ...] = ()
+
+
+class _MirrorSpine:
+    """Reduced model of tpu/runtime.py's generation spine: the global
+    runtime lock, the per-space build lock (both through the mc_hooks
+    seam, same names the real runtime constructs), the
+    ``runtime.mirror.capture`` yield point, and the async-rebuild
+    marker discipline.  ``_publish`` is the machine's declared writer;
+    captures assert generation monotonicity — the invariant in-flight
+    dispatches lean on (docs/durability.md)."""
+
+    def __init__(self):
+        self._lock = mc_hooks.Lock("runtime.global")
+        self._build_lock = mc_hooks.Lock("tpu.build")
+        self.mirror: Optional[_Generation] = None
+        self.version = 0
+        self._rebuilding: set = set()
+
+    def bump(self) -> None:
+        """A write lands: the store version advances."""
+        with self._lock:
+            self.version += 1
+
+    def capture(self) -> _Generation:
+        """The dispatch-side mirror() shape: lock-free-ish capture
+        with a locked re-read, build outside the global lock."""
+        mc_hooks.mc_yield("runtime.mirror.capture", self)
+        with self._lock:
+            m = self.mirror
+            if m is not None and m._fresh_version == self.version:
+                return m
+        with self._build_lock:
+            with self._lock:
+                m = self.mirror
+                if m is not None \
+                        and m._fresh_version == self.version:
+                    return m             # built while we waited
+                ver = self.version
+            built = _Generation()        # the scan, outside the lock
+            with self._lock:
+                return self._publish(built, ver)
+
+    def refresh_async(self) -> None:
+        """The async-rebuild marker discipline around a stale mirror
+        (tpu/runtime.py mirror(), obligation rebuild-marker)."""
+        with self._lock:
+            stale = (self.mirror is not None
+                     and self.mirror._fresh_version != self.version)
+            if not stale or 0 in self._rebuilding:
+                return
+            self._rebuilding.add(0)
+        try:
+            self.capture()
+        finally:
+            with self._lock:
+                self._rebuilding.discard(0)
+
+    def _publish(self, m: _Generation, ver: int) -> _Generation:
+        """Declared mirror-generation writer (caller holds _lock)."""
+        m._fresh_version = ver
+        m._delta_cursors = {0: ver}
+        m._part_sig = (1,)
+        prev = self.mirror
+        m.generation = (prev.generation if prev is not None else 0) + 1
+        self.mirror = m
+        return m
+
+
+def _mirror_prepare() -> dict:
+    spine = _MirrorSpine()
+    spine.capture()                      # generation 1 pre-published
+    return {"spine": spine, "captured": []}
+
+
+def _mirror_bodies(ctx) -> List[Tuple[str, Callable]]:
+    spine, captured = ctx["spine"], ctx["captured"]
+
+    def writer():
+        spine.bump()
+        spine.bump()
+
+    def reader():
+        g1 = spine.capture()
+        g2 = spine.capture()
+        captured.append((g1.generation, g2.generation))
+        if g2.generation < g1.generation:
+            raise McViolation(
+                f"mirror generation regressed: captured "
+                f"{g1.generation} then {g2.generation}",
+                kind="invariant")
+
+    def rebuilder():
+        spine.refresh_async()
+
+    return [("writer", writer), ("reader", reader),
+            ("rebuilder", rebuilder)]
+
+
+def _mirror_quiesce(ctx) -> None:
+    spine = ctx["spine"]
+    if spine._rebuilding:
+        raise McViolation(
+            f"rebuild-marker obligation: markers {spine._rebuilding!r} "
+            f"never discarded at quiescence", kind="obligation")
+    if spine.mirror is None or spine.mirror.generation < 1:
+        raise McViolation("no published generation at quiescence",
+                          kind="invariant")
+    if spine.mirror._fresh_version > spine.version:
+        raise McViolation(
+            f"published freshness {spine.mirror._fresh_version} ahead "
+            f"of the store version {spine.version}", kind="invariant")
+
+
+def _mirror_machines() -> List[Tuple]:
+    return [("mirror-generation", _Generation, _MirrorSpine)]
+
+
+# ====================================================== journal-cursor
+def _journal_prepare() -> dict:
+    from ...common.events import EventJournal
+    return {"j": EventJournal(), "seen": []}
+
+
+def _journal_bodies(ctx) -> List[Tuple[str, Callable]]:
+    j, seen = ctx["j"], ctx["seen"]
+
+    def recorder(tag: str):
+        def body():
+            for i in range(2):
+                j.record("query.slow", detail=f"{tag}{i}")
+        return body
+
+    def reader():
+        cursor = 0
+        for _ in range(3):
+            evs, nxt = j.since(cursor, limit=2)
+            if nxt < cursor:
+                raise McViolation(
+                    f"journal cursor regressed {cursor} -> {nxt}",
+                    kind="invariant")
+            for e in evs:
+                if e["seq"] <= cursor:
+                    raise McViolation(
+                        f"event seq {e['seq']} re-delivered at cursor "
+                        f"{cursor}", kind="invariant")
+                seen.append(e["seq"])
+            cursor = nxt
+            mc_hooks.mc_yield("journal.reader", j)
+
+    return [("rec-a", recorder("a")), ("rec-b", recorder("b")),
+            ("reader", reader)]
+
+
+def _journal_quiesce(ctx) -> None:
+    j, seen = ctx["j"], ctx["seen"]
+    if j._seq != 4 or len(j._entries) != 4:
+        raise McViolation(
+            f"journal advanced to seq {j._seq} with "
+            f"{len(j._entries)} entries; expected 4/4 (lost or "
+            f"double-counted record)", kind="invariant")
+    if seen != sorted(seen) or len(seen) != len(set(seen)):
+        raise McViolation(
+            f"cursor delivered out of order or twice: {seen!r}",
+            kind="invariant")
+
+
+def _journal_machines() -> List[Tuple]:
+    from ...common.events import EventJournal
+    return [("journal-cursor", EventJournal, EventJournal)]
+
+
+# =================================================== dispatch-admission
+class _ProbeRuntime:
+    """Minimal runtime for the windowed dispatcher: one batched entry
+    point echoing payloads (no continuous_session attribute, so the
+    dispatcher stays windowed-only)."""
+
+    def mc_probe(self, space_id, payloads):
+        return list(payloads), None
+
+
+def _dispatch_prepare() -> dict:
+    from ...graph.batch_dispatch import GoBatchDispatcher
+    disp = GoBatchDispatcher(_ProbeRuntime())
+    return {"disp": disp, "key": ("mc_probe", 0),
+            "results": [], "sheds": []}
+
+
+def _dispatch_bodies(ctx) -> List[Tuple[str, Callable]]:
+    from ...graph.batch_dispatch import AdmissionShed
+    disp, key = ctx["disp"], ctx["key"]
+    results, sheds = ctx["results"], ctx["sheds"]
+
+    def submitter(i: int):
+        def body():
+            try:
+                res, _mirror = disp.submit_batched(key, i)
+                results.append((i, res))
+            except AdmissionShed:
+                sheds.append(i)
+        return body
+
+    return [(f"submit-{i}", submitter(i)) for i in range(3)]
+
+
+def _dispatch_quiesce(ctx) -> None:
+    disp, key = ctx["disp"], ctx["key"]
+    st = disp._keys.get(key)
+    if st is not None and (st.queue or st.dispatching):
+        raise McViolation(
+            f"dispatch key not quiescent: queue={len(st.queue)} "
+            f"dispatching={st.dispatching}", kind="obligation")
+    if disp._inflight._free != 1 or disp._inflight._waiters:
+        raise McViolation(
+            f"pipeline-slot obligation: free={disp._inflight._free} "
+            f"waiters={disp._inflight._waiters!r} at quiescence",
+            kind="obligation")
+    if disp.meter._active != 0:
+        raise McViolation(
+            f"busy-meter obligation: active={disp.meter._active} "
+            f"begin(s) never end()ed", kind="obligation")
+    served = len(ctx["results"]) + len(ctx["sheds"])
+    if served != 3:
+        raise McViolation(
+            f"{served}/3 submitters completed", kind="obligation")
+    for i, res in ctx["results"]:
+        if res != i:
+            raise McViolation(
+                f"submitter {i} got {res!r} (cross-wired batch "
+                f"result)", kind="invariant")
+
+
+SCENARIOS: Dict[str, Scenario] = {s.name: s for s in (
+    Scenario(
+        name="prioslots-handoff",
+        title="_PrioritySlots priority handoff and waiter-heap churn",
+        prepare=_prioslots_prepare, bodies=_prioslots_bodies,
+        quiesce=_prioslots_quiesce,
+        covers=("obligation:pipeline-slot", "obligation:waiter-heap"),
+        classes=("nebula_tpu.graph.batch_dispatch._PrioritySlots",),
+    ),
+    Scenario(
+        name="lane-churn",
+        title="_LaneLedger join/leave churn under the stream condition",
+        prepare=_lane_prepare, bodies=_lane_bodies,
+        quiesce=_lane_quiesce,
+        covers=("obligation:lane-seat",),
+        classes=("nebula_tpu.graph.batch_dispatch._LaneLedger",),
+    ),
+    Scenario(
+        name="breaker-probe",
+        title="DeviceCircuitBreaker half-open probe hand-back races",
+        prepare=_breaker_prepare, bodies=_breaker_bodies,
+        quiesce=_breaker_quiesce, machines=_breaker_machines,
+        covers=("machine:breaker-cell", "obligation:probe-token"),
+        classes=("nebula_tpu.storage.device.DeviceCircuitBreaker",),
+        flag_overrides={"tpu_breaker_failures": 1},
+    ),
+    Scenario(
+        name="mirror-swap",
+        title="mirror generation publish vs in-flight capture",
+        prepare=_mirror_prepare, bodies=_mirror_bodies,
+        quiesce=_mirror_quiesce, machines=_mirror_machines,
+        covers=("machine:mirror-generation",
+                "obligation:rebuild-marker"),
+    ),
+    Scenario(
+        name="journal-cursor",
+        title="EventJournal record vs since() cursor advance",
+        prepare=_journal_prepare, bodies=_journal_bodies,
+        quiesce=_journal_quiesce, machines=_journal_machines,
+        covers=("machine:journal-cursor",),
+        classes=("nebula_tpu.common.events.EventJournal",),
+    ),
+    Scenario(
+        name="dispatch-admission",
+        title="windowed dispatcher admission, leader election and shed",
+        prepare=_dispatch_prepare, bodies=_dispatch_bodies,
+        quiesce=_dispatch_quiesce,
+        covers=("obligation:pipeline-slot", "obligation:busy-meter",
+                "obligation:waiter-heap"),
+        classes=("nebula_tpu.graph.batch_dispatch.GoBatchDispatcher",),
+        flag_overrides={"admission_control": True,
+                        "admission_queue_max": 2,
+                        "go_batch_inflight": 1,
+                        "go_batch_window_ms": 0,
+                        "go_batch_max": 1024},
+        smoke=(1, 80, 25.0),
+        full=(2, 1500, 180.0),
+    ),
+)}

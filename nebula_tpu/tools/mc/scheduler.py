@@ -1,0 +1,630 @@
+"""nebulamc cooperative scheduler — deterministic execution of N
+logical threads over the production code's real sync seams.
+
+The design is CHESS-style stateless model checking: the scenario code
+runs for real (actual locks are NOT held — the shims below replace
+them entirely), but every synchronization operation first ANNOUNCES
+itself to the scheduler and parks until GRANTED.  At each step exactly
+one logical thread is runnable; which one is decided by a
+``Schedule`` — either a recorded prefix being replayed or the
+explorer's default policy (lowest-index enabled thread).  Two runs
+with the same schedule are bit-identical, which is what makes a
+failure's schedule id replayable (``python -m nebula_tpu.tools.mc
+replay --schedule=...``).
+
+Mechanics
+---------
+Each logical thread is a real Python thread with a pair of
+``threading.Event`` gates (``gate`` lets it run, ``parked`` tells the
+scheduler it stopped).  The scheduler and at most ONE logical thread
+are ever unparked at a time, so shared scheduler state needs no
+locking of its own.  A thread that wants to perform op X calls
+``_announce(op)``: it publishes the op, parks, and runs X's commit
+only after the scheduler hands control back.  The scheduler's step
+loop:
+
+  1. compute the ENABLED set (announced op can commit now: a lock
+     acquire is enabled iff the lock is free or reentrantly owned;
+     a condition wait is always enabled — committing it BLOCKS the
+     thread until a notify; a thread parked in a wait is disabled
+     until notified, then re-enabled wanting the lock back),
+  2. ask the schedule to pick one (replay prefix first, then default),
+  3. grant that thread one step; wait for it to park again.
+
+No enabled thread + live threads = deadlock (reported with every
+thread's announced op).  Threads parked in a TIMED wait escape
+deterministically: when nothing else is enabled the scheduler wakes
+the lowest-index timed waiter as a spurious timeout (capped per run so
+a livelock cannot spin forever).  Aborts unwind via ``_McStop``
+(a BaseException: production cleanup blocks catching ``Exception``
+don't swallow it; ``except BaseException`` re-raise blocks in the
+dispatcher do — _announce re-raises on every subsequent op, so the
+unwind always makes it out).
+
+Threads NOT claimed by the runtime (``applies()`` is False — e.g. the
+pytest main thread building a scenario's fixture objects) pass
+through: shim constructors hand back real primitives and shim ops
+degrade to plain bookkeeping, so scenario ``prepare()`` can construct
+production objects before exploration starts.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Upper bound on spurious timeout wakes granted per execution; a
+# scenario whose threads ping-pong on wait(timeout) forever is a bug
+# we want reported as a deadlock, not an endless run.
+MAX_TIMEOUT_WAKES = 64
+
+# Hard step ceiling per execution: a runaway scenario (livelock under
+# some interleaving) terminates with a diagnosable McError instead of
+# hanging the test suite.
+MAX_STEPS = 20_000
+
+
+class McError(RuntimeError):
+    """Scheduler-level failure: deadlock, step overrun, misuse of a
+    shim (releasing a lock the thread doesn't hold, ...)."""
+
+    def __init__(self, msg: str, kind: str = "error"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+class McViolation(AssertionError):
+    """A property failure in the EXPLORED CODE: a state-machine write
+    outside its declared transitions, an undischarged obligation at
+    quiescence, or a scenario's own invariant assertion."""
+
+    def __init__(self, msg: str, kind: str = "violation"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+class _McStop(BaseException):
+    """Raised inside logical threads to unwind them when a run aborts
+    (violation found / budget exhausted).  BaseException so production
+    ``except Exception`` cleanup can't swallow it; _announce re-raises
+    it on every subsequent sync op so even ``except BaseException``
+    re-raise blocks eventually unwind."""
+
+
+class Op:
+    """One announced synchronization operation."""
+
+    __slots__ = ("kind", "target", "note")
+
+    def __init__(self, kind: str, target: Optional[object] = None,
+                 note: str = ""):
+        self.kind = kind        # acquire/release/wait/notify/yield/...
+        self.target = target    # McLock / McCondition / None
+        self.note = note
+
+    def resources(self) -> frozenset:
+        """The footprint this op (and the code slice it unblocks, up
+        to the thread's next announce) may touch.  Two ops are
+        DEPENDENT (order matters; sleep sets must not prune across
+        them) iff their footprints may overlap.  Lock/condition ops on
+        DISTINCT locks commute — their slices run under their
+        respective locks — so they get their lock identity; everything
+        else (yield points most importantly: they mark LOCK-FREE reads
+        of shared state) is conservatively dependent with everything,
+        encoded as the wildcard ``"*"`` (see explore._dependent)."""
+        if self.kind in ("acquire", "release"):
+            return frozenset((id(self.target),))
+        if self.kind in ("wait", "notify"):
+            return frozenset((id(self.target.lock),))
+        return frozenset(("*",))
+
+    def __repr__(self):
+        t = getattr(self.target, "name", None)
+        return f"{self.kind}({t or self.note})"
+
+
+class Schedule:
+    """A replayable sequence of choices.  Each entry is the INDEX INTO
+    THE SORTED ENABLED SET at that step (not a thread id) — compact,
+    and any prefix of a valid schedule is valid."""
+
+    def __init__(self, choices: Sequence[int] = ()):
+        self.choices: List[int] = list(choices)
+
+    def __len__(self):
+        return len(self.choices)
+
+
+class _Logical:
+    """One logical thread: the real thread + its scheduler-side
+    state."""
+
+    def __init__(self, idx: int, name: str, fn: Callable[[], None],
+                 sched: "Scheduler"):
+        self.idx = idx
+        self.name = name
+        self.gate = threading.Event()     # set => thread may run
+        self.parked = threading.Event()   # set => thread is stopped
+        self.op: Optional[Op] = None      # announced, uncommitted op
+        self.waiting_on = None            # McCondition it is parked in
+        self.wait_timed = False           # that wait had a timeout
+        self.pending_reacquire = None     # notified; wants lock back
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.timed_out = False            # scheduler granted a timeout
+        self._sched = sched
+        self.thread = threading.Thread(
+            target=self._run, args=(fn,), name=f"mc-{name}", daemon=True)
+
+    def _run(self, fn: Callable[[], None]) -> None:
+        self.gate.wait()
+        self.gate.clear()
+        try:
+            if not self._sched._aborting:
+                fn()
+        except _McStop:
+            pass
+        except BaseException as e:       # surfaced as the run's result
+            self.error = e
+        finally:
+            self.done = True
+            self.parked.set()
+
+
+def _live_sched() -> Optional["Scheduler"]:
+    """The scheduler shim OPERATIONS route to: the one currently
+    installed in mc_hooks, NOT the shim's birth scheduler.  A shim can
+    outlive its run — module singletons (the process-global
+    EventJournal) built while a construct claim had the factories
+    installed keep their shims forever — and OS thread idents get
+    reused across executions, so routing by the birth scheduler can
+    land a fresh logical thread in a DEAD run whose reap flag silently
+    unwinds it mid-body.  Routing by the active scheduler makes a
+    stale shim either join the current run (calling thread claimed) or
+    pass through; birth-run state is cleared by that run's _reap."""
+    from ...common import mc_hooks
+    act = mc_hooks.active()
+    return act if isinstance(act, Scheduler) else None
+
+
+class McLock:
+    """Instrumented mutex.  Holds NO real lock — mutual exclusion is
+    enforced by the scheduler's enabled-set computation, so 'holding'
+    it is pure bookkeeping and any interleaving can be forced."""
+
+    __slots__ = ("name", "reentrant", "sched", "owner", "depth")
+
+    def __init__(self, name: str, sched: "Scheduler",
+                 reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self.sched = sched
+        self.owner: Optional[_Logical] = None
+        self.depth = 0
+
+    # -- production Lock/OrderedLock surface ---------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        sched = _live_sched()
+        me = sched._me() if sched is not None else None
+        if me is None:                    # unclaimed thread passthrough
+            return True
+        sched._announce(me, Op("acquire", self))
+        # granted => enabled => free or reentrant-owned
+        if self.owner is me:
+            self.depth += 1
+        else:
+            self.owner = me
+            self.depth = 1
+        return True
+
+    def release(self):
+        sched = _live_sched()
+        me = sched._me() if sched is not None else None
+        if me is None:
+            return
+        if self.owner is not me:
+            raise McError(f"{me.name} releasing {self.name} "
+                          f"owned by "
+                          f"{self.owner.name if self.owner else 'nobody'}",
+                          kind="lock-misuse")
+        sched._announce(me, Op("release", self))
+        self.depth -= 1
+        if self.depth == 0:
+            self.owner = None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self.owner is not None
+
+
+class McCondition:
+    """Instrumented condition variable over an McLock.  FIFO waiter
+    list; notify moves waiters to ``pending_reacquire`` (they re-enter
+    the enabled set wanting the lock back — the classic two-phase wake
+    where missed-wakeup bugs live)."""
+
+    __slots__ = ("name", "lock", "sched", "waiters")
+
+    def __init__(self, name: str, sched: "Scheduler",
+                 lock: Optional[McLock] = None):
+        self.name = name
+        self.sched = sched
+        self.lock = lock if lock is not None \
+            else McLock(name + ".lock", sched)
+        self.waiters: List[_Logical] = []
+
+    # -- production threading.Condition surface ------------------------
+    def acquire(self, *a, **kw):
+        return self.lock.acquire(*a, **kw)
+
+    def release(self):
+        self.lock.release()
+
+    def __enter__(self):
+        self.lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.lock.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        sched = _live_sched()
+        me = sched._me() if sched is not None else None
+        if me is None:
+            return True
+        if self.lock.owner is not me:
+            raise McError(f"{me.name} wait on {self.name} without "
+                          f"holding its lock", kind="lock-misuse")
+        me.wait_timed = timeout is not None
+        me.timed_out = False
+        # committing the wait releases the lock and parks the thread in
+        # the waiter list; the announce returns only when this thread
+        # has been notified (or timeout-woken) AND rescheduled AND
+        # reacquired the lock
+        sched._announce(me, Op("wait", self))
+        return not me.timed_out
+
+    def notify(self, n: int = 1) -> None:
+        sched = _live_sched()
+        me = sched._me() if sched is not None else None
+        if me is None:
+            return
+        if self.lock.owner is not me:
+            raise McError(f"{me.name} notify on {self.name} without "
+                          f"holding its lock", kind="lock-misuse")
+        sched._announce(me, Op("notify", self))
+        for _ in range(min(n, len(self.waiters))):
+            w = self.waiters.pop(0)
+            w.waiting_on = None
+            w.pending_reacquire = self.lock
+
+    def notify_all(self) -> None:
+        self.notify(n=len(self.waiters) + 1_000_000)
+
+
+class Scheduler:
+    """One deterministic execution.  Use:
+
+        sched = Scheduler(schedule)
+        result = sched.run([(name, fn), ...])
+
+    ``run`` installs the scheduler into common/mc_hooks, starts the
+    logical threads, drives the step loop to completion (all threads
+    done) or failure, uninstalls, and returns an ``ExecResult``.
+    """
+
+    def __init__(self, schedule: Optional[Schedule] = None,
+                 monitors: Sequence[object] = ()):
+        self.schedule = schedule or Schedule()
+        self.monitors = list(monitors)   # machines.Monitor instances
+        self.threads: List[_Logical] = []
+        self._by_thread: Dict[int, _Logical] = {}
+        self.trace: List[Tuple[str, str]] = []   # (thread, op repr)
+        # per-step exploration record for the explorer: at each step,
+        # the sorted enabled thread indices, the chosen position, and
+        # each enabled candidate's announced-op resource tuple
+        self.steps: List[Tuple[Tuple[int, ...], int,
+                               Tuple[Tuple[object, ...], ...]]] = []
+        self.timeout_wakes = 0
+        self._aborting = False
+        self._construct_ident: Optional[int] = None
+        self.violation: Optional[BaseException] = None
+        self.divergence = False   # replay prefix no longer applicable
+        # every shim this run announced against — long-lived shims
+        # (module singletons) must not carry THIS run's bookkeeping
+        # (owners, waiter entries) into the next execution
+        self._touched: set = set()
+
+    # ------------------------------------------------- mc_hooks runtime
+    def applies(self) -> bool:
+        ident = threading.get_ident()
+        return ident in self._by_thread \
+            or ident == self._construct_ident
+
+    def construct(self, fn: Callable[[], object]) -> object:
+        """Run scenario setup with the CALLING thread claimed for
+        CONSTRUCTION only: the mc_hooks factories hand back
+        instrumented primitives (so shared objects built here carry
+        shims into exploration), but lock OPERATIONS pass through as
+        no-ops — there is no concurrency yet, and the construction
+        thread is never a logical thread (``_me()`` returns None for
+        it)."""
+        from ...common import mc_hooks
+        prev = mc_hooks.active()
+        self._construct_ident = threading.get_ident()
+        mc_hooks.install(self)
+        try:
+            return fn()
+        finally:
+            self._construct_ident = None
+            if prev is not None:
+                mc_hooks.install(prev)
+            else:
+                mc_hooks.uninstall()
+
+    def new_lock(self, name: str, reentrant: bool = False) -> McLock:
+        return McLock(name, self, reentrant=reentrant)
+
+    def new_condition(self, name: str, lock=None) -> McCondition:
+        mlock = lock if isinstance(lock, McLock) else None
+        return McCondition(name, self, mlock)
+
+    def yield_point(self, note: str, obj=None) -> None:
+        me = self._me()
+        if me is None:
+            return
+        self._announce(me, Op("yield", None, note))
+
+    # --------------------------------------------------------- plumbing
+    def _me(self) -> Optional[_Logical]:
+        return self._by_thread.get(threading.get_ident())
+
+    def _announce(self, me: _Logical, op: Op) -> None:
+        """Publish ``op`` and park until the scheduler grants it.  On
+        return the op is COMMITTED (for a wait: woken AND the lock
+        reacquired)."""
+        if self._aborting:
+            raise _McStop()
+        if op.target is not None:
+            self._touched.add(op.target)
+            if isinstance(op.target, McCondition):
+                self._touched.add(op.target.lock)
+        me.op = op
+        me.parked.set()                   # hand control to scheduler
+        me.gate.wait()                    # ... until granted
+        me.gate.clear()
+        if self._aborting:
+            raise _McStop()
+
+    def _grant(self, t: _Logical) -> None:
+        """Let thread t run one step; wait for it to park again."""
+        t.parked.clear()
+        t.gate.set()
+        t.parked.wait()
+
+    # ------------------------------------------------------ enabled set
+    def _enabled(self) -> List[_Logical]:
+        out = []
+        for t in self.threads:
+            if t.done or t.waiting_on is not None:
+                continue
+            if t.pending_reacquire is not None:
+                if t.pending_reacquire.owner is None:
+                    out.append(t)
+                continue
+            op = t.op
+            if op is None:
+                continue
+            if op.kind == "acquire":
+                lk: McLock = op.target
+                if lk.owner is None or (lk.reentrant and lk.owner is t):
+                    out.append(t)
+            else:
+                out.append(t)
+        return out
+
+    def _commit(self, t: _Logical) -> None:
+        """Apply the scheduler-side effect of t's announced op, then
+        grant t the step.  Most effects live in the shim after its
+        announce returns; waits and reacquires are handled here
+        because they change PARKING state."""
+        if t.pending_reacquire is not None:
+            lk = t.pending_reacquire
+            t.pending_reacquire = None
+            lk.owner = t
+            lk.depth = 1
+            self.trace.append((t.name, f"reacquire({lk.name})"))
+            self._grant(t)
+            return
+        op = t.op
+        t.op = None
+        self.trace.append((t.name, repr(op)))
+        if op.kind == "wait":
+            cond: McCondition = op.target
+            # release the lock, join the waiter list, park.  The
+            # thread does NOT run — its announce stays blocked until a
+            # notify (or timeout wake) re-enables it and a later step
+            # grants the reacquire.
+            cond.lock.depth = 0
+            cond.lock.owner = None
+            cond.waiters.append(t)
+            t.waiting_on = cond
+            return
+        self._grant(t)
+
+    # -------------------------------------------------------- main loop
+    def run(self, bodies: Sequence[Tuple[str, Callable[[], None]]]
+            ) -> "ExecResult":
+        from ...common import mc_hooks
+        for i, (name, fn) in enumerate(bodies):
+            t = _Logical(i, name, fn, self)
+            self.threads.append(t)
+        prev = mc_hooks.active()
+        mc_hooks.install(self)
+        try:
+            for t in self.threads:
+                t.thread.start()
+                self._by_thread[t.thread.ident] = t
+                # first announce: let the thread run to its first op
+                self._grant(t)
+            self._loop()
+        finally:
+            mc_hooks.install(prev) if prev is not None \
+                else mc_hooks.uninstall()
+            self._reap()
+        return self._result()
+
+    def _loop(self) -> None:
+        step = 0
+        while True:
+            if all(t.done for t in self.threads):
+                return
+            for t in self.threads:
+                if t.error is not None and self.violation is None:
+                    self.violation = t.error
+                    self._abort()
+                    return
+            enabled = self._enabled()
+            if not enabled:
+                if not self._timeout_wake():
+                    self._deadlock()
+                    return
+                continue
+            step += 1
+            if step > MAX_STEPS:
+                self.violation = McError(
+                    f"execution exceeded {MAX_STEPS} steps "
+                    f"(livelock?)", kind="step-overrun")
+                self._abort()
+                return
+            enabled.sort(key=lambda t: t.idx)
+            pos = self._choose(len(enabled))
+            if pos is None:               # replay prefix diverged
+                self.divergence = True
+                pos = 0
+            chosen = enabled[pos]
+            self.steps.append((
+                tuple(t.idx for t in enabled), pos,
+                tuple(self._op_resources(t) for t in enabled)))
+            self._commit(chosen)
+
+    def _op_resources(self, t: _Logical) -> frozenset:
+        if t.pending_reacquire is not None:
+            return frozenset((id(t.pending_reacquire),))
+        if t.op is not None:
+            return t.op.resources()
+        return frozenset(("*",))
+
+    def _choose(self, n: int) -> Optional[int]:
+        k = len(self.steps)
+        if k < len(self.schedule):
+            pos = self.schedule.choices[k]
+            if pos >= n:
+                return None               # divergence
+            return pos
+        return 0                          # default: lowest index
+
+    def _timeout_wake(self) -> bool:
+        """Spuriously wake the lowest-index TIMED waiter (models the
+        timeout firing).  Deterministic, and capped."""
+        if self.timeout_wakes >= MAX_TIMEOUT_WAKES:
+            return False
+        for t in self.threads:
+            if t.waiting_on is not None and t.wait_timed:
+                cond = t.waiting_on
+                if t in cond.waiters:
+                    cond.waiters.remove(t)
+                t.waiting_on = None
+                t.timed_out = True
+                t.pending_reacquire = cond.lock
+                self.timeout_wakes += 1
+                return True
+        return False
+
+    def _deadlock(self) -> None:
+        lines = []
+        for t in self.threads:
+            if t.done:
+                continue
+            if t.waiting_on is not None:
+                what = f"waiting on {t.waiting_on.name} (untimed)"
+            elif t.pending_reacquire is not None:
+                what = (f"notified, blocked reacquiring "
+                        f"{t.pending_reacquire.name}")
+            elif t.op is not None:
+                what = f"blocked at {t.op!r}"
+            else:
+                what = "not yet announced"
+            lines.append(f"  {t.name}: {what}")
+        self.violation = McError(
+            "deadlock: no logical thread is enabled\n"
+            + "\n".join(lines), kind="deadlock")
+        self._abort()
+
+    def _abort(self) -> None:
+        self._aborting = True
+        self._reap()
+
+    def _reap(self) -> None:
+        """Unwind every live thread: wake them all (announce raises
+        _McStop), drain waiters, join."""
+        self._aborting = True
+        for t in self.threads:
+            t.waiting_on = None
+            t.pending_reacquire = None
+            t.gate.set()
+        for t in self.threads:
+            if t.thread.is_alive():
+                t.thread.join(timeout=5.0)
+                if t.thread.is_alive():   # pragma: no cover
+                    raise McError(f"logical thread {t.name} failed to "
+                                  f"unwind", kind="stuck-thread")
+        # scrub THIS run's bookkeeping off every shim it touched: a
+        # shim living past the run (module singleton, cached
+        # dispatcher) must present clean state to the next execution
+        mine = set(self.threads)
+        for obj in self._touched:
+            if isinstance(obj, McCondition):
+                obj.waiters = [w for w in obj.waiters
+                               if w not in mine]
+            elif isinstance(obj, McLock) and obj.owner in mine:
+                obj.owner = None
+                obj.depth = 0
+
+    def _result(self) -> "ExecResult":
+        return ExecResult(
+            steps=tuple(self.steps),
+            trace=tuple(self.trace),
+            violation=self.violation,
+            divergence=self.divergence,
+            errors=tuple(t.error for t in self.threads),
+        )
+
+
+class ExecResult:
+    """Outcome of one deterministic execution."""
+
+    __slots__ = ("steps", "trace", "violation", "divergence", "errors")
+
+    def __init__(self, steps, trace, violation, divergence, errors):
+        self.steps = steps          # ((enabled idxs), chosen pos,
+                                    #  (resources per candidate)) each
+        self.trace = trace
+        self.violation = violation
+        self.divergence = divergence
+        self.errors = errors
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+    @property
+    def choices(self) -> Tuple[int, ...]:
+        return tuple(s[1] for s in self.steps)

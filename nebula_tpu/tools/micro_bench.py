@@ -719,10 +719,10 @@ def bench_kernel_roofline(reps: int,
 
 
 def bench_lint(budget_s: float) -> dict:
-    """Wall time of the whole-package nebulint run (all eighteen
+    """Wall time of the whole-package nebulint run (all nineteen
     checks — the jaxpr tracing of every registered kernel bucket, the
-    v4 mesh traces at 2/4/8-way AND the v5 obligation/protocol flow
-    passes included).  The analysis gates tier-1, so
+    v4 mesh traces at 2/4/8-way, the v5 obligation/protocol flow
+    passes AND the v6 mc-coverage pass included).  The analysis gates tier-1, so
     it must stay interactive: exceeding ``budget_s`` is reported as a
     guard failure in the result (and main() exits non-zero on it).
     Both cache states are timed — the cold number is what a fresh
@@ -749,6 +749,36 @@ def bench_lint(budget_s: float) -> dict:
             "within_budget": cold <= budget_s}
 
 
+def bench_mc(budget_s: float) -> dict:
+    """Wall time of the nebulamc tier-1 smoke: every registered
+    scenario explored at its SMOKE budget (small preemption bound,
+    capped executions), exactly what tests/test_mc.py gates tier-1
+    with.  Budget-guarded like bench_lint — the model checker rides
+    the fast test lane, so the whole smoke sweep must stay
+    interactive; the exhaustive full-budget sweep lives in the chaos
+    lane (scripts/chaos.sh) and is deliberately NOT timed here.  The
+    per-scenario execution counts make exploration regressions (a
+    seam change blowing up the interleaving space) visible before
+    they slow tier-1 down."""
+    from .mc import SCENARIOS, explore_scenario
+    t0 = time.perf_counter()
+    per = {}
+    clean = True
+    for name in sorted(SCENARIOS):
+        s = SCENARIOS[name]
+        r = explore_scenario(s, *s.smoke)
+        per[name] = {"executions": r.executions,
+                     "exhausted": r.exhausted,
+                     "seconds": round(r.seconds, 2)}
+        clean = clean and r.violation is None
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 2),
+            "budget_s": budget_s,
+            "scenarios": per,
+            "clean": clean,
+            "within_budget": clean and wall <= budget_s}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -765,6 +795,15 @@ def main(argv=None) -> int:
                          "via the content-hash cache (the two v5 "
                          "passes are pure AST, <0.5 s combined); "
                          "tests/test_lint.py backstops at 60 s)")
+    ap.add_argument("--mc-budget-s", type=float, default=30.0,
+                    help="fail when the nebulamc smoke sweep (every "
+                         "registered scenario at its tier-1 budget) "
+                         "exceeds this wall time — the round-19 "
+                         "model-checking layer gates tier-1 through "
+                         "tests/test_mc.py, so the smoke bounds must "
+                         "stay interactive (currently ~2 s for six "
+                         "scenarios; the exhaustive sweep lives in "
+                         "scripts/chaos.sh)")
     args = ap.parse_args(argv)
     reps = 50 if args.quick else 400
     rows = 20_000 if args.quick else 200_000
@@ -785,9 +824,11 @@ def main(argv=None) -> int:
         "continuous_path": bench_continuous_path(reps),
         "kernel_roofline": bench_kernel_roofline(reps),
         "lint": bench_lint(args.lint_budget_s),
+        "mc_path": bench_mc(args.mc_budget_s),
     }
     print(json.dumps(out))
     ok = out["lint"]["within_budget"] \
+        and out["mc_path"]["within_budget"] \
         and out["metrics_path"]["within_budget"] \
         and out["admission_path"]["within_budget"] \
         and out["slo_path"]["within_budget"] \

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import deadline as deadlines
+from ..common import mc_hooks
 from ..common import protocol
 from ..common import tracing
 from ..common.deadline import DeadlineExceeded
@@ -678,6 +679,11 @@ class TpuQueryRuntime:
         # would mark a mirror missing that write as fresh forever
         vers = self._store_versions(space_id, stores)
         ver = self._space_version(space_id, stores, vers)
+        # scheduling point for nebulamc's mirror-swap scenario: a
+        # publish may land between the version capture above and the
+        # generation capture below — the explorer proves an in-flight
+        # dispatch keeps a coherent (older) generation either way
+        mc_hooks.mc_yield("runtime.mirror.capture", self)
         with self._lock:
             m = self.mirrors.get(space_id)
             if m is not None \
@@ -754,7 +760,10 @@ class TpuQueryRuntime:
         with self._lock:
             lk = self._build_locks.get(space_id)
             if lk is None:
-                lk = self._build_locks[space_id] = threading.Lock()
+                # seam-constructed (common/mc_hooks.py): nebulamc's
+                # mirror-swap scenario substitutes an instrumented lock
+                lk = self._build_locks[space_id] = \
+                    mc_hooks.Lock("tpu.build")
             return lk
 
     def _publish(self, space_id: int, m: CsrMirror, ver: int,

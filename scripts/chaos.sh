@@ -4,8 +4,11 @@
 #
 # Runs the kill matrix — real SIGKILL'd subprocess daemons
 # (tests/test_proc_chaos.py over tools/proc_cluster.py), the partition
-# cells (directional link cuts via the /faults endpoint), and the
-# wire-level fault-injection chaos suite (tests/test_chaos.py) — under
+# cells (directional link cuts via the /faults endpoint), the
+# wire-level fault-injection chaos suite (tests/test_chaos.py), and
+# the nebulamc exhaustive interleaving sweep (mc_sweep: every
+# registered scenario at its full schedule budget, bound exhausted or
+# red — docs/static_analysis.md "The model-checking layer") — under
 # the runtime lock-order watchdog: NEBULA_LOCK_WATCHDOG=1 arms
 # common/ordered_lock.py in THIS process and is inherited by every
 # daemon subprocess ProcCluster spawns, so an inversion inside a
@@ -42,6 +45,7 @@ CELLS=(
   "wire_faults|tests/test_chaos.py"
   "crash_recovery|tests/test_crash_recovery.py"
   "write_serve|tests/test_write_serve.py"
+  "mc_sweep|tests/test_mc.py::test_scenario_exhaustive_sweep"
 )
 
 cell_target() {

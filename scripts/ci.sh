@@ -1,20 +1,25 @@
 #!/usr/bin/env bash
-# One-shot merge gate (docs/STATUS.md "round 17"): everything a PR
+# One-shot merge gate (docs/STATUS.md "round 19"): everything a PR
 # must hold, in the order a failure is cheapest to see.
 #
 #   1. tier-1 — the fast test suite on the forced-CPU jax platform
 #      (the same invocation the driver scores; `-m 'not slow'` keeps
 #      the chaos soaks and bench legs out of the gate);
-#   2. nebulint — the eighteen-check static/semantic/flow suite, run
+#   2. nebulint — the nineteen-check static/semantic/flow suite, run
 #      ONCE in SARIF mode with the baseline applied; the JSON lands in
 #      $CI_ARTIFACT_DIR (default build/) so CI uploads it as an
 #      annotation artifact, and a non-empty `results` array fails the
 #      gate exactly like the plain CLI would;
-#   3. micro_bench — the performance-budget components (`--quick`
+#   3. nebulamc — the deterministic interleaving model checker at
+#      smoke budgets, also in SARIF mode; a found violation ships its
+#      replayable schedule id inside the SARIF message text and fails
+#      the gate (the exhaustive sweep lives in chaos.sh);
+#   4. micro_bench — the performance-budget components (`--quick`
 #      statistics are noisier but the budgets are sized for it); the
-#      lint cold-wall budget (40 s), the admission/recovery/absorb/
-#      continuous path budgets and the kernel roofline all gate here
-#      via micro_bench's own exit status.
+#      lint cold-wall budget (40 s), the mc smoke-sweep budget, the
+#      admission/recovery/absorb/continuous path budgets and the
+#      kernel roofline all gate here via micro_bench's own exit
+#      status.
 #
 # scripts/lint.sh remains the interactive lint + sanitizer entry
 # point; this script is the merge gate CI calls.
@@ -32,6 +37,10 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 echo "== nebulint (SARIF artifact -> ${ARTIFACT_DIR}/nebulint.sarif) =="
 JAX_PLATFORMS=cpu python -m nebula_tpu.tools.lint --format=sarif \
   > "${ARTIFACT_DIR}/nebulint.sarif"
+
+echo "== nebulamc (SARIF artifact -> ${ARTIFACT_DIR}/nebulamc.sarif) =="
+JAX_PLATFORMS=cpu python -m nebula_tpu.tools.mc run --smoke --format=sarif \
+  > "${ARTIFACT_DIR}/nebulamc.sarif"
 
 echo "== micro_bench (budget components, --quick) =="
 JAX_PLATFORMS=cpu python -m nebula_tpu.tools.micro_bench --quick \

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Static-analysis + sanitizer gate (docs/static_analysis.md):
-#   1. nebulint — the eighteen whole-package checks over nebula_tpu:
+#   1. nebulint — the nineteen whole-package checks over nebula_tpu:
 #      the AST checks (lock discipline, lock-order cycles, Status
 #      discipline, JAX hot-path hygiene, flag/span/metric/event
 #      registries), the two SEMANTIC passes — the jaxpr device-path
@@ -19,16 +19,26 @@
 #      slots, waiter heaps, the busy meter, rebuild markers, rider
 #      wakeups, context binds) and the typed-protocol registry
 #      closing every reason string + state-machine transition
-#      (common/protocol.py);
-#   2. asan_driver — the native C ABI driven under the ASan+UBSan build,
+#      (common/protocol.py) — and the v6 MC layer: mc-coverage, the
+#      registry-to-scenario closure check (every STATE_MACHINES /
+#      OBLIGATIONS entry modeled by a nebulamc scenario, no stale
+#      covers tags, scenario classes fully instrumented);
+#   2. nebulamc — the deterministic interleaving model checker
+#      (tools/mc/) at each scenario's tier-1 smoke budget; failures
+#      print replayable schedule ids (the exhaustive full-budget
+#      sweep is scripts/chaos.sh --cell mc_sweep);
+#   3. asan_driver — the native C ABI driven under the ASan+UBSan build,
 #      when `make -C native asan` has produced the instrumented .so and
 #      libasan is present (skipped, loudly, otherwise).
-# Exit status is non-zero when either gate fails.
+# Exit status is non-zero when any gate fails.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== nebulint (static + semantic analysis) =="
 JAX_PLATFORMS=cpu python -m nebula_tpu.tools.lint
+
+echo "== nebulamc (bounded interleaving model-check, smoke budgets) =="
+JAX_PLATFORMS=cpu python -m nebula_tpu.tools.mc run --smoke
 
 if [ -f native/libnebula_native_asan.so ]; then
   libasan="$(gcc -print-file-name=libasan.so 2>/dev/null || true)"

@@ -1,0 +1,255 @@
+"""Historical soak bugs reconstructed as nebulamc fixture scenarios.
+
+Three concurrency bugs that shipped (and were fixed) in earlier
+rounds, rebuilt in their original racy form so the model checker's
+regression tests can prove it FINDS each one within a bounded budget
+— and that the fixed shapes (the production scenarios plus the fixed
+control here) pass the same exploration exhaustively:
+
+* PR 6  — ``RacyPrioritySlots``: the slot-handoff missed wakeup.  A
+  waiter popping itself as head while ``_free > 0`` and other waiters
+  remain must hand the spare slot on (``notify_all``); without it the
+  new head re-waits on a notification that never comes and the queue
+  wedges.  nebulamc reports it as a DEADLOCK.
+* PR 7  — ``pr7-probe-leak``: a half-open probe that ends without
+  exercising the device (deadline fired, semantic decline) must hand
+  the token back via ``release_probe``; the original path simply
+  returned.  nebulamc reports the undischarged probe-token obligation
+  at quiescence (cell left ``probing=True`` — the breaker never
+  probes again).
+* PR 15 — ``RacyLaneTick``: the stranded lane seat.  When the
+  leave-extract fetch fails AFTER the leavers left the seat map, the
+  failure path woke their waiters but never released their lanes —
+  the ledger leaks a seat per failed cohort until the stream starves.
+  The failure here triggers only when a JOIN lands inside the extract
+  window, so finding it requires actual interleaving search.
+  ``FixedLaneTick`` releases on the failure path too and passes the
+  same exploration exhaustively.
+
+Not a pytest module (no ``test_`` prefix) and not part of the
+package: loaded by tests/test_mc.py and by the CLI's ``--fixtures``
+flag (``python -m nebula_tpu.tools.mc run --fixtures=<this file>``).
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Callable, List, Tuple
+
+from nebula_tpu.common import mc_hooks
+from nebula_tpu.tools.mc import McViolation, Scenario
+
+
+# ------------------------------------------------------------ PR 6 bug
+class RacyPrioritySlots:
+    """graph/batch_dispatch._PrioritySlots as it shipped before PR 6's
+    fix: no hand-on notify after popping ourselves as head."""
+
+    def __init__(self, n: int):
+        self._cond = mc_hooks.Condition("fixture.slots")
+        self._free = max(1, int(n))
+        self._seq = 0
+        self._waiters: List[Tuple[int, int]] = []
+
+    def acquire(self, priority: int = 1) -> None:
+        with self._cond:
+            self._seq += 1
+            me = (int(priority), self._seq)
+            heapq.heappush(self._waiters, me)
+            while self._free <= 0 or self._waiters[0] != me:
+                self._cond.wait()
+            heapq.heappop(self._waiters)
+            self._free -= 1
+            # BUG (PR 6): when _free > 0 and _waiters remain, the pop
+            # above created a NEW head that nobody will notify again —
+            # the fixed class hands the spare slot on with notify_all
+
+    def release(self) -> None:
+        with self._cond:
+            self._free += 1
+            self._cond.notify_all()
+
+
+def _pr6_prepare() -> dict:
+    slots = RacyPrioritySlots(2)
+    # two slots "held" at the horizon's start: the releaser threads
+    # below model the in-flight batches completing
+    slots._free = 0
+    return {"slots": slots, "got": []}
+
+
+def _pr6_bodies(ctx) -> List[Tuple[str, Callable]]:
+    slots, got = ctx["slots"], ctx["got"]
+
+    def releaser(tag):
+        return lambda: slots.release()
+
+    def acquirer(prio, tag):
+        def body():
+            slots.acquire(prio)
+            got.append(tag)
+        return body
+
+    return [("rel-1", releaser(1)), ("rel-2", releaser(2)),
+            ("wait-a", acquirer(0, "a")), ("wait-b", acquirer(1, "b"))]
+
+
+def _pr6_quiesce(ctx) -> None:
+    if len(ctx["got"]) != 2:
+        raise McViolation(
+            f"only {len(ctx['got'])}/2 waiters acquired "
+            f"(lost slot handoff)", kind="obligation")
+
+
+# ------------------------------------------------------------ PR 7 bug
+def _pr7_prepare() -> dict:
+    from nebula_tpu.common import protocol
+    from nebula_tpu.storage.device import DeviceCircuitBreaker
+    b = DeviceCircuitBreaker()
+    key = (3, "go")
+    b.record_failure(key, protocol.DEVFAIL_TRANSFER)
+    # zero the open clock so the next admit half-opens under every
+    # schedule (tpu_breaker_open_s=0.0 would read as 30.0 — falsy)
+    b.reset_space(key[0])
+    return {"b": b, "key": key}
+
+
+def _pr7_bodies(ctx) -> List[Tuple[str, Callable]]:
+    b, key = ctx["b"], ctx["key"]
+
+    def prober_leaky():
+        tok = b.admit(key)
+        if tok is None:
+            # BUG (PR 7): the probe ended unclassified (deadline fired
+            # before the device ran) and the original code just
+            # returned — no release_probe, token gone forever
+            return
+
+    def bystander():
+        b.admit(key)
+
+    return [("probe", prober_leaky), ("bystander", bystander)]
+
+
+def _pr7_quiesce(ctx) -> None:
+    cell = ctx["b"]._cells.get(ctx["key"])
+    if cell is not None and cell.probing:
+        raise McViolation(
+            "probe-token obligation: half-open probe token never "
+            "discharged (cell left probing=True; the breaker will "
+            "never probe again)", kind="obligation")
+
+
+# ----------------------------------------------------------- PR 15 bug
+def _lane_tick_prepare() -> dict:
+    from nebula_tpu.graph.batch_dispatch import _LaneLedger
+    return {"cond": mc_hooks.Condition("fixture.stream"),
+            "ledger": _LaneLedger(2), "seated": {}, "served": [],
+            "joins": [0]}
+
+
+def _lane_tick_bodies(ctx, release_on_failure: bool
+                      ) -> List[Tuple[str, Callable]]:
+    cond, ledger = ctx["cond"], ctx["ledger"]
+    seated, served, joins = ctx["seated"], ctx["served"], ctx["joins"]
+
+    def rider(tag: str):
+        def body():
+            with cond:
+                while ledger.free_count() == 0:
+                    cond.wait()
+                lane = ledger.alloc()
+                seated[lane] = tag
+                joins[0] += 1
+                cond.notify_all()
+                while seated.get(lane) == tag:
+                    cond.wait()
+        return body
+
+    def ticker():
+        while len(served) < 2:
+            with cond:
+                while not seated:
+                    cond.wait()
+                leavers = list(seated.items())
+                for lane, _tag in leavers:
+                    del seated[lane]
+                joins_before = joins[0]
+            # the extract/clear fetch runs OUTSIDE the condition; a
+            # join landing in this window moves the frontier under
+            # the fetch and fails the cohort
+            mc_hooks.mc_yield("fixture.extract", ledger)
+            with cond:
+                if joins[0] > joins_before:
+                    # extract failed: wake the leavers with the error
+                    for lane, tag in leavers:
+                        served.append(tag)
+                        if release_on_failure:
+                            ledger.release(lane)
+                        # BUG (PR 15, release_on_failure=False): the
+                        # leavers left the seat map above, so the
+                        # pump-level cleanup can no longer reach them
+                        # — their lanes stay allocated forever
+                    cond.notify_all()
+                else:
+                    for lane, tag in leavers:
+                        ledger.release(lane)
+                        served.append(tag)
+                    cond.notify_all()
+
+    return [("rider-a", rider("a")), ("rider-b", rider("b")),
+            ("tick", ticker)]
+
+
+def _lane_tick_quiesce(ctx) -> None:
+    ledger = ctx["ledger"]
+    if ledger.seated_count() != 0 \
+            or ledger.free_count() != ledger.width:
+        raise McViolation(
+            f"lane-seat obligation: {ledger.seated_count()} seat(s) "
+            f"stranded at quiescence "
+            f"(free {ledger.free_count()}/{ledger.width})",
+            kind="obligation")
+    if sorted(ctx["served"]) != ["a", "b"]:
+        raise McViolation(f"riders served {ctx['served']!r}",
+                          kind="obligation")
+
+
+FIXTURE_SCENARIOS = {s.name: s for s in (
+    Scenario(
+        name="pr6-slots-missed-wakeup",
+        title="PR 6 regression: slot handoff without hand-on notify",
+        prepare=_pr6_prepare, bodies=_pr6_bodies,
+        quiesce=_pr6_quiesce,
+        covers=("obligation:pipeline-slot",),
+        smoke=(2, 400, 30.0), full=(2, 4000, 120.0),
+    ),
+    Scenario(
+        name="pr7-probe-leak",
+        title="PR 7 regression: unclassified probe never hands back "
+              "its token",
+        prepare=_pr7_prepare, bodies=_pr7_bodies,
+        quiesce=_pr7_quiesce,
+        covers=("obligation:probe-token",),
+        flag_overrides={"tpu_breaker_failures": 1},
+        smoke=(2, 400, 30.0), full=(2, 4000, 120.0),
+    ),
+    Scenario(
+        name="pr15-lane-strand",
+        title="PR 15 regression: failed extract strands the leavers' "
+              "lanes",
+        prepare=_lane_tick_prepare,
+        bodies=lambda ctx: _lane_tick_bodies(ctx, False),
+        quiesce=_lane_tick_quiesce,
+        covers=("obligation:lane-seat",),
+        smoke=(2, 800, 30.0), full=(2, 8000, 120.0),
+    ),
+    Scenario(
+        name="pr15-lane-strand-fixed",
+        title="PR 15 control: the failure path releases lanes too",
+        prepare=_lane_tick_prepare,
+        bodies=lambda ctx: _lane_tick_bodies(ctx, True),
+        quiesce=_lane_tick_quiesce,
+        covers=("obligation:lane-seat",),
+        smoke=(2, 800, 30.0), full=(2, 8000, 120.0),
+    ),
+)}

@@ -510,7 +510,7 @@ def test_all_checks_registered():
                                "context-capture", "jaxpr-audit",
                                "mesh-audit", "carveout-inventory",
                                "wire-contract", "obligation-tracking",
-                               "protocol-registry",
+                               "protocol-registry", "mc-coverage",
                                "stale-suppression"}
 
 
@@ -2668,4 +2668,207 @@ def test_protocol_suppression_roundtrip(tmp_path):
 
 def test_protocol_package_vocabulary_closed():
     vs = lint_paths(PKG_ROOT, checks=["protocol-registry"])
+    assert vs == [], "\n".join(repr(v) for v in vs)
+
+
+# ================================================= 21 · mc-coverage (v6)
+_MC_PROTO = """
+    STATE_MACHINES = {
+        "breaker-cell": {
+            "module": "storage/device.py",
+            "fields": ("state",),
+            "writers": ("admit", "record_success"),
+        },
+    }
+
+    OBLIGATIONS = {
+        "probe-token": {
+            "acquire": "DeviceCircuitBreaker.admit",
+            "discharge": ("release_probe",),
+            "quiescence": "no probe token outstanding",
+        },
+    }
+    """
+
+_MC_FULL_COVERS = ("machine:breaker-cell", "obligation:probe-token")
+
+
+def _mc_scen(covers=(), classes=()):
+    """A fake Scenario — mc-coverage only reads .covers/.classes."""
+    import types
+    return types.SimpleNamespace(covers=tuple(covers),
+                                 classes=tuple(classes))
+
+
+def _mc_lint(tmp_path, files, registry):
+    """check_mc_coverage over a fake package with an injected scenario
+    registry (the live tools/mc import is exactly what fixtures must
+    not depend on)."""
+    from nebula_tpu.tools.lint.core import load_package
+    from nebula_tpu.tools.lint.mccheck import check_mc_coverage
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    ctx = load_package(str(root), str(tmp_path))
+    return check_mc_coverage(ctx, registry=registry)
+
+
+def test_mc_uncovered_entries_flagged_at_their_key_lines(tmp_path):
+    vs = _mc_lint(tmp_path, {"common/protocol.py": _MC_PROTO},
+                  registry={})
+    assert len(vs) == 2, vs
+    machine = next(v for v in vs if v.symbol == "breaker-cell")
+    assert "covered by no registered nebulamc scenario" in machine.message
+    assert machine.line > 1, "must point at the key, not the file header"
+    oblig = next(v for v in vs if v.symbol == "probe-token")
+    assert "quiescence property is never asserted" in oblig.message
+    assert oblig.line > machine.line
+
+
+def test_mc_full_coverage_is_clean(tmp_path):
+    reg = {"breaker-probe": _mc_scen(covers=_MC_FULL_COVERS)}
+    assert _mc_lint(tmp_path, {"common/protocol.py": _MC_PROTO},
+                    reg) == []
+
+
+def test_mc_stale_and_malformed_tags_flagged(tmp_path):
+    reg = {"ghost": _mc_scen(
+        covers=_MC_FULL_COVERS + ("machine:ghost", "bogus-tag"))}
+    vs = _mc_lint(tmp_path, {"common/protocol.py": _MC_PROTO}, reg)
+    assert len(vs) == 2, vs
+    assert any("stale tag claims coverage" in v.message for v in vs)
+    assert any("malformed tag" in v.message for v in vs)
+    assert all(v.symbol == "ghost" for v in vs)
+
+
+_MC_LEDGER = """
+    class Ledger:
+        def __init__(self):
+            self._lock = object()
+            self.count = 0          # __init__ precedes concurrency
+
+        def alloc(self):
+            with self._lock:
+                self.count += 1     # under the lock: schedulable
+
+        def tick(self):
+            mc_yield("ledger.tick")
+            self.count += 1         # yield point: schedulable
+
+        def evict(self):
+            self.count -= 1         # naked: invisible to the scheduler
+    """
+
+
+def test_mc_naked_write_flagged_sync_ops_silence(tmp_path):
+    reg = {"churn": _mc_scen(covers=_MC_FULL_COVERS,
+                             classes=("pkg.graph.ledger.Ledger",))}
+    vs = _mc_lint(tmp_path, {
+        "common/protocol.py": _MC_PROTO,
+        "graph/ledger.py": _MC_LEDGER,
+    }, reg)
+    assert len(vs) == 1, vs
+    v = vs[0]
+    assert v.symbol == "Ledger.evict"
+    assert v.path.endswith("graph/ledger.py")
+    assert "cannot preempt inside evict()" in v.message
+    assert "mc=caller-synced" in v.message
+
+
+def test_mc_method_waiver_is_not_a_class_waiver(tmp_path):
+    """A caller-synced annotation above ONE def silences that method
+    only — the next naked method in the same class still fires."""
+    src = """
+    class Brief:
+        # single collector thread owns this mark
+        # nebulint: mc=caller-synced/metrics scrape is single-threaded
+        def scrape(self):
+            self.mark = 1
+
+        def rogue(self):
+            self.mark = 2
+    """
+    reg = {"s": _mc_scen(covers=_MC_FULL_COVERS,
+                         classes=("pkg.graph.brief.Brief",))}
+    vs = _mc_lint(tmp_path, {
+        "common/protocol.py": _MC_PROTO,
+        "graph/brief.py": src,
+    }, reg)
+    assert [v.symbol for v in vs] == ["Brief.rogue"], vs
+
+
+def test_mc_class_header_waiver_blankets_the_class(tmp_path):
+    """The _LaneLedger idiom: the annotation between the docstring and
+    the first statement waives every method."""
+    src = """
+    class Brief:
+        '''Caller-sequenced read-side brief.'''
+        # nebulint: mc=caller-synced/all writers hold the dispatcher lock
+
+        def scrape(self):
+            self.mark = 1
+
+        def rogue(self):
+            self.mark = 2
+    """
+    reg = {"s": _mc_scen(covers=_MC_FULL_COVERS,
+                         classes=("pkg.graph.brief.Brief",))}
+    assert _mc_lint(tmp_path, {
+        "common/protocol.py": _MC_PROTO,
+        "graph/brief.py": src,
+    }, reg) == []
+
+
+def test_mc_waiver_inside_a_method_does_not_blanket(tmp_path):
+    """An annotation buried in a method BODY is not a class waiver —
+    other methods' naked writes still fire."""
+    src = """
+    class Brief:
+        def scrape(self):
+            x = 1  # nebulint: mc=caller-synced/only about this line
+            self.mark = x
+
+        def rogue(self):
+            self.mark = 2
+    """
+    reg = {"s": _mc_scen(covers=_MC_FULL_COVERS,
+                         classes=("pkg.graph.brief.Brief",))}
+    vs = _mc_lint(tmp_path, {
+        "common/protocol.py": _MC_PROTO,
+        "graph/brief.py": src,
+    }, reg)
+    assert "Brief.rogue" in {v.symbol for v in vs}, vs
+
+
+def test_mc_missing_class_flagged(tmp_path):
+    reg = {"s": _mc_scen(covers=_MC_FULL_COVERS,
+                         classes=("pkg.graph.nosuch.Ghost",))}
+    vs = _mc_lint(tmp_path, {"common/protocol.py": _MC_PROTO}, reg)
+    assert len(vs) == 1
+    assert "not in the linted package" in vs[0].message
+
+
+def test_mc_registry_import_failure_is_one_violation(tmp_path,
+                                                     monkeypatch):
+    """A broken scenarios.py fails the lint with a pointer, it does
+    not crash the whole run."""
+    import nebula_tpu.tools.lint.mccheck as mccheck_mod
+
+    def boom():
+        raise ImportError("scenario module is on fire")
+    monkeypatch.setattr(mccheck_mod, "_scenario_registry", boom)
+    vs = _mc_lint(tmp_path, {"common/protocol.py": _MC_PROTO},
+                  registry=None)
+    assert len(vs) == 1
+    assert "cannot import the nebulamc scenario registry" in vs[0].message
+    assert "on fire" in vs[0].message
+
+
+def test_mc_package_coverage_closed():
+    """The real gate: every live STATE_MACHINES/OBLIGATIONS entry is
+    covered by a registered scenario and every scenario-driven class
+    is fully instrumented (or carries a reasoned waiver)."""
+    vs = lint_paths(PKG_ROOT, checks=["mc-coverage"])
     assert vs == [], "\n".join(repr(v) for v in vs)

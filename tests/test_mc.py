@@ -1,0 +1,310 @@
+"""nebulamc gate + engine unit tests (docs/static_analysis.md "The
+model-checking layer").
+
+Three tiers in one module:
+
+* scheduler/explorer unit tests — mutual exclusion bookkeeping,
+  wait/notify hand-off, deterministic replay (same schedule, same
+  trace), deadlock detection, the state-machine monitor catching a
+  rogue write, schedule-id round-trips;
+* the REGRESSION gate: the three historical soak bugs reconstructed in
+  tests/lint_fixtures/mc_racy.py (PR 6 missed wakeup, PR 7 leaked
+  probe token, PR 15 stranded lane seat) must each be FOUND within a
+  bounded budget, replay deterministically from their schedule ids,
+  and the fixed control must pass the same exploration exhaustively;
+* the tier-1 smoke: every registered production scenario explored at
+  its small smoke budget — the exhaustive full-budget sweep is the
+  slow-marked test at the bottom (scripts/chaos.sh runs it).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from nebula_tpu.common import mc_hooks
+from nebula_tpu.tools.mc import (McViolation, Monitor, SCENARIOS,
+                                 Schedule, Scheduler, decode_schedule,
+                                 encode_schedule, explore,
+                                 explore_scenario, run_scenario)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures",
+                        "mc_racy.py")
+
+
+def _load_fixtures():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_mc_racy", FIXTURES)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.FIXTURE_SCENARIOS
+
+
+# ================================================== scheduler unit tier
+class TestScheduler:
+    def test_lock_mutual_exclusion_bookkeeping(self):
+        """Two logical threads bumping a counter under an McLock: every
+        interleaving serializes the critical sections."""
+        def run_one(schedule):
+            sched = Scheduler(schedule)
+            state = {"lock": None, "n": 0, "max_in": 0, "in_cs": 0}
+
+            def build():
+                state["lock"] = mc_hooks.Lock("t.lock")
+            sched.construct(build)
+
+            def body():
+                with state["lock"]:
+                    state["in_cs"] += 1
+                    state["max_in"] = max(state["max_in"],
+                                          state["in_cs"])
+                    sched.yield_point("t.cs")
+                    state["n"] += 1
+                    state["in_cs"] -= 1
+            r = sched.run([("a", body), ("b", body)])
+            assert r.violation is None, r.violation
+            assert state["n"] == 2 and state["max_in"] == 1
+            return r
+        res = explore(run_one, max_preemptions=2)
+        assert res.ok and res.exhausted and res.executions >= 2
+
+    def test_wait_notify_handoff(self):
+        """A waiter parked on a condition wakes only after the notify,
+        and reacquires the lock before its wait() returns."""
+        def run_one(schedule):
+            sched = Scheduler(schedule)
+            box = {}
+
+            def build():
+                box["cond"] = mc_hooks.Condition("t.cond")
+                box["ready"] = False
+                box["order"] = []
+            sched.construct(build)
+            cond, order = box["cond"], box["order"]
+
+            def waiter():
+                with cond:
+                    while not box["ready"]:
+                        cond.wait()
+                    order.append("woke")
+
+            def notifier():
+                with cond:
+                    box["ready"] = True
+                    order.append("notified")
+                    cond.notify_all()
+            r = sched.run([("w", waiter), ("n", notifier)])
+            assert r.violation is None, r.violation
+            assert box["order"][-1] == "woke"
+            return r
+        res = explore(run_one, max_preemptions=2)
+        assert res.ok and res.exhausted
+
+    def test_deterministic_replay_same_trace(self):
+        """The same schedule prefix produces the identical trace —
+        the property every replayable schedule id rests on."""
+        scen = SCENARIOS["prioslots-handoff"]
+        r1 = run_scenario(scen, Schedule((1, 0, 2)))
+        r2 = run_scenario(scen, Schedule((1, 0, 2)))
+        assert r1.trace == r2.trace and len(r1.trace) > 3
+
+    def test_deadlock_detected(self):
+        """Two threads acquiring two locks in opposite orders: some
+        interleaving must deadlock, and the report names both."""
+        def run_one(schedule):
+            sched = Scheduler(schedule)
+            box = {}
+
+            def build():
+                box["a"] = mc_hooks.Lock("t.A")
+                box["b"] = mc_hooks.Lock("t.B")
+            sched.construct(build)
+            a, b = box["a"], box["b"]
+
+            def ab():
+                with a:
+                    sched.yield_point("t.mid")
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    sched.yield_point("t.mid")
+                    with a:
+                        pass
+            return sched.run([("ab", ab), ("ba", ba)])
+        res = explore(run_one, max_preemptions=2)
+        assert res.violation is not None
+        assert "deadlock" in str(res.violation).lower()
+
+    def test_monitor_flags_rogue_write(self):
+        """A write to a declared machine field outside its declared
+        writer methods is a violation even on a clean schedule."""
+        class Cell:
+            def __init__(self):
+                self.state = "closed"
+
+            def admit(self):            # declared writer
+                self.state = "half_open"
+
+            def poke(self):             # NOT a declared writer
+                self.state = "open"
+
+        mon = Monitor()
+        mon.bind("breaker-cell", Cell, Cell)
+        try:
+            c = Cell()
+            c.admit()
+            assert mon.violations == []
+            with pytest.raises(McViolation):
+                c.poke()
+            assert mon.violations
+            assert "outside" in mon.violations[0]
+        finally:
+            mon.unbind_all()
+
+    def test_schedule_id_roundtrip(self):
+        for choices in ((), (0,), (1, 0, 35, 2), tuple(range(12))):
+            sid = encode_schedule("lane-churn", choices)
+            name, sched = decode_schedule(sid)
+            assert name == "lane-churn"
+            assert tuple(sched.choices) == choices
+        with pytest.raises(ValueError):
+            decode_schedule("no-at-sign")
+
+
+# =============================================== historical-bug gate
+class TestHistoricalBugs:
+    """Each reconstructed soak bug must be FOUND within its smoke
+    budget and must replay deterministically from the reported id."""
+
+    def _find(self, name):
+        reg = _load_fixtures()
+        s = reg[name]
+        res = explore_scenario(s, *s.smoke)
+        assert res.violation is not None, \
+            f"{name}: bug not found in {res.executions} executions"
+        sid = encode_schedule(name, res.failing_choices)
+        # replay round-trip: decode the id, re-run, same failure class
+        rname, schedule = decode_schedule(sid)
+        assert rname == name
+        replay = run_scenario(reg[name], schedule)
+        assert replay.violation is not None, \
+            f"{name}: schedule {sid} did not reproduce on replay"
+        return res, replay
+
+    def test_pr6_missed_wakeup_found_and_replays(self):
+        res, replay = self._find("pr6-slots-missed-wakeup")
+        assert "deadlock" in str(replay.violation).lower()
+
+    def test_pr7_probe_leak_found_and_replays(self):
+        res, replay = self._find("pr7-probe-leak")
+        assert "probe" in str(replay.violation)
+
+    def test_pr15_lane_strand_found_and_replays(self):
+        res, replay = self._find("pr15-lane-strand")
+        assert "strand" in str(replay.violation)
+
+    def test_pr15_fixed_control_passes_exhaustively(self):
+        reg = _load_fixtures()
+        s = reg["pr15-lane-strand-fixed"]
+        res = explore_scenario(s, *s.smoke)
+        assert res.ok, res.violation
+        assert res.exhausted, "control scenario must exhaust its bound"
+
+
+# ================================================= production smoke
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_smoke(name):
+    """Tier-1: every registered scenario is clean within its small
+    smoke budget (bounded preemptions, capped executions/seconds)."""
+    s = SCENARIOS[name]
+    res = explore_scenario(s, *s.smoke)
+    assert res.violation is None, (
+        f"{name} FAILED: {res.violation}\n  replay: python -m "
+        f"nebula_tpu.tools.mc replay --schedule="
+        f"{encode_schedule(name, res.failing_choices)}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_exhaustive_sweep(name):
+    """The chaos-lane sweep (scripts/chaos.sh --cell mc_sweep): full
+    budgets, and the bound must actually be exhausted — a
+    budget-truncated 'pass' is not a proof."""
+    s = SCENARIOS[name]
+    res = explore_scenario(s, *s.full)
+    assert res.violation is None, (
+        f"{name} FAILED: {res.violation}\n  replay: python -m "
+        f"nebula_tpu.tools.mc replay --schedule="
+        f"{encode_schedule(name, res.failing_choices)}")
+    assert res.exhausted, (
+        f"{name}: {res.executions} executions in {res.seconds:.0f}s "
+        f"without exhausting bound {res.bound} — raise the budget")
+
+
+# ========================================================== CLI tier
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "nebula_tpu.tools.mc", *args],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class TestCli:
+    def test_list_names_every_scenario(self):
+        p = _cli("list")
+        assert p.returncode == 0
+        for name in SCENARIOS:
+            assert name in p.stdout
+
+    def test_run_unknown_scenario_is_usage_error(self):
+        p = _cli("run", "no-such-scenario")
+        assert p.returncode == 2
+        assert "closed" in p.stderr
+
+    def test_run_clean_scenario_exits_zero(self):
+        p = _cli("run", "prioslots-handoff", "--smoke")
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "ok " in p.stdout
+
+    def test_run_fixture_bug_exits_one_with_replayable_id(self):
+        p = _cli("run", "pr7-probe-leak", "--smoke",
+                 f"--fixtures={FIXTURES}")
+        assert p.returncode == 1, p.stdout + p.stderr
+        line = [ln for ln in p.stdout.splitlines()
+                if "--schedule=" in ln][0]
+        sid = line.split("--schedule=")[1].strip()
+        rp = _cli("replay", f"--schedule={sid}",
+                  f"--fixtures={FIXTURES}")
+        assert rp.returncode == 1, rp.stdout + rp.stderr
+        assert "FAIL pr7-probe-leak" in rp.stdout
+
+    def test_run_sarif_shape(self):
+        import json
+        p = _cli("run", "pr15-lane-strand", "--smoke",
+                 "--format=sarif", f"--fixtures={FIXTURES}")
+        assert p.returncode == 1
+        doc = json.loads(p.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "nebulamc"
+        assert run["results"] and all(
+            r["ruleId"] == "mc-violation" for r in run["results"])
+
+    def test_sarif_golden_file(self):
+        """Golden-file contract for mc findings: exploration is
+        deterministic, so the SARIF payload for the PR 7 probe-leak
+        fixture — failing schedule id included — is byte-stable."""
+        import json
+        p = _cli("run", "pr7-probe-leak", "--smoke",
+                 "--format=sarif", f"--fixtures={FIXTURES}")
+        assert p.returncode == 1
+        doc = json.loads(p.stdout)
+        golden_path = os.path.join(os.path.dirname(FIXTURES),
+                                   "golden_mc.sarif")
+        with open(golden_path, encoding="utf-8") as fh:
+            golden = json.load(fh)
+        assert doc == golden, json.dumps(doc, indent=2, sort_keys=True)
