@@ -98,6 +98,15 @@ EVENT_KINDS = (
                              # ``state`` field says which
                              # (common/slo.py, docs/observability.md
                              # "SLO burn rates")
+    "tpu.model_drift",       # a live measurement crossed its DECLARED
+                             # static-model bound: per-collective ICI
+                             # bytes over KernelSpec.ici_bytes, or
+                             # achieved GB/s over MESH_MODEL's
+                             # hbm_gbps (common/flight.py fold — fires
+                             # on the in-bound -> over transition,
+                             # re-arms when the cell returns in-bound;
+                             # docs/observability.md "The device
+                             # timeline")
 )
 
 _rng = random.Random()       # event ids; independent of seeded test RNGs

@@ -169,6 +169,13 @@ METRIC_NAMES = (
     # runtime failures, fast-path declines while open, half-open
     # probes, and the per-(space, class) state gauge
     "tpu.breaker.*",
+    # flight recorder (common/flight.py, docs/observability.md "The
+    # device timeline"): ring occupancy plus the live-vs-declared
+    # drift family — per-axis (ici/hbm) overshoot-fraction gauges
+    # labeled by kernel class / timing kind, zero while every live
+    # measurement sits inside its declared model bound
+    "tpu.flight.records",
+    "tpu.model_drift.*",
     # crash-recovery counters (kvstore/wal.py, cluster.py,
     # docs/durability.md): WAL truncations/dropped bytes on replay,
     # flush failures that dropped an un-persisted tail, nodes that

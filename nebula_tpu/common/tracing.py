@@ -95,6 +95,12 @@ SPAN_NAMES = (
     "tpu.breaker",            # zero-duration marker: device breaker
                               # decline / classified runtime failure
                               # (tpu/runtime.py, docs/durability.md)
+    "graph.timeline.export",  # stitching one Chrome-trace export out
+                              # of the span tree + flight-recorder
+                              # rows (PROFILE FORMAT=trace / the
+                              # /timeline endpoint — common/flight.py
+                              # chrome_trace, docs/observability.md
+                              # "The device timeline")
 )
 
 _tls = threading.local()          # .ctx = (trace_id, span_id, True)
@@ -380,11 +386,13 @@ class SlowQueryLog:
                trace_id: Optional[int],
                seat: Optional[dict] = None) -> None:
         """``seat`` carries the continuous-dispatch markers of a slow
-        statement that rode a lane batch — lane, joined_tick, hops and
+        statement that rode a lane batch — lane, joined_tick, hops,
         the typed ``ending`` (common/protocol.py continuous-ending
-        vocabulary) — so the slow log attributes a slow rider to its
-        seat trajectory, not just its wall time (windowed statements
-        pass None and keep the PR 3 entry shape)."""
+        vocabulary) and the ``timeline`` anchor (first/last flight-
+        recorder tick ids for the rider's stream, common/flight.py) —
+        so the slow log attributes a slow rider to its seat trajectory
+        and its `/timeline` window, not just its wall time (windowed
+        statements pass None and keep the PR 3 entry shape)."""
         if self._PASSWORD_KW.search(stmt):
             stmt = self._STRING_RE.sub('"***"', stmt)
         if len(stmt) > self._MAX_STMT:
@@ -397,7 +405,8 @@ class SlowQueryLog:
                  "trace_id": (f"{trace_id:016x}"
                               if trace_id is not None else None)}
         if seat:
-            for k in ("lane", "joined_tick", "hops", "ending"):
+            for k in ("lane", "joined_tick", "hops", "ending",
+                      "timeline"):
                 if seat.get(k) is not None:
                     entry[k] = seat[k]
         with self._lock:

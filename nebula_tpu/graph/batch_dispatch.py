@@ -77,6 +77,7 @@ import time
 from typing import Dict, List, Tuple
 
 from ..common import deadline as deadlines
+from ..common import flight
 from ..common import mc_hooks
 from ..common import protocol
 from ..common import tracing
@@ -517,6 +518,9 @@ class _ContinuousStream:
         # tests can force arrivals to land mid-flight deterministically
         self.tick_delay_s = 0.0         # nebulint: guarded-by=none
         self._meter_open = False        # nebulint: guarded-by=none
+        # pump-only: perf_counter stamp of the previous tick's end —
+        # the flight recorder's idle-gap column (common/flight.py)
+        self._last_tick_end = 0.0       # nebulint: guarded-by=none
         self._pump_thread = threading.Thread(
             target=self._pump, daemon=True,
             name=f"continuous-go-{space_id}")
@@ -699,6 +703,10 @@ class _ContinuousStream:
         fetch+assembly runs here, after this tick's hop is enqueued,
         which is the overlap the idle-frac gauge measures."""
         t0 = time.perf_counter()
+        # idle gap since the previous tick ended (0 on the first tick)
+        # — one column of the flight-recorder tick record
+        idle_us = (t0 - self._last_tick_end) * 1e6 \
+            if self._last_tick_end else 0.0
         with self.cond:
             # riders present BEFORE this tick's generation check are
             # seatable this tick; later arrivals wait for the next
@@ -805,7 +813,12 @@ class _ContinuousStream:
                 self._widen_min = width + 1  # nebulint: disable=lock-discipline
 
         new_pending = None
-        if sess is not None and (joiners or evicted or seated_now):
+        leavers: List[_Rider] = []
+        occupancy = 0
+        join_us = hop_us = extract_us = clear_us = 0.0
+        busy = sess is not None and bool(joiners or evicted
+                                         or seated_now)
+        if busy:
             if not self._meter_open:
                 # one busy interval spans MANY ticks: _meter_close
                 # ends it at idle / drain / pump retirement
@@ -824,19 +837,22 @@ class _ContinuousStream:
             # the windowed equivalence (the leader thread's PROFILE
             # shows launch/kernel; riders see the seat markers)
             jctx = joiners[0].tctx if joiners else None
-            leavers: List[_Rider] = []
             resolver = None
             try:
                 with tracing.attach_captured(jctx):
                     with tracing.span("tpu.launch",
                                       joiners=len(joiners), steps=1):
                         if joiners:
+                            tj = time.perf_counter()
                             sess.join([(r.lane, r.payload.start_vids)
                                        for r in joiners])
+                            join_us = (time.perf_counter() - tj) * 1e6
                         with self.cond:
                             has_work = bool(self.seated)
                         if has_work:
+                            th = time.perf_counter()
                             sess.hop()
+                            hop_us = (time.perf_counter() - th) * 1e6
                             with self.cond:
                                 self.tick_no += 1
                                 for lane, r in \
@@ -849,12 +865,16 @@ class _ContinuousStream:
                                         del self.seated[lane]
                                         leavers.append(r)
                     if leavers:
+                        tx = time.perf_counter()
                         resolver = sess.extract([(r.lane, r.upto)
                                                  for r in leavers])
+                        extract_us = (time.perf_counter() - tx) * 1e6
                     if leavers or evicted:
+                        tc = time.perf_counter()
                         sess.clear([r.lane for r in leavers]
                                    + [r.lane for r in evicted
                                       if r.lane >= 0])
+                        clear_us = (time.perf_counter() - tc) * 1e6
             except BaseException as ex:
                 # leavers/evicted already left the seat map — the
                 # pump-level _fail_all can no longer reach them, so
@@ -908,8 +928,11 @@ class _ContinuousStream:
 
         # hop k's work is on the device; assemble hop k-1's leavers
         # NOW — host post-processing overlaps device compute
+        assemble_us = 0.0
         if pending is not None:
+            ta = time.perf_counter()
             self._finish(pending)
+            assemble_us = (time.perf_counter() - ta) * 1e6
         # nothing left in flight: the cohort just produced has no hop
         # to hide behind — flush it immediately rather than letting it
         # age one idle-poll interval
@@ -917,12 +940,36 @@ class _ContinuousStream:
             with self.cond:
                 empty = not self.seated and not self.queue
             if empty:
+                ta = time.perf_counter()
                 self._finish(new_pending)
+                assemble_us += (time.perf_counter() - ta) * 1e6
                 new_pending = None
         dur = time.perf_counter() - t0
         with self.cond:
             self.hop_ema_s = dur if self.hop_ema_s == 0.0 \
                 else 0.7 * self.hop_ema_s + 0.3 * dur
+            tick_done = self.tick_no
+            seated_riders = list(self.seated.values())
+        # pump-thread-only state (see __init__)
+        self._last_tick_end = time.perf_counter()  # nebulint: disable=lock-discipline
+        if busy:
+            rec_id = flight.recorder.note_tick(
+                stream=self.space_id, tick=tick_done,
+                seats=occupancy, joins=len(joiners),
+                leaves=len(leavers), evictions=len(evicted),
+                join_us=int(join_us), hop_us=int(hop_us),
+                extract_us=int(extract_us), clear_us=int(clear_us),
+                assemble_us=int(assemble_us), idle_us=int(idle_us),
+                dur_us=int(dur * 1e6),
+                generation=int(getattr(getattr(sess, "m", None),
+                                       "generation", -1)))
+            # advance every touched rider's slow-log timeline anchor
+            # (first note pins the window start —
+            # query_registry.note_timeline)
+            for r in joiners + leavers + evicted:
+                query_registry.note_timeline(r.qid, rec_id)
+            for r in seated_riders:
+                query_registry.note_timeline(r.qid, rec_id)
         return new_pending
 
     def _finish(self, pending) -> None:

@@ -287,6 +287,8 @@ class ShowExecutor(Executor):
             return self._show_events()
         if t == ast.ShowTarget.QUERIES:
             return self._show_queries()
+        if t == ast.ShowTarget.TIMELINE:
+            return self._show_timeline(s.count)
         if t == ast.ShowTarget.USERS:
             resp = _meta_call(self, "listUsers", {})
             return InterimResult(["Account"],
@@ -407,6 +409,43 @@ class ShowExecutor(Executor):
                          q.get("elapsed_us", 0),
                          "-" if dl is None else dl])
         return InterimResult(list(self._QUERY_COLS), rows)
+
+    def _show_timeline(self, count) -> InterimResult:
+        """SHOW TIMELINE [<n>]: the device flight recorder,
+        cluster-wide — metad fans ``showTimeline`` across every
+        heartbeating graphd replica (the SHOW QUERIES shape) and this
+        graphd merges its OWN recorder on top (standalone graphd /
+        metad unreachable), deduped by the stats.PROC_TOKEN process
+        identity so LocalCluster's shared recorder is never
+        double-listed.  Newest first (docs/observability.md "The
+        device timeline")."""
+        from ...common import flight, tracing
+        from ...common.stats import PROC_TOKEN
+        limit = int(count or 64)
+        with tracing.span("graph.timeline.export", limit=limit):
+            resp = _meta_call(self, "showTimeline", {"limit": limit},
+                              ignore=(ErrorCode.E_RPC_FAILURE,))
+        fanned = list((resp or {}).get("ticks", []))
+        rows_in = fanned
+        if not any(t.get("proc") == PROC_TOKEN for t in fanned):
+            rows_in = fanned + [dict(t, host="graphd")
+                                for t in flight.recorder.dump(limit=limit)]
+        rows = []
+        for t in sorted(rows_in, key=lambda t: -t.get("time_us", 0)):
+            src = t.get("stream", t.get("kernel", t.get("op", "")))
+            detail = " ".join(
+                f"{k}={t[k]}" for k in sorted(t)
+                if k not in ("id", "time_us", "kind", "host", "proc",
+                             "stream", "kernel", "op", "ici"))
+            if t.get("ici"):
+                detail += " ici=" + ",".join(
+                    f"{r['op']}:{r['bytes']}" for r in t["ici"])
+            rows.append([t.get("host", "graphd"), t.get("id", -1),
+                         t.get("time_us", 0), t.get("kind", ""),
+                         src, detail.strip()])
+        return InterimResult(
+            ["Host", "Id", "Time(us)", "Kind", "Source", "Detail"],
+            rows[:limit])
 
     def _show_events(self) -> InterimResult:
         """SHOW EVENTS: metad's cluster-wide aggregation (heartbeat

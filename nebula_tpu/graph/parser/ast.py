@@ -460,6 +460,7 @@ class ShowTarget(enum.Enum):
     STATS = "stats"                # SHOW STATS: daemon + cluster rollup
     EVENTS = "events"              # SHOW EVENTS: cluster event journal
     QUERIES = "queries"            # SHOW QUERIES: live query registry
+    TIMELINE = "timeline"          # SHOW TIMELINE: device flight recorder
 
 
 @dataclass
@@ -468,6 +469,7 @@ class ShowSentence(Sentence):
     target: ShowTarget = ShowTarget.SPACES
     module: Optional[str] = None  # SHOW CONFIGS graph
     name: Optional[str] = None    # SHOW USER/ROLES IN/CREATE * <name>
+    count: Optional[int] = None   # SHOW TIMELINE <n>: row cap
 
 
 @dataclass
@@ -575,6 +577,10 @@ class SequentialSentences:
     # response; EXPLAIN returns the executor plan without executing
     profile: bool = False
     explain: bool = False
+    # PROFILE FORMAT=trace: attach the flight-recorder Chrome-trace
+    # export (common/flight.py) instead of the raw span tree — host
+    # spans + device tick rows, openable in Perfetto/chrome://tracing
+    profile_format: Optional[str] = None
     # leading TIMEOUT <n> prefix: per-statement whole-request deadline
     # override in milliseconds (docs/admission.md); None = the
     # query_deadline_ms flag / client option applies

@@ -32,7 +32,7 @@ KEYWORDS = {
     "distinct", "as", "to", "upto", "match", "find", "path", "shortest",
     "all", "fetch", "prop", "on", "union", "intersect", "minus", "use",
     "show", "spaces", "tags", "edges", "hosts", "parts", "users", "configs",
-    "stats", "events", "queries", "kill", "query",
+    "stats", "events", "queries", "timeline", "kill", "query",
     "variables", "add", "remove", "create", "drop", "alter", "describe",
     "desc", "tag", "edge", "space", "if", "not", "exists", "insert",
     "vertex", "values", "update", "upsert", "set", "delete", "order", "by",

@@ -97,7 +97,7 @@ class _Parser:
         "storage", "path", "all", "in", "out", "both", "step", "of",
         # the live-query-plane words stay usable as names — only the
         # SHOW target / statement-head positions consume them as KWs
-        "queries", "kill", "query",
+        "queries", "timeline", "kill", "query",
     })
 
     def expect_id(self, what: str = "identifier") -> str:
@@ -135,6 +135,22 @@ class _Parser:
             self.next()
             if t.value.lower() == "profile":
                 out.profile = True
+                # optional FORMAT=trace suffix: the response carries
+                # the flight-recorder Chrome-trace export instead of
+                # the raw span tree.  FORMAT lexes as a plain ID (not
+                # a keyword — same stance as PROFILE itself), so it is
+                # special-cased only here, right after the prefix.
+                f = self.peek()
+                if f.type == "ID" and isinstance(f.value, str) \
+                        and f.value.lower() == "format":
+                    self.next()
+                    self.expect_sym("=")
+                    v = self.next()
+                    if not (v.type == "ID" and isinstance(v.value, str)
+                            and v.value.lower() in ("trace", "tree")):
+                        self.fail("PROFILE FORMAT must be trace or tree")
+                    if v.value.lower() == "trace":
+                        out.profile_format = "trace"
             else:
                 out.explain = True
         # optional TIMEOUT <ms> prefix (after PROFILE/EXPLAIN when both
@@ -937,11 +953,18 @@ class _Parser:
                    "parts": ast.ShowTarget.PARTS, "users": ast.ShowTarget.USERS,
                    "stats": ast.ShowTarget.STATS,
                    "events": ast.ShowTarget.EVENTS,
-                   "queries": ast.ShowTarget.QUERIES}
+                   "queries": ast.ShowTarget.QUERIES,
+                   "timeline": ast.ShowTarget.TIMELINE}
         kw = self.next()
         if kw.type != "KW" or kw.value not in mapping:
             self.fail("expected SHOW target")
-        return ast.ShowSentence(target=mapping[kw.value])
+        count = None
+        if kw.value == "timeline" and self.peek().type == "INT":
+            # SHOW TIMELINE <n>: cap the per-replica record fan-out
+            count = int(self.next().value)
+            if count <= 0:
+                self.fail("SHOW TIMELINE count must be positive")
+        return ast.ShowSentence(target=mapping[kw.value], count=count)
 
     def p_kill(self) -> ast.KillQuerySentence:
         self.expect_kw("kill")

@@ -99,7 +99,8 @@ def current() -> Optional[int]:
 class _Entry:
     __slots__ = ("qid", "session", "user", "stmt", "cls", "space",
                  "mode", "phase", "hop", "lane", "joined_tick",
-                 "ending", "start_us", "deadline", "kill_flag")
+                 "ending", "tl_first", "tl_last", "start_us",
+                 "deadline", "kill_flag")
 
     def __init__(self, qid, session, user, stmt, cls, space, mode,
                  dl):
@@ -115,6 +116,8 @@ class _Entry:
         self.lane = -1
         self.joined_tick = -1
         self.ending = None        # protocol continuous-ending, once done
+        self.tl_first = -1        # first/last flight-recorder tick id
+        self.tl_last = -1         # for the rider's stream (flight.py)
         self.start_us = now_micros()
         self.deadline = dl
         self.kill_flag = False
@@ -197,16 +200,31 @@ class QueryRegistry:
         if e is not None:
             e.ending = ending
 
+    def note_timeline(self, qid: Optional[int], rec_id: int) -> None:
+        """Anchor the rider's stream to a flight-recorder tick id
+        (common/flight.py): the first note pins tl_first, every note
+        advances tl_last — the pump calls this once per tick per
+        seated rider."""
+        e = self._entries.get(qid) if qid is not None else None
+        if e is not None:
+            if e.tl_first < 0:
+                e.tl_first = rec_id
+            e.tl_last = rec_id
+
     def seat_markers(self, qid: Optional[int]) -> Optional[dict]:
         """The continuous-tier seat trajectory of a still-registered
-        statement — lane, joined_tick, hop count, typed ending — or
-        None when it never rode a lane batch.  The engine folds this
-        into slow-query-log entries before unregistering."""
+        statement — lane, joined_tick, hop count, typed ending, and
+        the [first, last] recorder tick-id window — or None when it
+        never rode a lane batch.  The engine folds this into
+        slow-query-log entries before unregistering."""
         e = self._entries.get(qid) if qid is not None else None
         if e is None or (e.lane < 0 and e.ending is None):
             return None
-        return {"lane": e.lane, "joined_tick": e.joined_tick,
-                "hops": e.hop, "ending": e.ending}
+        out = {"lane": e.lane, "joined_tick": e.joined_tick,
+               "hops": e.hop, "ending": e.ending}
+        if e.tl_first >= 0:
+            out["timeline"] = [e.tl_first, e.tl_last]
+        return out
 
     # ------------------------------------------------------- kill
     def kill(self, qid: int) -> bool:

@@ -247,11 +247,31 @@ class ExecutionEngine:
             if phases:
                 tracing.observe_phases(phases)
             if profiled and tree is not None:
-                resp["profile"] = tree
-                if phases:
-                    resp["profile"]["critical_path"] = phases
-                    resp["profile"]["critical_path_summary"] = \
-                        tracing.critical_path_summary(phases)
+                if resp.pop("_profile_format", None) == "trace":
+                    # PROFILE FORMAT=trace: the flight-recorder
+                    # Chrome-trace export — host spans from this
+                    # query's tree stitched above the device tick rows
+                    # (clipped to the statement's recorder window when
+                    # it rode a lane batch), openable in Perfetto /
+                    # chrome://tracing (docs/observability.md)
+                    from ..common import flight
+                    seat = query_registry.seat_markers(
+                        resp.get("_qid"))
+                    ticks = flight.recorder.export()
+                    tl = (seat or {}).get("timeline")
+                    if tl:
+                        win = [t for t in ticks
+                               if tl[0] <= t.get("id", -1) <= tl[1]]
+                        ticks = win or ticks
+                    resp["profile"] = flight.chrome_trace(
+                        tree=tree, ticks=ticks, seat=seat)
+                else:
+                    resp["profile"] = tree
+                    if phases:
+                        resp["profile"]["critical_path"] = phases
+                        resp["profile"]["critical_path_summary"] = \
+                            tracing.critical_path_summary(phases)
+        resp.pop("_profile_format", None)
         qid = resp.pop("_qid", None)
         threshold = flags.get("slow_query_threshold_ms", 0)
         if threshold and resp.get("latency_in_us", 0) >= threshold * 1000:
@@ -284,6 +304,10 @@ class ExecutionEngine:
             return resp, False
 
         seq = parsed.value()
+        if seq.profile and seq.profile_format:
+            # surfaced to execute() through the response dict like
+            # _qid — popped there before the client sees it
+            resp["_profile_format"] = seq.profile_format
         ectx = ExecutionContext(session, self.meta, self.schema_man,
                                 self.storage, tpu_runtime=self.tpu_runtime,
                                 router=self.router)
@@ -493,6 +517,19 @@ class GraphService:
     # daemonStats shape, meta/service.py rpc_showQueries/rpc_killQuery)
     def rpc_listQueries(self, req: dict) -> dict:
         return {"queries": query_registry.snapshot()}
+
+    # metad's SHOW TIMELINE fan-out target (meta/service.py
+    # rpc_showTimeline): this replica's flight-recorder records,
+    # newest first (common/flight.py)
+    def rpc_listTimeline(self, req: dict) -> dict:
+        try:
+            limit = int(req.get("limit", 64))
+        except (TypeError, ValueError):
+            limit = 64
+        from ..common import flight
+        from ..common.stats import PROC_TOKEN
+        return {"ticks": [dict(t, proc=PROC_TOKEN)
+                          for t in flight.recorder.dump(limit=limit)]}
 
     def rpc_killQuery(self, req: dict) -> dict:
         try:

@@ -157,7 +157,7 @@ class MetaService:
     # event stores (their own locks) — same reasoning.
     _UNLOCKED_RPCS = ("rpc_download", "rpc_ingest", "rpc_showStats",
                       "rpc_listEvents", "rpc_showQueries",
-                      "rpc_killQuery")
+                      "rpc_showTimeline", "rpc_killQuery")
 
     def _locked(self, fn):
         if fn.__name__ in self._UNLOCKED_RPCS:
@@ -392,6 +392,29 @@ class MetaService:
                 for q in (r or {}).get("queries", []):
                     queries[q["id"]] = dict(q, host=h)
         return {"queries": list(queries.values())}
+
+    def rpc_showTimeline(self, req: dict) -> dict:
+        """SHOW TIMELINE fan-out: one ``listTimeline`` RPC per live
+        graphd replica (the showQueries shape).  Records keep their
+        per-process ids and gain a ``host`` tag; an unreachable
+        replica is skipped — the timeline statement must not hang on
+        a dead graphd."""
+        try:
+            limit = int(req.get("limit", 64))
+        except (TypeError, ValueError):
+            limit = 64
+        admin = getattr(self.balancer, "admin", None)
+        ticks: List[dict] = []
+        if admin is not None:
+            for h in self._live_graph_hosts():
+                try:
+                    r = admin.cm.call(HostAddr.parse(h),
+                                      "listTimeline", {"limit": limit})
+                except Exception:  # noqa: BLE001 — replica churn
+                    continue
+                for t in (r or {}).get("ticks", []):
+                    ticks.append(dict(t, host=h))
+        return {"ticks": ticks}
 
     def rpc_killQuery(self, req: dict) -> dict:
         """KILL QUERY fan-out: ids carry a process tag, so the first

@@ -17,7 +17,7 @@ import concurrent.futures
 import threading
 from typing import Dict, List, Optional
 
-from ..common import protocol
+from ..common import flight, protocol
 from ..common.clock import Duration
 from ..common.deadline import DeadlineExceeded
 from ..common.flags import flags
@@ -435,6 +435,11 @@ class StorageService:
                                reason, protocol.PEER_OPAQUE_EVENTS)
             return {"ok": False, "reason": wire_reason}
         stats.add_value("tpu.peer_absorb.windows_served")
+        # the served window lands on THIS host's device timeline too:
+        # peer absorb traffic competes with local dispatches for the
+        # link, so "why was this tick slow" needs it (common/flight.py)
+        flight.recorder.note_dispatch(
+            "peer_delta_serve", space=space_id, events=len(events))
         return {"ok": True, "events": [list(e) for e in events],
                 "version": ver}
 

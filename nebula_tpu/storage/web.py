@@ -8,6 +8,12 @@ StorageServer.cpp:60-89):
                                           snapshot files into the space
   GET /admin                              raft part status
 
+The WebService builtins ride along on every storaged too — notably
+GET /timeline (the device flight recorder, common/flight.py): this
+host's absorb windows and peer-delta serves land there, so a slow
+continuous tick on a graphd can be cross-read against the storaged
+that fed it (docs/observability.md "The device timeline").
+
 The reference's /download shells out to ``hdfs dfs -get``
 (/root/reference/src/common/hdfs/HdfsCommandHelper.h); we do the same
 for ``hdfs://`` urls when an ``hdfs`` binary is on PATH (tests fake one,

@@ -69,6 +69,7 @@ ENDPOINT_CONTRACT = {
     "/healthz": {"keys": {"healthy", "checks"}, "dynamic": True},
     "/events": {"keys": {"error", "events"}, "dynamic": True},
     "/queries": {"keys": {"error", "queries"}, "dynamic": True},
+    "/timeline": {"keys": {"error", "ticks"}, "dynamic": True},
 }
 
 

@@ -779,6 +779,55 @@ def bench_mc(budget_s: float) -> dict:
             "within_budget": clean and wall <= budget_s}
 
 
+def bench_timeline_path(reps: int, record_budget_ns: float = 50_000.0,
+                        export_budget_s: float = 1.0) -> dict:
+    """Flight-recorder hot-path cost (docs/observability.md "The
+    device timeline"): per-record latency of note_tick /
+    note_sharded_dispatch with the ring at capacity — the only cost
+    nebulaprof adds to every pump tick and sharded dispatch — plus
+    one full Chrome-trace export at timeline_export_max_ticks.
+    Deterministic budget guard, like bench_metrics: a record over
+    ``record_budget_ns`` or an export over ``export_budget_s`` fails
+    the run.  The end-to-end confirmation is query_path's GO/s
+    (measured recorder-on, pinned in BASELINE.md)."""
+    from ..common import flight
+    from ..common.flags import flags
+    r = flight.FlightRecorder()
+    n = max(2000, reps * 100)
+    # pre-fill so every note below exercises the wrap path
+    for i in range(int(flags.get("flight_recorder_size") or 1024) + 1):
+        r.note_tick(stream=0, tick=i, seats=4, joins=1, leaves=1,
+                    evictions=0, join_us=10, hop_us=900, extract_us=60,
+                    clear_us=10, assemble_us=120, idle_us=5,
+                    dur_us=1100, generation=1)
+    t0 = time.perf_counter()
+    for i in range(n):
+        r.note_tick(stream=0, tick=i, seats=4, joins=1, leaves=1,
+                    evictions=0, join_us=10, hop_us=900, extract_us=60,
+                    clear_us=10, assemble_us=120, idle_us=5,
+                    dur_us=1100, generation=1)
+    tick_ns = (time.perf_counter() - t0) / n * 1e9
+    m = max(500, reps * 10)
+    t0 = time.perf_counter()
+    for i in range(m):
+        r.note_sharded_dispatch(
+            "ell_go_sharded", 8,
+            [("sharding_constraint", 1 << 16)], 1 << 17,
+            rung=1024, steps=3)
+    shard_ns = (time.perf_counter() - t0) / m * 1e9
+    t0 = time.perf_counter()
+    trace = flight.chrome_trace(ticks=r.export())
+    export_s = time.perf_counter() - t0
+    return {"tick_ns_per_record": round(tick_ns, 1),
+            "sharded_ns_per_record": round(shard_ns, 1),
+            "export_s": round(export_s, 4),
+            "export_events": len(trace["traceEvents"]),
+            "record_budget_ns": record_budget_ns,
+            "within_budget": (tick_ns <= record_budget_ns
+                              and shard_ns <= record_budget_ns
+                              and export_s <= export_budget_s)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -823,6 +872,7 @@ def main(argv=None) -> int:
         "peer_absorb_path": bench_peer_absorb(reps),
         "continuous_path": bench_continuous_path(reps),
         "kernel_roofline": bench_kernel_roofline(reps),
+        "timeline_path": bench_timeline_path(reps),
         "lint": bench_lint(args.lint_budget_s),
         "mc_path": bench_mc(args.mc_budget_s),
     }
@@ -836,7 +886,8 @@ def main(argv=None) -> int:
         and out["absorb_path"]["within_budget"] \
         and out["peer_absorb_path"]["within_budget"] \
         and out["continuous_path"]["within_budget"] \
-        and out["kernel_roofline"]["within_budget"]
+        and out["kernel_roofline"]["within_budget"] \
+        and out["timeline_path"]["within_budget"]
     return 0 if ok else 1
 
 

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..common import deadline as deadlines
+from ..common import flight as _flight
 from ..common import mc_hooks
 from ..common import protocol
 from ..common import tracing
@@ -189,8 +190,11 @@ flags.define(
     "sample every Nth dense/sparse device dispatch with a "
     "block_until_ready timestamp around the kernel — the device-"
     "compute-vs-link split (tpu.device_compute.latency_us histogram, "
-    "achieved-GB/s gauge, BASELINE.md roofline columns).  0 disables "
-    "sampling (no serialization of the dispatch pipeline at all)")
+    "achieved-GB/s gauge, BASELINE.md roofline columns) AND the "
+    "flight-recorder kernel-timing rows that feed the live-vs-"
+    "declared HBM drift fold (common/flight.py, docs/observability.md "
+    "'The device timeline').  0 disables sampling (no serialization "
+    "of the dispatch pipeline at all, and no timing rows)")
 flags.define(
     "tpu_adaptive_single", True,
     "single-query GO runs the adaptive sparse-frontier kernel "
@@ -877,8 +881,15 @@ class TpuQueryRuntime:
                     m._absorb_declined_ver = ver
                 self._note_absorb_failure(space_id, reason, n_events)
                 return None
-            _stats.observe("tpu.absorb.latency_us",
-                           (time.perf_counter() - t0) * 1e6)
+            wall_us = (time.perf_counter() - t0) * 1e6
+            _stats.observe("tpu.absorb.latency_us", wall_us)
+            # mirror maintenance on the device timeline: absorb
+            # windows interleave with query dispatches, and "why was
+            # this tick slow" is often "an absorb ran" (flight.py)
+            _flight.recorder.note_dispatch(
+                "ell_absorb", space=space_id, events=n_events,
+                wall_us=int(wall_us),
+                generation=int(getattr(out, "generation", -1)))
             return out
 
     def _note_absorb_failure(self, space_id: int, reason: str,
@@ -1858,6 +1869,9 @@ class TpuQueryRuntime:
             out_dev = kern(jnp.asarray(ids), jnp.asarray(qid), ecnt, e0,
                            *extra, *ix.kernel_args()[1:])
         self._bump("go_sparse")
+        _flight.recorder.note_dispatch(
+            "sparse_go", rung=c0, steps=steps,
+            h2d_bytes=int(ids.nbytes + qid.nbytes))
         self._maybe_time_device(
             out_dev, sum(c * (d_max + 12) * 4 for c in caps[1:]),
             kind="sparse_go")
@@ -1952,6 +1966,17 @@ class TpuQueryRuntime:
             out_dev = kern(jnp.asarray(placed[0]), jnp.asarray(placed[1]),
                            args[0], args[1], args[2], *args[3], *args[4])
         self._bump("go_mesh_sparse")
+        # live ICI accounting: per hop the candidate router ships two
+        # [k, cap_x] int32 planes, the hub router two [k, cap_e], and
+        # the overflow/early-exit scalars ride a psum — folded against
+        # the spec's fx.steps-scaled bound at the SAME live caps
+        self._note_sharded_ici(
+            "mesh_sparse_go", k,
+            [("all_to_all", 2 * 4 * k * (cap_x + cap_e) * steps),
+             ("psum", 4 * k * steps)],
+            ell=ix, c0s=(c0,), steps=steps, sparse_cap=cap,
+            sparse_growth=int(flags.get("tpu_sparse_growth") or 8),
+            fields={"rung": c0, "steps": steps})
 
         def resolve():
             overflow, qids, vids_new = sharded_sparse_pairs(
@@ -2038,6 +2063,16 @@ class TpuQueryRuntime:
             with tracing.span("tpu.kernel", kind="ell_go_sharded",
                               width=B, packed=True):
                 out_dev = kern(f0_dev, eslot, hrows, *nbrs, *ets)
+            # live ICI accounting: steps-1 frontier re-replications,
+            # (k-1)/k of the packed [n_rows+1, W] matrix each
+            fbytes = (ix.n_rows + 1) * lanes_width(B)
+            self._note_sharded_ici(
+                "ell_go_sharded", mesh.shape["parts"],
+                [("sharding_constraint",
+                  fbytes * max(steps - 1, 1))],
+                ell=ix, widths=(B,), steps=steps,
+                fields={"rung": B, "steps": steps,
+                        "h2d_bytes": fbytes})
         elif count_mode:
             deg = self._deg_dev(m, ix, et_tuple)
             kern = self._kernel(
@@ -2080,6 +2115,11 @@ class TpuQueryRuntime:
                 with tracing.span("tpu.kernel", kind="ell_go", width=B):
                     out_dev = kern(f0_dev, *args)
         self._bump("go_dense")
+        if mesh_mt is None:
+            # sharded dispatches already logged a (richer) row above
+            _flight.recorder.note_dispatch(
+                "ell_go_count" if count_mode else "ell_go",
+                rung=B, steps=steps, hop_bytes=int(hop_bytes))
         self._maybe_time_device(out_dev, hop_bytes, kind="ell_go")
 
         if count_mode:
@@ -2352,6 +2392,40 @@ class TpuQueryRuntime:
             self.stats["device_timed_dispatches"] += 1
         _stats.observe("tpu.device_compute.latency_us", dt * 1e6,
                        kind=kind)
+        gbps = (bytes_moved / dt / 1e9) if dt > 0 else 0.0
+        _flight.recorder.note_timing(kind, dt * 1e6, int(bytes_moved),
+                                     gbps)
+        if gbps > 0:
+            # live-vs-declared HBM fold: achieved streaming rate above
+            # the MESH_MODEL bandwidth means the roofline model is
+            # stale — tpu.model_drift fires typed (common/flight.py)
+            _flight.recorder.fold("hbm", kind, gbps,
+                                  float(MESH_MODEL["hbm_gbps"]))
+
+    def _note_sharded_ici(self, kernel_name: str, k: int, ops,
+                          trips: int = 1,
+                          fields: Optional[dict] = None,
+                          **shape) -> None:
+        """Fold one sharded dispatch's live per-collective ICI bytes
+        against the registry-declared ``KernelSpec.ici_bytes`` bound
+        evaluated at the LIVE shapes — ``shape`` becomes the ``fx``
+        the spec's bound function reads, ``trips`` multiplies a
+        per-level bound (BFS declares per level; the live side ships
+        one exchange per level too, so both sides scale together).
+        This is the meshaudit invariant checked on the RUNNING system
+        instead of a traced fixture; the recorder fires
+        ``tpu.model_drift`` on live > declared (common/flight.py)."""
+        spec = kernels.KERNEL_REGISTRY.get(kernel_name)
+        if spec is None or spec.ici_bytes is None:
+            return
+        from types import SimpleNamespace
+        try:
+            declared = int(spec.ici_bytes(SimpleNamespace(**shape),
+                                          k)) * max(int(trips), 1)
+        except Exception:   # noqa: BLE001 — accounting never fails a dispatch
+            return
+        _flight.recorder.note_sharded_dispatch(
+            kernel_name, k, ops, declared, **(fields or {}))
 
     # ------------------------------------------------ host assembly
     def _assemble_results(self, space_id: int, m: CsrMirror,
@@ -3315,6 +3389,20 @@ class TpuQueryRuntime:
             dense_hop_bytes(ix, lanes_width(B) if packed_mode else B,
                             max_steps + 1),
             kind="ell_bfs")
+        if mt is not None:
+            # live ICI accounting: the spec declares the frontier
+            # re-replication PER LEVEL; trips scales both sides by the
+            # level count so the fold compares like with like
+            fbytes = (ix.n_rows + 1) * lanes_width(B)
+            self._note_sharded_ici(
+                "ell_bfs_sharded", mesh.shape["parts"],
+                [("sharding_constraint", fbytes * max_steps)],
+                trips=max_steps, ell=ix, widths=(B,),
+                fields={"rung": B, "steps": max_steps,
+                        "h2d_bytes": 2 * fbytes})
+        else:
+            _flight.recorder.note_dispatch(
+                "ell_bfs", rung=B, steps=max_steps)
         nqp = min(B, max(8, -(-nq // 8) * 8))
         with tracing.span("tpu.fetch"):
             host = np.asarray(d_dev[:, :nqp])[:, :nq]   # device slice
@@ -3392,6 +3480,15 @@ class TpuQueryRuntime:
             return None
         self._bump("path_device", nq)
         self._bump("bfs_mesh_sparse")
+        # live ICI accounting: per level, two [k, cap_x] candidate
+        # planes + two [k, cap_e] hub planes + the psum'd scalars —
+        # the spec's per-level bound rides trips like the levels do
+        self._note_sharded_ici(
+            "mesh_sparse_bfs", k,
+            [("all_to_all", 2 * 4 * k * (cap_x + cap_e) * max_steps),
+             ("psum", 4 * k * max_steps)],
+            trips=max_steps, sparse_cap=cap,
+            fields={"rung": cap, "steps": max_steps})
         # device-side column slice before the fetch, like the
         # replicated path — B-nq padded columns are pure link waste
         nqp = min(B, max(8, -(-nq // 8) * 8))

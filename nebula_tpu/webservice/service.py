@@ -23,6 +23,11 @@ HTTP server exposing
                                     health check passes, else 503
   GET /events[?limit=N]             event journal, newest first
                                     (common/events.py)
+  GET /timeline[?limit=N]           flight-recorder device timeline,
+                                    newest first; ?format=trace (plus
+                                    optional ?trace=<hex>) exports
+                                    Chrome-trace JSON (common/flight.py,
+                                    docs/observability.md)
 
 plus ``register_handler(path, fn)`` for daemon-specific paths (storage's
 /download /ingest /admin, meta's /*-dispatch — SURVEY.md §2.10) and
@@ -58,6 +63,7 @@ class WebService:
         self.register_handler("/healthz", self._healthz)
         self.register_handler("/events", self._events)
         self.register_handler("/queries", self._queries)
+        self.register_handler("/timeline", self._timeline)
         outer = self
 
         class _Req(BaseHTTPRequestHandler):
@@ -243,6 +249,41 @@ class WebService:
         except ValueError:
             return 400, {"error": f"bad limit {q.get('limit')!r}"}
         return 200, {"events": journal.dump(limit=limit)}
+
+    def _timeline(self, q: dict, body: bytes):
+        """The device flight recorder, THIS process only
+        (common/flight.py; cluster-wide is SHOW TIMELINE's metad
+        fan-out).
+        GET /timeline[?limit=N]       recorder records, newest first
+        GET /timeline?format=trace    Chrome-trace JSON of the last
+                                      records (timeline_export_max_ticks
+                                      caps the stitch), optionally
+                                      joined with one span tree via
+                                      ?trace=<hex> — open the payload
+                                      in chrome://tracing / Perfetto."""
+        from ..common import flight
+        from ..common.tracing import trace_store
+        raw = q.get("limit")
+        try:
+            limit = int(raw) if raw is not None else None
+        except ValueError:
+            return 400, {"error": f"bad limit {raw!r}"}
+        if q.get("format") == "trace":
+            tree = None
+            tid = q.get("trace")
+            if tid:
+                try:
+                    tree = trace_store.tree(int(tid, 16))
+                except ValueError:
+                    return 400, {"error": f"bad trace id {tid!r}"}
+                if tree is None:
+                    return 404, {"error": f"trace {tid} not found "
+                                          "(evicted or never sampled)"}
+            trace = flight.chrome_trace(
+                tree=tree, ticks=flight.recorder.export(limit))
+            return 200, trace
+        return 200, {"ticks": flight.recorder.dump(
+            limit=64 if limit is None else limit)}
 
     def _queries(self, q: dict, body: bytes):
         """The live query registry, THIS process only

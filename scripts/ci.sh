@@ -17,9 +17,14 @@
 #   4. micro_bench — the performance-budget components (`--quick`
 #      statistics are noisier but the budgets are sized for it); the
 #      lint cold-wall budget (40 s), the mc smoke-sweep budget, the
-#      admission/recovery/absorb/continuous path budgets and the
-#      kernel roofline all gate here via micro_bench's own exit
+#      admission/recovery/absorb/continuous/timeline path budgets and
+#      the kernel roofline all gate here via micro_bench's own exit
 #      status.
+#
+# The Perfetto golden (tests/golden_timeline.json, the byte-stable
+# chrome_trace pin) rides along to $CI_ARTIFACT_DIR beside the SARIF
+# artifacts so a reviewer can open the reference timeline in
+# chrome://tracing without checking the branch out.
 #
 # scripts/lint.sh remains the interactive lint + sanitizer entry
 # point; this script is the merge gate CI calls.
@@ -45,5 +50,8 @@ JAX_PLATFORMS=cpu python -m nebula_tpu.tools.mc run --smoke --format=sarif \
 echo "== micro_bench (budget components, --quick) =="
 JAX_PLATFORMS=cpu python -m nebula_tpu.tools.micro_bench --quick \
   > "${ARTIFACT_DIR}/micro_bench.json"
+
+echo "== perfetto golden -> ${ARTIFACT_DIR}/golden_timeline.json =="
+cp tests/golden_timeline.json "${ARTIFACT_DIR}/golden_timeline.json"
 
 echo "ci.sh: merge gate green (artifacts in ${ARTIFACT_DIR}/)"

@@ -293,6 +293,24 @@ def test_runtime_mesh_sharded_parity():
         assert c.tpu_runtime.stats.get("go_mesh_sparse", 0) > 0
         assert c.tpu_runtime.stats.get("bfs_mesh_sparse", 0) > 0
         assert c.tpu_runtime.stats.get("path_device", 0) > 0
+        # live-vs-declared ICI accounting (common/flight.py): a healthy
+        # 8-way dryrun stays IN-BOUND on every sharded kernel's
+        # KernelSpec.ici_bytes model and the tpu.model_drift gauges
+        # read zero — the declared models hold on live dispatches
+        from nebula_tpu.common.flight import recorder
+        from nebula_tpu.common.stats import stats as _stats
+        mesh_kernels = ("ell_go_sharded", "ell_bfs_sharded",
+                        "mesh_sparse_go", "mesh_sparse_bfs")
+        cells = {k: v for k, v in recorder.drift_cells().items()
+                 if k.split("/", 1)[-1] in mesh_kernels}
+        assert cells, "mesh dispatches never folded ICI accounting"
+        for k, cell in cells.items():
+            assert 0 < cell["live"] <= cell["declared"], (k, cell)
+            assert not cell["over"], (k, cell)
+        drift = {labels: v for name, labels, v in _stats.gauges()
+                 if name == "tpu.model_drift.ici"
+                 and labels[0][1] in mesh_kernels}
+        assert drift and all(v == 0.0 for v in drift.values()), drift
     finally:
         flags.set("tpu_mesh_devices", 0)
         flags.set("tpu_mesh_mode", "sparse")
